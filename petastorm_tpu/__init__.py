@@ -1,0 +1,34 @@
+"""petastorm_tpu — a TPU-native data access framework for deep learning on
+Apache Parquet.
+
+Capability parity target: ``abditag2/petastorm`` (fork of ``uber/petastorm``),
+re-designed TPU-first: the storage/ETL plane is pure pyarrow (no Spark
+required), the decode plane is a GIL-releasing host thread pool, and the
+delivery plane is a double-buffered ``jax.device_put`` loader that feeds
+pjit/shard_map training loops (``petastorm_tpu.jax.DataLoader``).
+
+Public surface mirrors the reference's top level
+(``petastorm/__init__.py :: make_reader, make_batch_reader, TransformSpec``).
+Imports are lazy (PEP 562) so ``import petastorm_tpu`` stays cheap on hosts
+that only need the ETL side.
+"""
+
+__version__ = '0.1.0'
+
+_LAZY = {
+    'TransformSpec': 'petastorm_tpu.transform',
+    'Unischema': 'petastorm_tpu.unischema',
+    'UnischemaField': 'petastorm_tpu.unischema',
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module = importlib.import_module(_LAZY[name])
+        value = getattr(module, name)
+        globals()[name] = value
+        return value
+    raise AttributeError('module %r has no attribute %r' % (__name__, name))

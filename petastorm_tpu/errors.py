@@ -1,0 +1,32 @@
+"""Framework-wide exception types.
+
+Parity: reference ``petastorm/errors.py :: NoDataAvailableError`` and
+``petastorm/etl/dataset_metadata.py :: PetastormMetadataError``.
+"""
+
+
+class PetastormTpuError(Exception):
+    """Base class for all first-party errors."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """Raised when a reader is constructed over a selection that yields no rows
+    (e.g. all row groups pruned by predicates/selectors/sharding)."""
+
+
+class MetadataError(PetastormTpuError):
+    """Raised when dataset footer metadata is missing or malformed.
+
+    Parity: ``petastorm/etl/dataset_metadata.py :: PetastormMetadataError``.
+    """
+
+
+# Alias kept so code written against the reference's name keeps working.
+PetastormMetadataError = MetadataError
+
+
+class DecodeFieldError(PetastormTpuError):
+    """Raised when a codec fails to decode a field value.
+
+    Parity: ``petastorm/utils.py :: DecodeFieldError``.
+    """

@@ -1,0 +1,67 @@
+"""User preprocessing pushed into reader workers.
+
+Parity: reference ``petastorm/transform.py :: TransformSpec, transform_schema``.
+The transform runs inside the L2 decode plane (parallel, off the training
+thread) — row path gets a ``dict``, batch path gets a ``pandas.DataFrame``.
+"""
+
+from petastorm_tpu.unischema import Unischema
+
+__all__ = ['TransformSpec', 'transform_schema']
+
+
+class TransformSpec(object):
+    """Describes a worker-side transform and its effect on the schema.
+
+    ``func``: row path ``dict -> dict``; batch path ``DataFrame -> DataFrame``.
+    ``edit_fields``: list of ``UnischemaField`` (or 4/5-tuples
+    ``(name, numpy_dtype, shape, [codec,] nullable)``) added/modified by func.
+    ``removed_fields``: field names func drops.
+
+    Parity: ``petastorm/transform.py :: TransformSpec``.
+    """
+
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):
+        self.func = func
+        self.edit_fields = [self._normalize(f) for f in (edit_fields or [])]
+        self.removed_fields = list(removed_fields or [])
+        # selected_fields: keep-only projection applied after func (reference
+        # supports this on the batch path).
+        self.selected_fields = list(selected_fields) if selected_fields is not None else None
+
+    @staticmethod
+    def _normalize(field):
+        from petastorm_tpu.unischema import UnischemaField
+        if isinstance(field, UnischemaField):
+            return field
+        if isinstance(field, (tuple, list)):
+            if len(field) == 4:
+                name, dtype, shape, nullable = field
+                shape = tuple(shape) if shape is not None else ()
+                codec = None if shape == () else _default_tensor_codec()
+                return UnischemaField(name, dtype, shape, codec, nullable)
+            if len(field) == 5:
+                return UnischemaField(*field)
+        raise ValueError('edit_fields entries must be UnischemaField or 4/5-tuples, got %r' % (field,))
+
+
+def _default_tensor_codec():
+    from petastorm_tpu.codecs import NdarrayCodec
+    return NdarrayCodec()
+
+
+def transform_schema(schema, transform_spec):
+    """Compute the post-transform schema without running ``func``.
+
+    Parity: ``petastorm/transform.py :: transform_schema``.
+    """
+    removed = set(transform_spec.removed_fields)
+    fields = {name: f for name, f in schema.fields.items() if name not in removed}
+    for f in transform_spec.edit_fields:
+        fields[f.name] = f
+    if transform_spec.selected_fields is not None:
+        missing = set(transform_spec.selected_fields) - set(fields)
+        if missing:
+            raise ValueError('selected_fields not in post-transform schema: %s' % sorted(missing))
+        fields = {name: f for name, f in fields.items() if name in transform_spec.selected_fields}
+    return Unischema(schema.name + '_transformed', list(fields.values()))

@@ -1,0 +1,344 @@
+"""Unischema: a single-source-of-truth schema with numpy / pyarrow / Spark /
+JAX projections.
+
+Parity surface: reference ``petastorm/unischema.py :: Unischema,
+UnischemaField, create_schema_view (method), match_unischema_fields,
+dict_to_spark_row, insert_explicit_nulls``.
+
+TPU-first additions (not in the reference):
+
+* ``Unischema.as_arrow_schema()`` — the primary storage projection (the
+  reference's was Spark ``StructType``; ours is pyarrow because the ETL path
+  is a pyarrow ``ParquetWriter``).
+* ``UnischemaField`` -> ``jax.ShapeDtypeStruct`` projection
+  (``field_shape_dtype_struct`` / ``Unischema.as_shape_dtype_structs``) so a
+  loader batch can be described as a pytree of ShapeDtypeStructs and fed to
+  ``jax.eval_shape`` / pjit sharding annotations directly.
+* ``encode_row`` — the Spark-free twin of ``dict_to_spark_row``.
+"""
+
+import re
+from collections import OrderedDict, namedtuple
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.codecs import ScalarCodec, _arrow_type_for_numpy
+
+__all__ = [
+    'Unischema',
+    'UnischemaField',
+    'dict_to_spark_row',
+    'encode_row',
+    'insert_explicit_nulls',
+    'match_unischema_fields',
+    'field_shape_dtype_struct',
+]
+
+
+class UnischemaField(namedtuple('UnischemaField', ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])):
+    """A single field: ``(name, numpy_dtype, shape, codec, nullable)``.
+
+    ``shape`` is a tuple; ``None`` entries are wildcard dimensions (variable
+    per row). ``codec=None`` means "native scalar column" and implies
+    ``shape == ()``.
+
+    Parity: ``petastorm/unischema.py :: UnischemaField`` (a namedtuple there
+    too, so instances pickle the same way).
+    """
+
+    __slots__ = ()
+
+    def __new__(cls, name, numpy_dtype, shape=(), codec=None, nullable=False):
+        if shape is None:
+            shape = ()
+        shape = tuple(shape)
+        if codec is None and len(shape) > 0:
+            # Scalars may omit the codec; tensors must say how they serialize.
+            raise ValueError('Field %r has non-scalar shape %r but no codec' % (name, shape))
+        return super(UnischemaField, cls).__new__(cls, name, numpy_dtype, shape, codec, nullable)
+
+    @property
+    def codec_or_default(self):
+        """Effective codec: an inferred ``ScalarCodec`` when ``codec is None``."""
+        if self.codec is not None:
+            return self.codec
+        return ScalarCodec(self.numpy_dtype)
+
+    def __eq__(self, other):
+        if not isinstance(other, UnischemaField):
+            return NotImplemented
+        return (self.name == other.name
+                and np.dtype(self.numpy_dtype) == np.dtype(other.numpy_dtype)
+                and self.shape == other.shape
+                and self.codec == other.codec
+                and self.nullable == other.nullable)
+
+    def __ne__(self, other):
+        result = self.__eq__(other)
+        return result if result is NotImplemented else not result
+
+    def __hash__(self):
+        return hash((self.name, np.dtype(self.numpy_dtype).str, self.shape, self.nullable))
+
+
+def field_shape_dtype_struct(field, leading_dims=(), wildcard_overrides=None):
+    """Project a ``UnischemaField`` to a ``jax.ShapeDtypeStruct``.
+
+    ``leading_dims`` prepends batch/sequence dimensions.  Wildcard (``None``)
+    dimensions must be resolved via ``wildcard_overrides`` (a full replacement
+    shape tuple) because XLA requires static shapes.
+
+    TPU-first addition; the reference's closest analog is the tf dtype/shape
+    projection in ``petastorm/tf_utils.py :: _schema_to_tf_dtypes``.
+    """
+    import jax
+
+    shape = tuple(wildcard_overrides) if wildcard_overrides is not None else field.shape
+    if any(d is None for d in shape):
+        raise ValueError(
+            'Field %r has wildcard dims %r; pass wildcard_overrides to resolve them '
+            '(XLA requires static shapes)' % (field.name, shape))
+    return jax.ShapeDtypeStruct(tuple(leading_dims) + shape, np.dtype(field.numpy_dtype))
+
+
+class Unischema(object):
+    """An ordered collection of :class:`UnischemaField`.
+
+    Parity: ``petastorm/unischema.py :: Unischema`` — attribute access per
+    field, ``create_schema_view``, namedtuple row-type generation,
+    ``as_spark_schema`` (optional), plus our arrow/JAX projections.
+    """
+
+    def __init__(self, name, fields):
+        self._name = name
+        self._fields = OrderedDict((f.name, f) for f in sorted(fields, key=lambda f: f.name))
+        self._namedtuple = None
+
+    def __getattr__(self, item):
+        # Attribute access per field (schema.my_field). Class-level attributes
+        # (name/fields/methods) win, so fields shadowed by those are reachable
+        # via schema.fields['name'].
+        fields = self.__dict__.get('_fields')
+        if fields is not None and item in fields:
+            return fields[item]
+        raise AttributeError('Schema %r has no field %r' % (self.__dict__.get('_name'), item))
+
+    @property
+    def fields(self):
+        return self._fields
+
+    @property
+    def name(self):
+        return self._name
+
+    def create_schema_view(self, fields):
+        """Sub-schema selection.
+
+        ``fields`` may mix :class:`UnischemaField` instances and regex
+        pattern strings (full-matched against field names).
+
+        Parity: ``petastorm/unischema.py :: Unischema.create_schema_view``.
+        """
+        frozen = []
+        patterns = []
+        for f in fields:
+            if isinstance(f, UnischemaField):
+                if f.name not in self._fields:
+                    raise ValueError('Field %r does not belong to schema %r' % (f.name, self._name))
+                frozen.append(f)
+            elif isinstance(f, str):
+                patterns.append(f)
+            else:
+                raise ValueError('create_schema_view accepts UnischemaField or str, got %r' % (f,))
+        matched = match_unischema_fields(self, patterns) if patterns else []
+        view_fields = {f.name: f for f in matched}
+        view_fields.update({f.name: f for f in frozen})
+        return Unischema('%s_view' % self._name, list(view_fields.values()))
+
+    # -- row type ------------------------------------------------------------
+
+    def make_namedtuple(self, **kwargs):
+        """Build a row instance of this schema's namedtuple type."""
+        return self._get_namedtuple()(**kwargs)
+
+    def make_namedtuple_from_dict(self, row):
+        return self._get_namedtuple()(**{k: row.get(k) for k in self._fields})
+
+    def _get_namedtuple(self):
+        if self._namedtuple is None:
+            # Python >= 3.7 namedtuples have no 255-field limit, so the
+            # reference's _new_gt_255_compatible_namedtuple workaround
+            # collapses to a plain namedtuple here.
+            self._namedtuple = namedtuple(self._name, list(self._fields))
+        return self._namedtuple
+
+    # -- projections ---------------------------------------------------------
+
+    def as_arrow_schema(self):
+        """Storage projection: one pyarrow field per Unischema field, typed by
+        the field codec's storage type."""
+        return pa.schema([
+            pa.field(f.name, f.codec_or_default.arrow_dtype(), nullable=bool(f.nullable))
+            for f in self._fields.values()
+        ])
+
+    def as_spark_schema(self):
+        """Spark ``StructType`` projection (requires pyspark).
+
+        Parity: ``petastorm/unischema.py :: Unischema.as_spark_schema``.
+        """
+        from pyspark.sql.types import StructField, StructType
+        return StructType([
+            StructField(f.name, f.codec_or_default.spark_dtype(), f.nullable)
+            for f in self._fields.values()
+        ])
+
+    def as_shape_dtype_structs(self, leading_dims=(), wildcard_overrides=None):
+        """JAX projection: ``{name: jax.ShapeDtypeStruct}`` for all fields.
+
+        ``wildcard_overrides`` maps field name -> replacement shape for fields
+        with ``None`` dims.  TPU-first addition (see module docstring).
+        """
+        overrides = wildcard_overrides or {}
+        return {
+            name: field_shape_dtype_struct(f, leading_dims, overrides.get(name))
+            for name, f in self._fields.items()
+        }
+
+    @classmethod
+    def from_arrow_schema(cls, arrow_schema, omit_unsupported_fields=True):
+        """Infer a scalar Unischema from a plain Parquet/arrow schema.
+
+        Used by the batch-reader path over vanilla Parquet stores.
+        Parity: ``petastorm/etl/dataset_metadata.py :: infer_or_load_unischema``
+        (the infer half) and ``petastorm/unischema.py`` arrow inference.
+        """
+        fields = []
+        for arrow_field in arrow_schema:
+            np_dtype = _numpy_dtype_for_arrow(arrow_field.type)
+            if np_dtype is None:
+                if omit_unsupported_fields:
+                    continue
+                raise ValueError('Unsupported arrow type %r for field %r'
+                                 % (arrow_field.type, arrow_field.name))
+            if pa.types.is_list(arrow_field.type) or pa.types.is_large_list(arrow_field.type):
+                fields.append(UnischemaField(arrow_field.name, np_dtype, (None,),
+                                             codec=_PassthroughListCodec(np_dtype),
+                                             nullable=arrow_field.nullable))
+            else:
+                fields.append(UnischemaField(arrow_field.name, np_dtype, (),
+                                             codec=None, nullable=arrow_field.nullable))
+        return cls('inferred', fields)
+
+    def __str__(self):
+        return 'Unischema(%s, %s)' % (self._name, list(self._fields))
+
+    __repr__ = __str__
+
+    def __eq__(self, other):
+        return (isinstance(other, Unischema)
+                and list(self._fields.values()) == list(other._fields.values()))
+
+    def __hash__(self):
+        return hash(tuple(self._fields))
+
+    def __reduce__(self):
+        # Stable pickling independent of the lazily-built namedtuple cache.
+        return (self.__class__, (self._name, list(self._fields.values())))
+
+
+class _PassthroughListCodec(object):
+    """Internal codec for inferred variable-length list columns (batch path)."""
+
+    def __init__(self, np_dtype):
+        self._np_dtype = np.dtype(np_dtype)
+
+    def encode(self, unischema_field, value):
+        return np.asarray(value, dtype=self._np_dtype).tolist()
+
+    def decode(self, unischema_field, value):
+        return np.asarray(value, dtype=self._np_dtype)
+
+    def arrow_dtype(self):
+        return pa.list_(_arrow_type_for_numpy(self._np_dtype))
+
+    def __eq__(self, other):
+        return isinstance(other, _PassthroughListCodec) and self._np_dtype == other._np_dtype
+
+    def __hash__(self):
+        return hash(('_PassthroughListCodec', self._np_dtype.str))
+
+
+def _numpy_dtype_for_arrow(arrow_type):
+    try:
+        if pa.types.is_list(arrow_type) or pa.types.is_large_list(arrow_type):
+            return _numpy_dtype_for_arrow(arrow_type.value_type)
+        if pa.types.is_string(arrow_type) or pa.types.is_large_string(arrow_type):
+            return np.dtype('O')
+        if pa.types.is_binary(arrow_type) or pa.types.is_large_binary(arrow_type):
+            return np.dtype('O')
+        if pa.types.is_timestamp(arrow_type) or pa.types.is_date(arrow_type):
+            return np.dtype('datetime64[ns]')
+        if pa.types.is_decimal(arrow_type):
+            return np.dtype('O')
+        return np.dtype(arrow_type.to_pandas_dtype())
+    except (NotImplementedError, TypeError):
+        return None
+
+
+def match_unischema_fields(schema, field_regex):
+    """Return schema fields whose names full-match any of ``field_regex``.
+
+    Parity: ``petastorm/unischema.py :: match_unischema_fields`` (the modern
+    fullmatch semantics; the legacy partial-match behavior is not replicated).
+    """
+    if isinstance(field_regex, str):
+        field_regex = [field_regex]
+    compiled = [re.compile(p) for p in field_regex]
+    return [f for name, f in schema.fields.items()
+            if any(c.fullmatch(name) for c in compiled)]
+
+
+def insert_explicit_nulls(unischema, row_dict):
+    """Fill missing keys with ``None`` for nullable fields; raise otherwise.
+
+    Parity: ``petastorm/unischema.py :: insert_explicit_nulls``.
+    """
+    for name, field in unischema.fields.items():
+        if name not in row_dict or row_dict[name] is None:
+            if field.nullable:
+                row_dict[name] = None
+            else:
+                raise ValueError('Field %r is not nullable but is missing from the row' % (name,))
+    return row_dict
+
+
+def encode_row(unischema, row_dict):
+    """Encode a ``{field: numpy value}`` dict to storable cells.
+
+    The Spark-free twin of ``dict_to_spark_row`` — used by the pyarrow ETL
+    writer (``petastorm_tpu/etl/dataset_metadata.py``).
+    """
+    unknown = set(row_dict.keys()) - set(unischema.fields.keys())
+    if unknown:
+        raise ValueError('Rows contain fields not in schema %r: %s' % (unischema.name, sorted(unknown)))
+    encoded = {}
+    for name, field in unischema.fields.items():
+        if name not in row_dict or row_dict[name] is None:
+            if not field.nullable:
+                raise ValueError('Field %r is not nullable but got None' % (name,))
+            encoded[name] = None
+        else:
+            encoded[name] = field.codec_or_default.encode(field, row_dict[name])
+    return encoded
+
+
+def dict_to_spark_row(unischema, row_dict):
+    """Encode a row dict into a ``pyspark.Row`` (requires pyspark).
+
+    Parity: ``petastorm/unischema.py :: dict_to_spark_row``.
+    """
+    from pyspark.sql import Row
+    encoded = encode_row(unischema, dict(row_dict))
+    return Row(**encoded)

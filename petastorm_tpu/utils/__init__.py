@@ -1,0 +1,51 @@
+"""General helpers shared across layers.
+
+Parity: reference ``petastorm/utils.py :: decode_row, run_in_subprocess``.
+"""
+
+import pickle
+import subprocess
+import sys
+
+from petastorm_tpu.errors import DecodeFieldError
+
+__all__ = ['decode_row', 'run_in_subprocess']
+
+
+def decode_row(row, schema):
+    """Decode all cells of an encoded row dict through their field codecs.
+
+    Parity: ``petastorm/utils.py :: decode_row``.  Runs inside L2 reader
+    workers — the per-row CPU hot path.
+    """
+    decoded = {}
+    for name, value in row.items():
+        field = schema.fields.get(name)
+        if field is None:
+            continue
+        if value is None:
+            decoded[name] = None
+            continue
+        try:
+            decoded[name] = field.codec_or_default.decode(field, value)
+        except Exception as e:
+            raise DecodeFieldError('Failed to decode field %r: %s' % (name, e)) from e
+    return decoded
+
+
+def run_in_subprocess(func, *args, **kwargs):
+    """Run ``func(*args, **kwargs)`` in a fresh python subprocess and return
+    its pickled result.
+
+    Parity: ``petastorm/utils.py :: run_in_subprocess``.  Used by ETL helpers
+    that must not pollute the parent interpreter (e.g. metadata regeneration).
+    """
+    payload = pickle.dumps((func, args, kwargs))
+    program = (
+        'import pickle, sys\n'
+        'func, args, kwargs = pickle.loads(sys.stdin.buffer.read())\n'
+        'sys.stdout.buffer.write(pickle.dumps(func(*args, **kwargs)))\n'
+    )
+    proc = subprocess.run([sys.executable, '-c', program], input=payload,
+                          stdout=subprocess.PIPE, check=True)
+    return pickle.loads(proc.stdout)

@@ -1,0 +1,120 @@
+"""Unischema unit tests.
+
+Modeled on the reference's ``petastorm/tests/test_unischema.py`` coverage:
+views, regex matching, row-type generation, >255 fields, projections.
+"""
+
+import pickle
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import (
+    Unischema, UnischemaField, encode_row, field_shape_dtype_struct,
+    insert_explicit_nulls, match_unischema_fields,
+)
+
+TestSchema = Unischema('TestSchema', [
+    UnischemaField('id', np.int64, (), None, False),
+    UnischemaField('value', np.float32, (), None, True),
+    UnischemaField('image', np.uint8, (16, 32, 3), CompressedImageCodec('png'), False),
+    UnischemaField('matrix', np.float64, (4, 4), NdarrayCodec(), False),
+    UnischemaField('name', np.str_, (), ScalarCodec(pa.string()), False),
+])
+
+
+def test_fields_sorted_and_attribute_access():
+    assert list(TestSchema.fields) == sorted(['id', 'value', 'image', 'matrix', 'name'])
+    assert TestSchema.id.numpy_dtype == np.int64
+    assert TestSchema.image.shape == (16, 32, 3)
+
+
+def test_tensor_field_requires_codec():
+    with pytest.raises(ValueError, match='no codec'):
+        UnischemaField('bad', np.float32, (3, 3), None, False)
+
+
+def test_create_schema_view_with_fields_and_regex():
+    view = TestSchema.create_schema_view([TestSchema.id, 'im.*'])
+    assert set(view.fields) == {'id', 'image'}
+    with pytest.raises(ValueError, match='does not belong'):
+        TestSchema.create_schema_view([UnischemaField('zzz', np.int32, (), None, False)])
+
+
+def test_match_unischema_fields_fullmatch_only():
+    # 'id' must not partial-match inside 'ids...' style names; fullmatch semantics.
+    schema = Unischema('S', [
+        UnischemaField('id', np.int64, (), None, False),
+        UnischemaField('id_extra', np.int64, (), None, False),
+    ])
+    assert {f.name for f in match_unischema_fields(schema, ['id'])} == {'id'}
+    assert {f.name for f in match_unischema_fields(schema, ['id.*'])} == {'id', 'id_extra'}
+
+
+def test_namedtuple_row_type():
+    row = TestSchema.make_namedtuple(id=1, value=2.0, image=None, matrix=None, name='x')
+    assert row.id == 1
+    assert type(row).__name__ == 'TestSchema'
+
+
+def test_gt_255_fields_namedtuple():
+    fields = [UnischemaField('f%04d' % i, np.int32, (), None, False) for i in range(300)]
+    schema = Unischema('Big', fields)
+    row = schema.make_namedtuple_from_dict({'f%04d' % i: i for i in range(300)})
+    assert row.f0299 == 299
+
+
+def test_arrow_schema_projection():
+    arrow = TestSchema.as_arrow_schema()
+    assert arrow.field('id').type == pa.int64()
+    assert arrow.field('image').type == pa.binary()
+    assert arrow.field('value').nullable
+
+
+def test_shape_dtype_struct_projection():
+    sds = TestSchema.as_shape_dtype_structs(leading_dims=(8,))
+    assert sds['image'].shape == (8, 16, 32, 3)
+    assert sds['image'].dtype == np.uint8
+    assert sds['id'].shape == (8,)
+
+
+def test_shape_dtype_struct_wildcard_requires_override():
+    f = UnischemaField('var', np.float32, (None, 3), NdarrayCodec(), False)
+    with pytest.raises(ValueError, match='wildcard'):
+        field_shape_dtype_struct(f)
+    sds = field_shape_dtype_struct(f, leading_dims=(2,), wildcard_overrides=(10, 3))
+    assert sds.shape == (2, 10, 3)
+
+
+def test_pickle_roundtrip():
+    restored = pickle.loads(pickle.dumps(TestSchema))
+    assert restored == TestSchema
+    assert restored.image.codec == CompressedImageCodec('png')
+
+
+def test_insert_explicit_nulls():
+    row = {'id': 1, 'image': b'x', 'matrix': b'y', 'name': 'n'}
+    insert_explicit_nulls(TestSchema, row)
+    assert row['value'] is None
+    with pytest.raises(ValueError, match='not nullable'):
+        insert_explicit_nulls(TestSchema, {'value': None})
+
+
+def test_encode_row_rejects_unknown_fields():
+    with pytest.raises(ValueError, match='not in schema'):
+        encode_row(TestSchema, {'nope': 1})
+
+
+def test_from_arrow_schema_inference():
+    arrow = pa.schema([
+        pa.field('a', pa.int32()),
+        pa.field('b', pa.float64()),
+        pa.field('s', pa.string()),
+        pa.field('l', pa.list_(pa.int64())),
+    ])
+    schema = Unischema.from_arrow_schema(arrow)
+    assert schema.fields['a'].numpy_dtype == np.dtype('int32')
+    assert schema.fields['l'].shape == (None,)
+    assert schema.fields['s'].numpy_dtype == np.dtype('O')
