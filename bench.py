@@ -1,0 +1,125 @@
+"""Benchmark: ImageNet-shaped JPEG Parquet -> device batches, images/sec/host.
+
+The reference publishes no numbers (BASELINE.json "published": {}); its own
+harness measures reader rows/sec (``petastorm/benchmark/throughput.py``).
+``vs_baseline`` here is therefore measured, not quoted: the same dataset is
+read through a faithful reimplementation of the reference's delivery
+strategy — per-row decode iteration with per-row python collate, no
+double-buffering (its pytorch ``DataLoader`` hot loop) — and the reported
+ratio is tpu-native throughput / reference-strategy throughput on identical
+hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BENCH_DIR = os.environ.get('PETASTORM_TPU_BENCH_DIR', '/tmp/petastorm_tpu_bench')
+DATASET_URL = 'file://' + BENCH_DIR + '/imagenet_like'
+NUM_IMAGES = int(os.environ.get('PETASTORM_TPU_BENCH_ROWS', '768'))
+IMAGE_HW = (224, 224)
+BATCH = 64
+
+
+def ensure_dataset():
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    fs, path = get_filesystem_and_path_or_paths(DATASET_URL)
+    if fs.exists(path + '/_common_metadata'):
+        return
+
+    schema = Unischema('ImagenetLike', [
+        UnischemaField('noun_id', np.int64, (), None, False),
+        UnischemaField('image', np.uint8, (IMAGE_HW[0], IMAGE_HW[1], 3),
+                       CompressedImageCodec('jpeg', quality=85), False),
+    ])
+    rng = np.random.default_rng(0)
+    # Smooth gradients compress like natural images (pure noise would make
+    # JPEG decode artificially cheap).
+    base = np.linspace(0, 255, IMAGE_HW[0] * IMAGE_HW[1] * 3, dtype=np.float32)
+    base = base.reshape(IMAGE_HW[0], IMAGE_HW[1], 3)
+
+    def rows():
+        for i in range(NUM_IMAGES):
+            jitter = rng.integers(0, 64, (8, 8, 3)).repeat(28, 0).repeat(28, 1)
+            img = np.clip(base + jitter, 0, 255).astype(np.uint8)
+            yield {'noun_id': np.int64(i), 'image': img}
+
+    with DatasetWriter(DATASET_URL, schema, rows_per_rowgroup=64) as w:
+        w.write_many(rows())
+
+
+def tpu_native_epoch():
+    """Our path: thread-pool decode -> columnar collate -> double-buffered
+    device_put."""
+    import jax
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import DataLoader
+
+    with make_reader(DATASET_URL, num_epochs=1, workers_count=8,
+                     shuffle_row_groups=False, columnar_decode=True) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+        n = 0
+        last = None
+        t0 = time.monotonic()
+        for batch in loader:
+            n += batch['image'].shape[0]
+            last = batch
+        jax.block_until_ready(last)
+        dt = time.monotonic() - t0
+    return n / dt
+
+
+def reference_strategy_epoch():
+    """Reference-style delivery: iterate rows, per-row python collate into a
+    batch list, synchronous put, no prefetch overlap."""
+    import jax
+    from petastorm_tpu import make_reader
+
+    with make_reader(DATASET_URL, num_epochs=1, workers_count=8,
+                     shuffle_row_groups=False) as reader:
+        n = 0
+        t0 = time.monotonic()
+        batch_rows = []
+        for row in reader:
+            batch_rows.append(row.image)
+            if len(batch_rows) == BATCH:
+                dev = jax.device_put(np.stack(batch_rows))
+                jax.block_until_ready(dev)
+                n += BATCH
+                batch_rows = []
+        dt = time.monotonic() - t0
+    return n / dt
+
+
+def main():
+    ensure_dataset()
+    import jax
+    jax.jit(lambda x: x + 1)(np.zeros(8))  # backend warmup outside timing
+
+    tpu_native_epoch()  # warmup epoch (page cache, pools)
+    # Best-of-3 per path: single-host timings are noisy; steady-state
+    # throughput is the quantity of interest.
+    ours = max(tpu_native_epoch() for _ in range(3))
+    theirs = max(reference_strategy_epoch() for _ in range(3))
+
+    print(json.dumps({
+        'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
+        'value': round(ours, 1),
+        'unit': 'images/s',
+        'vs_baseline': round(ours / theirs, 2),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,34 @@
+"""Write a plain (non-petastorm) Parquet dataset for make_batch_reader demos.
+
+Parity: reference ``examples/hello_world/external_dataset/generate_external_dataset.py``
+(there via Spark; here via pyarrow).
+"""
+
+import argparse
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+
+
+def generate_external_dataset(output_url='file:///tmp/external_dataset', rows_count=100):
+    fs, path = get_filesystem_and_path_or_paths(output_url)
+    fs.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(0)
+    table = pa.table({
+        'id': pa.array(np.arange(rows_count, dtype=np.int64)),
+        'value1': pa.array(rng.standard_normal(rows_count)),
+        'value2': pa.array(rng.standard_normal(rows_count)),
+    })
+    with fs.open(path + '/data.parquet', 'wb') as f:
+        pq.write_table(table, f, row_group_size=25)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-o', '--output-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    generate_external_dataset(args.output_url)
+    print('Wrote %s' % args.output_url)

@@ -1,0 +1,21 @@
+"""Read a plain Parquet dataset via make_batch_reader.
+
+Parity: reference ``examples/hello_world/external_dataset/python_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu import make_batch_reader
+
+
+def python_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with make_batch_reader(dataset_url) as reader:
+        for batch in reader:
+            print('batch of %d: ids %s...' % (len(batch.id), batch.id[:5]))
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    python_hello_world(args.dataset_url)

@@ -1,0 +1,43 @@
+"""Hello-world petastorm-format dataset (acceptance config #2).
+
+Parity: reference ``examples/hello_world/petastorm_dataset/
+generate_petastorm_dataset.py`` — same HelloWorldSchema shape, written with
+the pyarrow DatasetWriter instead of Spark.
+"""
+
+import argparse
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec
+from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+HelloWorldSchema = Unischema('HelloWorldSchema', [
+    UnischemaField('id', np.int64, (), None, False),
+    UnischemaField('image1', np.uint8, (128, 256, 3), CompressedImageCodec('png'), False),
+    UnischemaField('array_4d', np.uint8, (None, 128, 30, 4), NdarrayCodec(), False),
+])
+
+
+def row_generator(idx, rng):
+    return {
+        'id': np.int64(idx),
+        'image1': rng.integers(0, 255, (128, 256, 3), dtype=np.uint8),
+        'array_4d': rng.integers(0, 255, (int(rng.integers(1, 5)), 128, 30, 4),
+                                 dtype=np.uint8),
+    }
+
+
+def generate_petastorm_dataset(output_url='file:///tmp/hello_world_dataset', rows_count=10):
+    rng = np.random.default_rng(0)
+    with DatasetWriter(output_url, HelloWorldSchema, rows_per_rowgroup=5) as writer:
+        writer.write_many(row_generator(i, rng) for i in range(rows_count))
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-o', '--output-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    generate_petastorm_dataset(args.output_url)
+    print('Wrote %s' % args.output_url)

@@ -1,0 +1,24 @@
+"""Read the hello-world dataset straight into device memory.
+
+The TPU-native analog of the reference's tensorflow/pytorch hello worlds.
+"""
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.jax import DataLoader
+
+
+def jax_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    # array_4d has a wildcard dim -> keep fixed-shape fields only for batching.
+    with make_reader(dataset_url, schema_fields=['id', 'image1']) as reader:
+        for batch in DataLoader(reader, batch_size=4):
+            print('id:', batch['id'], 'image1:', batch['image1'].shape,
+                  'on', next(iter(batch['image1'].devices())))
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    jax_hello_world(args.dataset_url)

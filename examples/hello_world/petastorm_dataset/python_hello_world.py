@@ -1,0 +1,21 @@
+"""Read the hello-world dataset with the plain python API.
+
+Parity: reference ``examples/hello_world/petastorm_dataset/python_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu import make_reader
+
+
+def python_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with make_reader(dataset_url) as reader:
+        for sample in reader:
+            print(sample.id, sample.image1.shape, sample.array_4d.shape)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    python_hello_world(args.dataset_url)

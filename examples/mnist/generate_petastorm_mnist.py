@@ -1,0 +1,61 @@
+"""Write an MNIST-shaped petastorm dataset (acceptance config #1).
+
+Parity: reference ``examples/mnist/generate_petastorm_mnist.py``, which
+downloads real MNIST via torchvision and writes it with Spark.  This
+environment has no network egress, so by default we synthesize MNIST-shaped
+data whose pixel distribution depends on the label (so models demonstrably
+learn); pass ``--mnist-data-dir`` pointing at a local torchvision MNIST copy
+to use real digits.
+"""
+
+import argparse
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec
+from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+MnistSchema = Unischema('MnistSchema', [
+    UnischemaField('idx', np.int64, (), None, False),
+    UnischemaField('digit', np.int64, (), None, False),
+    UnischemaField('image', np.uint8, (28, 28), CompressedImageCodec('png'), False),
+])
+
+
+def synthetic_mnist_rows(num_rows, seed=0):
+    """Label-dependent synthetic digits: a bright patch whose position is the
+    label; trivially learnable, MNIST-shaped."""
+    rng = np.random.default_rng(seed)
+    for i in range(num_rows):
+        digit = int(rng.integers(0, 10))
+        image = rng.integers(0, 50, (28, 28), dtype=np.uint8)
+        r, c = divmod(digit, 5)
+        image[4 + r * 12: 12 + r * 12, 2 + c * 5: 7 + c * 5] += 180
+        yield {'idx': np.int64(i), 'digit': np.int64(digit), 'image': image}
+
+
+def real_mnist_rows(data_dir, train=True):
+    from torchvision import datasets  # optional; needs a local copy
+    ds = datasets.MNIST(data_dir, train=train, download=False)
+    for i in range(len(ds)):
+        img, digit = ds[i]
+        yield {'idx': np.int64(i), 'digit': np.int64(digit),
+               'image': np.asarray(img, dtype=np.uint8)}
+
+
+def generate_mnist_dataset(output_url, num_rows=10000, mnist_data_dir=None, train=True):
+    rows = (real_mnist_rows(mnist_data_dir, train) if mnist_data_dir
+            else synthetic_mnist_rows(num_rows))
+    with DatasetWriter(output_url, MnistSchema, rows_per_rowgroup=1000) as writer:
+        writer.write_many(rows)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-o', '--output-url', default='file:///tmp/mnist_petastorm')
+    parser.add_argument('-n', '--num-rows', type=int, default=10000)
+    parser.add_argument('--mnist-data-dir', default=None)
+    args = parser.parse_args()
+    generate_mnist_dataset(args.output_url, args.num_rows, args.mnist_data_dir)
+    print('Wrote %s' % args.output_url)

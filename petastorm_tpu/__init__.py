@@ -16,6 +16,9 @@ that only need the ETL side.
 __version__ = '0.1.0'
 
 _LAZY = {
+    'make_reader': 'petastorm_tpu.reader',
+    'make_batch_reader': 'petastorm_tpu.reader',
+    'Reader': 'petastorm_tpu.reader',
     'TransformSpec': 'petastorm_tpu.transform',
     'Unischema': 'petastorm_tpu.unischema',
     'UnischemaField': 'petastorm_tpu.unischema',

@@ -1,0 +1,22 @@
+"""Row-group result cache interface.
+
+Parity: reference ``petastorm/cache.py :: CacheBase, NullCache``.  The disk
+implementation lives in ``petastorm_tpu/local_disk_cache.py``.
+"""
+
+
+class CacheBase(object):
+    def get(self, key, fill_cache_func):
+        """Return the cached value for ``key``, computing and storing it via
+        ``fill_cache_func()`` on a miss."""
+        raise NotImplementedError()
+
+    def cleanup(self):
+        """Release resources / delete backing storage if owned."""
+
+
+class NullCache(CacheBase):
+    """No caching: always calls ``fill_cache_func``."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()

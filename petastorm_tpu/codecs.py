@@ -57,7 +57,9 @@ class DataframeColumnCodec(object):
         raise NotImplementedError()
 
     def __eq__(self, other):
-        return isinstance(other, self.__class__) and self.__dict__ == other.__dict__
+        # Exact type match: NdarrayCodec and CompressedNdarrayCodec produce
+        # incompatible bytes and must never compare equal.
+        return type(other) is type(self) and self.__dict__ == other.__dict__
 
     def __ne__(self, other):
         return not self.__eq__(other)
@@ -107,6 +109,14 @@ class ScalarCodec(DataframeColumnCodec):
     def __init__(self, storage_type):
         self._arrow_type = self._normalize(storage_type)
 
+    def __setstate__(self, state):
+        # Accept pickles written by the reference implementation, whose
+        # ScalarCodec state is {'_spark_type': <pyspark sql type>} (requires
+        # pyspark importable to have unpickled at all).
+        if '_arrow_type' not in state and '_spark_type' in state:
+            state = {'_arrow_type': self._normalize(state['_spark_type'])}
+        self.__dict__.update(state)
+
     @staticmethod
     def _normalize(storage_type):
         if isinstance(storage_type, pa.DataType):
@@ -142,7 +152,9 @@ class ScalarCodec(DataframeColumnCodec):
 
     def decode(self, unischema_field, value):
         dtype = np.dtype(unischema_field.numpy_dtype)
-        if dtype.kind in ('U', 'S'):
+        if dtype.kind == 'S':
+            return value if isinstance(value, bytes) else str(value).encode('utf-8')
+        if dtype.kind == 'U':
             return value if isinstance(value, str) else str(value)
         if dtype == np.dtype(object):
             return value
@@ -271,13 +283,15 @@ class CompressedImageCodec(DataframeColumnCodec):
 
     def decode(self, unischema_field, value):
         import cv2
-        flag = cv2.IMREAD_UNCHANGED if np.dtype(unischema_field.numpy_dtype) != np.uint8 \
-            else cv2.IMREAD_ANYCOLOR
-        arr = cv2.imdecode(np.frombuffer(value, dtype=np.uint8), flag)
+        # IMREAD_UNCHANGED unconditionally: ANYCOLOR caps at 3 channels and
+        # would silently drop the alpha plane of (H, W, 4) fields.
+        arr = cv2.imdecode(np.frombuffer(value, dtype=np.uint8), cv2.IMREAD_UNCHANGED)
         if arr is None:
             raise DecodeFieldError('cv2.imdecode failed for field %r' % (unischema_field.name,))
         if arr.ndim == 3 and arr.shape[2] == 3:
-            arr = arr[:, :, ::-1]  # BGR -> RGB
+            # cvtColor is a SIMD copy; much cheaper than materializing the
+            # negative-stride view arr[:, :, ::-1] would cost downstream.
+            arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
         return np.ascontiguousarray(arr.astype(unischema_field.numpy_dtype, copy=False))
 
     def arrow_dtype(self):

@@ -1,0 +1,12 @@
+"""ETL plane: dataset writing, footer metadata, row-group indexing.
+
+Parity: reference ``petastorm/etl/``.  The reference's write path is Spark;
+ours is a pyarrow ``ParquetWriter`` (Spark optional), because TPU-VM hosts
+run no JVM.
+"""
+
+from petastorm_tpu.etl.dataset_metadata import (  # noqa: F401
+    materialize_dataset, materialize_dataset_pyarrow, get_schema,
+    get_schema_from_dataset_url, infer_or_load_unischema, load_row_groups,
+    RowGroupPiece,
+)

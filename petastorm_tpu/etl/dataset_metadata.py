@@ -1,0 +1,385 @@
+"""Dataset footer metadata: write-side generation, read-side loading.
+
+Parity: reference ``petastorm/etl/dataset_metadata.py :: materialize_dataset,
+get_schema, get_schema_from_dataset_url, infer_or_load_unischema,
+load_row_groups`` and its footer key constants.  The footer key strings are
+kept byte-identical to the reference's so datasets written by real petastorm
+read unmodified, and datasets we write are readable by it (codec classes
+unpickle via the module-rename shim below).
+
+Write path difference (TPU-first): the reference requires a live Spark
+session; ours is a pyarrow ``ParquetWriter`` wrapped by
+:func:`materialize_dataset_pyarrow` / :class:`DatasetWriter`.  A
+Spark-compatible ``materialize_dataset`` context manager is still provided
+for hosts that do have pyspark.
+"""
+
+import json
+import logging
+import pickle
+import posixpath
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+from petastorm_tpu.unischema import Unischema, encode_row
+
+logger = logging.getLogger(__name__)
+
+# Byte-identical to the reference's keys (petastorm/etl/dataset_metadata.py)
+# for on-disk compatibility.
+UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'
+ROW_GROUPS_PER_FILE_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
+
+_COMMON_METADATA = '_common_metadata'
+
+
+@dataclass(frozen=True)
+class RowGroupPiece:
+    """One unit of read work: a single row group of a single file.
+
+    Parity: the reference's pyarrow ``ParquetDatasetPiece`` usage in
+    ``load_row_groups``; modern pyarrow dropped that class, so we carry our
+    own (also what travels to pool workers, so it stays tiny and picklable).
+    """
+    path: str            # filesystem path of the parquet file
+    row_group: int       # row-group ordinal within the file
+    num_rows: int = -1   # row count when known from metadata (-1 = unknown)
+    partition_values: tuple = ()  # ((key, value), ...) from dir partitioning
+
+
+# -- legacy pickle compatibility ---------------------------------------------
+
+_MODULE_RENAMES = {
+    'petastorm.unischema': 'petastorm_tpu.unischema',
+    'petastorm.codecs': 'petastorm_tpu.codecs',
+}
+
+
+class _CompatUnpickler(pickle.Unpickler):
+    """Unpickles Unischemas written by the reference implementation by
+    remapping its module paths onto ours."""
+
+    def find_class(self, module, name):
+        return super().find_class(_MODULE_RENAMES.get(module, module), name)
+
+
+def _loads_schema(blob):
+    import io
+    return _CompatUnpickler(io.BytesIO(blob)).load()
+
+
+# -- filesystem helpers ------------------------------------------------------
+
+def _list_parquet_files(fs, path):
+    """All data files under ``path``, excluding metadata/hidden files."""
+    if fs.isfile(path):
+        return [path]
+    files = sorted(f for f in fs.find(path)
+                   if not _is_metadata_or_hidden(f))
+    return files
+
+
+def _is_metadata_or_hidden(path):
+    base = posixpath.basename(path)
+    return base.startswith('_') or base.startswith('.') or base.endswith('.crc')
+
+
+def _partition_values_for(path, root):
+    """Extract hive-style key=value directory partition values."""
+    rel = path[len(root):].lstrip('/')
+    values = []
+    for part in rel.split('/')[:-1]:
+        if '=' in part:
+            key, _, value = part.partition('=')
+            values.append((key, value))
+    return tuple(values)
+
+
+# -- write side --------------------------------------------------------------
+
+class DatasetWriter(object):
+    """Streaming Spark-free dataset writer.
+
+    Encodes row dicts through the schema's codecs and writes Parquet with
+    controlled row-group sizing, then stamps the petastorm-compatible footer
+    metadata.  Replaces the reference's Spark
+    ``dataframe.write.parquet`` + ``materialize_dataset`` pair for TPU-VM
+    hosts.
+
+    Usage::
+
+        with DatasetWriter(url, MySchema, rowgroup_size_mb=64) as w:
+            for row in rows:
+                w.write(row)
+    """
+
+    def __init__(self, dataset_url, schema, rowgroup_size_mb=None,
+                 rows_per_rowgroup=None, rows_per_file=None, compression='snappy',
+                 storage_options=None, filesystem=None):
+        if rowgroup_size_mb is not None and rows_per_rowgroup is not None:
+            raise ValueError('Pass rowgroup_size_mb or rows_per_rowgroup, not both')
+        self._schema = schema
+        self._arrow_schema = schema.as_arrow_schema()
+        self._rowgroup_size_mb = rowgroup_size_mb
+        self._rows_per_rowgroup = rows_per_rowgroup
+        self._rows_per_file = rows_per_file
+        self._compression = compression
+        self._fs, self._path = get_filesystem_and_path_or_paths(
+            dataset_url, storage_options=storage_options, filesystem=filesystem)
+        self._buffer = []
+        self._buffer_nbytes = 0
+        self._file_index = 0
+        self._writer = None
+        self._sink = None
+        self._rows_in_file = 0
+        self._closed = False
+
+    # -- row API -------------------------------------------------------------
+
+    def write(self, row_dict):
+        encoded = encode_row(self._schema, row_dict)
+        self._buffer.append(encoded)
+        self._buffer_nbytes += sum(len(v) if isinstance(v, (bytes, bytearray)) else 8
+                                   for v in encoded.values() if v is not None)
+        if self._rowgroup_ready():
+            self._flush_rowgroup()
+
+    def write_many(self, rows):
+        for row in rows:
+            self.write(row)
+
+    def _rowgroup_ready(self):
+        if self._rows_per_rowgroup is not None:
+            return len(self._buffer) >= self._rows_per_rowgroup
+        limit_mb = self._rowgroup_size_mb if self._rowgroup_size_mb is not None else 32
+        return self._buffer_nbytes >= limit_mb * (1 << 20)
+
+    def _flush_rowgroup(self):
+        if not self._buffer:
+            return
+        columns = {name: [row.get(name) for row in self._buffer]
+                   for name in self._schema.fields}
+        table = pa.table(
+            {name: pa.array(columns[name], type=self._arrow_schema.field(name).type)
+             for name in self._schema.fields},
+            schema=self._arrow_schema)
+        if self._writer is None or (self._rows_per_file is not None
+                                    and self._rows_in_file >= self._rows_per_file):
+            self._roll_file()
+        self._writer.write_table(table)  # one write_table call == one row group
+        self._rows_in_file += len(self._buffer)
+        self._buffer = []
+        self._buffer_nbytes = 0
+
+    def _close_current_file(self):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self._sink is not None:
+            self._sink.close()  # flush fsspec buffers; footer must hit storage
+            self._sink = None
+
+    def _roll_file(self):
+        self._close_current_file()
+        self._fs.makedirs(self._path, exist_ok=True)
+        name = posixpath.join(self._path, 'part_%05d.parquet' % self._file_index)
+        self._file_index += 1
+        self._rows_in_file = 0
+        self._sink = self._fs.open(name, 'wb')
+        self._writer = pq.ParquetWriter(self._sink, self._arrow_schema,
+                                        compression=self._compression)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self):
+        if self._closed:
+            return
+        self._flush_rowgroup()
+        self._close_current_file()
+        self._closed = True
+        _write_common_metadata(self._fs, self._path, self._schema)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        if exc_type is None:
+            self.close()
+
+
+def write_dataset(schema, rows, dataset_url, **kwargs):
+    """One-shot convenience over :class:`DatasetWriter`."""
+    with DatasetWriter(dataset_url, schema, **kwargs) as writer:
+        writer.write_many(rows)
+
+
+@contextmanager
+def materialize_dataset_pyarrow(dataset_url, schema, storage_options=None, filesystem=None):
+    """Context manager stamping footer metadata around any pyarrow-based
+    write the caller performs into ``dataset_url``."""
+    yield
+    fs, path = get_filesystem_and_path_or_paths(
+        dataset_url, storage_options=storage_options, filesystem=filesystem)
+    _write_common_metadata(fs, path, schema)
+
+
+@contextmanager
+def materialize_dataset(spark, dataset_url, schema, row_group_size_mb=None,
+                        use_summary_metadata=False, filesystem_factory=None,
+                        storage_options=None):
+    """Spark-parity context manager.
+
+    Parity: ``petastorm/etl/dataset_metadata.py :: materialize_dataset`` —
+    sets ``parquet.block.size`` on entry, stamps footer metadata on exit.
+    Works with ``spark=None`` for non-Spark writers (then equivalent to
+    :func:`materialize_dataset_pyarrow`).
+    """
+    if spark is not None and row_group_size_mb is not None:
+        hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+        hadoop_conf.setInt('parquet.block.size', row_group_size_mb << 20)
+    yield
+    filesystem = filesystem_factory() if filesystem_factory is not None else None
+    fs, path = get_filesystem_and_path_or_paths(
+        dataset_url, storage_options=storage_options, filesystem=filesystem)
+    _write_common_metadata(fs, path, schema)
+
+
+def _collect_rowgroup_counts(fs, path, files=None):
+    files = files if files is not None else _list_parquet_files(fs, path)
+
+    def count(f):
+        with fs.open(f, 'rb') as handle:
+            return posixpath.relpath(f, path), pq.ParquetFile(handle).metadata.num_row_groups
+
+    with ThreadPoolExecutor(max_workers=min(16, max(1, len(files)))) as pool:
+        return dict(pool.map(count, files))
+
+
+def _write_common_metadata(fs, path, schema):
+    """Write ``_common_metadata`` carrying the pickled Unischema and the
+    per-file row-group count map (reference-compatible footer keys)."""
+    counts = _collect_rowgroup_counts(fs, path)
+    files = _list_parquet_files(fs, path)
+    if files:
+        with fs.open(files[0], 'rb') as handle:
+            arrow_schema = pq.ParquetFile(handle).schema_arrow
+    else:
+        arrow_schema = schema.as_arrow_schema()
+    metadata = dict(arrow_schema.metadata or {})
+    metadata[UNISCHEMA_KEY] = pickle.dumps(schema, protocol=4)
+    metadata[ROW_GROUPS_PER_FILE_KEY] = json.dumps(counts).encode('utf-8')
+    annotated = arrow_schema.with_metadata(metadata)
+    with fs.open(posixpath.join(path, _COMMON_METADATA), 'wb') as out:
+        pq.write_metadata(annotated, out)
+
+
+# -- read side ---------------------------------------------------------------
+
+def _read_common_metadata(fs, path):
+    meta_path = posixpath.join(path, _COMMON_METADATA)
+    if not fs.exists(meta_path):
+        return None
+    with fs.open(meta_path, 'rb') as handle:
+        return pq.read_schema(handle)
+
+
+def get_schema(fs, path):
+    """Load the pickled Unischema from the dataset footer.
+
+    Parity: ``petastorm/etl/dataset_metadata.py :: get_schema``.  Raises
+    :class:`MetadataError` when absent (the reference tells users to run its
+    metadata-generation CLI; so do we).
+    """
+    arrow_schema = _read_common_metadata(fs, path)
+    if arrow_schema is None or not arrow_schema.metadata \
+            or UNISCHEMA_KEY not in arrow_schema.metadata:
+        raise MetadataError(
+            'Dataset at %r has no petastorm metadata (missing %s footer key). '
+            'If it was written without materialize_dataset, run '
+            'petastorm-tpu-generate-metadata to add it.' % (path, UNISCHEMA_KEY))
+    return _loads_schema(arrow_schema.metadata[UNISCHEMA_KEY])
+
+
+def get_schema_from_dataset_url(dataset_url, storage_options=None, filesystem=None):
+    """Parity: ``petastorm/etl/dataset_metadata.py :: get_schema_from_dataset_url``."""
+    fs, path = get_filesystem_and_path_or_paths(
+        dataset_url, storage_options=storage_options, filesystem=filesystem)
+    return get_schema(fs, path)
+
+
+def infer_or_load_unischema(fs, path):
+    """Stored Unischema when present, else inferred from the arrow schema
+    (scalar columns only), as for vanilla Parquet stores.
+
+    Parity: ``petastorm/etl/dataset_metadata.py :: infer_or_load_unischema``.
+    """
+    try:
+        return get_schema(fs, path)
+    except MetadataError:
+        pass
+    except Exception as e:  # legacy pickle needing pyspark, version skew, ...
+        logger.warning('Failed to unpickle stored Unischema (%s); inferring from '
+                       'arrow schema instead', e)
+    files = _list_parquet_files(fs, path)
+    if not files:
+        raise MetadataError('No parquet files found under %r' % (path,))
+    with fs.open(files[0], 'rb') as handle:
+        arrow_schema = pq.ParquetFile(handle).schema_arrow
+    return Unischema.from_arrow_schema(arrow_schema)
+
+
+def load_row_groups(fs, path, fast_from_metadata=True):
+    """Enumerate all row-group pieces of the dataset.
+
+    Uses the footer's per-file row-group count map when present (no file
+    footers opened — the point of the metadata); otherwise scans file footers
+    in a thread pool.
+
+    Parity: ``petastorm/etl/dataset_metadata.py :: load_row_groups`` incl.
+    the fallback hierarchy (summary metadata -> per-file footers).
+    """
+    files = _list_parquet_files(fs, path)
+    if not files:
+        raise MetadataError('No parquet files found under %r' % (path,))
+
+    counts = None
+    if fast_from_metadata:
+        arrow_schema = _read_common_metadata(fs, path)
+        if arrow_schema is not None and arrow_schema.metadata \
+                and ROW_GROUPS_PER_FILE_KEY in arrow_schema.metadata:
+            counts = json.loads(arrow_schema.metadata[ROW_GROUPS_PER_FILE_KEY].decode('utf-8'))
+
+    pieces = []
+    if counts is not None:
+        present = {posixpath.relpath(f, path): f for f in files}
+        for rel, n in sorted(counts.items()):
+            full = present.get(rel)
+            if full is None:
+                logger.warning('File %r in footer metadata is missing on disk; skipping', rel)
+                continue
+            parts = _partition_values_for(full, path)
+            pieces.extend(RowGroupPiece(full, i, -1, parts) for i in range(int(n)))
+        return pieces
+
+    lock = threading.Lock()
+
+    def scan(f):
+        with fs.open(f, 'rb') as handle:
+            md = pq.ParquetFile(handle).metadata
+            found = [RowGroupPiece(f, i, md.row_group(i).num_rows,
+                                   _partition_values_for(f, path))
+                     for i in range(md.num_row_groups)]
+        with lock:
+            pieces.extend(found)
+
+    with ThreadPoolExecutor(max_workers=min(16, len(files))) as pool:
+        list(pool.map(scan, files))
+    pieces.sort(key=lambda p: (p.path, p.row_group))
+    return pieces

@@ -1,0 +1,74 @@
+"""Filesystem resolution: dataset URL -> (filesystem, path).
+
+Parity: reference ``petastorm/fs_utils.py :: FilesystemResolver,
+get_filesystem_and_path_or_paths``.  The reference resolves to a *pyarrow*
+filesystem with bespoke HDFS namenode logic (``petastorm/hdfs/namenode.py``);
+on TPU-VM hosts the primary remote store is GCS, so we resolve through
+**fsspec** (gcsfs / s3fs / local), which pyarrow consumes directly.  HDFS HA
+namenode resolution is delegated to fsspec's hdfs driver rather than
+re-implementing hadoop-XML parsing.
+"""
+
+from urllib.parse import urlparse
+
+import fsspec
+
+__all__ = ['FilesystemResolver', 'get_filesystem_and_path_or_paths', 'get_dataset_path']
+
+
+class FilesystemResolver(object):
+    """Resolves a dataset URL to an fsspec filesystem + root path.
+
+    Parity: ``petastorm/fs_utils.py :: FilesystemResolver``.
+    """
+
+    def __init__(self, dataset_url, storage_options=None, filesystem=None):
+        if not isinstance(dataset_url, str):
+            raise ValueError('dataset_url must be a string, got %r' % (dataset_url,))
+        dataset_url = dataset_url[:-1] if dataset_url.endswith('/') else dataset_url
+        self._dataset_url = dataset_url
+        parsed = urlparse(dataset_url)
+        self._parsed = parsed
+        if filesystem is not None:
+            self._filesystem = filesystem
+            self._path = parsed.path if parsed.scheme else dataset_url
+        else:
+            protocol = parsed.scheme or 'file'
+            self._filesystem, self._path = _resolve(protocol, dataset_url, storage_options or {})
+
+    def filesystem(self):
+        return self._filesystem
+
+    def get_dataset_path(self):
+        return self._path
+
+    def parsed_dataset_url(self):
+        return self._parsed
+
+
+def _resolve(protocol, url, storage_options):
+    fs, _, paths = fsspec.get_fs_token_paths(url, storage_options=storage_options)
+    path = paths[0] if paths else urlparse(url).path
+    return fs, path
+
+
+def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesystem=None):
+    """Resolve one URL or a list of URLs (all on the same filesystem).
+
+    Parity: ``petastorm/fs_utils.py :: get_filesystem_and_path_or_paths``.
+    """
+    urls = url_or_urls if isinstance(url_or_urls, list) else [url_or_urls]
+    schemes = {urlparse(u).scheme or 'file' for u in urls}
+    if len(schemes) > 1:
+        raise ValueError('All dataset URLs must share a scheme, got %s' % sorted(schemes))
+    resolvers = [FilesystemResolver(u, storage_options=storage_options, filesystem=filesystem)
+                 for u in urls]
+    fs = resolvers[0].filesystem()
+    paths = [r.get_dataset_path() for r in resolvers]
+    return (fs, paths if isinstance(url_or_urls, list) else paths[0])
+
+
+def get_dataset_path(url):
+    """Bare path portion of a dataset URL (scheme stripped)."""
+    parsed = urlparse(url)
+    return parsed.path if parsed.scheme else url

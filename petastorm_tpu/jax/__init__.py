@@ -1,0 +1,8 @@
+"""JAX/TPU delivery plane — the first-class loader of this framework.
+
+North star (BASELINE.json): ``petastorm.jax.DataLoader`` — double-buffered
+``device_put`` batches straight into pjit/pmap training loops, per-host
+row-group sharding by ``jax.process_index()``.
+"""
+
+from petastorm_tpu.jax.loader import DataLoader, make_jax_loader  # noqa: F401

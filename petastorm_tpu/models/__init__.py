@@ -1,0 +1,13 @@
+"""Example model zoo for the acceptance configs (BASELINE.json):
+
+* ``mlp`` — MNIST (config #1)
+* ``resnet`` — ResNet-50 for ImageNet-Parquet (config #3, the flagship)
+* ``dlrm`` — Criteo embedding tables (config #4)
+
+The reference ships no models (it is a data library); these exist so the
+loader can be proven against real pjit training loops, as its examples do
+with TF/torch models.
+"""
+
+from petastorm_tpu.models.mlp import MLP  # noqa: F401
+from petastorm_tpu.models.resnet import ResNet50  # noqa: F401

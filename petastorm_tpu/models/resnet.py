@@ -1,0 +1,57 @@
+"""ResNet-50 in flax — the flagship model for the ImageNet-Parquet config.
+
+TPU notes: compute runs in bfloat16 (MXU native) with float32 parameters and
+batch statistics; convolutions are NHWC (XLA's preferred TPU layout).
+"""
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    projection: bool = False
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if self.projection:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False, dtype=self.dtype,
+                    padding=[(3, 3), (3, 3)])(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 epsilon=1e-5, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, (filters, blocks) in enumerate([(64, 3), (128, 4), (256, 6), (512, 3)]):
+            for j in range(blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(filters, strides=strides, projection=(j == 0),
+                                    dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        # Final classifier in float32 for numerically stable logits/softmax.
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,132 @@
+"""NGram: temporal windowing over timestamp-sorted rows (AV/sensor use case).
+
+Parity: reference ``petastorm/ngram.py :: NGram`` — ``fields`` maps relative
+offset -> field list for that timestep; the worker sorts a row group by
+``timestamp_field`` and emits sliding windows ``{offset: row}``, discarding
+windows whose consecutive timestamp gaps exceed ``delta_threshold``.
+Windows never span row-group boundaries (documented reference limitation,
+kept: it is what makes NGram embarrassingly parallel across row groups).
+``timestamp_overlap=False`` makes windows disjoint (stride = window length
+instead of 1).
+"""
+
+import numbers
+
+from petastorm_tpu.unischema import UnischemaField, match_unischema_fields
+
+__all__ = ['NGram']
+
+
+class NGram(object):
+    def __init__(self, fields, delta_threshold, timestamp_field, timestamp_overlap=True):
+        if not isinstance(fields, dict) or not fields:
+            raise ValueError('fields must be a non-empty {offset: [fields]} dict')
+        for offset in fields:
+            if not isinstance(offset, numbers.Integral):
+                raise ValueError('NGram offsets must be integers, got %r' % (offset,))
+        self._fields = {int(k): list(v) for k, v in fields.items()}
+        self._delta_threshold = delta_threshold
+        self._timestamp_field = timestamp_field
+        self._timestamp_overlap = timestamp_overlap
+        self._min_offset = min(self._fields)
+        self._max_offset = max(self._fields)
+        self._resolved = all(
+            isinstance(f, UnischemaField)
+            for flist in self._fields.values() for f in flist) and \
+            isinstance(timestamp_field, UnischemaField)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def fields(self):
+        return self._fields
+
+    @property
+    def delta_threshold(self):
+        return self._delta_threshold
+
+    @property
+    def length(self):
+        """Window length in timesteps (offsets may be sparse within it)."""
+        return self._max_offset - self._min_offset + 1
+
+    @property
+    def timestamp_field_name(self):
+        f = self._timestamp_field
+        return f.name if isinstance(f, UnischemaField) else f
+
+    def get_field_names_at_timestep(self, offset):
+        return [f.name if isinstance(f, UnischemaField) else f
+                for f in self._fields.get(offset, [])]
+
+    def get_field_names_at_all_timesteps(self):
+        """Every field (or regex) any timestep needs, plus the timestamp."""
+        names = {f.name if isinstance(f, UnischemaField) else f
+                 for flist in self._fields.values() for f in flist}
+        names.add(self.timestamp_field_name)
+        return sorted(names)
+
+    def resolve_regex_field_names(self, schema):
+        """Replace regex/str entries with concrete UnischemaFields from schema."""
+        resolved = {}
+        for offset, flist in self._fields.items():
+            out = []
+            for f in flist:
+                if isinstance(f, UnischemaField):
+                    out.append(f)
+                else:
+                    matched = match_unischema_fields(schema, [f])
+                    if not matched:
+                        raise ValueError('NGram field pattern %r matches nothing in schema %r'
+                                         % (f, schema.name))
+                    out.extend(matched)
+            resolved[offset] = out
+        self._fields = resolved
+        if not isinstance(self._timestamp_field, UnischemaField):
+            matched = match_unischema_fields(schema, [self._timestamp_field])
+            if len(matched) != 1:
+                raise ValueError('timestamp_field %r must match exactly one field'
+                                 % (self._timestamp_field,))
+            self._timestamp_field = matched[0]
+        self._resolved = True
+
+    def get_schema_at_timestep(self, schema, offset):
+        names = set(self.get_field_names_at_timestep(offset))
+        return schema.create_schema_view(
+            [f for name, f in schema.fields.items() if name in names])
+
+    # -- window assembly (runs in the worker) --------------------------------
+
+    def form_sequences(self, rows, schema_view):
+        """Sort rows by timestamp and emit valid windows as {offset: row_dict}.
+
+        Parity: the reference's window-assembly step in
+        ``petastorm/py_dict_reader_worker.py`` (symbol ``form_stable_sequences``
+        [unverified name]).
+        """
+        ts_name = self.timestamp_field_name
+        rows = sorted(rows, key=lambda r: r[ts_name])
+        length = self.length
+        windows = []
+        i = 0
+        while i + length <= len(rows):
+            window = rows[i:i + length]
+            if self._window_is_stable(window, ts_name):
+                windows.append({offset: self._project(window[offset - self._min_offset], offset)
+                                for offset in self._fields})
+                i += length if not self._timestamp_overlap else 1
+            else:
+                i += 1
+        return windows
+
+    def _window_is_stable(self, window, ts_name):
+        if self._delta_threshold is None:
+            return True
+        for a, b in zip(window, window[1:]):
+            if b[ts_name] - a[ts_name] > self._delta_threshold:
+                return False
+        return True
+
+    def _project(self, row, offset):
+        names = set(self.get_field_names_at_timestep(offset))
+        return {k: v for k, v in row.items() if k in names}

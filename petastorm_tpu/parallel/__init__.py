@@ -1,0 +1,15 @@
+"""Device-mesh and multi-host helpers for feeding pjit/shard_map loops.
+
+No reference equivalent: the reference delegates cross-host coordination to
+Horovod/NCCL outside the library (SURVEY.md §2.6).  The TPU-native design
+uses the JAX runtime instead: static input sharding from
+``jax.process_index()``, global arrays via
+``jax.make_array_from_process_local_data``, barriers via
+``multihost_utils.sync_global_devices`` — collectives ride ICI/DCN, never
+our own sockets.
+"""
+
+from petastorm_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, data_parallel_sharding, global_batch_from_local,
+    host_shard_info, sync_hosts,
+)

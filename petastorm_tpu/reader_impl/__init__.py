@@ -1,0 +1,1 @@
+"""Reader-internal helpers: shuffling buffers, cross-process serializers."""

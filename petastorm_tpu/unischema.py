@@ -36,6 +36,9 @@ __all__ = [
 ]
 
 
+_DEFAULT_SCALAR_CODECS = {}  # dtype.str -> ScalarCodec (see codec_or_default)
+
+
 class UnischemaField(namedtuple('UnischemaField', ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])):
     """A single field: ``(name, numpy_dtype, shape, codec, nullable)``.
 
@@ -60,10 +63,18 @@ class UnischemaField(namedtuple('UnischemaField', ['name', 'numpy_dtype', 'shape
 
     @property
     def codec_or_default(self):
-        """Effective codec: an inferred ``ScalarCodec`` when ``codec is None``."""
+        """Effective codec: an inferred ``ScalarCodec`` when ``codec is None``.
+
+        Accessed per cell in the decode plane, so default codecs are cached
+        per dtype rather than constructed on every access (namedtuple slots
+        forbid per-instance caching)."""
         if self.codec is not None:
             return self.codec
-        return ScalarCodec(self.numpy_dtype)
+        dtype = np.dtype(self.numpy_dtype)
+        codec = _DEFAULT_SCALAR_CODECS.get(dtype.str)
+        if codec is None:
+            codec = _DEFAULT_SCALAR_CODECS[dtype.str] = ScalarCodec(dtype)
+        return codec
 
     def __eq__(self, other):
         if not isinstance(other, UnischemaField):
@@ -166,12 +177,22 @@ class Unischema(object):
         return self._get_namedtuple()(**{k: row.get(k) for k in self._fields})
 
     def _get_namedtuple(self):
-        if self._namedtuple is None:
+        # __dict__.get guards against instances restored from legacy
+        # (reference-petastorm) pickles whose state lacks the cache slot.
+        if self.__dict__.get('_namedtuple') is None:
             # Python >= 3.7 namedtuples have no 255-field limit, so the
             # reference's _new_gt_255_compatible_namedtuple workaround
             # collapses to a plain namedtuple here.
             self._namedtuple = namedtuple(self._name, list(self._fields))
         return self._namedtuple
+
+    def __setstate__(self, state):
+        # Accept state written by the reference implementation (its __dict__
+        # carries one attribute per field in addition to _name/_fields).
+        self.__dict__.update(state)
+        self.__dict__.setdefault('_namedtuple', None)
+        if not isinstance(self.__dict__.get('_fields'), OrderedDict):
+            self.__dict__['_fields'] = OrderedDict(self.__dict__.get('_fields') or {})
 
     # -- projections ---------------------------------------------------------
 

@@ -1,0 +1,27 @@
+"""Execution plane: worker pools + ventilator.
+
+Parity: reference ``petastorm/workers_pool/__init__.py :: EmptyResultError,
+TimeoutWaitingForResultError, VentilatedItemProcessedMessage``.
+"""
+
+DEFAULT_TIMEOUT_S = 60
+
+
+class EmptyResultError(RuntimeError):
+    """Raised by ``get_results`` when all work is done and queues are drained."""
+
+
+class TimeoutWaitingForResultError(RuntimeError):
+    """Raised by ``get_results`` when no result arrived within the timeout
+    (e.g. a dead worker process)."""
+
+
+class VentilatedItemProcessedMessage(object):
+    """Ack flowing worker -> ventilator: one ventilated item fully processed."""
+
+
+from collections import namedtuple  # noqa: E402
+
+#: Wrapper a ventilator puts around a work item so the pool can ack with the
+#: item's position (exact resume tokens need identity, not just a count).
+VentilatedItem = namedtuple('VentilatedItem', ['position', 'args'])

@@ -1,0 +1,65 @@
+"""Synchronous in-caller-thread pool: deterministic ordering for tests/debug.
+
+Parity: reference ``petastorm/workers_pool/dummy_pool.py :: DummyPool`` —
+work items execute lazily inside ``get_results``, one at a time, in
+ventilation order.
+"""
+
+from collections import deque
+
+from petastorm_tpu.workers_pool import EmptyResultError, VentilatedItem
+
+
+class DummyPool(object):
+    def __init__(self, workers_count=1):
+        # workers_count accepted for signature parity; always synchronous.
+        self._pending = deque()
+        self._results = deque()
+        self._worker = None
+        self._ventilator = None
+        self._stopped = False
+        self.items_processed = 0
+
+    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+        self._worker = worker_class(0, self._results.append, worker_setup_args)
+        self._ventilator = ventilator
+        if ventilator is not None:
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        self._pending.append((args, kwargs))
+
+    def get_results(self):
+        while not self._results:
+            if self._pending:
+                args, kwargs = self._pending.popleft()
+                position = None
+                if len(args) == 1 and isinstance(args[0], VentilatedItem):
+                    position, args = args[0].position, tuple(args[0].args)
+                self._worker.process(*args, **kwargs)
+                self.items_processed += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item(position)
+            elif self._ventilator is not None and not self._ventilator.completed():
+                # Ventilator thread may still be filling us; spin briefly.
+                import time
+                time.sleep(0.001)
+            else:
+                raise EmptyResultError()
+        return self._results.popleft()
+
+    def stop(self):
+        self._stopped = True
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        if self._worker is not None:
+            self._worker.shutdown()
+
+    def join(self):
+        if not self._stopped:
+            raise RuntimeError('join() called before stop()')
+
+    @property
+    def diagnostics(self):
+        return {'pool': 'dummy', 'items_processed': self.items_processed,
+                'pending': len(self._pending), 'results_ready': len(self._results)}

@@ -1,0 +1,151 @@
+"""Default pool: N python threads, GIL-releasing decode scales for I/O+CPU.
+
+Parity: reference ``petastorm/workers_pool/thread_pool.py :: ThreadPool`` —
+input queue + bounded results queue, worker exceptions re-raised in the
+caller, ``VentilatedItemProcessedMessage`` acks flow back to the ventilator.
+
+pyarrow Parquet decode, zlib, and cv2 imdecode all release the GIL, so a
+thread pool saturates host cores without ProcessPool serialization overhead —
+this is the recommended pool on TPU-VM hosts (see SURVEY.md §7 stage 9).
+"""
+
+import queue
+import sys
+import threading
+
+from petastorm_tpu.workers_pool import (DEFAULT_TIMEOUT_S, EmptyResultError,
+                                        TimeoutWaitingForResultError, VentilatedItem)
+
+_SENTINEL = object()
+
+
+class _WorkerError(object):
+    """Exception captured in a worker thread, travelling the results queue."""
+
+    def __init__(self, exc, tb_str):
+        self.exc = exc
+        self.tb_str = tb_str
+
+
+class ThreadPool(object):
+    def __init__(self, workers_count=10, results_queue_size=50, profiler=None):
+        self._workers_count = workers_count
+        self._input_queue = queue.Queue()
+        self._results_queue = queue.Queue(maxsize=results_queue_size)
+        self._threads = []
+        self._workers = []
+        self._ventilator = None
+        self._stop_event = threading.Event()
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0  # ventilated but result-not-yet-consumed items
+        self.items_processed = 0
+        self._profiler = profiler
+
+    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+        self._ventilator = ventilator
+        for worker_id in range(self._workers_count):
+            worker = worker_class(worker_id, self._publish, worker_setup_args)
+            self._workers.append(worker)
+            thread = threading.Thread(target=self._worker_loop, args=(worker,),
+                                      name='reader-worker-%d' % worker_id, daemon=True)
+            self._threads.append(thread)
+            thread.start()
+        if ventilator is not None:
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        with self._inflight_lock:
+            self._inflight += 1
+        self._input_queue.put((args, kwargs))
+
+    def _publish(self, result):
+        # Bounded put that stays responsive to stop(): a worker blocked on a
+        # full results queue must not deadlock teardown.
+        while not self._stop_event.is_set():
+            try:
+                self._results_queue.put(result, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def _worker_loop(self, worker):
+        while not self._stop_event.is_set():
+            try:
+                item = self._input_queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _SENTINEL:
+                break
+            args, kwargs = item
+            position = None
+            if len(args) == 1 and isinstance(args[0], VentilatedItem):
+                position, args = args[0].position, tuple(args[0].args)
+            try:
+                worker.process(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — travels to the caller
+                import traceback
+                self._results_queue.put(_WorkerError(e, traceback.format_exc()))
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+                    self.items_processed += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item(position)
+
+    def get_results(self, timeout=DEFAULT_TIMEOUT_S):
+        """Next result; EmptyResultError when all work is drained.
+
+        An item may publish multiple results (rows) or none, so 'drained'
+        means: ventilator completed AND no in-flight items AND queue empty.
+        """
+        while True:
+            try:
+                result = self._results_queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._all_done():
+                    raise EmptyResultError()
+                timeout -= 0.05
+                if timeout <= 0:
+                    raise TimeoutWaitingForResultError(
+                        'No results within timeout; worker threads alive: %d'
+                        % sum(t.is_alive() for t in self._threads))
+                continue
+            if isinstance(result, _WorkerError):
+                sys.stderr.write(result.tb_str)
+                raise result.exc
+            return result
+
+    def _all_done(self):
+        if self._ventilator is not None and not self._ventilator.completed():
+            return False
+        with self._inflight_lock:
+            inflight = self._inflight
+        return inflight == 0 and self._input_queue.empty() and self._results_queue.empty()
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stop_event.set()
+        for _ in self._threads:
+            self._input_queue.put(_SENTINEL)
+        for worker in self._workers:
+            worker.shutdown()
+
+    def join(self):
+        for thread in self._threads:
+            thread.join()
+
+    @property
+    def results_qsize(self):
+        return self._results_queue.qsize()
+
+    @property
+    def diagnostics(self):
+        return {
+            'pool': 'thread',
+            'workers_count': self._workers_count,
+            'items_processed': self.items_processed,
+            'inflight': self._inflight,
+            'input_qsize': self._input_queue.qsize(),
+            'results_qsize': self._results_queue.qsize(),
+        }

@@ -2,15 +2,24 @@
 
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic
 (mesh construction, per-host batch assembly) is exercised without TPU
-hardware.  Must run before jax is imported anywhere.
+hardware.  Must run before any test imports jax.
+
+Note: on axon-tunnelled hosts a sitecustomize hook registers the TPU backend
+at interpreter start; ``jax.config.update('jax_platforms', 'cpu')`` after
+import (but before first backend use) still wins, and is required — env vars
+alone are overridden by the hook.
 """
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

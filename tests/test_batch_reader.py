@@ -1,0 +1,119 @@
+"""make_batch_reader over plain (non-petastorm) Parquet.
+
+Modeled on the reference's ``petastorm/tests/test_parquet_reader.py``.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import make_batch_reader
+from petastorm_tpu.predicates import in_lambda
+from petastorm_tpu.transform import TransformSpec
+
+
+@pytest.fixture(scope='module')
+def plain_parquet(tmp_path_factory):
+    path = tmp_path_factory.mktemp('plain')
+    df = pd.DataFrame({
+        'idx': np.arange(100, dtype=np.int64),
+        'value': np.arange(100, dtype=np.float64) * 0.5,
+        'name': ['row_%d' % i for i in range(100)],
+        'vec': [np.arange(4, dtype=np.float32) + i for i in range(100)],
+    })
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    pq.write_table(table, str(path / 'data.parquet'), row_group_size=20)
+    return 'file://' + str(path), df
+
+
+def _collect(reader):
+    batches = []
+    with reader:
+        for batch in reader:
+            batches.append(batch)
+    return batches
+
+
+def test_batches_cover_all_rows(plain_parquet):
+    url, df = plain_parquet
+    batches = _collect(make_batch_reader(url, reader_pool_type='dummy'))
+    assert len(batches) == 5  # 100 rows / 20 per group
+    ids = np.concatenate([b.idx for b in batches])
+    assert sorted(ids.tolist()) == list(range(100))
+    values = np.concatenate([b.value for b in batches])
+    assert set(values.tolist()) == set((np.arange(100) * 0.5).tolist())
+
+
+def test_list_column_stacks_rectangular(plain_parquet):
+    url, _ = plain_parquet
+    batches = _collect(make_batch_reader(url, reader_pool_type='dummy',
+                                         shuffle_row_groups=False))
+    vec = batches[0].vec
+    assert vec.shape == (20, 4)
+    np.testing.assert_array_equal(vec[3], np.arange(4) + 3)
+
+
+def test_column_projection(plain_parquet):
+    url, _ = plain_parquet
+    batches = _collect(make_batch_reader(url, schema_fields=['idx', 'value'],
+                                         reader_pool_type='dummy'))
+    assert set(batches[0]._fields) == {'idx', 'value'}
+
+
+def test_predicate_on_batch_path(plain_parquet):
+    url, _ = plain_parquet
+    batches = _collect(make_batch_reader(
+        url, predicate=in_lambda(['idx'], lambda v: v['idx'] < 30),
+        reader_pool_type='dummy'))
+    ids = np.concatenate([b.idx for b in batches])
+    assert sorted(ids.tolist()) == list(range(30))
+
+
+def test_transform_spec_pandas(plain_parquet):
+    url, _ = plain_parquet
+
+    def double(df):
+        df = df.copy()
+        df['value'] = df['value'] * 2
+        return df
+
+    batches = _collect(make_batch_reader(
+        url, schema_fields=['idx', 'value'],
+        transform_spec=TransformSpec(double), reader_pool_type='dummy',
+        shuffle_row_groups=False))
+    np.testing.assert_allclose(batches[0].value, np.arange(20) * 1.0)
+
+
+def test_sharding_batch_path(plain_parquet):
+    url, _ = plain_parquet
+    seen = set()
+    for shard in range(2):
+        batches = _collect(make_batch_reader(url, cur_shard=shard, shard_count=2,
+                                             reader_pool_type='dummy'))
+        ids = {int(i) for b in batches for i in b.idx}
+        assert seen.isdisjoint(ids)
+        seen |= ids
+    assert seen == set(range(100))
+
+
+def test_thread_pool_batch(plain_parquet):
+    url, _ = plain_parquet
+    batches = _collect(make_batch_reader(url, reader_pool_type='thread', workers_count=3))
+    ids = np.concatenate([b.idx for b in batches])
+    assert sorted(ids.tolist()) == list(range(100))
+
+
+def test_partitioned_directory(tmp_path):
+    """Hive-partitioned dataset: partition key materialized from dir names."""
+    for part in (0, 1):
+        sub = tmp_path / ('part=%d' % part)
+        sub.mkdir()
+        df = pd.DataFrame({'idx': np.arange(5, dtype=np.int64) + 5 * part})
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                       str(sub / 'f.parquet'))
+    batches = _collect(make_batch_reader('file://' + str(tmp_path),
+                                         reader_pool_type='dummy'))
+    ids = sorted(int(i) for b in batches for i in b.idx)
+    assert ids == list(range(10))

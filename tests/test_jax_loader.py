@@ -1,0 +1,179 @@
+"""petastorm_tpu.jax.DataLoader: device batches, double buffering, sharding.
+
+Runs on 8 virtual CPU devices (conftest) — the same code path drives real
+TPU meshes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.jax import DataLoader
+from petastorm_tpu.parallel import data_parallel_sharding, make_mesh
+
+from test_common import create_test_dataset
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('jaxds')
+    return create_test_dataset('file://' + str(path), num_rows=64, rows_per_rowgroup=8)
+
+
+def test_row_loader_yields_device_batches(dataset):
+    with DataLoader(make_reader(dataset.url, reader_pool_type='dummy',
+                                shuffle_row_groups=False),
+                    batch_size=16) as loader:
+        batches = list(loader)
+    assert len(batches) == 4
+    b = batches[0]
+    assert isinstance(b['image_png'], jax.Array)
+    assert b['image_png'].shape == (16, 16, 32, 3)
+    assert b['matrix'].shape == (16, 8, 4)
+    # String field excluded from device transfer.
+    assert 'sensor_name' not in b
+    expected = {r['id']: r for r in dataset.data}
+    ids = np.asarray(b['id'])
+    np.testing.assert_array_equal(np.asarray(b['matrix'][0]),
+                                  expected[int(ids[0])]['matrix'])
+
+
+def test_row_loader_all_rows_once(dataset):
+    with DataLoader(make_reader(dataset.url, reader_pool_type='thread', workers_count=4),
+                    batch_size=16) as loader:
+        ids = np.concatenate([np.asarray(b['id']) for b in loader])
+    assert sorted(ids.tolist()) == list(range(64))
+
+
+def test_columnar_loader_rebatches(dataset):
+    # batch reader yields 8-row chunks; loader re-batches to 10 with drop_last.
+    with DataLoader(make_batch_reader(dataset.url, reader_pool_type='dummy',
+                                      shuffle_row_groups=False),
+                    batch_size=10) as loader:
+        batches = list(loader)
+    assert len(batches) == 6  # 64 rows -> 6 full batches of 10
+    for b in batches:
+        assert np.asarray(b['id']).shape == (10,)
+
+
+def test_columnar_loader_keep_last(dataset):
+    with DataLoader(make_batch_reader(dataset.url, reader_pool_type='dummy'),
+                    batch_size=10, drop_last=False) as loader:
+        sizes = [len(np.asarray(b['id'])) for b in loader]
+    assert sorted(sizes, reverse=True) == [10] * 6 + [4]
+
+
+def test_shuffling_changes_order_not_content(dataset):
+    with DataLoader(make_reader(dataset.url, reader_pool_type='dummy',
+                                shuffle_row_groups=False),
+                    batch_size=16, shuffling_queue_capacity=32, seed=5) as loader:
+        shuffled = np.concatenate([np.asarray(b['id']) for b in loader])
+    assert sorted(shuffled.tolist()) == list(range(64))
+    assert shuffled.tolist() != list(range(64))
+
+
+def test_columnar_shuffle(dataset):
+    with DataLoader(make_batch_reader(dataset.url, reader_pool_type='dummy',
+                                      shuffle_row_groups=False),
+                    batch_size=16, shuffling_queue_capacity=32, seed=5) as loader:
+        ids = np.concatenate([np.asarray(b['id']) for b in loader])
+    assert sorted(ids.tolist()) == list(range(64))
+    assert ids.tolist() != list(range(64))
+
+
+def test_transform_fn_casts(dataset):
+    def to_bf16(batch):
+        batch['matrix'] = batch['matrix'].astype('bfloat16') \
+            if hasattr(batch['matrix'], 'astype') else batch['matrix']
+        return batch
+
+    def cast(batch):
+        d = dict(batch._asdict() if hasattr(batch, '_asdict') else batch)
+        d['matrix'] = np.asarray(d['matrix'], dtype=np.float32) * 0 + 1
+        return d
+
+    with DataLoader(make_reader(dataset.url, schema_fields=['id', 'matrix'],
+                                reader_pool_type='dummy'),
+                    batch_size=8, transform_fn=cast) as loader:
+        b = next(iter(loader))
+    np.testing.assert_array_equal(np.asarray(b['matrix']),
+                                  np.ones((8, 8, 4), np.float32))
+
+
+def test_global_sharded_batch_over_mesh(tmp_path):
+    """pjit-style global batch over the 8-device CPU mesh."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    df = pd.DataFrame({
+        'idx': np.arange(64, dtype=np.int64),
+        'matrix': [np.arange(32, dtype=np.float32).reshape(8, 4) + i for i in range(64)],
+    })
+    table = pa.table({
+        'idx': pa.array(df['idx']),
+        'matrix': pa.array([m.ravel().tolist() for m in df['matrix']],
+                           type=pa.list_(pa.float32())),
+    })
+    pq.write_table(table, str(tmp_path / 'd.parquet'), row_group_size=16)
+
+    mesh = make_mesh({'data': 8})
+    sharding = data_parallel_sharding(mesh)
+    with DataLoader(make_batch_reader('file://' + str(tmp_path), reader_pool_type='dummy'),
+                    batch_size=32, sharding=sharding,
+                    transform_fn=lambda b: {k: (v.reshape(-1, 8, 4) if k == 'matrix' else v)
+                                            for k, v in b.items()}) as loader:
+        b = next(iter(loader))
+    arr = b['matrix']
+    assert isinstance(arr, jax.Array)
+    assert arr.shape == (32, 8, 4)        # single-host: global == local
+    assert len(arr.sharding.device_set) == 8
+
+    # The sharded batch feeds a jitted computation without resharding.
+    @jax.jit
+    def mean_norm(x):
+        return jax.numpy.mean(x * x)
+
+    val = mean_norm(arr)
+    assert np.isfinite(float(val))
+
+
+def test_prefetch_pipeline_depth(dataset):
+    with DataLoader(make_reader(dataset.url, reader_pool_type='dummy',
+                                shuffle_row_groups=False),
+                    batch_size=8, prefetch=3) as loader:
+        batches = list(loader)
+    assert len(batches) == 8
+    ids = np.concatenate([np.asarray(b['id']) for b in batches])
+    np.testing.assert_array_equal(ids, np.arange(64))
+
+
+def test_make_jax_loader_convenience(dataset):
+    from petastorm_tpu.jax import make_jax_loader
+    with make_jax_loader(dataset.url, batch_size=16, batched=True,
+                         reader_pool_type='dummy') as loader:
+        total = sum(len(np.asarray(b['id'])) for b in loader)
+    assert total == 64
+
+
+def test_columnar_decode_fast_path(dataset):
+    """make_reader(columnar_decode=True): codec-decoded columnar batches."""
+    with make_reader(dataset.url, reader_pool_type='dummy', shuffle_row_groups=False,
+                     columnar_decode=True) as reader:
+        chunks = list(reader)
+    assert reader.batched_output
+    assert chunks[0].image_png.shape == (8, 16, 32, 3)
+    ids = np.concatenate([c.id for c in chunks])
+    assert sorted(ids.tolist()) == list(range(64))
+    expected = {r['id']: r for r in dataset.data}
+    np.testing.assert_array_equal(chunks[0].matrix[3],
+                                  expected[int(chunks[0].id[3])]['matrix'])
+
+
+def test_columnar_decode_through_loader(dataset):
+    with DataLoader(make_reader(dataset.url, reader_pool_type='thread', workers_count=4,
+                                columnar_decode=True),
+                    batch_size=16) as loader:
+        ids = np.concatenate([np.asarray(b['id']) for b in loader])
+    assert sorted(ids.tolist()) == list(range(64))
