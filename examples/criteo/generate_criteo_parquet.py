@@ -1,0 +1,42 @@
+"""Write a Criteo-shaped plain-Parquet dataset (acceptance config #4).
+
+The real Criteo-1TB flow materializes via SparkDatasetConverter; this
+generator produces the same column layout (13 dense floats, 26 categorical
+ids, binary label) with pyarrow so the DLRM example runs hermetically.
+"""
+
+import argparse
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+
+NUM_DENSE = 13
+NUM_CATEGORICAL = 26
+VOCAB_SIZES = [1000 + 37 * i for i in range(NUM_CATEGORICAL)]
+
+
+def generate_criteo_parquet(output_url, rows_count=20000, rows_per_group=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    fs, path = get_filesystem_and_path_or_paths(output_url)
+    fs.makedirs(path, exist_ok=True)
+    columns = {'label': pa.array(rng.integers(0, 2, rows_count).astype(np.int32))}
+    for i in range(NUM_DENSE):
+        columns['dense_%d' % i] = pa.array(
+            rng.lognormal(0, 1, rows_count).astype(np.float32))
+    for i in range(NUM_CATEGORICAL):
+        columns['cat_%d' % i] = pa.array(
+            rng.integers(0, VOCAB_SIZES[i], rows_count).astype(np.int32))
+    with fs.open(path + '/data.parquet', 'wb') as f:
+        pq.write_table(pa.table(columns), f, row_group_size=rows_per_group)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-o', '--output-url', default='file:///tmp/criteo_parquet')
+    parser.add_argument('-n', '--rows-count', type=int, default=20000)
+    args = parser.parse_args()
+    generate_criteo_parquet(args.output_url, args.rows_count)
+    print('Wrote %s' % args.output_url)

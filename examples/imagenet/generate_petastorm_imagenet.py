@@ -1,0 +1,66 @@
+"""Write an ImageNet-shaped petastorm dataset (acceptance config #3).
+
+Parity: reference ``examples/imagenet/generate_petastorm_imagenet.py`` —
+same ImagenetSchema (id, text, image with ``CompressedImageCodec('png')``).
+Reads a local ImageNet directory tree when given one; otherwise synthesizes
+ImageNet-shaped data (no network egress in TPU sandboxes).
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ImagenetSchema = Unischema('ImagenetSchema', [
+    UnischemaField('noun_id', np.str_, (), ScalarCodec(np.str_), False),
+    UnischemaField('text', np.str_, (), ScalarCodec(np.str_), False),
+    UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False),
+])
+
+
+def synthetic_rows(rows_count, hw=(224, 224), seed=0):
+    rng = np.random.default_rng(seed)
+    base = np.linspace(0, 255, hw[0] * hw[1] * 3, dtype=np.float32).reshape(hw[0], hw[1], 3)
+    for i in range(rows_count):
+        jitter = rng.integers(0, 64, (8, 8, 3)).repeat(hw[0] // 8, 0).repeat(hw[1] // 8, 1)
+        yield {
+            'noun_id': 'n%08d' % (i % 1000),
+            'text': 'synset %d' % (i % 1000),
+            'image': np.clip(base + jitter, 0, 255).astype(np.uint8),
+        }
+
+
+def directory_rows(imagenet_dir):
+    import cv2
+    for noun_id in sorted(os.listdir(imagenet_dir)):
+        class_dir = os.path.join(imagenet_dir, noun_id)
+        if not os.path.isdir(class_dir):
+            continue
+        for name in sorted(os.listdir(class_dir)):
+            img = cv2.imread(os.path.join(class_dir, name))
+            if img is None:
+                continue
+            yield {'noun_id': noun_id, 'text': noun_id,
+                   'image': cv2.cvtColor(img, cv2.COLOR_BGR2RGB)}
+
+
+def generate_petastorm_imagenet(output_url, imagenet_dir=None, rows_count=1000,
+                                rowgroup_size_mb=64):
+    rows = directory_rows(imagenet_dir) if imagenet_dir else synthetic_rows(rows_count)
+    with DatasetWriter(output_url, ImagenetSchema,
+                       rowgroup_size_mb=rowgroup_size_mb) as writer:
+        writer.write_many(rows)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('-o', '--output-url', default='file:///tmp/imagenet_petastorm')
+    parser.add_argument('--imagenet-dir', default=None)
+    parser.add_argument('-n', '--rows-count', type=int, default=1000)
+    args = parser.parse_args()
+    generate_petastorm_imagenet(args.output_url, args.imagenet_dir, args.rows_count)
+    print('Wrote %s' % args.output_url)

@@ -1,0 +1,4 @@
+"""Measurement harnesses: reader throughput + training data-stall profiling."""
+
+from petastorm_tpu.benchmark.stall_profiler import StallMonitor  # noqa: F401
+from petastorm_tpu.benchmark.throughput import BenchmarkResult, reader_throughput  # noqa: F401

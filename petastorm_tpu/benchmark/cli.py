@@ -1,0 +1,33 @@
+"""Throughput benchmark CLI.
+
+Parity: reference ``petastorm/benchmark/cli.py`` (console script wrapping
+``petastorm/benchmark/throughput.py``).
+"""
+
+import argparse
+
+from petastorm_tpu.benchmark.throughput import reader_throughput
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('dataset_url')
+    parser.add_argument('--field-regex', nargs='*', default=None)
+    parser.add_argument('-w', '--warmup-rows', type=int, default=100)
+    parser.add_argument('-m', '--measure-rows', type=int, default=1000)
+    parser.add_argument('-p', '--pool-type', default='thread',
+                        choices=['thread', 'process', 'dummy'])
+    parser.add_argument('--workers-count', type=int, default=10)
+    args = parser.parse_args(argv)
+    result = reader_throughput(args.dataset_url, field_regex=args.field_regex,
+                               warmup_rows=args.warmup_rows,
+                               measure_rows=args.measure_rows,
+                               pool_type=args.pool_type,
+                               workers_count=args.workers_count)
+    print('%.1f rows/sec (%d rows in %.2fs after %d warmup rows)'
+          % (result.rows_per_second, result.rows_read, result.duration_s,
+             result.warmup_rows))
+
+
+if __name__ == '__main__':
+    main()

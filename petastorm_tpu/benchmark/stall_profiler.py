@@ -1,0 +1,72 @@
+"""Step-time data-stall profiler — the BASELINE.json headline metric.
+
+The reference has no equivalent (SURVEY.md §5.1 gap); the north-star target
+is **<= 2% step-time data-stall** for ImageNet-Parquet -> ResNet-50.  The
+monitor wraps any batch iterator and attributes wall time to "waiting for
+data" (inside ``__next__``) versus "step" (between yields):
+
+    monitor = StallMonitor()
+    for batch in monitor.wrap(loader):
+        train_step(batch)            # counted as step time
+    print(monitor.report())          # {'stall_pct': ..., ...}
+
+With JAX async dispatch the *device* is only truly stalled when ``__next__``
+blocks, which is exactly what this measures.  Optional
+``jax.profiler.TraceAnnotation`` spans make the stalls visible in TensorBoard
+profiles (enabled when ``annotate=True``).
+"""
+
+import time
+
+
+class StallMonitor(object):
+    def __init__(self, annotate=False, warmup_steps=1):
+        self._annotate = annotate
+        self._warmup_steps = warmup_steps
+        self.reset()
+
+    def reset(self):
+        self.wait_time = 0.0
+        self.step_time = 0.0
+        self.steps = 0
+        self._skipped = 0
+
+    def wrap(self, iterable):
+        annotation = None
+        if self._annotate:
+            from jax.profiler import TraceAnnotation
+            annotation = TraceAnnotation
+        iterator = iter(iterable)
+        while True:
+            wait_start = time.monotonic()
+            try:
+                if annotation is not None:
+                    with annotation('petastorm_tpu.data_wait'):
+                        batch = next(iterator)
+                else:
+                    batch = next(iterator)
+            except StopIteration:
+                return
+            wait_end = time.monotonic()
+            yield batch
+            step_end = time.monotonic()
+            if self._skipped < self._warmup_steps:
+                # First pulls pay pipeline fill + compile; not steady state.
+                self._skipped += 1
+                continue
+            self.wait_time += wait_end - wait_start
+            self.step_time += step_end - wait_end
+            self.steps += 1
+
+    @property
+    def stall_fraction(self):
+        total = self.wait_time + self.step_time
+        return (self.wait_time / total) if total > 0 else 0.0
+
+    def report(self):
+        return {
+            'steps': self.steps,
+            'data_wait_s': round(self.wait_time, 4),
+            'step_s': round(self.step_time, 4),
+            'stall_pct': round(100.0 * self.stall_fraction, 2),
+        }

@@ -1,0 +1,43 @@
+"""Bare reader throughput: rows/sec after warmup, no training in the loop.
+
+Parity: reference ``petastorm/benchmark/throughput.py :: reader_throughput,
+BenchmarkResult`` — knobs mirror ``make_reader`` (pool type, workers count).
+"""
+
+import time
+from collections import namedtuple
+
+BenchmarkResult = namedtuple('BenchmarkResult',
+                             ['rows_per_second', 'rows_read', 'duration_s', 'warmup_rows'])
+
+
+def reader_throughput(dataset_url, field_regex=None, warmup_rows=100, measure_rows=1000,
+                      pool_type='thread', loaders_count=None, workers_count=10,
+                      read_method='read', spawn_new_process=None, storage_options=None,
+                      **reader_kwargs):
+    """Measure rows/sec of the bare reader.
+
+    ``loaders_count``/``spawn_new_process``/``read_method`` accepted for
+    reference-CLI signature parity; measurement itself is single-loader,
+    in-process.
+    """
+    from petastorm_tpu.reader import make_reader
+
+    with make_reader(dataset_url, schema_fields=field_regex,
+                     reader_pool_type=pool_type, workers_count=workers_count,
+                     num_epochs=None, storage_options=storage_options,
+                     **reader_kwargs) as reader:
+        read = 0
+        for _ in reader:
+            read += 1
+            if read >= warmup_rows:
+                break
+        start = time.monotonic()
+        measured = 0
+        for _ in reader:
+            measured += 1
+            if measured >= measure_rows:
+                break
+        duration = time.monotonic() - start
+    return BenchmarkResult(rows_per_second=measured / duration if duration else float('inf'),
+                           rows_read=measured, duration_s=duration, warmup_rows=warmup_rows)

@@ -1,0 +1,45 @@
+"""Inspect dataset footer metadata from the command line.
+
+Parity: reference ``petastorm/etl/metadata_util.py`` (print/inspect CLI).
+"""
+
+import argparse
+
+from petastorm_tpu.etl.dataset_metadata import (ROW_GROUPS_PER_FILE_KEY, UNISCHEMA_KEY,
+                                                _read_common_metadata, get_schema,
+                                                load_row_groups)
+from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+
+
+def print_dataset_metadata(dataset_url, print_values=False):
+    fs, path = get_filesystem_and_path_or_paths(dataset_url)
+    arrow_schema = _read_common_metadata(fs, path)
+    if arrow_schema is None:
+        print('No _common_metadata at %s' % dataset_url)
+        return
+    meta = arrow_schema.metadata or {}
+    print('Footer keys: %s' % sorted(meta))
+    if UNISCHEMA_KEY in meta:
+        schema = get_schema(fs, path)
+        print('Unischema %r:' % schema.name)
+        for name, field in schema.fields.items():
+            print('  %-24s %-12s shape=%-16s codec=%s nullable=%s'
+                  % (name, str(field.numpy_dtype), field.shape,
+                     type(field.codec).__name__ if field.codec else None,
+                     field.nullable))
+    if ROW_GROUPS_PER_FILE_KEY in meta:
+        pieces = load_row_groups(fs, path)
+        print('Row groups: %d across %d files'
+              % (len(pieces), len({p.path for p in pieces})))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('dataset_url')
+    parser.add_argument('--print-values', action='store_true')
+    args = parser.parse_args(argv)
+    print_dataset_metadata(args.dataset_url, args.print_values)
+
+
+if __name__ == '__main__':
+    main()

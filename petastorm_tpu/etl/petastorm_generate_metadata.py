@@ -1,0 +1,67 @@
+"""Regenerate/repair footer metadata for datasets written without the writer.
+
+Parity: reference ``petastorm/etl/petastorm_generate_metadata.py ::
+generate_petastorm_metadata`` (console script
+``petastorm-generate-metadata``) — there it spins a local Spark session;
+here it is a pure pyarrow pass.
+"""
+
+import argparse
+import importlib
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import _write_common_metadata, get_schema
+from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+from petastorm_tpu.unischema import Unischema
+
+
+def generate_petastorm_metadata(dataset_url, unischema_class=None, storage_options=None,
+                                filesystem=None):
+    """Stamp ``_common_metadata`` (schema pickle + row-group map) onto an
+    existing Parquet directory.
+
+    ``unischema_class``: dotted path to a ``Unischema`` instance (e.g.
+    ``examples.mnist.generate_petastorm_mnist.MnistSchema``).  When omitted,
+    the existing footer schema is reused (metadata refresh after appends) or,
+    failing that, inferred from the arrow schema (scalar fields only).
+    """
+    fs, path = get_filesystem_and_path_or_paths(
+        dataset_url, storage_options=storage_options, filesystem=filesystem)
+
+    if unischema_class is not None:
+        module_path, _, attr = unischema_class.rpartition('.')
+        schema = getattr(importlib.import_module(module_path), attr)
+        if not isinstance(schema, Unischema):
+            raise ValueError('%r is not a Unischema instance' % (unischema_class,))
+    else:
+        try:
+            schema = get_schema(fs, path)
+        except MetadataError:
+            import sys
+            import pyarrow as pa
+            from petastorm_tpu.etl.dataset_metadata import infer_or_load_unischema
+            schema = infer_or_load_unischema(fs, path)
+            binary_fields = [n for n, f in schema.fields.items()
+                             if f.codec_or_default.arrow_dtype() in (pa.binary(), pa.string())
+                             and f.shape == ()]
+            if binary_fields:
+                print('WARNING: schema inferred from arrow types only — binary columns %s '
+                      'will read back as raw bytes (codec metadata cannot be inferred). '
+                      'Pass --unischema-class to restore tensor/image decoding.'
+                      % binary_fields, file=sys.stderr)
+    _write_common_metadata(fs, path, schema)
+    return schema
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('dataset_url')
+    parser.add_argument('--unischema-class', default=None,
+                        help='Dotted path to the Unischema instance to stamp')
+    args = parser.parse_args(argv)
+    schema = generate_petastorm_metadata(args.dataset_url, args.unischema_class)
+    print('Stamped metadata for schema %s onto %s' % (schema.name, args.dataset_url))
+
+
+if __name__ == '__main__':
+    main()

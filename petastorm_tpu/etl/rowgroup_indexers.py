@@ -1,0 +1,66 @@
+"""Indexer objects: scan decoded rows, build value -> row-group-id maps.
+
+Parity: reference ``petastorm/etl/rowgroup_indexers.py :: SingleFieldIndexer``.
+"""
+
+from collections import defaultdict
+
+__all__ = ['SingleFieldIndexer', 'FieldNotPresentError']
+
+
+class FieldNotPresentError(ValueError):
+    pass
+
+
+class SingleFieldIndexer(object):
+    """Inverted index over one field: ``value -> {row-group ordinals}``."""
+
+    def __init__(self, index_name, index_field):
+        self._index_name = index_name
+        self._index_field = index_field
+        self._index_data = defaultdict(set)
+
+    @property
+    def index_name(self):
+        return self._index_name
+
+    @property
+    def index_field(self):
+        return self._index_field
+
+    #: Field names this indexer must read (reader-side column pruning).
+    def get_field_names(self):
+        return [self._index_field]
+
+    def build_index(self, decoded_rows, piece_ordinal):
+        if not decoded_rows:
+            return
+        for row in decoded_rows:
+            if self._index_field not in row:
+                raise FieldNotPresentError(
+                    'Field %r not present while indexing' % (self._index_field,))
+            value = row[self._index_field]
+            if value is not None:
+                self._index_data[value].add(piece_ordinal)
+
+    def indexed_values(self):
+        return list(self._index_data.keys())
+
+    def get_row_group_indexes(self, value_key=None):
+        if value_key is None:
+            out = set()
+            for groups in self._index_data.values():
+                out |= groups
+            return out
+        return self._index_data.get(value_key, set())
+
+    def __getstate__(self):
+        # defaultdict with a lambda-free factory pickles fine, but freeze to a
+        # plain dict for cross-implementation stability of the footer blob.
+        state = self.__dict__.copy()
+        state['_index_data'] = dict(self._index_data)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._index_data = defaultdict(set, self._index_data)

@@ -1,0 +1,83 @@
+"""Offline inverted-index build over row groups; stored in the dataset footer.
+
+Parity: reference ``petastorm/etl/rowgroup_indexing.py ::
+build_rowgroup_index, get_row_group_indexes`` and its footer key
+``dataset-toolkit.rowgroups_index.v1`` (kept byte-identical for on-disk
+compatibility).  Consumed at reader init by ``petastorm_tpu/selectors.py``
+to prune row groups before any data I/O.
+"""
+
+import pickle
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import (_COMMON_METADATA, _read_common_metadata,
+                                                get_schema, load_row_groups)
+from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+from petastorm_tpu.utils import decode_row
+
+ROWGROUPS_INDEX_KEY = b'dataset-toolkit.rowgroups_index.v1'
+
+
+def build_rowgroup_index(dataset_url, spark_context=None, indexers=None,
+                         storage_options=None, filesystem=None):
+    """Scan the dataset once, feed every row group through ``indexers``, and
+    persist the pickled index map into the footer.
+
+    ``spark_context`` is accepted for signature parity with the reference but
+    unused: the scan runs on a host thread pool (no JVM on TPU-VM hosts).
+    """
+    if not indexers:
+        raise ValueError('indexers must be a non-empty list')
+    fs, path = get_filesystem_and_path_or_paths(
+        dataset_url, storage_options=storage_options, filesystem=filesystem)
+    schema = get_schema(fs, path)
+    pieces = load_row_groups(fs, path)
+
+    needed_fields = sorted({name for ix in indexers for name in ix.get_field_names()})
+    missing = [n for n in needed_fields if n not in schema.fields]
+    if missing:
+        raise ValueError('Indexed fields %s not in schema' % missing)
+
+    def scan(ordinal_piece):
+        ordinal, piece = ordinal_piece
+        with fs.open(piece.path, 'rb') as f:
+            table = pq.ParquetFile(f).read_row_group(piece.row_group,
+                                                     columns=needed_fields)
+        rows = [decode_row(r, schema) for r in table.to_pylist()]
+        return ordinal, rows
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        for ordinal, rows in pool.map(scan, enumerate(pieces)):
+            for indexer in indexers:
+                indexer.build_index(rows, ordinal)
+
+    index_map = {ix.index_name: ix for ix in indexers}
+    _write_footer_key(fs, path, ROWGROUPS_INDEX_KEY,
+                      zlib.compress(pickle.dumps(index_map, protocol=4)))
+    return index_map
+
+
+def get_row_group_indexes(fs, path):
+    """Load the pickled ``{index_name: indexer}`` map from the footer."""
+    arrow_schema = _read_common_metadata(fs, path)
+    if arrow_schema is None or not arrow_schema.metadata \
+            or ROWGROUPS_INDEX_KEY not in arrow_schema.metadata:
+        raise MetadataError(
+            'Dataset at %r has no row-group index (footer key %s); run '
+            'build_rowgroup_index first' % (path, ROWGROUPS_INDEX_KEY))
+    return pickle.loads(zlib.decompress(arrow_schema.metadata[ROWGROUPS_INDEX_KEY]))
+
+
+def _write_footer_key(fs, path, key, value):
+    arrow_schema = _read_common_metadata(fs, path)
+    if arrow_schema is None:
+        raise MetadataError('Dataset at %r has no _common_metadata' % (path,))
+    metadata = dict(arrow_schema.metadata or {})
+    metadata[key] = value
+    import posixpath
+    with fs.open(posixpath.join(path, _COMMON_METADATA), 'wb') as out:
+        pq.write_metadata(arrow_schema.with_metadata(metadata), out)

@@ -1,0 +1,100 @@
+"""Size-limited LRU row-group result cache on local disk.
+
+Parity: reference ``petastorm/local_disk_cache.py :: LocalDiskCache`` — the
+reference wraps the third-party ``diskcache`` library; that is not available
+on TPU-VM images, so this is a small self-contained equivalent: one pickle
+file per key, LRU eviction by access time once ``size_limit`` is exceeded.
+Use case: repeated epochs over remote (GCS) data with decode amortized.
+
+Thread-safe within one process (a lock around the size accounting); safe for
+multiple reader workers.  Multiple processes sharing one path get
+best-effort behavior (atomic renames; eviction may race benignly).
+"""
+
+import hashlib
+import os
+import pickle
+import threading
+
+from petastorm_tpu.cache import CacheBase
+
+
+class LocalDiskCache(CacheBase):
+    def __init__(self, path, size_limit_bytes, expected_row_size_bytes=None,
+                 shards=None, cleanup=False, **_compat_kwargs):
+        """``shards``/``**_compat_kwargs`` accepted for reference-signature
+        compatibility (diskcache tuning knobs); unused here."""
+        if path is None:
+            raise ValueError("cache_location is required for cache_type='local-disk'")
+        self._path = path
+        self._size_limit = size_limit_bytes or (1 << 30)
+        self._cleanup_on_exit = cleanup
+        self._lock = threading.Lock()
+        os.makedirs(path, exist_ok=True)
+
+    def __getstate__(self):
+        # Crosses the ProcessPool boundary inside worker args; the lock is
+        # per-process state.
+        state = self.__dict__.copy()
+        del state['_lock']
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def _key_path(self, key):
+        digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()
+        return os.path.join(self._path, digest + '.pkl')
+
+    def get(self, key, fill_cache_func):
+        key_path = self._key_path(key)
+        try:
+            with open(key_path, 'rb') as f:
+                value = pickle.load(f)
+            os.utime(key_path)  # LRU touch
+            return value
+        except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+            pass
+        value = fill_cache_func()
+        tmp_path = key_path + '.tmp.%d' % os.getpid()
+        with open(tmp_path, 'wb') as f:
+            pickle.dump(value, f, protocol=4)
+        os.replace(tmp_path, key_path)  # atomic publish
+        self._evict_if_needed()
+        return value
+
+    def _evict_if_needed(self):
+        with self._lock:
+            entries = []
+            total = 0
+            for name in os.listdir(self._path):
+                if not name.endswith('.pkl'):
+                    continue
+                full = os.path.join(self._path, name)
+                try:
+                    st = os.stat(full)
+                except FileNotFoundError:
+                    continue
+                entries.append((st.st_atime, st.st_size, full))
+                total += st.st_size
+            if total <= self._size_limit:
+                return
+            for _, size, full in sorted(entries):  # oldest access first
+                try:
+                    os.remove(full)
+                except FileNotFoundError:
+                    continue
+                total -= size
+                if total <= self._size_limit:
+                    break
+
+    def cleanup(self):
+        if not self._cleanup_on_exit:
+            return
+        for name in os.listdir(self._path):
+            if name.endswith('.pkl'):
+                try:
+                    os.remove(os.path.join(self._path, name))
+                except FileNotFoundError:
+                    pass

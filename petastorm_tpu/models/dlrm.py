@@ -1,0 +1,66 @@
+"""DLRM (Deep Learning Recommendation Model) in flax — Criteo config (#4).
+
+TPU notes: the dense MLPs run in bfloat16 on the MXU; embedding lookups are
+gathers (bandwidth-bound, kept fp32); the pairwise-dot feature interaction
+is expressed as one batched matmul so XLA tiles it onto the MXU instead of
+emitting O(F^2) small ops.  For multi-chip runs the natural sharding is
+model-parallel embedding tables (shard the vocab axis) + data-parallel MLPs;
+see ``examples/criteo/jax_example.py``.
+"""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    layer_sizes: Sequence[int]
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for i, size in enumerate(self.layer_sizes):
+            x = nn.Dense(size, dtype=self.dtype)(x)
+            if i < len(self.layer_sizes) - 1:
+                x = nn.relu(x)
+        return x
+
+
+class DLRM(nn.Module):
+    """num_dense continuous features + one categorical id per embedding table."""
+
+    vocab_sizes: Sequence[int]
+    embedding_dim: int = 16
+    bottom_mlp: Sequence[int] = (64, 32, 16)
+    top_mlp: Sequence[int] = (64, 32, 1)
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, dense_features, categorical_ids):
+        """dense: (B, num_dense) float; categorical: (B, num_tables) int."""
+        if self.bottom_mlp[-1] != self.embedding_dim:
+            raise ValueError('bottom MLP must end at embedding_dim')
+        dense_emb = MLP(self.bottom_mlp, dtype=self.dtype)(dense_features)
+
+        tables = [
+            nn.Embed(vocab, self.embedding_dim, name='table_%d' % i,
+                     embedding_init=nn.initializers.normal(0.01))
+            for i, vocab in enumerate(self.vocab_sizes)
+        ]
+        cat_embs = [table(categorical_ids[:, i]) for i, table in enumerate(tables)]
+
+        # (B, F, D): all features, dense projection first.
+        feats = jnp.stack([dense_emb.astype(jnp.float32)] +
+                          [e.astype(jnp.float32) for e in cat_embs], axis=1)
+        feats = feats.astype(self.dtype)
+        # Pairwise dot interactions as one batched matmul (MXU-friendly).
+        interactions = jnp.einsum('bfd,bgd->bfg', feats, feats)
+        num_feats = len(self.vocab_sizes) + 1
+        iu, ju = jnp.triu_indices(num_feats, k=1)
+        pairwise = interactions[:, iu, ju]
+
+        top_in = jnp.concatenate([dense_emb, pairwise.astype(self.dtype)], axis=1)
+        logits = MLP(self.top_mlp, dtype=self.dtype)(top_in)
+        return logits[:, 0].astype(jnp.float32)

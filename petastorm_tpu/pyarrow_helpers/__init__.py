@@ -1,0 +1,1 @@
+"""pyarrow plumbing helpers."""

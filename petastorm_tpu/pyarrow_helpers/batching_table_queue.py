@@ -1,0 +1,55 @@
+"""Re-batch a stream of arrow tables into fixed-size batches.
+
+Parity: reference ``petastorm/pyarrow_helpers/batching_table_queue.py ::
+BatchingTableQueue`` — feeds ``BatchedDataLoader``; slicing stays in arrow
+(zero-copy) until the consumer materializes numpy/torch tensors.
+"""
+
+from collections import deque
+
+import pyarrow as pa
+
+
+class BatchingTableQueue(object):
+    """``put(table)`` arrow tables in; ``get()`` fixed-``batch_size`` tables out."""
+
+    def __init__(self, batch_size):
+        if batch_size <= 0:
+            raise ValueError('batch_size must be positive')
+        self._batch_size = batch_size
+        self._tables = deque()   # (table, start_row)
+        self._available = 0
+
+    def put(self, table):
+        if table.num_rows:
+            self._tables.append((table, 0))
+            self._available += table.num_rows
+
+    def empty(self):
+        return self._available < self._batch_size
+
+    def get(self):
+        """Next full batch as a single arrow table; raises if not ready."""
+        if self.empty():
+            raise IndexError('fewer than batch_size rows buffered')
+        parts = []
+        need = self._batch_size
+        while need > 0:
+            table, start = self._tables.popleft()
+            avail = table.num_rows - start
+            take = min(avail, need)
+            parts.append(table.slice(start, take))  # zero-copy
+            if take < avail:
+                self._tables.appendleft((table, start + take))
+            need -= take
+        self._available -= self._batch_size
+        return parts[0] if len(parts) == 1 else pa.concat_tables(parts)
+
+    def drain(self):
+        """Remaining rows (< batch_size) as one table, or None."""
+        if self._available == 0:
+            return None
+        parts = [t.slice(start) for t, start in self._tables]
+        self._tables.clear()
+        self._available = 0
+        return parts[0] if len(parts) == 1 else pa.concat_tables(parts)

@@ -1,0 +1,226 @@
+"""PyTorch adapters: row DataLoader, columnar BatchedDataLoader, in-memory loader.
+
+Parity: reference ``petastorm/pytorch.py :: decimal_friendly_collate,
+DataLoader, BatchedDataLoader, InMemBatchedDataLoader``.  Torch here is CPU
+only (the TPU path is ``petastorm_tpu.jax``); these adapters exist so
+reference users can migrate incrementally.
+"""
+
+import decimal
+from collections.abc import Mapping, Sequence
+
+import numpy as np
+
+from petastorm_tpu.reader_impl.shuffling_buffer import (NoopShufflingBuffer,
+                                                        RandomShufflingBuffer)
+
+_TORCH_STRING_ERROR = (
+    'Cannot convert a string field to a torch tensor; project it away with '
+    "schema_fields or transform it (reference behavior is the same TypeError)")
+
+
+def decimal_friendly_collate(batch):
+    """Collate that converts ``decimal.Decimal`` cells to floats first.
+
+    Parity: ``petastorm/pytorch.py :: decimal_friendly_collate``.
+    """
+    import torch
+    first = batch[0]
+    if isinstance(first, decimal.Decimal):
+        return torch.as_tensor([float(x) for x in batch])
+    if isinstance(first, np.ndarray):
+        return torch.as_tensor(np.stack(batch))
+    if isinstance(first, (str, bytes)):
+        return list(batch)
+    if isinstance(first, Mapping):
+        return {key: decimal_friendly_collate([d[key] for d in batch]) for key in first}
+    if hasattr(first, '_fields'):  # namedtuple
+        return type(first)(*(decimal_friendly_collate([getattr(d, f) for d in batch])
+                             for f in first._fields))
+    if isinstance(first, Sequence) and not isinstance(first, (str, bytes)):
+        transposed = zip(*batch)
+        return [decimal_friendly_collate(samples) for samples in transposed]
+    if first is None:
+        return list(batch)
+    return torch.as_tensor(np.asarray(batch))
+
+
+class _LoaderBase(object):
+    def __init__(self, reader):
+        self.reader = reader
+        self._in_iter = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.reader.stop()
+        self.reader.join()
+
+    def stop(self):
+        self.reader.stop()
+
+    def join(self):
+        self.reader.join()
+
+
+class DataLoader(_LoaderBase):
+    """Row-path loader: iterate rows, optional shuffling reservoir, collate.
+
+    Parity: ``petastorm/pytorch.py :: DataLoader`` (same constructor args).
+    """
+
+    def __init__(self, reader, batch_size=1, collate_fn=decimal_friendly_collate,
+                 shuffling_queue_capacity=0, min_after_retrieve=None, seed=None):
+        super(DataLoader, self).__init__(reader)
+        if getattr(reader, 'batched_output', False):
+            raise ValueError('DataLoader requires a row reader (make_reader); '
+                             'use BatchedDataLoader for batch readers')
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self._shuffle_capacity = shuffling_queue_capacity
+        self._min_after_retrieve = (min_after_retrieve if min_after_retrieve is not None
+                                    else shuffling_queue_capacity // 2)
+        self._seed = seed
+
+    def __iter__(self):
+        if self._shuffle_capacity > 0:
+            buffer = RandomShufflingBuffer(self._shuffle_capacity,
+                                           self._min_after_retrieve, seed=self._seed)
+        else:
+            buffer = NoopShufflingBuffer()
+        batch = []
+        for row in self.reader:
+            buffer.add_many([row])
+            while buffer.can_retrieve():
+                batch.append(buffer.retrieve())
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+        buffer.finish()
+        while not buffer.finished:
+            batch.append(buffer.retrieve())
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch:
+            yield self.collate_fn(batch)
+
+
+class BatchedDataLoader(_LoaderBase):
+    """Columnar loader over batch readers: no per-row python loop.
+
+    Parity: ``petastorm/pytorch.py :: BatchedDataLoader`` — rebatching via
+    numpy slicing of column chunks, torch tensors per column.
+    ``transform_fn`` maps the dict of column tensors (e.g. to device).
+    """
+
+    def __init__(self, reader, batch_size=1, transform_fn=None,
+                 shuffling_queue_capacity=0, seed=None):
+        super(BatchedDataLoader, self).__init__(reader)
+        if not getattr(reader, 'batched_output', False):
+            raise ValueError('BatchedDataLoader requires a batch/columnar reader '
+                             '(make_batch_reader or make_reader(columnar_decode=True))')
+        self.batch_size = batch_size
+        self._transform_fn = transform_fn
+        self._shuffle_capacity = shuffling_queue_capacity
+        self._seed = seed
+
+    def __iter__(self):
+        import torch
+        rng = np.random.default_rng(self._seed)
+        shuffle = self._shuffle_capacity > 0
+        columns = None
+        count = 0
+
+        def emit(take):
+            nonlocal columns, count
+            batch = {}
+            for k, chunks in columns.items():
+                merged = np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+                picked = merged[take]
+                keep = np.ones(len(merged), dtype=bool)
+                keep[take] = False
+                columns[k] = [merged[keep]]
+                batch[k] = (torch.as_tensor(picked) if picked.dtype != object
+                            else picked.tolist())
+            count -= len(take)
+            if self._transform_fn is not None:
+                batch = self._transform_fn(batch)
+            return batch
+
+        for chunk in self.reader:
+            chunk_dict = chunk._asdict() if hasattr(chunk, '_asdict') else dict(chunk)
+            n = len(next(iter(chunk_dict.values())))
+            if columns is None:
+                columns = {k: [np.asarray(v)] for k, v in chunk_dict.items()}
+            else:
+                for k, v in chunk_dict.items():
+                    columns[k].append(np.asarray(v))
+            count += n
+            threshold = max(self.batch_size, self._shuffle_capacity if shuffle else 0)
+            while count >= threshold and count >= self.batch_size:
+                take = (rng.permutation(count)[:self.batch_size] if shuffle
+                        else np.arange(self.batch_size))
+                yield emit(take)
+        while count >= self.batch_size:
+            take = (rng.permutation(count)[:self.batch_size] if shuffle
+                    else np.arange(self.batch_size))
+            yield emit(take)
+        if count:
+            yield emit(np.arange(count) if not shuffle else rng.permutation(count))
+
+
+class InMemBatchedDataLoader(_LoaderBase):
+    """Caches the full epoch in RAM once, then serves ``num_epochs`` shuffled
+    passes without re-reading Parquet.
+
+    Parity: ``petastorm/pytorch.py :: InMemBatchedDataLoader``.
+    """
+
+    def __init__(self, reader, batch_size=1, num_epochs=1, rows_capacity=None,
+                 shuffle=True, transform_fn=None, seed=None):
+        super(InMemBatchedDataLoader, self).__init__(reader)
+        if not getattr(reader, 'batched_output', False):
+            raise ValueError('InMemBatchedDataLoader requires a batch/columnar reader')
+        self.batch_size = batch_size
+        self._num_epochs = num_epochs
+        self._rows_capacity = rows_capacity
+        self._shuffle = shuffle
+        self._transform_fn = transform_fn
+        self._seed = seed
+        self._columns = None
+
+    def _materialize(self):
+        chunks = {}
+        total = 0
+        for chunk in self.reader:
+            chunk_dict = chunk._asdict() if hasattr(chunk, '_asdict') else dict(chunk)
+            n = len(next(iter(chunk_dict.values())))
+            if self._rows_capacity is not None and total + n > self._rows_capacity:
+                n = self._rows_capacity - total
+                chunk_dict = {k: v[:n] for k, v in chunk_dict.items()}
+            for k, v in chunk_dict.items():
+                chunks.setdefault(k, []).append(np.asarray(v))
+            total += n
+            if self._rows_capacity is not None and total >= self._rows_capacity:
+                break
+        self._columns = {k: (np.concatenate(v) if len(v) > 1 else v[0])
+                         for k, v in chunks.items()}
+
+    def __iter__(self):
+        import torch
+        if self._columns is None:
+            self._materialize()
+        total = len(next(iter(self._columns.values()))) if self._columns else 0
+        rng = np.random.default_rng(self._seed)
+        for _epoch in range(self._num_epochs):
+            order = rng.permutation(total) if self._shuffle else np.arange(total)
+            for start in range(0, total - self.batch_size + 1, self.batch_size):
+                take = order[start:start + self.batch_size]
+                batch = {k: (torch.as_tensor(v[take]) if v.dtype != object
+                             else v[take].tolist())
+                         for k, v in self._columns.items()}
+                if self._transform_fn is not None:
+                    batch = self._transform_fn(batch)
+                yield batch

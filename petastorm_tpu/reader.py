@@ -147,14 +147,16 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
         schema_view = stored_schema
 
     pieces = load_row_groups(fs, path)
-    if filters is not None:
-        from petastorm_tpu.etl.rowgroup_filtering import apply_arrow_filters
-        pieces = apply_arrow_filters(fs, pieces, filters, stored_schema)
+    # Selector first: stored index ordinals refer to the full, unfiltered
+    # load_row_groups ordering.
     if rowgroup_selector is not None:
         from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
         indexes = get_row_group_indexes(fs, path)
         keep = rowgroup_selector.select_row_groups(indexes)
         pieces = [p for i, p in enumerate(pieces) if i in keep]
+    if filters is not None:
+        from petastorm_tpu.etl.rowgroup_filtering import apply_arrow_filters
+        pieces = apply_arrow_filters(fs, pieces, filters, stored_schema)
 
     if cur_shard is None and shard_count is None:
         cur_shard, shard_count = _jax_default_shard()
@@ -311,7 +313,7 @@ class Reader(object):
     def _start(self, start_epoch=0, start_cursor=0):
         # Small in-flight window: keeps resume tokens tight and bounds memory;
         # large enough to never starve the workers.
-        window = max(2 * getattr(self._pool, '_workers_count', 1), 4)
+        window = max(2 * self._pool.workers_count, 4)
         self._ventilator = ConcurrentVentilator(
             ventilate_fn=self._pool.ventilate,
             items=self._items,
@@ -405,7 +407,7 @@ def _clone_pool(pool):
     if isinstance(pool, DummyPool):
         return DummyPool()
     if isinstance(pool, ThreadPool):
-        return ThreadPool(pool._workers_count, pool._results_queue.maxsize)
+        return ThreadPool(pool.workers_count, pool._results_queue.maxsize)
     from petastorm_tpu.workers_pool.process_pool import ProcessPool
     if isinstance(pool, ProcessPool):
         return ProcessPool(pool.workers_count, pool.results_queue_size)

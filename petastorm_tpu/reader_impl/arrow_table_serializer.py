@@ -1,0 +1,20 @@
+"""Arrow IPC stream wire format for the columnar (batch) path.
+
+Parity: reference ``petastorm/reader_impl/arrow_table_serializer.py ::
+ArrowTableSerializer`` — zero-copy-able framing for ``pyarrow.Table``
+results crossing the ProcessPool boundary.
+"""
+
+import pyarrow as pa
+
+
+class ArrowTableSerializer(object):
+    def serialize(self, table):
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        return sink.getvalue()
+
+    def deserialize(self, serialized):
+        with pa.ipc.open_stream(pa.BufferReader(serialized)) as reader:
+            return reader.read_all()

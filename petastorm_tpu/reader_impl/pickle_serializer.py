@@ -1,0 +1,14 @@
+"""Pickle wire format for cross-process results.
+
+Parity: reference ``petastorm/reader_impl/pickle_serializer.py :: PickleSerializer``.
+"""
+
+import pickle
+
+
+class PickleSerializer(object):
+    def serialize(self, rows):
+        return pickle.dumps(rows, protocol=4)
+
+    def deserialize(self, serialized_rows):
+        return pickle.loads(serialized_rows)

@@ -1,0 +1,71 @@
+"""Row-group selectors: reader-init pruning via the stored inverted indexes.
+
+Parity: reference ``petastorm/selectors.py :: RowGroupSelectorBase,
+SingleIndexSelector, IntersectIndexSelector, UnionIndexSelector`` — set
+algebra over row-group ordinal sets, evaluated before any data I/O.
+"""
+
+__all__ = ['RowGroupSelectorBase', 'SingleIndexSelector',
+           'IntersectIndexSelector', 'UnionIndexSelector']
+
+
+class RowGroupSelectorBase(object):
+    def get_index_names(self):
+        """Names of footer indexes this selector needs."""
+        raise NotImplementedError()
+
+    def select_row_groups(self, index_dict):
+        """``index_dict``: {index_name: indexer}; returns set of ordinals."""
+        raise NotImplementedError()
+
+
+class SingleIndexSelector(RowGroupSelectorBase):
+    """Row groups containing any of ``values_list`` per one index."""
+
+    def __init__(self, index_name, values_list):
+        self._index_name = index_name
+        self._values = list(values_list)
+
+    def get_index_names(self):
+        return [self._index_name]
+
+    def select_row_groups(self, index_dict):
+        indexer = index_dict.get(self._index_name)
+        if indexer is None:
+            raise ValueError('Dataset has no index named %r (available: %s)'
+                             % (self._index_name, sorted(index_dict)))
+        out = set()
+        for value in self._values:
+            out |= indexer.get_row_group_indexes(value)
+        return out
+
+
+class _CompositeSelector(RowGroupSelectorBase):
+    def __init__(self, selectors):
+        self._selectors = list(selectors)
+        if not self._selectors:
+            raise ValueError('selector list must be non-empty')
+
+    def get_index_names(self):
+        return [name for s in self._selectors for name in s.get_index_names()]
+
+
+class IntersectIndexSelector(_CompositeSelector):
+    """Row groups selected by ALL child selectors."""
+
+    def select_row_groups(self, index_dict):
+        result = None
+        for selector in self._selectors:
+            groups = selector.select_row_groups(index_dict)
+            result = groups if result is None else (result & groups)
+        return result
+
+
+class UnionIndexSelector(_CompositeSelector):
+    """Row groups selected by ANY child selector."""
+
+    def select_row_groups(self, index_dict):
+        result = set()
+        for selector in self._selectors:
+            result |= selector.select_row_groups(index_dict)
+        return result

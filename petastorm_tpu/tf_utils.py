@@ -1,0 +1,152 @@
+"""TensorFlow adapters.
+
+Parity: reference ``petastorm/tf_utils.py :: tf_tensors,
+make_petastorm_dataset, _schema_to_tf_dtypes`` — tf.data integration with
+dtypes/shapes derived from the (possibly transformed/ngram) schema.  TF here
+is CPU-only glue for migration; the TPU path is ``petastorm_tpu.jax``.
+"""
+
+import datetime
+import decimal
+
+import numpy as np
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+_NUMPY_TO_TF = {
+    'b': 'int8',  # handled via dtype size below
+}
+
+
+def _tf_dtype_for(numpy_dtype):
+    tf = _tf()
+    dtype = np.dtype(numpy_dtype)
+    if dtype.kind in ('U', 'S', 'O'):
+        return tf.string
+    if dtype.kind == 'M':
+        return tf.int64  # datetimes surface as epoch integers
+    return tf.dtypes.as_dtype(dtype)
+
+
+def _schema_to_tf_dtypes(schema):
+    """Ordered (names, dtypes) for the schema's fields.
+
+    Parity: ``petastorm/tf_utils.py :: _schema_to_tf_dtypes``.
+    """
+    names = list(schema.fields)
+    return names, [_tf_dtype_for(schema.fields[n].numpy_dtype) for n in names]
+
+
+def _sanitize_value(value, field):
+    """numpy/py value -> something tf.data accepts (dates/decimals normalized).
+
+    Parity: the date/Decimal conversions in ``petastorm/tf_utils.py``.
+    """
+    if isinstance(value, decimal.Decimal):
+        return str(value)
+    if isinstance(value, (datetime.date, datetime.datetime)):
+        return np.int64(int(value.strftime('%s')) if hasattr(value, 'strftime') else value)
+    if isinstance(value, np.datetime64):
+        return value.astype('datetime64[s]').astype(np.int64)
+    if value is None:
+        dtype = np.dtype(field.numpy_dtype)
+        if dtype.kind in ('U', 'S', 'O'):
+            return ''
+        return dtype.type(0)  # tf rejects None; explicit zero for nullables
+    return value
+
+
+def make_petastorm_dataset(reader):
+    """Wrap a reader into a ``tf.data.Dataset``.
+
+    Row readers yield schema-named namedtuples of tensors; batch/columnar
+    readers yield namedtuples of batched tensors; NGram readers yield
+    ``{offset: namedtuple}`` dicts.
+
+    Parity: ``petastorm/tf_utils.py :: make_petastorm_dataset``.
+    """
+    tf = _tf()
+    schema = reader.schema
+
+    if reader.ngram is not None:
+        return _make_ngram_dataset(tf, reader)
+
+    names, dtypes = _schema_to_tf_dtypes(schema)
+    batched = getattr(reader, 'batched_output', False)
+
+    def generator():
+        for item in reader:
+            yield tuple(_sanitize_value(getattr(item, n), schema.fields[n]) for n in names)
+
+    leading = (None,) if batched else ()
+    signature = tuple(
+        tf.TensorSpec(shape=leading + _tf_shape(schema.fields[n]), dtype=d)
+        for n, d in zip(names, dtypes))
+    dataset = tf.data.Dataset.from_generator(generator, output_signature=signature)
+    row_type = schema._get_namedtuple()
+    return dataset.map(lambda *args: row_type(*args))
+
+
+def _tf_shape(field):
+    if np.dtype(field.numpy_dtype).kind in ('U', 'S', 'O'):
+        return ()
+    return tuple(d if d is not None else None for d in field.shape)
+
+
+def _make_ngram_dataset(tf, reader):
+    ngram = reader.ngram
+    schema = reader.schema
+    offsets = sorted(ngram.fields)
+    specs = {}
+    names_at = {}
+    for offset in offsets:
+        names = sorted(ngram.get_field_names_at_timestep(offset))
+        names_at[offset] = names
+        specs[offset] = tuple(
+            tf.TensorSpec(shape=_tf_shape(schema.fields[n]),
+                          dtype=_tf_dtype_for(schema.fields[n].numpy_dtype))
+            for n in names)
+
+    def generator():
+        for window in reader:
+            yield tuple(
+                tuple(_sanitize_value(getattr(window[offset], n), schema.fields[n])
+                      for n in names_at[offset])
+                for offset in offsets)
+
+    signature = tuple(specs[offset] for offset in offsets)
+    dataset = tf.data.Dataset.from_generator(generator, output_signature=signature)
+
+    def to_dict(*steps):
+        return {offset: dict(zip(names_at[offset], step))
+                for offset, step in zip(offsets, steps)}
+
+    return dataset.map(to_dict)
+
+
+def tf_tensors(reader):
+    """Legacy TF1 tensors interface: one `tf.py_function` pull per session run.
+
+    Parity: reference ``petastorm/tf_utils.py :: tf_tensors`` (queue-runner
+    machinery reduced to a py_function pull: TF1 QueueRunners are deprecated
+    in the TF2 runtime this targets; reads still happen in the reader's own
+    worker pool).
+    """
+    tf = _tf()
+    schema = reader.schema
+    if reader.ngram is not None:
+        raise NotImplementedError('tf_tensors with NGram: use make_petastorm_dataset')
+    names, dtypes = _schema_to_tf_dtypes(schema)
+
+    def pull():
+        row = next(reader)
+        return [np.asarray(_sanitize_value(getattr(row, n), schema.fields[n]))
+                for n in names]
+
+    tensors = tf.py_function(pull, [], dtypes)
+    row_type = schema._get_namedtuple()
+    return row_type(*tensors)

@@ -1,0 +1,88 @@
+"""Copy/subset a petastorm dataset, regenerating metadata.
+
+Parity: reference ``petastorm/tools/copy_dataset.py :: copy_dataset``
+(console script ``petastorm-copy-dataset``) — there a Spark job; here a
+host-side streaming copy through the reader/writer pair (no JVM), with
+column projection, predicate filtering, and re-chunking.
+"""
+
+import argparse
+
+from petastorm_tpu.etl.dataset_metadata import DatasetWriter, get_schema_from_dataset_url
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.unischema import Unischema
+
+
+def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
+                 overwrite_output=False, partitions_count=None, row_group_size_mb=None,
+                 rows_per_rowgroup=None, predicate=None, storage_options=None):
+    """Stream rows from ``source_url`` into a fresh dataset at ``target_url``.
+
+    ``field_regex``: keep only matching columns. ``not_null_fields``: drop
+    rows with nulls in these fields. ``partitions_count`` is accepted for
+    signature parity (Spark partition count) and maps to ``rows_per_file``.
+    """
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    fs, target_path = get_filesystem_and_path_or_paths(target_url,
+                                                       storage_options=storage_options)
+    if fs.exists(target_path) and fs.ls(target_path):
+        if not overwrite_output:
+            raise ValueError('Target %r exists; pass overwrite_output=True' % (target_url,))
+        fs.rm(target_path, recursive=True)
+
+    stored_schema = get_schema_from_dataset_url(source_url, storage_options=storage_options)
+    if field_regex:
+        schema = stored_schema.create_schema_view(field_regex)
+    else:
+        schema = stored_schema
+    schema = Unischema(stored_schema.name, list(schema.fields.values()))
+
+    not_null_fields = set(not_null_fields or [])
+    missing = not_null_fields - set(schema.fields)
+    if missing:
+        raise ValueError('not_null_fields %s not in copied schema' % sorted(missing))
+
+    rows_per_file = None
+    writer_kwargs = {}
+    if rows_per_rowgroup is not None:
+        writer_kwargs['rows_per_rowgroup'] = rows_per_rowgroup
+    elif row_group_size_mb is not None:
+        writer_kwargs['rowgroup_size_mb'] = row_group_size_mb
+
+    copied = 0
+    with make_reader(source_url, schema_fields=list(schema.fields), predicate=predicate,
+                     shuffle_row_groups=False, num_epochs=1,
+                     storage_options=storage_options) as reader, \
+            DatasetWriter(target_url, schema, rows_per_file=rows_per_file,
+                          storage_options=storage_options, **writer_kwargs) as writer:
+        for row in reader:
+            row_dict = row._asdict()
+            if not_null_fields and any(row_dict.get(f) is None for f in not_null_fields):
+                continue
+            writer.write(row_dict)
+            copied += 1
+    return copied
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('source_url')
+    parser.add_argument('target_url')
+    parser.add_argument('--field-regex', nargs='*', default=None,
+                        help='Copy only fields full-matching these regexes')
+    parser.add_argument('--not-null-fields', nargs='*', default=None,
+                        help='Drop rows with nulls in these fields')
+    parser.add_argument('--overwrite-output', action='store_true')
+    parser.add_argument('--rows-per-rowgroup', type=int, default=None)
+    parser.add_argument('--row-group-size-mb', type=int, default=None)
+    args = parser.parse_args(argv)
+    n = copy_dataset(args.source_url, args.target_url, field_regex=args.field_regex,
+                     not_null_fields=args.not_null_fields,
+                     overwrite_output=args.overwrite_output,
+                     rows_per_rowgroup=args.rows_per_rowgroup,
+                     row_group_size_mb=args.row_group_size_mb)
+    print('Copied %d rows to %s' % (n, args.target_url))
+
+
+if __name__ == '__main__':
+    main()

@@ -12,7 +12,8 @@ from petastorm_tpu.workers_pool import EmptyResultError, VentilatedItem
 
 class DummyPool(object):
     def __init__(self, workers_count=1):
-        # workers_count accepted for signature parity; always synchronous.
+        # Always synchronous; the attribute is the uniform pool-sizing surface.
+        self.workers_count = 1
         self._pending = deque()
         self._results = deque()
         self._worker = None

@@ -1,0 +1,164 @@
+"""True multiprocess pool over ZeroMQ PUSH/PULL sockets.
+
+Parity: reference ``petastorm/workers_pool/process_pool.py :: ProcessPool`` —
+main process binds a work (ventilator) PUSH socket and a sink PULL socket;
+worker processes are spawned via fresh-interpreter exec
+(``exec_in_new_process``), receive pickled work items, and send back
+serialized results (pickle for row lists, Arrow IPC for tables —
+``petastorm_tpu/reader_impl/*_serializer.py``).
+
+On TPU-VM hosts the ThreadPool is usually the better choice (pyarrow/cv2
+release the GIL; note the pool-choice guidance in SURVEY.md §7 stage 9) —
+the ProcessPool exists for parity and for transform-heavy pure-python
+workloads where the GIL does bind.
+"""
+
+import os
+import pickle
+import tempfile
+import uuid
+
+from petastorm_tpu.workers_pool import (DEFAULT_TIMEOUT_S, EmptyResultError,
+                                        TimeoutWaitingForResultError, VentilatedItem)
+from petastorm_tpu.workers_pool.exec_in_new_process import exec_in_new_process
+from petastorm_tpu.workers_pool.process_worker import worker_main
+
+
+class ProcessPool(object):
+    def __init__(self, workers_count=10, results_queue_size=50, zmq_copy_buffers=True):
+        self.workers_count = workers_count
+        self.results_queue_size = results_queue_size
+        self._zmq_copy_buffers = zmq_copy_buffers
+        self._context = None
+        self._work_socket = None
+        self._sink_socket = None
+        self._processes = []
+        self._ventilator = None
+        self._inflight = 0
+        self.items_processed = 0
+        self._stopped = False
+
+    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+        import zmq
+
+        from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
+        from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+
+        self._pickle_ser = PickleSerializer()
+        self._arrow_ser = ArrowTableSerializer()
+
+        self._context = zmq.Context()
+        endpoint_dir = tempfile.mkdtemp(prefix='pstpu_zmq_')
+        work_addr = 'ipc://%s' % os.path.join(endpoint_dir, 'work_' + uuid.uuid4().hex[:8])
+        sink_addr = 'ipc://%s' % os.path.join(endpoint_dir, 'sink_' + uuid.uuid4().hex[:8])
+        self._work_socket = self._context.socket(zmq.PUSH)
+        self._work_socket.bind(work_addr)
+        self._sink_socket = self._context.socket(zmq.PULL)
+        self._sink_socket.set_hwm(self.results_queue_size)
+        self._sink_socket.bind(sink_addr)
+
+        try:
+            setup_payload = pickle.dumps(
+                (worker_class, worker_setup_args, work_addr, sink_addr,
+                 self._zmq_copy_buffers), protocol=4)
+        except Exception:
+            # Unpicklable worker args (e.g. a closure transform): fail clean,
+            # leaving no bound sockets behind.
+            self._work_socket.close(0)
+            self._sink_socket.close(0)
+            self._context.term()
+            self._work_socket = self._sink_socket = self._context = None
+            raise
+        for worker_id in range(self.workers_count):
+            self._processes.append(exec_in_new_process(worker_main, setup_payload, worker_id))
+
+        self._ventilator = ventilator
+        if ventilator is not None:
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        position = None
+        if len(args) == 1 and isinstance(args[0], VentilatedItem):
+            position, args = args[0].position, tuple(args[0].args)
+        self._inflight += 1
+        self._work_socket.send(pickle.dumps((position, args, kwargs), protocol=4))
+
+    def get_results(self, timeout=DEFAULT_TIMEOUT_S):
+        import zmq
+        deadline_ms = int(timeout * 1000)
+        poller = zmq.Poller()
+        poller.register(self._sink_socket, zmq.POLLIN)
+        waited = 0
+        while True:
+            events = dict(poller.poll(50))
+            if self._sink_socket in events:
+                tag, payload = self._sink_socket.recv_multipart()
+                if tag == b'R':
+                    return self._pickle_ser.deserialize(payload)
+                if tag == b'A':
+                    return self._arrow_ser.deserialize(payload)
+                if tag == b'K':
+                    position = pickle.loads(payload)
+                    self._inflight -= 1
+                    self.items_processed += 1
+                    if self._ventilator is not None:
+                        self._ventilator.processed_item(position)
+                    continue
+                if tag == b'E':
+                    exc, tb_str = pickle.loads(payload)
+                    import sys
+                    sys.stderr.write(tb_str)
+                    raise exc
+                raise RuntimeError('Unknown sink tag %r' % (tag,))
+            if self._all_done():
+                raise EmptyResultError()
+            dead = [p for p in self._processes if p.poll() is not None]
+            if dead and self._inflight > 0:
+                raise TimeoutWaitingForResultError(
+                    '%d worker process(es) died (exit codes %s) with %d items in flight'
+                    % (len(dead), [p.returncode for p in dead], self._inflight))
+            waited += 50
+            if waited >= deadline_ms:
+                raise TimeoutWaitingForResultError(
+                    'No results within %ss; %d in flight, %d/%d workers alive'
+                    % (timeout, self._inflight,
+                       sum(p.poll() is None for p in self._processes),
+                       len(self._processes)))
+
+    def _all_done(self):
+        if self._ventilator is not None and not self._ventilator.completed():
+            return False
+        return self._inflight == 0
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        if self._work_socket is not None:
+            for _ in self._processes:
+                self._work_socket.send_multipart([b'', b'STOP'])
+
+    def join(self):
+        for process in self._processes:
+            try:
+                process.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                process.kill()
+        if self._work_socket is not None:
+            self._work_socket.close(0)
+        if self._sink_socket is not None:
+            self._sink_socket.close(0)
+        if self._context is not None:
+            self._context.term()
+
+    @property
+    def diagnostics(self):
+        return {
+            'pool': 'process',
+            'workers_count': self.workers_count,
+            'items_processed': self.items_processed,
+            'inflight': self._inflight,
+            'workers_alive': sum(p.poll() is None for p in self._processes),
+        }

@@ -1,0 +1,64 @@
+"""Child-process main loop for :class:`ProcessPool`.
+
+Connects back to the parent's ZeroMQ sockets, receives pickled work items,
+publishes serialized results, and acks each item so the parent's ventilator
+can refill.  Message framing (multipart):
+
+  work (parent->worker):  [pickle((position, args, kwargs))] | [b'', b'STOP']
+  sink (worker->parent):  [tag, payload]
+      tag b'R'  pickle-serialized result
+      tag b'A'  arrow-IPC-serialized pyarrow.Table result
+      tag b'K'  ack: pickle(position or None)
+      tag b'E'  error: pickle((exception, traceback_str))
+"""
+
+import pickle
+import traceback
+
+
+def worker_main(setup_payload, worker_id):
+    import pyarrow as pa
+    import zmq
+
+    from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
+    from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+
+    worker_class, worker_args, work_addr, sink_addr, copy_buffers = \
+        pickle.loads(setup_payload)
+
+    context = zmq.Context()
+    work_socket = context.socket(zmq.PULL)
+    work_socket.connect(work_addr)
+    sink_socket = context.socket(zmq.PUSH)
+    sink_socket.connect(sink_addr)
+
+    pickle_ser = PickleSerializer()
+    arrow_ser = ArrowTableSerializer()
+
+    def publish(result):
+        if isinstance(result, pa.Table):
+            sink_socket.send_multipart([b'A', arrow_ser.serialize(result)],
+                                       copy=copy_buffers)
+        else:
+            sink_socket.send_multipart([b'R', pickle_ser.serialize(result)],
+                                       copy=copy_buffers)
+
+    worker = worker_class(worker_id, publish, worker_args)
+    try:
+        while True:
+            frames = work_socket.recv_multipart()
+            if frames[-1] == b'STOP':
+                break
+            position, args, kwargs = pickle.loads(frames[0])
+            try:
+                worker.process(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — shipped to the parent
+                sink_socket.send_multipart(
+                    [b'E', pickle.dumps((e, traceback.format_exc()))])
+            finally:
+                sink_socket.send_multipart([b'K', pickle.dumps(position)])
+    finally:
+        worker.shutdown()
+        work_socket.close(0)
+        sink_socket.close(0)
+        context.term()

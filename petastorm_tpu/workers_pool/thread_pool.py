@@ -29,7 +29,8 @@ class _WorkerError(object):
 
 class ThreadPool(object):
     def __init__(self, workers_count=10, results_queue_size=50, profiler=None):
-        self._workers_count = workers_count
+        #: Uniform public attribute across all pool classes (reader sizing).
+        self.workers_count = workers_count
         self._input_queue = queue.Queue()
         self._results_queue = queue.Queue(maxsize=results_queue_size)
         self._threads = []
@@ -43,7 +44,7 @@ class ThreadPool(object):
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         self._ventilator = ventilator
-        for worker_id in range(self._workers_count):
+        for worker_id in range(self.workers_count):
             worker = worker_class(worker_id, self._publish, worker_setup_args)
             self._workers.append(worker)
             thread = threading.Thread(target=self._worker_loop, args=(worker,),
@@ -143,7 +144,7 @@ class ThreadPool(object):
     def diagnostics(self):
         return {
             'pool': 'thread',
-            'workers_count': self._workers_count,
+            'workers_count': self.workers_count,
             'items_processed': self.items_processed,
             'inflight': self._inflight,
             'input_qsize': self._input_queue.qsize(),

@@ -25,6 +25,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # pytest-timeout is not installed in the TPU image; register the mark so
+    # the suite stays warning-free (the marks document intent either way).
+    config.addinivalue_line('markers', 'timeout(seconds): per-test time budget')
+
+
 @pytest.fixture(scope='session')
 def rng():
     return np.random.default_rng(42)

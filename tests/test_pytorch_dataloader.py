@@ -1,0 +1,91 @@
+"""Torch adapter tests.
+
+Modeled on the reference's ``petastorm/tests/test_pytorch_dataloader.py``.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.pytorch import (BatchedDataLoader, DataLoader,
+                                   InMemBatchedDataLoader, decimal_friendly_collate)
+
+from test_common import create_test_dataset
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('torchds')
+    return create_test_dataset('file://' + str(path), num_rows=40, rows_per_rowgroup=8)
+
+
+def test_row_dataloader_collates_tensors(dataset):
+    with DataLoader(make_reader(dataset.url, schema_fields=['id', 'matrix'],
+                                reader_pool_type='dummy', shuffle_row_groups=False),
+                    batch_size=10) as loader:
+        batches = list(loader)
+    assert len(batches) == 4
+    assert isinstance(batches[0].matrix, torch.Tensor)
+    assert batches[0].matrix.shape == (10, 8, 4)
+    assert batches[0].id.tolist() == list(range(10))
+
+
+def test_row_dataloader_shuffling(dataset):
+    with DataLoader(make_reader(dataset.url, schema_fields=['id'],
+                                reader_pool_type='dummy', shuffle_row_groups=False),
+                    batch_size=40, shuffling_queue_capacity=20, seed=1) as loader:
+        batch = next(iter(loader))
+    assert sorted(batch.id.tolist()) == list(range(40))
+    assert batch.id.tolist() != list(range(40))
+
+
+def test_row_dataloader_rejects_batch_reader(dataset):
+    reader = make_batch_reader(dataset.url)
+    with pytest.raises(ValueError, match='row reader'):
+        DataLoader(reader, batch_size=4)
+    reader.stop(); reader.join()
+
+
+def test_batched_dataloader_over_columnar_decode(dataset):
+    with BatchedDataLoader(make_reader(dataset.url, columnar_decode=True,
+                                       schema_fields=['id', 'matrix', 'image_png'],
+                                       reader_pool_type='dummy', shuffle_row_groups=False),
+                           batch_size=16) as loader:
+        batches = list(loader)
+    sizes = [len(b['id']) for b in batches]
+    assert sum(sizes) == 40
+    assert isinstance(batches[0]['matrix'], torch.Tensor)
+    assert batches[0]['image_png'].shape == (16, 16, 32, 3)
+
+
+def test_batched_dataloader_rejects_row_reader(dataset):
+    reader = make_reader(dataset.url)
+    with pytest.raises(ValueError, match='batch/columnar'):
+        BatchedDataLoader(reader)
+    reader.stop(); reader.join()
+
+
+def test_inmem_loader_multiple_epochs(dataset):
+    with InMemBatchedDataLoader(make_reader(dataset.url, columnar_decode=True,
+                                            schema_fields=['id'],
+                                            reader_pool_type='dummy'),
+                                batch_size=8, num_epochs=3, seed=0) as loader:
+        batches = list(loader)
+    assert len(batches) == 15  # 40/8 per epoch * 3
+    all_ids = np.concatenate([b['id'].numpy() for b in batches])
+    # every epoch covers the full id set
+    for e in range(3):
+        epoch_ids = all_ids[e * 40:(e + 1) * 40]
+        assert sorted(epoch_ids.tolist()) == list(range(40))
+
+
+def test_decimal_friendly_collate():
+    import decimal
+    out = decimal_friendly_collate([decimal.Decimal('1.5'), decimal.Decimal('2.5')])
+    assert out.dtype == torch.float64 or out.dtype == torch.float32
+    assert out.tolist() == [1.5, 2.5]
+    nested = decimal_friendly_collate([{'a': np.ones(2)}, {'a': np.zeros(2)}])
+    assert nested['a'].shape == (2, 2)
+    strings = decimal_friendly_collate(['x', 'y'])
+    assert strings == ['x', 'y']

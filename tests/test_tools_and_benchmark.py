@@ -1,0 +1,109 @@
+"""Tools (copy/metadata CLIs) + benchmark harness + stall profiler + DLRM."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.benchmark import StallMonitor, reader_throughput
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+from petastorm_tpu.etl.petastorm_generate_metadata import generate_petastorm_metadata
+from petastorm_tpu.tools.copy_dataset import copy_dataset
+
+from test_common import TestSchema, create_test_dataset
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('toolsds')
+    return create_test_dataset('file://' + str(path), num_rows=20, rows_per_rowgroup=5)
+
+
+def test_copy_dataset_projection_and_filter(dataset, tmp_path):
+    target = 'file://' + str(tmp_path / 'copy')
+    n = copy_dataset(dataset.url, target, field_regex=['id', 'matrix', 'nullable_scalar'],
+                     not_null_fields=['nullable_scalar'], rows_per_rowgroup=4)
+    expected = [r for r in dataset.data if r['nullable_scalar'] is not None]
+    assert n == len(expected)
+    with make_reader(target, reader_pool_type='dummy') as reader:
+        rows = list(reader)
+    assert set(rows[0]._fields) == {'id', 'matrix', 'nullable_scalar'}
+    assert {int(r.id) for r in rows} == {r['id'] for r in expected}
+
+
+def test_copy_dataset_refuses_overwrite(dataset, tmp_path):
+    target = 'file://' + str(tmp_path / 'c2')
+    copy_dataset(dataset.url, target, field_regex=['id'])
+    with pytest.raises(ValueError, match='overwrite_output'):
+        copy_dataset(dataset.url, target, field_regex=['id'])
+    copy_dataset(dataset.url, target, field_regex=['id'], overwrite_output=True)
+
+
+def test_generate_metadata_on_plain_dataset(tmp_path):
+    """Stamp petastorm metadata onto externally-written Parquet files."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pq.write_table(pa.table({'a': [1, 2, 3]}), str(tmp_path / 'f.parquet'))
+    url = 'file://' + str(tmp_path)
+    with pytest.raises(MetadataError):
+        get_schema_from_dataset_url(url)
+    schema = generate_petastorm_metadata(url)
+    assert 'a' in schema.fields
+    assert get_schema_from_dataset_url(url).fields['a'].numpy_dtype == np.dtype('int64')
+
+
+def test_generate_metadata_with_unischema_class(tmp_path, dataset):
+    import shutil
+    target = tmp_path / 'cloned'
+    shutil.copytree(dataset.path, target)
+    (target / '_common_metadata').unlink()
+    url = 'file://' + str(target)
+    schema = generate_petastorm_metadata(
+        url, unischema_class='test_common.TestSchema')
+    assert schema == TestSchema
+    with make_reader(url, reader_pool_type='dummy') as reader:
+        assert len(list(reader)) == 20
+
+
+def test_metadata_util_prints(dataset, capsys):
+    from petastorm_tpu.etl.metadata_util import print_dataset_metadata
+    print_dataset_metadata(dataset.url)
+    out = capsys.readouterr().out
+    assert 'TestSchema' in out and 'Row groups: 4' in out
+
+
+def test_reader_throughput_harness(dataset):
+    result = reader_throughput(dataset.url, warmup_rows=5, measure_rows=10,
+                               pool_type='dummy', workers_count=1)
+    assert result.rows_read == 10
+    assert result.rows_per_second > 0
+
+
+def test_stall_monitor_attribution():
+    import time
+    monitor = StallMonitor(warmup_steps=0)
+
+    def slow_source():
+        for _ in range(5):
+            time.sleep(0.02)   # data wait
+            yield 1
+
+    for _ in monitor.wrap(slow_source()):
+        time.sleep(0.01)       # step
+    report = monitor.report()
+    assert report['steps'] == 5
+    assert report['data_wait_s'] > report['step_s']
+    assert 50 < report['stall_pct'] < 85
+
+
+def test_dlrm_forward_shapes():
+    import jax
+    import jax.numpy as jnp
+    from petastorm_tpu.models.dlrm import DLRM
+    model = DLRM(vocab_sizes=[100, 200, 300], embedding_dim=16)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 13)),
+                        jnp.zeros((2, 3), jnp.int32))
+    out = jax.jit(model.apply)(params, jnp.ones((4, 13)),
+                               jnp.ones((4, 3), jnp.int32))
+    assert out.shape == (4,)
+    assert np.isfinite(np.asarray(out)).all()
