@@ -48,6 +48,26 @@ class DataframeColumnCodec(object):
     def decode(self, unischema_field, value):
         raise NotImplementedError()
 
+    def decode_into(self, unischema_field, value, dst):
+        """Decode straight into a preallocated array slice.
+
+        The columnar decode plane preallocates one ``(N, *shape)`` batch array
+        per row group and hands each codec a ``dst = batch[i]`` view, so the
+        decoded value never exists as a separate allocation that must then be
+        stacked (``np.stack`` is a full extra memory pass).  Codecs override
+        this when the underlying library can write into caller memory
+        (see ``CompressedImageCodec``); the default decodes then copies.
+        """
+        decoded = np.asarray(self.decode(unischema_field, value))
+        if decoded.shape != dst.shape:
+            # np.copyto would happily broadcast a (6,) cell over a (5, 6)
+            # slice; a cell whose stored shape deviates from the schema must
+            # surface as an error instead of silently flood-filling.
+            raise DecodeFieldError(
+                'Field %r cell has shape %r, schema expects %r'
+                % (unischema_field.name, decoded.shape, dst.shape))
+        np.copyto(dst, decoded, casting='same_kind')
+
     def arrow_dtype(self):
         """pyarrow storage type of the encoded cell."""
         raise NotImplementedError()
@@ -230,6 +250,12 @@ class CompressedNdarrayCodec(NdarrayCodec):
     def decode(self, unischema_field, value):
         return super(CompressedNdarrayCodec, self).decode(unischema_field, zlib.decompress(value))
 
+    def decode_batch_into(self, unischema_field, cells, dst):
+        """Whole-column native inflate (C++ zlib + .npy unpack, one GIL-free
+        call per row group).  False -> caller uses the per-cell path."""
+        from petastorm_tpu import native
+        return native.zlib_npy_decompress_batch(cells, dst)
+
 
 # -- images ------------------------------------------------------------------
 
@@ -281,18 +307,62 @@ class CompressedImageCodec(DataframeColumnCodec):
             raise ValueError('cv2.imencode failed for field %r' % (unischema_field.name,))
         return encoded.tobytes()
 
-    def decode(self, unischema_field, value):
+    @staticmethod
+    def _imdecode(unischema_field, value):
+        """BGR-ordered cv2 decode of one cell (shared by decode/decode_into).
+
+        IMREAD_UNCHANGED unconditionally: ANYCOLOR caps at 3 channels and
+        would silently drop the alpha plane of (H, W, 4) fields.
+        """
         import cv2
-        # IMREAD_UNCHANGED unconditionally: ANYCOLOR caps at 3 channels and
-        # would silently drop the alpha plane of (H, W, 4) fields.
         arr = cv2.imdecode(np.frombuffer(value, dtype=np.uint8), cv2.IMREAD_UNCHANGED)
         if arr is None:
             raise DecodeFieldError('cv2.imdecode failed for field %r' % (unischema_field.name,))
+        return arr
+
+    def decode(self, unischema_field, value):
+        import cv2
+        arr = self._imdecode(unischema_field, value)
         if arr.ndim == 3 and arr.shape[2] == 3:
             # cvtColor is a SIMD copy; much cheaper than materializing the
             # negative-stride view arr[:, :, ::-1] would cost downstream.
             arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+        shape = unischema_field.shape
+        if (shape is not None and arr.ndim + 1 == len(shape) and shape[-1] == 1
+                and arr.shape == tuple(shape[:-1])):
+            # Grayscale decodes 2-D; a field declared (H, W, 1) must get the
+            # declared rank on EVERY path (row, columnar-fallback, decode_into)
+            # or batch shapes would depend on which path a row group took.
+            arr = arr.reshape(shape)
         return np.ascontiguousarray(arr.astype(unischema_field.numpy_dtype, copy=False))
+
+    def decode_batch_into(self, unischema_field, cells, dst):
+        """Whole-column native JPEG decode (C++ libjpeg straight to RGB in the
+        batch array: no BGR intermediate, no per-image python).  False ->
+        caller uses the per-cell path."""
+        if self._image_codec not in ('.jpg', '.jpeg'):
+            return False
+        from petastorm_tpu import native
+        return native.jpeg_decode_batch(cells, dst)
+
+    def decode_into(self, unischema_field, value, dst):
+        import cv2
+        arr = self._imdecode(unischema_field, value)
+        if arr.ndim == 3 and arr.shape[2] == 3:
+            if arr.shape == dst.shape and arr.dtype == dst.dtype and dst.flags['C_CONTIGUOUS']:
+                # Fused BGR->RGB + batch placement: one pass instead of
+                # cvtColor-allocate + stack-copy.
+                cv2.cvtColor(arr, cv2.COLOR_BGR2RGB, dst=dst)
+                return
+            arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+        if (arr.ndim + 1 == dst.ndim and dst.shape[-1] == 1
+                and arr.shape == dst.shape[:-1]):
+            arr = arr.reshape(dst.shape)  # grayscale (H, W) -> (H, W, 1) only
+        if arr.shape != dst.shape:
+            raise DecodeFieldError(
+                'Field %r image decoded to shape %r, schema expects %r'
+                % (unischema_field.name, arr.shape, dst.shape))
+        np.copyto(dst, arr, casting='same_kind')
 
     def arrow_dtype(self):
         return pa.binary()

@@ -1,0 +1,142 @@
+"""ctypes bindings for the native decode plane (``pt_decode.cc``).
+
+The shared library is compiled lazily on first import (g++ -O3, linked
+against the system libjpeg/zlib) and cached next to the source; a stale or
+failed build degrades gracefully — callers check :func:`get_lib` for ``None``
+and fall back to the pure-python/cv2 codec paths, so the framework never
+hard-requires the native component (same posture as the reference, whose
+native speed all comes from optional third-party wheels — SURVEY.md §2.6).
+
+Set ``PETASTORM_TPU_NO_NATIVE=1`` to disable the native path entirely.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, 'pt_decode.cc')
+_SO = os.path.join(_HERE, 'libpt_decode.so')
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    # Compile to a unique temp path and rename into place: os.rename is
+    # atomic, so concurrent processes (ZeroMQ pool workers on a fresh
+    # checkout) never dlopen a partially written ELF.
+    tmp = '%s.%d.tmp' % (_SO, os.getpid())
+    cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17',
+           '-o', tmp, _SRC, '-ljpeg', '-lz']
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError('native build failed: %s' % proc.stderr[-2000:])
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):  # compile failure or timeout
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _load():
+    lib = ctypes.CDLL(_SO)
+    lib.pt_jpeg_decode_batch.restype = ctypes.c_int
+    lib.pt_jpeg_decode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.pt_zlib_npy_decompress_batch.restype = ctypes.c_int
+    lib.pt_zlib_npy_decompress_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t]
+    return lib
+
+
+def get_lib():
+    """The loaded native library, or None if unavailable/disabled."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        if os.environ.get('PETASTORM_TPU_NO_NATIVE'):
+            _tried = True
+            return None
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            _lib = _load()
+        except Exception as e:  # noqa: BLE001 — any failure means "no native"
+            logger.warning('Native decode library unavailable (%s); '
+                           'falling back to cv2/python decode', e)
+            _lib = None
+        _tried = True
+        return _lib
+
+
+def _as_ptr_arrays(cells):
+    """list[bytes] -> (char** array, size_t* array) borrowing the bytes."""
+    n = len(cells)
+    ptrs = (ctypes.c_char_p * n)(*cells)
+    lens = (ctypes.c_size_t * n)(*[len(c) for c in cells])
+    return ptrs, lens
+
+
+def jpeg_decode_batch(cells, dst):
+    """Decode list[bytes] JPEGs into a (N, H, W, 3)/(N, H, W) uint8 array.
+
+    Returns True when the whole batch was decoded natively; False means the
+    caller must use the fallback path (library missing, or some cell failed /
+    had unexpected dimensions — dst contents are then undefined).
+    """
+    lib = get_lib()
+    if lib is None or dst.dtype.kind != 'u' or dst.itemsize != 1 \
+            or not dst.flags['C_CONTIGUOUS']:
+        return False
+    if dst.ndim == 4 and dst.shape[3] in (1, 3):
+        h, w, c = dst.shape[1], dst.shape[2], dst.shape[3]
+    elif dst.ndim == 3:
+        h, w, c = dst.shape[1], dst.shape[2], 1
+    else:
+        return False
+    ptrs, lens = _as_ptr_arrays(cells)
+    rc = lib.pt_jpeg_decode_batch(ptrs, lens, len(cells),
+                                  dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
+    return rc == 0
+
+
+def zlib_npy_decompress_batch(cells, dst):
+    """Inflate+unpack list[bytes] zlib(.npy) cells into a (N, ...) array.
+
+    Every cell's .npy header must declare exactly the C-ordered dtype+shape
+    of a ``dst`` slice (np.lib.format's key order is fixed, so this is an
+    exact prefix match rendered here); Fortran-ordered / reshaped / foreign-
+    dtype cells are rejected natively and handled by the caller's ``np.load``
+    fallback.  Returns True on full success, False -> caller falls back.
+    """
+    lib = get_lib()
+    if lib is None or not dst.flags['C_CONTIGUOUS'] or dst.dtype.hasobject:
+        return False
+    cell_bytes = dst[0].nbytes if len(dst) else 0
+    if cell_bytes == 0:
+        return False
+    expected = "{'descr': %r, 'fortran_order': False, 'shape': %r," \
+        % (dst.dtype.str, tuple(dst.shape[1:]))
+    expected = expected.encode('latin1')
+    ptrs, lens = _as_ptr_arrays(cells)
+    rc = lib.pt_zlib_npy_decompress_batch(
+        ptrs, lens, len(cells), dst.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(cell_bytes), expected, ctypes.c_size_t(len(expected)))
+    return rc == 0

@@ -1,0 +1,161 @@
+// Native decode plane: batch JPEG -> RGB/grayscale directly into a
+// preallocated (N, H, W, C) batch array.
+//
+// Why this exists (TPU-first rationale): the decode plane is the host-CPU
+// hot spot of the whole framework (reference analog:
+// petastorm/codecs.py :: CompressedImageCodec.decode, which goes through
+// cv2.imdecode to BGR and then pays a full extra image pass converting to
+// RGB).  libjpeg emits scanlines in any requested color space, so decoding
+// straight to RGB into the caller's batch slice removes both the
+// intermediate allocation and the conversion pass.  One C call decodes a
+// whole row group's column, so worker threads spend the row group's decode
+// window entirely outside the GIL.
+//
+// Exposed C ABI (consumed via ctypes from petastorm_tpu/native/__init__.py):
+//   pt_jpeg_decode_batch(srcs, lens, n, dst, h, w, c) -> 0 on success, or
+//     (index+1) of the first image that failed / had unexpected dims.
+//   pt_zlib_npy_decompress_batch(srcs, lens, n, dst, cell_bytes,
+//                                expected_hdr, expected_hdr_len) -> same
+//     contract; each cell is zlib(np.save bytes) of a fixed-shape array
+//     (CompressedNdarrayCodec).  The .npy header travels inside the
+//     compressed stream, so it is parsed post-inflate; the header dict must
+//     START WITH expected_hdr — the caller renders the exact
+//     "{'descr': ..., 'fortran_order': False, 'shape': ...," prefix np.save
+//     emits for the schema's dtype/shape (np.lib.format key order is fixed),
+//     so Fortran-ordered, re-shaped, or foreign-dtype cells are rejected here
+//     and handled by the python fallback instead of being raw-memcpy'd.
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <new>
+
+#include <jpeglib.h>
+#include <zlib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* err = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  std::longjmp(err->jump, 1);
+}
+
+void emit_message(j_common_ptr, int) {}  // silence corrupt-stream warnings
+
+// Decode one JPEG into dst (h*w*c, C-contiguous). Returns true on success
+// with exact dimension match.
+bool decode_one(const uint8_t* src, size_t len, uint8_t* dst,
+                unsigned h, unsigned w, unsigned c) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.emit_message = emit_message;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(src),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  // Strict channel match with the schema: libjpeg would happily expand
+  // grayscale to RGB (or fold color to gray), but the cv2 fallback raises on
+  // such cells — the two paths must agree, so reject and let python decide.
+  if ((c == 1) != (cinfo.num_components == 1)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = (c == 1) ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_width != w || cinfo.output_height != h ||
+      static_cast<unsigned>(cinfo.output_components) != c) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  const size_t stride = static_cast<size_t>(w) * c;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = dst + stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int pt_jpeg_decode_batch(const uint8_t** srcs, const size_t* lens, int n,
+                         uint8_t* dst, int h, int w, int c) {
+  const size_t img_bytes = static_cast<size_t>(h) * w * c;
+  for (int i = 0; i < n; ++i) {
+    if (!decode_one(srcs[i], lens[i], dst + img_bytes * i,
+                    static_cast<unsigned>(h), static_cast<unsigned>(w),
+                    static_cast<unsigned>(c))) {
+      return i + 1;
+    }
+  }
+  return 0;
+}
+
+int pt_zlib_npy_decompress_batch(const uint8_t** srcs, const size_t* lens,
+                                 int n, uint8_t* dst, size_t cell_bytes,
+                                 const char* expected_hdr,
+                                 size_t expected_hdr_len) {
+  // Scratch holds one inflated .npy: magic(6) + version(2) + header-len
+  // field (<=4) + header (<=64KiB, 64-byte aligned in practice) + data.
+  const size_t scratch_cap = cell_bytes + 65536 + 16;
+  uint8_t* scratch = new (std::nothrow) uint8_t[scratch_cap];
+  if (scratch == nullptr) return -1;
+  int failed = 0;
+  for (int i = 0; i < n; ++i) {
+    uLongf out_len = static_cast<uLongf>(scratch_cap);
+    int rc = uncompress(scratch, &out_len, srcs[i],
+                        static_cast<uLong>(lens[i]));
+    if (rc != Z_OK || out_len < 10 ||
+        std::memcmp(scratch, "\x93NUMPY", 6) != 0) {
+      failed = i + 1;
+      break;
+    }
+    const uint8_t major = scratch[6];
+    size_t hdr_off, hlen;
+    if (major == 1) {
+      hdr_off = 10;
+      hlen = scratch[8] | (scratch[9] << 8);
+    } else if (major == 2 || major == 3) {
+      if (out_len < 12) { failed = i + 1; break; }
+      hdr_off = 12;
+      hlen = static_cast<size_t>(scratch[8]) |
+             (static_cast<size_t>(scratch[9]) << 8) |
+             (static_cast<size_t>(scratch[10]) << 16) |
+             (static_cast<size_t>(scratch[11]) << 24);
+    } else {
+      failed = i + 1;
+      break;
+    }
+    const size_t data_off = hdr_off + hlen;
+    if (out_len != data_off + cell_bytes ||  // payload size mismatch
+        hlen < expected_hdr_len ||           // header can't hold the prefix
+        std::memcmp(scratch + hdr_off, expected_hdr, expected_hdr_len) != 0) {
+      failed = i + 1;  // fortran_order / shape / dtype differs from schema
+      break;
+    }
+    std::memcpy(dst + cell_bytes * i, scratch + data_off, cell_bytes);
+  }
+  delete[] scratch;
+  return failed;
+}
+
+}  // extern "C"

@@ -1,0 +1,172 @@
+"""Native decode plane: C++ batch JPEG/zlib decode vs the python/cv2 paths.
+
+The native library is optional by design (petastorm_tpu/native/__init__.py
+falls back when the toolchain or libjpeg is missing), so every test here
+first checks availability and the reader-level test asserts fallback
+equivalence by running the same dataset with the native path disabled.
+"""
+
+import io
+import zlib
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import native
+from petastorm_tpu.codecs import CompressedImageCodec, CompressedNdarrayCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+cv2 = pytest.importorskip('cv2')
+
+requires_native = pytest.mark.skipif(native.get_lib() is None,
+                                     reason='native library unavailable')
+
+
+def _jpeg_cell(img, quality=90):
+    ok, enc = cv2.imencode('.jpg', img[:, :, ::-1],
+                           [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+    assert ok
+    return enc.tobytes()
+
+
+def _rand_image(seed, shape=(32, 24, 3)):
+    return np.random.default_rng(seed).integers(0, 255, shape).astype(np.uint8)
+
+
+@requires_native
+def test_jpeg_batch_matches_cv2():
+    field = UnischemaField('image', np.uint8, (32, 24, 3),
+                          CompressedImageCodec('jpeg', 90), False)
+    codec = field.codec
+    imgs = [_rand_image(i) for i in range(7)]
+    cells = [_jpeg_cell(img) for img in imgs]
+    dst = np.empty((7, 32, 24, 3), np.uint8)
+    assert codec.decode_batch_into(field, cells, dst)
+    for cell, native_img in zip(cells, dst):
+        # +/-1 LSB tolerance: system libjpeg and cv2's bundled build may
+        # differ in IDCT/upsampling rounding even though both are correct.
+        diff = np.abs(native_img.astype(int) - codec.decode(field, cell).astype(int))
+        assert diff.max() <= 1
+
+
+@requires_native
+def test_jpeg_batch_grayscale():
+    img = np.random.default_rng(3).integers(0, 255, (16, 16)).astype(np.uint8)
+    ok, enc = cv2.imencode('.jpg', img, [int(cv2.IMWRITE_JPEG_QUALITY), 95])
+    assert ok
+    dst = np.empty((2, 16, 16), np.uint8)
+    assert native.jpeg_decode_batch([enc.tobytes()] * 2, dst)
+    field = UnischemaField('gray', np.uint8, (16, 16),
+                          CompressedImageCodec('jpeg', 95), False)
+    ref = field.codec.decode(field, enc.tobytes())
+    assert np.abs(dst[0].astype(int) - ref.astype(int)).max() <= 1
+
+    # (H, W, 1) declared shape: native maps to grayscale; the cv2 fallback
+    # reshapes its 2-D decode to match (regression: used to raise).
+    field1 = UnischemaField('gray1', np.uint8, (16, 16, 1),
+                           CompressedImageCodec('jpeg', 95), False)
+    dst1 = np.empty((2, 16, 16, 1), np.uint8)
+    assert native.jpeg_decode_batch([enc.tobytes()] * 2, dst1)
+    fallback = np.empty((16, 16, 1), np.uint8)
+    field1.codec.decode_into(field1, enc.tobytes(), fallback)
+    assert np.abs(dst1[0].astype(int) - fallback.astype(int)).max() <= 1
+    # decode() must honor the declared trailing-singleton rank too, so every
+    # decode path (row, columnar fallback, decode_into) agrees on shape.
+    assert field1.codec.decode(field1, enc.tobytes()).shape == (16, 16, 1)
+
+
+@requires_native
+def test_jpeg_batch_rejects_wrong_dims():
+    cells = [_jpeg_cell(_rand_image(0, (32, 24, 3)))]
+    dst = np.empty((1, 64, 64, 3), np.uint8)  # wrong spatial dims
+    assert not native.jpeg_decode_batch(cells, dst)
+    assert not native.jpeg_decode_batch([b'not a jpeg'],
+                                        np.empty((1, 8, 8, 3), np.uint8))
+
+
+@requires_native
+def test_zlib_npy_batch_roundtrip():
+    field = UnischemaField('mat', np.float32, (5, 6),
+                          CompressedNdarrayCodec(), False)
+    codec = field.codec
+    arrays = [np.random.default_rng(i).standard_normal((5, 6)).astype(np.float32)
+              for i in range(4)]
+    cells = [codec.encode(field, a) for a in arrays]
+    dst = np.empty((4, 5, 6), np.float32)
+    assert codec.decode_batch_into(field, cells, dst)
+    for a, d in zip(arrays, dst):
+        assert np.array_equal(a, d)
+
+
+@requires_native
+def test_zlib_npy_batch_rejects_fortran_order():
+    """Column-major cells must be rejected natively (same byte count as
+    C-order — a raw memcpy would scramble elements) and round-trip correctly
+    through the python fallback."""
+    field = UnischemaField('mat', np.float32, (3, 4),
+                          CompressedNdarrayCodec(), False)
+    codec = field.codec
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    cell = codec.encode(field, np.asfortranarray(arr))
+    dst = np.empty((1, 3, 4), np.float32)
+    assert not native.zlib_npy_decompress_batch([cell], dst)
+    assert np.array_equal(codec.decode(field, cell), arr)  # fallback is correct
+    # Same nbytes but different declared shape must also be rejected.
+    other = UnischemaField('mat', np.float32, (2, 6), CompressedNdarrayCodec(), False)
+    cell26 = codec.encode(other, np.zeros((2, 6), np.float32))
+    assert not native.zlib_npy_decompress_batch([cell26], dst)
+
+
+@requires_native
+def test_zlib_npy_batch_rejects_size_mismatch():
+    field = UnischemaField('mat', np.float32, (5, 6),
+                          CompressedNdarrayCodec(), False)
+    cell = field.codec.encode(field, np.zeros((5, 6), np.float32))
+    dst = np.empty((1, 7, 6), np.float32)  # wrong shape -> payload mismatch
+    assert not native.zlib_npy_decompress_batch([cell], dst)
+    assert not native.zlib_npy_decompress_batch([b'\x00bogus'],
+                                                np.empty((1, 5, 6), np.float32))
+
+
+def test_reader_native_and_fallback_agree(tmp_path, monkeypatch):
+    """End-to-end: columnar decode must yield identical rows with the native
+    path enabled and disabled."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+
+    schema = Unischema('Imgs', [
+        UnischemaField('idx', np.int64, (), None, False),
+        UnischemaField('image', np.uint8, (32, 24, 3),
+                       CompressedImageCodec('jpeg', 90), False),
+        UnischemaField('mat', np.float32, (5, 6), CompressedNdarrayCodec(), False),
+    ])
+    url = 'file://' + str(tmp_path / 'ds')
+    rows = [{'idx': np.int64(i), 'image': _rand_image(i),
+             'mat': np.random.default_rng(100 + i).standard_normal((5, 6)).astype(np.float32)}
+            for i in range(10)]
+    with DatasetWriter(url, schema, rows_per_rowgroup=4) as w:
+        for r in rows:
+            w.write(r)
+
+    def read_all():
+        out = {}
+        with make_reader(url, num_epochs=1, shuffle_row_groups=False,
+                         reader_pool_type='dummy', columnar_decode=True) as reader:
+            for batch in reader:
+                for i, idx in enumerate(batch.idx):
+                    out[int(idx)] = (batch.image[i].copy(), batch.mat[i].copy())
+        return out
+
+    native_out = read_all()
+
+    # Disable native decode via the codec hooks (get_lib caches, so patch the
+    # bindings rather than the env var).
+    monkeypatch.setattr(native, 'jpeg_decode_batch', lambda cells, dst: False)
+    monkeypatch.setattr(native, 'zlib_npy_decompress_batch', lambda cells, dst: False)
+    fallback_out = read_all()
+
+    assert set(native_out) == set(fallback_out) == set(range(10))
+    for i in range(10):
+        img_diff = np.abs(native_out[i][0].astype(int) - fallback_out[i][0].astype(int))
+        assert img_diff.max() <= 1  # lossy decoder builds may differ by 1 LSB
+        assert np.array_equal(native_out[i][1], fallback_out[i][1])
