@@ -1,0 +1,23 @@
+"""Read a plain Parquet dataset as a tf.data.Dataset via make_batch_reader.
+
+Parity: reference ``examples/hello_world/external_dataset/tensorflow_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu import make_batch_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def tensorflow_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with make_batch_reader(dataset_url) as reader:
+        dataset = make_petastorm_dataset(reader)
+        for batch in dataset.take(2):
+            print('columnar batch ids:', batch.id.numpy()[:5])
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    tensorflow_hello_world(args.dataset_url)

@@ -1,0 +1,25 @@
+"""Read the hello-world dataset through the torch DataLoader adapter.
+
+Parity: reference ``examples/hello_world/petastorm_dataset/pytorch_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.pytorch import DataLoader
+
+
+def pytorch_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    # batch_size=1: array_4d has a wildcard leading dim, so rows cannot be
+    # stacked (same constraint as the reference example).
+    with DataLoader(make_reader(dataset_url), batch_size=1) as loader:
+        for batch in loader:
+            print('id batch:', batch.id, 'image1:', tuple(batch.image1.shape))
+            break
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    pytorch_hello_world(args.dataset_url)

@@ -1,0 +1,24 @@
+"""Read the hello-world dataset as a tf.data.Dataset.
+
+Parity: reference ``examples/hello_world/petastorm_dataset/tensorflow_hello_world.py``
+(eager tf.data iteration via ``make_petastorm_dataset``).
+"""
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def tensorflow_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with make_reader(dataset_url) as reader:
+        dataset = make_petastorm_dataset(reader)
+        for sample in dataset.take(4):
+            print(int(sample.id), sample.image1.shape)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    tensorflow_hello_world(args.dataset_url)

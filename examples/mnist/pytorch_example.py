@@ -1,0 +1,52 @@
+"""Train a small torch MLP on the MNIST Parquet dataset (CPU).
+
+Parity: reference ``examples/mnist/pytorch_example.py`` — the torch adapter
+end-to-end flow (make_reader -> petastorm_tpu.pytorch.DataLoader -> train).
+"""
+
+import argparse
+
+import numpy as np
+
+
+def train(dataset_url, epochs=1, batch_size=128, lr=1e-3):
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.pytorch import DataLoader
+    from petastorm_tpu.transform import TransformSpec
+
+    model = nn.Sequential(nn.Flatten(), nn.Linear(28 * 28, 128), nn.ReLU(),
+                          nn.Linear(128, 10))
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+
+    transform = TransformSpec(
+        lambda row: {**row, 'image': (row['image'].astype(np.float32) / 255.0)})
+
+    accs = []
+    for epoch in range(epochs):
+        reader = make_reader(dataset_url, num_epochs=1, workers_count=4,
+                             transform_spec=transform)
+        with DataLoader(reader, batch_size=batch_size,
+                        shuffling_queue_capacity=2048) as loader:
+            for batch in loader:
+                images, labels = batch.image, batch.digit
+                opt.zero_grad()
+                logits = model(images)
+                loss = F.cross_entropy(logits, labels)
+                loss.backward()
+                opt.step()
+                accs.append((logits.argmax(-1) == labels).float().mean().item())
+        print('epoch %d: loss=%.4f acc=%.3f' % (epoch, loss.item(), np.mean(accs[-20:])))
+    return float(np.mean(accs[-20:]))
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/mnist_petastorm')
+    parser.add_argument('--epochs', type=int, default=1)
+    parser.add_argument('--batch-size', type=int, default=128)
+    args = parser.parse_args()
+    print('final accuracy: %.3f' % train(args.dataset_url, args.epochs, args.batch_size))

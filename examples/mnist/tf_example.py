@@ -1,0 +1,41 @@
+"""Train a small keras MLP on the MNIST Parquet dataset (CPU).
+
+Parity: reference ``examples/mnist/tf_example.py`` — the TF adapter
+end-to-end flow (make_reader -> make_petastorm_dataset -> model.fit).
+"""
+
+import argparse
+
+
+def train(dataset_url, epochs=1, batch_size=128):
+    import tensorflow as tf
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Flatten(input_shape=(28, 28)),
+        tf.keras.layers.Dense(128, activation='relu'),
+        tf.keras.layers.Dense(10),
+    ])
+    model.compile(optimizer='adam',
+                  loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+                  metrics=['accuracy'])
+
+    history = None
+    for _ in range(epochs):
+        with make_reader(dataset_url, num_epochs=1, workers_count=4) as reader:
+            dataset = make_petastorm_dataset(reader) \
+                .map(lambda row: (tf.cast(row.image, tf.float32) / 255.0, row.digit)) \
+                .batch(batch_size)
+            history = model.fit(dataset, epochs=1, verbose=2)
+    return float(history.history['accuracy'][-1])
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/mnist_petastorm')
+    parser.add_argument('--epochs', type=int, default=1)
+    parser.add_argument('--batch-size', type=int, default=128)
+    args = parser.parse_args()
+    print('final accuracy: %.3f' % train(args.dataset_url, args.epochs, args.batch_size))

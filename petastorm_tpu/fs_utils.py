@@ -4,9 +4,10 @@ Parity: reference ``petastorm/fs_utils.py :: FilesystemResolver,
 get_filesystem_and_path_or_paths``.  The reference resolves to a *pyarrow*
 filesystem with bespoke HDFS namenode logic (``petastorm/hdfs/namenode.py``);
 on TPU-VM hosts the primary remote store is GCS, so we resolve through
-**fsspec** (gcsfs / s3fs / local), which pyarrow consumes directly.  HDFS HA
-namenode resolution is delegated to fsspec's hdfs driver rather than
-re-implementing hadoop-XML parsing.
+**fsspec** (gcsfs / s3fs / local), which pyarrow consumes directly.
+``hdfs://`` URLs route through ``petastorm_tpu/hdfs/namenode.py`` (hadoop
+XML config parsing, HA nameservice expansion, namenode failover) before the
+fsspec hdfs driver opens the connection.
 """
 
 from urllib.parse import urlparse
@@ -22,7 +23,8 @@ class FilesystemResolver(object):
     Parity: ``petastorm/fs_utils.py :: FilesystemResolver``.
     """
 
-    def __init__(self, dataset_url, storage_options=None, filesystem=None):
+    def __init__(self, dataset_url, storage_options=None, filesystem=None,
+                 hdfs_driver='libhdfs', user=None):
         if not isinstance(dataset_url, str):
             raise ValueError('dataset_url must be a string, got %r' % (dataset_url,))
         dataset_url = dataset_url[:-1] if dataset_url.endswith('/') else dataset_url
@@ -32,6 +34,10 @@ class FilesystemResolver(object):
         if filesystem is not None:
             self._filesystem = filesystem
             self._path = parsed.path if parsed.scheme else dataset_url
+        elif parsed.scheme == 'hdfs':
+            self._filesystem = _resolve_hdfs(parsed, hdfs_driver, user,
+                                             storage_options or {})
+            self._path = parsed.path
         else:
             protocol = parsed.scheme or 'file'
             self._filesystem, self._path = _resolve(protocol, dataset_url, storage_options or {})
@@ -52,7 +58,34 @@ def _resolve(protocol, url, storage_options):
     return fs, path
 
 
-def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesystem=None):
+def _resolve_hdfs(parsed, hdfs_driver, user, storage_options):
+    """hdfs:// authority -> filesystem, with HA nameservice expansion.
+
+    Parity: the reference's ``FilesystemResolver`` hdfs branch
+    (``petastorm/fs_utils.py``) backed by ``petastorm/hdfs/namenode.py``:
+    an empty authority uses ``fs.defaultFS``; an authority matching a
+    configured nameservice expands to its namenode list; otherwise the
+    authority is a direct ``host:port``.  ``storage_options`` (e.g.
+    ``user``, ``kerb_ticket``) pass through to the fsspec hdfs driver.
+    """
+    from petastorm_tpu.hdfs.namenode import HdfsConnector, HdfsNamenodeResolver
+    resolver = HdfsNamenodeResolver()
+    if not parsed.netloc:
+        _, namenodes = resolver.resolve_default_hdfs_service()
+    else:
+        namenodes = resolver.resolve_hdfs_name_service(parsed.netloc)
+        if namenodes is None:
+            namenodes = [parsed.netloc]
+    connector = HdfsConnector()
+    if len(namenodes) == 1:
+        return connector.hdfs_connect_namenode(namenodes[0], driver=hdfs_driver,
+                                               user=user, storage_options=storage_options)
+    return connector.connect_to_either_namenode(namenodes, user=user,
+                                                storage_options=storage_options)
+
+
+def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesystem=None,
+                                     hdfs_driver='libhdfs', user=None):
     """Resolve one URL or a list of URLs (all on the same filesystem).
 
     Parity: ``petastorm/fs_utils.py :: get_filesystem_and_path_or_paths``.
@@ -61,7 +94,8 @@ def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesyst
     schemes = {urlparse(u).scheme or 'file' for u in urls}
     if len(schemes) > 1:
         raise ValueError('All dataset URLs must share a scheme, got %s' % sorted(schemes))
-    resolvers = [FilesystemResolver(u, storage_options=storage_options, filesystem=filesystem)
+    resolvers = [FilesystemResolver(u, storage_options=storage_options, filesystem=filesystem,
+                                    hdfs_driver=hdfs_driver, user=user)
                  for u in urls]
     fs = resolvers[0].filesystem()
     paths = [r.get_dataset_path() for r in resolvers]

@@ -1,0 +1,4 @@
+from petastorm_tpu.hdfs.namenode import (HdfsConnectError,           # noqa: F401
+                                         HdfsConnector,
+                                         HdfsNamenodeResolver,
+                                         MaxFailoversExceeded)

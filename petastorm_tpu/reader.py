@@ -90,12 +90,13 @@ def make_reader(dataset_url,
                 cache_type='null', cache_location=None, cache_size_limit=None,
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None,
-                storage_options=None, filesystem=None,
+                storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                 seed=None, resume_state=None, zmq_copy_buffers=True,
                 columnar_decode=False):
     """Reader over a petastorm-format dataset (codec-decoded rows).
 
-    Parity: ``petastorm/reader.py :: make_reader`` (argument names kept).
+    Parity: ``petastorm/reader.py :: make_reader`` (argument names kept,
+    including ``hdfs_driver`` — see ``petastorm_tpu/hdfs/namenode.py``).
     Yields namedtuple rows.  See module docstring for TPU-first defaults.
 
     ``columnar_decode=True`` (extension): workers publish one stacked
@@ -105,7 +106,8 @@ def make_reader(dataset_url,
     consumer thread.
     """
     fs, path = get_filesystem_and_path_or_paths(
-        dataset_url, storage_options=storage_options, filesystem=filesystem)
+        dataset_url, storage_options=storage_options, filesystem=filesystem,
+        hdfs_driver=hdfs_driver)
     stored_schema = get_schema(fs, path)
 
     return _make_reader_common(
@@ -215,7 +217,7 @@ def make_batch_reader(dataset_url_or_urls,
                       cache_type='null', cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None,
-                      storage_options=None, filesystem=None,
+                      storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                       seed=None, resume_state=None, zmq_copy_buffers=True):
     """Columnar reader over *any* Parquet store (no petastorm metadata needed).
 
@@ -227,7 +229,8 @@ def make_batch_reader(dataset_url_or_urls,
                                                    ArrowResultConverter)
 
     fs, path_or_paths = get_filesystem_and_path_or_paths(
-        dataset_url_or_urls, storage_options=storage_options, filesystem=filesystem)
+        dataset_url_or_urls, storage_options=storage_options, filesystem=filesystem,
+        hdfs_driver=hdfs_driver)
     paths = path_or_paths if isinstance(path_or_paths, list) else [path_or_paths]
 
     stored_schema = infer_or_load_unischema(fs, paths[0])

@@ -1,0 +1,175 @@
+"""HDFS namenode resolution — mocked hadoop XML configs and fake connectors.
+
+Mirrors the reference's test approach (``petastorm/tests/test_hdfs_namenode``):
+no real namenode is ever contacted; configuration parsing, HA expansion, and
+failover ordering are asserted against fabricated core-site/hdfs-site files
+and a connector stub.
+"""
+
+import os
+
+import pytest
+
+from petastorm_tpu.hdfs.namenode import (HdfsConnectError, HdfsConnector,
+                                         HdfsNamenodeResolver,
+                                         MaxFailoversExceeded)
+
+HA_CONFIG = {
+    'fs.defaultFS': 'hdfs://nameservice1',
+    'dfs.nameservices': 'nameservice1',
+    'dfs.ha.namenodes.nameservice1': 'nn1,nn2',
+    'dfs.namenode.rpc-address.nameservice1.nn1': 'namenode-a:8020',
+    'dfs.namenode.rpc-address.nameservice1.nn2': 'namenode-b:8020',
+}
+
+_CORE_SITE = """<?xml version="1.0"?>
+<configuration>
+  <property><name>fs.defaultFS</name><value>hdfs://nameservice1</value></property>
+</configuration>
+"""
+
+_HDFS_SITE = """<?xml version="1.0"?>
+<configuration>
+  <property><name>dfs.nameservices</name><value>nameservice1</value></property>
+  <property><name>dfs.ha.namenodes.nameservice1</name><value>nn1,nn2</value></property>
+  <property><name>dfs.namenode.rpc-address.nameservice1.nn1</name><value>namenode-a:8020</value></property>
+  <property><name>dfs.namenode.rpc-address.nameservice1.nn2</name><value>namenode-b:8020</value></property>
+</configuration>
+"""
+
+
+def test_ha_nameservice_resolution():
+    resolver = HdfsNamenodeResolver(HA_CONFIG)
+    assert resolver.resolve_hdfs_name_service('nameservice1') == \
+        ['namenode-a:8020', 'namenode-b:8020']
+    # An unknown namespace is not an error — it's a plain hostname.
+    assert resolver.resolve_hdfs_name_service('some-host') is None
+
+
+def test_default_service_resolution():
+    resolver = HdfsNamenodeResolver(HA_CONFIG)
+    ns, namenodes = resolver.resolve_default_hdfs_service()
+    assert ns == 'nameservice1'
+    assert namenodes == ['namenode-a:8020', 'namenode-b:8020']
+
+
+def test_default_service_non_ha_appends_port():
+    resolver = HdfsNamenodeResolver({'fs.defaultFS': 'hdfs://single-nn'})
+    ns, namenodes = resolver.resolve_default_hdfs_service()
+    assert ns == 'single-nn'
+    assert namenodes == ['single-nn:8020']
+
+
+def test_missing_rpc_address_raises():
+    config = dict(HA_CONFIG)
+    del config['dfs.namenode.rpc-address.nameservice1.nn2']
+    with pytest.raises(HdfsConnectError, match='rpc-address'):
+        HdfsNamenodeResolver(config).resolve_hdfs_name_service('nameservice1')
+
+
+def test_no_configuration_default_service_raises():
+    with pytest.raises(HdfsConnectError, match='no hadoop configuration'):
+        HdfsNamenodeResolver({}).resolve_default_hdfs_service()
+
+
+def test_non_hdfs_default_fs_raises():
+    with pytest.raises(HdfsConnectError, match='does not define an HDFS'):
+        HdfsNamenodeResolver({'fs.defaultFS': 'file:///'}).resolve_default_hdfs_service()
+
+
+def test_site_xml_loading(tmp_path, monkeypatch):
+    conf = tmp_path / 'hadoop-conf'
+    conf.mkdir()
+    (conf / 'core-site.xml').write_text(_CORE_SITE)
+    (conf / 'hdfs-site.xml').write_text(_HDFS_SITE)
+    monkeypatch.setenv('HADOOP_CONF_DIR', str(conf))
+    monkeypatch.delenv('HADOOP_HOME', raising=False)
+    resolver = HdfsNamenodeResolver()
+    assert resolver.resolve_default_hdfs_service()[1] == \
+        ['namenode-a:8020', 'namenode-b:8020']
+
+
+def test_hadoop_home_layout(tmp_path, monkeypatch):
+    home = tmp_path / 'hadoop'
+    conf = home / 'etc' / 'hadoop'
+    conf.mkdir(parents=True)
+    (conf / 'core-site.xml').write_text(_CORE_SITE)
+    (conf / 'hdfs-site.xml').write_text(_HDFS_SITE)
+    monkeypatch.delenv('HADOOP_CONF_DIR', raising=False)
+    monkeypatch.setenv('HADOOP_HOME', str(home))
+    resolver = HdfsNamenodeResolver()
+    assert resolver.resolve_hdfs_name_service('nameservice1') == \
+        ['namenode-a:8020', 'namenode-b:8020']
+
+
+class _FakeConnector(HdfsConnector):
+    """Connector stub: 'down' authorities raise, others return a token."""
+
+    down = set()
+    attempts = []
+
+    @classmethod
+    def hdfs_connect_namenode(cls, url_authority, driver='libhdfs', user=None,
+                              storage_options=None):
+        cls.attempts.append(url_authority)
+        cls.last_storage_options = storage_options
+        if url_authority in cls.down:
+            raise ConnectionError('namenode %s is down' % url_authority)
+        return 'fs@%s' % url_authority
+
+
+def test_failover_picks_second_namenode():
+    _FakeConnector.down = {'namenode-a:8020'}
+    _FakeConnector.attempts = []
+    fs = _FakeConnector.connect_to_either_namenode(
+        ['namenode-a:8020', 'namenode-b:8020'])
+    assert fs == 'fs@namenode-b:8020'
+    assert _FakeConnector.attempts == ['namenode-a:8020', 'namenode-b:8020']
+
+
+def test_failover_all_down_raises():
+    _FakeConnector.down = {'namenode-a:8020', 'namenode-b:8020'}
+    with pytest.raises(MaxFailoversExceeded) as exc_info:
+        _FakeConnector.connect_to_either_namenode(
+            ['namenode-a:8020', 'namenode-b:8020'])
+    assert len(exc_info.value.failed_exceptions) == 2
+
+
+def test_failover_caps_at_max_namenodes():
+    _FakeConnector.down = {'a:1', 'b:2', 'c:3'}
+    _FakeConnector.attempts = []
+    with pytest.raises(MaxFailoversExceeded):
+        _FakeConnector.connect_to_either_namenode(['a:1', 'b:2', 'c:3'])
+    assert _FakeConnector.attempts == ['a:1', 'b:2']  # MAX_NAMENODES == 2
+
+
+def test_filesystem_resolver_hdfs_route(monkeypatch, tmp_path):
+    """hdfs:// URLs route through namenode resolution + connector."""
+    from petastorm_tpu import fs_utils
+
+    conf = tmp_path / 'conf'
+    conf.mkdir()
+    (conf / 'core-site.xml').write_text(_CORE_SITE)
+    (conf / 'hdfs-site.xml').write_text(_HDFS_SITE)
+    monkeypatch.setenv('HADOOP_CONF_DIR', str(conf))
+    monkeypatch.delenv('HADOOP_HOME', raising=False)
+    _FakeConnector.down = set()
+    _FakeConnector.attempts = []
+    monkeypatch.setattr('petastorm_tpu.hdfs.namenode.HdfsConnector', _FakeConnector)
+
+    resolver = fs_utils.FilesystemResolver('hdfs://nameservice1/data/set')
+    assert resolver.filesystem() == 'fs@namenode-a:8020'
+    assert resolver.get_dataset_path() == '/data/set'
+
+    # Direct host:port authority skips nameservice expansion.
+    resolver = fs_utils.FilesystemResolver('hdfs://other-nn:9000/x')
+    assert resolver.filesystem() == 'fs@other-nn:9000'
+
+    # Empty authority falls back to fs.defaultFS.
+    resolver = fs_utils.FilesystemResolver('hdfs:///data/set')
+    assert resolver.filesystem() == 'fs@namenode-a:8020'
+
+    # storage_options (e.g. kerberos credentials) reach the hdfs driver.
+    fs_utils.FilesystemResolver('hdfs://other-nn:9000/x',
+                                storage_options={'kerb_ticket': '/tmp/krb5cc'})
+    assert _FakeConnector.last_storage_options == {'kerb_ticket': '/tmp/krb5cc'}
