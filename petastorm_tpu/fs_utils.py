@@ -94,11 +94,15 @@ def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesyst
     schemes = {urlparse(u).scheme or 'file' for u in urls}
     if len(schemes) > 1:
         raise ValueError('All dataset URLs must share a scheme, got %s' % sorted(schemes))
-    resolvers = [FilesystemResolver(u, storage_options=storage_options, filesystem=filesystem,
-                                    hdfs_driver=hdfs_driver, user=user)
-                 for u in urls]
-    fs = resolvers[0].filesystem()
-    paths = [r.get_dataset_path() for r in resolvers]
+    # Resolve the filesystem once from the first URL (for hdfs:// this opens a
+    # live namenode connection — doing it per URL would multiply startup cost);
+    # the remaining URLs only need their path portion extracted.
+    first = FilesystemResolver(urls[0], storage_options=storage_options, filesystem=filesystem,
+                               hdfs_driver=hdfs_driver, user=user)
+    fs = first.filesystem()
+    strip = getattr(type(fs), '_strip_protocol', None)
+    paths = [first.get_dataset_path()]
+    paths += [strip(u) if strip is not None else urlparse(u).path for u in urls[1:]]
     return (fs, paths if isinstance(url_or_urls, list) else paths[0])
 
 

@@ -154,12 +154,16 @@ class HdfsConnector(object):
         ``driver`` is accepted for reference API parity ('libhdfs'/'libhdfs3');
         both map to pyarrow's single maintained libhdfs binding underneath.
         ``storage_options`` (e.g. ``user``, ``kerb_ticket``) are forwarded to
-        the fsspec driver; an explicit ``user`` argument wins over the one in
-        ``storage_options``.
+        the fsspec driver.  The authority may carry userinfo
+        (``user@host:port``); precedence is explicit ``user`` argument >
+        URL userinfo > ``storage_options['user']``.
         """
-        host, _, port = url_authority.partition(':')
+        userinfo, at, hostport = url_authority.rpartition('@')
+        host, _, port = hostport.partition(':')
         import fsspec
         kwargs = dict(storage_options or {})
+        if at and userinfo:
+            kwargs['user'] = userinfo
         if user is not None:
             kwargs['user'] = user
         return fsspec.filesystem('hdfs', host=host or 'default',

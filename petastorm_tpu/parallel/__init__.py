@@ -13,3 +13,7 @@ from petastorm_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, data_parallel_sharding, global_batch_from_local,
     host_shard_info, sync_hosts,
 )
+from petastorm_tpu.parallel.ring_attention import (  # noqa: F401
+    full_attention, ring_attention, ulysses_attention,
+    make_ring_attention, make_ulysses_attention,
+)

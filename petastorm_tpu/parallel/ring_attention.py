@@ -1,0 +1,165 @@
+"""Sequence/context parallelism: ring attention and all-to-all (Ulysses).
+
+No reference equivalent — the reference's only sequence machinery is NGram
+host-side windowing (SURVEY.md §5.7); long-context *device-side* sharding is
+a first-class TPU obligation here.  Two strategies, both designed for the
+ICI torus:
+
+* ``ring_attention`` — the sequence axis is sharded over a mesh axis; each
+  device holds one contiguous Q/K/V block and K/V blocks rotate around the
+  ring via ``jax.lax.ppermute`` (one neighbour hop per step, so traffic rides
+  ICI links, never DCN).  Softmax is computed *online* (flash-attention
+  style running max / running sum), so the full [seq, seq] score matrix is
+  never materialised — memory is O(seq_local²) per step and the K/V rotation
+  overlaps with the block matmuls under XLA's async collective scheduler.
+
+* ``ulysses_attention`` — all-to-all head↔sequence re-sharding: each device
+  trades its sequence shard for a head shard (``jax.lax.all_to_all``), runs
+  dense local attention over the *full* sequence for its heads, and trades
+  back.  Two all-to-alls total; preferable when heads ≥ devices and the
+  per-device full-sequence score tile fits in HBM.
+
+Both are written to run inside ``jax.shard_map`` (see ``make_*`` wrappers)
+with Q/K/V laid out ``[batch, seq, heads, head_dim]``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30  # finite stand-in for -inf: keeps exp() exactly 0 without NaNs
+
+
+def full_attention(q, k, v, causal=False, scale=None):
+    """Dense single-device reference attention (test oracle, small shapes).
+
+    q, k, v: [batch, seq, heads, head_dim].
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    if causal:
+        q_pos = jnp.arange(q.shape[1])[:, None]
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', p, v)
+
+
+def _online_block(q, k, v, o, l, m, q_offset, kv_offset, causal, scale):
+    """Fold one K/V block into the running (o, l, m) accumulator.
+
+    o: [b, q, h, d] unnormalised output, l: [b, h, q] running softmax
+    denominator, m: [b, h, q] running max.  ``q_offset``/``kv_offset`` are
+    the blocks' global sequence positions (for the causal mask).
+    """
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
+        k_pos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp(NEG_INF - NEG_INF) would be 1 for fully-masked rows; gate to 0.
+    alpha = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_new))
+    p = jnp.where(m_new[..., None] == NEG_INF, 0.0, jnp.exp(s - m_new[..., None]))
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = (o * jnp.transpose(alpha, (0, 2, 1))[..., None]
+             + jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32))
+    return o_new, l_new, m_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Ring attention over a sharded sequence axis — call inside shard_map.
+
+    Arguments are the *local* blocks ``[batch, seq_local, heads, head_dim]``
+    of arrays whose sequence dim is sharded over mesh axis ``axis_name``.
+    Runs ``axis_size`` steps; step i computes Q·K_blockᵀ against the K/V
+    block that started ``i`` hops up-ring, then rotates K/V one hop down.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, q_len, h, d = q.shape
+    kv_len = k.shape[1]
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    o = jnp.zeros((b, q_len, h, d), jnp.float32)
+    l = jnp.zeros((b, h, q_len), jnp.float32)
+    m = jnp.full((b, h, q_len), NEG_INF, jnp.float32)
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        kv_idx = (my_idx - i) % axis_size  # origin of the block in hand
+        o, l, m = _online_block(q, k_blk, v_blk, o, l, m,
+                                q_offset=my_idx * q_len,
+                                kv_offset=kv_idx * kv_len,
+                                causal=causal, scale=scale)
+        # Rotate even on the last step (balanced cost; XLA overlaps it).
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, l, m, k_blk, v_blk
+
+    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, l, m, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows yield 0, not NaN
+    out = o / jnp.transpose(l, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      attn_fn=None):
+    """All-to-all sequence parallelism — call inside shard_map.
+
+    Local blocks ``[batch, seq_local, heads, head_dim]``; ``heads`` must be
+    divisible by the axis size.  Re-shards seq→heads, runs dense local
+    attention (or ``attn_fn``) over the full sequence, re-shards back.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % axis_size:
+        raise ValueError('heads=%d not divisible by axis size %d' % (h, axis_size))
+
+    def seq_to_heads(x):  # [b, s/n, h, d] -> [b, s, h/n, d]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):  # [b, s, h/n, d] -> [b, s/n, h, d]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    attn_fn = attn_fn or full_attention
+    out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+                  causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def _make_sp_fn(inner, mesh, seq_axis, batch_axis):
+    batch_spec = batch_axis if batch_axis in mesh.axis_names else None
+    spec = P(batch_spec, seq_axis, None, None)
+    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn, NamedSharding(mesh, spec)
+
+
+def make_ring_attention(mesh, seq_axis='seq', batch_axis='data',
+                        causal=False, scale=None):
+    """shard_map-wrapped ring attention over ``mesh``.
+
+    Returns ``(fn, sharding)``: ``fn(q, k, v)`` on global arrays
+    ``[batch, seq, heads, head_dim]`` with seq sharded over ``seq_axis``
+    (and batch over ``batch_axis`` when present in the mesh); ``sharding``
+    is the NamedSharding inputs should be placed with.
+    """
+    inner = functools.partial(ring_attention, axis_name=seq_axis,
+                              causal=causal, scale=scale)
+    return _make_sp_fn(inner, mesh, seq_axis, batch_axis)
+
+
+def make_ulysses_attention(mesh, seq_axis='seq', batch_axis='data',
+                           causal=False, scale=None, attn_fn=None):
+    """shard_map-wrapped all-to-all attention over ``mesh`` (see above)."""
+    inner = functools.partial(ulysses_attention, axis_name=seq_axis,
+                              causal=causal, scale=scale, attn_fn=attn_fn)
+    return _make_sp_fn(inner, mesh, seq_axis, batch_axis)

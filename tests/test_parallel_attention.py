@@ -1,0 +1,94 @@
+"""Ring / all-to-all (Ulysses) sequence parallelism vs dense attention.
+
+Runs on the 8-virtual-device CPU mesh (conftest) — the same shardings
+compile unchanged on a TPU pod slice.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from petastorm_tpu.parallel import make_mesh
+from petastorm_tpu.parallel.ring_attention import (
+    full_attention, make_ring_attention, make_ulysses_attention)
+
+B, S, H, D = 2, 64, 8, 16
+
+
+@pytest.fixture(scope='module')
+def qkv():
+    rng = np.random.default_rng(7)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _place(mesh, sharding, *arrays):
+    return [jax.device_put(a, sharding) for a in arrays]
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('mesh_axes', [{'seq': 8}, {'data': 2, 'seq': 4}])
+def test_ring_matches_dense(qkv, causal, mesh_axes):
+    mesh = make_mesh(mesh_axes)
+    fn, sharding = make_ring_attention(mesh, causal=causal)
+    q, k, v = _place(mesh, sharding, *qkv)
+    got = jax.jit(fn)(q, k, v)
+    want = full_attention(*qkv, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_ulysses_matches_dense(qkv, causal):
+    mesh = make_mesh({'seq': 8})
+    fn, sharding = make_ulysses_attention(mesh, causal=causal)
+    q, k, v = _place(mesh, sharding, *qkv)
+    got = jax.jit(fn)(q, k, v)
+    want = full_attention(*qkv, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match_dense(qkv):
+    mesh = make_mesh({'seq': 8})
+    fn, sharding = make_ring_attention(mesh, causal=True)
+    q, k, v = qkv
+
+    def loss_ring(q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(*_place(mesh, sharding, q, k, v))
+    want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads(qkv):
+    mesh = make_mesh({'seq': 8})
+    fn, sharding = make_ulysses_attention(mesh)
+    q, k, v = _place(mesh, sharding, *(x[:, :, :4] for x in qkv))  # 4 heads < 8 devices
+    with pytest.raises(ValueError, match='not divisible'):
+        jax.jit(fn)(q, k, v)
+
+
+def test_ring_long_sequence_memory_shape(qkv):
+    # 8× the sequence on the same mesh still only ever materialises
+    # [seq_local, seq_local] score tiles; assert output correctness on a
+    # longer-than-test default sequence as a smoke for the long-context path.
+    rng = np.random.default_rng(11)
+    s = 256
+    mk = lambda: jnp.asarray(rng.standard_normal((1, s, 4, 8)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    mesh = make_mesh({'seq': 8})
+    fn, sharding = make_ring_attention(mesh, causal=True)
+    got = jax.jit(fn)(*_place(mesh, sharding, q, k, v))
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
