@@ -22,6 +22,8 @@ _LAZY = {
     'TransformSpec': 'petastorm_tpu.transform',
     'Unischema': 'petastorm_tpu.unischema',
     'UnischemaField': 'petastorm_tpu.unischema',
+    'NoDataAvailableError': 'petastorm_tpu.errors',
+    'PoisonedRowGroupError': 'petastorm_tpu.errors',
 }
 
 __all__ = list(_LAZY)

@@ -11,10 +11,9 @@ from dataclasses import dataclass, field as dataclass_field
 
 import numpy as np
 import pyarrow as pa
-import pyarrow.parquet as pq
 
 from petastorm_tpu.cache import NullCache
-from petastorm_tpu.workers_pool.worker_base import WorkerBase
+from petastorm_tpu.reader_impl.parquet_worker_base import ParquetWorkerBase
 
 
 @dataclass
@@ -26,35 +25,21 @@ class BatchWorkerArgs:
     transform_spec: object = None
     predicate: object = None
     cache: object = dataclass_field(default_factory=NullCache)
+    #: Transient-I/O retries per row group before PoisonedRowGroupError
+    #: (SURVEY.md §5.3 build obligation; no reference equivalent).
+    read_retries: int = 2
+    retry_backoff_s: float = 0.1
 
 
-class ArrowReaderWorker(WorkerBase):
-    def __init__(self, worker_id, publish_func, args):
-        super(ArrowReaderWorker, self).__init__(worker_id, publish_func, args)
-        self._a = args
-        self._open_files = {}
-
-    def _parquet_file(self, path):
-        entry = self._open_files.get(path)
-        if entry is None:
-            handle = self._a.filesystem.open(path, 'rb')
-            entry = (handle, pq.ParquetFile(handle))
-            self._open_files[path] = entry
-        return entry[1]
-
-    def shutdown(self):
-        for handle, _ in self._open_files.values():
-            try:
-                handle.close()
-            except Exception:  # noqa: BLE001
-                pass
-        self._open_files.clear()
+class ArrowReaderWorker(ParquetWorkerBase):
 
     def process(self, piece_index, _row_drop_partition=0):
         piece = self._a.pieces[piece_index]
         cache_key = '%s:%d:batch:%s' % (piece.path, piece.row_group,
                                         ','.join(sorted(self._a.schema_view.fields)))
-        table = self._a.cache.get(cache_key, lambda: self._load_table(piece))
+        table = self._a.cache.get(
+            cache_key,
+            lambda: self._read_with_retry(piece, lambda: self._load_table(piece)))
         if table is not None and table.num_rows > 0:
             self.publish_func(table)
 

@@ -30,3 +30,26 @@ class DecodeFieldError(PetastormTpuError):
 
     Parity: ``petastorm/utils.py :: DecodeFieldError``.
     """
+
+
+class PoisonedRowGroupError(PetastormTpuError):
+    """A row group kept failing after ``read_retries`` retries with backoff.
+
+    No reference equivalent: the reference has no retry and a failed read
+    surfaces as a bare worker exception (SURVEY.md §5.3).  Carries the piece
+    identity so operators can quarantine or repair the exact row group.
+    """
+
+    def __init__(self, path, row_group, attempts, cause):
+        self.path = path
+        self.row_group = row_group
+        self.attempts = attempts
+        self.cause = str(cause)
+        super(PoisonedRowGroupError, self).__init__(
+            'Row group %d of %r still failing after %d attempt(s): %s'
+            % (row_group, path, attempts, self.cause))
+
+    def __reduce__(self):
+        # Default Exception reduction would replay __init__ with one arg
+        # (the message) and break ProcessPool error propagation.
+        return (type(self), (self.path, self.row_group, self.attempts, self.cause))

@@ -31,6 +31,7 @@ class FilesystemResolver(object):
         self._dataset_url = dataset_url
         parsed = urlparse(dataset_url)
         self._parsed = parsed
+        self._explicit_fs = filesystem is not None
         if filesystem is not None:
             self._filesystem = filesystem
             self._path = parsed.path if parsed.scheme else dataset_url
@@ -47,6 +48,19 @@ class FilesystemResolver(object):
 
     def get_dataset_path(self):
         return self._path
+
+    def path_for(self, url):
+        """Path for another URL on this same filesystem, via the same
+        extraction rule that produced :meth:`get_dataset_path` — mixing rules
+        across URLs of one list would yield inconsistent path forms."""
+        url = url[:-1] if url.endswith('/') else url
+        parsed = urlparse(url)
+        if self._explicit_fs:
+            return parsed.path if parsed.scheme else url
+        if parsed.scheme == 'hdfs':
+            return parsed.path
+        # Same normalization fsspec.get_fs_token_paths applies for the first URL.
+        return type(self._filesystem)._strip_protocol(url)
 
     def parsed_dataset_url(self):
         return self._parsed
@@ -100,9 +114,7 @@ def get_filesystem_and_path_or_paths(url_or_urls, storage_options=None, filesyst
     first = FilesystemResolver(urls[0], storage_options=storage_options, filesystem=filesystem,
                                hdfs_driver=hdfs_driver, user=user)
     fs = first.filesystem()
-    strip = getattr(type(fs), '_strip_protocol', None)
-    paths = [first.get_dataset_path()]
-    paths += [strip(u) if strip is not None else urlparse(u).path for u in urls[1:]]
+    paths = [first.get_dataset_path()] + [first.path_for(u) for u in urls[1:]]
     return (fs, paths if isinstance(url_or_urls, list) else paths[0])
 
 

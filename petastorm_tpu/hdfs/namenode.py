@@ -163,7 +163,9 @@ class HdfsConnector(object):
         import fsspec
         kwargs = dict(storage_options or {})
         if at and userinfo:
-            kwargs['user'] = userinfo
+            # userinfo may be 'user' or 'user:password'; only the user part is
+            # a username (passwords are not a thing libhdfs accepts anyway).
+            kwargs['user'] = userinfo.partition(':')[0]
         if user is not None:
             kwargs['user'] = user
         return fsspec.filesystem('hdfs', host=host or 'default',

@@ -13,11 +13,10 @@ which is what makes the ThreadPool the right default on TPU-VM hosts.
 from dataclasses import dataclass, field as dataclass_field
 
 import numpy as np
-import pyarrow.parquet as pq
 
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.errors import DecodeFieldError
-from petastorm_tpu.workers_pool.worker_base import WorkerBase
+from petastorm_tpu.reader_impl.parquet_worker_base import ParquetWorkerBase
 
 
 @dataclass
@@ -38,29 +37,13 @@ class RowWorkerArgs:
     #: per-row python work — the row-path analog of the reference's
     #: BatchedDataLoader speedup, pushed one stage earlier.
     columnar_output: bool = False
+    #: Transient-I/O retries per row group before PoisonedRowGroupError
+    #: (SURVEY.md §5.3 build obligation; no reference equivalent).
+    read_retries: int = 2
+    retry_backoff_s: float = 0.1
 
 
-class PyDictReaderWorker(WorkerBase):
-    def __init__(self, worker_id, publish_func, args):
-        super(PyDictReaderWorker, self).__init__(worker_id, publish_func, args)
-        self._a = args
-        self._open_files = {}  # path -> (file handle, ParquetFile)
-
-    def _parquet_file(self, path):
-        entry = self._open_files.get(path)
-        if entry is None:
-            handle = self._a.filesystem.open(path, 'rb')
-            entry = (handle, pq.ParquetFile(handle))
-            self._open_files[path] = entry
-        return entry[1]
-
-    def shutdown(self):
-        for handle, _ in self._open_files.values():
-            try:
-                handle.close()
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
-        self._open_files.clear()
+class PyDictReaderWorker(ParquetWorkerBase):
 
     # -- work item -----------------------------------------------------------
 
@@ -72,17 +55,23 @@ class PyDictReaderWorker(WorkerBase):
             if self._a.transform_spec is None or self._a.transform_spec.func is None:
                 # True columnar decode: no intermediate row dicts at all.
                 columns = self._a.cache.get(
-                    cache_key + ':c', lambda: self._load_columns(piece, row_drop_partition))
+                    cache_key + ':c',
+                    lambda: self._read_with_retry(
+                        piece, lambda: self._load_columns(piece, row_drop_partition)))
                 if columns is not None and len(next(iter(columns.values()), ())) > 0:
                     self.publish_func(columns)
                 return
-            rows = self._a.cache.get(cache_key,
-                                     lambda: self._load_rows(piece, row_drop_partition))
+            rows = self._a.cache.get(
+                cache_key,
+                lambda: self._read_with_retry(
+                    piece, lambda: self._load_rows(piece, row_drop_partition)))
             if rows:
                 self.publish_func(_stack_columnar(rows))
             return
-        rows = self._a.cache.get(cache_key,
-                                 lambda: self._load_rows(piece, row_drop_partition))
+        rows = self._a.cache.get(
+            cache_key,
+            lambda: self._read_with_retry(
+                piece, lambda: self._load_rows(piece, row_drop_partition)))
         if self._a.ngram is not None:
             rows = self._a.ngram.form_sequences(rows, self._a.schema_view)
         if rows:

@@ -92,7 +92,7 @@ def make_reader(dataset_url,
                 transform_spec=None, filters=None,
                 storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                 seed=None, resume_state=None, zmq_copy_buffers=True,
-                columnar_decode=False):
+                columnar_decode=False, read_retries=2, retry_backoff_s=0.1):
     """Reader over a petastorm-format dataset (codec-decoded rows).
 
     Parity: ``petastorm/reader.py :: make_reader`` (argument names kept,
@@ -124,7 +124,8 @@ def make_reader(dataset_url,
         cache_extra_settings=cache_extra_settings,
         transform_spec=transform_spec, filters=filters, seed=seed,
         resume_state=resume_state, zmq_copy_buffers=zmq_copy_buffers,
-        columnar_decode=columnar_decode)
+        columnar_decode=columnar_decode, read_retries=read_retries,
+        retry_backoff_s=retry_backoff_s)
 
 
 def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
@@ -134,7 +135,7 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
                         shard_count, cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings,
                         transform_spec, filters, seed, resume_state, zmq_copy_buffers,
-                        columnar_decode=False):
+                        columnar_decode=False, read_retries=2, retry_backoff_s=0.1):
     from petastorm_tpu.ngram import NGram
     from petastorm_tpu.py_dict_reader_worker import PyDictReaderWorker, RowWorkerArgs
 
@@ -179,7 +180,8 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
         filesystem=fs, pieces=pieces, schema=stored_schema, schema_view=schema_view,
         transform_spec=transform_spec, predicate=predicate, cache=cache, ngram=ngram,
         shuffle_row_drop_partitions=shuffle_row_drop_partitions,
-        columnar_output=columnar_decode)
+        columnar_output=columnar_decode, read_retries=read_retries,
+        retry_backoff_s=retry_backoff_s)
 
     # Work items: (piece_index, row_drop_partition).
     items = [(i, p) for i in range(len(pieces))
@@ -218,7 +220,8 @@ def make_batch_reader(dataset_url_or_urls,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None,
                       storage_options=None, filesystem=None, hdfs_driver='libhdfs',
-                      seed=None, resume_state=None, zmq_copy_buffers=True):
+                      seed=None, resume_state=None, zmq_copy_buffers=True,
+                      read_retries=2, retry_backoff_s=0.1):
     """Columnar reader over *any* Parquet store (no petastorm metadata needed).
 
     Parity: ``petastorm/reader.py :: make_batch_reader``.  Yields namedtuples
@@ -260,7 +263,9 @@ def make_batch_reader(dataset_url_or_urls,
                            cache_row_size_estimate, cache_extra_settings)
     worker_args = BatchWorkerArgs(filesystem=fs, pieces=pieces, schema=stored_schema,
                                   schema_view=schema_view, transform_spec=transform_spec,
-                                  predicate=predicate, cache=cache)
+                                  predicate=predicate, cache=cache,
+                                  read_retries=read_retries,
+                                  retry_backoff_s=retry_backoff_s)
     items = [(i, 0) for i in range(len(pieces))]
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size, zmq_copy_buffers)
     result_schema = transform_schema(schema_view, transform_spec) \

@@ -1,0 +1,89 @@
+"""Shared base for the two L2 decode workers: per-worker ParquetFile handle
+cache plus per-row-group retry with exponential backoff.
+
+The handle cache mirrors what both reference workers do implicitly through
+pyarrow dataset pieces (``petastorm/py_dict_reader_worker.py`` /
+``petastorm/arrow_reader_worker.py``).  The retry layer is a TPU-build
+addition (SURVEY.md §5.3 obligation): remote object stores (GCS) throw
+transient ``OSError``s that the reference would surface as a dead epoch; here
+the handle is evicted, the read retried with backoff, and only a row group
+that *keeps* failing is surfaced — by id — as ``PoisonedRowGroupError``.
+"""
+
+import logging
+import time
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import PoisonedRowGroupError
+from petastorm_tpu.workers_pool.worker_base import WorkerBase
+
+logger = logging.getLogger(__name__)
+
+#: Exceptions treated as transient I/O failures.  pyarrow raises OSError
+#: subclasses (ArrowIOError aliases OSError in modern pyarrow); fsspec remote
+#: filesystems additionally raise EOFError/TimeoutError on truncated bodies.
+TRANSIENT_IO_ERRORS = (OSError, EOFError, TimeoutError)
+
+#: OSError subclasses that are *permanent* conditions — retrying them only
+#: delays the inevitable and mislabels the failure.
+PERMANENT_IO_ERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                       NotADirectoryError)
+
+
+class ParquetWorkerBase(WorkerBase):
+    """File-handle caching + retry; subclasses implement the decode logic."""
+
+    def __init__(self, worker_id, publish_func, args):
+        super(ParquetWorkerBase, self).__init__(worker_id, publish_func, args)
+        self._a = args
+        self._open_files = {}  # path -> (file handle, ParquetFile)
+
+    def _parquet_file(self, path):
+        entry = self._open_files.get(path)
+        if entry is None:
+            handle = self._a.filesystem.open(path, 'rb')
+            entry = (handle, pq.ParquetFile(handle))
+            self._open_files[path] = entry
+        return entry[1]
+
+    def _evict_file(self, path):
+        """Drop a possibly-wedged cached handle so the next attempt reopens."""
+        entry = self._open_files.pop(path, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except Exception:  # noqa: BLE001 — handle may already be broken
+                pass
+
+    def shutdown(self):
+        for handle, _ in self._open_files.values():
+            try:
+                handle.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._open_files.clear()
+
+    def _read_with_retry(self, piece, read_fn):
+        """Run ``read_fn()`` (which may open + read ``piece``), retrying
+        transient I/O errors ``read_retries`` times with exponential backoff."""
+        retries = getattr(self._a, 'read_retries', 0)
+        backoff = getattr(self._a, 'retry_backoff_s', 0.1)
+        attempt = 0
+        while True:
+            try:
+                return read_fn()
+            except TRANSIENT_IO_ERRORS as e:
+                self._evict_file(piece.path)
+                if isinstance(e, PERMANENT_IO_ERRORS):
+                    raise
+                attempt += 1
+                if attempt > retries:
+                    raise PoisonedRowGroupError(piece.path, piece.row_group,
+                                                attempt, e) from e
+                delay = backoff * (2 ** (attempt - 1))
+                logger.warning(
+                    'Transient read failure on row group %d of %r '
+                    '(attempt %d/%d, retrying in %.2fs): %s',
+                    piece.row_group, piece.path, attempt, retries + 1, delay, e)
+                time.sleep(delay)
