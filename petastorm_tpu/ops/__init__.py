@@ -1,0 +1,8 @@
+"""TPU Pallas kernels for the hot compute ops.
+
+No reference equivalent: the reference is a data library with no first-party
+native compute (SURVEY.md §2.6); these kernels serve the framework's model
+zoo and the sequence-parallel attention plane (``petastorm_tpu.parallel``).
+"""
+
+from petastorm_tpu.ops.flash_attention import flash_attention  # noqa: F401

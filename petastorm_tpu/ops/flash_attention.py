@@ -1,0 +1,302 @@
+"""Pallas TPU flash attention (forward + backward), MXU-tiled.
+
+Block-wise online-softmax attention: the [seq, seq] score matrix is never
+materialised — each grid step holds one ``block_q × block_k`` tile in VMEM,
+folding it into running (max, denominator, output) accumulators in fp32
+while the matmuls feed the MXU in the input dtype.  The backward pass is
+the standard flash recomputation split into a dQ kernel (grid over Q
+blocks) and a dK/dV kernel (grid over K blocks), using the saved
+log-sum-exp instead of stored probabilities.
+
+Used standalone and as the ``attn_fn`` inside
+``petastorm_tpu.parallel.ulysses_attention`` (each device's local full-
+sequence attention after the all-to-all) — the composition that makes long
+context cheap: Ulysses moves the data, this kernel keeps HBM traffic at
+O(seq · head_dim).
+
+K and V live whole in VMEM per (batch·head) grid step, so the practical
+per-device sequence limit is ~8k at head_dim 128 fp32 (half the ~16 MB
+VMEM); shard longer sequences with ring/Ulysses first.
+
+No reference equivalent (the reference has no compute kernels at all,
+SURVEY.md §2.6).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # finite stand-in for -inf (exp() underflows to exactly 0)
+
+
+def _auto_interpret():
+    return jax.default_backend() != 'tpu'
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                seq_len, block_q, block_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    d = q.shape[-1]
+
+    num_kv = pl.cdiv(seq_len, block_k)
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing.
+        num_kv = jnp.minimum(num_kv, pl.cdiv((qi + 1) * block_q, block_k))
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        o, l, m = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_len  # padded keys never attend
+        if causal:
+            mask &= q_pos >= k_pos
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_new))
+        p = jnp.where(m_new[:, None] == NEG_INF, 0.0, jnp.exp(s - m_new[:, None]))
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return o_new, l_new, m_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    o, l, m = jax.lax.fori_loop(0, num_kv, body, (o0, l0, m0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
+    o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+    # lse rides as [bh, 1, seq]: a (1, 1, block_q) block keeps the last-two
+    # block dims Mosaic-legal (second-to-last equals the full array dim).
+    lse_ref[0, 0] = lse.astype(jnp.float32)
+
+
+def _fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k, interpret):
+    bh, seq_pad, d = q3.shape
+    grid = (bh, seq_pad // block_q)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          seq_len=seq_len, block_q=block_q, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_pad, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, causal, seq_len, block_q, block_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]       # [block_q]
+    delta = delta_ref[0, 0]   # [block_q]
+    d = q.shape[-1]
+
+    num_kv = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_kv = jnp.minimum(num_kv, pl.cdiv((qi + 1) * block_q, block_k))
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        # Padded query rows carry lse == NEG_INF; without the q_pos guard
+        # exp(s - NEG_INF) overflows to inf and poisons ds with NaNs.
+        mask = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            mask &= q_pos >= k_pos
+        # exp(s - lse) == softmax row (lse = m + log l); masked/empty rows
+        # have lse == NEG_INF and p underflows to 0.
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kv, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, seq_len, block_q, block_k):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+
+    num_q = pl.cdiv(seq_len, block_q)
+    q_start = (ki * block_k) // block_q if causal else 0
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        mask = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            mask &= q_pos >= k_pos
+        p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk = dk + jax.lax.dot_general(ds, q_blk, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(q_start, num_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, seq_len, block_q, block_k,
+         interpret):
+    bh, seq_pad, d = q3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]  # [bh, 1, seq] like lse
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          seq_len=seq_len, block_q=block_q, block_k=block_k),
+        grid=(bh, seq_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_pad, d), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          seq_len=seq_len, block_q=block_q, block_k=block_k),
+        grid=(bh, seq_pad // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_pad, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, seq_pad, d), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q3, k3, v3, scale, causal, seq_len, block_q, block_k):
+    out, _ = _flash_fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k):
+    out, lse = _fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k,
+                    interpret=_auto_interpret())
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_bwd(scale, causal, seq_len, block_q, block_k, res, g):
+    q3, k3, v3, out, lse = res
+    return _bwd(q3, k3, v3, out, lse, g, scale, causal, seq_len,
+                block_q, block_k, interpret=_auto_interpret())
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128):
+    """Flash attention over ``[batch, seq, heads, head_dim]`` inputs.
+
+    Drop-in for ``petastorm_tpu.parallel.full_attention`` (same signature and
+    semantics, O(seq) memory).  Differentiable via the flash backward
+    kernels.  Sequences are padded to the block size internally; padded keys
+    are masked out, padded query rows are sliced off.
+
+    Compiles to Mosaic on TPU; on CPU/GPU backends it runs the same kernels
+    through the Pallas interpreter (tests, dry runs).
+    """
+    if q.ndim != 4:
+        raise ValueError('expected [batch, seq, heads, head_dim], got %r' % (q.shape,))
+    b, seq_len, h, d = q.shape
+    kv_len = k.shape[1]
+    if kv_len != seq_len:
+        raise ValueError('flash_attention requires seq_q == seq_kv (got %d vs %d)'
+                         % (seq_len, kv_len))
+    scale = scale if scale is not None else d ** -0.5
+
+    import math
+    block_q = min(block_q, max(seq_len, 16))
+    block_k = min(block_k, max(seq_len, 16))
+    # Pad to the lcm so BOTH grids (seq_pad // block_q, seq_pad // block_k)
+    # cover the sequence exactly — padding to max() alone drops tail blocks
+    # whenever the smaller block doesn't divide the larger.
+    lcm = math.lcm(block_q, block_k)
+    seq_pad = -(-seq_len // lcm) * lcm
+
+    def to3(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, seq_len, d)
+        if seq_pad != seq_len:
+            x = jnp.pad(x, ((0, 0), (0, seq_pad - seq_len), (0, 0)))
+        return x
+
+    out = _flash(to3(q), to3(k), to3(v), scale, causal, seq_len, block_q, block_k)
+    out = out[:, :seq_len].reshape(b, h, seq_len, d)
+    return jnp.moveaxis(out, 1, 2)

@@ -1,0 +1,124 @@
+"""Pallas flash attention vs the dense oracle (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu.ops import flash_attention
+from petastorm_tpu.parallel import full_attention
+
+
+def _qkv(rng, b=2, s=64, h=2, d=16, dtype=np.float32):
+    shape = (b, s, h, d)
+    return tuple(jnp.asarray(rng.standard_normal(shape).astype(dtype))
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_matches_dense_oracle(rng, causal):
+    q, k, v = _qkv(rng)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize('seq', [24, 100])
+def test_padded_sequences(rng, seq):
+    """Sequence lengths that don't divide the block size are padded+masked."""
+    q, k, v = _qkv(rng, s=seq)
+    for causal in (False, True):
+        got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_bfloat16(rng):
+    q, k, v = _qkv(rng, dtype=np.float32)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    want = full_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), causal=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(got.astype(np.float32), want, atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_gradients_match_oracle(rng, causal):
+    q, k, v = _qkv(rng, b=1, s=48, h=2, d=8)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        return jnp.sum(out * jnp.cos(out))  # non-trivial cotangent
+
+    def loss_dense(q, k, v):
+        out = full_attention(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, 'qkv'):
+        np.testing.assert_allclose(g, w, atol=1e-4, rtol=1e-4,
+                                   err_msg='d%s mismatch' % name)
+
+
+def test_gradients_with_padding(rng):
+    q, k, v = _qkv(rng, b=1, s=40, h=1, d=8)  # 40 % 16 != 0
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    got = jax.grad(lambda *a: loss(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=16, block_k=16),
+        *a), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(lambda *a: loss(
+        lambda q, k, v: full_attention(q, k, v, causal=True), *a),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, atol=1e-4, rtol=1e-4)
+
+
+def test_as_ulysses_attn_fn(rng):
+    """flash_attention slots into Ulysses as the per-device local attention."""
+    from jax.sharding import Mesh
+    from petastorm_tpu.parallel import make_ulysses_attention
+
+    devices = jax.devices()[:4]
+    mesh = Mesh(np.array(devices).reshape(4), ('seq',))
+    q, k, v = _qkv(rng, b=1, s=64, h=4, d=8)
+    fn, sharding = make_ulysses_attention(
+        mesh, seq_axis='seq', batch_axis='data', causal=True,
+        attn_fn=lambda *a, **kw: flash_attention(*a, block_q=16, block_k=16, **kw))
+    got = jax.jit(fn)(jax.device_put(q, sharding), jax.device_put(k, sharding),
+                      jax.device_put(v, sharding))
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5, rtol=2e-5)
+
+
+def test_mismatched_block_sizes(rng):
+    """block_q != block_k with neither dividing the other: lcm padding must
+    keep every tail block covered (regression: max()-padding dropped rows)."""
+    q, k, v = _qkv(rng, b=1, s=48, h=1, d=8)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=48)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    got = flash_attention(q, k, v, causal=False, block_q=48, block_k=32)
+    np.testing.assert_allclose(got, full_attention(q, k, v), atol=2e-5, rtol=2e-5)
+
+
+def test_no_nans_in_raw_dq_with_padding(rng):
+    """Padded query rows must not produce NaN/inf in the dq kernel output
+    (jax_debug_nans aborts on them even if later sliced off)."""
+    q, k, v = _qkv(rng, b=1, s=40, h=1, d=8)
+    with jax.debug_nans(True):
+        g = jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=16, block_k=16) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_jit_and_vmap_compose(rng):
+    q, k, v = _qkv(rng, b=2, s=32, h=2, d=8)
+    jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16))
+    np.testing.assert_allclose(jitted(q, k, v),
+                               full_attention(q, k, v), atol=2e-5, rtol=2e-5)
