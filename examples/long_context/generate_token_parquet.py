@@ -1,0 +1,37 @@
+"""Write a synthetic long-context token dataset (documents as token arrays).
+
+Each row is one document: ``tokens`` is a fixed-length int32 sequence
+(Zipf-ish draws so the LM has learnable statistics), stored through
+NdarrayCodec — the pattern for any pre-tokenized corpus.
+"""
+
+import sys
+
+import numpy as np
+
+from petastorm_tpu.codecs import NdarrayCodec
+from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SEQ_LEN = 1024
+VOCAB = 4096
+NUM_DOCS = 256
+
+TokenSchema = Unischema('TokenSchema', [
+    UnischemaField('doc_id', np.int64, (), None, False),
+    UnischemaField('tokens', np.int32, (SEQ_LEN,), NdarrayCodec(), False),
+])
+
+
+def main(path='/tmp/lc_tokens'):
+    url = path if '://' in path else 'file://' + path
+    rng = np.random.default_rng(0)
+    with DatasetWriter(url, TokenSchema, rows_per_rowgroup=32) as writer:
+        for i in range(NUM_DOCS):
+            tokens = (rng.zipf(1.3, SEQ_LEN) % VOCAB).astype(np.int32)
+            writer.write({'doc_id': np.int64(i), 'tokens': tokens})
+    print('wrote %d docs of %d tokens to %s' % (NUM_DOCS, SEQ_LEN, url))
+
+
+if __name__ == '__main__':
+    main(*sys.argv[1:])

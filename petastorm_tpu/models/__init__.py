@@ -3,6 +3,7 @@
 * ``mlp`` — MNIST (config #1)
 * ``resnet`` — ResNet-50 for ImageNet-Parquet (config #3, the flagship)
 * ``dlrm`` — Criteo embedding tables (config #4)
+* ``transformer`` — long-context LM (sequence/tensor-parallel flagship)
 
 The reference ships no models (it is a data library); these exist so the
 loader can be proven against real pjit training loops, as its examples do
@@ -11,3 +12,5 @@ with TF/torch models.
 
 from petastorm_tpu.models.mlp import MLP  # noqa: F401
 from petastorm_tpu.models.resnet import ResNet50  # noqa: F401
+from petastorm_tpu.models.transformer import (  # noqa: F401
+    TransformerLM, param_shardings, make_attn_fn)

@@ -1,0 +1,181 @@
+"""Long-context Transformer LM — the sequence-parallel flagship.
+
+No reference equivalent (the reference ships no models; SURVEY.md §2.6 —
+its examples train third-party torch/TF models).  This model exists to
+prove the framework's long-context plane end to end: data from
+``petastorm_tpu.jax.DataLoader``, attention from
+``petastorm_tpu.ops.flash_attention`` (single device) or
+``petastorm_tpu.parallel.ring/ulysses`` (sequence-sharded), parameters
+sharded Megatron-style over a ``model`` mesh axis.
+
+TPU design notes:
+* All matmuls run in bfloat16 on the MXU (``dtype``); accumulation and the
+  softmax/norm stats stay fp32.
+* ``attn_fn`` is injected, not hard-coded: the module computes q/k/v
+  ``[batch, seq, heads, head_dim]`` and delegates — so one model definition
+  serves dense oracle, Pallas flash, ring (seq axis over ICI ring via
+  ppermute), and Ulysses (all-to-all) without touching the module.
+* ``param_shardings`` maps the param pytree onto a mesh: attention/MLP
+  input projections shard their *output* features over ``model``; output
+  projections shard their *input* features — the Megatron sandwich, which
+  leaves XLA exactly one all-reduce per block per direction.
+"""
+
+import functools
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.ops import flash_attention
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param('scale', nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale
+
+
+class Attention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attn_fn: Callable = flash_attention
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        if d_model % self.num_heads:
+            raise ValueError('d_model %d not divisible by %d heads'
+                             % (d_model, self.num_heads))
+        head_dim = d_model // self.num_heads
+        qkv = nn.DenseGeneral((3, self.num_heads, head_dim), axis=-1,
+                              dtype=self.dtype, name='qkv')(x)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)  # each [b, s, h, hd]
+        out = self.attn_fn(q, k, v, causal=True)
+        return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
+                               name='out')(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    attn_fn: Callable = flash_attention
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.num_heads, self.dtype, self.attn_fn,
+                          name='attn')(RMSNorm(name='ln1')(x))
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name='ffw_in')(RMSNorm(name='ln2')(x))
+        h = nn.gelu(h)
+        return x + nn.Dense(x.shape[-1], dtype=self.dtype, name='ffw_out')(h)
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: tokens [batch, seq] -> logits [batch, seq, vocab]."""
+
+    vocab_size: int
+    d_model: int = 512
+    num_heads: int = 8
+    num_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_fn: Callable = flash_attention
+    remat: bool = False  # jax.checkpoint each block: FLOPs for HBM
+
+    @nn.compact
+    def __call__(self, tokens):
+        embed = nn.Embed(self.vocab_size, self.d_model, name='embed',
+                         dtype=self.dtype)
+        x = embed(tokens)
+        pos = nn.Embed(self.max_seq_len, self.d_model, name='pos_embed',
+                       dtype=self.dtype)(jnp.arange(tokens.shape[1])[None, :])
+        x = x + pos
+        block = Block
+        if self.remat:
+            block = nn.remat(Block)
+        for i in range(self.num_layers):
+            x = block(self.num_heads, self.d_ff, self.dtype, self.attn_fn,
+                      name='block_%d' % i)(x)
+        x = RMSNorm(name='ln_f')(x)
+        # Tied output head: attend() reuses the (vocab-sharded) embedding.
+        return embed.attend(x.astype(self.dtype)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+
+#: (path-suffix match, PartitionSpec factory) — Megatron TP sandwich.
+def _spec_for(path, model_axis):
+    names = [p.key for p in path if hasattr(p, 'key')]
+    leaf = names[-1] if names else ''
+    parent = names[-2] if len(names) > 1 else ''
+    if parent in ('embed', 'pos_embed'):
+        return P(model_axis, None)             # vocab/position sharded
+    if parent == 'qkv':
+        # kernel [d_model, 3, heads, head_dim] — shard heads.
+        return P(None, None, model_axis, None) if leaf == 'kernel' \
+            else P(None, model_axis, None)     # bias [3, heads, head_dim]
+    if parent == 'out':
+        # kernel [heads, head_dim, d_model] — shard input heads.
+        return P(model_axis, None, None) if leaf == 'kernel' else P(None)
+    if parent == 'ffw_in':
+        return P(None, model_axis) if leaf == 'kernel' else P(model_axis)
+    if parent == 'ffw_out':
+        return P(model_axis, None) if leaf == 'kernel' else P(None)
+    return P()                                 # norms & everything else: replicated
+
+
+def param_shardings(params, mesh, model_axis='model'):
+    """NamedSharding pytree for ``TransformerLM`` params over ``mesh``.
+
+    Tensor parallelism the XLA way: annotate the parameters, let GSPMD
+    propagate through the matmuls and insert the block all-reduces —
+    never hand-written collectives (scaling-book recipe).
+    """
+    if model_axis not in mesh.axis_names:
+        return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), params)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(mesh, _spec_for(path, model_axis)), params)
+
+
+def make_attn_fn(mesh=None, strategy='flash', seq_axis='seq',
+                 batch_axis='data', head_axis='model'):
+    """Attention implementation for a (mesh, strategy) pair.
+
+    'flash'   — Pallas kernel, no sequence sharding (or inside Ulysses).
+    'ring'    — K/V rotate the ICI ring over ``seq_axis`` (longest contexts).
+    'ulysses' — all-to-all seq<->head reshard, flash locally.
+    'dense'   — O(seq²) oracle (tests only).
+    """
+    from petastorm_tpu.parallel import (full_attention, make_ring_attention,
+                                        make_ulysses_attention)
+    if strategy == 'flash':
+        return flash_attention
+    if strategy == 'dense':
+        return full_attention
+    if mesh is None:
+        raise ValueError('strategy %r needs a mesh' % (strategy,))
+    if strategy == 'ring':
+        fn, _ = make_ring_attention(mesh, seq_axis=seq_axis, batch_axis=batch_axis,
+                                    head_axis=head_axis, causal=True)
+    elif strategy == 'ulysses':
+        fn, _ = make_ulysses_attention(
+            mesh, seq_axis=seq_axis, batch_axis=batch_axis, head_axis=head_axis,
+            causal=True, attn_fn=flash_attention)
+    else:
+        raise ValueError('unknown attention strategy %r' % (strategy,))
+    return functools.partial(_drop_causal_kwarg, fn)
+
+
+def _drop_causal_kwarg(fn, q, k, v, causal=True):
+    # shard_map-wrapped fns already curried causal at construction time.
+    return fn(q, k, v)

@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-NEG_INF = -1e30  # finite stand-in for -inf (exp() underflows to exactly 0)
+# Shared with ring attention so masked-softmax semantics never diverge.
+from petastorm_tpu.parallel.ring_attention import NEG_INF
 
 
 def _auto_interpret():

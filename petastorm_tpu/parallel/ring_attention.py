@@ -135,31 +135,39 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     return heads_to_seq(out)
 
 
-def _make_sp_fn(inner, mesh, seq_axis, batch_axis):
+def _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis=None):
     batch_spec = batch_axis if batch_axis in mesh.axis_names else None
-    spec = P(batch_spec, seq_axis, None, None)
+    head_spec = head_axis if head_axis in mesh.axis_names else None
+    spec = P(batch_spec, seq_axis, head_spec, None)
     fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn, NamedSharding(mesh, spec)
 
 
 def make_ring_attention(mesh, seq_axis='seq', batch_axis='data',
-                        causal=False, scale=None):
+                        head_axis=None, causal=False, scale=None):
     """shard_map-wrapped ring attention over ``mesh``.
 
     Returns ``(fn, sharding)``: ``fn(q, k, v)`` on global arrays
     ``[batch, seq, heads, head_dim]`` with seq sharded over ``seq_axis``
-    (and batch over ``batch_axis`` when present in the mesh); ``sharding``
-    is the NamedSharding inputs should be placed with.
+    (and batch/heads over ``batch_axis``/``head_axis`` when present in the
+    mesh — heads are independent, so a tensor-parallel head shard composes
+    freely with the sequence ring); ``sharding`` is the NamedSharding
+    inputs should be placed with.
     """
     inner = functools.partial(ring_attention, axis_name=seq_axis,
                               causal=causal, scale=scale)
-    return _make_sp_fn(inner, mesh, seq_axis, batch_axis)
+    return _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis)
 
 
 def make_ulysses_attention(mesh, seq_axis='seq', batch_axis='data',
-                           causal=False, scale=None, attn_fn=None):
-    """shard_map-wrapped all-to-all attention over ``mesh`` (see above)."""
+                           head_axis=None, causal=False, scale=None,
+                           attn_fn=None):
+    """shard_map-wrapped all-to-all attention over ``mesh`` (see above).
+
+    With ``head_axis`` the *local* head count (heads / head_shards) must
+    still be divisible by the ``seq_axis`` size.
+    """
     inner = functools.partial(ulysses_attention, axis_name=seq_axis,
                               causal=causal, scale=scale, attn_fn=attn_fn)
-    return _make_sp_fn(inner, mesh, seq_axis, batch_axis)
+    return _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis)

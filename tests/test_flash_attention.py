@@ -122,3 +122,10 @@ def test_jit_and_vmap_compose(rng):
     jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16))
     np.testing.assert_allclose(jitted(q, k, v),
                                full_attention(q, k, v), atol=2e-5, rtol=2e-5)
+    # vmap over an extra leading axis: each inner call sees [b, s, h, d].
+    q5, k5, v5 = (jnp.stack([x, x * 0.5]) for x in (q, k, v))
+    batched = jax.vmap(lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16))
+    got = batched(q5, k5, v5)
+    np.testing.assert_allclose(got[0], full_attention(q, k, v), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(got[1], full_attention(q * 0.5, k * 0.5, v * 0.5),
+                               atol=2e-5, rtol=2e-5)
