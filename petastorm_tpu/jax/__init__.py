@@ -5,4 +5,5 @@ North star (BASELINE.json): ``petastorm.jax.DataLoader`` — double-buffered
 row-group sharding by ``jax.process_index()``.
 """
 
-from petastorm_tpu.jax.loader import DataLoader, make_jax_loader  # noqa: F401
+from petastorm_tpu.jax.loader import (DataLoader, InMemDataLoader,  # noqa: F401
+                                      make_jax_loader)

@@ -22,6 +22,7 @@ execution model instead of translated from them:
 """
 
 import logging
+import time
 import warnings
 from collections import deque
 
@@ -72,15 +73,37 @@ class DataLoader(object):
         self._seed = seed
         self._warned_fields = set()
         self._batched_input = getattr(reader, 'batched_output', False)
+        #: Per-stage wall time (SURVEY.md §5.1 obligation): 'host_batch_s'
+        #: covers waiting on the decode plane + collate, 'transform_s' the
+        #: user hook, 'device_put_s' the H2D *dispatch* (the DMA itself is
+        #: async and overlaps).  Pair with StallMonitor for the consumer
+        #: view and reader.diagnostics['decode_utilization'] for the
+        #: worker-pool view (thread/dummy pools; the ZeroMQ process pool
+        #: decodes out-of-process and does not report it).
+        self.stats = {'host_batch_s': 0.0, 'transform_s': 0.0,
+                      'device_put_s': 0.0, 'batches': 0}
 
     # -- iteration -----------------------------------------------------------
 
     def __iter__(self):
         pending = deque()
-        for host_batch in self._host_batches():
+        batches = self._host_batches()
+        while True:
+            t0 = time.monotonic()
+            try:
+                host_batch = next(batches)
+            except StopIteration:
+                break
+            t1 = time.monotonic()
             if self._transform_fn is not None:
                 host_batch = self._transform_fn(host_batch)
+            t2 = time.monotonic()
             pending.append(self._to_device(host_batch))
+            t3 = time.monotonic()
+            self.stats['host_batch_s'] += t1 - t0
+            self.stats['transform_s'] += t2 - t1
+            self.stats['device_put_s'] += t3 - t2
+            self.stats['batches'] += 1
             if len(pending) > self._prefetch:
                 yield pending.popleft()
         while pending:
@@ -287,6 +310,53 @@ def _strip_none_leaves(obj):
         out = {k: _strip_none_leaves(v) for k, v in obj.items()}
         return {k: v for k, v in out.items() if v is not None}
     return obj
+
+
+class InMemDataLoader(DataLoader):
+    """Epoch-cached loader: reads the dataset once, then serves ``num_epochs``
+    (re)shuffled epochs straight from host RAM — no Parquet re-read, no
+    decode-plane work after epoch 0.
+
+    Parity: ``petastorm/pytorch.py :: InMemBatchedDataLoader``.  The right
+    tool when the (decoded) dataset fits in host memory and epochs are short
+    — e.g. MNIST-scale fine-tuning where reader startup would dominate.
+    Construct the underlying reader with ``num_epochs=1``; epoch repetition
+    happens here.
+    """
+
+    def __init__(self, reader, batch_size, num_epochs=1, shuffle=True,
+                 seed=None, **kwargs):
+        if getattr(reader, 'ngram', None) is not None:
+            raise ValueError('InMemDataLoader does not support NGram readers')
+        super(InMemDataLoader, self).__init__(reader, batch_size, seed=seed, **kwargs)
+        self._num_epochs = num_epochs
+        self._shuffle = shuffle
+        self._cache = None
+
+    def _host_batches(self):
+        if self._cache is None:
+            # The cache must hold EVERY row: drop_last applies per epoch (the
+            # per-epoch loop below), not to the one-time read — otherwise a
+            # ragged tail would be excluded from all epochs permanently.
+            drop_last, self._drop_last = self._drop_last, False
+            try:
+                parts = list(super(InMemDataLoader, self)._host_batches())
+            finally:
+                self._drop_last = drop_last
+            if not parts:
+                return
+            self._cache = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs), *parts)
+        n = len(next(iter(jax.tree_util.tree_leaves(self._cache))))
+        rng = np.random.default_rng(self._seed)
+        epoch = 0
+        while self._num_epochs is None or epoch < self._num_epochs:
+            order = rng.permutation(n) if self._shuffle else np.arange(n)
+            stop = n - self.batch_size + 1 if self._drop_last else n
+            for start in range(0, max(stop, 0), self.batch_size):
+                idx = order[start:start + self.batch_size]
+                yield jax.tree_util.tree_map(lambda v: v[idx], self._cache)
+            epoch += 1
 
 
 def make_jax_loader(dataset_url, batch_size, batched=True, loader_kwargs=None, **reader_kwargs):

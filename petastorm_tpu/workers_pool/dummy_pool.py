@@ -5,6 +5,7 @@ work items execute lazily inside ``get_results``, one at a time, in
 ventilation order.
 """
 
+import time
 from collections import deque
 
 from petastorm_tpu.workers_pool import EmptyResultError, VentilatedItem
@@ -20,10 +21,14 @@ class DummyPool(object):
         self._ventilator = None
         self._stopped = False
         self.items_processed = 0
+        self.busy_time = 0.0
+        self._started_at = None
+        self._stopped_at = None
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         self._worker = worker_class(0, self._results.append, worker_setup_args)
         self._ventilator = ventilator
+        self._started_at = time.monotonic()
         if ventilator is not None:
             ventilator.start()
 
@@ -37,13 +42,14 @@ class DummyPool(object):
                 position = None
                 if len(args) == 1 and isinstance(args[0], VentilatedItem):
                     position, args = args[0].position, tuple(args[0].args)
+                started = time.monotonic()
                 self._worker.process(*args, **kwargs)
+                self.busy_time += time.monotonic() - started
                 self.items_processed += 1
                 if self._ventilator is not None:
                     self._ventilator.processed_item(position)
             elif self._ventilator is not None and not self._ventilator.completed():
                 # Ventilator thread may still be filling us; spin briefly.
-                import time
                 time.sleep(0.001)
             else:
                 raise EmptyResultError()
@@ -51,6 +57,8 @@ class DummyPool(object):
 
     def stop(self):
         self._stopped = True
+        if self._stopped_at is None:
+            self._stopped_at = time.monotonic()
         if self._ventilator is not None:
             self._ventilator.stop()
         if self._worker is not None:
@@ -62,5 +70,9 @@ class DummyPool(object):
 
     @property
     def diagnostics(self):
+        end = self._stopped_at if self._stopped_at is not None else time.monotonic()
+        wall = (end - self._started_at) if self._started_at else 0.0
         return {'pool': 'dummy', 'items_processed': self.items_processed,
-                'pending': len(self._pending), 'results_ready': len(self._results)}
+                'pending': len(self._pending), 'results_ready': len(self._results),
+                'decode_busy_s': round(self.busy_time, 4),
+                'decode_utilization': round(self.busy_time / wall, 4) if wall else 0.0}

@@ -12,6 +12,7 @@ this is the recommended pool on TPU-VM hosts (see SURVEY.md §7 stage 9).
 import queue
 import sys
 import threading
+import time
 
 from petastorm_tpu.workers_pool import (DEFAULT_TIMEOUT_S, EmptyResultError,
                                         TimeoutWaitingForResultError, VentilatedItem)
@@ -40,10 +41,14 @@ class ThreadPool(object):
         self._inflight_lock = threading.Lock()
         self._inflight = 0  # ventilated but result-not-yet-consumed items
         self.items_processed = 0
+        self.busy_time = 0.0  # summed seconds inside worker.process (all threads)
+        self._started_at = None
+        self._stopped_at = None
         self._profiler = profiler
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         self._ventilator = ventilator
+        self._started_at = time.monotonic()
         for worker_id in range(self.workers_count):
             worker = worker_class(worker_id, self._publish, worker_setup_args)
             self._workers.append(worker)
@@ -81,15 +86,18 @@ class ThreadPool(object):
             position = None
             if len(args) == 1 and isinstance(args[0], VentilatedItem):
                 position, args = args[0].position, tuple(args[0].args)
+            started = time.monotonic()
             try:
                 worker.process(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 — travels to the caller
                 import traceback
                 self._results_queue.put(_WorkerError(e, traceback.format_exc()))
             finally:
+                elapsed = time.monotonic() - started
                 with self._inflight_lock:
                     self._inflight -= 1
                     self.items_processed += 1
+                    self.busy_time += elapsed
                 if self._ventilator is not None:
                     self._ventilator.processed_item(position)
 
@@ -124,6 +132,8 @@ class ThreadPool(object):
         return inflight == 0 and self._input_queue.empty() and self._results_queue.empty()
 
     def stop(self):
+        if self._stopped_at is None:
+            self._stopped_at = time.monotonic()
         if self._ventilator is not None:
             self._ventilator.stop()
         self._stop_event.set()
@@ -142,6 +152,10 @@ class ThreadPool(object):
 
     @property
     def diagnostics(self):
+        # Wall clock ends at stop(): reading diagnostics long after teardown
+        # must not decay utilization toward zero.
+        end = self._stopped_at if self._stopped_at is not None else time.monotonic()
+        wall = (end - self._started_at) if self._started_at else 0.0
         return {
             'pool': 'thread',
             'workers_count': self.workers_count,
@@ -149,4 +163,10 @@ class ThreadPool(object):
             'inflight': self._inflight,
             'input_qsize': self._input_queue.qsize(),
             'results_qsize': self._results_queue.qsize(),
+            'decode_busy_s': round(self.busy_time, 4),
+            # Fraction of total worker-thread time spent decoding: ~1.0 means
+            # the decode plane is the bottleneck (add workers/hosts); low
+            # values mean workers starve on I/O or the consumer backpressures.
+            'decode_utilization': round(
+                self.busy_time / (wall * self.workers_count), 4) if wall else 0.0,
         }
