@@ -11,7 +11,7 @@ our own sockets.
 
 from petastorm_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, data_parallel_sharding, global_batch_from_local,
-    host_shard_info, sync_hosts,
+    host_shard_info, sync_hosts, min_over_hosts, epoch_steps,
 )
 from petastorm_tpu.parallel.ring_attention import (  # noqa: F401
     full_attention, ring_attention, ulysses_attention,

@@ -64,3 +64,40 @@ def sync_hosts(tag='petastorm_tpu'):
     """
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(tag)
+
+
+def min_over_hosts(value):
+    """min(value) across all hosts (identity single-host) — rides an
+    all-gather over ICI/DCN, never our own sockets."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    return int(np.min(gathered))
+
+
+def epoch_steps(reader, batch_size, drop_last=True):
+    """Per-host steps ALL hosts can take this epoch without hanging a pjit
+    loop — the classic uneven-shard pitfall (SURVEY.md §7 risks): row groups
+    shard round-robin, so hosts can hold different row counts, and a host
+    that runs out of batches deadlocks every collective.
+
+    Cap the loop with ``itertools.islice(loader, epoch_steps(reader, B))``.
+    Counts are pre-predicate: with ``predicate=``/``shuffle_row_drop_
+    partitions``/NGram windows the true yield is data-dependent — set the
+    step budget yourself in those cases (NGram raises here).
+
+    ``drop_last=False`` is single-host only: the final ragged batch would
+    have different shapes on different hosts, breaking global-batch
+    assembly — exactly the failure this function guards against.
+    """
+    if getattr(reader, 'ngram', None) is not None:
+        raise ValueError('epoch_steps cannot bound an NGram reader: window '
+                         'counts are data-dependent; set the step budget '
+                         'explicitly')
+    if not drop_last and jax.process_count() > 1:
+        raise ValueError('drop_last=False is unsafe multi-host: the ragged '
+                         'final batch differs across hosts')
+    local = reader.num_local_rows()
+    steps = local // batch_size if drop_last else -(-local // batch_size)
+    return min_over_hosts(steps)

@@ -339,6 +339,40 @@ class Reader(object):
         flight at snapshot time are re-read on resume)."""
         return self._ventilator.state_dict()
 
+    # -- introspection -------------------------------------------------------
+
+    def num_local_rows(self):
+        """Row count of this shard — an upper bound under ``predicate=`` /
+        ``shuffle_row_drop_partitions`` / NGram windowing (all data-
+        dependent).  Piece counts come from the footer scan when available;
+        fast-metadata pieces lazily open their file footers here (threaded,
+        memoized — the piece list is immutable).  Feeds
+        ``parallel.epoch_steps`` — the uneven-shard guard for pjit loops."""
+        if getattr(self, '_num_local_rows', None) is not None:
+            return self._num_local_rows
+        import pyarrow.parquet as pq
+        from concurrent.futures import ThreadPoolExecutor
+        total = 0
+        unknown = {}
+        for piece in self._worker_args.pieces:
+            if piece.num_rows >= 0:
+                total += piece.num_rows
+            else:
+                unknown.setdefault(piece.path, []).append(piece.row_group)
+        fs = self._worker_args.filesystem
+
+        def scan(item):
+            path, row_groups = item
+            with fs.open(path, 'rb') as handle:
+                md = pq.ParquetFile(handle).metadata
+                return sum(md.row_group(i).num_rows for i in row_groups)
+
+        if unknown:
+            with ThreadPoolExecutor(max_workers=min(16, len(unknown))) as pool:
+                total += sum(pool.map(scan, unknown.items()))
+        self._num_local_rows = total
+        return total
+
     # -- iteration -----------------------------------------------------------
 
     def __iter__(self):
