@@ -36,6 +36,9 @@ logger = logging.getLogger(__name__)
 # for on-disk compatibility.
 UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'
 ROW_GROUPS_PER_FILE_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
+#: Our extension (not in the reference): per-file list of per-row-group ROW
+#: counts, so epoch sizing never has to re-open file footers.
+ROW_GROUP_ROW_COUNTS_KEY = b'petastorm-tpu.rowgroup_row_counts.v1'
 
 _COMMON_METADATA = '_common_metadata'
 
@@ -256,16 +259,39 @@ def _collect_rowgroup_counts(fs, path, files=None):
 
     def count(f):
         with fs.open(f, 'rb') as handle:
-            return posixpath.relpath(f, path), pq.ParquetFile(handle).metadata.num_row_groups
+            md = pq.ParquetFile(handle).metadata
+            return (posixpath.relpath(f, path), md.num_row_groups,
+                    [md.row_group(i).num_rows for i in range(md.num_row_groups)])
 
     with ThreadPoolExecutor(max_workers=min(16, max(1, len(files)))) as pool:
-        return dict(pool.map(count, files))
+        scanned = list(pool.map(count, files))
+    return ({rel: n for rel, n, _ in scanned},
+            {rel: rows for rel, _, rows in scanned})
+
+
+def read_row_group_num_rows(fs, file_row_groups):
+    """Total rows of ``{path: [row_group_index, ...]}`` via a threaded footer
+    scan — the shared slow path behind ``Reader.num_local_rows`` (fast path:
+    counts stamped in the footer at materialize time)."""
+
+    def scan(item):
+        path, row_groups = item
+        with fs.open(path, 'rb') as handle:
+            md = pq.ParquetFile(handle).metadata
+            return sum(md.row_group(i).num_rows for i in row_groups)
+
+    if not file_row_groups:
+        return 0
+    with ThreadPoolExecutor(max_workers=min(16, len(file_row_groups))) as pool:
+        return sum(pool.map(scan, file_row_groups.items()))
 
 
 def _write_common_metadata(fs, path, schema):
     """Write ``_common_metadata`` carrying the pickled Unischema and the
-    per-file row-group count map (reference-compatible footer keys)."""
-    counts = _collect_rowgroup_counts(fs, path)
+    per-file row-group count map (reference-compatible footer keys), plus the
+    per-row-group ROW counts under our own key so readers never re-open
+    footers just to size an epoch."""
+    counts, row_counts = _collect_rowgroup_counts(fs, path)
     files = _list_parquet_files(fs, path)
     if files:
         with fs.open(files[0], 'rb') as handle:
@@ -275,6 +301,7 @@ def _write_common_metadata(fs, path, schema):
     metadata = dict(arrow_schema.metadata or {})
     metadata[UNISCHEMA_KEY] = pickle.dumps(schema, protocol=4)
     metadata[ROW_GROUPS_PER_FILE_KEY] = json.dumps(counts).encode('utf-8')
+    metadata[ROW_GROUP_ROW_COUNTS_KEY] = json.dumps(row_counts).encode('utf-8')
     annotated = arrow_schema.with_metadata(metadata)
     with fs.open(posixpath.join(path, _COMMON_METADATA), 'wb') as out:
         pq.write_metadata(annotated, out)
@@ -349,12 +376,15 @@ def load_row_groups(fs, path, fast_from_metadata=True):
     if not files:
         raise MetadataError('No parquet files found under %r' % (path,))
 
-    counts = None
+    counts = row_counts = None
     if fast_from_metadata:
         arrow_schema = _read_common_metadata(fs, path)
         if arrow_schema is not None and arrow_schema.metadata \
                 and ROW_GROUPS_PER_FILE_KEY in arrow_schema.metadata:
             counts = json.loads(arrow_schema.metadata[ROW_GROUPS_PER_FILE_KEY].decode('utf-8'))
+            if ROW_GROUP_ROW_COUNTS_KEY in arrow_schema.metadata:
+                row_counts = json.loads(
+                    arrow_schema.metadata[ROW_GROUP_ROW_COUNTS_KEY].decode('utf-8'))
 
     pieces = []
     if counts is not None:
@@ -365,7 +395,11 @@ def load_row_groups(fs, path, fast_from_metadata=True):
                 logger.warning('File %r in footer metadata is missing on disk; skipping', rel)
                 continue
             parts = _partition_values_for(full, path)
-            pieces.extend(RowGroupPiece(full, i, -1, parts) for i in range(int(n)))
+            per_rg = (row_counts or {}).get(rel)
+            per_rg = per_rg if per_rg is not None and len(per_rg) == int(n) else None
+            pieces.extend(
+                RowGroupPiece(full, i, per_rg[i] if per_rg else -1, parts)
+                for i in range(int(n)))
         return pieces
 
     lock = threading.Lock()

@@ -83,9 +83,11 @@ def epoch_steps(reader, batch_size, drop_last=True):
     that runs out of batches deadlocks every collective.
 
     Cap the loop with ``itertools.islice(loader, epoch_steps(reader, B))``.
-    Counts are pre-predicate: with ``predicate=``/``shuffle_row_drop_
-    partitions``/NGram windows the true yield is data-dependent — set the
-    step budget yourself in those cases (NGram raises here).
+    ``predicate=`` and NGram readers raise: their yields are data-dependent,
+    so a metadata-derived budget would overshoot and hang a host — set the
+    step budget explicitly for those.  (``shuffle_row_drop_partitions`` is
+    fine: every row is still delivered exactly once per epoch, spread over
+    the N visits.)
 
     ``drop_last=False`` is single-host only: the final ragged batch would
     have different shapes on different hosts, breaking global-batch
@@ -95,6 +97,10 @@ def epoch_steps(reader, batch_size, drop_last=True):
         raise ValueError('epoch_steps cannot bound an NGram reader: window '
                          'counts are data-dependent; set the step budget '
                          'explicitly')
+    if getattr(reader, 'predicate', None) is not None:
+        raise ValueError('epoch_steps cannot bound a predicate= reader: the '
+                         'filtered yield is data-dependent; set the step '
+                         'budget explicitly')
     if not drop_last and jax.process_count() > 1:
         raise ValueError('drop_last=False is unsafe multi-host: the ragged '
                          'final batch differs across hosts')

@@ -350,8 +350,7 @@ class Reader(object):
         ``parallel.epoch_steps`` — the uneven-shard guard for pjit loops."""
         if getattr(self, '_num_local_rows', None) is not None:
             return self._num_local_rows
-        import pyarrow.parquet as pq
-        from concurrent.futures import ThreadPoolExecutor
+        from petastorm_tpu.etl.dataset_metadata import read_row_group_num_rows
         total = 0
         unknown = {}
         for piece in self._worker_args.pieces:
@@ -359,19 +358,14 @@ class Reader(object):
                 total += piece.num_rows
             else:
                 unknown.setdefault(piece.path, []).append(piece.row_group)
-        fs = self._worker_args.filesystem
-
-        def scan(item):
-            path, row_groups = item
-            with fs.open(path, 'rb') as handle:
-                md = pq.ParquetFile(handle).metadata
-                return sum(md.row_group(i).num_rows for i in row_groups)
-
-        if unknown:
-            with ThreadPoolExecutor(max_workers=min(16, len(unknown))) as pool:
-                total += sum(pool.map(scan, unknown.items()))
+        total += read_row_group_num_rows(self._worker_args.filesystem, unknown)
         self._num_local_rows = total
         return total
+
+    @property
+    def predicate(self):
+        """The worker-side row predicate, if any (data-dependent yield)."""
+        return getattr(self._worker_args, 'predicate', None)
 
     # -- iteration -----------------------------------------------------------
 

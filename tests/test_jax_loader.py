@@ -277,3 +277,56 @@ def test_min_over_hosts_multihost(monkeypatch):
                         lambda x: np.array([7, 3, 5]))
     monkeypatch.setattr(mesh_mod.jax, 'process_count', lambda: 3)
     assert mesh_mod.min_over_hosts(7) == 3
+
+
+def test_epoch_steps_rejects_data_dependent_readers(dataset):
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.parallel import epoch_steps
+    from petastorm_tpu.predicates import in_lambda
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     predicate=in_lambda(['id'], lambda id: id % 2 == 0)) as r:
+        with pytest.raises(ValueError, match='predicate'):
+            epoch_steps(r, 10)
+
+
+def test_num_local_rows_from_footer_without_reopening_files(dataset):
+    """Row counts are stamped in the footer at write time; sizing an epoch
+    must not re-open data-file footers."""
+    import fsspec
+
+    class CountingFS:
+        def __init__(self, real):
+            self.real = real
+            self.opened = []
+
+        def open(self, path, *a, **kw):
+            self.opened.append(path)
+            return self.real.open(path, *a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self.real, name)
+
+    fs = CountingFS(fsspec.filesystem('file'))
+    with make_reader(dataset.url, reader_pool_type='dummy', filesystem=fs) as r:
+        fs.opened.clear()
+        assert r.num_local_rows() == 64
+    assert fs.opened == []  # footer metadata satisfied the count
+
+
+def test_num_local_rows_falls_back_to_scan_for_old_datasets(tmp_path):
+    """Datasets written before ROW_GROUP_ROW_COUNTS_KEY existed (or by the
+    reference) lazily scan footers instead."""
+    import pyarrow.parquet as pq
+    from petastorm_tpu.etl import dataset_metadata as dm
+
+    ds = create_test_dataset('file://' + str(tmp_path / 'old'), num_rows=30,
+                             rows_per_rowgroup=6)
+    meta_path = ds.path + '/_common_metadata'
+    schema = pq.read_schema(meta_path)
+    md = {k: v for k, v in schema.metadata.items()
+          if k != dm.ROW_GROUP_ROW_COUNTS_KEY}
+    pq.write_metadata(schema.with_metadata(md), meta_path)
+
+    with make_reader(ds.url, reader_pool_type='dummy') as r:
+        assert r.num_local_rows() == 30
+        assert r.num_local_rows() == 30  # memoized second call

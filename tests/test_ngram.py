@@ -1,0 +1,106 @@
+"""Dedicated NGram semantics tests (BASELINE config #5).
+
+Covers window assembly (sorting, sliding, projection), delta_threshold gap
+rejection, timestamp_overlap stride, regex field resolution, negative/sparse
+offsets, and the end-to-end reader path incl. the within-row-group
+limitation the reference documents.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import NdarrayCodec
+from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SensorSchema = Unischema('SensorSchema', [
+    UnischemaField('ts', np.int64, (), None, False),
+    UnischemaField('lidar', np.float32, (4,), NdarrayCodec(), False),
+    UnischemaField('speed', np.float64, (), None, False),
+])
+
+
+def _rows(timestamps):
+    return [{'ts': np.int64(t),
+             'lidar': np.full(4, t, np.float32),
+             'speed': float(t) * 0.1}
+            for t in timestamps]
+
+
+def _ngram(fields=None, delta=1, overlap=True):
+    fields = fields or {0: ['ts', 'lidar'], 1: ['ts', 'speed']}
+    ng = NGram(fields=fields, delta_threshold=delta, timestamp_field='ts',
+               timestamp_overlap=overlap)
+    ng.resolve_regex_field_names(SensorSchema)
+    return ng
+
+
+def test_sliding_windows_and_projection():
+    ng = _ngram()
+    windows = ng.form_sequences(_rows([3, 1, 2, 4]), SensorSchema)  # unsorted input
+    assert len(windows) == 3  # (1,2) (2,3) (3,4)
+    first = windows[0]
+    assert set(first) == {0, 1}
+    assert set(first[0]) == {'ts', 'lidar'}   # offset-0 projection
+    assert set(first[1]) == {'ts', 'speed'}   # offset-1 projection
+    assert [w[0]['ts'] for w in windows] == [1, 2, 3]
+    assert [w[1]['ts'] for w in windows] == [2, 3, 4]
+
+
+def test_delta_threshold_rejects_gappy_windows():
+    ng = _ngram(delta=1)
+    # Gap between 2 and 10 exceeds threshold: only (1,2) and (10,11) remain.
+    windows = ng.form_sequences(_rows([1, 2, 10, 11]), SensorSchema)
+    assert [(w[0]['ts'], w[1]['ts']) for w in windows] == [(1, 2), (10, 11)]
+
+    assert len(_ngram(delta=None).form_sequences(_rows([1, 2, 10, 11]),
+                                                 SensorSchema)) == 3
+
+
+def test_timestamp_overlap_false_is_disjoint():
+    ng = _ngram(overlap=False)
+    windows = ng.form_sequences(_rows([1, 2, 3, 4, 5]), SensorSchema)
+    assert [(w[0]['ts'], w[1]['ts']) for w in windows] == [(1, 2), (3, 4)]
+
+
+def test_sparse_and_negative_offsets():
+    ng = _ngram(fields={-1: ['lidar'], 1: ['speed']}, delta=2)
+    windows = ng.form_sequences(_rows([1, 2, 3]), SensorSchema)
+    assert len(windows) == 1  # window length 3 over 3 rows
+    assert set(windows[0]) == {-1, 1}
+    np.testing.assert_array_equal(windows[0][-1]['lidar'], np.full(4, 1, np.float32))
+    assert windows[0][1]['speed'] == pytest.approx(0.3)
+
+
+def test_regex_field_resolution_and_errors():
+    ng = NGram(fields={0: ['li.*'], 1: ['speed']}, delta_threshold=1,
+               timestamp_field='ts')
+    ng.resolve_regex_field_names(SensorSchema)
+    assert ng.get_field_names_at_timestep(0) == ['lidar']
+
+    bad = NGram(fields={0: ['nomatch.*']}, delta_threshold=1, timestamp_field='ts')
+    with pytest.raises(ValueError, match='matches nothing'):
+        bad.resolve_regex_field_names(SensorSchema)
+    with pytest.raises(ValueError, match='integers'):
+        NGram(fields={'a': ['x']}, delta_threshold=1, timestamp_field='ts')
+
+
+def test_end_to_end_reader_windows_stay_within_row_groups(tmp_path):
+    """Windows never span row-group boundaries (documented limitation)."""
+    url = 'file://' + str(tmp_path / 'sensor')
+    with DatasetWriter(url, SensorSchema, rows_per_rowgroup=5) as w:
+        w.write_many(_rows(range(10)))  # row groups: ts 0-4 and 5-9
+
+    ng = NGram(fields={0: ['ts', 'lidar'], 1: ['ts', 'speed']},
+               delta_threshold=1, timestamp_field='ts')
+    with make_reader(url, schema_fields=ng, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        windows = list(reader)
+    starts = sorted(int(w[0].ts) for w in windows)
+    # 4 windows per row group; the (4,5) boundary window must be absent.
+    assert starts == [0, 1, 2, 3, 5, 6, 7, 8]
+    one = next(w for w in windows if int(w[0].ts) == 2)
+    np.testing.assert_array_equal(np.asarray(one[0].lidar), np.full(4, 2, np.float32))
+    assert float(one[1].speed) == pytest.approx(0.3)

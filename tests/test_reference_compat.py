@@ -1,0 +1,61 @@
+"""On-disk compatibility with reference-written datasets.
+
+The reference pickles its ``Unischema`` under the module paths
+``petastorm.unischema`` / ``petastorm.codecs``; our footer reader remaps
+them through ``_CompatUnpickler`` so real petastorm datasets open
+unmodified (SURVEY.md §7 risk: footer-metadata compatibility).
+
+A reference footer is fabricated here by re-pickling our schema at
+protocol 0 (module names are stored as length-free text) and rewriting
+``petastorm_tpu.`` → ``petastorm.`` — byte-exact to what the reference's
+``materialize_dataset`` would emit for an equivalent schema.
+"""
+
+import pickle
+
+import numpy as np
+import pyarrow.parquet as pq
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.etl import dataset_metadata as dm
+from tests.test_common import assert_rows_equal, create_test_dataset
+
+
+def _doctor_footer_to_reference_modules(path):
+    """Rewrite _common_metadata so the pickled schema claims petastorm.*"""
+    meta_path = path + '/' + '_common_metadata'
+    arrow_schema = pq.read_schema(meta_path)
+    blob = arrow_schema.metadata[dm.UNISCHEMA_KEY]
+    schema_obj = pickle.loads(blob)
+    doctored = pickle.dumps(schema_obj, protocol=0).replace(
+        b'petastorm_tpu.', b'petastorm.')
+    assert b'petastorm.unischema' in doctored
+    assert b'petastorm_tpu' not in doctored
+    metadata = dict(arrow_schema.metadata)
+    metadata[dm.UNISCHEMA_KEY] = doctored
+    pq.write_metadata(arrow_schema.with_metadata(metadata), meta_path)
+
+
+def test_reads_reference_pickled_unischema(tmp_path):
+    ds = create_test_dataset('file://' + str(tmp_path / 'refds'), num_rows=20,
+                             rows_per_rowgroup=5)
+    _doctor_footer_to_reference_modules(ds.path)
+
+    # Fresh read resolves petastorm.unischema.Unischema -> ours.
+    schema = dm.get_schema_from_dataset_url(ds.url)
+    assert sorted(schema.fields) == sorted(
+        ['id', 'id2', 'image_png', 'matrix', 'decimal_like', 'embedding',
+         'sensor_name', 'nullable_scalar'])
+
+    with make_reader(ds.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        assert_rows_equal(list(reader), ds.data)
+
+
+def test_unknown_modules_still_fail_loudly(tmp_path):
+    """The shim remaps only known petastorm modules — arbitrary pickles
+    still raise (no silent wrong-class resolution)."""
+    import pytest
+    blob = pickle.dumps(np.float64(1.0), protocol=0).replace(b'numpy', b'nonexistent_mod')
+    with pytest.raises(Exception):
+        dm._loads_schema(blob)

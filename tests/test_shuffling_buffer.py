@@ -1,0 +1,78 @@
+"""Shuffling reservoir unit tests.
+
+Parity target: reference ``petastorm/reader_impl/shuffling_buffer.py``
+behavior — flow control (can_add/can_retrieve), minimum mixing radius,
+drain-after-finish, and seeded determinism.
+"""
+
+import pytest
+
+from petastorm_tpu.reader_impl.shuffling_buffer import (NoopShufflingBuffer,
+                                                        RandomShufflingBuffer)
+
+
+def test_noop_is_fifo():
+    buf = NoopShufflingBuffer()
+    buf.add_many([1, 2, 3])
+    assert buf.can_retrieve() and buf.can_add()
+    assert [buf.retrieve() for _ in range(3)] == [1, 2, 3]
+    assert not buf.can_retrieve()
+    buf.finish()
+    assert buf.finished and not buf.can_add()
+
+
+def test_random_respects_min_after_retrieve():
+    buf = RandomShufflingBuffer(shuffling_buffer_capacity=10, min_after_retrieve=4)
+    buf.add_many(range(4))
+    assert not buf.can_retrieve()  # exactly min: not enough mixing radius yet
+    buf.add_many([4])
+    assert buf.can_retrieve()
+    buf.retrieve()
+    assert not buf.can_retrieve()  # back at min
+
+
+def test_random_capacity_gates_can_add():
+    buf = RandomShufflingBuffer(shuffling_buffer_capacity=3, min_after_retrieve=1)
+    buf.add_many([1, 2])
+    assert buf.can_add()
+    buf.add_many([3])
+    assert not buf.can_add()  # at capacity
+    buf.retrieve()
+    assert buf.can_add()
+
+
+def test_drain_after_finish_yields_everything():
+    buf = RandomShufflingBuffer(shuffling_buffer_capacity=100, min_after_retrieve=50)
+    buf.add_many(range(10))
+    assert not buf.can_retrieve()  # below min while still filling
+    buf.finish()
+    out = []
+    while not buf.finished:
+        assert buf.can_retrieve()
+        out.append(buf.retrieve())
+    assert sorted(out) == list(range(10))
+
+
+def test_seeded_determinism_and_shuffling():
+    def run(seed):
+        buf = RandomShufflingBuffer(20, min_after_retrieve=0, seed=seed)
+        buf.add_many(range(20))
+        buf.finish()
+        out = []
+        while not buf.finished:
+            out.append(buf.retrieve())
+        return out
+
+    assert run(3) == run(3)
+    assert run(3) != run(4)
+    assert sorted(run(3)) == list(range(20))
+    assert run(3) != list(range(20))  # actually shuffled
+
+
+def test_retrieve_guard():
+    buf = RandomShufflingBuffer(5, min_after_retrieve=2)
+    buf.add_many([1])
+    with pytest.raises(RuntimeError):
+        buf.retrieve()
+    with pytest.raises(ValueError):
+        RandomShufflingBuffer(5, min_after_retrieve=5)
