@@ -214,6 +214,75 @@ def make_spark_converter(df, parent_cache_dir_url=None, parquet_row_group_size_b
     return SparkDatasetConverter(cache_dir_url, row_count)
 
 
+def make_pandas_converter(df, parent_cache_dir_url, parquet_row_group_size_bytes=32 << 20,
+                          compression_codec=None, dtype='float32'):
+    """Spark-free twin of :func:`make_spark_converter` for pandas DataFrames.
+
+    No reference equivalent (the reference is Spark-only here); this is the
+    TPU-VM-native "DataFrame → training data in two lines" path: materialize
+    ``df`` to cached Parquet (content-hash dedup, atexit GC) and hand back
+    the same :class:`SparkDatasetConverter` loader surface
+    (``make_jax_loader`` / ``make_tf_dataset`` / ``make_torch_dataloader``).
+    """
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    if dtype == 'float32':
+        for name in df.columns:
+            if df[name].dtype == np.float64:
+                df = df.assign(**{name: df[name].astype(np.float32)})
+            elif df[name].dtype == object and len(df) and \
+                    isinstance(df[name].iloc[0], np.ndarray):
+                df = df.assign(**{name: df[name].map(
+                    lambda a: a.astype(np.float32) if a.dtype == np.float64 else a)})
+
+    # Cache key covers values AND schema (column names/dtypes) AND the
+    # materialization config — content-only hashing would alias frames that
+    # differ in any of those and hand back Parquet with the wrong shape or
+    # under the wrong cache root.  Numeric columns hash vectorized; only
+    # object columns pay a per-cell map (ndarray cells -> bytes).
+    hasher = hashlib.sha1()
+    hasher.update(repr([parent_cache_dir_url, parquet_row_group_size_bytes,
+                        compression_codec, list(df.columns),
+                        [str(t) for t in df.dtypes]]).encode('utf-8'))
+    for name in df.columns:
+        col = df[name]
+        if col.dtype == object:
+            col = col.map(lambda v: v.tobytes() if isinstance(v, np.ndarray) else v)
+        hasher.update(pd.util.hash_pandas_object(col, index=False).values.tobytes())
+    content_hash = hasher.hexdigest()
+
+    with _CACHE_LOCK:
+        cached = _CACHED_CONVERTERS.get(content_hash)
+    if cached is not None:
+        return SparkDatasetConverter(cached.cache_dir_url, cached.row_count)
+
+    cache_dir_url = '%s/%s' % (parent_cache_dir_url.rstrip('/'), uuid.uuid4().hex)
+    fs, path = get_filesystem_and_path_or_paths(cache_dir_url)
+    fs.makedirs(path, exist_ok=True)
+    columns = {}
+    for name in df.columns:
+        first = df[name].iloc[0] if len(df) else None
+        if isinstance(first, np.ndarray):  # array cells -> arrow lists
+            columns[name] = pa.array([c.ravel().tolist() for c in df[name]])
+        else:
+            columns[name] = pa.array(df[name])
+    table = pa.table(columns)
+    row_bytes = max(1, table.nbytes // max(1, table.num_rows))
+    with fs.open(path + '/part_00000.parquet', 'wb') as out:
+        pq.write_table(table, out,
+                       row_group_size=max(1, parquet_row_group_size_bytes // row_bytes),
+                       compression=compression_codec or 'snappy')
+
+    meta = CachedDataFrameMeta(content_hash, cache_dir_url, len(df),
+                               parquet_row_group_size_bytes)
+    with _CACHE_LOCK:
+        _CACHED_CONVERTERS[content_hash] = meta
+    return SparkDatasetConverter(cache_dir_url, len(df))
+
+
 @atexit.register
 def _cleanup_cache_dirs():
     """GC cache dirs at interpreter exit (parity: reference atexit cleanup)."""

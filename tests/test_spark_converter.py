@@ -99,3 +99,55 @@ def test_delete(tmp_path):
 def test_make_spark_converter_requires_pyspark():
     with pytest.raises(ImportError, match='pyspark'):
         make_spark_converter(object())
+
+
+def test_make_pandas_converter_roundtrip_dedup_delete(tmp_path):
+    """Spark-free DataFrame materialization: content-hash dedup, loader
+    round-trip, delete()."""
+    import pandas as pd
+    from petastorm_tpu.spark.spark_dataset_converter import make_pandas_converter
+
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame({
+        'features': [rng.standard_normal(8).astype(np.float64) for _ in range(40)],
+        'label': np.arange(40, dtype=np.int64),
+    })
+    parent = 'file://' + str(tmp_path / 'cache')
+    conv = make_pandas_converter(df, parent_cache_dir_url=parent)
+    assert len(conv) == 40
+
+    # Same content -> same cache dir (no re-materialization).
+    again = make_pandas_converter(df.copy(), parent_cache_dir_url=parent)
+    assert again.cache_dir_url == conv.cache_dir_url
+
+    with conv.make_jax_loader(batch_size=10, num_epochs=1,
+                              reader_pool_type='dummy') as loader:
+        batches = list(loader)
+    labels = np.concatenate([np.asarray(b['label']) for b in batches])
+    assert sorted(labels.tolist()) == list(range(40))
+    feats = np.asarray(batches[0]['features'])
+    assert feats.shape == (10, 8)
+    assert feats.dtype == np.float32  # float64 normalized down
+
+    conv.delete()
+    other = make_pandas_converter(df, parent_cache_dir_url=parent)
+    assert other.cache_dir_url != conv.cache_dir_url  # cache entry evicted
+
+
+def test_pandas_converter_hash_covers_schema_and_config(tmp_path):
+    """Regression: same values under different column names, or a different
+    cache parent, must NOT dedup-collide."""
+    import pandas as pd
+    from petastorm_tpu.spark.spark_dataset_converter import make_pandas_converter
+
+    values = np.arange(10, dtype=np.int64)
+    parent_a = 'file://' + str(tmp_path / 'a')
+    parent_b = 'file://' + str(tmp_path / 'b')
+
+    c1 = make_pandas_converter(pd.DataFrame({'features': values}), parent_a)
+    c2 = make_pandas_converter(pd.DataFrame({'labels': values}), parent_a)
+    assert c1.cache_dir_url != c2.cache_dir_url  # column names differ
+
+    c3 = make_pandas_converter(pd.DataFrame({'features': values}), parent_b)
+    assert c3.cache_dir_url.startswith(parent_b)  # parent respected
+    assert c3.cache_dir_url != c1.cache_dir_url
