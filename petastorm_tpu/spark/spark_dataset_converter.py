@@ -195,22 +195,43 @@ def make_spark_converter(df, parent_cache_dir_url=None, parquet_row_group_size_b
     plan_hash = hashlib.sha1(
         df._jdf.queryExecution().analyzed().toString().encode('utf-8')).hexdigest()
 
+    def materialize(cache_dir_url):
+        writer = df.write.option('parquet.block.size', parquet_row_group_size_bytes)
+        if compression_codec:
+            writer = writer.option('compression', compression_codec)
+        writer.parquet(cache_dir_url)
+        return df.count()
+
+    return _get_or_materialize(plan_hash, parent_cache_dir_url,
+                               parquet_row_group_size_bytes, materialize)
+
+
+def _get_or_materialize(cache_key, parent_cache_dir_url, row_group_size_bytes,
+                        materialize_fn):
+    """Dedup-or-materialize shared by the Spark and pandas converters.
+
+    ``materialize_fn(cache_dir_url) -> row_count`` writes the Parquet copy.
+    Concurrent callers with the same key may both materialize; the loser's
+    directory is deleted and the winner's registration is returned, so no
+    orphan dir ever escapes the atexit GC.
+    """
     with _CACHE_LOCK:
-        cached = _CACHED_CONVERTERS.get(plan_hash)
+        cached = _CACHED_CONVERTERS.get(cache_key)
     if cached is not None:
         return SparkDatasetConverter(cached.cache_dir_url, cached.row_count)
 
     cache_dir_url = '%s/%s' % (parent_cache_dir_url.rstrip('/'), uuid.uuid4().hex)
-    writer = df.write.option('parquet.block.size', parquet_row_group_size_bytes)
-    if compression_codec:
-        writer = writer.option('compression', compression_codec)
-    writer.parquet(cache_dir_url)
-    row_count = df.count()
-
-    meta = CachedDataFrameMeta(plan_hash, cache_dir_url, row_count,
-                               parquet_row_group_size_bytes)
+    row_count = materialize_fn(cache_dir_url)
+    meta = CachedDataFrameMeta(cache_key, cache_dir_url, row_count, row_group_size_bytes)
     with _CACHE_LOCK:
-        _CACHED_CONVERTERS[plan_hash] = meta
+        winner = _CACHED_CONVERTERS.setdefault(cache_key, meta)
+    if winner is not meta:
+        try:
+            fs, path = get_filesystem_and_path_or_paths(cache_dir_url)
+            fs.rm(path, recursive=True)
+        except Exception:  # noqa: BLE001 — losing copy is best-effort cleanup
+            logger.warning('Failed to remove raced cache dir %s', cache_dir_url)
+        return SparkDatasetConverter(winner.cache_dir_url, winner.row_count)
     return SparkDatasetConverter(cache_dir_url, row_count)
 
 
@@ -230,19 +251,27 @@ def make_pandas_converter(df, parent_cache_dir_url, parquet_row_group_size_bytes
     import pyarrow.parquet as pq
 
     if dtype == 'float32':
+        def narrow(a):
+            return a.astype(np.float32) \
+                if isinstance(a, np.ndarray) and a.dtype == np.float64 else a
         for name in df.columns:
             if df[name].dtype == np.float64:
                 df = df.assign(**{name: df[name].astype(np.float32)})
-            elif df[name].dtype == object and len(df) and \
-                    isinstance(df[name].iloc[0], np.ndarray):
-                df = df.assign(**{name: df[name].map(
-                    lambda a: a.astype(np.float32) if a.dtype == np.float64 else a)})
+            elif df[name].dtype == object:
+                df = df.assign(**{name: df[name].map(narrow)})
 
     # Cache key covers values AND schema (column names/dtypes) AND the
     # materialization config — content-only hashing would alias frames that
     # differ in any of those and hand back Parquet with the wrong shape or
     # under the wrong cache root.  Numeric columns hash vectorized; only
-    # object columns pay a per-cell map (ndarray cells -> bytes).
+    # object columns pay a per-cell map (ndarray/list cells -> bytes).
+    def cell_key(v):
+        if isinstance(v, np.ndarray):
+            return v.tobytes()
+        if isinstance(v, (list, tuple)):
+            return repr(v)
+        return v
+
     hasher = hashlib.sha1()
     hasher.update(repr([parent_cache_dir_url, parquet_row_group_size_bytes,
                         compression_codec, list(df.columns),
@@ -250,37 +279,33 @@ def make_pandas_converter(df, parent_cache_dir_url, parquet_row_group_size_bytes
     for name in df.columns:
         col = df[name]
         if col.dtype == object:
-            col = col.map(lambda v: v.tobytes() if isinstance(v, np.ndarray) else v)
+            col = col.map(cell_key)
         hasher.update(pd.util.hash_pandas_object(col, index=False).values.tobytes())
     content_hash = hasher.hexdigest()
 
-    with _CACHE_LOCK:
-        cached = _CACHED_CONVERTERS.get(content_hash)
-    if cached is not None:
-        return SparkDatasetConverter(cached.cache_dir_url, cached.row_count)
+    def materialize(cache_dir_url):
+        fs, path = get_filesystem_and_path_or_paths(cache_dir_url)
+        fs.makedirs(path, exist_ok=True)
+        columns = {}
+        for name in df.columns:
+            has_arrays = df[name].dtype == object and any(
+                isinstance(c, np.ndarray) for c in df[name])
+            if has_arrays:  # array cells -> arrow lists (None cells -> null)
+                columns[name] = pa.array(
+                    [c.ravel().tolist() if isinstance(c, np.ndarray) else None
+                     for c in df[name]])
+            else:
+                columns[name] = pa.array(df[name])
+        table = pa.table(columns)
+        row_bytes = max(1, table.nbytes // max(1, table.num_rows))
+        with fs.open(path + '/part_00000.parquet', 'wb') as out:
+            pq.write_table(table, out,
+                           row_group_size=max(1, parquet_row_group_size_bytes // row_bytes),
+                           compression=compression_codec or 'snappy')
+        return len(df)
 
-    cache_dir_url = '%s/%s' % (parent_cache_dir_url.rstrip('/'), uuid.uuid4().hex)
-    fs, path = get_filesystem_and_path_or_paths(cache_dir_url)
-    fs.makedirs(path, exist_ok=True)
-    columns = {}
-    for name in df.columns:
-        first = df[name].iloc[0] if len(df) else None
-        if isinstance(first, np.ndarray):  # array cells -> arrow lists
-            columns[name] = pa.array([c.ravel().tolist() for c in df[name]])
-        else:
-            columns[name] = pa.array(df[name])
-    table = pa.table(columns)
-    row_bytes = max(1, table.nbytes // max(1, table.num_rows))
-    with fs.open(path + '/part_00000.parquet', 'wb') as out:
-        pq.write_table(table, out,
-                       row_group_size=max(1, parquet_row_group_size_bytes // row_bytes),
-                       compression=compression_codec or 'snappy')
-
-    meta = CachedDataFrameMeta(content_hash, cache_dir_url, len(df),
-                               parquet_row_group_size_bytes)
-    with _CACHE_LOCK:
-        _CACHED_CONVERTERS[content_hash] = meta
-    return SparkDatasetConverter(cache_dir_url, len(df))
+    return _get_or_materialize(content_hash, parent_cache_dir_url,
+                               parquet_row_group_size_bytes, materialize)
 
 
 @atexit.register

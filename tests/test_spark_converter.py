@@ -151,3 +151,25 @@ def test_pandas_converter_hash_covers_schema_and_config(tmp_path):
     c3 = make_pandas_converter(pd.DataFrame({'features': values}), parent_b)
     assert c3.cache_dir_url.startswith(parent_b)  # parent respected
     assert c3.cache_dir_url != c1.cache_dir_url
+
+
+def test_pandas_converter_list_and_missing_cells(tmp_path):
+    """Regression: list-cell columns and ndarray columns with missing cells
+    must hash and materialize without crashing."""
+    import pandas as pd
+    from petastorm_tpu.spark.spark_dataset_converter import make_pandas_converter
+
+    parent = 'file://' + str(tmp_path / 'cache')
+    df = pd.DataFrame({
+        'features': [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]],      # plain lists
+        'maybe': [np.zeros(2, np.float64), None, np.ones(2, np.float64)],
+        'label': np.arange(3, dtype=np.int64),
+    })
+    conv = make_pandas_converter(df, parent_cache_dir_url=parent)
+    assert len(conv) == 3
+    with conv.make_jax_loader(batch_size=3, num_epochs=1,
+                              reader_pool_type='dummy') as loader:
+        batch = next(iter(loader))
+    feats = np.asarray(batch['features'])
+    np.testing.assert_allclose(feats, [[1, 2], [3, 4], [5, 6]])
+    assert feats.dtype == np.float32
