@@ -17,3 +17,6 @@ from petastorm_tpu.parallel.ring_attention import (  # noqa: F401
     full_attention, ring_attention, ulysses_attention,
     make_ring_attention, make_ulysses_attention,
 )
+from petastorm_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply, make_pipeline,
+)

@@ -1,0 +1,100 @@
+"""SPMD pipeline parallelism: stages sharded over a mesh axis, activations
+hopping stage-to-stage via ``lax.ppermute``.
+
+No reference equivalent (the reference has no model parallelism of any
+kind, SURVEY.md §2.6); this is the TPU-idiomatic GPipe schedule from the
+scaling-book recipe: every device holds ONE stage's parameters, the
+microbatch stream enters at stage 0, and each schedule tick every device
+runs its stage then rotates its activation one hop down the ring — so all
+stages compute concurrently once the pipeline fills (bubble =
+``n_stages - 1`` ticks).  Differentiable end to end: the backward schedule
+is the transposed permutes the autodiff of ``ppermute`` produces.
+
+Call :func:`pipeline_apply` inside ``jax.shard_map`` (see
+:func:`make_pipeline`), with stage parameters sharded so device ``d``
+holds slice ``d`` of a stacked-stage pytree.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name, n_stages):
+    """Run the pipeline schedule for this device's stage (inside shard_map).
+
+    Args:
+        stage_fn: ``fn(stage_params, x) -> y`` with ``y.shape == x.shape``
+            (stages must preserve the activation shape so it can ride the
+            ring; project in/out before/after the pipeline).
+        stage_params: THIS device's stage parameters (leading stage axis
+            already sliced away by shard_map).
+        microbatches: ``[n_micro, microbatch, ...]`` input, replicated on
+            every device (only stage 0 reads it).
+        axis_name: mesh axis the stages live on.
+        n_stages: static stage count (== axis size).
+
+    Returns ``[n_micro, microbatch, ...]`` outputs, identical on every
+    device of the axis.
+    """
+    stage_id = jax.lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    state = jnp.zeros_like(microbatches[0])
+    outputs = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t; later stages consume the activation
+        # that just hopped in.  Inactive (bubble) ticks compute on garbage
+        # and mask the result — branchless, so XLA gets one fused schedule.
+        feed_idx = jnp.clip(t, 0, n_micro - 1)
+        inject = jax.lax.dynamic_index_in_dim(microbatches, feed_idx,
+                                              keepdims=False)
+        x = jnp.where(stage_id == 0, inject, state)
+        y = stage_fn(stage_params, x)
+        active = (t - stage_id >= 0) & (t - stage_id < n_micro)
+        y = jnp.where(active, y, state)
+
+        # The last stage retires microbatch t - (n_stages - 1).
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        retire = active & (stage_id == n_stages - 1)
+        current = jax.lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(retire, y, current), out_idx, axis=0)
+
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                       jnp.arange(ticks))
+    # Only the last stage holds real outputs; psum broadcasts them (every
+    # other device contributes zeros).
+    outputs = jnp.where(stage_id == n_stages - 1, outputs,
+                        jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis_name)
+
+
+def make_pipeline(mesh, stage_fn, pipe_axis='pipe'):
+    """shard_map-wrapped pipeline over ``mesh``'s ``pipe_axis``.
+
+    Returns ``(fn, stage_sharding)``: ``fn(stacked_params, microbatches)``
+    where ``stacked_params`` is a pytree whose leaves have a leading
+    ``n_stages`` axis (place with ``stage_sharding``) and ``microbatches``
+    is ``[n_micro, microbatch, ...]`` (replicated).
+    """
+    n_stages = mesh.shape[pipe_axis]
+
+    def inner(stacked_params, microbatches):
+        stage_params = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+        return pipeline_apply(stage_fn, stage_params, microbatches,
+                              pipe_axis, n_stages)
+
+    fn = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(pipe_axis), P()),
+        out_specs=P(),
+        check_vma=False)
+    return fn, NamedSharding(mesh, P(pipe_axis))

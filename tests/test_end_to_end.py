@@ -247,3 +247,28 @@ def test_diagnostics(dataset):
         d = reader.diagnostics
     assert d['ventilated_count'] == 6
     assert d['items_processed'] == 6
+
+
+def test_auto_shard_from_jax_process_topology(dataset, monkeypatch):
+    """SURVEY §4 multi-host simulation: with no explicit cur_shard, the
+    reader shards by the faked jax process topology; the two 'hosts' see
+    disjoint row sets whose union is the dataset."""
+    import petastorm_tpu.reader as reader_mod
+
+    seen = {}
+    for rank in (0, 1):
+        monkeypatch.setattr(reader_mod, '_jax_default_shard', lambda r=rank: (r, 2))
+        with make_reader(dataset.url, reader_pool_type='dummy',
+                         shuffle_row_groups=False) as r:
+            seen[rank] = {int(row.id) for row in r}
+    assert seen[0] & seen[1] == set()
+    assert seen[0] | seen[1] == set(range(len(dataset.data)))
+
+
+def test_auto_shard_uses_real_jax_api(monkeypatch):
+    """The default-shard hook reads jax.process_index/process_count."""
+    import petastorm_tpu.reader as reader_mod
+    import jax
+    monkeypatch.setattr(jax, 'process_count', lambda: 4)
+    monkeypatch.setattr(jax, 'process_index', lambda: 3)
+    assert reader_mod._jax_default_shard() == (3, 4)
