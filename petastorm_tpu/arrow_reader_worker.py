@@ -33,13 +33,22 @@ class BatchWorkerArgs:
 
 class ArrowReaderWorker(ParquetWorkerBase):
 
+    #: TransformSpec.func runs at DataFrame level here and may drop rows —
+    #: consumed by ``Reader.transform_may_change_row_count`` (epoch_steps
+    #: guard).  The row worker applies func per row 1:1, so it stays False.
+    DATAFRAME_TRANSFORM = True
+
     def process(self, piece_index, _row_drop_partition=0):
         piece = self._a.pieces[piece_index]
         cache_key = '%s:%d:batch:%s' % (piece.path, piece.row_group,
                                         ','.join(sorted(self._a.schema_view.fields)))
+        # The retry/poison classifier wraps only the I/O stage: an ArrowInvalid
+        # out of a user transform (e.g. from_pandas on a mixed-type column)
+        # must surface as the transform's own error, not as a corrupt file.
         table = self._a.cache.get(
             cache_key,
-            lambda: self._read_with_retry(piece, lambda: self._load_table(piece)))
+            lambda: self._apply_transform(
+                self._read_with_retry(piece, lambda: self._load_table(piece))))
         if table is not None and table.num_rows > 0:
             self.publish_func(table)
 
@@ -74,18 +83,21 @@ class ArrowReaderWorker(ParquetWorkerBase):
                 cast = value if dtype.kind in ('U', 'S', 'O') else dtype.type(value)
                 table = table.append_column(key, pa.array([cast] * table.num_rows))
 
-        spec = self._a.transform_spec
-        if spec is not None:
-            df = table.to_pandas()
-            if spec.func is not None:
-                df = spec.func(df)
-            for name in spec.removed_fields:
-                if name in df.columns:
-                    df = df.drop(columns=[name])
-            if spec.selected_fields is not None:
-                df = df[list(spec.selected_fields)]
-            table = pa.Table.from_pandas(df, preserve_index=False)
         return table
+
+    def _apply_transform(self, table):
+        spec = self._a.transform_spec
+        if table is None or spec is None:
+            return table
+        df = table.to_pandas()
+        if spec.func is not None:
+            df = spec.func(df)
+        for name in spec.removed_fields:
+            if name in df.columns:
+                df = df.drop(columns=[name])
+        if spec.selected_fields is not None:
+            df = df[list(spec.selected_fields)]
+        return pa.Table.from_pandas(df, preserve_index=False)
 
 
 class ArrowResultConverter(object):

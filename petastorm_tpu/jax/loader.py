@@ -328,6 +328,14 @@ class InMemDataLoader(DataLoader):
                  seed=None, **kwargs):
         if getattr(reader, 'ngram', None) is not None:
             raise ValueError('InMemDataLoader does not support NGram readers')
+        reader_epochs = getattr(reader, 'num_epochs', 1)
+        if reader_epochs != 1:
+            # num_epochs=None (infinite) would hang the one-time cache build
+            # forever; >1 would silently duplicate every row in the cache.
+            raise ValueError(
+                'InMemDataLoader requires a reader built with num_epochs=1 '
+                '(got num_epochs=%r); epoch repetition happens in the loader'
+                % (reader_epochs,))
         super(InMemDataLoader, self).__init__(reader, batch_size, seed=seed, **kwargs)
         self._num_epochs = num_epochs
         self._shuffle = shuffle

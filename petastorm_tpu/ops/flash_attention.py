@@ -286,6 +286,14 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128)
     import math
     block_q = min(block_q, max(seq_len, 16))
     block_k = min(block_k, max(seq_len, 16))
+    if not _auto_interpret():
+        # Mosaic on real TPU rejects non-tile-aligned layouts: block_q/block_k
+        # appear as the minor (lane) dim of the lse/delta blocks, so round UP
+        # to a 128-lane multiple.  The Pallas interpreter (CI) accepts any
+        # block shape — keep the requested sizes there so small-block tests
+        # still exercise multi-block grids and the lcm tail-block logic.
+        block_q = -(-block_q // 128) * 128
+        block_k = -(-block_k // 128) * 128
     # Pad to the lcm so BOTH grids (seq_pad // block_q, seq_pad // block_k)
     # cover the sequence exactly — padding to max() alone drops tail blocks
     # whenever the smaller block doesn't divide the larger.

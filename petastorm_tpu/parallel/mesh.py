@@ -101,6 +101,15 @@ def epoch_steps(reader, batch_size, drop_last=True):
         raise ValueError('epoch_steps cannot bound a predicate= reader: the '
                          'filtered yield is data-dependent; set the step '
                          'budget explicitly')
+    if getattr(reader, 'transform_may_change_row_count', False):
+        # The batch worker runs TransformSpec.func at DataFrame level, which
+        # may filter rows — the metadata-derived budget would overshoot and
+        # hang a host on every collective, the exact deadlock this guard
+        # prevents.  (Row-path transforms are per-row 1:1 and stay safe.)
+        raise ValueError('epoch_steps cannot bound a batch reader whose '
+                         'transform_spec has a func: the DataFrame transform '
+                         'may change the row count, making the yield data-'
+                         'dependent; set the step budget explicitly')
     if not drop_last and jax.process_count() > 1:
         raise ValueError('drop_last=False is unsafe multi-host: the ragged '
                          'final batch differs across hosts')

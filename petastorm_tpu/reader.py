@@ -367,6 +367,27 @@ class Reader(object):
         """The worker-side row predicate, if any (data-dependent yield)."""
         return getattr(self._worker_args, 'predicate', None)
 
+    @property
+    def transform_spec(self):
+        """The worker-side TransformSpec, if any.  A spec whose ``func`` drops
+        rows makes the yield data-dependent (see ``parallel.epoch_steps``)."""
+        return getattr(self._worker_args, 'transform_spec', None)
+
+    @property
+    def transform_may_change_row_count(self):
+        """True when this reader's transform runs at DataFrame level (the
+        batch worker), where ``func`` may filter rows.  The row worker applies
+        ``func`` per row 1:1, so row-path transforms cannot change counts."""
+        spec = self.transform_spec
+        if spec is None or getattr(spec, 'func', None) is None:
+            return False
+        return getattr(self._worker_class, 'DATAFRAME_TRANSFORM', False)
+
+    @property
+    def num_epochs(self):
+        """Epoch repetition count this reader was built with (None=infinite)."""
+        return self._num_epochs
+
     # -- iteration -----------------------------------------------------------
 
     def __iter__(self):

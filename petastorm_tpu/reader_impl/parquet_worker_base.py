@@ -13,6 +13,7 @@ that *keeps* failing is surfaced — by id — as ``PoisonedRowGroupError``.
 import logging
 import time
 
+import pyarrow as pa
 import pyarrow.parquet as pq
 
 from petastorm_tpu.errors import PoisonedRowGroupError
@@ -24,6 +25,12 @@ logger = logging.getLogger(__name__)
 #: subclasses (ArrowIOError aliases OSError in modern pyarrow); fsspec remote
 #: filesystems additionally raise EOFError/TimeoutError on truncated bodies.
 TRANSIENT_IO_ERRORS = (OSError, EOFError, TimeoutError)
+
+#: Permanent decode failures — a genuinely corrupt row group (bad magic,
+#: malformed thrift, invalid page data).  pyarrow surfaces these as
+#: ``ArrowInvalid`` (a ValueError subclass), which must NOT be retried but
+#: must still carry the piece identity that PoisonedRowGroupError promises.
+CORRUPT_DATA_ERRORS = (pa.ArrowInvalid,)
 
 #: OSError subclasses that are *permanent* conditions — retrying them only
 #: delays the inevitable and mislabels the failure.
@@ -38,6 +45,11 @@ class ParquetWorkerBase(WorkerBase):
         super(ParquetWorkerBase, self).__init__(worker_id, publish_func, args)
         self._a = args
         self._open_files = {}  # path -> (file handle, ParquetFile)
+        #: Cumulative seconds spent in retry-backoff sleeps.  Pools subtract
+        #: this from measured process() time so ``decode_utilization`` reflects
+        #: decode work, not waiting (docs/performance.md tells operators to
+        #: use it to distinguish decode-bound from I/O-bound).
+        self.retry_sleep_s = 0.0
 
     def _parquet_file(self, path):
         entry = self._open_files.get(path)
@@ -73,6 +85,13 @@ class ParquetWorkerBase(WorkerBase):
         while True:
             try:
                 return read_fn()
+            except CORRUPT_DATA_ERRORS as e:
+                # Corrupt bytes, not a flaky wire: no retry, but keep the
+                # piece-identity contract so the operator can quarantine it.
+                # attempt counts any transient retries that preceded this.
+                self._evict_file(piece.path)
+                raise PoisonedRowGroupError(piece.path, piece.row_group,
+                                            attempt + 1, e) from e
             except TRANSIENT_IO_ERRORS as e:
                 self._evict_file(piece.path)
                 if isinstance(e, PERMANENT_IO_ERRORS):
@@ -86,4 +105,5 @@ class ParquetWorkerBase(WorkerBase):
                     'Transient read failure on row group %d of %r '
                     '(attempt %d/%d, retrying in %.2fs): %s',
                     piece.row_group, piece.path, attempt, retries + 1, delay, e)
+                self.retry_sleep_s += delay
                 time.sleep(delay)

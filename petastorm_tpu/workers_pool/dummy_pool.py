@@ -43,8 +43,10 @@ class DummyPool(object):
                 if len(args) == 1 and isinstance(args[0], VentilatedItem):
                     position, args = args[0].position, tuple(args[0].args)
                 started = time.monotonic()
+                sleep_before = getattr(self._worker, 'retry_sleep_s', 0.0)
                 self._worker.process(*args, **kwargs)
-                self.busy_time += time.monotonic() - started
+                slept = getattr(self._worker, 'retry_sleep_s', 0.0) - sleep_before
+                self.busy_time += max(0.0, time.monotonic() - started - slept)
                 self.items_processed += 1
                 if self._ventilator is not None:
                     self._ventilator.processed_item(position)

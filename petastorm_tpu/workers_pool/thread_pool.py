@@ -87,13 +87,17 @@ class ThreadPool(object):
             if len(args) == 1 and isinstance(args[0], VentilatedItem):
                 position, args = args[0].position, tuple(args[0].args)
             started = time.monotonic()
+            sleep_before = getattr(worker, 'retry_sleep_s', 0.0)
             try:
                 worker.process(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 — travels to the caller
                 import traceback
                 self._results_queue.put(_WorkerError(e, traceback.format_exc()))
             finally:
-                elapsed = time.monotonic() - started
+                # Retry-backoff sleeps are waiting, not decoding — excluding
+                # them keeps decode_utilization an honest decode-work measure.
+                slept = getattr(worker, 'retry_sleep_s', 0.0) - sleep_before
+                elapsed = max(0.0, time.monotonic() - started - slept)
                 with self._inflight_lock:
                     self._inflight -= 1
                     self.items_processed += 1

@@ -142,6 +142,60 @@ def test_permanent_errors_not_retried(dataset, tmp_path):
     reader.join()
 
 
+class CorruptDataFilesystem(FlakyOpenFilesystem):
+    """Data-file handles yield pyarrow ArrowInvalid on read — simulating a
+    genuinely corrupt row group (bad magic / malformed pages)."""
+
+    def open(self, path, *args, **kwargs):
+        handle = self._real.open(path, *args, **kwargs)
+        if _is_data_file(path):
+            return _CorruptFile(handle)
+        return handle
+
+
+class _CorruptFile(object):
+    def __init__(self, inner):
+        self._inner = inner
+
+    def read(self, *args, **kwargs):
+        import pyarrow as pa
+        raise pa.ArrowInvalid('Parquet magic bytes not found in footer')
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_corrupt_row_group_poisoned_without_retry(dataset):
+    """ArrowInvalid (corrupt bytes, a ValueError subclass) must surface as
+    PoisonedRowGroupError with piece identity, attempts=1, and — since
+    retrying corrupt data is pointless — no backoff sleeps."""
+    import time
+    fs = CorruptDataFilesystem(fsspec.filesystem('file'), fail_times=0)
+    t0 = time.monotonic()
+    with pytest.raises(PoisonedRowGroupError) as exc_info:
+        with make_reader(dataset.url, filesystem=fs, workers_count=1,
+                         reader_pool_type='dummy', shuffle_row_groups=False,
+                         read_retries=5, retry_backoff_s=5.0) as reader:
+            list(reader)
+    assert time.monotonic() - t0 < 2.0, 'corrupt data was retried with backoff'
+    err = exc_info.value
+    assert err.path.endswith('.parquet')
+    assert err.attempts == 1
+    assert 'magic bytes' in str(err)
+
+
+def test_retry_sleep_excluded_from_busy_time(dataset):
+    """decode_utilization must measure decode work, not backoff waiting."""
+    fs = FlakyOpenFilesystem(fsspec.filesystem('file'), fail_times=1)
+    with make_reader(dataset.url, filesystem=fs, workers_count=1,
+                     reader_pool_type='dummy', shuffle_row_groups=False,
+                     read_retries=1, retry_backoff_s=0.5) as reader:
+        list(reader)
+        # 4 row groups x 0.5s first-retry backoff = 2s of sleeping; actual
+        # decode of 20 tiny rows is milliseconds.
+        assert reader.diagnostics['decode_busy_s'] < 1.0
+
+
 def test_zero_retries_fails_fast(dataset):
     fs = FlakyOpenFilesystem(fsspec.filesystem('file'), fail_times=1)
     with pytest.raises(PoisonedRowGroupError):

@@ -289,6 +289,44 @@ def test_epoch_steps_rejects_data_dependent_readers(dataset):
             epoch_steps(r, 10)
 
 
+def test_epoch_steps_rejects_row_dropping_transform(dataset):
+    """A batch-path TransformSpec func runs at DataFrame level and may drop
+    rows — the metadata-derived budget would overshoot and hang a host on
+    every collective (ADVICE r1, medium).  Row-path funcs are per-row 1:1
+    and must stay accepted."""
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.parallel import epoch_steps
+    from petastorm_tpu.transform import TransformSpec
+    spec = TransformSpec(lambda df: df)
+    with make_batch_reader(dataset.url, reader_pool_type='dummy',
+                           transform_spec=spec) as r:
+        with pytest.raises(ValueError, match='transform_spec'):
+            epoch_steps(r, 10)
+    # Row path: func(dict)->dict cannot change the row count: fine.
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     transform_spec=TransformSpec(lambda row: row)) as r:
+        assert epoch_steps(r, 10) == 6
+    # A spec with edit_fields only (no func) cannot change row counts: fine.
+    spec_no_func = TransformSpec(None, removed_fields=['text'])
+    with make_batch_reader(dataset.url, reader_pool_type='dummy',
+                           transform_spec=spec_no_func) as r:
+        assert epoch_steps(r, 10) == 6
+
+
+def test_inmem_loader_rejects_multi_epoch_reader(dataset):
+    """num_epochs=None would hang the cache build forever; >1 silently
+    duplicates rows (ADVICE r1)."""
+    from petastorm_tpu.jax import InMemDataLoader
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     num_epochs=None) as reader:
+        with pytest.raises(ValueError, match='num_epochs'):
+            InMemDataLoader(reader, batch_size=16)
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     num_epochs=2) as reader:
+        with pytest.raises(ValueError, match='num_epochs'):
+            InMemDataLoader(reader, batch_size=16)
+
+
 def test_num_local_rows_from_footer_without_reopening_files(dataset):
     """Row counts are stamped in the footer at write time; sizing an epoch
     must not re-open data-file footers."""
