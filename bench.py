@@ -1,15 +1,25 @@
-"""Benchmark: ImageNet-shaped JPEG Parquet -> device batches, images/sec/host.
+"""Benchmark: ImageNet-shaped JPEG Parquet -> device batches + ResNet-50 step.
 
-The reference publishes no numbers (BASELINE.json "published": {}); its own
-harness measures reader rows/sec (``petastorm/benchmark/throughput.py``).
-``vs_baseline`` here is therefore measured, not quoted: the same dataset is
-read through a faithful reimplementation of the reference's delivery
-strategy — per-row decode iteration with per-row python collate, no
-double-buffering (its pytorch ``DataLoader`` hot loop) — and the reported
-ratio is tpu-native throughput / reference-strategy throughput on identical
-hardware.
+Two measurements, one JSON line:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+* **images/s/host** (the `value`): thread-pool decode -> columnar collate ->
+  double-buffered `device_put`, whole-epoch wall clock.
+* **stall_pct** (the BASELINE.json north-star metric): a jitted ResNet-50
+  train step consumes `DataLoader` batches under `StallMonitor`; stall is the
+  fraction of steady-state wall time the consumer spends blocked in
+  `__next__` (target <= 2%).
+
+`vs_baseline` is measured, not quoted — the reference publishes no numbers
+(BASELINE.json "published": {}).  The baseline leg re-reads the same dataset
+through a faithful reimplementation of the reference's delivery strategy:
+per-row codec decode (cv2, native plane force-disabled via
+`native.disabled()`), per-row python collate, synchronous `device_put`, no
+prefetch overlap — its pytorch `DataLoader` hot loop.  Same hardware, same
+process, interleaved runs.
+
+Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", "stall_pct", "step_ms",
+ "baseline": <what the denominator measured>}.
 """
 
 import json
@@ -30,6 +40,7 @@ BATCH = 64
 # a 1-core sandbox, 8 still beats 4 because pyarrow/libjpeg release the GIL
 # during I/O waits, while >12 thrashes.
 WORKERS = min(32, max(8, os.cpu_count() or 8))
+TRAIN_STEPS = int(os.environ.get('PETASTORM_TPU_BENCH_TRAIN_STEPS', '36'))
 
 
 def ensure_dataset():
@@ -85,25 +96,124 @@ def tpu_native_epoch():
 
 
 def reference_strategy_epoch():
-    """Reference-style delivery: iterate rows, per-row python collate into a
-    batch list, synchronous put, no prefetch overlap."""
+    """Reference-style delivery: per-row cv2 decode (native plane OFF), per-row
+    python collate into a batch list, synchronous put, no prefetch overlap."""
     import jax
+    from petastorm_tpu import make_reader, native
+
+    with native.disabled():
+        with make_reader(DATASET_URL, num_epochs=1, workers_count=WORKERS,
+                         shuffle_row_groups=False) as reader:
+            n = 0
+            t0 = time.monotonic()
+            batch_rows = []
+            for row in reader:
+                batch_rows.append(row.image)
+                if len(batch_rows) == BATCH:
+                    dev = jax.device_put(np.stack(batch_rows))
+                    jax.block_until_ready(dev)
+                    n += BATCH
+                    batch_rows = []
+            dt = time.monotonic() - t0
+    return n / dt
+
+
+def _make_resnet_step():
+    """Jitted ResNet-50 SGD step: uint8 batch in (4x cheaper H2D than f32);
+    normalization + bf16 cast happen on device, fused into the first conv."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from petastorm_tpu.models.resnet import ResNet50
+
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, IMAGE_HW[0], IMAGE_HW[1], 3),
+                                          jnp.bfloat16), train=True)
+    params, batch_stats = variables['params'], variables['batch_stats']
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images_u8, labels):
+        images = images_u8.astype(jnp.bfloat16) / 255.0
+
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {'params': p, 'batch_stats': batch_stats}, images, train=True,
+                mutable=['batch_stats'])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels).mean()
+            return loss, mutated['batch_stats']
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), new_stats, new_opt, loss
+
+    return train_step, params, batch_stats, opt_state
+
+
+def _run_stall(loader, state, max_steps):
+    """Drive the train step over ``loader`` under StallMonitor.
+
+    The loop body blocks on the step's loss, so 'step' time is real device
+    compute and '__next__' wait is true data stall (the loader's prefetch
+    threads keep filling during the blocked step)."""
+    import numpy as np
+    from petastorm_tpu.benchmark.stall_profiler import StallMonitor
+
+    train_step, params, batch_stats, opt_state = state
+    monitor = StallMonitor(warmup_steps=3)
+    steps = 0
+    loss = None
+    for batch in monitor.wrap(loader):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, batch['image'], batch['noun_id'])
+        loss.block_until_ready()
+        steps += 1
+        if steps >= max_steps:
+            break
+    report = monitor.report()
+    assert loss is not None and np.isfinite(float(loss)), 'non-finite loss'
+    step_ms = 1000.0 * report['step_s'] / max(report['steps'], 1)
+    return report['stall_pct'], step_ms
+
+
+def train_stall_legs():
+    """North-star metric, two regimes:
+
+    * **streaming** — thread-pool JPEG decode feeding the step live.  Whether
+      this stalls is a host-cores : chip-speed ratio; on a 1-core sandbox
+      host with a datacenter chip it necessarily will (no host decode plane
+      sustains tens of kimg/s on one core) — reported for transparency.
+    * **hbm-cached** — DeviceInMemDataLoader: decode once, epoch cache in
+      device HBM, per-epoch device-side reshuffle, jnp.take per batch.  Zero
+      host work per step: the framework's TPU-native answer when the decoded
+      shard fits in HBM, and the headline stall number on this host.
+    """
     from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import DataLoader, DeviceInMemDataLoader
+
+    state = _make_resnet_step()
+
+    epochs = max(1, -(-(TRAIN_STEPS + 4) * BATCH // NUM_IMAGES))
+    with make_reader(DATASET_URL, num_epochs=epochs, workers_count=WORKERS,
+                     shuffle_row_groups=False, columnar_decode=True) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+        stream_stall, stream_step_ms = _run_stall(loader, state, TRAIN_STEPS + 4)
 
     with make_reader(DATASET_URL, num_epochs=1, workers_count=WORKERS,
-                     shuffle_row_groups=False) as reader:
-        n = 0
-        t0 = time.monotonic()
-        batch_rows = []
-        for row in reader:
-            batch_rows.append(row.image)
-            if len(batch_rows) == BATCH:
-                dev = jax.device_put(np.stack(batch_rows))
-                jax.block_until_ready(dev)
-                n += BATCH
-                batch_rows = []
-        dt = time.monotonic() - t0
-    return n / dt
+                     shuffle_row_groups=False, columnar_decode=True) as reader:
+        loader = DeviceInMemDataLoader(reader, batch_size=BATCH,
+                                       num_epochs=None, seed=0)
+        cached_stall, cached_step_ms = _run_stall(loader, state, TRAIN_STEPS + 4)
+
+    return {
+        'stall_pct': cached_stall,
+        'step_ms': round(cached_step_ms, 2),
+        'stall_pct_streaming': stream_stall,
+        'step_ms_streaming': round(stream_step_ms, 2),
+    }
 
 
 def main():
@@ -122,12 +232,25 @@ def main():
         theirs.append(reference_strategy_epoch())
     ours, theirs = max(ours), max(theirs)
 
-    print(json.dumps({
+    stall = train_stall_legs()
+
+    result = {
         'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
         'value': round(ours, 1),
         'unit': 'images/s',
         'vs_baseline': round(ours / theirs, 2),
-    }))
+        'host_cores': os.cpu_count(),
+        'baseline': 'same dataset+hardware via reference delivery strategy: '
+                    'per-row cv2 decode (native plane disabled), per-row '
+                    'python collate, sync device_put, no prefetch '
+                    '(%.1f images/s)' % theirs,
+        'stall_note': 'stall_pct = ResNet-50 train loop fed from the HBM '
+                      'epoch cache (DeviceInMemDataLoader); '
+                      'stall_pct_streaming = live thread-pool JPEG decode, '
+                      'bounded by host_cores vs chip speed',
+    }
+    result.update(stall)
+    print(json.dumps(result))
 
 
 if __name__ == '__main__':

@@ -4,6 +4,14 @@ Uses make_batch_reader (vanilla Parquet, no codecs) -> DataLoader with a
 transform assembling (dense, categorical, label) arrays on the host.
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import argparse
 import time
 
@@ -60,6 +68,8 @@ def train(dataset_url, epochs=1, batch_size=2048, lr=1e-3):
 
 
 if __name__ == '__main__':
+    from petastorm_tpu.utils import ensure_jax_backend
+    ensure_jax_backend()  # runs on any host; TPU when reachable
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--dataset-url', default='file:///tmp/criteo_parquet')
     parser.add_argument('--epochs', type=int, default=2)

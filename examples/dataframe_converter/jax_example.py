@@ -6,6 +6,14 @@ converter hands back loaders for JAX, TF, or torch.  With pyspark installed
 the same script works on a Spark DataFrame via ``make_spark_converter``.
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import numpy as np
 import pandas as pd
 

@@ -3,6 +3,14 @@
 The TPU-native analog of the reference's tensorflow/pytorch hello worlds.
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import argparse
 
 from petastorm_tpu import make_reader
@@ -18,6 +26,8 @@ def jax_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
 
 
 if __name__ == '__main__':
+    from petastorm_tpu.utils import ensure_jax_backend
+    ensure_jax_backend()  # runs on any host; TPU when reachable
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
     args = parser.parse_args()

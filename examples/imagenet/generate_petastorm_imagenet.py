@@ -6,6 +6,14 @@ Reads a local ImageNet directory tree when given one; otherwise synthesizes
 ImageNet-shaped data (no network egress in TPU sandboxes).
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import argparse
 import os
 

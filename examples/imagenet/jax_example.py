@@ -7,6 +7,14 @@ StallMonitor reports the step-time data-stall percentage that the <=2%
 target refers to.
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import argparse
 import time
 
@@ -90,6 +98,8 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1):
 
 
 if __name__ == '__main__':
+    from petastorm_tpu.utils import ensure_jax_backend
+    ensure_jax_backend()  # runs on any host; TPU when reachable
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--dataset-url', default='file:///tmp/imagenet_petastorm')
     parser.add_argument('--steps', type=int, default=50)

@@ -10,6 +10,14 @@ Run: python generate_token_parquet.py /tmp/lc_tokens
      python jax_example.py --dataset-url file:///tmp/lc_tokens
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import argparse
 
 import numpy as np
@@ -99,4 +107,6 @@ def main():
 
 
 if __name__ == '__main__':
+    from petastorm_tpu.utils import ensure_jax_backend
+    ensure_jax_backend()  # runs on any host; TPU when reachable
     main()

@@ -8,6 +8,14 @@ learn); pass ``--mnist-data-dir`` pointing at a local torchvision MNIST copy
 to use real digits.
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import argparse
 
 import numpy as np

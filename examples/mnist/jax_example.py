@@ -5,6 +5,14 @@ petastorm_tpu.jax.DataLoader -> jitted train step.  No reference equivalent
 exists for JAX; the structure mirrors ``examples/mnist/pytorch_example.py``.
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import argparse
 import time
 
@@ -55,6 +63,8 @@ def train(dataset_url, epochs=3, batch_size=128, lr=1e-3):
 
 
 if __name__ == '__main__':
+    from petastorm_tpu.utils import ensure_jax_backend
+    ensure_jax_backend()  # runs on any host; TPU when reachable
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--dataset-url', default='file:///tmp/mnist_petastorm')
     parser.add_argument('--epochs', type=int, default=3)

@@ -4,6 +4,14 @@ Generates a multi-field timestamped dataset, reads sliding windows with
 delta-threshold gap filtering, and feeds window tensors to a jitted step.
 """
 
+# -- run from a source checkout without installation -------------------------
+import os as _os, sys as _sys
+_d = _os.path.dirname(_os.path.abspath(__file__))
+while _d != _os.path.dirname(_d) and not _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')):
+    _d = _os.path.dirname(_d)
+if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
+    _sys.path.insert(0, _d)
+
 import argparse
 
 import numpy as np
@@ -63,6 +71,8 @@ def main(url):
 
 
 if __name__ == '__main__':
+    from petastorm_tpu.utils import ensure_jax_backend
+    ensure_jax_backend()  # runs on any host; TPU when reachable
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--dataset-url', default='file:///tmp/ngram_sensor')
     args = parser.parse_args()

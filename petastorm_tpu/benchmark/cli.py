@@ -18,12 +18,18 @@ def main(argv=None):
     parser.add_argument('-p', '--pool-type', default='thread',
                         choices=['thread', 'process', 'dummy'])
     parser.add_argument('--workers-count', type=int, default=10)
+    parser.add_argument('--loaders-count', type=int, default=1,
+                        help='concurrent readers; aggregate rows/sec reported')
+    parser.add_argument('--spawn-new-process', action='store_true',
+                        help='measure in a freshly exec\'d interpreter')
     args = parser.parse_args(argv)
     result = reader_throughput(args.dataset_url, field_regex=args.field_regex,
                                warmup_rows=args.warmup_rows,
                                measure_rows=args.measure_rows,
                                pool_type=args.pool_type,
-                               workers_count=args.workers_count)
+                               workers_count=args.workers_count,
+                               loaders_count=args.loaders_count,
+                               spawn_new_process=args.spawn_new_process)
     print('%.1f rows/sec (%d rows in %.2fs after %d warmup rows)'
           % (result.rows_per_second, result.rows_read, result.duration_s,
              result.warmup_rows))

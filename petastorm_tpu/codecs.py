@@ -131,8 +131,10 @@ class ScalarCodec(DataframeColumnCodec):
 
     def __setstate__(self, state):
         # Accept pickles written by the reference implementation, whose
-        # ScalarCodec state is {'_spark_type': <pyspark sql type>} (requires
-        # pyspark importable to have unpickled at all).
+        # ScalarCodec state is {'_spark_type': <pyspark sql type>}.  Without
+        # pyspark installed the type arrives as an _pyspark_stub instance
+        # (etl.dataset_metadata._CompatUnpickler), which _normalize duck-types
+        # the same way — real petastorm footers open on bare TPU-VM images.
         if '_arrow_type' not in state and '_spark_type' in state:
             state = {'_arrow_type': self._normalize(state['_spark_type'])}
         self.__dict__.update(state)
@@ -141,7 +143,9 @@ class ScalarCodec(DataframeColumnCodec):
     def _normalize(storage_type):
         if isinstance(storage_type, pa.DataType):
             return storage_type
-        # Spark SQL type instance (duck-typed so pyspark stays optional)?
+        # Spark SQL type instance (duck-typed so pyspark stays optional —
+        # covers both real pyspark classes and the unpickle-time stubs from
+        # etl.dataset_metadata._pyspark_stub)?
         type_name = type(storage_type).__name__
         _SPARK_TO_ARROW = {
             'BooleanType': pa.bool_(),
@@ -152,9 +156,17 @@ class ScalarCodec(DataframeColumnCodec):
             'FloatType': pa.float32(),
             'DoubleType': pa.float64(),
             'StringType': pa.string(),
+            'BinaryType': pa.binary(),
+            'DateType': pa.date32(),
+            'TimestampType': pa.timestamp('ns'),
         }
-        if type_name in _SPARK_TO_ARROW and hasattr(storage_type, 'typeName'):
-            return _SPARK_TO_ARROW[type_name]
+        if hasattr(storage_type, 'typeName'):
+            if type_name in _SPARK_TO_ARROW:
+                return _SPARK_TO_ARROW[type_name]
+            if type_name == 'DecimalType':
+                # Instance state carries precision/scale (spark defaults 10/0).
+                return pa.decimal128(getattr(storage_type, 'precision', 10),
+                                     getattr(storage_type, 'scale', 0))
         # numpy dtype or anything np.dtype() accepts
         return _arrow_type_for_numpy(storage_type)
 

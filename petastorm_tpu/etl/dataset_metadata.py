@@ -64,12 +64,48 @@ _MODULE_RENAMES = {
     'petastorm.codecs': 'petastorm_tpu.codecs',
 }
 
+_pyspark_stub_cache = {}
+
+
+def _pyspark_stub(module, name):
+    """A lightweight stand-in for a pyspark class referenced by a reference
+    pickle (``ScalarCodec._spark_type`` holds DataType instances).
+
+    Real petastorm footers are written on Spark clusters, but TPU-VM images
+    ship no pyspark — without this, such datasets cannot even unpickle.  The
+    stub only needs to (a) instantiate under any pickle protocol, (b) accept
+    BUILD state, and (c) duck-type ``typeName`` with the pyspark class name,
+    which is exactly what ``ScalarCodec.__setstate__`` -> ``_normalize``
+    consumes to recover the arrow storage type.
+    """
+    key = (module, name)
+    if key not in _pyspark_stub_cache:
+        @classmethod
+        def type_name(cls):
+            return cls.__name__[:-4].lower() if cls.__name__.endswith('Type') \
+                else cls.__name__.lower()
+
+        _pyspark_stub_cache[key] = type(name, (object,), {
+            '__module__': module,
+            '__init__': lambda self, *a, **kw: None,
+            'typeName': type_name,
+            '__repr__': lambda self: '%s()' % type(self).__name__,
+        })
+    return _pyspark_stub_cache[key]
+
 
 class _CompatUnpickler(pickle.Unpickler):
     """Unpickles Unischemas written by the reference implementation by
-    remapping its module paths onto ours."""
+    remapping its module paths onto ours, and satisfying pyspark lookups with
+    stub classes when pyspark is not installed (SURVEY.md §7 footer-compat
+    risk; reference ``petastorm/codecs.py :: ScalarCodec.spark_dtype``)."""
 
     def find_class(self, module, name):
+        if module == 'pyspark.sql.types' or module.startswith('pyspark.sql.types.'):
+            try:
+                return super().find_class(module, name)
+            except (ImportError, AttributeError):
+                return _pyspark_stub(module, name)
         return super().find_class(_MODULE_RENAMES.get(module, module), name)
 
 

@@ -78,8 +78,8 @@ class DataLoader(object):
         #: user hook, 'device_put_s' the H2D *dispatch* (the DMA itself is
         #: async and overlaps).  Pair with StallMonitor for the consumer
         #: view and reader.diagnostics['decode_utilization'] for the
-        #: worker-pool view (thread/dummy pools; the ZeroMQ process pool
-        #: decodes out-of-process and does not report it).
+        #: worker-pool view (all three pools; the ZeroMQ pool ships child
+        #: busy time back on each ack).
         self.stats = {'host_batch_s': 0.0, 'transform_s': 0.0,
                       'device_put_s': 0.0, 'batches': 0}
 
@@ -341,20 +341,27 @@ class InMemDataLoader(DataLoader):
         self._shuffle = shuffle
         self._cache = None
 
-    def _host_batches(self):
+    def _build_cache(self):
+        """One-time read of the whole dataset into ``self._cache`` (a dict
+        pytree of (N, ...) host arrays); returns it, or None when empty."""
         if self._cache is None:
-            # The cache must hold EVERY row: drop_last applies per epoch (the
-            # per-epoch loop below), not to the one-time read — otherwise a
-            # ragged tail would be excluded from all epochs permanently.
+            # The cache must hold EVERY row: drop_last applies per epoch, not
+            # to the one-time read — otherwise a ragged tail would be
+            # excluded from all epochs permanently.
             drop_last, self._drop_last = self._drop_last, False
             try:
                 parts = list(super(InMemDataLoader, self)._host_batches())
             finally:
                 self._drop_last = drop_last
             if not parts:
-                return
+                return None
             self._cache = jax.tree_util.tree_map(
                 lambda *xs: np.concatenate(xs), *parts)
+        return self._cache
+
+    def _host_batches(self):
+        if self._build_cache() is None:
+            return
         n = len(next(iter(jax.tree_util.tree_leaves(self._cache))))
         rng = np.random.default_rng(self._seed)
         epoch = 0
@@ -365,6 +372,84 @@ class InMemDataLoader(DataLoader):
                 idx = order[start:start + self.batch_size]
                 yield jax.tree_util.tree_map(lambda v: v[idx], self._cache)
             epoch += 1
+
+
+class DeviceInMemDataLoader(InMemDataLoader):
+    """Epoch cache in **device HBM**: decode the dataset once, then serve
+    every subsequent batch with an on-device gather — zero host work per
+    step after epoch 0.
+
+    The TPU-native sibling of :class:`InMemDataLoader` (which caches in host
+    RAM and still pays slice + H2D per batch).  When the decoded dataset fits
+    in HBM (MNIST/CIFAR-scale, or a per-host ImageNet shard at low
+    resolution), this is the idiomatic XLA pattern: the per-epoch shuffle is
+    a device-side permutation (``jax.random.permutation``) and each batch is
+    ``jnp.take`` over the resident arrays, so a fast chip is never throttled
+    by host decode or PCIe/tunnel latency.
+
+    Single-placement only: the cache lives on ``device`` (default: first
+    local device).  Multi-host training wants per-host shards anyway — build
+    the reader with ``cur_shard``/``shard_count`` (or rely on JAX auto-shard)
+    and each host caches only its shard.
+    """
+
+    def __init__(self, reader, batch_size, num_epochs=1, shuffle=True,
+                 seed=None, device=None, **kwargs):
+        for unsupported in ('transform_fn', 'shuffling_queue_capacity'):
+            if kwargs.get(unsupported):
+                # Batches never exist on the host here, so the host-side
+                # hooks cannot run — reject rather than silently drop them.
+                # Transform inside the jitted step instead (the TPU-native
+                # place for normalization/augmentation).
+                raise ValueError('DeviceInMemDataLoader does not support %s'
+                                 % unsupported)
+        super(DeviceInMemDataLoader, self).__init__(
+            reader, batch_size, num_epochs=num_epochs, shuffle=shuffle,
+            seed=seed, device=device, **kwargs)
+        if self._sharding is not None:
+            raise ValueError('DeviceInMemDataLoader caches on one device; '
+                             'use InMemDataLoader with sharding= for global '
+                             'batch assembly')
+        self._dev_cache = None
+
+    def __iter__(self):
+        import jax.numpy as jnp
+
+        if self._dev_cache is None:
+            # Build the host cache via the parent's one-time read, then move
+            # it to HBM wholesale (one transfer for the whole dataset).
+            if self._build_cache() is None:
+                return iter(())
+            numeric = _filter_numeric(self._cache, self._warned_fields)
+            place = (lambda x: jax.device_put(x, self._device)) \
+                if self._device is not None else jax.device_put
+            self._dev_cache = jax.tree_util.tree_map(place, numeric)
+            # The host copy is never read again — release dataset-sized RAM.
+            self._cache = None
+        cache = self._dev_cache
+        n = len(next(iter(jax.tree_util.tree_leaves(cache))))
+
+        def gen():
+            # Same seed semantics as the host-RAM sibling: an explicit seed
+            # reproduces, seed=None draws fresh entropy per loader.
+            seed = self._seed if self._seed is not None \
+                else int(np.random.default_rng().integers(2 ** 31))
+            key = jax.random.PRNGKey(seed)
+            epoch = 0
+            while self._num_epochs is None or epoch < self._num_epochs:
+                if self._shuffle:
+                    key, sub = jax.random.split(key)
+                    order = jax.random.permutation(sub, n)
+                else:
+                    order = jnp.arange(n)
+                stop = n - self.batch_size + 1 if self._drop_last else n
+                for start in range(0, max(stop, 0), self.batch_size):
+                    idx = order[start:start + self.batch_size]
+                    yield jax.tree_util.tree_map(
+                        lambda v: jnp.take(v, idx, axis=0), cache)
+                    self.stats['batches'] += 1
+                epoch += 1
+        return gen()
 
 
 def make_jax_loader(dataset_url, batch_size, batched=True, loader_kwargs=None, **reader_kwargs):

@@ -25,6 +25,26 @@ _SO = os.path.join(_HERE, 'libpt_decode.so')
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_force_disabled = False
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def disabled():
+    """Force the pure-python/cv2 fallback paths while the context is active.
+
+    Unlike ``PETASTORM_TPU_NO_NATIVE`` (checked once, at first load), this
+    works after the library has already been loaded — benchmarks use it to
+    run an honest no-native baseline leg in the same process."""
+    global _force_disabled
+    prev = _force_disabled
+    _force_disabled = True
+    try:
+        yield
+    finally:
+        _force_disabled = prev
 
 
 def _build():
@@ -65,6 +85,8 @@ def _load():
 def get_lib():
     """The loaded native library, or None if unavailable/disabled."""
     global _lib, _tried
+    if _force_disabled:
+        return None
     if _tried:
         return _lib
     with _lock:

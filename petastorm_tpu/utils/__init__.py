@@ -3,13 +3,63 @@
 Parity: reference ``petastorm/utils.py :: decode_row, run_in_subprocess``.
 """
 
+import logging
+import os
 import pickle
 import subprocess
 import sys
 
 from petastorm_tpu.errors import DecodeFieldError
 
-__all__ = ['decode_row', 'run_in_subprocess']
+__all__ = ['decode_row', 'run_in_subprocess', 'ensure_jax_backend',
+           'apply_jax_platforms_env']
+
+logger = logging.getLogger(__name__)
+
+
+def apply_jax_platforms_env():
+    """Honor an explicit ``JAX_PLATFORMS`` env var via ``jax.config``.
+
+    On some hosts a ``sitecustomize`` hook registers an accelerator plugin at
+    interpreter start and the env var alone is ignored; applying it through
+    the config restores the caller's intent.  No-op once a backend is
+    initialized (the choice is already locked in) or when the var is unset.
+    """
+    import jax
+    if not os.environ.get('JAX_PLATFORMS'):
+        return
+    try:
+        from jax._src import xla_bridge
+        if getattr(xla_bridge, '_backends', None):
+            return  # already initialized: too late, and nothing to fix
+    except ImportError:
+        pass
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+
+def ensure_jax_backend(fallback='cpu'):
+    """Make JAX usable on this host; returns ``jax.devices()``.
+
+    Honors an explicit ``JAX_PLATFORMS`` env var via ``jax.config`` (on some
+    hosts a ``sitecustomize`` hook registers an accelerator plugin at
+    interpreter start and the env var alone is ignored), then probes the
+    backend; if initialization fails (e.g. a TPU plugin is registered but no
+    device is reachable), falls back to ``fallback`` so library examples and
+    host-side tooling run on any machine.
+
+    Call this BEFORE any other JAX use but AFTER ``jax.distributed``
+    initialization if you use one — probing initializes the backend.
+    No reference equivalent (torch device selection is implicit there).
+    """
+    import jax
+    apply_jax_platforms_env()
+    try:
+        return jax.devices()
+    except RuntimeError as e:
+        logger.warning('JAX backend unavailable (%s); falling back to %r',
+                       e, fallback)
+        jax.config.update('jax_platforms', fallback)
+        return jax.devices()
 
 
 def decode_row(row, schema):

@@ -36,6 +36,12 @@ class ProcessPool(object):
         self._ventilator = None
         self._inflight = 0
         self.items_processed = 0
+        #: Summed child-side seconds inside worker.process (net of retry
+        #: sleeps), shipped back on each ack — diagnostics parity with the
+        #: in-process pools.
+        self.busy_time = 0.0
+        self._started_at = None
+        self._stopped_at = None
         self._stopped = False
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
@@ -72,6 +78,8 @@ class ProcessPool(object):
         for worker_id in range(self.workers_count):
             self._processes.append(exec_in_new_process(worker_main, setup_payload, worker_id))
 
+        import time
+        self._started_at = time.monotonic()
         self._ventilator = ventilator
         if ventilator is not None:
             ventilator.start()
@@ -98,9 +106,10 @@ class ProcessPool(object):
                 if tag == b'A':
                     return self._arrow_ser.deserialize(payload)
                 if tag == b'K':
-                    position = pickle.loads(payload)
+                    position, busy_s = pickle.loads(payload)
                     self._inflight -= 1
                     self.items_processed += 1
+                    self.busy_time += busy_s
                     if self._ventilator is not None:
                         self._ventilator.processed_item(position)
                     continue
@@ -133,6 +142,9 @@ class ProcessPool(object):
     def stop(self):
         if self._stopped:
             return
+        import time
+        if self._stopped_at is None:
+            self._stopped_at = time.monotonic()
         self._stopped = True
         if self._ventilator is not None:
             self._ventilator.stop()
@@ -155,10 +167,19 @@ class ProcessPool(object):
 
     @property
     def diagnostics(self):
+        import time
+        end = self._stopped_at if self._stopped_at is not None else time.monotonic()
+        wall = (end - self._started_at) if self._started_at else 0.0
         return {
             'pool': 'process',
             'workers_count': self.workers_count,
             'items_processed': self.items_processed,
             'inflight': self._inflight,
             'workers_alive': sum(p.poll() is None for p in self._processes),
+            'decode_busy_s': round(self.busy_time, 4),
+            # Child-side decode fraction of total worker-process wall time —
+            # same interpretation as the thread pool's number (low values
+            # additionally include child startup, which threads don't pay).
+            'decode_utilization': round(
+                self.busy_time / (wall * self.workers_count), 4) if wall else 0.0,
         }

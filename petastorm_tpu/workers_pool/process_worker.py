@@ -43,6 +43,8 @@ def worker_main(setup_payload, worker_id):
             sink_socket.send_multipart([b'R', pickle_ser.serialize(result)],
                                        copy=copy_buffers)
 
+    import time
+
     worker = worker_class(worker_id, publish, worker_args)
     try:
         while True:
@@ -50,13 +52,20 @@ def worker_main(setup_payload, worker_id):
             if frames[-1] == b'STOP':
                 break
             position, args, kwargs = pickle.loads(frames[0])
+            started = time.monotonic()
+            sleep_before = getattr(worker, 'retry_sleep_s', 0.0)
             try:
                 worker.process(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 — shipped to the parent
                 sink_socket.send_multipart(
                     [b'E', pickle.dumps((e, traceback.format_exc()))])
             finally:
-                sink_socket.send_multipart([b'K', pickle.dumps(position)])
+                # Ack carries this item's decode time (minus retry-backoff
+                # sleeps) so the parent pool can report decode_utilization
+                # like the in-process pools do.
+                slept = getattr(worker, 'retry_sleep_s', 0.0) - sleep_before
+                busy = max(0.0, time.monotonic() - started - slept)
+                sink_socket.send_multipart([b'K', pickle.dumps((position, busy))])
     finally:
         worker.shutdown()
         work_socket.close(0)

@@ -16,7 +16,11 @@ from petastorm_tpu.transform import TransformSpec
 
 from test_common import TestSchema, assert_rows_equal, create_test_dataset
 
-ALL_POOLS = ['thread', 'dummy']
+# The full matrix runs all three pools (reference test strategy, SURVEY §4).
+# ProcessPool spawns real child interpreters — keep workers_count small.
+ALL_POOLS = ['thread', 'dummy', 'process']
+
+MATRIX_WORKERS = {'thread': 4, 'dummy': 1, 'process': 2}
 
 
 @pytest.fixture(scope='module')
@@ -32,7 +36,8 @@ def _read_all(reader):
 
 @pytest.mark.parametrize('pool', ALL_POOLS)
 def test_full_read_matches_ground_truth(dataset, pool):
-    rows = _read_all(make_reader(dataset.url, reader_pool_type=pool, workers_count=4))
+    rows = _read_all(make_reader(dataset.url, reader_pool_type=pool,
+                                 workers_count=MATRIX_WORKERS[pool]))
     assert len(rows) == 30
     assert_rows_equal(rows, dataset.data)
 
@@ -57,6 +62,7 @@ def test_no_shuffle_is_file_order(dataset):
 @pytest.mark.parametrize('pool', ALL_POOLS)
 def test_schema_view_subset(dataset, pool):
     with make_reader(dataset.url, schema_fields=['id', 'matrix'],
+                     workers_count=MATRIX_WORKERS[pool],
                      reader_pool_type=pool) as reader:
         rows = list(reader)
     assert set(rows[0]._fields) == {'id', 'matrix'}
@@ -68,6 +74,7 @@ def test_schema_view_subset(dataset, pool):
 @pytest.mark.parametrize('pool', ALL_POOLS)
 def test_predicate_pushdown(dataset, pool):
     with make_reader(dataset.url, predicate=in_set({1, 2}, 'id2'),
+                     workers_count=MATRIX_WORKERS[pool],
                      reader_pool_type=pool) as reader:
         rows = list(reader)
     expected = [r for r in dataset.data if r['id2'] in {1, 2}]
@@ -113,6 +120,7 @@ def test_sharding_disjoint_and_complete(dataset, pool):
     seen = []
     for shard in range(3):
         with make_reader(dataset.url, cur_shard=shard, shard_count=3,
+                         workers_count=MATRIX_WORKERS[pool],
                          reader_pool_type=pool) as reader:
             seen.append({int(r.id) for r in reader})
     assert seen[0] | seen[1] | seen[2] == set(range(30))
@@ -140,6 +148,45 @@ def test_epoch_shuffles_differ(dataset):
     first, second = rows[:30], rows[30:]
     assert {r['id'] for r in first} == {r['id'] for r in second}
     assert [r['id'] for r in first] != [r['id'] for r in second]
+
+
+def _scale_matrix(row):
+    """Module-level (picklable) transform for the ProcessPool matrix leg —
+    closures can't cross the fresh-exec boundary, same constraint as the
+    reference's ZeroMQ pool."""
+    row = dict(row)
+    row['matrix'] = row['matrix'] * 3
+    return row
+
+
+def test_process_pool_full_feature_combination(dataset):
+    """ProcessPool with predicates + transform + schema view + epochs +
+    shuffle stacked together — the features the round-1 matrix never ran
+    through the ZeroMQ pool."""
+    spec = TransformSpec(_scale_matrix)
+    with make_reader(dataset.url, reader_pool_type='process', workers_count=2,
+                     schema_fields=['id', 'id2', 'matrix'],
+                     predicate=in_set({0, 1}, 'id2'), transform_spec=spec,
+                     num_epochs=2, shuffle_row_groups=True, seed=5) as reader:
+        rows = [r._asdict() for r in reader]
+    expected = {r['id']: r['matrix'] * 3 for r in dataset.data if r['id2'] in {0, 1}}
+    assert len(rows) == 2 * len(expected)
+    from collections import Counter
+    counts = Counter(int(r['id']) for r in rows)
+    assert set(counts) == set(expected) and set(counts.values()) == {2}
+    for row in rows:
+        np.testing.assert_array_equal(row['matrix'], expected[int(row['id'])])
+
+
+def test_process_pool_reports_decode_utilization(dataset):
+    """Diagnostics parity across pools: the ZeroMQ pool ships child busy
+    time back on each ack."""
+    with make_reader(dataset.url, reader_pool_type='process',
+                     workers_count=2) as reader:
+        list(reader)
+        d = reader.diagnostics
+    assert d['decode_busy_s'] > 0.0
+    assert 0.0 < d['decode_utilization'] <= 1.0
 
 
 def test_transform_spec_row_path(dataset):
@@ -266,7 +313,9 @@ def test_auto_shard_from_jax_process_topology(dataset, monkeypatch):
 
 
 def test_auto_shard_uses_real_jax_api(monkeypatch):
-    """The default-shard hook reads jax.process_index/process_count."""
+    """The default-shard hook always probes jax.process_index/process_count —
+    on TPU pods the topology comes from the runtime with no explicit
+    jax.distributed.initialize, so the probe must never be skipped."""
     import petastorm_tpu.reader as reader_mod
     import jax
     monkeypatch.setattr(jax, 'process_count', lambda: 4)

@@ -250,6 +250,44 @@ def test_inmem_loader_caches_ragged_tail(tmp_path):
     assert total == 70
 
 
+def test_device_inmem_loader_epochs_and_reshuffle(dataset):
+    """DeviceInMemDataLoader: HBM-resident epoch cache, on-device gather per
+    batch, per-epoch device-side reshuffle — zero host work after epoch 0."""
+    import jax
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+    with make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        loader = DeviceInMemDataLoader(reader, batch_size=16, num_epochs=3, seed=7)
+        epochs = [[] for _ in range(3)]
+        for i, batch in enumerate(loader):
+            assert isinstance(batch['id'], jax.Array)  # device-resident
+            epochs[i // 4].append(np.asarray(batch['id']))
+    flat = [sorted(np.concatenate(e).tolist()) for e in epochs]
+    assert flat[0] == flat[1] == flat[2] == list(range(64))  # each epoch complete
+    assert not all((epochs[0][j] == epochs[1][j]).all() for j in range(4))  # reshuffled
+
+
+def test_device_inmem_loader_no_shuffle_matches_source_order(dataset):
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+    with make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        loader = DeviceInMemDataLoader(reader, batch_size=16, num_epochs=1,
+                                       shuffle=False)
+        got = np.concatenate([np.asarray(b['id']) for b in loader])
+    np.testing.assert_array_equal(got, np.arange(64))
+
+
+def test_device_inmem_loader_rejects_sharding(dataset):
+    from jax.sharding import NamedSharding, PartitionSpec
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+    from petastorm_tpu.parallel import make_mesh
+    mesh = make_mesh()
+    sharding = NamedSharding(mesh, PartitionSpec('data'))
+    with make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1) as reader:
+        with pytest.raises(ValueError, match='sharding'):
+            DeviceInMemDataLoader(reader, batch_size=16, sharding=sharding)
+
+
 def test_num_local_rows_and_epoch_steps(dataset):
     """Uneven-shard guard: row counts from footers (fast-metadata pieces
     carry -1 and are lazily scanned) -> per-host step budget."""

@@ -1,25 +1,144 @@
 """On-disk compatibility with reference-written datasets.
 
 The reference pickles its ``Unischema`` under the module paths
-``petastorm.unischema`` / ``petastorm.codecs``; our footer reader remaps
-them through ``_CompatUnpickler`` so real petastorm datasets open
-unmodified (SURVEY.md §7 risk: footer-metadata compatibility).
+``petastorm.unischema`` / ``petastorm.codecs``, with ``ScalarCodec`` holding
+**pyspark sql DataType instances** (``petastorm/codecs.py ::
+ScalarCodec.spark_dtype``).  Our footer reader remaps the module paths and
+satisfies the pyspark lookups with stub classes, so real petastorm datasets
+open unmodified on TPU-VM images that ship no pyspark (SURVEY.md §7 risk:
+footer-metadata compatibility).
 
-A reference footer is fabricated here by re-pickling our schema at
-protocol 0 (module names are stored as length-free text) and rewriting
-``petastorm_tpu.`` → ``petastorm.`` — byte-exact to what the reference's
-``materialize_dataset`` would emit for an equivalent schema.
+Two layers of evidence:
+
+* a **frozen byte-exact fixture** (``tests/data/reference_unischema_footer
+  .b64``, generated once by ``tests/data/gen_reference_footer_fixture.py``
+  from independently synthesized reference-layout classes — NOT from
+  petastorm_tpu classes) unpickles into a working schema with pyspark absent;
+* a protocol-0 module-rename check (the original round-1 test) still guards
+  the rename table itself.
 """
 
+import base64
+import os
 import pickle
+from decimal import Decimal
 
 import numpy as np
+import pyarrow as pa
 import pyarrow.parquet as pq
+import pytest
 
 from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
 from petastorm_tpu.etl import dataset_metadata as dm
+from petastorm_tpu.unischema import Unischema
 from tests.test_common import assert_rows_equal, create_test_dataset
 
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       'data', 'reference_unischema_footer.b64')
+
+
+def _fixture_bytes():
+    with open(FIXTURE) as f:
+        return base64.b64decode(f.read())
+
+
+def test_pyspark_is_really_absent():
+    """The whole point: these tests prove footer compat WITHOUT pyspark."""
+    with pytest.raises(ImportError):
+        import pyspark  # noqa: F401
+
+
+def test_frozen_reference_footer_unpickles_without_pyspark():
+    blob = _fixture_bytes()
+    assert b'petastorm_tpu' not in blob  # genuinely foreign bytes
+    assert b'pyspark' in blob
+
+    schema = dm._loads_schema(blob)
+    assert isinstance(schema, Unischema)
+    assert schema.name == 'RefSchema'
+    assert sorted(schema.fields) == ['id', 'image', 'label', 'matrix',
+                                     'price', 'sparse']
+
+    # ScalarCodec spark types recovered through the stub layer:
+    assert isinstance(schema.fields['id'].codec, ScalarCodec)
+    assert schema.fields['id'].codec.arrow_dtype() == pa.int32()
+    assert schema.fields['label'].codec.arrow_dtype() == pa.string()
+    assert schema.fields['price'].codec.arrow_dtype() == pa.decimal128(10, 2)
+    # Binary codecs map onto ours with their exact state:
+    assert isinstance(schema.fields['matrix'].codec, NdarrayCodec)
+    assert isinstance(schema.fields['sparse'].codec, CompressedNdarrayCodec)
+    image_codec = schema.fields['image'].codec
+    assert isinstance(image_codec, CompressedImageCodec)
+    assert image_codec.image_codec == 'png' and image_codec.quality == 80
+    # Field tuples carry the reference layout verbatim:
+    assert schema.fields['matrix'].shape == (4, 3)
+    assert schema.fields['label'].nullable is True
+
+
+def test_end_to_end_read_over_reference_footer(tmp_path):
+    """Write cells in the (shared) on-disk format, then splice the frozen
+    reference footer in — the reader must decode rows with no petastorm_tpu
+    schema anywhere on disk."""
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+
+    schema = dm._loads_schema(_fixture_bytes())
+    rng = np.random.default_rng(7)
+    rows = []
+    for i in range(12):
+        rows.append({
+            'id': np.int32(i),
+            'label': 'item-%d' % i if i % 3 else None,
+            'price': Decimal('%d.%02d' % (i, i)),
+            'matrix': rng.standard_normal((4, 3)).astype(np.float32),
+            'sparse': rng.standard_normal(8).astype(np.float64),
+            'image': rng.integers(0, 255, (6, 5, 3), dtype=np.uint8),
+        })
+    url = 'file://' + str(tmp_path / 'refds')
+    with DatasetWriter(url, schema, rows_per_rowgroup=4) as w:
+        for row in rows:
+            w.write(row)
+
+    # Replace the footer blob with the EXACT frozen reference bytes.
+    meta_path = str(tmp_path / 'refds') + '/_common_metadata'
+    arrow_schema = pq.read_schema(meta_path)
+    metadata = dict(arrow_schema.metadata)
+    metadata[dm.UNISCHEMA_KEY] = _fixture_bytes()
+    pq.write_metadata(arrow_schema.with_metadata(metadata), meta_path)
+
+    with make_reader(url, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        got = sorted([r._asdict() for r in reader], key=lambda r: int(r['id']))
+    assert len(got) == 12
+    for want, have in zip(rows, got):
+        assert int(have['id']) == int(want['id'])
+        assert have['label'] == want['label']
+        assert Decimal(have['price']) == want['price']
+        np.testing.assert_array_equal(have['matrix'], want['matrix'])
+        np.testing.assert_array_equal(have['sparse'], want['sparse'])
+        np.testing.assert_array_equal(have['image'], want['image'])
+
+
+def test_fixture_matches_generator():
+    """The frozen bytes stay reproducible from the committed generator (run
+    in a subprocess so its sys.modules fakery cannot leak into this one)."""
+    import subprocess
+    import sys
+    gen = os.path.join(os.path.dirname(FIXTURE), 'gen_reference_footer_fixture.py')
+    code = (
+        'import importlib.util, base64, sys\n'
+        'spec = importlib.util.spec_from_file_location("gen", %r)\n'
+        'mod = importlib.util.module_from_spec(spec)\n'
+        'spec.loader.exec_module(mod)\n'
+        'sys.stdout.write(base64.b64encode(mod.build_fixture_bytes()).decode())\n'
+    ) % gen
+    out = subprocess.run([sys.executable, '-c', code], capture_output=True,
+                         text=True, check=True)
+    assert out.stdout.strip() == open(FIXTURE).read().strip()
+
+
+# -- round-1 rename-table guard (protocol 0) ---------------------------------
 
 def _doctor_footer_to_reference_modules(path):
     """Rewrite _common_metadata so the pickled schema claims petastorm.*"""
@@ -53,9 +172,17 @@ def test_reads_reference_pickled_unischema(tmp_path):
 
 
 def test_unknown_modules_still_fail_loudly(tmp_path):
-    """The shim remaps only known petastorm modules — arbitrary pickles
-    still raise (no silent wrong-class resolution)."""
-    import pytest
+    """The shim remaps only known petastorm/pyspark modules — arbitrary
+    pickles still raise (no silent wrong-class resolution)."""
     blob = pickle.dumps(np.float64(1.0), protocol=0).replace(b'numpy', b'nonexistent_mod')
+    with pytest.raises(Exception):
+        dm._loads_schema(blob)
+
+
+def test_stub_layer_scoped_to_pyspark_sql_types():
+    """Only pyspark.sql.types lookups get stubbed; other pyspark modules
+    (if referenced) still raise rather than resolving to a fake."""
+    blob = pickle.dumps(np.float64(1.0), protocol=0).replace(
+        b'numpy', b'pyspark.rdd')
     with pytest.raises(Exception):
         dm._loads_schema(blob)

@@ -79,6 +79,34 @@ def test_reader_throughput_harness(dataset):
     assert result.rows_per_second > 0
 
 
+def test_reader_throughput_multiple_loaders(dataset):
+    """loaders_count=N runs N concurrent readers and aggregates rows."""
+    result = reader_throughput(dataset.url, warmup_rows=2, measure_rows=10,
+                               pool_type='dummy', loaders_count=3)
+    assert result.rows_read == 30
+    assert result.rows_per_second > 0
+
+
+def test_reader_throughput_spawn_new_process(dataset):
+    """spawn_new_process runs the measurement in a fresh interpreter."""
+    result = reader_throughput(dataset.url, warmup_rows=2, measure_rows=8,
+                               pool_type='dummy', spawn_new_process=True)
+    assert result.rows_read == 8
+    assert result.rows_per_second > 0
+
+
+def test_reader_throughput_rejects_unknown_read_method(dataset):
+    """Silently ignored knobs are how benchmarks lie — unknown values raise."""
+    with pytest.raises(NotImplementedError, match='read_method'):
+        reader_throughput(dataset.url, read_method='batch')
+
+
+def test_reader_throughput_spawn_rejects_unserializable(dataset):
+    with pytest.raises(NotImplementedError, match='JSON-serializable'):
+        reader_throughput(dataset.url, spawn_new_process=True,
+                          predicate=lambda row: True)
+
+
 def test_stall_monitor_attribution():
     import time
     monitor = StallMonitor(warmup_steps=0)
