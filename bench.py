@@ -196,7 +196,10 @@ def train_stall_legs():
 
     state = _make_resnet_step()
 
-    epochs = max(1, -(-(TRAIN_STEPS + 4) * BATCH // NUM_IMAGES))
+    # Size by FULL batches per epoch (drop_last): epochs of ragged-tail rows
+    # never become steps, so dividing by row count would undershoot.
+    batches_per_epoch = max(1, NUM_IMAGES // BATCH)
+    epochs = -(-(TRAIN_STEPS + 4) // batches_per_epoch)
     with make_reader(DATASET_URL, num_epochs=epochs, workers_count=WORKERS,
                      shuffle_row_groups=False, columnar_decode=True) as reader:
         loader = DataLoader(reader, batch_size=BATCH, prefetch=2)

@@ -349,13 +349,15 @@ class CompressedImageCodec(DataframeColumnCodec):
         return np.ascontiguousarray(arr.astype(unischema_field.numpy_dtype, copy=False))
 
     def decode_batch_into(self, unischema_field, cells, dst):
-        """Whole-column native JPEG decode (C++ libjpeg straight to RGB in the
-        batch array: no BGR intermediate, no per-image python).  False ->
-        caller uses the per-cell path."""
-        if self._image_codec not in ('.jpg', '.jpeg'):
-            return False
+        """Whole-column native image decode (C++ libjpeg/libpng straight to
+        RGB/gray in the batch array: no BGR intermediate, no per-image
+        python).  False -> caller uses the per-cell cv2 path."""
         from petastorm_tpu import native
-        return native.jpeg_decode_batch(cells, dst)
+        if self._image_codec in ('.jpg', '.jpeg'):
+            return native.jpeg_decode_batch(cells, dst)
+        if self._image_codec == '.png':
+            return native.png_decode_batch(cells, dst)
+        return False
 
     def decode_into(self, unischema_field, value, dst):
         import cv2

@@ -53,7 +53,7 @@ def _build():
     # checkout) never dlopen a partially written ELF.
     tmp = '%s.%d.tmp' % (_SO, os.getpid())
     cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17',
-           '-o', tmp, _SRC, '-ljpeg', '-lz']
+           '-o', tmp, _SRC, '-ljpeg', '-lpng', '-lz']
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
@@ -71,6 +71,11 @@ def _load():
     lib = ctypes.CDLL(_SO)
     lib.pt_jpeg_decode_batch.restype = ctypes.c_int
     lib.pt_jpeg_decode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.pt_png_decode_batch.restype = ctypes.c_int
+    lib.pt_png_decode_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
         ctypes.c_int, ctypes.c_void_p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
@@ -136,6 +141,27 @@ def jpeg_decode_batch(cells, dst):
     ptrs, lens = _as_ptr_arrays(cells)
     rc = lib.pt_jpeg_decode_batch(ptrs, lens, len(cells),
                                   dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
+    return rc == 0
+
+
+def png_decode_batch(cells, dst):
+    """Decode list[bytes] 8-bit PNGs into a (N, H, W, 3)/(N, H, W[, 1]) uint8
+    array.  Same contract as :func:`jpeg_decode_batch`: True = whole batch
+    decoded natively; False = fall back (16-bit sources, channel mismatch,
+    and anything else the C side rejects)."""
+    lib = get_lib()
+    if lib is None or dst.dtype.kind != 'u' or dst.itemsize != 1 \
+            or not dst.flags['C_CONTIGUOUS']:
+        return False
+    if dst.ndim == 4 and dst.shape[3] in (1, 3):
+        h, w, c = dst.shape[1], dst.shape[2], dst.shape[3]
+    elif dst.ndim == 3:
+        h, w, c = dst.shape[1], dst.shape[2], 1
+    else:
+        return False
+    ptrs, lens = _as_ptr_arrays(cells)
+    rc = lib.pt_png_decode_batch(ptrs, lens, len(cells),
+                                 dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
     return rc == 0
 
 

@@ -32,6 +32,7 @@
 #include <new>
 
 #include <jpeglib.h>
+#include <png.h>
 #include <zlib.h>
 
 namespace {
@@ -104,6 +105,46 @@ int pt_jpeg_decode_batch(const uint8_t** srcs, const size_t* lens, int n,
     if (!decode_one(srcs[i], lens[i], dst + img_bytes * i,
                     static_cast<unsigned>(h), static_cast<unsigned>(w),
                     static_cast<unsigned>(c))) {
+      return i + 1;
+    }
+  }
+  return 0;
+}
+
+// Batch PNG -> grayscale/RGB decode via libpng's simplified API, straight
+// into the caller's (N, H, W, C) uint8 batch slice (the PNG sibling of
+// pt_jpeg_decode_batch; reference analog petastorm/codecs.py ::
+// CompressedImageCodec.decode via cv2.imdecode + BGR->RGB pass).
+// Rejections (caller falls back to cv2, keeping the two paths bit-identical):
+//   * 16-bit sources (the simplified API would rescale; cv2 preserves raw
+//     samples into uint16 — a different dtype entirely);
+//   * channel-count mismatch with the schema (gray vs color vs alpha) —
+//     libpng would happily convert, but the cv2 path errors, and the two
+//     paths must agree.
+int pt_png_decode_batch(const uint8_t** srcs, const size_t* lens, int n,
+                        uint8_t* dst, int h, int w, int c) {
+  const size_t img_bytes = static_cast<size_t>(h) * w * c;
+  for (int i = 0; i < n; ++i) {
+    png_image image;
+    std::memset(&image, 0, sizeof(image));
+    image.version = PNG_IMAGE_VERSION;
+    if (!png_image_begin_read_from_memory(&image, srcs[i], lens[i])) {
+      png_image_free(&image);
+      return i + 1;
+    }
+    const bool src_color = (image.format & PNG_FORMAT_FLAG_COLOR) != 0;
+    const bool src_alpha = (image.format & PNG_FORMAT_FLAG_ALPHA) != 0;
+    const bool src_16bit = (image.format & PNG_FORMAT_FLAG_LINEAR) != 0;
+    if (image.width != static_cast<png_uint_32>(w) ||
+        image.height != static_cast<png_uint_32>(h) || src_16bit ||
+        src_alpha || src_color != (c == 3)) {
+      png_image_free(&image);
+      return i + 1;
+    }
+    image.format = (c == 1) ? PNG_FORMAT_GRAY : PNG_FORMAT_RGB;
+    if (!png_image_finish_read(&image, nullptr, dst + img_bytes * i,
+                               static_cast<png_int_32>(w * c), nullptr)) {
+      png_image_free(&image);
       return i + 1;
     }
   }

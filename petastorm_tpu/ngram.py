@@ -6,8 +6,14 @@ offset -> field list for that timestep; the worker sorts a row group by
 windows whose consecutive timestamp gaps exceed ``delta_threshold``.
 Windows never span row-group boundaries (documented reference limitation,
 kept: it is what makes NGram embarrassingly parallel across row groups).
-``timestamp_overlap=False`` makes windows disjoint (stride = window length
-instead of 1).
+
+``timestamp_overlap=False`` uses the reference's **timestamp-range**
+interpretation: a stable window is emitted only when its first timestamp is
+strictly greater than the last emitted window's final timestamp, so emitted
+windows never overlap in time.  For strictly increasing timestamps this
+coincides with a stride of the window length; with duplicate timestamps it
+is stricter (a window starting AT the previous window's end time is still
+an overlap and is skipped) — see docs/migration.md.
 """
 
 import numbers
@@ -108,15 +114,19 @@ class NGram(object):
         rows = sorted(rows, key=lambda r: r[ts_name])
         length = self.length
         windows = []
-        i = 0
-        while i + length <= len(rows):
+        prev_end_ts = None
+        for i in range(len(rows) - length + 1):
             window = rows[i:i + length]
-            if self._window_is_stable(window, ts_name):
-                windows.append({offset: self._project(window[offset - self._min_offset], offset)
-                                for offset in self._fields})
-                i += length if not self._timestamp_overlap else 1
-            else:
-                i += 1
+            if not self._window_is_stable(window, ts_name):
+                continue
+            if (not self._timestamp_overlap and prev_end_ts is not None
+                    and window[0][ts_name] <= prev_end_ts):
+                # Timestamp ranges may not overlap: this window starts at or
+                # before the last emitted window's final timestamp.
+                continue
+            windows.append({offset: self._project(window[offset - self._min_offset], offset)
+                            for offset in self._fields})
+            prev_end_ts = window[-1][ts_name]
         return windows
 
     def _window_is_stable(self, window, ts_name):

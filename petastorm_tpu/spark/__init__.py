@@ -1,5 +1,5 @@
 """Spark integration (optional — pyspark is not installed on TPU-VM images)."""
 
 from petastorm_tpu.spark.spark_dataset_converter import (  # noqa: F401
-    SparkDatasetConverter, make_spark_converter,
+    SparkDatasetConverter, make_pandas_converter, make_spark_converter,
 )

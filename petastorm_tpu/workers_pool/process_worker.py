@@ -8,7 +8,9 @@ can refill.  Message framing (multipart):
   sink (worker->parent):  [tag, payload]
       tag b'R'  pickle-serialized result
       tag b'A'  arrow-IPC-serialized pyarrow.Table result
-      tag b'K'  ack: pickle(position or None)
+      tag b'K'  ack: pickle((position or None, busy_seconds)) — busy is the
+                worker.process wall time net of retry-backoff sleeps, feeding
+                the parent pool's decode_utilization
       tag b'E'  error: pickle((exception, traceback_str))
 """
 

@@ -1,0 +1,87 @@
+"""Non-local filesystem leg over fsspec's MemoryFileSystem (round-1 VERDICT
+item #9) — the sandbox stand-in for GCS (the north star materializes datasets
+to ``gs://`` for pod workers; gcsfs and MemoryFileSystem share the fsspec
+``AbstractFileSystem`` surface: ``open``/``find``/``exists``/``rm``/listing,
+no OS paths anywhere).
+
+Covers the three load-bearing flows: writer (DatasetWriter + footer
+metadata), reader (rows + columnar batches + sharding), and the pandas
+converter cache including its GC (delete) path.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from tests.test_common import assert_rows_equal, create_test_dataset
+
+
+@pytest.fixture()
+def mem_dataset():
+    import fsspec
+    url = 'memory://ds_reader'
+    ds = create_test_dataset(url, num_rows=20, rows_per_rowgroup=5)
+    yield ds
+    fsspec.filesystem('memory').rm('/ds_reader', recursive=True)
+
+
+def test_writer_produces_footer_metadata_in_memory_fs(mem_dataset):
+    import fsspec
+    fs = fsspec.filesystem('memory')
+    files = fs.find('/ds_reader')
+    assert any(f.endswith('_common_metadata') for f in files)
+    assert any(f.endswith('.parquet') for f in files)
+
+    from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+    schema = get_schema_from_dataset_url('memory://ds_reader')
+    assert 'id' in schema.fields
+
+
+def test_row_reader_over_memory_fs(mem_dataset):
+    with make_reader('memory://ds_reader', reader_pool_type='thread',
+                     workers_count=2, shuffle_row_groups=False) as reader:
+        rows = [r._asdict() for r in reader]
+    assert_rows_equal(rows, mem_dataset.data)
+
+
+def test_batch_reader_over_memory_fs(mem_dataset):
+    with make_batch_reader('memory://ds_reader', reader_pool_type='thread',
+                           workers_count=2, shuffle_row_groups=False) as reader:
+        total = sum(len(batch.id) for batch in reader)
+    assert total == 20
+
+
+def test_sharding_over_memory_fs(mem_dataset):
+    seen = set()
+    for shard in range(2):
+        with make_reader('memory://ds_reader', cur_shard=shard, shard_count=2,
+                         reader_pool_type='dummy') as reader:
+            ids = {int(r.id) for r in reader}
+        assert seen.isdisjoint(ids)
+        seen |= ids
+    assert seen == set(range(20))
+
+
+def test_pandas_converter_cache_and_gc_over_memory_fs():
+    import fsspec
+    import pandas as pd
+    from petastorm_tpu.spark import make_pandas_converter
+
+    fs = fsspec.filesystem('memory')
+    df = pd.DataFrame({'a': np.arange(10), 'b': np.arange(10) * 0.5})
+    conv = make_pandas_converter(df, parent_cache_dir_url='memory://conv_cache')
+    try:
+        # Materialized under the cache dir; a second conversion of the same
+        # frame dedups onto the same URL.
+        assert conv.cache_dir_url.startswith('memory://')
+        conv2 = make_pandas_converter(df, parent_cache_dir_url='memory://conv_cache')
+        assert conv2.cache_dir_url == conv.cache_dir_url
+
+        with make_batch_reader(conv.cache_dir_url, reader_pool_type='dummy') as reader:
+            total = sum(len(b.a) for b in reader)
+        assert total == 10
+    finally:
+        conv.delete()
+    # GC removed the materialized files.
+    leftover = [f for f in fs.find('/conv_cache')]
+    assert not leftover, leftover

@@ -71,10 +71,17 @@ def _run_hosts(url, shard_count, batch_size, budget):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for shard in range(shard_count)]
     results = []
-    for proc in procs:
-        out, err = proc.communicate(timeout=180)
-        assert proc.returncode == 0, 'host process failed:\n%s' % err[-4000:]
-        results.append(json.loads(out.strip().splitlines()[-1]))
+    try:
+        for proc in procs:
+            out, err = proc.communicate(timeout=180)
+            assert proc.returncode == 0, 'host process failed:\n%s' % err[-4000:]
+            results.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # One hung/failed child must not leak the siblings into the session.
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
     return sorted(results, key=lambda r: r['shard'])
 
 

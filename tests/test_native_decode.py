@@ -85,6 +85,57 @@ def test_jpeg_batch_rejects_wrong_dims():
 
 
 @requires_native
+def test_png_batch_matches_cv2_exactly():
+    """PNG is lossless: native libpng output must be BIT-identical to the
+    cv2 decode path for RGB and grayscale."""
+    rng = np.random.default_rng(3)
+    imgs = [rng.integers(0, 255, (16, 12, 3), dtype=np.uint8) for _ in range(6)]
+    cells = [cv2.imencode('.png', im[:, :, ::-1])[1].tobytes() for im in imgs]
+    dst = np.zeros((6, 16, 12, 3), np.uint8)
+    assert native.png_decode_batch(cells, dst)
+    for i, im in enumerate(imgs):
+        np.testing.assert_array_equal(dst[i], im)
+
+    gray = [rng.integers(0, 255, (9, 7), dtype=np.uint8) for _ in range(4)]
+    gcells = [cv2.imencode('.png', g)[1].tobytes() for g in gray]
+    gdst = np.zeros((4, 9, 7), np.uint8)
+    assert native.png_decode_batch(gcells, gdst)
+    for i, g in enumerate(gray):
+        np.testing.assert_array_equal(gdst[i], g)
+
+
+@requires_native
+def test_png_batch_rejects_mismatches():
+    """16-bit sources and channel mismatches fall back to cv2 (which
+    preserves uint16 samples / raises on shape divergence)."""
+    rng = np.random.default_rng(4)
+    g16 = rng.integers(0, 65535, (8, 9), dtype=np.uint16)
+    cell16 = [cv2.imencode('.png', g16)[1].tobytes()]
+    assert not native.png_decode_batch(cell16, np.zeros((1, 8, 9), np.uint8))
+
+    gray = rng.integers(0, 255, (8, 9), dtype=np.uint8)
+    gcell = [cv2.imencode('.png', gray)[1].tobytes()]
+    # gray source vs 3-channel schema -> reject
+    assert not native.png_decode_batch(gcell, np.zeros((1, 8, 9, 3), np.uint8))
+    # wrong spatial dims -> reject
+    assert not native.png_decode_batch(gcell, np.zeros((1, 4, 4), np.uint8))
+
+
+@requires_native
+def test_png_codec_batch_into_dispatch():
+    """CompressedImageCodec('png').decode_batch_into drives the native path;
+    a (H, W, 1)-shaped schema slice also round-trips."""
+    codec = CompressedImageCodec('png')
+    field = UnischemaField('image', np.uint8, (10, 11, 1), codec, False)
+    rng = np.random.default_rng(5)
+    gray = [rng.integers(0, 255, (10, 11), dtype=np.uint8) for _ in range(3)]
+    cells = [cv2.imencode('.png', g)[1].tobytes() for g in gray]
+    dst = np.zeros((3, 10, 11, 1), np.uint8)
+    assert codec.decode_batch_into(field, cells, dst)
+    for i, g in enumerate(gray):
+        np.testing.assert_array_equal(dst[i, :, :, 0], g)
+
+
 def test_zlib_npy_batch_roundtrip():
     field = UnischemaField('mat', np.float32, (5, 6),
                           CompressedNdarrayCodec(), False)

@@ -65,6 +65,55 @@ def test_timestamp_overlap_false_is_disjoint():
     assert [(w[0]['ts'], w[1]['ts']) for w in windows] == [(1, 2), (3, 4)]
 
 
+# -- golden tests: timestamp-RANGE overlap semantics --------------------------
+# Expected windows below are derived BY HAND from the rule (written down
+# before implementation, round-1 VERDICT item #7):
+#   * a window is `length` consecutive sorted rows;
+#   * stable = every consecutive gap <= delta_threshold;
+#   * with timestamp_overlap=False a stable window is emitted only when its
+#     first timestamp is STRICTLY greater than the final timestamp of the
+#     last emitted window (time ranges never overlap, not just row sets).
+
+def test_overlap_false_irregular_timestamps_golden():
+    # ts: 0 10 11 12 13 30, length 2, delta 5.
+    # Stable pairs: (10,11) (11,12) (12,13).  Emission: (10,11) -> prev=11;
+    # (11,12) starts at 11 <= 11 -> skip; (12,13) starts at 12 > 11 -> emit.
+    ng = _ngram(delta=5, overlap=False)
+    windows = ng.form_sequences(_rows([0, 10, 11, 12, 13, 30]), SensorSchema)
+    assert [(w[0]['ts'], w[1]['ts']) for w in windows] == [(10, 11), (12, 13)]
+
+
+def test_overlap_false_duplicate_timestamps_golden():
+    # ts: 0 1 1 2 3, length 2, no threshold.
+    # Sorted pairs by index: (0,1) (1,1) (1,2) (2,3).
+    # (0,1) emit, prev=1; (1,1) starts at 1 <= 1 -> time-range overlap, skip;
+    # (1,2) starts at 1 <= 1 -> skip; (2,3) starts at 2 > 1 -> emit.
+    # (A naive stride-of-length rule would emit (1,2) here instead — the
+    # timestamp-range rule is stricter with duplicate boundary timestamps.)
+    ng = _ngram(delta=None, overlap=False)
+    windows = ng.form_sequences(_rows([0, 1, 1, 2, 3]), SensorSchema)
+    assert [(w[0]['ts'], w[1]['ts']) for w in windows] == [(0, 1), (2, 3)]
+
+
+def test_overlap_false_gap_resets_nothing_golden():
+    # ts: 1 2 3 20 21 22, length 3, delta 1.
+    # Stable triples: (1,2,3) and (20,21,22) only (any window crossing the
+    # 3->20 gap is unstable).  Both emitted: ranges don't overlap.
+    ng = _ngram(fields={0: ['ts', 'lidar'], 1: ['ts'], 2: ['ts', 'speed']},
+                delta=1, overlap=False)
+    windows = ng.form_sequences(_rows([1, 2, 3, 20, 21, 22]), SensorSchema)
+    assert [(w[0]['ts'], w[2]['ts']) for w in windows] == [(1, 3), (20, 22)]
+
+
+def test_overlap_true_emits_every_stable_window_golden():
+    # Same data as the duplicate-timestamp case but overlap allowed: every
+    # stable window is emitted (stride 1 over the sorted rows).
+    ng = _ngram(delta=None, overlap=True)
+    windows = ng.form_sequences(_rows([0, 1, 1, 2, 3]), SensorSchema)
+    assert [(w[0]['ts'], w[1]['ts']) for w in windows] == \
+        [(0, 1), (1, 1), (1, 2), (2, 3)]
+
+
 def test_sparse_and_negative_offsets():
     ng = _ngram(fields={-1: ['lidar'], 1: ['speed']}, delta=2)
     windows = ng.form_sequences(_rows([1, 2, 3]), SensorSchema)

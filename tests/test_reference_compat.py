@@ -44,13 +44,27 @@ def _fixture_bytes():
         return base64.b64decode(f.read())
 
 
-def test_pyspark_is_really_absent():
-    """The whole point: these tests prove footer compat WITHOUT pyspark."""
-    with pytest.raises(ImportError):
+@pytest.fixture()
+def no_pyspark(monkeypatch):
+    """Simulate a pyspark-free host (sys.modules[...] = None makes import
+    raise ImportError) so the stub-layer tests prove what they claim even in
+    a dev environment that has pyspark installed."""
+    import sys
+    for mod in ('pyspark', 'pyspark.sql', 'pyspark.sql.types'):
+        monkeypatch.setitem(sys.modules, mod, None)
+
+
+def test_environment_note():
+    """TPU-VM images ship no pyspark (SURVEY.md §7); in dev environments
+    that DO have it, the no_pyspark fixture simulates its absence."""
+    try:
         import pyspark  # noqa: F401
+        pytest.skip('pyspark installed here; stub tests use the no_pyspark fixture')
+    except ImportError:
+        pass  # the deployment reality these tests target
 
 
-def test_frozen_reference_footer_unpickles_without_pyspark():
+def test_frozen_reference_footer_unpickles_without_pyspark(no_pyspark):
     blob = _fixture_bytes()
     assert b'petastorm_tpu' not in blob  # genuinely foreign bytes
     assert b'pyspark' in blob
@@ -77,7 +91,7 @@ def test_frozen_reference_footer_unpickles_without_pyspark():
     assert schema.fields['label'].nullable is True
 
 
-def test_end_to_end_read_over_reference_footer(tmp_path):
+def test_end_to_end_read_over_reference_footer(tmp_path, no_pyspark):
     """Write cells in the (shared) on-disk format, then splice the frozen
     reference footer in — the reader must decode rows with no petastorm_tpu
     schema anywhere on disk."""
