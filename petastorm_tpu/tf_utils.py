@@ -8,6 +8,7 @@ is CPU-only glue for migration; the TPU path is ``petastorm_tpu.jax``.
 
 import datetime
 import decimal
+import threading
 
 import numpy as np
 
@@ -128,25 +129,105 @@ def _make_ngram_dataset(tf, reader):
     return dataset.map(to_dict)
 
 
-def tf_tensors(reader):
-    """Legacy TF1 tensors interface: one `tf.py_function` pull per session run.
+def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
+    """Legacy TF1 tensors interface.
 
-    Parity: reference ``petastorm/tf_utils.py :: tf_tensors`` (queue-runner
-    machinery reduced to a py_function pull: TF1 QueueRunners are deprecated
-    in the TF2 runtime this targets; reads still happen in the reader's own
-    worker pool).
+    Parity: reference ``petastorm/tf_utils.py :: tf_tensors``.  In graph mode
+    (``tf.compat.v1.Session``) this reproduces the reference's queue-runner
+    machinery: a ``py_func`` pull feeds a ``RandomShuffleQueue`` through a
+    ``QueueRunner`` registered in the ``QUEUE_RUNNERS`` collection, so
+    ``tf.compat.v1.train.start_queue_runners`` spins the prefetch threads and
+    ``shuffling_queue_capacity``/``min_after_dequeue`` behave as in TF1.
+    With ``shuffling_queue_capacity=0`` the pull op is returned directly
+    (also the reference's behavior).  In eager mode the pull happens per
+    call; shuffling requires graph mode (use ``make_petastorm_dataset``
+    for tf.data-native shuffling instead).
+
+    NGram readers yield ``{offset: namedtuple}`` dicts, flattened through
+    the queue and reassembled, as in the reference.
     """
     tf = _tf()
     schema = reader.schema
     if reader.ngram is not None:
-        raise NotImplementedError('tf_tensors with NGram: use make_petastorm_dataset')
+        return _tf_tensors_ngram(tf, reader, shuffling_queue_capacity,
+                                 min_after_dequeue)
     names, dtypes = _schema_to_tf_dtypes(schema)
+    # QueueRunner threads call the pull concurrently; Reader.__next__ keeps a
+    # row buffer, so serialize (decode parallelism lives in the reader's pool).
+    lock = threading.Lock()
 
     def pull():
-        row = next(reader)
+        with lock:
+            row = next(reader)
         return [np.asarray(_sanitize_value(getattr(row, n), schema.fields[n]))
                 for n in names]
 
-    tensors = tf.py_function(pull, [], dtypes)
+    tensors = _pull_through_queue(tf, pull, dtypes, shuffling_queue_capacity,
+                                  min_after_dequeue)
+    for t, n in zip(tensors, names):
+        _set_static_shape(t, schema.fields[n])
     row_type = schema._get_namedtuple()
     return row_type(*tensors)
+
+
+def _tf_tensors_ngram(tf, reader, shuffling_queue_capacity, min_after_dequeue):
+    """NGram variant: fields of every timestep flattened through one queue,
+    reassembled into the reference's ``{offset: namedtuple}`` shape."""
+    schema = reader.schema
+    ngram = reader.ngram
+    offsets = sorted(ngram.fields)
+    names_at = {o: sorted(ngram.get_field_names_at_timestep(o)) for o in offsets}
+    flat_fields = [(o, n) for o in offsets for n in names_at[o]]
+    dtypes = [_tf_dtype_for(schema.fields[n].numpy_dtype) for _, n in flat_fields]
+    lock = threading.Lock()
+
+    def pull():
+        with lock:
+            window = next(reader)
+        return [np.asarray(_sanitize_value(getattr(window[o], n), schema.fields[n]))
+                for o, n in flat_fields]
+
+    tensors = _pull_through_queue(tf, pull, dtypes, shuffling_queue_capacity,
+                                  min_after_dequeue)
+    for t, (_, n) in zip(tensors, flat_fields):
+        _set_static_shape(t, schema.fields[n])
+    it = iter(tensors)
+    result = {}
+    for offset in offsets:
+        row_type = schema.create_schema_view(names_at[offset])._get_namedtuple()
+        result[offset] = row_type(*(next(it) for _ in names_at[offset]))
+    return result
+
+
+def _pull_through_queue(tf, pull, dtypes, shuffling_queue_capacity,
+                        min_after_dequeue):
+    """One ``py_func`` pull, optionally buffered through a queue-runner-fed
+    ``RandomShuffleQueue`` (graph mode only, like the reference)."""
+    if tf.executing_eagerly():
+        if shuffling_queue_capacity > 0:
+            raise ValueError(
+                'tf_tensors shuffling_queue_capacity requires graph mode '
+                '(tf.compat.v1.Session); in eager, use make_petastorm_dataset '
+                'with tf.data shuffling')
+        return tf.py_function(pull, [], dtypes)
+
+    v1 = tf.compat.v1
+    tensors = v1.py_func(pull, [], dtypes)
+    if shuffling_queue_capacity <= 0:
+        return tensors
+    queue = v1.RandomShuffleQueue(capacity=shuffling_queue_capacity,
+                                  min_after_dequeue=min_after_dequeue,
+                                  dtypes=dtypes)
+    # Several parallel enqueue ops, as the reference does: each op re-traces
+    # the py_func pull, so the runner's threads read concurrently.
+    runner = v1.train.QueueRunner(queue, [queue.enqueue(tensors)] * 4)
+    v1.train.add_queue_runner(runner)
+    return queue.dequeue()
+
+
+def _set_static_shape(tensor, field):
+    """py_func outputs are unknown-rank; restore the schema's static shape."""
+    if np.dtype(field.numpy_dtype).kind in ('U', 'S', 'O'):
+        tensor.set_shape(())
+    elif field.shape is not None and all(d is not None for d in field.shape):
+        tensor.set_shape(field.shape)

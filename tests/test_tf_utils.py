@@ -92,3 +92,70 @@ def test_tf_tensors_pull(dataset):
         assert int(row.id.numpy()) == 0
         row2 = tf_tensors(reader)
         assert int(row2.id.numpy()) == 1
+
+
+def test_tf_tensors_eager_shuffle_rejected(dataset):
+    with make_reader(dataset.url, reader_pool_type='dummy') as reader:
+        with pytest.raises(ValueError, match='graph mode'):
+            tf_tensors(reader, shuffling_queue_capacity=10)
+
+
+def test_tf_tensors_graph_mode_direct(dataset):
+    """shuffling_queue_capacity=0 in a TF1 graph: plain py_func pull with the
+    schema's static shapes restored on the tensors."""
+    v1 = tf.compat.v1
+    with make_reader(dataset.url, schema_fields=['id', 'matrix'],
+                     reader_pool_type='dummy', shuffle_row_groups=False) as reader:
+        with tf.Graph().as_default():
+            row = tf_tensors(reader)
+            assert row.matrix.shape.as_list() == [8, 4]
+            with v1.Session() as sess:
+                ids = [int(sess.run(row.id)) for _ in range(3)]
+    assert ids == [0, 1, 2]
+
+
+def test_tf_tensors_graph_mode_queue_runner(dataset):
+    """The reference's TF1 machinery: RandomShuffleQueue fed by QueueRunner
+    threads started via start_queue_runners."""
+    v1 = tf.compat.v1
+    with make_reader(dataset.url, schema_fields=['id', 'matrix'],
+                     reader_pool_type='thread', num_epochs=None) as reader:
+        with tf.Graph().as_default() as graph:
+            row = tf_tensors(reader, shuffling_queue_capacity=12,
+                             min_after_dequeue=4)
+            runners = graph.get_collection(v1.GraphKeys.QUEUE_RUNNERS)
+            assert len(runners) == 1
+            assert row.matrix.shape.as_list() == [8, 4]
+            with v1.Session() as sess:
+                coord = v1.train.Coordinator()
+                threads = v1.train.start_queue_runners(sess=sess, coord=coord)
+                seen = [int(sess.run(row.id)) for _ in range(40)]
+                coord.request_stop()
+                sess.run(runners[0].cancel_op)
+                coord.join(threads, stop_grace_period_secs=10)
+    assert set(seen) <= set(range(20))
+    assert len(set(seen)) > 10  # drew broadly across the dataset
+    # min_after_dequeue warm-up means draws are shuffled, not sequential.
+    assert seen[:20] != sorted(seen[:20])
+
+
+def test_tf_tensors_ngram(tmp_path):
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    S = Unischema('Seq', [
+        UnischemaField('ts', np.int64, (), None, False),
+        UnischemaField('v', np.float32, (2,), NdarrayCodec(), False),
+    ])
+    with DatasetWriter('file://' + str(tmp_path / 's'), S, rows_per_rowgroup=10) as w:
+        w.write_many({'ts': np.int64(i), 'v': np.full(2, i, np.float32)}
+                     for i in range(10))
+    ngram = NGram({0: ['v', 'ts'], 1: ['v']}, delta_threshold=2, timestamp_field='ts')
+    with make_reader('file://' + str(tmp_path / 's'), schema_fields=ngram,
+                     reader_pool_type='dummy', shuffle_row_groups=False) as reader:
+        window = tf_tensors(reader)
+        assert set(window.keys()) == {0, 1}
+        assert int(window[0].ts.numpy()) == 0
+        assert float(window[1].v.numpy()[0]) == 1.0
+        window2 = tf_tensors(reader)
+        assert int(window2[0].ts.numpy()) == 1
