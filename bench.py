@@ -5,9 +5,12 @@ Two measurements, one JSON line:
 * **images/s/host** (the `value`): thread-pool decode -> columnar collate ->
   double-buffered `device_put`, whole-epoch wall clock.
 * **stall_pct** (the BASELINE.json north-star metric): a jitted ResNet-50
-  train step consumes `DataLoader` batches under `StallMonitor`; stall is the
-  fraction of steady-state wall time the consumer spends blocked in
-  `__next__` (target <= 2%).
+  train step consumes `DataLoader` batches; stall is measured as
+  `(wall_per_step - device_floor) / wall_per_step`, where the device floor
+  is the same step chained on a resident batch with no data pipeline
+  (target <= 2%).  This wall-vs-floor form is exact under JAX async
+  dispatch and needs no per-step device syncs (which on this tunneled
+  backend either under-wait or cost a ~60-100 ms round-trip each).
 
 `vs_baseline` is measured, not quoted — the reference publishes no numbers
 (BASELINE.json "published": {}).  The baseline leg re-reads the same dataset
@@ -32,7 +35,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BENCH_DIR = os.environ.get('PETASTORM_TPU_BENCH_DIR', '/tmp/petastorm_tpu_bench')
-DATASET_URL = 'file://' + BENCH_DIR + '/imagenet_like'
+DATASET_URL = 'file://' + BENCH_DIR + '/imagenet_like_v2'  # v2: image column
+# stored with parquet compression NONE (JPEG bytes are incompressible; the
+# writer now defaults codec-compressed columns to NONE)
 NUM_IMAGES = int(os.environ.get('PETASTORM_TPU_BENCH_ROWS', '768'))
 IMAGE_HW = (224, 224)
 BATCH = 64
@@ -153,30 +158,54 @@ def _make_resnet_step():
     return train_step, params, batch_stats, opt_state
 
 
-def _run_stall(loader, state, max_steps):
-    """Drive the train step over ``loader`` under StallMonitor.
-
-    The loop body blocks on the step's loss, so 'step' time is real device
-    compute and '__next__' wait is true data stall (the loader's prefetch
-    threads keep filling during the blocked step)."""
-    import numpy as np
-    from petastorm_tpu.benchmark.stall_profiler import StallMonitor
+def _device_floor_ms(state, steps):
+    """Pure device step time: one resident batch, ``steps`` chained
+    executions, a single terminal D2H sync.  No data pipeline and no
+    per-step tunnel round-trips — the denominator for stall%."""
+    import jax
 
     train_step, params, batch_stats, opt_state = state
-    monitor = StallMonitor(warmup_steps=3)
+    x = jax.device_put(np.zeros((BATCH, IMAGE_HW[0], IMAGE_HW[1], 3), np.uint8))
+    y = jax.device_put(np.zeros((BATCH,), np.int64))
+    params, batch_stats, opt_state, loss = train_step(
+        params, batch_stats, opt_state, x, y)
+    float(loss)  # compile + settle
+    t0 = time.monotonic()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, x, y)
+    float(loss)  # forces the whole chain; block_until_ready under-waits here
+    return 1000.0 * (time.monotonic() - t0) / steps
+
+
+def _run_stall(loader, state, max_steps, floor_ms):
+    """Wall-clock ``max_steps`` async-dispatched steps over ``loader`` (one
+    terminal sync), then ``stall% = (wall - device_floor) / wall``.
+
+    Per-step ``block_until_ready``/value pulls would either under-wait (the
+    tunneled backend acks before execution completes) or add a ~60-100 ms
+    tunnel round-trip to every step; measuring the whole window against a
+    device-only floor needs neither."""
+    warmup = 3
+    train_step, params, batch_stats, opt_state = state
     steps = 0
     loss = None
-    for batch in monitor.wrap(loader):
+    t0 = None
+    for batch in loader:
         params, batch_stats, opt_state, loss = train_step(
             params, batch_stats, opt_state, batch['image'], batch['noun_id'])
-        loss.block_until_ready()
         steps += 1
-        if steps >= max_steps:
+        if steps == warmup:
+            float(loss)  # drain pipeline-fill + any compile before timing
+            t0 = time.monotonic()
+        if steps >= max_steps + warmup:
             break
-    report = monitor.report()
-    assert loss is not None and np.isfinite(float(loss)), 'non-finite loss'
-    step_ms = 1000.0 * report['step_s'] / max(report['steps'], 1)
-    return report['stall_pct'], step_ms
+    loss_val = float(loss)  # forces every chained timed step
+    assert t0 is not None and steps > warmup, 'loader too short for the run'
+    assert np.isfinite(loss_val), 'non-finite loss'
+    wall_ms = 1000.0 * (time.monotonic() - t0) / (steps - warmup)
+    stall_pct = max(0.0, 100.0 * (wall_ms - floor_ms) / wall_ms)
+    return round(stall_pct, 2), wall_ms
 
 
 def train_stall_legs():
@@ -195,6 +224,12 @@ def train_stall_legs():
     from petastorm_tpu.jax import DataLoader, DeviceInMemDataLoader
 
     state = _make_resnet_step()
+    # The cached leg and the floor are cheap (~28 ms/step, no host work):
+    # run 2x the steps so the wall-vs-floor difference — the stall signal —
+    # sits above run-to-run timer noise.  The streaming leg pays full host
+    # decode per step, so it keeps the base count.
+    cached_steps = 2 * TRAIN_STEPS
+    floor_ms = _device_floor_ms(state, cached_steps)
 
     # Size by FULL batches per epoch (drop_last): epochs of ragged-tail rows
     # never become steps, so dividing by row count would undershoot.
@@ -203,17 +238,20 @@ def train_stall_legs():
     with make_reader(DATASET_URL, num_epochs=epochs, workers_count=WORKERS,
                      shuffle_row_groups=False, columnar_decode=True) as reader:
         loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
-        stream_stall, stream_step_ms = _run_stall(loader, state, TRAIN_STEPS + 4)
+        stream_stall, stream_step_ms = _run_stall(loader, state, TRAIN_STEPS,
+                                                  floor_ms)
 
     with make_reader(DATASET_URL, num_epochs=1, workers_count=WORKERS,
                      shuffle_row_groups=False, columnar_decode=True) as reader:
         loader = DeviceInMemDataLoader(reader, batch_size=BATCH,
                                        num_epochs=None, seed=0)
-        cached_stall, cached_step_ms = _run_stall(loader, state, TRAIN_STEPS + 4)
+        cached_stall, cached_step_ms = _run_stall(loader, state, cached_steps,
+                                                  floor_ms)
 
     return {
         'stall_pct': cached_stall,
         'step_ms': round(cached_step_ms, 2),
+        'device_step_ms': round(floor_ms, 2),
         'stall_pct_streaming': stream_stall,
         'step_ms_streaming': round(stream_step_ms, 2),
     }

@@ -169,6 +169,21 @@ class DatasetWriter(object):
         self._rowgroup_size_mb = rowgroup_size_mb
         self._rows_per_rowgroup = rows_per_rowgroup
         self._rows_per_file = rows_per_file
+        # Codec cells that are already compressed (JPEG/PNG images, zlib
+        # ndarrays) gain nothing from parquet-level compression — snappy over
+        # them is pure CPU burned on every read.  Per-column override: NONE
+        # for those, the requested codec for everything else.
+        if isinstance(compression, str):
+            from petastorm_tpu.codecs import (CompressedImageCodec,
+                                              CompressedNdarrayCodec)
+            precompressed = [
+                name for name, f in schema.fields.items()
+                if isinstance(f.codec, (CompressedImageCodec,
+                                        CompressedNdarrayCodec))]
+            if precompressed:
+                compression = dict.fromkeys(schema.fields, compression)
+                for name in precompressed:
+                    compression[name] = 'NONE'
         self._compression = compression
         self._fs, self._path = get_filesystem_and_path_or_paths(
             dataset_url, storage_options=storage_options, filesystem=filesystem)

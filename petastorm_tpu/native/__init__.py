@@ -121,6 +121,61 @@ def _as_ptr_arrays(cells):
     return ptrs, lens
 
 
+def _arrow_ptr_arrays(column):
+    """pyarrow binary (Chunked)Array -> (char**, size_t*, keepalive), borrowing
+    the arrow buffers directly — no per-cell ``bytes`` copies, the marshalling
+    win the ``to_pylist`` path can't have.  None when unsupported (nulls,
+    non-binary type)."""
+    import numpy as np
+    import pyarrow as pa
+
+    chunks = column.chunks if isinstance(column, pa.ChunkedArray) else [column]
+    ptr_parts, len_parts = [], []
+    for chunk in chunks:
+        if chunk.null_count:
+            return None
+        if pa.types.is_binary(chunk.type):
+            off_dtype = np.int32
+        elif pa.types.is_large_binary(chunk.type):
+            off_dtype = np.int64
+        else:
+            return None
+        validity, offsets_buf, data_buf = chunk.buffers()
+        # A sliced chunk shares its parent's buffers; chunk.offset shifts the
+        # window into the offsets vector.
+        offs = np.frombuffer(
+            offsets_buf, dtype=off_dtype, count=len(chunk) + 1,
+            offset=chunk.offset * np.dtype(off_dtype).itemsize).astype(np.uint64)
+        ptr_parts.append(data_buf.address + offs[:-1])
+        len_parts.append(np.diff(offs))
+    ptrs = np.ascontiguousarray(np.concatenate(ptr_parts))
+    lens = np.ascontiguousarray(np.concatenate(len_parts))
+    return (ptrs.ctypes.data_as(ctypes.POINTER(ctypes.c_char_p)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_size_t)),
+            (ptrs, lens, chunks))
+
+
+def _marshal_cells(cells):
+    """Cells (list[bytes] OR pyarrow binary column) -> (char**, size_t*, n,
+    keepalive); None if this cell container can't go native."""
+    if isinstance(cells, (list, tuple)):
+        if any(c is None for c in cells):
+            return None
+        ptrs, lens = _as_ptr_arrays(cells)
+        return ptrs, lens, len(cells), cells
+    try:
+        import pyarrow as pa
+        if isinstance(cells, (pa.Array, pa.ChunkedArray)):
+            marshalled = _arrow_ptr_arrays(cells)
+            if marshalled is None:
+                return None
+            ptrs, lens, keep = marshalled
+            return ptrs, lens, len(cells), keep
+    except ImportError:
+        pass
+    return None
+
+
 def jpeg_decode_batch(cells, dst):
     """Decode list[bytes] JPEGs into a (N, H, W, 3)/(N, H, W) uint8 array.
 
@@ -138,9 +193,13 @@ def jpeg_decode_batch(cells, dst):
         h, w, c = dst.shape[1], dst.shape[2], 1
     else:
         return False
-    ptrs, lens = _as_ptr_arrays(cells)
-    rc = lib.pt_jpeg_decode_batch(ptrs, lens, len(cells),
+    marshalled = _marshal_cells(cells)
+    if marshalled is None:
+        return False
+    ptrs, lens, n, keep = marshalled
+    rc = lib.pt_jpeg_decode_batch(ptrs, lens, n,
                                   dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
+    del keep
     return rc == 0
 
 
@@ -159,9 +218,13 @@ def png_decode_batch(cells, dst):
         h, w, c = dst.shape[1], dst.shape[2], 1
     else:
         return False
-    ptrs, lens = _as_ptr_arrays(cells)
-    rc = lib.pt_png_decode_batch(ptrs, lens, len(cells),
+    marshalled = _marshal_cells(cells)
+    if marshalled is None:
+        return False
+    ptrs, lens, n, keep = marshalled
+    rc = lib.pt_png_decode_batch(ptrs, lens, n,
                                  dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
+    del keep
     return rc == 0
 
 
@@ -183,8 +246,12 @@ def zlib_npy_decompress_batch(cells, dst):
     expected = "{'descr': %r, 'fortran_order': False, 'shape': %r," \
         % (dst.dtype.str, tuple(dst.shape[1:]))
     expected = expected.encode('latin1')
-    ptrs, lens = _as_ptr_arrays(cells)
+    marshalled = _marshal_cells(cells)
+    if marshalled is None:
+        return False
+    ptrs, lens, n, keep = marshalled
     rc = lib.pt_zlib_npy_decompress_batch(
-        ptrs, lens, len(cells), dst.ctypes.data_as(ctypes.c_void_p),
+        ptrs, lens, n, dst.ctypes.data_as(ctypes.c_void_p),
         ctypes.c_size_t(cell_bytes), expected, ctypes.c_size_t(len(expected)))
+    del keep
     return rc == 0

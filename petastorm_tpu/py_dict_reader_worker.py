@@ -146,29 +146,32 @@ class PyDictReaderWorker(ParquetWorkerBase):
                     arr = arr.astype(f.numpy_dtype, copy=False)
                 out[name] = arr
                 continue
-            cells = column.to_pylist()
             if f is None:
-                out[name] = _stack_cells_np(cells)
+                out[name] = _stack_cells_np(column.to_pylist())
                 continue
             codec = f.codec_or_default
             shape = f.shape if f.shape is not None else ()
             static = all(s is not None for s in shape) and \
                 np.dtype(f.numpy_dtype).kind not in ('U', 'S', 'O')
-            if static and shape and not any(c is None for c in cells):
+            if static and shape and column.null_count == 0:
                 # Preallocated batch: each cell decodes straight into its
                 # (i, ...) slice — no per-cell allocation + no np.stack pass.
-                dst = np.empty((len(cells),) + tuple(shape), dtype=f.numpy_dtype)
+                dst = np.empty((len(column),) + tuple(shape), dtype=f.numpy_dtype)
                 batch_decode = getattr(codec, 'decode_batch_into', None)
                 try:
-                    if batch_decode is not None and batch_decode(f, cells, dst):
+                    # The arrow column goes to the native plane as-is: cell
+                    # pointers aim into arrow buffers, skipping the per-cell
+                    # bytes copies a to_pylist materialization would pay.
+                    if batch_decode is not None and batch_decode(f, column, dst):
                         out[name] = dst  # whole column decoded in one native call
                         continue
-                    for i, c in enumerate(cells):
+                    for i, c in enumerate(column.to_pylist()):
                         codec.decode_into(f, c, dst[i])
                 except Exception as e:
                     raise DecodeFieldError('Failed to decode field %r: %s' % (name, e)) from e
                 out[name] = dst
                 continue
+            cells = column.to_pylist()
             decode = codec.decode
             try:  # hoisted per-column error context; the loop stays lean
                 decoded = [decode(f, c) if c is not None else None for c in cells]

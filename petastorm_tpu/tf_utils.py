@@ -222,7 +222,9 @@ def _pull_through_queue(tf, pull, dtypes, shuffling_queue_capacity,
     # the py_func pull, so the runner's threads read concurrently.
     runner = v1.train.QueueRunner(queue, [queue.enqueue(tensors)] * 4)
     v1.train.add_queue_runner(runner)
-    return queue.dequeue()
+    dequeued = queue.dequeue()
+    # A one-component queue dequeues to a bare Tensor, not a list.
+    return [dequeued] if len(dtypes) == 1 else dequeued
 
 
 def _set_static_shape(tensor, field):

@@ -1,0 +1,283 @@
+"""A faithful duck-typed pyspark stand-in for converter tests.
+
+The sandbox has no pyspark (SURVEY.md §7), so ``make_spark_converter``'s
+live-Spark path would otherwise stay untested.  This module installs a
+minimal ``pyspark`` package into ``sys.modules`` that reproduces exactly the
+surface the converter touches — ``df.sparkSession.conf``, ``df.schema.fields``
+(with ``VectorUDT``/``DoubleType`` data types), ``withColumn`` over
+``F.col(...).cast(...)`` / ``vector_to_array`` expressions, the analyzed-plan
+string behind ``df._jdf.queryExecution()``, ``df.write.option(...).parquet``
+and ``df.count()`` — backed by a pandas DataFrame and a pyarrow writer.
+
+Mirrors the reference's test strategy of faithful fakes (SURVEY.md §4: mocked
+hadoop XML + fake connector for HDFS HA); no converter code is patched.
+"""
+
+import contextlib
+import sys
+import types as _types
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+# -- pyspark.sql.types -------------------------------------------------------
+
+class DataType(object):
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+
+class DoubleType(DataType):
+    pass
+
+
+class FloatType(DataType):
+    pass
+
+
+class LongType(DataType):
+    pass
+
+
+class StringType(DataType):
+    pass
+
+
+class VectorUDT(DataType):
+    """Spark ML vector column type (name-matched by the converter)."""
+
+
+class DenseVector(object):
+    def __init__(self, values):
+        self._values = np.asarray(values, dtype=np.float64)
+
+    def toArray(self):
+        return self._values
+
+
+# -- column expressions ------------------------------------------------------
+
+class _Col(object):
+    def __init__(self, name):
+        self.name = name
+
+    def cast(self, data_type):
+        return _Cast(self.name, data_type)
+
+
+class _Cast(object):
+    def __init__(self, name, data_type):
+        self.name = name
+        self.data_type = data_type
+
+    def apply(self, series):
+        if isinstance(self.data_type, FloatType):
+            return series.astype(np.float32), FloatType()
+        if isinstance(self.data_type, DoubleType):
+            return series.astype(np.float64), DoubleType()
+        raise NotImplementedError(type(self.data_type))
+
+
+class _VectorToArray(object):
+    def __init__(self, name, dtype):
+        self.name = name
+        self.dtype = dtype
+
+    def apply(self, series):
+        np_dtype = np.float32 if self.dtype == 'float32' else np.float64
+        return (series.map(lambda v: v.toArray().astype(np_dtype)),
+                _ArrayType(np_dtype))
+
+
+class _ArrayType(DataType):
+    def __init__(self, np_dtype):
+        self.np_dtype = np_dtype
+
+
+def vector_to_array(col, dtype='float64'):
+    return _VectorToArray(col.name, dtype)
+
+
+def col(name):
+    return _Col(name)
+
+
+# -- session / dataframe -----------------------------------------------------
+
+class _Conf(object):
+    def __init__(self, values):
+        self._values = dict(values)
+
+    def get(self, key, default=None):
+        return self._values.get(key, default)
+
+    def set(self, key, value):
+        self._values[key] = value
+
+
+class FakeSparkSession(object):
+    def __init__(self, conf=None):
+        self.conf = _Conf(conf or {})
+
+
+class _Field(object):
+    def __init__(self, name, data_type):
+        self.name = name
+        self.dataType = data_type
+
+
+class _Schema(object):
+    def __init__(self, fields):
+        self.fields = fields
+
+
+class _AnalyzedPlan(object):
+    def __init__(self, text):
+        self._text = text
+
+    def toString(self):
+        return self._text
+
+
+class _QueryExecution(object):
+    def __init__(self, text):
+        self._text = text
+
+    def analyzed(self):
+        return _AnalyzedPlan(self._text)
+
+
+class _Jdf(object):
+    def __init__(self, text):
+        self._text = text
+
+    def queryExecution(self):
+        return _QueryExecution(self._text)
+
+
+class _Writer(object):
+    def __init__(self, df):
+        self._df = df
+        self.options = {}
+
+    def option(self, key, value):
+        self.options[key] = value
+        return self
+
+    def parquet(self, url):
+        assert url.startswith('file://'), url
+        path = url[len('file://'):]
+        import os
+        os.makedirs(path, exist_ok=True)
+        columns = {}
+        for field in self._df.schema.fields:
+            series = self._df._pdf[field.name]
+            if isinstance(field.dataType, _ArrayType):
+                columns[field.name] = pa.array(
+                    [c.tolist() for c in series],
+                    type=pa.list_(pa.from_numpy_dtype(field.dataType.np_dtype)))
+            else:
+                columns[field.name] = pa.array(series)
+        pq.write_table(pa.table(columns), path + '/part-00000.parquet')
+
+
+def _infer_type(series):
+    if series.dtype == np.float64:
+        return DoubleType()
+    if series.dtype == np.float32:
+        return FloatType()
+    if series.dtype == np.int64:
+        return LongType()
+    if series.dtype == object:
+        first = series.iloc[0]
+        if isinstance(first, DenseVector):
+            return VectorUDT()
+        if isinstance(first, str):
+            return StringType()
+    raise NotImplementedError(series.dtype)
+
+
+class FakeDataFrame(object):
+    """pandas-backed stand-in for ``pyspark.sql.DataFrame``.
+
+    The analyzed-plan string — what the converter hashes for dedup — is
+    derived from the source name plus the applied column expressions, like a
+    real logical plan: same source + same projection → identical plan text.
+    """
+
+    def __init__(self, pdf, session, source='table', schema=None, plan_ops=()):
+        self._pdf = pdf
+        self.sparkSession = session
+        self._source = source
+        self._plan_ops = tuple(plan_ops)
+        self.schema = schema or _Schema(
+            [_Field(n, _infer_type(pdf[n])) for n in pdf.columns])
+
+    @property
+    def _jdf(self):
+        text = 'Relation[%s] %s\n%s' % (
+            ','.join('%s#%s' % (f.name, type(f.dataType).__name__)
+                     for f in self.schema.fields),
+            self._source, '\n'.join(self._plan_ops))
+        return _Jdf(text)
+
+    def withColumn(self, name, expr):
+        series, data_type = expr.apply(self._pdf[name])
+        pdf = self._pdf.assign(**{name: series})
+        fields = [(_Field(name, data_type) if f.name == name else f)
+                  for f in self.schema.fields]
+        op = 'Project[%s := %s(%s)]' % (name, type(expr).__name__,
+                                        getattr(expr, 'dtype', ''))
+        return FakeDataFrame(pdf, self.sparkSession, self._source,
+                             _Schema(fields), self._plan_ops + (op,))
+
+    @property
+    def write(self):
+        return _Writer(self)
+
+    def count(self):
+        return len(self._pdf)
+
+
+# -- sys.modules installer ---------------------------------------------------
+
+@contextlib.contextmanager
+def installed():
+    """Install the fake ``pyspark`` package for the duration of the block."""
+    modules = {}
+
+    def mod(name, **attrs):
+        m = _types.ModuleType(name)
+        for k, v in attrs.items():
+            setattr(m, k, v)
+        modules[name] = m
+        return m
+
+    pyspark = mod('pyspark')
+    sql = mod('pyspark.sql')
+    ml = mod('pyspark.ml')
+    mod('pyspark.sql.types', DoubleType=DoubleType, FloatType=FloatType,
+        LongType=LongType, StringType=StringType, VectorUDT=VectorUDT)
+    mod('pyspark.sql.functions', col=col)
+    mod('pyspark.ml.functions', vector_to_array=vector_to_array)
+    pyspark.sql = sql
+    pyspark.ml = ml
+    sql.types = modules['pyspark.sql.types']
+    sql.functions = modules['pyspark.sql.functions']
+    ml.functions = modules['pyspark.ml.functions']
+
+    saved = {name: sys.modules.get(name) for name in modules}
+    sys.modules.update(modules)
+    try:
+        yield
+    finally:
+        for name, original in saved.items():
+            if original is None:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = original

@@ -221,3 +221,62 @@ def test_reader_native_and_fallback_agree(tmp_path, monkeypatch):
         img_diff = np.abs(native_out[i][0].astype(int) - fallback_out[i][0].astype(int))
         assert img_diff.max() <= 1  # lossy decoder builds may differ by 1 LSB
         assert np.array_equal(native_out[i][1], fallback_out[i][1])
+
+
+@requires_native
+def test_arrow_column_zero_copy_decode():
+    """pyarrow binary columns decode natively without to_pylist: plain,
+    chunked, and sliced arrays all match the bytes-list path."""
+    import pyarrow as pa
+
+    rng = np.random.default_rng(3)
+    imgs = [rng.integers(0, 255, (16, 24, 3), np.uint8) for _ in range(10)]
+    cells = [_jpeg_cell(img) for img in imgs]
+
+    expected = np.empty((10, 16, 24, 3), np.uint8)
+    assert native.jpeg_decode_batch(cells, expected)
+
+    # Plain Array
+    out = np.empty_like(expected)
+    assert native.jpeg_decode_batch(pa.array(cells, type=pa.binary()), out)
+    np.testing.assert_array_equal(out, expected)
+
+    # ChunkedArray with several chunks
+    chunked = pa.chunked_array([cells[:3], cells[3:7], cells[7:]],
+                               type=pa.binary())
+    out = np.empty_like(expected)
+    assert native.jpeg_decode_batch(chunked, out)
+    np.testing.assert_array_equal(out, expected)
+
+    # Sliced array (non-zero offset shares the parent's buffers)
+    sliced = pa.array(cells, type=pa.binary()).slice(4, 5)
+    out5 = np.empty((5, 16, 24, 3), np.uint8)
+    assert native.jpeg_decode_batch(sliced, out5)
+    np.testing.assert_array_equal(out5, expected[4:9])
+
+    # large_binary offsets are 64-bit
+    out = np.empty_like(expected)
+    assert native.jpeg_decode_batch(pa.array(cells, type=pa.large_binary()), out)
+    np.testing.assert_array_equal(out, expected)
+
+
+@requires_native
+def test_arrow_column_with_nulls_falls_back():
+    import pyarrow as pa
+    rng = np.random.default_rng(4)
+    cells = [_jpeg_cell(rng.integers(0, 255, (8, 8, 3), np.uint8)), None]
+    out = np.empty((2, 8, 8, 3), np.uint8)
+    assert not native.jpeg_decode_batch(pa.array(cells, type=pa.binary()), out)
+    assert not native.jpeg_decode_batch(cells, out)  # list with None too
+
+
+@requires_native
+def test_arrow_zlib_column_roundtrip():
+    import pyarrow as pa
+    arrs = [np.full((3, 2), i, np.float32) for i in range(6)]
+    codec = CompressedNdarrayCodec()
+    field = UnischemaField('m', np.float32, (3, 2), codec, False)
+    cells = pa.array([codec.encode(field, a) for a in arrs], type=pa.binary())
+    dst = np.empty((6, 3, 2), np.float32)
+    assert native.zlib_npy_decompress_batch(cells, dst)
+    np.testing.assert_array_equal(dst, np.stack(arrs))

@@ -173,3 +173,86 @@ def test_pandas_converter_list_and_missing_cells(tmp_path):
     feats = np.asarray(batch['features'])
     np.testing.assert_allclose(feats, [[1, 2], [3, 4], [5, 6]])
     assert feats.dtype == np.float32
+
+
+# -- make_spark_converter live path over the faithful fake pyspark -----------
+# (the sandbox has no pyspark; fake_pyspark.py reproduces exactly the surface
+# the converter touches, backed by pandas — see its docstring)
+
+def _fake_df(session, n=24, source='sensors'):
+    import pandas as pd
+    from fake_pyspark import DenseVector, FakeDataFrame
+    pdf = pd.DataFrame({
+        'features': [DenseVector(np.arange(4, dtype=np.float64) + i)
+                     for i in range(n)],
+        'weight': np.linspace(0.0, 1.0, n),          # float64 -> cast check
+        'label': np.arange(n, dtype=np.int64),
+    })
+    return FakeDataFrame(pdf, session, source=source)
+
+
+def test_make_spark_converter_live_path(tmp_path):
+    """Full make_spark_converter flow: conf-key lookup, VectorUDT->array and
+    float64->float32 normalization, plan-hash dedup, loader round-trip."""
+    import fake_pyspark
+    from fake_pyspark import FakeSparkSession
+
+    parent = 'file://' + str(tmp_path / 'spark_cache')
+    session = FakeSparkSession(
+        {SparkDatasetConverter.PARENT_CACHE_DIR_URL_CONF: parent})
+    with fake_pyspark.installed():
+        conv = make_spark_converter(_fake_df(session))  # url via spark conf
+        assert len(conv) == 24
+        assert conv.cache_dir_url.startswith(parent)
+
+        # Identical logical plan -> dedup, no second materialization.
+        again = make_spark_converter(_fake_df(session))
+        assert again.cache_dir_url == conv.cache_dir_url
+
+        # A different source table -> different plan -> new cache dir.
+        other = make_spark_converter(_fake_df(session, source='other'))
+        assert other.cache_dir_url != conv.cache_dir_url
+
+    with conv.make_jax_loader(batch_size=6, num_epochs=1,
+                              reader_pool_type='dummy') as loader:
+        batches = list(loader)
+    labels = np.concatenate([np.asarray(b['label']) for b in batches])
+    assert sorted(labels.tolist()) == list(range(24))
+    feats = np.asarray(batches[0]['features'])
+    assert feats.dtype == np.float32          # vector_to_array(dtype='float32')
+    assert feats.shape == (6, 4)
+    weights = np.asarray(batches[0]['weight'])
+    assert weights.dtype == np.float32        # DoubleType cast down
+
+    conv.delete()
+    other.delete()
+
+
+def test_make_spark_converter_requires_cache_dir(tmp_path):
+    import fake_pyspark
+    from fake_pyspark import FakeSparkSession
+
+    with fake_pyspark.installed():
+        with pytest.raises(ValueError, match='parent_cache_dir_url'):
+            make_spark_converter(_fake_df(FakeSparkSession()))
+
+
+def test_make_spark_converter_explicit_url_and_float64(tmp_path):
+    """dtype='float64' keeps doubles; explicit parent url overrides conf."""
+    import fake_pyspark
+    from fake_pyspark import FakeSparkSession
+
+    parent = 'file://' + str(tmp_path / 'cache64')
+    with fake_pyspark.installed():
+        conv = make_spark_converter(_fake_df(FakeSparkSession()),
+                                    parent_cache_dir_url=parent,
+                                    dtype='float64')
+    assert conv.cache_dir_url.startswith(parent)
+    with conv.make_torch_dataloader(batch_size=8, num_epochs=1,
+                                    reader_pool_type='dummy') as loader:
+        batch = next(iter(loader))
+    assert batch['weight'].dtype.is_floating_point
+    import torch
+    assert batch['weight'].dtype == torch.float64
+    assert batch['features'].shape == (8, 4)
+    conv.delete()

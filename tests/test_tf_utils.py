@@ -139,6 +139,26 @@ def test_tf_tensors_graph_mode_queue_runner(dataset):
     assert seen[:20] != sorted(seen[:20])
 
 
+def test_tf_tensors_queue_single_field(dataset):
+    """Regression: a one-component queue dequeues to a bare Tensor; tf_tensors
+    must still return a 1-field namedtuple."""
+    v1 = tf.compat.v1
+    with make_reader(dataset.url, schema_fields=['id'],
+                     reader_pool_type='thread', num_epochs=None) as reader:
+        with tf.Graph().as_default() as graph:
+            row = tf_tensors(reader, shuffling_queue_capacity=8,
+                             min_after_dequeue=2)
+            runners = graph.get_collection(v1.GraphKeys.QUEUE_RUNNERS)
+            with v1.Session() as sess:
+                coord = v1.train.Coordinator()
+                threads = v1.train.start_queue_runners(sess=sess, coord=coord)
+                seen = [int(sess.run(row.id)) for _ in range(10)]
+                coord.request_stop()
+                sess.run(runners[0].cancel_op)
+                coord.join(threads, stop_grace_period_secs=10)
+    assert set(seen) <= set(range(20))
+
+
 def test_tf_tensors_ngram(tmp_path):
     from petastorm_tpu.codecs import NdarrayCodec
     from petastorm_tpu.etl.dataset_metadata import DatasetWriter
