@@ -257,7 +257,34 @@ def train_stall_legs():
     }
 
 
+def _start_watchdog(budget_s):
+    """Print a diagnostic JSON line and hard-exit if the run wedges.
+
+    The tunneled device can hang indefinitely (even ``jax.devices()`` blocks
+    when the relay pool is wedged — observed in round 2); a bench that never
+    prints is worse than one that reports the failure."""
+    import faulthandler
+    import threading
+
+    def fire():
+        print(json.dumps({
+            'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
+            'value': 0.0, 'unit': 'images/s', 'vs_baseline': 0.0,
+            'error': 'watchdog: run exceeded %ds — TPU tunnel likely wedged; '
+                     'stacks on stderr' % budget_s,
+        }), flush=True)
+        faulthandler.dump_traceback(file=sys.stderr)
+        os._exit(3)
+
+    timer = threading.Timer(budget_s, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main():
+    watchdog = _start_watchdog(
+        int(os.environ.get('PETASTORM_TPU_BENCH_BUDGET_S', '900')))
     ensure_dataset()
     import jax
     jax.jit(lambda x: x + 1)(np.zeros(8))  # backend warmup outside timing
@@ -291,6 +318,7 @@ def main():
                       'bounded by host_cores vs chip speed',
     }
     result.update(stall)
+    watchdog.cancel()
     print(json.dumps(result))
 
 

@@ -1,0 +1,117 @@
+"""Drop-in import alias: ``import petastorm`` → :mod:`petastorm_tpu`.
+
+Migration surface for reference users (``abditag2/petastorm``): every
+reference import line keeps working verbatim —
+
+    from petastorm import make_reader, make_batch_reader, TransformSpec
+    from petastorm.unischema import Unischema, UnischemaField, dict_to_spark_row
+    from petastorm.codecs import CompressedImageCodec, NdarrayCodec
+    from petastorm.etl.dataset_metadata import materialize_dataset
+    from petastorm.pytorch import DataLoader, BatchedDataLoader
+    from petastorm.tf_utils import tf_tensors, make_petastorm_dataset
+    from petastorm.spark import SparkDatasetConverter, make_spark_converter
+    from petastorm.predicates import in_set, in_pseudorandom_split
+    ...
+
+A meta-path finder lazily maps ``petastorm.X`` to ``petastorm_tpu.X`` the
+first time each submodule is imported; nothing heavyweight (tf/torch) loads
+until the corresponding adapter is touched, and identity is preserved
+(``petastorm.unischema.Unischema is petastorm_tpu.unischema.Unischema``), so
+isinstance checks and pickles interoperate across both names.  Each alias is
+a thin proxy module rather than the real module object, so the real modules
+keep their own ``__name__``/``__spec__`` (pickle-by-module-path and logging
+stay correct).
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+import types
+
+import petastorm_tpu as _real_pkg
+
+__version__ = _real_pkg.__version__
+
+
+class _AliasModule(types.ModuleType):
+    """Proxy module forwarding attribute access to the real petastorm_tpu
+    module while keeping its own name/spec.
+
+    Writes and deletes forward too, so ``mock.patch('petastorm.codecs.X')``
+    and module-level knob assignment through the alias reach the module the
+    real code actually reads.  Import-machinery attributes (dunders and the
+    child-submodule bindings the import system sets on packages) stay local —
+    forwarding those would clobber the real package's own state.
+    """
+
+    def __getattr__(self, name):
+        try:
+            return getattr(self.__dict__['__alias_real__'], name)
+        except AttributeError:
+            raise AttributeError('module %r has no attribute %r'
+                                 % (self.__name__, name)) from None
+
+    def __setattr__(self, name, value):
+        if name.startswith('__') or isinstance(value, _AliasModule):
+            types.ModuleType.__setattr__(self, name, value)
+        else:
+            setattr(self.__dict__['__alias_real__'], name, value)
+
+    def __delattr__(self, name):
+        if name.startswith('__') or name in self.__dict__:
+            types.ModuleType.__delattr__(self, name)
+        else:
+            delattr(self.__dict__['__alias_real__'], name)
+
+    def __dir__(self):
+        return sorted(set(dir(self.__dict__['__alias_real__']))
+                      | set(self.__dict__))
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, real_name):
+        self._real_name = real_name
+
+    def create_module(self, spec):
+        real = importlib.import_module(self._real_name)
+        module = _AliasModule(spec.name)
+        module.__dict__['__alias_real__'] = real
+        if hasattr(real, '__path__'):
+            # Mark as a package (empty search path: children resolve through
+            # the finder below, never the filesystem).
+            module.__path__ = []
+        return module
+
+    def exec_module(self, module):
+        pass
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith('petastorm.'):
+            return None
+        real_name = 'petastorm_tpu' + fullname[len('petastorm'):]
+        try:
+            real_spec = importlib.util.find_spec(real_name)
+        except (ImportError, ModuleNotFoundError):
+            return None
+        if real_spec is None:
+            return None
+        return importlib.util.spec_from_loader(
+            fullname, _AliasLoader(real_name),
+            is_package=real_spec.submodule_search_locations is not None)
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.append(_AliasFinder())
+
+
+def __getattr__(name):
+    # Top-level surface (make_reader, TransformSpec, ...) forwards to
+    # petastorm_tpu's own lazy __getattr__.
+    return getattr(_real_pkg, name)
+
+
+def __dir__():
+    return sorted(set(dir(_real_pkg)) | set(globals()))
