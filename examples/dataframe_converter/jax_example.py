@@ -55,4 +55,6 @@ def main():
 
 
 if __name__ == '__main__':
+    from petastorm_tpu.utils import ensure_jax_backend
+    ensure_jax_backend()  # honor JAX_PLATFORMS; fall back to cpu off-TPU
     main()

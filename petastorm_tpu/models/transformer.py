@@ -134,6 +134,13 @@ def _spec_for(path, model_axis):
     return P()                                 # norms & everything else: replicated
 
 
+def megatron_spec_fn(model_axis='model'):
+    """Public path→PartitionSpec callable with the Megatron TP rules — the
+    ``base_spec_fn`` hook for :func:`petastorm_tpu.parallel.fsdp_shardings`
+    (FSDP × TP composition)."""
+    return functools.partial(_spec_for, model_axis=model_axis)
+
+
 def param_shardings(params, mesh, model_axis='model'):
     """NamedSharding pytree for ``TransformerLM`` params over ``mesh``.
 

@@ -20,3 +20,6 @@ from petastorm_tpu.parallel.ring_attention import (  # noqa: F401
 from petastorm_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply, make_pipeline,
 )
+from petastorm_tpu.parallel.fsdp import (  # noqa: F401
+    fsdp_shardings, fsdp_size_report,
+)

@@ -1,0 +1,86 @@
+"""Fully-sharded data parallelism (FSDP / ZeRO-3) the XLA way.
+
+No reference equivalent (`abditag2/petastorm` is a data library; its only
+parallelism is input sharding — SURVEY.md §2.6); this is a TPU-first
+extension alongside ``parallel/mesh.py``'s DP helpers and
+``models/transformer.py``'s Megatron TP rules.
+
+FSDP on TPU is a *sharding annotation*, not a runtime: shard every large
+parameter along the ``data`` mesh axis and let GSPMD insert the all-gather
+before each use and the reduce-scatter on the gradients.  The scaling-book
+recipe applies — pick the axis, annotate, let XLA place collectives on the
+ICI ring; there is no hand-written gather/scatter anywhere.
+
+Composes with Megatron TP: pass ``base_spec_fn`` (e.g. the transformer's
+``_spec_for``) and FSDP claims a *free* dimension of each param, so a
+``('data', 'model')`` mesh gets ZeRO-3 × tensor-parallel layouts.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def fsdp_shardings(params, mesh, data_axis='data', min_shard_elements=2 ** 14,
+                   base_spec_fn=None):
+    """NamedSharding pytree sharding each large param over ``data_axis``.
+
+    Per leaf: start from ``base_spec_fn(path)`` (default: replicated), then
+    assign ``data_axis`` to the largest dimension that is still free in the
+    base spec and divisible by the axis size.  Leaves smaller than
+    ``min_shard_elements`` stay on the base spec — sharding tiny norms/biases
+    costs more in collective latency than it saves in HBM.
+
+    Returns a pytree of :class:`jax.sharding.NamedSharding` suitable for
+    ``jax.device_put`` / ``jit(..., in_shardings=...)``.
+    """
+    if data_axis not in mesh.axis_names:
+        raise ValueError('mesh has no axis %r (axes: %s)'
+                         % (data_axis, mesh.axis_names))
+    axis_size = mesh.shape[data_axis]
+
+    def as_spec(dims):
+        while dims and dims[-1] is None:  # canonical: no trailing Nones
+            dims.pop()
+        return P(*dims)
+
+    def spec_for(path, leaf):
+        base = list(base_spec_fn(path)) if base_spec_fn is not None else []
+        shape = np.shape(leaf)
+        base += [None] * (len(shape) - len(base))
+        if int(np.prod(shape, dtype=np.int64)) < min_shard_elements:
+            return as_spec(base)
+        # Largest free, divisible dimension gets the data axis.
+        candidates = [(dim, i) for i, dim in enumerate(shape)
+                      if base[i] is None and dim % axis_size == 0]
+        if not candidates:
+            return as_spec(base)
+        _, best = max(candidates)
+        base[best] = data_axis
+        return as_spec(base)
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, spec_for(path, leaf)), params)
+
+
+def fsdp_size_report(params, shardings):
+    """{'total_mb', 'per_device_mb', 'sharded_fraction'} for a params tree —
+    the observability hook training scripts log at startup."""
+    total = 0
+    per_device = 0
+    for leaf, sharding in zip(jax.tree_util.tree_leaves(params),
+                              jax.tree_util.tree_leaves(
+                                  shardings, is_leaf=lambda s: isinstance(s, NamedSharding))):
+        nbytes = np.prod(np.shape(leaf), dtype=np.int64) * np.dtype(leaf.dtype).itemsize
+        total += nbytes
+        shard_factor = 1
+        for name in jax.tree_util.tree_leaves(tuple(sharding.spec)):
+            if name is not None:
+                shard_factor *= sharding.mesh.shape[name]
+        per_device += nbytes // shard_factor
+    return {
+        'total_mb': round(total / 2 ** 20, 3),
+        'per_device_mb': round(per_device / 2 ** 20, 3),
+        'sharded_fraction': round(1.0 - per_device / total, 4) if total else 0.0,
+    }

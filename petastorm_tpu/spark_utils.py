@@ -1,0 +1,43 @@
+"""Spark RDD helpers.
+
+Parity: reference ``petastorm/spark_utils.py :: dataset_as_rdd`` — expose a
+petastorm dataset to Spark jobs as an RDD of schema namedtuples (the ETL-side
+escape hatch for teams whose feature pipelines are Spark-native).  The decode
+happens per-row in the executors via the same codec path the reader workers
+use (``petastorm_tpu.utils.decode_row``).
+
+pyspark is an optional extra (absent on TPU-VM images); importing this module
+is safe without it — only calling :func:`dataset_as_rdd` requires a live
+session.
+"""
+
+from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+
+
+def dataset_as_rdd(dataset_url, spark_session, schema_fields=None,
+                   storage_options=None):
+    """RDD of schema-named rows for the petastorm dataset at ``dataset_url``.
+
+    ``schema_fields``: optional list of field names (or regex patterns, as
+    ``create_schema_view`` accepts) restricting the view — executors then
+    only decode the requested columns.
+    """
+    from petastorm_tpu.utils import decode_row
+
+    schema = get_schema_from_dataset_url(dataset_url,
+                                         storage_options=storage_options)
+    view = schema.create_schema_view(schema_fields) if schema_fields else schema
+
+    dataframe = spark_session.read.parquet(dataset_url)
+    if schema_fields:
+        # Prune at the parquet scan, not per-row in python — unrequested
+        # (often image-sized) columns must never reach the executors.
+        dataframe = dataframe.select(list(view.fields))
+
+    def to_row(spark_row):
+        encoded = spark_row.asDict()
+        decoded = decode_row(
+            {k: v for k, v in encoded.items() if k in view.fields}, view)
+        return view.make_namedtuple_from_dict(decoded)
+
+    return dataframe.rdd.map(to_row)

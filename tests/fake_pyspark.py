@@ -120,9 +120,84 @@ class _Conf(object):
         self._values[key] = value
 
 
+class FakeRow(object):
+    """pyspark.sql.Row stand-in: attribute access + asDict()."""
+
+    def __init__(self, values):
+        self._values = dict(values)
+
+    def asDict(self):
+        return dict(self._values)
+
+    def __getattr__(self, name):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+class FakeRDD(object):
+    def __init__(self, items):
+        self._items = list(items)
+
+    def map(self, fn):
+        return FakeRDD(fn(i) for i in self._items)
+
+    def flatMap(self, fn):
+        return FakeRDD(x for i in self._items for x in fn(i))
+
+    def collect(self):
+        return list(self._items)
+
+    def count(self):
+        return len(self._items)
+
+    def take(self, n):
+        return self._items[:n]
+
+
+class _ReadDataFrame(object):
+    """Result of session.read.parquet: .select() prunes columns (recorded in
+    ``selected_columns`` so tests can assert scan-level pruning), .rdd yields
+    FakeRows."""
+
+    def __init__(self, table):
+        self._table = table
+        self.selected_columns = None
+
+    def select(self, columns):
+        pruned = _ReadDataFrame(self._table.select(list(columns)))
+        pruned.selected_columns = list(columns)
+        return pruned
+
+    @property
+    def rdd(self):
+        table = self._table
+        return FakeRDD(
+            FakeRow({name: table.column(name)[i].as_py()
+                     for name in table.column_names})
+            for i in range(table.num_rows))
+
+
+class _ParquetReader(object):
+    """session.read.parquet(url) -> DataFrame-ish with .select and .rdd."""
+
+    def __init__(self, session):
+        self._session = session
+
+    def parquet(self, url):
+        import pyarrow.parquet as pq
+        assert url.startswith('file://'), url
+        return _ReadDataFrame(pq.read_table(url[len('file://'):]))
+
+
 class FakeSparkSession(object):
     def __init__(self, conf=None):
         self.conf = _Conf(conf or {})
+
+    @property
+    def read(self):
+        return _ParquetReader(self)
 
 
 class _Field(object):

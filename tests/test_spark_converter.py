@@ -256,3 +256,35 @@ def test_make_spark_converter_explicit_url_and_float64(tmp_path):
     assert batch['weight'].dtype == torch.float64
     assert batch['features'].shape == (8, 4)
     conv.delete()
+
+
+def test_dataset_as_rdd(tmp_path):
+    """Reference petastorm/spark_utils.py :: dataset_as_rdd over the fake
+    session: executors decode codec cells back to schema namedtuples."""
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    from fake_pyspark import FakeSparkSession
+
+    url = 'file://' + str(tmp_path / 'rdd_ds')
+    S = Unischema('S', [
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField('vec', np.float32, (3,), NdarrayCodec(), False),
+    ])
+    with DatasetWriter(url, S, rows_per_rowgroup=4) as w:
+        w.write_many({'id': np.int64(i), 'vec': np.full(3, i, np.float32)}
+                     for i in range(12))
+
+    rdd = dataset_as_rdd(url, FakeSparkSession())
+    rows = rdd.collect()
+    assert rdd.count() == 12
+    assert sorted(int(r.id) for r in rows) == list(range(12))
+    by_id = {int(r.id): r for r in rows}
+    np.testing.assert_array_equal(by_id[5].vec, np.full(3, 5, np.float32))
+
+    # schema_fields view: only requested columns decoded
+    view_rows = dataset_as_rdd(url, FakeSparkSession(),
+                               schema_fields=['id']).collect()
+    assert not hasattr(view_rows[0], 'vec')
+    assert sorted(int(r.id) for r in view_rows) == list(range(12))
