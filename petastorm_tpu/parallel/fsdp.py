@@ -51,6 +51,10 @@ def fsdp_shardings(params, mesh, data_axis='data', min_shard_elements=2 ** 14,
         base += [None] * (len(shape) - len(base))
         if int(np.prod(shape, dtype=np.int64)) < min_shard_elements:
             return as_spec(base)
+        taken = {axis for entry in base if entry is not None
+                 for axis in (entry if isinstance(entry, tuple) else (entry,))}
+        if data_axis in taken:  # base spec already spends the data axis
+            return as_spec(base)
         # Largest free, divisible dimension gets the data axis.
         candidates = [(dim, i) for i, dim in enumerate(shape)
                       if base[i] is None and dim % axis_size == 0]

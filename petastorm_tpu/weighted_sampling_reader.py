@@ -1,0 +1,98 @@
+"""Mix several readers into one stream by sampling probability.
+
+Parity: reference ``petastorm/weighted_sampling_reader.py ::
+WeightedSamplingReader`` — each ``next`` draws one of the underlying readers
+with the configured probability (dataset mixing for curriculum/multi-corpus
+training).  Extensions beyond the reference: an explicit ``seed`` for
+reproducible mixing, and ``exhaust='stop'|'drop'`` policy (the reference
+stops the whole stream when any constituent exhausts; ``'drop'`` renormalizes
+over the remaining readers instead).
+"""
+
+import numpy as np
+
+
+class WeightedSamplingReader(object):
+    """Iterator over rows drawn from ``readers`` with ``probabilities``.
+
+    All readers must share row shape conventions (same schema family and the
+    same ``batched_output``); the mixed stream exposes the first reader's
+    ``schema``/``ngram``/``batched_output`` so downstream adapters
+    (``make_petastorm_dataset``, torch/JAX loaders) treat it like a plain
+    reader.
+    """
+
+    def __init__(self, readers, probabilities, seed=None, exhaust='stop'):
+        if len(readers) < 1:
+            raise ValueError('Need at least one reader')
+        if len(readers) != len(probabilities):
+            raise ValueError('readers and probabilities must align (%d vs %d)'
+                             % (len(readers), len(probabilities)))
+        if exhaust not in ('stop', 'drop'):
+            raise ValueError("exhaust must be 'stop' or 'drop'")
+        weights = np.asarray(probabilities, dtype=np.float64)
+        if (weights < 0).any() or weights.sum() <= 0:
+            raise ValueError('probabilities must be non-negative with a '
+                             'positive sum')
+        self._readers = list(readers)       # active (drop mode removes)
+        self._all_readers = list(readers)   # lifecycle targets
+        self._orig_weights = weights / weights.sum()
+        self._weights = self._orig_weights.copy()
+        self._rng = np.random.default_rng(seed)
+        self._exhaust = exhaust
+        first = self._readers[0]
+        self.schema = first.schema
+        self.ngram = getattr(first, 'ngram', None)
+        self.batched_output = getattr(first, 'batched_output', False)
+        for other in self._readers[1:]:
+            if getattr(other, 'batched_output', False) != self.batched_output:
+                raise ValueError('All readers must have the same '
+                                 'batched_output mode')
+        self.last_row_consumed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while self._readers:
+            idx = int(self._rng.choice(len(self._weights), p=self._weights))
+            try:
+                return next(self._readers[idx])
+            except StopIteration:
+                if self._exhaust == 'stop':
+                    self.last_row_consumed = True
+                    raise
+                del self._readers[idx]
+                weights = np.delete(self._weights, idx)
+                if not len(weights) or weights.sum() <= 0:
+                    break
+                self._weights = weights / weights.sum()
+        self.last_row_consumed = True
+        raise StopIteration
+
+    def next(self):
+        return self.__next__()
+
+    # -- lifecycle (delegates to every constituent) --------------------------
+
+    def stop(self):
+        for reader in self._all_readers:
+            reader.stop()
+
+    def join(self):
+        for reader in self._all_readers:
+            reader.join()
+
+    def reset(self):
+        for reader in self._all_readers:
+            reader.reset()
+        self._readers = list(self._all_readers)  # drop mode: restore mix
+        self._weights = self._orig_weights.copy()
+        self.last_row_consumed = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.stop()
+        self.join()

@@ -62,6 +62,15 @@ def test_composes_with_base_spec(mesh):
     assert shardings['embed']['table'].spec == P('data')
 
 
+def test_base_spec_already_using_data_axis(mesh):
+    """Regression: a base spec that already spends the data axis must pass
+    through untouched, not produce a duplicate-axis spec."""
+    shardings = fsdp_shardings(
+        _params(), mesh, base_spec_fn=lambda path: P('data'))
+    assert shardings['dense']['kernel'].spec == P('data')
+    assert shardings['embed']['table'].spec == P('data')
+
+
 def test_indivisible_dims_stay_on_base(mesh):
     params = {'odd': jnp.zeros((17, 33), jnp.float32)}  # nothing divides by 4
     shardings = fsdp_shardings(params, mesh, min_shard_elements=1)

