@@ -10,8 +10,10 @@ ICI torus:
   ring via ``jax.lax.ppermute`` (one neighbour hop per step, so traffic rides
   ICI links, never DCN).  Softmax is computed *online* (flash-attention
   style running max / running sum), so the full [seq, seq] score matrix is
-  never materialised — memory is O(seq_local²) per step and the K/V rotation
-  overlaps with the block matmuls under XLA's async collective scheduler.
+  never materialised — memory is O(seq_local²) per step, or
+  O(seq_local × block_k) with ``block_k`` chunking (rematerialized, so the
+  bound holds through the backward pass too); the K/V rotation overlaps
+  with the block matmuls under XLA's async collective scheduler.
 
 * ``ulysses_attention`` — all-to-all head↔sequence re-sharding: each device
   trades its sequence shard for a head shard (``jax.lax.all_to_all``), runs
@@ -47,15 +49,19 @@ def full_attention(q, k, v, causal=False, scale=None):
     return jnp.einsum('bhqk,bkhd->bqhd', p, v)
 
 
-def _online_block(q, k, v, o, l, m, q_offset, kv_offset, causal, scale):
+def _online_block(q, k, v, o, l, m, q_offset, kv_offset, causal, scale,
+                  kv_valid=None):
     """Fold one K/V block into the running (o, l, m) accumulator.
 
     o: [b, q, h, d] unnormalised output, l: [b, h, q] running softmax
     denominator, m: [b, h, q] running max.  ``q_offset``/``kv_offset`` are
     the blocks' global sequence positions (for the causal mask).
+    ``kv_valid``: positions >= it in this K block are padding (chunked path).
     """
     s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                    preferred_element_type=jnp.float32) * scale
+    if kv_valid is not None:
+        s = jnp.where(jnp.arange(k.shape[1])[None, :] < kv_valid, s, NEG_INF)
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
         k_pos = kv_offset + jnp.arange(k.shape[1])[None, :]
@@ -71,19 +77,41 @@ def _online_block(q, k, v, o, l, m, q_offset, kv_offset, causal, scale):
     return o_new, l_new, m_new
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   block_k=None):
     """Ring attention over a sharded sequence axis — call inside shard_map.
 
     Arguments are the *local* blocks ``[batch, seq_local, heads, head_dim]``
     of arrays whose sequence dim is sharded over mesh axis ``axis_name``.
     Runs ``axis_size`` steps; step i computes Q·K_blockᵀ against the K/V
     block that started ``i`` hops up-ring, then rotates K/V one hop down.
+
+    ``block_k``: also chunk each hop's K/V block, bounding the per-step
+    score tile to [b, h, seq_local, block_k] in BOTH directions — the
+    chunk fold is rematerialized (``jax.checkpoint``), so the backward
+    pass recomputes probability tiles instead of storing them.  Set it
+    when seq_local² scores would not fit (e.g. 128k context over 8
+    devices).  K/V are padded/re-laid-out once before the ring loop and
+    rotate in chunked layout.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, q_len, h, d = q.shape
     kv_len = k.shape[1]
+
+    if block_k is not None:
+        if block_k < 1:
+            raise ValueError('block_k must be >= 1, got %r' % (block_k,))
+        pad = (-kv_len) % block_k
+        if pad:
+            k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        n_chunks = (kv_len + pad) // block_k
+        # [n_chunks, b, block_k, h, d]: chunked once here; ppermute rotates
+        # this layout (pad < block_k extra rows of ICI traffic per hop).
+        k = jnp.moveaxis(k.reshape(b, n_chunks, block_k, h, d), 1, 0)
+        v = jnp.moveaxis(v.reshape(b, n_chunks, block_k, h, d), 1, 0)
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     o = jnp.zeros((b, q_len, h, d), jnp.float32)
@@ -93,10 +121,29 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     def body(i, carry):
         o, l, m, k_blk, v_blk = carry
         kv_idx = (my_idx - i) % axis_size  # origin of the block in hand
-        o, l, m = _online_block(q, k_blk, v_blk, o, l, m,
-                                q_offset=my_idx * q_len,
-                                kv_offset=kv_idx * kv_len,
-                                causal=causal, scale=scale)
+        if block_k is not None:
+            def fold(acc, xs):
+                kc, vc, j = xs
+
+                def one_chunk(q_, kc_, vc_, o_, l_, m_, j_):
+                    return _online_block(
+                        q_, kc_, vc_, o_, l_, m_,
+                        q_offset=my_idx * q_len,
+                        kv_offset=kv_idx * kv_len + j_ * block_k,
+                        causal=causal, scale=scale,
+                        kv_valid=kv_len - j_ * block_k)
+
+                # Remat: backward recomputes this chunk's tile rather than
+                # saving [b, h, q, block_k] residuals for every chunk.
+                return jax.checkpoint(one_chunk)(q, kc, vc, *acc, j), None
+
+            (o, l, m), _ = jax.lax.scan(
+                fold, (o, l, m), (k_blk, v_blk, jnp.arange(n_chunks)))
+        else:
+            o, l, m = _online_block(q, k_blk, v_blk, o, l, m,
+                                    q_offset=my_idx * q_len,
+                                    kv_offset=kv_idx * kv_len,
+                                    causal=causal, scale=scale)
         # Rotate even on the last step (balanced cost; XLA overlaps it).
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
@@ -145,7 +192,8 @@ def _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis=None):
 
 
 def make_ring_attention(mesh, seq_axis='seq', batch_axis='data',
-                        head_axis=None, causal=False, scale=None):
+                        head_axis=None, causal=False, scale=None,
+                        block_k=None):
     """shard_map-wrapped ring attention over ``mesh``.
 
     Returns ``(fn, sharding)``: ``fn(q, k, v)`` on global arrays
@@ -156,7 +204,7 @@ def make_ring_attention(mesh, seq_axis='seq', batch_axis='data',
     inputs should be placed with.
     """
     inner = functools.partial(ring_attention, axis_name=seq_axis,
-                              causal=causal, scale=scale)
+                              causal=causal, scale=scale, block_k=block_k)
     return _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis)
 
 
