@@ -10,10 +10,11 @@ ICI torus:
   ring via ``jax.lax.ppermute`` (one neighbour hop per step, so traffic rides
   ICI links, never DCN).  Softmax is computed *online* (flash-attention
   style running max / running sum), so the full [seq, seq] score matrix is
-  never materialised — memory is O(seq_local²) per step, or
-  O(seq_local × block_k) with ``block_k`` chunking (rematerialized, so the
-  bound holds through the backward pass too); the K/V rotation overlaps
-  with the block matmuls under XLA's async collective scheduler.
+  never materialised — score-tile memory is O(seq_local²) per step, or
+  O(seq_local × block_k) with ``block_k`` chunking (hop and chunk folds
+  rematerialized: backward recomputes tiles and stores only accumulator
+  carries, linear in seq_local); the K/V rotation overlaps with the block
+  matmuls under XLA's async collective scheduler.
 
 * ``ulysses_attention`` — all-to-all head↔sequence re-sharding: each device
   trades its sequence shard for a head shard (``jax.lax.all_to_all``), runs
@@ -86,13 +87,16 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     Runs ``axis_size`` steps; step i computes Q·K_blockᵀ against the K/V
     block that started ``i`` hops up-ring, then rotates K/V one hop down.
 
-    ``block_k``: also chunk each hop's K/V block, bounding the per-step
-    score tile to [b, h, seq_local, block_k] in BOTH directions — the
-    chunk fold is rematerialized (``jax.checkpoint``), so the backward
-    pass recomputes probability tiles instead of storing them.  Set it
-    when seq_local² scores would not fit (e.g. 128k context over 8
-    devices).  K/V are padded/re-laid-out once before the ring loop and
-    rotate in chunked layout.
+    ``block_k``: also chunk each hop's K/V block, bounding every score
+    tile (forward AND backward — hop folds and chunk folds are both
+    rematerialized, so probability tiles are recomputed, never stored) to
+    [b, h, seq_local, block_k].  What backward does store is accumulator
+    carries: O(axis_size) copies across hops plus O(n_chunks) transient
+    copies while one hop recomputes — linear in seq_local, versus the
+    quadratic score tiles of the unchunked path.  Set it when seq_local²
+    scores would not fit (e.g. 128k context over 8 devices).  K/V are
+    padded/re-laid-out once before the ring loop and rotate in chunked
+    layout; only the final padded chunk pays a validity mask.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     axis_size = jax.lax.psum(1, axis_name)
@@ -118,27 +122,49 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     l = jnp.zeros((b, h, q_len), jnp.float32)
     m = jnp.full((b, h, q_len), NEG_INF, jnp.float32)
 
+    if block_k is not None:
+        def hop_fold(q_, k_blk, v_blk, o, l, m, kv_idx):
+            def one_chunk(qc, kc, vc, oc, lc, mc, j, kv_valid):
+                return _online_block(
+                    qc, kc, vc, oc, lc, mc,
+                    q_offset=my_idx * q_len,
+                    kv_offset=kv_idx * kv_len + j * block_k,
+                    causal=causal, scale=scale, kv_valid=kv_valid)
+
+            def fold(acc, xs):
+                kc, vc, j = xs
+                # Remat: backward recomputes this chunk's tile rather than
+                # saving [b, h, q, block_k] residuals for every chunk.
+                full = jax.checkpoint(
+                    lambda a1, a2, a3, a4, a5, a6, a7:
+                        one_chunk(a1, a2, a3, a4, a5, a6, a7, None))
+                return full(q_, kc, vc, *acc, j), None
+
+            # Full chunks need no validity mask (pad is static): only the
+            # final padded chunk pays the compare+select over its tile.
+            n_full = n_chunks - 1 if pad else n_chunks
+            acc = (o, l, m)
+            if n_full:
+                acc, _ = jax.lax.scan(
+                    fold, acc,
+                    (k_blk[:n_full], v_blk[:n_full], jnp.arange(n_full)))
+            if pad:
+                j_last = n_chunks - 1
+                masked = jax.checkpoint(
+                    lambda a1, a2, a3, a4, a5, a6:
+                        one_chunk(a1, a2, a3, a4, a5, a6, j_last,
+                                  kv_len - j_last * block_k))
+                acc = masked(q_, k_blk[j_last], v_blk[j_last], *acc)
+            return acc
+
     def body(i, carry):
         o, l, m, k_blk, v_blk = carry
         kv_idx = (my_idx - i) % axis_size  # origin of the block in hand
         if block_k is not None:
-            def fold(acc, xs):
-                kc, vc, j = xs
-
-                def one_chunk(q_, kc_, vc_, o_, l_, m_, j_):
-                    return _online_block(
-                        q_, kc_, vc_, o_, l_, m_,
-                        q_offset=my_idx * q_len,
-                        kv_offset=kv_idx * kv_len + j_ * block_k,
-                        causal=causal, scale=scale,
-                        kv_valid=kv_len - j_ * block_k)
-
-                # Remat: backward recomputes this chunk's tile rather than
-                # saving [b, h, q, block_k] residuals for every chunk.
-                return jax.checkpoint(one_chunk)(q, kc, vc, *acc, j), None
-
-            (o, l, m), _ = jax.lax.scan(
-                fold, (o, l, m), (k_blk, v_blk, jnp.arange(n_chunks)))
+            # Hop-level remat bounds cross-hop residuals to the (o, l, m)
+            # carries; tiles and chunk carries are recomputed per hop.
+            o, l, m = jax.checkpoint(hop_fold)(q, k_blk, v_blk, o, l, m,
+                                               kv_idx)
         else:
             o, l, m = _online_block(q, k_blk, v_blk, o, l, m,
                                     q_offset=my_idx * q_len,

@@ -1,0 +1,8 @@
+"""Public test utilities for downstream users of the framework.
+
+Parity: reference ``petastorm/test_util/reader_mock.py :: ReaderMock`` —
+a synthetic in-memory reader so adapter/integration tests don't need a
+materialized Parquet dataset.
+"""
+
+from petastorm_tpu.test_util.reader_mock import ReaderMock, schema_data_generator  # noqa: F401

@@ -1,0 +1,96 @@
+"""Synthetic reader for testing adapters without a dataset on disk.
+
+Parity: reference ``petastorm/test_util/reader_mock.py :: ReaderMock``.
+Generates rows straight from a :class:`~petastorm_tpu.unischema.Unischema`
+(deterministic per row index), walks and quacks like a
+:class:`~petastorm_tpu.reader.Reader` (iterator protocol, ``schema``,
+``ngram``, ``batched_output``, ``stop/join/reset``, context manager), and
+plugs into every adapter (``make_petastorm_dataset``, torch loaders,
+``petastorm_tpu.jax.DataLoader``).
+"""
+
+import decimal
+
+import numpy as np
+
+
+def schema_data_generator(schema, index, rng=None):
+    """One deterministic row dict for ``schema`` at row ``index``."""
+    rng = rng or np.random.default_rng(index)
+    row = {}
+    for name, field in schema.fields.items():
+        dtype = np.dtype(field.numpy_dtype)
+        shape = tuple(d if d is not None else 4
+                      for d in (field.shape or ()))
+        if dtype.kind in ('U', 'S', 'O'):
+            row[name] = '%s_%d' % (name, index)
+        elif dtype.kind == 'f':
+            row[name] = (np.full(shape, index, dtype) if shape
+                         else dtype.type(index))
+        elif dtype.kind in ('i', 'u'):
+            row[name] = (rng.integers(0, 127, shape).astype(dtype) if shape
+                         else dtype.type(index))
+        elif dtype.kind == 'b':
+            row[name] = (np.full(shape, index % 2, dtype) if shape
+                         else dtype.type(index % 2))
+        elif dtype.kind == 'M':
+            row[name] = np.datetime64('2020-01-01') + np.timedelta64(index, 'D')
+        else:
+            row[name] = dtype.type(index)
+    return row
+
+
+class ReaderMock(object):
+    """Iterator of synthetic schema rows.
+
+    ``num_rows=None`` streams forever (the reference mock's behavior);
+    bounded mocks raise ``StopIteration`` after ``num_rows`` and support
+    ``reset()``.
+    """
+
+    def __init__(self, schema, data_generator=schema_data_generator,
+                 num_rows=None):
+        self.schema = schema
+        self.ngram = None
+        self.batched_output = False
+        self.last_row_consumed = False
+        self._generator = data_generator
+        self._num_rows = num_rows
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._num_rows is not None and self._index >= self._num_rows:
+            self.last_row_consumed = True
+            raise StopIteration
+        row = self._generator(self.schema, self._index)
+        self._index += 1
+        return self.schema.make_namedtuple_from_dict(row)
+
+    def next(self):
+        return self.__next__()
+
+    def reset(self):
+        if not self.last_row_consumed:
+            # Mirror the real Reader's guard: a mock that permitted
+            # mid-iteration reset would green-light adapter code that
+            # crashes on the genuine article.
+            raise NotImplementedError(
+                'reset() mid-iteration is not supported (matches Reader)')
+        self._index = 0
+        self.last_row_consumed = False
+
+    def stop(self):
+        pass
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.stop()
+        self.join()
