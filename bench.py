@@ -282,9 +282,44 @@ def _start_watchdog(budget_s):
     return timer
 
 
+def _device_probe_ok(timeout_s=90):
+    """Can a fresh interpreter initialize the configured JAX backend?
+
+    Probed in a subprocess because a wedged TPU tunnel makes backend init
+    block indefinitely (observed: even ``jax.devices()`` hangs) — a hang in
+    a child is a timeout here, not a hang there."""
+    import subprocess
+    try:
+        probe = subprocess.run(
+            [sys.executable, '-c', 'import jax; jax.devices()'],
+            timeout=timeout_s, capture_output=True)
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _reexec_cpu_fallback():
+    """Re-exec this bench on the CPU backend (sitecustomize hook stripped).
+
+    The host-side pipeline (parquet read -> native decode -> columnar
+    collate) is the framework's own work and measures fine against the
+    reference strategy on any backend; only the TPU train legs need the
+    chip.  The JSON is labeled so nobody mistakes it for a TPU number."""
+    env = dict(os.environ)
+    env['PYTHONPATH'] = ''
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PETASTORM_TPU_BENCH_CPU_FALLBACK'] = '1'
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
 def main():
     watchdog = _start_watchdog(
         int(os.environ.get('PETASTORM_TPU_BENCH_BUDGET_S', '900')))
+    cpu_fallback = bool(os.environ.get('PETASTORM_TPU_BENCH_CPU_FALLBACK'))
+    if not cpu_fallback and not _device_probe_ok():
+        sys.stderr.write('bench: TPU backend init wedged; re-running the '
+                         'host-pipeline legs on the CPU backend\n')
+        _reexec_cpu_fallback()
     ensure_dataset()
     import jax
     jax.jit(lambda x: x + 1)(np.zeros(8))  # backend warmup outside timing
@@ -299,6 +334,25 @@ def main():
         ours.append(tpu_native_epoch())
         theirs.append(reference_strategy_epoch())
     ours, theirs = max(ours), max(theirs)
+
+    if cpu_fallback:
+        # ResNet-50 train legs need the chip (~30 s/step on host CPU);
+        # report the host-pipeline comparison and say what's missing.
+        result = {
+            'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
+            'value': round(ours, 1),
+            'unit': 'images/s',
+            'vs_baseline': round(ours / theirs, 2),
+            'host_cores': os.cpu_count(),
+            'backend': 'cpu-fallback (TPU tunnel wedged at bench time; '
+                       'host decode/collate pipeline vs reference strategy '
+                       'is backend-independent)',
+            'baseline': 'reference delivery strategy, %.1f images/s' % theirs,
+            'stall_pct': None,
+        }
+        watchdog.cancel()
+        print(json.dumps(result))
+        return
 
     stall = train_stall_legs()
 

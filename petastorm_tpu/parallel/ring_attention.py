@@ -136,8 +136,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
                 # Remat: backward recomputes this chunk's tile rather than
                 # saving [b, h, q, block_k] residuals for every chunk.
                 full = jax.checkpoint(
-                    lambda a1, a2, a3, a4, a5, a6, a7:
-                        one_chunk(a1, a2, a3, a4, a5, a6, a7, None))
+                    functools.partial(one_chunk, kv_valid=None))
                 return full(q_, kc, vc, *acc, j), None
 
             # Full chunks need no validity mask (pad is static): only the
@@ -151,9 +150,8 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
             if pad:
                 j_last = n_chunks - 1
                 masked = jax.checkpoint(
-                    lambda a1, a2, a3, a4, a5, a6:
-                        one_chunk(a1, a2, a3, a4, a5, a6, j_last,
-                                  kv_len - j_last * block_k))
+                    functools.partial(one_chunk, j=j_last,
+                                      kv_valid=kv_len - j_last * block_k))
                 acc = masked(q_, k_blk[j_last], v_blk[j_last], *acc)
             return acc
 

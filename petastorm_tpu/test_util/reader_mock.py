@@ -9,8 +9,6 @@ plugs into every adapter (``make_petastorm_dataset``, torch loaders,
 ``petastorm_tpu.jax.DataLoader``).
 """
 
-import decimal
-
 import numpy as np
 
 

@@ -107,9 +107,10 @@ def test_ring_chunked_matches_dense(qkv, causal, block_k):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_ring_chunked_gradients_match_dense(qkv):
+@pytest.mark.parametrize('block_k', [4, 3])  # 3 exercises the masked tail
+def test_ring_chunked_gradients_match_dense(qkv, block_k):
     mesh = make_mesh({'seq': 8})
-    fn, sharding = make_ring_attention(mesh, causal=True, block_k=4)
+    fn, sharding = make_ring_attention(mesh, causal=True, block_k=block_k)
     q, k, v = _place(mesh, sharding, *qkv)
 
     def loss_ring(q, k, v):
