@@ -306,7 +306,7 @@ def _reexec_cpu_fallback():
     reference strategy on any backend; only the TPU train legs need the
     chip.  The JSON is labeled so nobody mistakes it for a TPU number."""
     env = dict(os.environ)
-    env['PYTHONPATH'] = ''
+    env.pop('PYTHONPATH', None)  # the axon sitecustomize hook rides on it
     env['JAX_PLATFORMS'] = 'cpu'
     env['PETASTORM_TPU_BENCH_CPU_FALLBACK'] = '1'
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)

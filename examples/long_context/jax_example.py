@@ -42,12 +42,18 @@ def main():
                         choices=['auto', 'flash', 'ring', 'ulysses', 'dense'])
     parser.add_argument('--batch-size', type=int, default=8)
     parser.add_argument('--steps', type=int, default=30)
+    parser.add_argument('--block-k', type=int, default=None,
+                        help='chunk ring-attention score tiles (memory cap '
+                             'for very long local sequences)')
     args = parser.parse_args()
 
     n_dev = len(jax.devices())
     strategy = args.strategy
     if strategy == 'auto':
         strategy = 'ring' if n_dev > 1 else 'flash'
+    if args.block_k is not None and strategy != 'ring':
+        parser.error('--block-k only applies to the ring strategy '
+                     '(resolved strategy: %s)' % strategy)
 
     if strategy in ('ring', 'ulysses'):
         sp = 2 if n_dev % 2 == 0 else 1
@@ -67,7 +73,8 @@ def main():
 
     model = TransformerLM(
         vocab_size=VOCAB, d_model=256, num_heads=8, num_layers=4, d_ff=1024,
-        max_seq_len=SEQ_LEN, attn_fn=make_attn_fn(mesh, strategy, head_axis=None),
+        max_seq_len=SEQ_LEN, attn_fn=make_attn_fn(mesh, strategy, head_axis=None,
+                                             block_k=args.block_k),
         remat=True)
     rng = jax.random.PRNGKey(0)
     init_tokens = jnp.zeros((mesh.shape['data'], SEQ_LEN), jnp.int32)

@@ -155,11 +155,14 @@ def param_shardings(params, mesh, model_axis='model'):
 
 
 def make_attn_fn(mesh=None, strategy='flash', seq_axis='seq',
-                 batch_axis='data', head_axis='model'):
+                 batch_axis='data', head_axis='model', block_k=None):
     """Attention implementation for a (mesh, strategy) pair.
 
     'flash'   — Pallas kernel, no sequence sharding (or inside Ulysses).
-    'ring'    — K/V rotate the ICI ring over ``seq_axis`` (longest contexts).
+    'ring'    — K/V rotate the ICI ring over ``seq_axis`` (longest contexts);
+                ``block_k`` additionally chunks each hop's score tile (set
+                it when seq_local² would not fit — see
+                ``parallel.ring_attention``).
     'ulysses' — all-to-all seq<->head reshard, flash locally.
     'dense'   — O(seq²) oracle (tests only).
     """
@@ -173,7 +176,8 @@ def make_attn_fn(mesh=None, strategy='flash', seq_axis='seq',
         raise ValueError('strategy %r needs a mesh' % (strategy,))
     if strategy == 'ring':
         fn, _ = make_ring_attention(mesh, seq_axis=seq_axis, batch_axis=batch_axis,
-                                    head_axis=head_axis, causal=True)
+                                    head_axis=head_axis, causal=True,
+                                    block_k=block_k)
     elif strategy == 'ulysses':
         fn, _ = make_ulysses_attention(
             mesh, seq_axis=seq_axis, batch_axis=batch_axis, head_axis=head_axis,

@@ -5,4 +5,7 @@ a synthetic in-memory reader so adapter/integration tests don't need a
 materialized Parquet dataset.
 """
 
+from petastorm_tpu.test_util.fault_injection import (  # noqa: F401
+    FlakyOpenFilesystem, FlakyReadFilesystem, is_data_file,
+)
 from petastorm_tpu.test_util.reader_mock import ReaderMock, schema_data_generator  # noqa: F401

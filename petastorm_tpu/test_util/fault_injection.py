@@ -1,0 +1,72 @@
+"""Fault-injection filesystems for exercising the retry/poisoning plane.
+
+No reference equivalent (SURVEY.md §5.3: the reference has no fault
+injection hooks); these are the public counterpart to the framework's
+transient-retry + ``PoisonedRowGroupError`` machinery — wrap any fsspec
+filesystem and pass it as ``make_reader(..., filesystem=...)`` to simulate
+GCS flakes deterministically.
+
+Only *data* files (``*.parquet`` not starting with ``_``) are failed:
+footer/metadata reads happen at reader construction, which deliberately has
+no retry layer.
+"""
+
+import threading
+
+
+def is_data_file(path):
+    """True for row-group data files (``*.parquet`` not ``_``-prefixed)."""
+    name = path.rsplit('/', 1)[-1]
+    return name.endswith('.parquet') and not name.startswith('_')
+
+
+_is_data_file = is_data_file  # module-internal alias
+
+
+class FlakyOpenFilesystem(object):
+    """Delegating fs whose first ``fail_times`` opens of each data file raise
+    OSError."""
+
+    def __init__(self, real_fs, fail_times):
+        self._real = real_fs
+        self._fail_times = fail_times
+        self._counts = {}
+        self._lock = threading.Lock()
+
+    def open(self, path, *args, **kwargs):
+        if _is_data_file(path):
+            with self._lock:
+                n = self._counts.get(path, 0)
+                self._counts[path] = n + 1
+            if n < self._fail_times:
+                raise OSError('injected transient open failure #%d on %s' % (n, path))
+        return self._real.open(path, *args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+class FlakyReadFilesystem(FlakyOpenFilesystem):
+    """First open of each data file succeeds but the handle dies on first
+    read — exercises eviction of a wedged cached handle."""
+
+    def open(self, path, *args, **kwargs):
+        handle = self._real.open(path, *args, **kwargs)
+        if _is_data_file(path):
+            with self._lock:
+                n = self._counts.get(path, 0)
+                self._counts[path] = n + 1
+            if n < self._fail_times:
+                return _DyingFile(handle)
+        return handle
+
+
+class _DyingFile(object):
+    def __init__(self, inner):
+        self._inner = inner
+
+    def read(self, *args, **kwargs):
+        raise OSError('injected read failure')
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -3,69 +3,15 @@ row-group surfacing (SURVEY.md §5.3 build obligation; no reference
 equivalent — the reference surfaces a bare worker exception with no retry).
 """
 
-import threading
 
 import fsspec
 import pytest
 
+from petastorm_tpu.test_util import (
+    FlakyOpenFilesystem, FlakyReadFilesystem, is_data_file)
 from petastorm_tpu import make_reader, make_batch_reader
 from petastorm_tpu.errors import PoisonedRowGroupError
 from tests.test_common import assert_rows_equal, create_test_dataset
-
-
-def _is_data_file(path):
-    name = path.rsplit('/', 1)[-1]
-    return name.endswith('.parquet') and not name.startswith('_')
-
-
-class FlakyOpenFilesystem(object):
-    """Delegating fs whose first ``fail_times`` opens of each data file raise
-    OSError (footer/metadata files are untouched, so reader construction —
-    which has no retry layer — is unaffected)."""
-
-    def __init__(self, real_fs, fail_times):
-        self._real = real_fs
-        self._fail_times = fail_times
-        self._counts = {}
-        self._lock = threading.Lock()
-
-    def open(self, path, *args, **kwargs):
-        if _is_data_file(path):
-            with self._lock:
-                n = self._counts.get(path, 0)
-                self._counts[path] = n + 1
-            if n < self._fail_times:
-                raise OSError('injected transient open failure #%d on %s' % (n, path))
-        return self._real.open(path, *args, **kwargs)
-
-    def __getattr__(self, name):
-        return getattr(self._real, name)
-
-
-class FlakyReadFilesystem(FlakyOpenFilesystem):
-    """First open of each data file succeeds but the handle dies on first
-    read — exercises eviction of a wedged cached handle."""
-
-    def open(self, path, *args, **kwargs):
-        handle = self._real.open(path, *args, **kwargs)
-        if _is_data_file(path):
-            with self._lock:
-                n = self._counts.get(path, 0)
-                self._counts[path] = n + 1
-            if n < self._fail_times:
-                return _DyingFile(handle)
-        return handle
-
-
-class _DyingFile(object):
-    def __init__(self, inner):
-        self._inner = inner
-
-    def read(self, *args, **kwargs):
-        raise OSError('injected read failure')
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
 
 
 @pytest.fixture(scope='module')
@@ -148,7 +94,7 @@ class CorruptDataFilesystem(FlakyOpenFilesystem):
 
     def open(self, path, *args, **kwargs):
         handle = self._real.open(path, *args, **kwargs)
-        if _is_data_file(path):
+        if is_data_file(path):
             return _CorruptFile(handle)
         return handle
 
