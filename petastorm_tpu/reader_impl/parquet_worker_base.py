@@ -38,6 +38,15 @@ PERMANENT_IO_ERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
                        NotADirectoryError)
 
 
+def _is_plain_local(fs):
+    """Exactly fsspec's LocalFileSystem — not a subclass or wrapper."""
+    try:
+        from fsspec.implementations.local import LocalFileSystem
+    except ImportError:
+        return False
+    return type(fs) is LocalFileSystem
+
+
 class ParquetWorkerBase(WorkerBase):
     """File-handle caching + retry; subclasses implement the decode logic."""
 
@@ -54,8 +63,16 @@ class ParquetWorkerBase(WorkerBase):
     def _parquet_file(self, path):
         entry = self._open_files.get(path)
         if entry is None:
-            handle = self._a.filesystem.open(path, 'rb')
-            entry = (handle, pq.ParquetFile(handle))
+            fs = self._a.filesystem
+            if _is_plain_local(fs):
+                # Local files skip the python file-object layer entirely:
+                # pyarrow mmaps the path natively (~2x on page reads).  Exact
+                # type check — delegating wrappers (fault injection, tests)
+                # must keep flowing through fs.open().
+                entry = (None, pq.ParquetFile(path, memory_map=True))
+            else:
+                handle = fs.open(path, 'rb')
+                entry = (handle, pq.ParquetFile(handle))
             self._open_files[path] = entry
         return entry[1]
 
@@ -64,14 +81,17 @@ class ParquetWorkerBase(WorkerBase):
         entry = self._open_files.pop(path, None)
         if entry is not None:
             try:
-                entry[0].close()
+                (entry[0] or entry[1]).close()
             except Exception:  # noqa: BLE001 — handle may already be broken
                 pass
 
     def shutdown(self):
-        for handle, _ in self._open_files.values():
+        for handle, parquet_file in self._open_files.values():
             try:
-                handle.close()
+                # Local mmap entries have no fsspec handle; close the
+                # ParquetFile itself so the mapped fd is released now, not
+                # at GC time.
+                (handle or parquet_file).close()
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
         self._open_files.clear()
