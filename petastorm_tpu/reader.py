@@ -323,9 +323,14 @@ class Reader(object):
         self._worker_args = worker_args
         start_epoch = start_cursor = 0
         if resume_state is not None:
-            start_epoch = resume_state.get('epoch', 0)
-            start_cursor = resume_state.get('cursor', 0)
-            self._seed = resume_state.get('seed', self._seed)
+            # Checkpoint round-trips (orbax) restore int leaves as 0-d numpy
+            # arrays; normalize here so callers pass tokens back verbatim.
+            def as_int(value, default):
+                return default if value is None else int(value)
+            start_epoch = as_int(resume_state.get('epoch'), 0)
+            start_cursor = as_int(resume_state.get('cursor'), 0)
+            seed = resume_state.get('seed', self._seed)
+            self._seed = seed if seed is None else int(seed)
         self._start(start_epoch, start_cursor)
 
     def _start(self, start_epoch=0, start_cursor=0):
