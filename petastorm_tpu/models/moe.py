@@ -1,0 +1,175 @@
+"""Mixture-of-Experts FFN with all-to-all expert parallelism.
+
+No reference equivalent (the reference is a data library — SURVEY.md §2.6);
+this is the transformer-side EP obligation, the GShard/Switch pattern done
+the XLA way:
+
+* **Routing** — Switch top-1: a replicated router picks one expert per
+  token; the gate probability scales the expert output (so router gradients
+  flow through the gate).
+* **Capacity** — each expert accepts ``capacity`` token slots per device
+  per step (``capacity_factor`` × fair share); overflow tokens are dropped
+  (contribute zero), the standard fixed-shape trick that keeps everything
+  static for XLA.
+* **Dispatch** — one-hot dispatch/combine tensors turn routing into
+  einsums (MXU work, no gathers), and two ``lax.all_to_all``s move token
+  slots to the devices that own the experts and back — ICI traffic only,
+  inside ``jax.shard_map``.
+
+``moe_apply`` is the single-device oracle (all experts everywhere);
+``make_expert_parallel_moe`` returns the sharded twin + param shardings.
+Tested equal to the oracle (forward and gradients) on the CPU mesh.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _a2a(x, axis_name, split_axis, concat_axis):
+    """``lax.all_to_all`` with a hand-written transpose.
+
+    The stock transpose rule in this jax version returns the cotangent with
+    the split/concat dims swapped (verified: a [El, ep, ...] cotangent comes
+    back [ep, El, ...] and lowering fails); an all_to_all's transpose is
+    simply the reverse all_to_all, written out here.
+    """
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis)
+
+
+def _a2a_fwd(x, axis_name, split_axis, concat_axis):
+    return _a2a(x, axis_name, split_axis, concat_axis), None
+
+
+def _a2a_bwd(axis_name, split_axis, concat_axis, _, g):
+    return (_a2a(g, axis_name, concat_axis, split_axis),)
+
+
+_a2a.defvjp(_a2a_fwd, _a2a_bwd)
+
+
+def moe_init(rng, d_model, d_ff, num_experts, dtype=jnp.float32):
+    """{'router': [d, E], 'w1': [E, d, f], 'w2': [E, f, d]}."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale1 = 1.0 / np.sqrt(d_model)
+    scale2 = 1.0 / np.sqrt(d_ff)
+    return {
+        'router': (jax.random.normal(k1, (d_model, num_experts)) * scale1).astype(dtype),
+        'w1': (jax.random.normal(k2, (num_experts, d_model, d_ff)) * scale1).astype(dtype),
+        'w2': (jax.random.normal(k3, (num_experts, d_ff, d_model)) * scale2).astype(dtype),
+    }
+
+
+def _route(params, x, capacity):
+    """Switch top-1 dispatch/combine tensors for local tokens ``x [T, d]``.
+
+    Returns (dispatch [T, E, C] one-hot slots, combine = dispatch * gate).
+    Tokens beyond an expert's capacity get all-zero rows (dropped).
+    """
+    logits = x @ params['router']                     # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)               # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
+    onehot = jax.nn.one_hot(expert, params['router'].shape[1],
+                            dtype=jnp.float32)        # [T, E]
+    # Slot index of each token within its expert (arrival order).
+    slot = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # [T, E]
+    kept = onehot * (slot < capacity)
+    dispatch = kept[:, :, None] * jax.nn.one_hot(
+        slot.astype(jnp.int32), capacity, dtype=jnp.float32)  # [T, E, C]
+    combine = dispatch * gate[:, None, None].astype(jnp.float32)
+    return dispatch, combine
+
+
+def _expert_ffn(w1, w2, xs):
+    """Per-expert FFN over slot buffers ``xs [E?, C?, d]`` (vmapped over E)."""
+    return jax.vmap(lambda a, b, x: jax.nn.relu(x @ a) @ b)(w1, w2, xs)
+
+
+def moe_apply(params, x, capacity_factor=2.0):
+    """Single-device oracle: dense dispatch to every expert, no collectives.
+
+    ``x``: [T, d] tokens; returns [T, d].
+    """
+    num_experts = params['router'].shape[1]
+    capacity = _capacity(x.shape[0], num_experts, capacity_factor)
+    dispatch, combine = _route(params, x, capacity)
+    xs = jnp.einsum('tec,td->ecd', dispatch, x.astype(jnp.float32))
+    ys = _expert_ffn(params['w1'].astype(jnp.float32),
+                     params['w2'].astype(jnp.float32), xs)
+    return jnp.einsum('tec,ecd->td', combine, ys).astype(x.dtype)
+
+
+def _capacity(tokens, num_experts, capacity_factor):
+    return max(1, int(np.ceil(tokens * capacity_factor / num_experts)))
+
+
+def make_expert_parallel_moe(mesh, num_experts, expert_axis='expert',
+                             batch_axis='data', capacity_factor=2.0):
+    """shard_map-wrapped MoE over ``mesh``: experts sharded over
+    ``expert_axis`` (leading E axis of w1/w2), tokens over ``batch_axis``.
+
+    Tokens shard over BOTH axes (the expert axis does double duty as extra
+    data parallelism — the standard GShard layout, so no device routes a
+    token twice); experts shard over ``expert_axis`` alone, the router is
+    replicated.
+
+    Returns ``(fn, param_shardings_fn, token_sharding)``: ``fn(params, x)``
+    on global ``x [T, d]`` placed with ``token_sharding``;
+    ``param_shardings_fn(params)`` places the params.  ``num_experts`` must
+    be divisible by the expert-axis size.
+    """
+    ep = mesh.shape[expert_axis] if expert_axis in mesh.axis_names else 1
+    if num_experts % max(ep, 1):
+        raise ValueError('num_experts=%d not divisible by %r axis size %d'
+                         % (num_experts, expert_axis, ep))
+    experts_local = num_experts // ep
+
+    def inner(params, x):
+        # x: [T_local, d]; every device routes its own tokens.
+        capacity = _capacity(x.shape[0], num_experts, capacity_factor)
+        dispatch, combine = _route(params, x, capacity)
+        xs = jnp.einsum('tec,td->ecd', dispatch,
+                        x.astype(jnp.float32))        # [E, C, d]
+        d = xs.shape[-1]
+        if ep > 1:
+            # Send each expert block to its owner; receive my experts' slot
+            # buffers from every peer: [E, C, d] -> [El, ep, C, d] (dim 1
+            # indexes the source peer) -> [El, ep*C, d].
+            xs = _a2a(xs.reshape(ep, experts_local, capacity, d),
+                      expert_axis, 0, 1)
+            xs = xs.reshape(experts_local, ep * capacity, d)
+        ys = _expert_ffn(params['w1'].astype(jnp.float32),
+                         params['w2'].astype(jnp.float32), xs)
+        if ep > 1:
+            # Route results back to the tokens' home devices:
+            # [El, ep*C, d] -> [ep, El, C, d] -> [E, C, d], the same
+            # expert-major order the forward reshape used.
+            ys = _a2a(ys.reshape(experts_local, ep, capacity, d),
+                      expert_axis, 1, 0)
+            ys = ys.reshape(num_experts, capacity, d)
+        return jnp.einsum('tec,ecd->td', combine, ys).astype(x.dtype)
+
+    expert_spec = expert_axis if expert_axis in mesh.axis_names else None
+    token_axes = tuple(ax for ax in (batch_axis, expert_axis)
+                       if ax in mesh.axis_names)
+    token_spec = P(token_axes) if token_axes else P()
+    fn = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=({'router': P(), 'w1': P(expert_spec), 'w2': P(expert_spec)},
+                  token_spec),
+        out_specs=token_spec)
+
+    def param_shardings(params):
+        return {
+            'router': NamedSharding(mesh, P()),
+            'w1': NamedSharding(mesh, P(expert_spec)),
+            'w2': NamedSharding(mesh, P(expert_spec)),
+        }
+
+    return fn, param_shardings, NamedSharding(mesh, token_spec)
