@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from petastorm_tpu import make_reader
 from petastorm_tpu.benchmark import StallMonitor
-from petastorm_tpu.jax import DataLoader
+from petastorm_tpu.jax import DataLoader, augment
 from petastorm_tpu.models.resnet import ResNet50
 from petastorm_tpu.parallel import data_parallel_sharding, make_mesh
 from petastorm_tpu.transform import TransformSpec
@@ -62,8 +62,15 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1):
     opt_state = tx.init(params)
 
     @jax.jit
-    def train_step(params, batch_stats, opt_state, images, labels):
-        images = images.astype(jnp.float32) / 127.5 - 1.0
+    def train_step(params, batch_stats, opt_state, images, labels, key):
+        # Augmentation runs ON DEVICE (petastorm_tpu.jax.augment): the host
+        # pool only decodes; flips/crops are bandwidth-trivial for the chip
+        # and fuse into the first conv under XLA.
+        k_crop, k_flip = jax.random.split(key)
+        images = augment.random_crop(k_crop, images, images.shape[1:3],
+                                     padding=4)
+        images = augment.random_flip_left_right(k_flip, images)
+        images = augment.normalize(images, dtype=jnp.float32)
 
         def loss_fn(p):
             logits, mutated = model.apply(
@@ -83,9 +90,12 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1):
                      transform_spec=make_transform(image_hw), columnar_decode=True,
                      num_epochs=None, workers_count=8) as reader:
         loader = DataLoader(reader, batch_size=batch_size, sharding=sharding)
+        step_key = jax.random.PRNGKey(17)
         for batch in monitor.wrap(loader):
+            step_key, key = jax.random.split(step_key)
             params, batch_stats, opt_state, loss = train_step(
-                params, batch_stats, opt_state, batch['image'], batch['label'])
+                params, batch_stats, opt_state, batch['image'], batch['label'],
+                key)
             done += 1
             if done >= steps:
                 break

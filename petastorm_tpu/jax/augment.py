@@ -1,0 +1,225 @@
+"""Device-side image augmentation: jitted, keyed-RNG, static-shape ops.
+
+TPU-first rationale: the reference pushes all preprocessing into host worker
+pools (reference analog: ``petastorm/transform.py :: TransformSpec`` — the
+only augmentation hook it has), which is the right place for *decode* but
+the wrong place for *augmentation* on a TPU host: the host core budget is
+the pipeline bottleneck (see ``docs/performance.md``), while random crops /
+flips / color jitter are trivially cheap, bandwidth-bound elementwise work
+for the chip and fuse into the first convolution under XLA.  Every op here:
+
+* takes a ``jax.random`` key first — pure, reproducible, vmap/pjit-safe;
+* is static-shape (per-sample crops use clamped ``dynamic_slice``, never
+  data-dependent shapes), so nothing recompiles step to step;
+* consumes the loader's uint8 NHWC batches directly (transfer stays 4x
+  cheaper than f32; normalization happens on-device at the end).
+
+Typical wiring — augment INSIDE the jitted train step, downstream of the
+``DataLoader``::
+
+    @jax.jit
+    def train_step(params, ..., images_u8, labels, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        x = augment.random_crop(k1, images_u8, (224, 224), padding=8)
+        x = augment.random_flip_left_right(k2, x)
+        x = augment.normalize(x, IMAGENET_MEAN, IMAGENET_STD)   # -> bf16
+        x, la, lb, lam = augment.mixup(k3, x, labels, alpha=0.2)
+        ...
+
+Under a data-parallel mesh the batch axis is sharded; the per-sample ops
+(crop, flip, color, cutout, normalize) partition with zero collectives.
+:func:`mixup` and :func:`cutmix` combine each sample with a *shuffled
+partner*, so with a sharded batch axis XLA realizes ``x[perm]`` with a
+cross-device gather — cheap relative to a train step, but not free; apply
+them per-host (e.g. in the loader's ``transform_fn``) if ICI budget is
+tight.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    'IMAGENET_MEAN', 'IMAGENET_STD',
+    'normalize', 'center_crop', 'random_crop', 'random_flip_left_right',
+    'random_brightness', 'random_contrast', 'random_saturation',
+    'color_jitter', 'random_cutout', 'mixup', 'cutmix', 'mixup_loss',
+]
+
+#: ImageNet channel statistics in 0..255 scale (match torchvision's
+#: 0..1-scale constants times 255).
+IMAGENET_MEAN = (123.675, 116.28, 103.53)
+IMAGENET_STD = (58.395, 57.12, 57.375)
+
+
+def _as_float(images):
+    """uint8 -> f32 in 0..255; float inputs pass through unchanged."""
+    if jnp.issubdtype(images.dtype, jnp.integer):
+        return images.astype(jnp.float32)
+    return images
+
+
+def normalize(images, mean=IMAGENET_MEAN, std=IMAGENET_STD,
+              dtype=jnp.bfloat16):
+    """Channel-wise ``(x - mean) / std`` -> ``dtype`` (default bf16 for MXU).
+
+    ``mean``/``std`` are in the same scale as the input (0..255 for the
+    loader's uint8 batches).
+    """
+    x = _as_float(images)
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    return ((x - mean) / std).astype(dtype)
+
+
+def center_crop(images, crop_hw):
+    """Static center crop of NHWC ``images`` to ``crop_hw = (ch, cw)``."""
+    ch, cw = crop_hw
+    h, w = images.shape[1], images.shape[2]
+    if ch > h or cw > w:
+        raise ValueError('crop %r larger than image %r' % (crop_hw, (h, w)))
+    top, left = (h - ch) // 2, (w - cw) // 2
+    return images[:, top:top + ch, left:left + cw, :]
+
+
+def random_crop(key, images, crop_hw, padding=0):
+    """Per-sample random crop (optionally zero-padding first).
+
+    ``images``: NHWC.  With ``padding=p`` the image is zero-padded by ``p``
+    on each spatial side before cropping (the CIFAR/ImageNet-style "pad and
+    crop" augmentation).  Crop offsets are uniform per sample; shapes stay
+    static (``dynamic_slice`` with clamped starts).
+    """
+    ch, cw = crop_hw
+    if padding:
+        images = jnp.pad(
+            images, ((0, 0), (padding, padding), (padding, padding), (0, 0)))
+    n, h, w, c = images.shape
+    if ch > h or cw > w:
+        raise ValueError('crop %r larger than padded image %r'
+                         % (crop_hw, (h, w)))
+    kt, kl = jax.random.split(key)
+    tops = jax.random.randint(kt, (n,), 0, h - ch + 1)
+    lefts = jax.random.randint(kl, (n,), 0, w - cw + 1)
+
+    def crop_one(img, top, left):
+        return jax.lax.dynamic_slice(img, (top, left, 0), (ch, cw, c))
+
+    return jax.vmap(crop_one)(images, tops, lefts)
+
+
+def random_flip_left_right(key, images, prob=0.5):
+    """Per-sample horizontal flip with probability ``prob``."""
+    n = images.shape[0]
+    flip = jax.random.bernoulli(key, prob, (n,))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+def random_brightness(key, images, max_delta=0.125):
+    """Additive brightness jitter: ``x + u*255``, ``u ~ U(-d, d)`` per sample.
+
+    Output is f32 in 0..255 scale (clipped); feed to :func:`normalize` last.
+    """
+    x = _as_float(images)
+    n = x.shape[0]
+    delta = jax.random.uniform(key, (n, 1, 1, 1), minval=-max_delta,
+                               maxval=max_delta) * 255.0
+    return jnp.clip(x + delta, 0.0, 255.0)
+
+
+def random_contrast(key, images, lower=0.8, upper=1.2):
+    """Per-sample contrast: ``(x - mean_sample) * f + mean_sample``."""
+    x = _as_float(images)
+    n = x.shape[0]
+    f = jax.random.uniform(key, (n, 1, 1, 1), minval=lower, maxval=upper)
+    mean = jnp.mean(x, axis=(1, 2, 3), keepdims=True)
+    return jnp.clip((x - mean) * f + mean, 0.0, 255.0)
+
+
+def random_saturation(key, images, lower=0.8, upper=1.2):
+    """Per-sample saturation: blend with the grayscale (Rec.601) image."""
+    x = _as_float(images)
+    n = x.shape[0]
+    f = jax.random.uniform(key, (n, 1, 1, 1), minval=lower, maxval=upper)
+    gray = (0.299 * x[..., 0:1] + 0.587 * x[..., 1:2] + 0.114 * x[..., 2:3])
+    return jnp.clip(gray + (x - gray) * f, 0.0, 255.0)
+
+
+def color_jitter(key, images, brightness=0.125, contrast=0.2, saturation=0.2):
+    """Brightness -> contrast -> saturation jitter (each per-sample)."""
+    kb, kc, ks = jax.random.split(key, 3)
+    x = random_brightness(kb, images, brightness)
+    x = random_contrast(kc, x, 1.0 - contrast, 1.0 + contrast)
+    return random_saturation(ks, x, 1.0 - saturation, 1.0 + saturation)
+
+
+def random_cutout(key, images, size, fill=0.0):
+    """Zero out one random ``size x size`` square per sample (DeVries &
+    Taylor 2017).  The mask is built from broadcasted iotas — static shapes,
+    squares clamp at image borders like the paper's implementation.
+    """
+    n, h, w, _ = images.shape
+    ky, kx = jax.random.split(key)
+    cy = jax.random.randint(ky, (n, 1, 1), 0, h)
+    cx = jax.random.randint(kx, (n, 1, 1), 0, w)
+    ys = jnp.arange(h)[None, :, None]
+    xs = jnp.arange(w)[None, None, :]
+    half = size // 2
+    inside = ((ys >= cy - half) & (ys < cy + (size - half)) &
+              (xs >= cx - half) & (xs < cx + (size - half)))
+    fill = jnp.asarray(fill, images.dtype)
+    return jnp.where(inside[..., None], fill, images)
+
+
+def mixup(key, images, labels, alpha=0.2):
+    """Batch mixup (Zhang et al. 2018): convex-combine each sample with a
+    shuffled partner.
+
+    Returns ``(mixed_images, labels_a, labels_b, lam)``; train with
+    :func:`mixup_loss`.  ``lam`` is a scalar Beta(alpha, alpha) draw shared
+    by the batch (the paper's formulation — keeps the op a cheap
+    batch-axis-parallel lerp).
+    """
+    x = _as_float(images)
+    k_lam, k_perm = jax.random.split(key)
+    lam = jax.random.beta(k_lam, alpha, alpha)
+    perm = jax.random.permutation(k_perm, x.shape[0])
+    mixed = lam * x + (1.0 - lam) * x[perm]
+    return mixed, labels, labels[perm], lam
+
+
+def cutmix(key, images, labels, alpha=1.0):
+    """CutMix (Yun et al. 2019): paste a random rectangle from a shuffled
+    partner; label weight = kept-area fraction.
+
+    Returns ``(mixed_images, labels_a, labels_b, lam)`` with ``lam`` the
+    *actual* area fraction of the original image kept (recomputed after
+    border clamping, as in the paper).
+    """
+    x = _as_float(images)
+    n, h, w, _ = x.shape
+    k_lam, k_perm, ky, kx = jax.random.split(key, 4)
+    lam0 = jax.random.beta(k_lam, alpha, alpha)
+    perm = jax.random.permutation(k_perm, n)
+    ratio = jnp.sqrt(1.0 - lam0)
+    cut_h = (ratio * h).astype(jnp.int32)
+    cut_w = (ratio * w).astype(jnp.int32)
+    cy = jax.random.randint(ky, (), 0, h)
+    cx = jax.random.randint(kx, (), 0, w)
+    y0 = jnp.clip(cy - cut_h // 2, 0, h)
+    y1 = jnp.clip(cy + cut_h // 2, 0, h)
+    x0 = jnp.clip(cx - cut_w // 2, 0, w)
+    x1 = jnp.clip(cx + cut_w // 2, 0, w)
+    ys = jnp.arange(h)[:, None]
+    xs = jnp.arange(w)[None, :]
+    inside = ((ys >= y0) & (ys < y1) & (xs >= x0) & (xs < x1))
+    mixed = jnp.where(inside[None, :, :, None], x[perm], x)
+    lam = 1.0 - ((y1 - y0) * (x1 - x0)) / (h * w)
+    return mixed, labels, labels[perm], lam
+
+
+def mixup_loss(logits, labels_a, labels_b, lam):
+    """Convex cross-entropy for :func:`mixup` / :func:`cutmix` targets."""
+    import optax
+    la = optax.softmax_cross_entropy_with_integer_labels(logits, labels_a)
+    lb = optax.softmax_cross_entropy_with_integer_labels(logits, labels_b)
+    return (lam * la + (1.0 - lam) * lb).mean()

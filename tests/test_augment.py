@@ -1,0 +1,161 @@
+"""Device-side augmentation ops: correctness, determinism, jit/SPMD safety."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu.jax import augment
+
+
+@pytest.fixture(scope='module')
+def batch():
+    rng = np.random.default_rng(0)
+    return rng.integers(0, 256, (8, 16, 20, 3), np.uint8)
+
+
+def test_normalize_scale_and_dtype(batch):
+    out = augment.normalize(batch, mean=(10.0, 10.0, 10.0),
+                            std=(2.0, 2.0, 2.0), dtype=jnp.float32)
+    expected = (batch.astype(np.float32) - 10.0) / 2.0
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+    assert augment.normalize(batch).dtype == jnp.bfloat16
+
+
+def test_center_crop(batch):
+    out = augment.center_crop(batch, (8, 10))
+    np.testing.assert_array_equal(np.asarray(out), batch[:, 4:12, 5:15, :])
+    with pytest.raises(ValueError):
+        augment.center_crop(batch, (64, 64))
+
+
+def test_random_crop_contents_come_from_source(batch):
+    key = jax.random.PRNGKey(1)
+    out = np.asarray(augment.random_crop(key, batch, (8, 8)))
+    assert out.shape == (8, 8, 8, 3)
+    # Every crop must appear verbatim somewhere in its source image.
+    for i in range(batch.shape[0]):
+        found = any(
+            np.array_equal(out[i], batch[i, t:t + 8, l:l + 8, :])
+            for t in range(16 - 8 + 1) for l in range(20 - 8 + 1))
+        assert found, 'crop %d not a contiguous window of its source' % i
+
+
+def test_random_crop_padding_allows_full_size(batch):
+    key = jax.random.PRNGKey(2)
+    out = augment.random_crop(key, batch, (16, 20), padding=4)
+    assert out.shape == batch.shape
+
+
+def test_random_flip_is_flip_or_identity(batch):
+    key = jax.random.PRNGKey(3)
+    out = np.asarray(augment.random_flip_left_right(key, batch))
+    flipped = batch[:, :, ::-1, :]
+    for i in range(batch.shape[0]):
+        assert (np.array_equal(out[i], batch[i])
+                or np.array_equal(out[i], flipped[i]))
+    assert not np.array_equal(out, batch), 'prob=0.5 over 8 samples flipped none'
+    all_flipped = np.asarray(
+        augment.random_flip_left_right(key, batch, prob=1.0))
+    np.testing.assert_array_equal(all_flipped, flipped)
+
+
+def test_color_ops_stay_in_range_and_vary_per_sample(batch):
+    key = jax.random.PRNGKey(4)
+    for op in (augment.random_brightness, augment.random_contrast,
+               augment.random_saturation, augment.color_jitter):
+        out = np.asarray(op(key, batch))
+        assert out.min() >= 0.0 and out.max() <= 255.0
+        deltas = [np.abs(out[i] - batch[i].astype(np.float32)).mean()
+                  for i in range(batch.shape[0])]
+        assert len({round(d, 3) for d in deltas}) > 1, (
+            '%s applied the same jitter to every sample' % op.__name__)
+
+
+def test_cutout_area(batch):
+    key = jax.random.PRNGKey(5)
+    out = np.asarray(augment.random_cutout(key, batch, size=6, fill=0))
+    changed = (out != batch).any(axis=-1)
+    for i in range(batch.shape[0]):
+        n = changed[i].sum()
+        assert 0 < n <= 36, 'cutout area %d outside (0, 36]' % n
+        ys, xs = np.nonzero(changed[i])
+        # the changed region is a solid rectangle (clamped square)
+        assert n == (ys.max() - ys.min() + 1) * (xs.max() - xs.min() + 1)
+
+
+def test_mixup_convexity(batch):
+    key = jax.random.PRNGKey(6)
+    labels = jnp.arange(batch.shape[0])
+    mixed, la, lb, lam = augment.mixup(key, batch, labels, alpha=0.3)
+    lam = float(lam)
+    assert 0.0 <= lam <= 1.0
+    x = batch.astype(np.float32)
+    mn = np.minimum.reduce([x[i] for i in range(len(x))]).min()
+    mx = np.maximum.reduce([x[i] for i in range(len(x))]).max()
+    assert np.asarray(mixed).min() >= mn and np.asarray(mixed).max() <= mx
+    np.testing.assert_array_equal(np.asarray(la), np.arange(8))
+
+
+def test_cutmix_lam_matches_pasted_area(batch):
+    key = jax.random.PRNGKey(7)
+    labels = jnp.arange(batch.shape[0])
+    mixed, la, lb, lam = augment.cutmix(key, batch, labels, alpha=1.0)
+    mixed = np.asarray(mixed)
+    perm_used = np.asarray(lb)
+    # Where the batch got pasted, pixels equal the partner image.
+    kept = np.isclose(mixed, batch.astype(np.float32)).all(axis=(1, 2, 3))
+    frac_kept_pixels = np.isclose(
+        mixed[0], batch[0].astype(np.float32)).all(axis=-1).mean()
+    if perm_used[0] != 0 and not kept[0]:
+        assert abs(frac_kept_pixels - float(lam)) < 0.15
+
+
+def test_mixup_loss_interpolates():
+    logits = jnp.array([[4.0, 0.0], [0.0, 4.0]])
+    la = jnp.array([0, 1])
+    lb = jnp.array([1, 0])
+    full = augment.mixup_loss(logits, la, lb, 1.0)
+    none = augment.mixup_loss(logits, la, lb, 0.0)
+    half = augment.mixup_loss(logits, la, lb, 0.5)
+    assert full < none
+    np.testing.assert_allclose(half, (full + none) / 2, rtol=1e-6)
+
+
+def test_same_key_same_result_jit(batch):
+    key = jax.random.PRNGKey(8)
+
+    def pipeline(key, x):
+        k1, k2, k3 = jax.random.split(key, 3)
+        x = augment.random_crop(k1, x, (8, 8), padding=2)
+        x = augment.random_flip_left_right(k2, x)
+        x = augment.random_cutout(k3, x, 3)
+        return augment.normalize(x, dtype=jnp.float32)
+
+    eager = pipeline(key, batch)
+    jitted = jax.jit(pipeline)(key, batch)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted),
+                               rtol=1e-5, atol=1e-5)
+    again = jax.jit(pipeline)(key, batch)
+    np.testing.assert_array_equal(np.asarray(jitted), np.asarray(again))
+
+
+def test_augment_under_data_parallel_sharding(batch):
+    """Ops must partition over a sharded batch axis with no host fallback."""
+    from petastorm_tpu.parallel import data_parallel_sharding, make_mesh
+
+    mesh = make_mesh()
+    sharding = data_parallel_sharding(mesh)
+    global_batch = jax.device_put(batch, sharding)
+    key = jax.random.PRNGKey(9)
+
+    @jax.jit
+    def step(key, x):
+        k1, k2 = jax.random.split(key)
+        x = augment.random_crop(k1, x, (8, 8))
+        x = augment.random_flip_left_right(k2, x)
+        return augment.normalize(x, dtype=jnp.float32).mean()
+
+    out = step(key, global_batch)
+    assert np.isfinite(float(out))
