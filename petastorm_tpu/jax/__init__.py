@@ -5,7 +5,7 @@ North star (BASELINE.json): ``petastorm.jax.DataLoader`` — double-buffered
 row-group sharding by ``jax.process_index()``.
 """
 
-from petastorm_tpu.jax import augment  # noqa: F401
+from petastorm_tpu.jax import augment, packing  # noqa: F401
 from petastorm_tpu.jax.loader import (DataLoader,  # noqa: F401
                                       DeviceInMemDataLoader, InMemDataLoader,
                                       make_jax_loader)

@@ -1,0 +1,237 @@
+"""Sequence packing: fixed-shape batches from variable-length sequences.
+
+XLA compiles one program per shape, so variable-length sequences must
+become static shapes before they reach the chip.  Naive padding wastes
+FLOPs quadratically (attention) on pad tokens; *packing* lays several
+sequences end-to-end in one row of length ``max_len`` and tracks ownership
+with ``segment_ids``, recovering most of the padding waste (the approach
+of T5's pack_dataset and jax grain's pack-and-batch; no reference analog —
+the closest reference machinery is host-side window assembly in
+``petastorm/ngram.py :: NGram``, which emits per-window rows and leaves
+batching shape problems to the consumer).
+
+Host side (numpy, runs in the loader's worker pool or ``transform_fn``):
+
+* :func:`pack_sequences` — pack a list of 1-D token arrays into
+  ``(rows, max_len)`` with first-fit-decreasing (offline, best utilization).
+* :func:`pack_stream` — streaming greedy packer: wraps any iterator of
+  sequences (e.g. a reader column) and yields fixed-shape batches forever
+  ready for ``device_put``.
+
+Device side (jitted):
+
+* :func:`segment_mask` — block-diagonal (optionally causal) attention mask
+  from segment ids.
+* :func:`packed_attention` — dense attention restricted to segments; same
+  ``[batch, seq, heads, head_dim]`` convention as
+  ``petastorm_tpu.ops.flash_attention`` and a drop-in ``attn_fn`` for
+  ``models.transformer.TransformerLM`` via ``functools.partial``.
+* :func:`next_token_targets` — LM targets + loss weights that never cross
+  a packing boundary.
+
+Packing invariant used throughout: segments within a row are CONTIGUOUS
+(sequence i occupies one unbroken span), so "causal within segment" equals
+"row-causal AND same segment" — a cheap mask, no per-segment position
+bookkeeping on device.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['pack_sequences', 'pack_stream', 'segment_mask',
+           'packed_attention', 'next_token_targets']
+
+
+def _emit(rows, max_len, dtype, pad_id):
+    """Render packed rows (lists of sequences) to the batch dict.
+
+    ``dtype=None`` promotes over the actual sequences in this batch (the
+    streaming packer can't know future dtypes, so each batch is exactly
+    wide enough for its own rows — never a silent narrowing cast).
+    """
+    n = len(rows)
+    if dtype is None:
+        dtype = np.result_type(*[s.dtype for seqs in rows for s in seqs])
+    tokens = np.full((n, max_len), pad_id, dtype)
+    segment_ids = np.zeros((n, max_len), np.int32)
+    positions = np.zeros((n, max_len), np.int32)
+    for r, seqs in enumerate(rows):
+        off = 0
+        for s, seq in enumerate(seqs):
+            L = len(seq)
+            tokens[r, off:off + L] = seq
+            segment_ids[r, off:off + L] = s + 1
+            positions[r, off:off + L] = np.arange(L)
+            off += L
+    return {'tokens': tokens, 'segment_ids': segment_ids,
+            'positions': positions}
+
+
+def pack_sequences(sequences, max_len, pad_id=0):
+    """Pack 1-D arrays into ``(rows, max_len)`` via first-fit-decreasing.
+
+    Returns ``{'tokens', 'segment_ids', 'positions'}``; ``segment_ids`` is
+    1-based per row (0 marks padding), ``positions`` restarts at 0 for each
+    sequence.  Raises if any sequence exceeds ``max_len`` (truncation is a
+    modeling decision — do it upstream where the tokenizer lives).
+    """
+    seqs = [np.asarray(s) for s in sequences]
+    if not seqs:
+        raise ValueError('no sequences to pack')
+    for s in seqs:
+        if s.ndim != 1:
+            raise ValueError('expected 1-D sequences, got shape %r' % (s.shape,))
+        if len(s) > max_len:
+            raise ValueError('sequence of length %d exceeds max_len=%d; '
+                             'truncate upstream' % (len(s), max_len))
+    order = sorted(range(len(seqs)), key=lambda i: -len(seqs[i]))
+    rows, room = [], []
+    for i in order:
+        L = len(seqs[i])
+        for r in range(len(rows)):          # first fit
+            if room[r] >= L:
+                rows[r].append(seqs[i])
+                room[r] -= L
+                break
+        else:
+            rows.append([seqs[i]])
+            room.append(max_len - L)
+    return _emit(rows, max_len, np.result_type(*seqs), pad_id)
+
+
+def pack_stream(seq_iter, max_len, rows_per_batch, pad_id=0,
+                open_rows=32, drop_last=False):
+    """Greedy streaming packer: yields fixed-shape batches from an iterator.
+
+    Keeps up to ``open_rows`` partially-filled rows; each incoming sequence
+    goes to the fullest row it fits in (best-fit — keeps rows closing
+    fast), or opens a new row, and full-enough batches are emitted as soon
+    as ``rows_per_batch`` rows have closed.  The tail is flushed as a final
+    short-padded batch unless ``drop_last``.
+
+    Suited to wrapping a reader column::
+
+        seqs = (row.tokens for row in make_reader(url, ...))
+        for batch in pack_stream(seqs, max_len=4096, rows_per_batch=8):
+            step(batch['tokens'], batch['segment_ids'])
+    """
+    if rows_per_batch < 1 or open_rows < 1:
+        raise ValueError('rows_per_batch and open_rows must be >= 1')
+    open_ = []      # list of (room, [seqs])
+    closed = []
+
+    def close_fullest():
+        i = min(range(len(open_)), key=lambda j: open_[j][0])
+        closed.append(open_.pop(i)[1])
+
+    for seq in seq_iter:
+        seq = np.asarray(seq)
+        if seq.ndim != 1:
+            raise ValueError('expected 1-D sequences, got %r' % (seq.shape,))
+        if len(seq) > max_len:
+            raise ValueError('sequence of length %d exceeds max_len=%d'
+                             % (len(seq), max_len))
+        if len(seq) == max_len:     # exactly-full row: close it now
+            closed.append([seq])
+        else:
+            fits = [i for i, (room, _) in enumerate(open_)
+                    if room >= len(seq)]
+            if fits:
+                i = min(fits, key=lambda j: open_[j][0])   # best fit
+                room, seqs = open_[i]
+                seqs.append(seq)
+                open_[i] = (room - len(seq), seqs)
+                if open_[i][0] == 0:
+                    closed.append(open_.pop(i)[1])
+            else:
+                open_.append((max_len - len(seq), [seq]))
+                if len(open_) > open_rows:
+                    close_fullest()
+        while len(closed) >= rows_per_batch:
+            yield _emit(closed[:rows_per_batch], max_len, None, pad_id)
+            closed = closed[rows_per_batch:]
+    # drain
+    closed.extend(seqs for _, seqs in sorted(open_, key=lambda e: e[0]))
+    while len(closed) >= rows_per_batch:
+        yield _emit(closed[:rows_per_batch], max_len, None, pad_id)
+        closed = closed[rows_per_batch:]
+    if closed and not drop_last:
+        pad_rows = rows_per_batch - len(closed)
+        batch = _emit(closed, max_len, None, pad_id)
+        if pad_rows:
+            batch = {k: np.concatenate(
+                [v, np.zeros((pad_rows,) + v.shape[1:], v.dtype)])
+                for k, v in batch.items()}
+            if pad_id != 0:
+                batch['tokens'][-pad_rows:] = pad_id
+        yield batch
+
+
+def segment_mask(segment_ids_q, segment_ids_kv, causal=False):
+    """Boolean attention mask ``[batch, 1, len_q, len_kv]`` from segment ids.
+
+    A query may attend a key iff both carry the same NONZERO segment id;
+    with ``causal=True`` additionally key_pos <= query_pos (valid because
+    packed segments are contiguous — see module docstring).  The head axis
+    is kept size-1 for broadcast.
+    """
+    q = jnp.asarray(segment_ids_q)
+    kv = jnp.asarray(segment_ids_kv)
+    mask = (q[:, :, None] == kv[:, None, :]) & (q[:, :, None] != 0)
+    if causal:
+        lq, lkv = q.shape[-1], kv.shape[-1]
+        mask = mask & (jnp.arange(lkv)[None, :] <= jnp.arange(lq)[:, None])
+    return mask[:, None, :, :]
+
+
+def packed_attention(q, k, v, segment_ids, causal=True, scale=None):
+    """Dense attention over packed rows: segments never attend each other.
+
+    Same tensor convention as ``ops.flash_attention`` (``[batch, seq,
+    heads, head_dim]``); softmax statistics in fp32.  Use as the
+    ``attn_fn`` of ``models.transformer.TransformerLM``::
+
+        attn = functools.partial(packed_attention, segment_ids=seg)
+        TransformerLM(..., attn_fn=attn)
+
+    O(seq^2) score memory — the correctness oracle and the moderate-length
+    path; at long context pair packing with the flash/ring kernels by
+    masking at the loss instead (one doc per row).
+    """
+    if q.ndim != 4:
+        raise ValueError('expected [batch, seq, heads, head_dim], got %r'
+                         % (q.shape,))
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    mask = segment_mask(segment_ids, segment_ids, causal=causal)
+    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask, scores, -jnp.inf)
+    # Fully-masked query rows (padding) would softmax over -inf -> NaN;
+    # give them a finite row and zero them after.
+    any_valid = mask.any(axis=-1, keepdims=True)
+    scores = jnp.where(any_valid, scores, 0.0)
+    weights = jax.nn.softmax(scores, axis=-1)
+    weights = jnp.where(any_valid, weights, 0.0)
+    out = jnp.einsum('bhqk,bkhd->bqhd', weights.astype(q.dtype), v)
+    return out
+
+
+def next_token_targets(tokens, segment_ids):
+    """LM ``(targets, weights)`` that never cross a packing boundary.
+
+    ``targets[t] = tokens[t+1]``; ``weights[t] = 1`` only where position
+    ``t`` and ``t+1`` belong to the same nonzero segment (the last token of
+    each sequence and all padding get weight 0).  Works on numpy or jax
+    arrays; shapes ``[batch, seq]`` in, same out.
+    """
+    xp = jnp if isinstance(tokens, jnp.ndarray) else np
+    targets = xp.concatenate(
+        [tokens[:, 1:], xp.zeros_like(tokens[:, :1])], axis=1)
+    seg_next = xp.concatenate(
+        [segment_ids[:, 1:], xp.zeros_like(segment_ids[:, :1])], axis=1)
+    weights = ((segment_ids == seg_next) & (segment_ids != 0)).astype(
+        xp.float32)
+    return targets, weights
+

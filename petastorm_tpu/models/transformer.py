@@ -91,12 +91,17 @@ class TransformerLM(nn.Module):
     remat: bool = False  # jax.checkpoint each block: FLOPs for HBM
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None):
+        """``positions`` overrides the default row-absolute ``arange``
+        positions — pass ``packing.pack_*``'s per-segment ``positions`` so
+        each packed document is embedded as if it started at 0."""
         embed = nn.Embed(self.vocab_size, self.d_model, name='embed',
                          dtype=self.dtype)
         x = embed(tokens)
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
         pos = nn.Embed(self.max_seq_len, self.d_model, name='pos_embed',
-                       dtype=self.dtype)(jnp.arange(tokens.shape[1])[None, :])
+                       dtype=self.dtype)(positions)
         x = x + pos
         block = Block
         if self.remat:
