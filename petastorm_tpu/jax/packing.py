@@ -197,8 +197,8 @@ def packed_attention(q, k, v, segment_ids, causal=True, scale=None):
         TransformerLM(..., attn_fn=attn)
 
     O(seq^2) score memory — the correctness oracle and the moderate-length
-    path; at long context pair packing with the flash/ring kernels by
-    masking at the loss instead (one doc per row).
+    path; at long context use ``ops.flash_attention(..., segment_ids=seg)``
+    — the same semantics as Pallas kernels with O(seq) memory.
     """
     if q.ndim != 4:
         raise ValueError('expected [batch, seq, heads, head_dim], got %r'

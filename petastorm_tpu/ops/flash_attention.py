@@ -40,8 +40,12 @@ def _auto_interpret():
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                seq_len, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
+                seq_len, block_q, block_k, packed):
+    if packed:
+        sq_ref, sk_ref, o_ref, lse_ref = refs
+    else:
+        o_ref, lse_ref = refs
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [block_q, d]
     d = q.shape[-1]
@@ -63,6 +67,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         mask = k_pos < seq_len  # padded keys never attend
         if causal:
             mask &= q_pos >= k_pos
+        if packed:
+            # Packed rows: queries only see keys of their own NONZERO
+            # segment (0 marks padding in both roles).
+            sq = sq_ref[0, 0]                                   # [block_q]
+            sk = sk_ref[0, 0, pl.ds(kb * block_k, block_k)]     # [block_k]
+            mask &= (sq[:, None] == sk[None, :]) & (sq[:, None] != 0)
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_new))
@@ -85,18 +95,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
-def _fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k, interpret):
+def _fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k, packed,
+         heads, interpret):
     bh, seq_pad, d = q3.shape
     grid = (bh, seq_pad // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+    ]
+    args = [q3, k3, v3]
+    if packed:
+        # seg3 is [batch, 1, seq_pad]; every head of a batch row shares it,
+        # so the index map folds the (batch*heads) grid axis back down.
+        in_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i // heads, 0, j)),
+            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i // heads, 0, 0)),
+        ]
+        args += [seg3, seg3]
     return pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          seq_len=seq_len, block_q=block_q, block_k=block_k),
+                          seq_len=seq_len, block_q=block_q, block_k=block_k,
+                          packed=packed),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
@@ -106,15 +128,19 @@ def _fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, 1, seq_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*args)
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, causal, seq_len, block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                   scale, causal, seq_len, block_q, block_k, packed):
+    if packed:
+        sq_ref, sk_ref, dq_ref = refs
+    else:
+        (dq_ref,) = refs
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -138,6 +164,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
             mask &= q_pos >= k_pos
+        if packed:
+            sq = sq_ref[0, 0]
+            sk = sk_ref[0, 0, pl.ds(kb * block_k, block_k)]
+            mask &= (sq[:, None] == sk[None, :]) & (sq[:, None] != 0)
         # exp(s - lse) == softmax row (lse = m + log l); masked/empty rows
         # have lse == NEG_INF and p underflows to 0.
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
@@ -151,8 +181,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, seq_len, block_q, block_k):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                    scale, causal, seq_len, block_q, block_k, packed):
+    if packed:
+        sq_ref, sk_ref, dk_ref, dv_ref = refs
+    else:
+        dk_ref, dv_ref = refs
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)  # [block_k, d]
     v = v_ref[0].astype(jnp.float32)
@@ -174,6 +208,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
             mask &= q_pos >= k_pos
+        if packed:
+            sq_blk = sq_ref[0, 0, pl.ds(qb * block_q, block_q)]
+            sk = sk_ref[0, 0]
+            mask &= (sq_blk[:, None] == sk[None, :]) & (sq_blk[:, None] != 0)
         p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
         dv = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -190,41 +228,59 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, seq_len, block_q, block_k,
-         interpret):
+def _bwd(q3, k3, v3, seg3, o3, lse, do3, scale, causal, seq_len, block_q,
+         block_k, packed, heads, interpret):
     bh, seq_pad, d = q3.shape
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]  # [bh, 1, seq] like lse
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+    ]
+    dq_args = [q3, k3, v3, do3, lse, delta]
+    if packed:
+        dq_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i // heads, 0, j)),
+            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i // heads, 0, 0)),
+        ]
+        dq_args += [seg3, seg3]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          seq_len=seq_len, block_q=block_q, block_k=block_k),
+                          seq_len=seq_len, block_q=block_q, block_k=block_k,
+                          packed=packed),
         grid=(bh, seq_pad // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_pad, d), q3.dtype),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(*dq_args)
 
+    dkv_specs = [
+        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i, 0, 0)),
+    ]
+    dkv_args = [q3, k3, v3, do3, lse, delta]
+    if packed:
+        dkv_specs += [
+            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i // heads, 0, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda i, j: (i // heads, 0, j)),
+        ]
+        dkv_args += [seg3, seg3]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          seq_len=seq_len, block_q=block_q, block_k=block_k),
+                          seq_len=seq_len, block_q=block_q, block_k=block_k,
+                          packed=packed),
         grid=(bh, seq_pad // block_k),
-        in_specs=[
-            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -234,7 +290,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, seq_len, block_q, block_k,
             jax.ShapeDtypeStruct((bh, seq_pad, d), v3.dtype),
         ],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -242,34 +298,51 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, seq_len, block_q, block_k,
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q3, k3, v3, scale, causal, seq_len, block_q, block_k):
-    out, _ = _flash_fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k, packed,
+           heads):
+    out, _ = _flash_fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q,
+                        block_k, packed, heads)
     return out
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k):
-    out, lse = _fwd(q3, k3, v3, scale, causal, seq_len, block_q, block_k,
-                    interpret=_auto_interpret())
-    return out, (q3, k3, v3, out, lse)
+def _flash_fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k,
+               packed, heads):
+    out, lse = _fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q,
+                    block_k, packed, heads, interpret=_auto_interpret())
+    return out, (q3, k3, v3, seg3, out, lse)
 
 
-def _flash_bwd(scale, causal, seq_len, block_q, block_k, res, g):
-    q3, k3, v3, out, lse = res
-    return _bwd(q3, k3, v3, out, lse, g, scale, causal, seq_len,
-                block_q, block_k, interpret=_auto_interpret())
+def _flash_bwd(scale, causal, seq_len, block_q, block_k, packed, heads, res,
+               g):
+    import numpy as _np
+    q3, k3, v3, seg3, out, lse = res
+    dq, dk, dv = _bwd(q3, k3, v3, seg3, out, lse, g, scale, causal, seq_len,
+                      block_q, block_k, packed, heads,
+                      interpret=_auto_interpret())
+    # Integer operands take a float0 cotangent (segment ids are labels);
+    # the non-packed path carries seg3=None (empty pytree, no cotangent).
+    dseg = (None if seg3 is None
+            else _np.zeros(seg3.shape, dtype=jax.dtypes.float0))
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
+                    segment_ids=None):
     """Flash attention over ``[batch, seq, heads, head_dim]`` inputs.
 
     Drop-in for ``petastorm_tpu.parallel.full_attention`` (same signature and
     semantics, O(seq) memory).  Differentiable via the flash backward
     kernels.  Sequences are padded to the block size internally; padded keys
     are masked out, padded query rows are sliced off.
+
+    ``segment_ids`` (``[batch, seq]`` int, 0 = padding) restricts attention
+    to same-nonzero-segment pairs — the O(seq)-memory path for
+    ``petastorm_tpu.jax.packing`` packed rows (same semantics as
+    ``packing.packed_attention``, which is the dense oracle).
 
     Compiles to Mosaic on TPU; on CPU/GPU backends it runs the same kernels
     through the Pallas interpreter (tests, dry runs).
@@ -281,6 +354,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128)
     if kv_len != seq_len:
         raise ValueError('flash_attention requires seq_q == seq_kv (got %d vs %d)'
                          % (seq_len, kv_len))
+    packed = segment_ids is not None
+    if packed and tuple(segment_ids.shape) != (b, seq_len):
+        raise ValueError('segment_ids must be [batch, seq] = %r, got %r'
+                         % ((b, seq_len), tuple(segment_ids.shape)))
     scale = scale if scale is not None else d ** -0.5
 
     import math
@@ -306,6 +383,15 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128)
             x = jnp.pad(x, ((0, 0), (0, seq_pad - seq_len), (0, 0)))
         return x
 
-    out = _flash(to3(q), to3(k), to3(v), scale, causal, seq_len, block_q, block_k)
+    if packed:
+        seg = jnp.asarray(segment_ids, jnp.int32)
+        if seq_pad != seq_len:   # pad with 0 = "padding segment"
+            seg = jnp.pad(seg, ((0, 0), (0, seq_pad - seq_len)))
+        seg3 = seg[:, None, :]   # [b, 1, seq_pad]; heads share via index map
+    else:
+        seg3 = None
+
+    out = _flash(to3(q), to3(k), to3(v), seg3, scale, causal, seq_len,
+                 block_q, block_k, packed, h)
     out = out[:, :seq_len].reshape(b, h, seq_len, d)
     return jnp.moveaxis(out, 1, 2)

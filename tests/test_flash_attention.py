@@ -129,3 +129,86 @@ def test_jit_and_vmap_compose(rng):
     np.testing.assert_allclose(got[0], full_attention(q, k, v), atol=2e-5, rtol=2e-5)
     np.testing.assert_allclose(got[1], full_attention(q * 0.5, k * 0.5, v * 0.5),
                                atol=2e-5, rtol=2e-5)
+
+
+# -- packed (segment-restricted) flash ---------------------------------------
+
+def _segments(rng, b, s, max_segs=4):
+    """Random contiguous nonzero segments with a zero-padded tail."""
+    out = np.zeros((b, s), np.int32)
+    for r in range(b):
+        off = 0
+        for seg in range(1, max_segs + 1):
+            L = int(rng.integers(1, max(2, s // max_segs)))
+            if off + L > s - 2:
+                break
+            out[r, off:off + L] = seg
+            off += L
+    return jnp.asarray(out)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('seq', [64, 52])
+def test_packed_matches_packed_dense_oracle(rng, causal, seq):
+    from petastorm_tpu.jax.packing import packed_attention
+
+    q, k, v = _qkv(rng, s=seq)
+    seg = _segments(rng, 2, seq)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          segment_ids=seg)
+    want = packed_attention(q, k, v, seg, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_packed_gradients_match_oracle(rng, causal):
+    from petastorm_tpu.jax.packing import packed_attention
+
+    q, k, v = _qkv(rng, s=48)
+    seg = _segments(rng, 2, 48)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=causal, block_q=16,
+                               block_k=16, segment_ids=seg).sum()
+
+    def loss_dense(q, k, v):
+        return packed_attention(q, k, v, seg, causal=causal).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gd, 'qkv'):
+        np.testing.assert_allclose(a, b_, atol=3e-5, rtol=3e-5,
+                                   err_msg='d%s causal=%s' % (name, causal))
+
+
+def test_packed_no_cross_segment_leakage(rng):
+    """Perturbing segment 2's keys must not change segment 1's outputs."""
+    q, k, v = _qkv(rng, b=1, s=32)
+    seg = jnp.asarray(np.array([[1] * 10 + [2] * 12 + [0] * 10], np.int32))
+    base = flash_attention(q, k, v, block_q=16, block_k=16, segment_ids=seg)
+    k2 = k.at[:, 10:22].add(7.0)
+    v2 = v.at[:, 10:22].add(-3.0)
+    pert = flash_attention(q, k2, v2, block_q=16, block_k=16, segment_ids=seg)
+    np.testing.assert_allclose(base[:, :10], pert[:, :10], atol=1e-6)
+    assert not np.allclose(base[:, 10:22], pert[:, 10:22])
+    # padding rows output exactly zero
+    assert np.abs(np.asarray(base[:, 22:])).max() == 0.0
+
+
+def test_packed_rejects_bad_segment_shape(rng):
+    q, k, v = _qkv(rng, s=32)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, segment_ids=jnp.zeros((2, 16), jnp.int32))
+
+
+def test_packed_in_jit(rng):
+    q, k, v = _qkv(rng, s=32)
+    seg = _segments(rng, 2, 32)
+
+    @jax.jit
+    def f(q, k, v, seg):
+        return flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                               segment_ids=seg)
+
+    out = f(q, k, v, seg)
+    assert np.isfinite(np.asarray(out)).all()
