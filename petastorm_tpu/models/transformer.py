@@ -160,7 +160,8 @@ def param_shardings(params, mesh, model_axis='model'):
 
 
 def make_attn_fn(mesh=None, strategy='flash', seq_axis='seq',
-                 batch_axis='data', head_axis='model', block_k=None):
+                 batch_axis='data', head_axis='model', block_k=None,
+                 segment_ids=None):
     """Attention implementation for a (mesh, strategy) pair.
 
     'flash'   — Pallas kernel, no sequence sharding (or inside Ulysses).
@@ -170,28 +171,39 @@ def make_attn_fn(mesh=None, strategy='flash', seq_axis='seq',
                 ``parallel.ring_attention``).
     'ulysses' — all-to-all seq<->head reshard, flash locally.
     'dense'   — O(seq²) oracle (tests only).
+
+    ``segment_ids`` ([batch, seq], 0 = padding — see
+    ``petastorm_tpu.jax.packing``) restricts attention to packed-row
+    segments under every strategy; for 'ring'/'ulysses' place them with
+    the sequence sharding (``P(batch_axis, seq_axis)``).
     """
     from petastorm_tpu.parallel import (full_attention, make_ring_attention,
                                         make_ulysses_attention)
+    packed = segment_ids is not None
     if strategy == 'flash':
-        return flash_attention
+        return (functools.partial(flash_attention, segment_ids=segment_ids)
+                if packed else flash_attention)
     if strategy == 'dense':
-        return full_attention
+        return (functools.partial(full_attention, segment_ids=segment_ids)
+                if packed else full_attention)
     if mesh is None:
         raise ValueError('strategy %r needs a mesh' % (strategy,))
     if strategy == 'ring':
         fn, _ = make_ring_attention(mesh, seq_axis=seq_axis, batch_axis=batch_axis,
                                     head_axis=head_axis, causal=True,
-                                    block_k=block_k)
+                                    block_k=block_k, packed=packed)
     elif strategy == 'ulysses':
         fn, _ = make_ulysses_attention(
             mesh, seq_axis=seq_axis, batch_axis=batch_axis, head_axis=head_axis,
-            causal=True, attn_fn=flash_attention)
+            causal=True, attn_fn=flash_attention, packed=packed)
     else:
         raise ValueError('unknown attention strategy %r' % (strategy,))
-    return functools.partial(_drop_causal_kwarg, fn)
+    return functools.partial(_drop_causal_kwarg, fn, segment_ids)
 
 
-def _drop_causal_kwarg(fn, q, k, v, causal=True):
-    # shard_map-wrapped fns already curried causal at construction time.
+def _drop_causal_kwarg(fn, segment_ids, q, k, v, causal=True):
+    # shard_map-wrapped fns already curried causal at construction time;
+    # packed wrappers take the segment ids as a positional fourth arg.
+    if segment_ids is not None:
+        return fn(q, k, v, segment_ids)
     return fn(q, k, v)

@@ -35,10 +35,21 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 NEG_INF = -1e30  # finite stand-in for -inf: keeps exp() exactly 0 without NaNs
 
 
-def full_attention(q, k, v, causal=False, scale=None):
+def _segment_mask(seg_q, seg_k):
+    """[b, q, k] bool: same NONZERO segment (the packed-row attention rule;
+    single source of truth for this compute layer — the data-plane twin is
+    ``petastorm_tpu.jax.packing.segment_mask``)."""
+    return ((seg_q[:, :, None] == seg_k[:, None, :])
+            & (seg_q[:, :, None] != 0))
+
+
+def full_attention(q, k, v, causal=False, scale=None, segment_ids=None):
     """Dense single-device reference attention (test oracle, small shapes).
 
-    q, k, v: [batch, seq, heads, head_dim].
+    q, k, v: [batch, seq, heads, head_dim].  ``segment_ids`` ([batch, seq]
+    int, 0 = padding) restricts attention to same-nonzero-segment pairs
+    (packed rows — see ``petastorm_tpu.jax.packing``); fully-masked rows
+    output exactly 0, matching the ring/flash kernels.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
@@ -46,18 +57,26 @@ def full_attention(q, k, v, causal=False, scale=None):
         q_pos = jnp.arange(q.shape[1])[:, None]
         k_pos = jnp.arange(k.shape[1])[None, :]
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if segment_ids is not None:
+        s = jnp.where(_segment_mask(segment_ids, segment_ids)[:, None, :, :],
+                      s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if segment_ids is not None:
+        # padding rows would softmax uniformly over NEG_INF; zero them
+        p = jnp.where((segment_ids != 0)[:, None, :, None], p, 0.0)
     return jnp.einsum('bhqk,bkhd->bqhd', p, v)
 
 
 def _online_block(q, k, v, o, l, m, q_offset, kv_offset, causal, scale,
-                  kv_valid=None):
+                  kv_valid=None, seg_q=None, seg_k=None):
     """Fold one K/V block into the running (o, l, m) accumulator.
 
     o: [b, q, h, d] unnormalised output, l: [b, h, q] running softmax
     denominator, m: [b, h, q] running max.  ``q_offset``/``kv_offset`` are
     the blocks' global sequence positions (for the causal mask).
     ``kv_valid``: positions >= it in this K block are padding (chunked path).
+    ``seg_q``/``seg_k``: [b, q]/[b, k] packed segment ids (0 = padding) —
+    cross-segment pairs are masked.
     """
     s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -67,6 +86,8 @@ def _online_block(q, k, v, o, l, m, q_offset, kv_offset, causal, scale,
         q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
         k_pos = kv_offset + jnp.arange(k.shape[1])[None, :]
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if seg_q is not None:
+        s = jnp.where(_segment_mask(seg_q, seg_k)[:, None, :, :], s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     # exp(NEG_INF - NEG_INF) would be 1 for fully-masked rows; gate to 0.
     alpha = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_new))
@@ -79,7 +100,7 @@ def _online_block(q, k, v, o, l, m, q_offset, kv_offset, causal, scale,
 
 
 def ring_attention(q, k, v, axis_name, causal=False, scale=None,
-                   block_k=None):
+                   block_k=None, segment_ids=None):
     """Ring attention over a sharded sequence axis — call inside shard_map.
 
     Arguments are the *local* blocks ``[batch, seq_local, heads, head_dim]``
@@ -97,12 +118,19 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     scores would not fit (e.g. 128k context over 8 devices).  K/V are
     padded/re-laid-out once before the ring loop and rotate in chunked
     layout; only the final padded chunk pays a validity mask.
+
+    ``segment_ids``: the *local* [batch, seq_local] shard of packed segment
+    ids (0 = padding); they rotate around the ring with their K/V block so
+    cross-segment pairs are masked even across shard boundaries.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, q_len, h, d = q.shape
     kv_len = k.shape[1]
+    packed = segment_ids is not None
+    seg_q = jnp.asarray(segment_ids, jnp.int32) if packed else None
+    seg_kv = seg_q
 
     if block_k is not None:
         if block_k < 1:
@@ -116,6 +144,12 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         # this layout (pad < block_k extra rows of ICI traffic per hop).
         k = jnp.moveaxis(k.reshape(b, n_chunks, block_k, h, d), 1, 0)
         v = jnp.moveaxis(v.reshape(b, n_chunks, block_k, h, d), 1, 0)
+        if packed:
+            # pad value 0 == "padding segment": padded tail masks itself,
+            # which the kv_valid guard enforces anyway.
+            seg_kv = jnp.moveaxis(
+                jnp.pad(seg_kv, ((0, 0), (0, pad))).reshape(
+                    b, n_chunks, block_k), 1, 0)
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     o = jnp.zeros((b, q_len, h, d), jnp.float32)
@@ -123,69 +157,88 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     m = jnp.full((b, h, q_len), NEG_INF, jnp.float32)
 
     if block_k is not None:
-        def hop_fold(q_, k_blk, v_blk, o, l, m, kv_idx):
-            def one_chunk(qc, kc, vc, oc, lc, mc, j, kv_valid):
+        def hop_fold(q_, k_blk, v_blk, sk_blk, o, l, m, kv_idx):
+            def one_chunk(qc, kc, vc, skc, oc, lc, mc, j, kv_valid):
                 return _online_block(
                     qc, kc, vc, oc, lc, mc,
                     q_offset=my_idx * q_len,
                     kv_offset=kv_idx * kv_len + j * block_k,
-                    causal=causal, scale=scale, kv_valid=kv_valid)
+                    causal=causal, scale=scale, kv_valid=kv_valid,
+                    seg_q=seg_q, seg_k=skc)
 
             def fold(acc, xs):
-                kc, vc, j = xs
+                kc, vc, skc, j = xs
                 # Remat: backward recomputes this chunk's tile rather than
                 # saving [b, h, q, block_k] residuals for every chunk.
                 full = jax.checkpoint(
                     functools.partial(one_chunk, kv_valid=None))
-                return full(q_, kc, vc, *acc, j), None
+                return full(q_, kc, vc, skc, *acc, j), None
 
             # Full chunks need no validity mask (pad is static): only the
             # final padded chunk pays the compare+select over its tile.
             n_full = n_chunks - 1 if pad else n_chunks
             acc = (o, l, m)
+            sk_all = (sk_blk if packed else
+                      jnp.zeros((n_chunks, b, block_k), jnp.int32))
             if n_full:
                 acc, _ = jax.lax.scan(
                     fold, acc,
-                    (k_blk[:n_full], v_blk[:n_full], jnp.arange(n_full)))
+                    (k_blk[:n_full], v_blk[:n_full], sk_all[:n_full],
+                     jnp.arange(n_full)))
             if pad:
                 j_last = n_chunks - 1
                 masked = jax.checkpoint(
                     functools.partial(one_chunk, j=j_last,
                                       kv_valid=kv_len - j_last * block_k))
-                acc = masked(q_, k_blk[j_last], v_blk[j_last], *acc)
+                acc = masked(q_, k_blk[j_last], v_blk[j_last],
+                             sk_all[j_last], *acc)
             return acc
 
     def body(i, carry):
-        o, l, m, k_blk, v_blk = carry
+        o, l, m, k_blk, v_blk, sk_blk = carry
         kv_idx = (my_idx - i) % axis_size  # origin of the block in hand
         if block_k is not None:
             # Hop-level remat bounds cross-hop residuals to the (o, l, m)
             # carries; tiles and chunk carries are recomputed per hop.
-            o, l, m = jax.checkpoint(hop_fold)(q, k_blk, v_blk, o, l, m,
-                                               kv_idx)
+            o, l, m = jax.checkpoint(hop_fold)(q, k_blk, v_blk, sk_blk,
+                                               o, l, m, kv_idx)
         else:
             o, l, m = _online_block(q, k_blk, v_blk, o, l, m,
                                     q_offset=my_idx * q_len,
                                     kv_offset=kv_idx * kv_len,
-                                    causal=causal, scale=scale)
+                                    causal=causal, scale=scale,
+                                    seg_q=seg_q,
+                                    seg_k=sk_blk if packed else None)
         # Rotate even on the last step (balanced cost; XLA overlaps it).
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return o, l, m, k_blk, v_blk
+        if packed:
+            sk_blk = jax.lax.ppermute(sk_blk, axis_name, perm)
+        return o, l, m, k_blk, v_blk, sk_blk
 
-    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, l, m, k, v))
+    # A dummy scalar stands in for the segment carry when not packed, so the
+    # fori_loop carry structure stays uniform.
+    sk0 = seg_kv if packed else jnp.zeros((), jnp.int32)
+    o, l, m, _, _, _ = jax.lax.fori_loop(0, axis_size, body,
+                                         (o, l, m, k, v, sk0))
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows yield 0, not NaN
     out = o / jnp.transpose(l, (0, 2, 1))[..., None]
     return out.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
-                      attn_fn=None):
+                      attn_fn=None, segment_ids=None):
     """All-to-all sequence parallelism — call inside shard_map.
 
     Local blocks ``[batch, seq_local, heads, head_dim]``; ``heads`` must be
     divisible by the axis size.  Re-shards seq→heads, runs dense local
     attention (or ``attn_fn``) over the full sequence, re-shards back.
+
+    ``segment_ids``: the local [batch, seq_local] shard of packed segment
+    ids — all-gathered (int32, tiny next to K/V) so the full-sequence local
+    attention can mask cross-segment pairs; ``attn_fn`` must accept a
+    ``segment_ids`` kwarg (``full_attention`` and ``ops.flash_attention``
+    both do).
     """
     axis_size = jax.lax.psum(1, axis_name)
     h = q.shape[2]
@@ -201,23 +254,37 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
                                   tiled=True)
 
     attn_fn = attn_fn or full_attention
+    kwargs = {}
+    if segment_ids is not None:
+        kwargs['segment_ids'] = jax.lax.all_gather(
+            jnp.asarray(segment_ids, jnp.int32), axis_name, axis=1,
+            tiled=True)
     out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
-                  causal=causal, scale=scale)
+                  causal=causal, scale=scale, **kwargs)
     return heads_to_seq(out)
 
 
-def _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis=None):
+def _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis=None,
+                packed=False):
     batch_spec = batch_axis if batch_axis in mesh.axis_names else None
     head_spec = head_axis if head_axis in mesh.axis_names else None
     spec = P(batch_spec, seq_axis, head_spec, None)
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    if packed:
+        # fn(q, k, v, segment_ids): ids are sharded like the sequence.
+        seg_spec = P(batch_spec, seq_axis)
+        fn = jax.shard_map(
+            lambda q, k, v, seg: inner(q, k, v, segment_ids=seg),
+            mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+            out_specs=spec, check_vma=False)
+    else:
+        fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
     return fn, NamedSharding(mesh, spec)
 
 
 def make_ring_attention(mesh, seq_axis='seq', batch_axis='data',
                         head_axis=None, causal=False, scale=None,
-                        block_k=None):
+                        block_k=None, packed=False):
     """shard_map-wrapped ring attention over ``mesh``.
 
     Returns ``(fn, sharding)``: ``fn(q, k, v)`` on global arrays
@@ -226,20 +293,28 @@ def make_ring_attention(mesh, seq_axis='seq', batch_axis='data',
     mesh — heads are independent, so a tensor-parallel head shard composes
     freely with the sequence ring); ``sharding`` is the NamedSharding
     inputs should be placed with.
+
+    With ``packed=True`` the returned fn is ``fn(q, k, v, segment_ids)``
+    (global ``[batch, seq]`` ids sharded over ``seq_axis`` alongside the
+    sequence): packed rows keep their document boundaries across shard
+    hops.
     """
     inner = functools.partial(ring_attention, axis_name=seq_axis,
                               causal=causal, scale=scale, block_k=block_k)
-    return _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis)
+    return _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis,
+                       packed=packed)
 
 
 def make_ulysses_attention(mesh, seq_axis='seq', batch_axis='data',
                            head_axis=None, causal=False, scale=None,
-                           attn_fn=None):
+                           attn_fn=None, packed=False):
     """shard_map-wrapped all-to-all attention over ``mesh`` (see above).
 
     With ``head_axis`` the *local* head count (heads / head_shards) must
-    still be divisible by the ``seq_axis`` size.
+    still be divisible by the ``seq_axis`` size.  ``packed=True``: see
+    ``make_ring_attention``; ``attn_fn`` must accept ``segment_ids``.
     """
     inner = functools.partial(ulysses_attention, axis_name=seq_axis,
                               causal=causal, scale=scale, attn_fn=attn_fn)
-    return _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis)
+    return _make_sp_fn(inner, mesh, seq_axis, batch_axis, head_axis,
+                       packed=packed)

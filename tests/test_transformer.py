@@ -111,3 +111,29 @@ def test_remat_matches_and_trains(tokens):
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], 'loss did not decrease: %s' % losses
+
+
+def test_make_attn_fn_packed_strategies():
+    """segment_ids reach every strategy through make_attn_fn (packed rows)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from petastorm_tpu.parallel import full_attention, make_mesh
+
+    rng = np.random.default_rng(3)
+    B, S, H, D = 2, 32, 8, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+               for _ in range(3))
+    seg = np.zeros((B, S), np.int32)
+    seg[:, :12] = 1
+    seg[:, 12:26] = 2
+    seg = jnp.asarray(seg)
+    want = full_attention(q, k, v, causal=True, segment_ids=seg)
+
+    mesh = make_mesh({'seq': 8})
+    seg_sh = jax.device_put(seg, NamedSharding(mesh, P(None, 'seq')))
+    for strategy, ids in (('dense', seg), ('flash', seg),
+                          ('ring', seg_sh), ('ulysses', seg_sh)):
+        fn = make_attn_fn(mesh=mesh, strategy=strategy, head_axis=None,
+                          segment_ids=ids)
+        got = fn(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5, err_msg=strategy)
