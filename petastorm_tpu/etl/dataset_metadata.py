@@ -161,9 +161,11 @@ class DatasetWriter(object):
 
     def __init__(self, dataset_url, schema, rowgroup_size_mb=None,
                  rows_per_rowgroup=None, rows_per_file=None, compression='snappy',
-                 storage_options=None, filesystem=None):
+                 storage_options=None, filesystem=None, workers=0):
         if rowgroup_size_mb is not None and rows_per_rowgroup is not None:
             raise ValueError('Pass rowgroup_size_mb or rows_per_rowgroup, not both')
+        if workers < 0:
+            raise ValueError('workers must be >= 0')
         self._schema = schema
         self._arrow_schema = schema.as_arrow_schema()
         self._rowgroup_size_mb = rowgroup_size_mb
@@ -187,27 +189,75 @@ class DatasetWriter(object):
         self._compression = compression
         self._fs, self._path = get_filesystem_and_path_or_paths(
             dataset_url, storage_options=storage_options, filesystem=filesystem)
-        self._buffer = []
-        self._buffer_nbytes = 0
+        self._buffer = []        # encoded dicts, or Futures when workers > 0
+        self._buffer_nbytes = 0  # bytes of *resolved* rows (async: a floor)
+        self._accounted = 0      # prefix of self._buffer already in _buffer_nbytes
         self._file_index = 0
         self._writer = None
         self._sink = None
         self._rows_in_file = 0
         self._closed = False
+        # Codec encode (cv2 JPEG/PNG, zlib) releases the GIL, so a thread
+        # pool parallelizes the CPU-heavy half of materialization — the
+        # TPU-host stand-in for the reference's Spark-executor write
+        # parallelism (petastorm/etl/dataset_metadata.py ::
+        # materialize_dataset runs the encode on Spark workers).  Parquet
+        # serialization stays ordered on the caller thread.
+        self._executor = None
+        if workers:
+            from concurrent.futures import ThreadPoolExecutor
+            self._executor = ThreadPoolExecutor(
+                workers, thread_name_prefix='pt-writer-encode')
+            self._max_pending = max(8, 4 * workers)
 
     # -- row API -------------------------------------------------------------
 
     def write(self, row_dict):
-        encoded = encode_row(self._schema, row_dict)
-        self._buffer.append(encoded)
-        self._buffer_nbytes += sum(len(v) if isinstance(v, (bytes, bytearray)) else 8
-                                   for v in encoded.values() if v is not None)
+        """Encode and buffer one row; may flush a row group.
+
+        With ``workers > 0`` the codec encode runs on the writer's thread
+        pool, so a bad row surfaces at the flush that includes it (or at
+        ``close()``), not necessarily at this call.  The dict is shallow-
+        copied at submit time (rebinding keys on a reused dict is safe),
+        but array *contents* are read when the encode runs — don't mutate
+        a cell's buffer in place after passing it.
+        """
+        if self._executor is not None:
+            self._buffer.append(
+                self._executor.submit(encode_row, self._schema,
+                                      dict(row_dict)))
+            # Backpressure: never hold more than max_pending un-encoded rows
+            # (bounds memory when the producer outruns the encoders).
+            if len(self._buffer) - self._accounted > self._max_pending:
+                self._account_resolved(block_one=True)
+            else:
+                self._account_resolved()
+        else:
+            encoded = encode_row(self._schema, row_dict)
+            self._buffer.append(encoded)
+            self._buffer_nbytes += self._row_nbytes(encoded)
+            self._accounted += 1
         if self._rowgroup_ready():
             self._flush_rowgroup()
 
     def write_many(self, rows):
         for row in rows:
             self.write(row)
+
+    @staticmethod
+    def _row_nbytes(encoded):
+        return sum(len(v) if isinstance(v, (bytes, bytearray)) else 8
+                   for v in encoded.values() if v is not None)
+
+    def _account_resolved(self, block_one=False):
+        """Fold completed futures (an in-order prefix) into the byte count."""
+        while self._accounted < len(self._buffer):
+            fut = self._buffer[self._accounted]
+            if not (block_one or fut.done()):
+                break
+            self._buffer_nbytes += self._row_nbytes(fut.result())
+            self._accounted += 1
+            block_one = False
 
     def _rowgroup_ready(self):
         if self._rows_per_rowgroup is not None:
@@ -218,6 +268,8 @@ class DatasetWriter(object):
     def _flush_rowgroup(self):
         if not self._buffer:
             return
+        if self._executor is not None:
+            self._buffer = [f.result() for f in self._buffer]
         columns = {name: [row.get(name) for row in self._buffer]
                    for name in self._schema.fields}
         table = pa.table(
@@ -231,6 +283,7 @@ class DatasetWriter(object):
         self._rows_in_file += len(self._buffer)
         self._buffer = []
         self._buffer_nbytes = 0
+        self._accounted = 0
 
     def _close_current_file(self):
         if self._writer is not None:
@@ -255,10 +308,34 @@ class DatasetWriter(object):
     def close(self):
         if self._closed:
             return
-        self._flush_rowgroup()
+        try:
+            self._flush_rowgroup()
+        except BaseException:
+            self._abort()
+            raise
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
         self._close_current_file()
         self._closed = True
         _write_common_metadata(self._fs, self._path, self._schema)
+
+    def _abort(self):
+        """Teardown after a failed write/flush: release the pool and file
+        handles, drop buffered rows, and mark the writer closed WITHOUT
+        stamping footer metadata — a partially-written dataset must not
+        read as valid, and a retried ``close()`` must not crash on leftover
+        futures or mask the original error."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+        self._buffer = []
+        self._accounted = 0
+        self._buffer_nbytes = 0
+        try:
+            self._close_current_file()
+        finally:
+            self._closed = True
 
     def __enter__(self):
         return self
@@ -266,6 +343,8 @@ class DatasetWriter(object):
     def __exit__(self, exc_type, exc_value, tb):
         if exc_type is None:
             self.close()
+        else:
+            self._abort()
 
 
 def write_dataset(schema, rows, dataset_url, **kwargs):

@@ -351,7 +351,21 @@ def encode_row(unischema, row_dict):
                 raise ValueError('Field %r is not nullable but got None' % (name,))
             encoded[name] = None
         else:
-            encoded[name] = field.codec_or_default.encode(field, row_dict[name])
+            value = row_dict[name]
+            # Shape compliance at WRITE time (parity: the reference's
+            # dict_to_spark_row validates via codec shape checks): a
+            # wrong-shape cell would otherwise encode fine and poison the
+            # fixed-shape columnar decode plane at read time.  None dims
+            # are wildcards.
+            if field.shape and isinstance(value, np.ndarray):
+                ok = (value.ndim == len(field.shape)
+                      and all(exp is None or exp == got
+                              for exp, got in zip(field.shape, value.shape)))
+                if not ok:
+                    raise ValueError(
+                        'Field %r expects shape %r, got %r'
+                        % (name, field.shape, value.shape))
+            encoded[name] = field.codec_or_default.encode(field, value)
     return encoded
 
 
