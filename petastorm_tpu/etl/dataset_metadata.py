@@ -20,7 +20,7 @@ import pickle
 import posixpath
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from contextlib import contextmanager
+from contextlib import contextmanager, suppress
 from dataclasses import dataclass
 
 import pyarrow as pa
@@ -205,7 +205,6 @@ class DatasetWriter(object):
         # serialization stays ordered on the caller thread.
         self._executor = None
         if workers:
-            from concurrent.futures import ThreadPoolExecutor
             self._executor = ThreadPoolExecutor(
                 workers, thread_name_prefix='pt-writer-encode')
             self._max_pending = max(8, 4 * workers)
@@ -333,7 +332,10 @@ class DatasetWriter(object):
         self._accounted = 0
         self._buffer_nbytes = 0
         try:
-            self._close_current_file()
+            # A sink failing to close (e.g. a broken remote stream) must not
+            # replace the root-cause error this teardown runs under.
+            with suppress(Exception):
+                self._close_current_file()
         finally:
             self._closed = True
 
