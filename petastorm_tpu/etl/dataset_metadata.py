@@ -157,15 +157,35 @@ class DatasetWriter(object):
         with DatasetWriter(url, MySchema, rowgroup_size_mb=64) as w:
             for row in rows:
                 w.write(row)
+
+    Multi-host materialization (the pod analog of the reference's
+    Spark-executor parallel write): every host writes its own shard of rows
+    into the SAME directory with a distinct ``part_prefix`` (e.g.
+    ``'part_h%03d' % jax.process_index()``) and ``stamp_metadata=False``,
+    then — after a barrier (``parallel.sync_hosts()``) — exactly one host
+    stamps the footer over the whole directory with
+    :func:`materialize_dataset_pyarrow` or the
+    ``petastorm-tpu-generate-metadata`` CLI.  ``stamp_metadata=False`` is
+    REQUIRED for concurrent writers: the stamp scans the whole directory,
+    and a default per-host ``close()`` stamp would race other hosts'
+    still-open part files.
     """
 
     def __init__(self, dataset_url, schema, rowgroup_size_mb=None,
                  rows_per_rowgroup=None, rows_per_file=None, compression='snappy',
-                 storage_options=None, filesystem=None, workers=0):
+                 storage_options=None, filesystem=None, workers=0,
+                 part_prefix='part', stamp_metadata=True):
         if rowgroup_size_mb is not None and rows_per_rowgroup is not None:
             raise ValueError('Pass rowgroup_size_mb or rows_per_rowgroup, not both')
         if workers < 0:
             raise ValueError('workers must be >= 0')
+        if '/' in part_prefix or not part_prefix:
+            raise ValueError('part_prefix must be a non-empty file-name prefix')
+        if part_prefix[0] in '_.':
+            # The dataset file lister treats leading '_'/'.' as
+            # metadata/hidden — such parts would write fine and then be
+            # invisible to the footer stamp and every reader.
+            raise ValueError("part_prefix must not start with '_' or '.'")
         self._schema = schema
         self._arrow_schema = schema.as_arrow_schema()
         self._rowgroup_size_mb = rowgroup_size_mb
@@ -187,6 +207,8 @@ class DatasetWriter(object):
                 for name in precompressed:
                     compression[name] = 'NONE'
         self._compression = compression
+        self._part_prefix = str(part_prefix)
+        self._stamp_metadata = bool(stamp_metadata)
         self._fs, self._path = get_filesystem_and_path_or_paths(
             dataset_url, storage_options=storage_options, filesystem=filesystem)
         self._buffer = []        # encoded dicts, or Futures when workers > 0
@@ -295,7 +317,8 @@ class DatasetWriter(object):
     def _roll_file(self):
         self._close_current_file()
         self._fs.makedirs(self._path, exist_ok=True)
-        name = posixpath.join(self._path, 'part_%05d.parquet' % self._file_index)
+        name = posixpath.join(self._path, '%s_%05d.parquet'
+                              % (self._part_prefix, self._file_index))
         self._file_index += 1
         self._rows_in_file = 0
         self._sink = self._fs.open(name, 'wb')
@@ -317,7 +340,8 @@ class DatasetWriter(object):
             self._executor = None
         self._close_current_file()
         self._closed = True
-        _write_common_metadata(self._fs, self._path, self._schema)
+        if self._stamp_metadata:
+            _write_common_metadata(self._fs, self._path, self._schema)
 
     def _abort(self):
         """Teardown after a failed write/flush: release the pool and file

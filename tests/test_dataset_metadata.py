@@ -228,3 +228,48 @@ def test_parallel_writer_rejects_bad_workers(tmp_path):
     with pytest.raises(ValueError):
         DatasetWriter('file://' + str(tmp_path / 'x'), _image_schema(),
                       workers=-1)
+
+
+def test_multihost_materialization_recipe(tmp_path):
+    """Two 'hosts' write distinct part_prefix shards into one directory;
+    the post-barrier footer stamp covers the union (DatasetWriter
+    docstring recipe)."""
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset_pyarrow
+
+    schema = _image_schema()
+    url = 'file://' + str(tmp_path / 'pod')
+    for host in range(2):
+        with DatasetWriter(url, schema, rows_per_rowgroup=8,
+                           part_prefix='part_h%03d' % host,
+                           stamp_metadata=False) as w:
+            for i in range(host * 20, host * 20 + 20):
+                rng = np.random.default_rng(i)
+                w.write({'idx': np.int64(i),
+                         'img': rng.integers(0, 256, (32, 32, 3), np.uint8)})
+    # "host 0 after the barrier"
+    with materialize_dataset_pyarrow(url, schema):
+        pass
+
+    names = sorted(p.name for p in (tmp_path / 'pod').glob('*.parquet'))
+    assert any(n.startswith('part_h000') for n in names)
+    assert any(n.startswith('part_h001') for n in names)
+
+    from petastorm_tpu import make_reader
+    with make_reader(url, num_epochs=1, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as r:
+        idx = sorted(int(row.idx) for row in r)
+    assert idx == list(range(40))
+
+
+def test_part_prefix_validated(tmp_path):
+    for bad in ('', 'a/b'):
+        with pytest.raises(ValueError):
+            DatasetWriter('file://' + str(tmp_path / 'x'), _image_schema(),
+                          part_prefix=bad)
+
+
+def test_part_prefix_rejects_hidden_names(tmp_path):
+    for bad in ('_h000', '.tmp'):
+        with pytest.raises(ValueError, match='_'):
+            DatasetWriter('file://' + str(tmp_path / 'x'), _image_schema(),
+                          part_prefix=bad)
