@@ -179,6 +179,7 @@ class DatasetWriter(object):
             raise ValueError('Pass rowgroup_size_mb or rows_per_rowgroup, not both')
         if workers < 0:
             raise ValueError('workers must be >= 0')
+        part_prefix = str(part_prefix)
         if '/' in part_prefix or not part_prefix:
             raise ValueError('part_prefix must be a non-empty file-name prefix')
         if part_prefix[0] in '_.':
@@ -207,7 +208,7 @@ class DatasetWriter(object):
                 for name in precompressed:
                     compression[name] = 'NONE'
         self._compression = compression
-        self._part_prefix = str(part_prefix)
+        self._part_prefix = part_prefix
         self._stamp_metadata = bool(stamp_metadata)
         self._fs, self._path = get_filesystem_and_path_or_paths(
             dataset_url, storage_options=storage_options, filesystem=filesystem)

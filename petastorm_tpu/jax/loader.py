@@ -86,19 +86,30 @@ class DataLoader(object):
     # -- iteration -----------------------------------------------------------
 
     def __iter__(self):
+        # TraceAnnotation spans make the data pipeline visible in
+        # ``jax.profiler`` device traces (SURVEY.md §5.1): when a step
+        # stalls, the trace shows whether the time went to the decode
+        # plane (pt/host_batch), the user hook (pt/transform), or the H2D
+        # dispatch (pt/device_put).  Overhead is negligible when no trace
+        # is active.
+        from jax.profiler import TraceAnnotation
+
         pending = deque()
         batches = self._host_batches()
         while True:
             t0 = time.monotonic()
             try:
-                host_batch = next(batches)
+                with TraceAnnotation('pt/host_batch'):
+                    host_batch = next(batches)
             except StopIteration:
                 break
             t1 = time.monotonic()
             if self._transform_fn is not None:
-                host_batch = self._transform_fn(host_batch)
+                with TraceAnnotation('pt/transform'):
+                    host_batch = self._transform_fn(host_batch)
             t2 = time.monotonic()
-            pending.append(self._to_device(host_batch))
+            with TraceAnnotation('pt/device_put'):
+                pending.append(self._to_device(host_batch))
             t3 = time.monotonic()
             self.stats['host_batch_s'] += t1 - t0
             self.stats['transform_s'] += t2 - t1

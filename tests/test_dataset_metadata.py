@@ -270,6 +270,6 @@ def test_part_prefix_validated(tmp_path):
 
 def test_part_prefix_rejects_hidden_names(tmp_path):
     for bad in ('_h000', '.tmp'):
-        with pytest.raises(ValueError, match='_'):
+        with pytest.raises(ValueError, match='must not start'):
             DatasetWriter('file://' + str(tmp_path / 'x'), _image_schema(),
                           part_prefix=bad)
