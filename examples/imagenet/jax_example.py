@@ -28,6 +28,7 @@ from petastorm_tpu import make_reader
 from petastorm_tpu.benchmark import StallMonitor
 from petastorm_tpu.jax import DataLoader, augment
 from petastorm_tpu.models.resnet import ResNet50
+from petastorm_tpu.models.vit import ViT
 from petastorm_tpu.parallel import data_parallel_sharding, make_mesh
 from petastorm_tpu.transform import TransformSpec
 
@@ -50,14 +51,25 @@ def make_transform(image_hw):
                          removed_fields=['noun_id'])
 
 
-def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1):
+def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
+          model_name='resnet50'):
     mesh = make_mesh()
     sharding = data_parallel_sharding(mesh)
-    model = ResNet50(num_classes=1000)
-
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1,) + image_hw + (3,), jnp.float32), train=True)
-    params, batch_stats = variables['params'], variables['batch_stats']
+    stateless = model_name == 'vit'
+    if stateless:
+        # ViT-S/16 on the same pipeline; no BatchNorm state, so batch_stats
+        # stays an empty dict threaded through the shared step signature.
+        model = ViT(num_classes=1000, patch_size=16, d_model=384,
+                    num_heads=6, num_layers=12, d_ff=1536)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1,) + image_hw + (3,), jnp.float32))
+        params, batch_stats = variables['params'], {}
+    else:
+        model = ResNet50(num_classes=1000)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1,) + image_hw + (3,), jnp.float32),
+                               train=True)
+        params, batch_stats = variables['params'], variables['batch_stats']
     tx = optax.sgd(lr, momentum=0.9)
     opt_state = tx.init(params)
 
@@ -73,11 +85,16 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1):
         images = augment.normalize(images, dtype=jnp.float32)
 
         def loss_fn(p):
-            logits, mutated = model.apply(
-                {'params': p, 'batch_stats': batch_stats}, images, train=True,
-                mutable=['batch_stats'])
+            if stateless:
+                logits = model.apply({'params': p}, images)
+                new_stats = batch_stats
+            else:
+                logits, mutated = model.apply(
+                    {'params': p, 'batch_stats': batch_stats}, images,
+                    train=True, mutable=['batch_stats'])
+                new_stats = mutated['batch_stats']
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
-            return loss, mutated['batch_stats']
+            return loss, new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, new_opt = tx.update(grads, opt_state)
@@ -114,5 +131,8 @@ if __name__ == '__main__':
     parser.add_argument('--dataset-url', default='file:///tmp/imagenet_petastorm')
     parser.add_argument('--steps', type=int, default=50)
     parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--model', choices=['resnet50', 'vit'],
+                        default='resnet50')
     args = parser.parse_args()
-    train(args.dataset_url, args.steps, args.batch_size)
+    train(args.dataset_url, args.steps, args.batch_size,
+          model_name=args.model)

@@ -179,7 +179,9 @@ class DatasetWriter(object):
             raise ValueError('Pass rowgroup_size_mb or rows_per_rowgroup, not both')
         if workers < 0:
             raise ValueError('workers must be >= 0')
-        part_prefix = str(part_prefix)
+        if not isinstance(part_prefix, str):
+            raise ValueError('part_prefix must be a str, got %r'
+                             % (type(part_prefix).__name__,))
         if '/' in part_prefix or not part_prefix:
             raise ValueError('part_prefix must be a non-empty file-name prefix')
         if part_prefix[0] in '_.':

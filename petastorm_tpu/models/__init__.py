@@ -2,8 +2,11 @@
 
 * ``mlp`` — MNIST (config #1)
 * ``resnet`` — ResNet-50 for ImageNet-Parquet (config #3, the flagship)
+* ``vit`` — Vision Transformer on the same image pipeline (encoder blocks
+  shared with ``transformer``, so TP/FSDP rules apply unchanged)
 * ``dlrm`` — Criteo embedding tables (config #4)
 * ``transformer`` — long-context LM (sequence/tensor-parallel flagship)
+* ``moe`` — Switch-style expert-parallel FFN
 
 The reference ships no models (it is a data library); these exist so the
 loader can be proven against real pjit training loops, as its examples do
@@ -14,3 +17,4 @@ from petastorm_tpu.models.mlp import MLP  # noqa: F401
 from petastorm_tpu.models.resnet import ResNet50  # noqa: F401
 from petastorm_tpu.models.transformer import (  # noqa: F401
     TransformerLM, param_shardings, make_attn_fn)
+from petastorm_tpu.models.vit import ViT  # noqa: F401

@@ -46,6 +46,7 @@ class Attention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
     attn_fn: Callable = flash_attention
+    causal: bool = True  # False for encoder use (e.g. models.vit)
 
     @nn.compact
     def __call__(self, x):
@@ -57,7 +58,7 @@ class Attention(nn.Module):
         qkv = nn.DenseGeneral((3, self.num_heads, head_dim), axis=-1,
                               dtype=self.dtype, name='qkv')(x)
         q, k, v = jnp.moveaxis(qkv, -3, 0)  # each [b, s, h, hd]
-        out = self.attn_fn(q, k, v, causal=True)
+        out = self.attn_fn(q, k, v, causal=self.causal)
         return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
                                name='out')(out)
 
@@ -67,10 +68,12 @@ class Block(nn.Module):
     d_ff: int
     dtype: Any = jnp.bfloat16
     attn_fn: Callable = flash_attention
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x):
         x = x + Attention(self.num_heads, self.dtype, self.attn_fn,
+                          causal=self.causal,
                           name='attn')(RMSNorm(name='ln1')(x))
         h = nn.Dense(self.d_ff, dtype=self.dtype, name='ffw_in')(RMSNorm(name='ln2')(x))
         h = nn.gelu(h)
@@ -161,7 +164,7 @@ def param_shardings(params, mesh, model_axis='model'):
 
 def make_attn_fn(mesh=None, strategy='flash', seq_axis='seq',
                  batch_axis='data', head_axis='model', block_k=None,
-                 segment_ids=None):
+                 segment_ids=None, causal=True):
     """Attention implementation for a (mesh, strategy) pair.
 
     'flash'   — Pallas kernel, no sequence sharding (or inside Ulysses).
@@ -190,20 +193,26 @@ def make_attn_fn(mesh=None, strategy='flash', seq_axis='seq',
         raise ValueError('strategy %r needs a mesh' % (strategy,))
     if strategy == 'ring':
         fn, _ = make_ring_attention(mesh, seq_axis=seq_axis, batch_axis=batch_axis,
-                                    head_axis=head_axis, causal=True,
+                                    head_axis=head_axis, causal=causal,
                                     block_k=block_k, packed=packed)
     elif strategy == 'ulysses':
         fn, _ = make_ulysses_attention(
             mesh, seq_axis=seq_axis, batch_axis=batch_axis, head_axis=head_axis,
-            causal=True, attn_fn=flash_attention, packed=packed)
+            causal=causal, attn_fn=flash_attention, packed=packed)
     else:
         raise ValueError('unknown attention strategy %r' % (strategy,))
-    return functools.partial(_drop_causal_kwarg, fn, segment_ids)
+    return functools.partial(_check_curried_causal, fn, segment_ids, causal)
 
 
-def _drop_causal_kwarg(fn, segment_ids, q, k, v, causal=True):
-    # shard_map-wrapped fns already curried causal at construction time;
-    # packed wrappers take the segment ids as a positional fourth arg.
+def _check_curried_causal(fn, segment_ids, curried_causal, q, k, v,
+                          causal=True):
+    # shard_map-wrapped fns curried causal at construction time; a caller
+    # asking for different masking (e.g. an encoder calling a causal-curried
+    # wrapper) must hear about it, not silently get the curried behavior.
+    if causal != curried_causal:
+        raise ValueError(
+            'attn_fn was built with causal=%s but called with causal=%s — '
+            'pass causal=%s to make_attn_fn' % (curried_causal, causal, causal))
     if segment_ids is not None:
         return fn(q, k, v, segment_ids)
     return fn(q, k, v)
