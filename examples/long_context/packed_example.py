@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from petastorm_tpu import make_reader
 from petastorm_tpu.codecs import NdarrayCodec
 from petastorm_tpu.etl.dataset_metadata import DatasetWriter
-from petastorm_tpu.jax import packing
+from petastorm_tpu.jax import PackedDataLoader, packing
 from petastorm_tpu.models.transformer import TransformerLM
 from petastorm_tpu.unischema import Unischema, UnischemaField
 
@@ -91,27 +91,36 @@ def train(dataset_url, steps=20, rows_per_batch=4, lr=3e-3):
                              jnp.zeros((1, MAX_LEN), jnp.int32))
     opt_state = tx.init(params)
 
-    done = tokens_seen = real_tokens = 0
+    done = 0
+    stats = {'seen': 0, 'real': 0}
+
+    def count_tokens(batch):
+        # Runs on the HOST batch before transfer — stats come for free,
+        # no device->host readback against the prefetch pipeline.
+        stats['seen'] += batch['segment_ids'].size
+        stats['real'] += int((batch['segment_ids'] > 0).sum())
+        return batch
+
     t0 = time.monotonic()
     with make_reader(dataset_url, schema_fields=['tokens'],
                      num_epochs=None, workers_count=4) as reader:
-        seqs = (row.tokens for row in reader)
-        for batch in packing.pack_stream(seqs, max_len=MAX_LEN,
-                                         rows_per_batch=rows_per_batch):
+        # PackedDataLoader = pack_stream + the DataLoader's double-buffered
+        # device delivery (same prefetch/sharding machinery as images).
+        loader = PackedDataLoader(reader, 'tokens', max_len=MAX_LEN,
+                                  rows_per_batch=rows_per_batch, prefetch=2,
+                                  transform_fn=count_tokens)
+        for batch in loader:
             params, opt_state, loss = step(
-                params, opt_state, jnp.asarray(batch['tokens']),
-                jnp.asarray(batch['segment_ids']),
-                jnp.asarray(batch['positions']))
+                params, opt_state, batch['tokens'], batch['segment_ids'],
+                batch['positions'])
             done += 1
-            tokens_seen += batch['tokens'].size
-            real_tokens += int((batch['segment_ids'] > 0).sum())
             if done >= steps:
                 break
     loss = float(loss)
     dt = time.monotonic() - t0
-    util = real_tokens / tokens_seen
+    util = stats['real'] / stats['seen']
     print('steps=%d loss=%.3f packing_utilization=%.0f%% tokens/s=%.0f'
-          % (done, loss, 100 * util, real_tokens / dt))
+          % (done, loss, 100 * util, stats['real'] / dt))
     assert np.isfinite(loss)
     return loss, util
 

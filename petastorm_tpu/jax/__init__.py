@@ -8,4 +8,4 @@ row-group sharding by ``jax.process_index()``.
 from petastorm_tpu.jax import augment, packing  # noqa: F401
 from petastorm_tpu.jax.loader import (DataLoader,  # noqa: F401
                                       DeviceInMemDataLoader, InMemDataLoader,
-                                      make_jax_loader)
+                                      PackedDataLoader, make_jax_loader)

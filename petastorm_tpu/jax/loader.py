@@ -481,6 +481,62 @@ class DeviceInMemDataLoader(InMemDataLoader):
         return gen()
 
 
+class PackedDataLoader(DataLoader):
+    """Pack a variable-length sequence column into fixed-shape LM batches
+    with the DataLoader's prefetch/device delivery.
+
+    The loader-layer home of ``petastorm_tpu.jax.packing.pack_stream``:
+    rows stream out of the reader, their ``tokens_field`` column is packed
+    into ``(rows_per_batch, max_len)`` batches with ``segment_ids`` /
+    ``positions``, and batches ride the same double-buffered
+    ``device_put`` path as :class:`DataLoader` (``prefetch`` /
+    ``device`` / ``sharding`` / ``transform_fn`` all apply)::
+
+        with make_reader(url, schema_fields=['tokens']) as reader:
+            loader = PackedDataLoader(reader, 'tokens', max_len=4096,
+                                      rows_per_batch=8, sharding=sharding)
+            for batch in loader:
+                step(batch['tokens'], batch['segment_ids'],
+                     batch['positions'])
+
+    Ordering comes from the reader (shuffle row groups there);
+    ``shuffling_queue_capacity`` is rejected — reordering between packing
+    and delivery would break nothing but adds no mixing the reader can't
+    already provide.  With ``drop_last=False`` the final short batch is
+    padded with all-padding rows (static shapes), not ragged.
+    """
+
+    def __init__(self, reader, tokens_field, max_len, rows_per_batch,
+                 pad_id=0, open_rows=32, **loader_kwargs):
+        if loader_kwargs.get('shuffling_queue_capacity'):
+            raise ValueError('PackedDataLoader does not support '
+                             'shuffling_queue_capacity; shuffle in the '
+                             'reader (shuffle_row_groups)')
+        if getattr(reader, 'batched_output', False):
+            raise ValueError('PackedDataLoader needs a ROW reader '
+                             '(make_reader): batch readers yield columnar '
+                             'chunks, not per-document sequences')
+        super().__init__(reader, batch_size=rows_per_batch, **loader_kwargs)
+        self._tokens_field = tokens_field
+        self._max_len = int(max_len)
+        self._pad_id = pad_id
+        self._open_rows = int(open_rows)
+
+    def _host_batches(self):
+        from petastorm_tpu.jax import packing
+
+        def sequences():
+            for row in self.reader:
+                value = (row[self._tokens_field] if isinstance(row, dict)
+                         else getattr(row, self._tokens_field))
+                yield value
+
+        return packing.pack_stream(sequences(), self._max_len,
+                                   self.batch_size, pad_id=self._pad_id,
+                                   open_rows=self._open_rows,
+                                   drop_last=self._drop_last)
+
+
 def make_jax_loader(dataset_url, batch_size, batched=True, loader_kwargs=None, **reader_kwargs):
     """Convenience: reader + DataLoader in one call.
 

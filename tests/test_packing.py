@@ -239,3 +239,92 @@ def test_transformer_positions_override_changes_embedding():
     restarted = model.apply(params, tokens,
                             positions=jnp.asarray([[0, 1, 2, 0, 1, 2, 0, 1]]))
     assert not np.allclose(np.asarray(default), np.asarray(restarted))
+
+
+# -- PackedDataLoader (loader-layer packing) ---------------------------------
+
+@pytest.fixture(scope='module')
+def var_token_dataset(tmp_path_factory):
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('VarTok', [
+        UnischemaField('doc_id', np.int64, (), None, False),
+        UnischemaField('tokens', np.int32, (None,), NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(tmp_path_factory.mktemp('vartok'))
+    rng = np.random.default_rng(0)
+    lengths = {}
+    with DatasetWriter(url, schema, rows_per_rowgroup=16) as w:
+        for i in range(48):
+            L = int(rng.integers(5, 60))
+            lengths[i] = L
+            w.write({'doc_id': np.int64(i),
+                     'tokens': np.full(L, i, np.int32)})
+    return url, lengths
+
+
+def test_packed_loader_device_batches(var_token_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import PackedDataLoader
+
+    url, lengths = var_token_dataset
+    with make_reader(url, schema_fields=['tokens'], num_epochs=1,
+                     reader_pool_type='dummy', shuffle_row_groups=False) as r:
+        loader = PackedDataLoader(r, 'tokens', max_len=64, rows_per_batch=4,
+                                  drop_last=False)
+        seen = {}
+        for batch in loader:
+            assert isinstance(batch['tokens'], jax.Array)
+            assert batch['tokens'].shape == (4, 64)
+            tok = np.asarray(batch['tokens'])
+            seg = np.asarray(batch['segment_ids'])
+            for row in range(4):
+                for s in range(1, seg[row].max() + 1):
+                    vals = tok[row][seg[row] == s]
+                    doc = int(vals[0])
+                    assert (vals == doc).all()
+                    seen[doc] = len(vals)
+    assert seen == lengths, 'every document must arrive intact exactly once'
+
+
+def test_packed_loader_sharded(var_token_dataset):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import PackedDataLoader
+    from petastorm_tpu.parallel import make_mesh
+
+    url, _ = var_token_dataset
+    mesh = make_mesh({'data': 2, 'seq': 4})
+    sharding = NamedSharding(mesh, P('data', 'seq'))
+    with make_reader(url, schema_fields=['tokens'], num_epochs=1,
+                     reader_pool_type='dummy') as r:
+        loader = PackedDataLoader(r, 'tokens', max_len=64, rows_per_batch=4,
+                                  sharding=sharding)
+        n = 0
+        for batch in loader:
+            assert batch['tokens'].sharding == sharding
+            n += 1
+    assert n >= 1
+
+
+def test_packed_loader_rejects_shuffle_queue(var_token_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import PackedDataLoader
+
+    url, _ = var_token_dataset
+    with make_reader(url, num_epochs=1, reader_pool_type='dummy') as r:
+        with pytest.raises(ValueError, match='shuffling_queue_capacity'):
+            PackedDataLoader(r, 'tokens', 64, 4, shuffling_queue_capacity=8)
+
+
+def test_packed_loader_rejects_batch_reader(var_token_dataset):
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.jax import PackedDataLoader
+
+    url, _ = var_token_dataset
+    with make_batch_reader(url, num_epochs=1,
+                           reader_pool_type='dummy') as r:
+        with pytest.raises(ValueError, match='ROW reader'):
+            PackedDataLoader(r, 'tokens', 64, 4)
