@@ -240,7 +240,15 @@ class NdarrayCodec(DataframeColumnCodec):
         memfile = io.BytesIO(value)
         # allow_pickle=False: cells are untrusted input at read time.
         arr = np.load(memfile, allow_pickle=False)
-        return np.ascontiguousarray(arr)
+        arr = np.ascontiguousarray(arr)
+        expected = np.dtype(unischema_field.numpy_dtype)
+        if arr.dtype != expected and arr.dtype.kind == 'V' \
+                and arr.dtype.itemsize == expected.itemsize:
+            # Extension dtypes (ml_dtypes.bfloat16 — THE TPU storage dtype)
+            # ride through np.save as raw void bytes; the schema knows the
+            # real dtype, so restore it (zero-copy view).
+            arr = arr.view(expected)
+        return arr
 
     def arrow_dtype(self):
         return pa.binary()
