@@ -328,3 +328,49 @@ def test_packed_loader_rejects_batch_reader(var_token_dataset):
                            reader_pool_type='dummy') as r:
         with pytest.raises(ValueError, match='ROW reader'):
             PackedDataLoader(r, 'tokens', 64, 4)
+
+
+def test_packed_loader_over_dataset_mixture(var_token_dataset, tmp_path):
+    """LM-pretraining shape: WeightedSamplingReader mixes two corpora,
+    PackedDataLoader packs the mixed stream."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.jax import PackedDataLoader
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    url_a, _ = var_token_dataset
+    # second corpus: tokens are all negative so provenance is visible
+    schema = Unischema('VarTok2', [
+        UnischemaField('doc_id', np.int64, (), None, False),
+        UnischemaField('tokens', np.int32, (None,), NdarrayCodec(), False),
+    ])
+    url_b = 'file://' + str(tmp_path / 'corpus_b')
+    rng = np.random.default_rng(1)
+    with DatasetWriter(url_b, schema, rows_per_rowgroup=16) as w:
+        for i in range(48):
+            w.write({'doc_id': np.int64(i),
+                     'tokens': np.full(int(rng.integers(5, 40)), -1, np.int32)})
+
+    ra = make_reader(url_a, schema_fields=['tokens'], num_epochs=1,
+                     reader_pool_type='dummy', shuffle_row_groups=False)
+    rb = make_reader(url_b, schema_fields=['tokens'], num_epochs=1,
+                     reader_pool_type='dummy', shuffle_row_groups=False)
+    mixed = WeightedSamplingReader([ra, rb], [0.5, 0.5], seed=0)
+    loader = PackedDataLoader(mixed, 'tokens', max_len=64, rows_per_batch=4)
+    from_a = from_b = 0
+    for batch in loader:
+        tok = np.asarray(batch['tokens'])
+        seg = np.asarray(batch['segment_ids'])
+        for row in range(tok.shape[0]):
+            for s in range(1, seg[row].max() + 1):
+                vals = tok[row][seg[row] == s]
+                # a document never mixes corpora
+                assert (vals >= 0).all() or (vals == -1).all()
+                if (vals == -1).all():
+                    from_b += 1
+                else:
+                    from_a += 1
+    ra.stop(); ra.join(); rb.stop(); rb.join()
+    assert from_a > 5 and from_b > 5, (from_a, from_b)
