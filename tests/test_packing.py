@@ -357,20 +357,20 @@ def test_packed_loader_over_dataset_mixture(var_token_dataset, tmp_path):
                      reader_pool_type='dummy', shuffle_row_groups=False)
     rb = make_reader(url_b, schema_fields=['tokens'], num_epochs=1,
                      reader_pool_type='dummy', shuffle_row_groups=False)
-    mixed = WeightedSamplingReader([ra, rb], [0.5, 0.5], seed=0)
-    loader = PackedDataLoader(mixed, 'tokens', max_len=64, rows_per_batch=4)
     from_a = from_b = 0
-    for batch in loader:
-        tok = np.asarray(batch['tokens'])
-        seg = np.asarray(batch['segment_ids'])
-        for row in range(tok.shape[0]):
-            for s in range(1, seg[row].max() + 1):
-                vals = tok[row][seg[row] == s]
-                # a document never mixes corpora
-                assert (vals >= 0).all() or (vals == -1).all()
-                if (vals == -1).all():
-                    from_b += 1
-                else:
-                    from_a += 1
-    ra.stop(); ra.join(); rb.stop(); rb.join()
+    with WeightedSamplingReader([ra, rb], [0.5, 0.5], seed=0) as mixed:
+        loader = PackedDataLoader(mixed, 'tokens', max_len=64,
+                                  rows_per_batch=4)
+        for batch in loader:
+            tok = np.asarray(batch['tokens'])
+            seg = np.asarray(batch['segment_ids'])
+            for row in range(tok.shape[0]):
+                for s in range(1, seg[row].max() + 1):
+                    vals = tok[row][seg[row] == s]
+                    # a document never mixes corpora
+                    assert (vals >= 0).all() or (vals == -1).all()
+                    if (vals == -1).all():
+                        from_b += 1
+                    else:
+                        from_a += 1
     assert from_a > 5 and from_b > 5, (from_a, from_b)
