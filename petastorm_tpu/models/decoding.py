@@ -1,0 +1,90 @@
+"""Autoregressive generation for ``TransformerLM`` — compiled, static-shape.
+
+The XLA way to decode (no reference analog; the reference ships no
+models): the per-layer KV cache is a fixed ``[b, max_seq_len, h, hd]``
+buffer (``Attention._decode_step``), prefill and generation are both
+``lax.scan`` loops over it, and every step runs the same executable —
+no data-dependent Python control flow, one compile for any prompt.
+
+    tokens = decoding.generate(model, params, prompt, max_new_tokens=64)
+
+Greedy by default; pass ``temperature > 0`` with ``rng`` to sample.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['generate']
+
+
+def _decode_variant(model):
+    """The same architecture flipped into KV-cache mode."""
+    return model.clone(decode=True)
+
+
+def generate(model, params, prompt, max_new_tokens, temperature=0.0,
+             rng=None):
+    """Generate ``max_new_tokens`` continuations of ``prompt`` ``[b, L]``.
+
+    Returns ``[b, max_new_tokens]`` int32 tokens.  ``temperature=0`` is
+    greedy argmax; ``temperature>0`` samples with ``rng`` (required).
+    ``L + max_new_tokens`` must fit ``model.max_seq_len`` (the static
+    cache size).  Wrap in ``jax.jit`` with ``static_argnums`` for
+    ``max_new_tokens`` — everything inside is scan-compiled already.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2:
+        raise ValueError('prompt must be [batch, len], got %r'
+                         % (prompt.shape,))
+    b, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > model.max_seq_len:
+        raise ValueError('prompt+new = %d exceeds max_seq_len %d'
+                         % (total, model.max_seq_len))
+    if temperature > 0 and rng is None:
+        raise ValueError('temperature > 0 needs an rng key')
+
+    dec = _decode_variant(model)
+    # Cache SHAPES only — eval_shape runs no compute and no param init;
+    # a fresh cache is zeros with index 0 (init never mutates it).
+    cache_shapes = jax.eval_shape(
+        lambda: dec.init(jax.random.PRNGKey(0), prompt[:, :1],
+                         positions=jnp.zeros((b, 1), jnp.int32)))['cache']
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+    def step(cache, token, position):
+        logits, mutated = dec.apply(
+            {'params': params, 'cache': cache}, token[:, None],
+            positions=position[:, None], mutable=['cache'])
+        return mutated['cache'], logits[:, 0]  # [b, vocab]
+
+    # Prefill: ONE batched causal forward over the whole prompt fills every
+    # layer's cache (seq>1 path of Attention._decode_step) — MXU-efficient,
+    # not L sequential steps.  Its last logits predict the first new token.
+    prefill_logits, mutated = dec.apply(
+        {'params': params, 'cache': cache}, prompt,
+        positions=jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32),
+                                   (b, prompt_len)),
+        mutable=['cache'])
+    cache = mutated['cache']
+    last_logits = prefill_logits[:, -1]
+
+    def pick(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    key0 = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def gen_body(carry, t):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        token = pick(logits, sub).astype(jnp.int32)
+        cache, next_logits = step(cache, token, jnp.full((b,), t, jnp.int32))
+        return (cache, next_logits, key), token
+
+    steps = prompt_len + jnp.arange(max_new_tokens, dtype=jnp.int32)
+    (_, _, _), tokens = jax.lax.scan(
+        gen_body, (cache, last_logits, key0), steps)
+    return tokens.T  # [b, max_new_tokens]

@@ -47,6 +47,8 @@ class Attention(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_fn: Callable = flash_attention
     causal: bool = True  # False for encoder use (e.g. models.vit)
+    decode: bool = False  # autoregressive KV-cache mode (see models.decoding)
+    max_decode_len: int = 2048
 
     @nn.compact
     def __call__(self, x):
@@ -58,9 +60,53 @@ class Attention(nn.Module):
         qkv = nn.DenseGeneral((3, self.num_heads, head_dim), axis=-1,
                               dtype=self.dtype, name='qkv')(x)
         q, k, v = jnp.moveaxis(qkv, -3, 0)  # each [b, s, h, hd]
-        out = self.attn_fn(q, k, v, causal=self.causal)
+        if self.decode:
+            out = self._decode_step(q, k, v)
+        else:
+            out = self.attn_fn(q, k, v, causal=self.causal)
         return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
                                name='out')(out)
+
+    def _decode_step(self, q, k, v):
+        """Attention against a fixed-size KV cache (incremental decoding).
+
+        XLA-friendly: the cache is a STATIC ``[b, max_decode_len, h, hd]``
+        buffer updated in place with ``dynamic_update_slice``; one-token
+        queries attend the whole buffer with future positions masked — no
+        shape ever depends on the step index, so the generate loop compiles
+        once (``lax.scan`` in ``models.decoding``).  A multi-token call is
+        the PREFILL path: it assumes a fresh cache (index 0), writes the
+        whole prompt's K/V, and runs ordinary causal attention over it —
+        one MXU-batched forward instead of L sequential steps.  Flax init
+        never mutates the cache (``is_initializing`` guard), so a freshly
+        initialized cache is all-zeros with index 0.
+        """
+        b, seq, h, hd = q.shape
+        cache_k = self.variable('cache', 'key', jnp.zeros,
+                                (b, self.max_decode_len, h, hd), self.dtype)
+        cache_v = self.variable('cache', 'value', jnp.zeros,
+                                (b, self.max_decode_len, h, hd), self.dtype)
+        index = self.variable('cache', 'index', jnp.zeros, (), jnp.int32)
+        i = index.value
+        if not self.is_initializing():
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(self.dtype), (0, i, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(self.dtype), (0, i, 0, 0))
+            index.value = i + seq
+        if seq > 1:
+            # prefill (fresh cache): plain causal attention over the prompt
+            return self.attn_fn(q, k, v, causal=True)
+        keys = cache_k.value.astype(jnp.float32)
+        values = cache_v.value.astype(jnp.float32)
+        scores = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32), keys,
+                            preferred_element_type=jnp.float32) * hd ** -0.5
+        mask = jnp.arange(self.max_decode_len)[None, None, None, :] <= i
+        from petastorm_tpu.parallel.ring_attention import NEG_INF
+        scores = jnp.where(mask, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum('bhqk,bkhd->bqhd', probs, values)
+        return out.astype(q.dtype)
 
 
 class Block(nn.Module):
@@ -69,11 +115,14 @@ class Block(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_fn: Callable = flash_attention
     causal: bool = True
+    decode: bool = False
+    max_decode_len: int = 2048
 
     @nn.compact
     def __call__(self, x):
         x = x + Attention(self.num_heads, self.dtype, self.attn_fn,
-                          causal=self.causal,
+                          causal=self.causal, decode=self.decode,
+                          max_decode_len=self.max_decode_len,
                           name='attn')(RMSNorm(name='ln1')(x))
         h = nn.Dense(self.d_ff, dtype=self.dtype, name='ffw_in')(RMSNorm(name='ln2')(x))
         h = nn.gelu(h)
@@ -92,6 +141,7 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_fn: Callable = flash_attention
     remat: bool = False  # jax.checkpoint each block: FLOPs for HBM
+    decode: bool = False  # KV-cache incremental mode (models.decoding)
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -111,6 +161,7 @@ class TransformerLM(nn.Module):
             block = nn.remat(Block)
         for i in range(self.num_layers):
             x = block(self.num_heads, self.d_ff, self.dtype, self.attn_fn,
+                      decode=self.decode, max_decode_len=self.max_seq_len,
                       name='block_%d' % i)(x)
         x = RMSNorm(name='ln_f')(x)
         # Tied output head: attend() reuses the (vocab-sharded) embedding.

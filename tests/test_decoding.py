@@ -1,0 +1,81 @@
+"""KV-cache decoding: equivalence with the full forward, jit, sampling."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu.models.decoding import generate
+from petastorm_tpu.models.transformer import TransformerLM
+
+
+@pytest.fixture(scope='module')
+def lm():
+    model = TransformerLM(vocab_size=61, d_model=32, num_heads=2,
+                          num_layers=2, d_ff=64, max_seq_len=32,
+                          dtype=jnp.float32)
+    # Seed DIFFERENT from any constant inside decoding.py: a cache polluted
+    # by init-time params must show up as divergence, not coincide.
+    params = model.init(jax.random.PRNGKey(7),
+                        jnp.zeros((1, 8), jnp.int32))['params']
+    return model, params
+
+
+def test_greedy_matches_stepwise_full_forward(lm):
+    """The load-bearing equivalence: cached decoding must pick exactly the
+    tokens a full re-forward over the growing prefix would pick."""
+    model, params = lm
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 5)), jnp.int32)
+    got = np.asarray(generate(model, params, prompt, max_new_tokens=6))
+
+    seq = np.asarray(prompt)
+    for t in range(6):
+        logits = model.apply({'params': params}, jnp.asarray(seq))
+        nxt = np.argmax(np.asarray(logits)[:, -1], axis=-1)
+        np.testing.assert_array_equal(got[:, t], nxt,
+                                      err_msg='diverged at step %d' % t)
+        seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], axis=1)
+
+
+def test_generate_jits_once(lm):
+    model, params = lm
+    traces = []
+
+    @jax.jit
+    def gen(params, prompt):
+        traces.append(1)  # python side effect: fires only while TRACING
+        return generate(model, params, prompt, max_new_tokens=4)
+
+    p1 = jnp.zeros((2, 5), jnp.int32)
+    p2 = jnp.ones((2, 5), jnp.int32)
+    a = gen(params, p1)
+    b = gen(params, p2)
+    assert a.shape == b.shape == (2, 4)
+    assert a.dtype == jnp.int32
+    assert len(traces) == 1, 'generate retraced for a same-shape prompt'
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sampling_temperature(lm):
+    model, params = lm
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    s1 = generate(model, params, prompt, 8, temperature=1.0,
+                  rng=jax.random.PRNGKey(1))
+    s2 = generate(model, params, prompt, 8, temperature=1.0,
+                  rng=jax.random.PRNGKey(2))
+    s1r = generate(model, params, prompt, 8, temperature=1.0,
+                   rng=jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s1r))
+    assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+    with pytest.raises(ValueError, match='rng'):
+        generate(model, params, prompt, 4, temperature=0.5)
+
+
+def test_rejects_overflow_and_bad_prompt(lm):
+    model, params = lm
+    with pytest.raises(ValueError, match='max_seq_len'):
+        generate(model, params, jnp.zeros((1, 30), jnp.int32), 8)
+    with pytest.raises(ValueError, match='batch'):
+        generate(model, params, jnp.zeros((5,), jnp.int32), 2)
