@@ -23,11 +23,15 @@ def _decode_variant(model):
 
 
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None):
+             rng=None, top_k=None, top_p=None, eos_id=None, pad_id=0):
     """Generate ``max_new_tokens`` continuations of ``prompt`` ``[b, L]``.
 
     Returns ``[b, max_new_tokens]`` int32 tokens.  ``temperature=0`` is
-    greedy argmax; ``temperature>0`` samples with ``rng`` (required).
+    greedy argmax; ``temperature>0`` samples with ``rng`` (required),
+    optionally truncated to the ``top_k`` highest logits and/or the
+    ``top_p`` nucleus (smallest probability mass >= top_p).  With
+    ``eos_id`` set, rows that emit it keep emitting ``pad_id`` for the
+    remaining steps (shapes stay static — no early exit).
     ``L + max_new_tokens`` must fit ``model.max_seq_len`` (the static
     cache size).  Wrap in ``jax.jit`` with ``static_argnums`` for
     ``max_new_tokens`` — everything inside is scan-compiled already.
@@ -43,6 +47,12 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
                          % (total, model.max_seq_len))
     if temperature > 0 and rng is None:
         raise ValueError('temperature > 0 needs an rng key')
+    if (top_k is not None or top_p is not None) and temperature <= 0:
+        raise ValueError('top_k/top_p only apply when temperature > 0')
+    if top_k is not None and top_k < 1:
+        raise ValueError('top_k must be >= 1')
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError('top_p must be in (0, 1]')
 
     dec = _decode_variant(model)
     # Cache SHAPES only — eval_shape runs no compute and no param init;
@@ -70,21 +80,44 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     cache = mutated['cache']
     last_logits = prefill_logits[:, -1]
 
+    neg_inf = jnp.finfo(jnp.float32).min
+
     def pick(logits, key):
-        if temperature > 0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_k is not None and top_k < logits.shape[-1]:
+            # lax.top_k lowers much cheaper than a full-vocab sort on TPU.
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, neg_inf, logits)
+        if top_p is not None and top_p < 1.0:
+            # Nucleus: keep the smallest prefix (by descending prob) whose
+            # mass reaches top_p; mask the rest.  One descending sort —
+            # after the top-k mask, so the knobs share its cost path.
+            sorted_logits = jax.lax.top_k(logits, logits.shape[-1])[0]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep[j] for sorted position j: cumulative mass BEFORE j < top_p
+            keep_sorted = (cum - probs) < top_p
+            cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                             axis=-1, keepdims=True)
+            logits = jnp.where(logits < cutoff, neg_inf, logits)
+        return jax.random.categorical(key, logits, axis=-1)
 
     key0 = rng if rng is not None else jax.random.PRNGKey(0)
+    done0 = jnp.zeros((b,), bool)
 
     def gen_body(carry, t):
-        cache, logits, key = carry
+        cache, logits, key, done = carry
         key, sub = jax.random.split(key)
         token = pick(logits, sub).astype(jnp.int32)
+        if eos_id is not None:
+            token = jnp.where(done, jnp.int32(pad_id), token)
+            done = done | (token == eos_id)
         cache, next_logits = step(cache, token, jnp.full((b,), t, jnp.int32))
-        return (cache, next_logits, key), token
+        return (cache, next_logits, key, done), token
 
     steps = prompt_len + jnp.arange(max_new_tokens, dtype=jnp.int32)
-    (_, _, _), tokens = jax.lax.scan(
-        gen_body, (cache, last_logits, key0), steps)
+    _, tokens = jax.lax.scan(
+        gen_body, (cache, last_logits, key0, done0), steps)
     return tokens.T  # [b, max_new_tokens]

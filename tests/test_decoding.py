@@ -79,3 +79,60 @@ def test_rejects_overflow_and_bad_prompt(lm):
         generate(model, params, jnp.zeros((1, 30), jnp.int32), 8)
     with pytest.raises(ValueError, match='batch'):
         generate(model, params, jnp.zeros((5,), jnp.int32), 2)
+
+
+def test_top_k_restricts_support(lm):
+    """top_k=1 sampling must equal greedy regardless of temperature."""
+    model, params = lm
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 4)), jnp.int32)
+    greedy = generate(model, params, prompt, 6)
+    k1 = generate(model, params, prompt, 6, temperature=2.0, top_k=1,
+                  rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_top_p_one_is_plain_sampling(lm):
+    model, params = lm
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    a = generate(model, params, prompt, 6, temperature=1.0, top_p=1.0,
+                 rng=jax.random.PRNGKey(4))
+    b = generate(model, params, prompt, 6, temperature=1.0,
+                 rng=jax.random.PRNGKey(4))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_p_tiny_is_greedy(lm):
+    """A vanishing nucleus keeps only the argmax token."""
+    model, params = lm
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    greedy = generate(model, params, prompt, 6)
+    nucleus = generate(model, params, prompt, 6, temperature=1.5,
+                       top_p=1e-9, rng=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
+
+
+def test_eos_pads_rest_of_row(lm):
+    """Force an immediate EOS: everything after must be pad."""
+    model, params = lm
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    first = np.asarray(generate(model, params, prompt, 1))[:, 0]
+    out = np.asarray(generate(model, params, prompt, 6,
+                              eos_id=int(first[0]), pad_id=59))
+    row = out[0]
+    hits = np.nonzero(row == int(first[0]))[0]
+    assert hits.size >= 1
+    assert (row[hits[0] + 1:] == 59).all(), row
+
+
+def test_sampling_knob_validation(lm):
+    model, params = lm
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match='temperature'):
+        generate(model, params, prompt, 2, top_k=5)
+    with pytest.raises(ValueError, match='top_k'):
+        generate(model, params, prompt, 2, temperature=1.0, top_k=0,
+                 rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match='top_p'):
+        generate(model, params, prompt, 2, temperature=1.0, top_p=0.0,
+                 rng=jax.random.PRNGKey(0))
