@@ -22,6 +22,36 @@ def _decode_variant(model):
     return model.clone(decode=True)
 
 
+def _truncate_logits(logits, top_k, top_p):
+    """Mask ``[b, vocab]`` logits to the top-k set and/or top-p nucleus.
+
+    Index-based (selection by SORT POSITION, scattered back), so tied
+    logits at the threshold are resolved by sort order instead of all
+    being kept — ``top_k=1`` stays one token even on a flat distribution.
+    Cost is one ``lax.top_k`` of size k (k = vocab only when nucleus-only),
+    not a full-vocab sort per knob.
+    """
+    if top_k is None and top_p is None:
+        return logits
+    b, vocab = logits.shape
+    neg_inf = jnp.finfo(logits.dtype).min
+    k = top_k if (top_k is not None and top_k < vocab) else vocab
+    vals, idx = jax.lax.top_k(logits, k)        # descending, [b, k]
+    keep = jnp.ones(vals.shape, bool)
+    if top_p is not None and top_p < 1.0:
+        # After top-k masking, softmax over the kept slice equals softmax
+        # of the masked full vector — the nucleus is computed on exactly
+        # the distribution sampling would see.
+        probs = jax.nn.softmax(vals, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep sorted position j iff cumulative mass BEFORE j < top_p
+        # (position 0 always kept).
+        keep = (cum - probs) < top_p
+    masked = jnp.full_like(logits, neg_inf)
+    return masked.at[jnp.arange(b)[:, None], idx].set(
+        jnp.where(keep, vals, neg_inf))
+
+
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
              rng=None, top_k=None, top_p=None, eos_id=None, pad_id=0):
     """Generate ``max_new_tokens`` continuations of ``prompt`` ``[b, L]``.
@@ -80,28 +110,10 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     cache = mutated['cache']
     last_logits = prefill_logits[:, -1]
 
-    neg_inf = jnp.finfo(jnp.float32).min
-
     def pick(logits, key):
         if temperature <= 0:
             return jnp.argmax(logits, axis=-1)
-        logits = logits / temperature
-        if top_k is not None and top_k < logits.shape[-1]:
-            # lax.top_k lowers much cheaper than a full-vocab sort on TPU.
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-            logits = jnp.where(logits < kth, neg_inf, logits)
-        if top_p is not None and top_p < 1.0:
-            # Nucleus: keep the smallest prefix (by descending prob) whose
-            # mass reaches top_p; mask the rest.  One descending sort —
-            # after the top-k mask, so the knobs share its cost path.
-            sorted_logits = jax.lax.top_k(logits, logits.shape[-1])[0]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # keep[j] for sorted position j: cumulative mass BEFORE j < top_p
-            keep_sorted = (cum - probs) < top_p
-            cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
-                             axis=-1, keepdims=True)
-            logits = jnp.where(logits < cutoff, neg_inf, logits)
+        logits = _truncate_logits(logits / temperature, top_k, top_p)
         return jax.random.categorical(key, logits, axis=-1)
 
     key0 = rng if rng is not None else jax.random.PRNGKey(0)

@@ -136,3 +136,47 @@ def test_sampling_knob_validation(lm):
     with pytest.raises(ValueError, match='top_p'):
         generate(model, params, prompt, 2, temperature=1.0, top_p=0.0,
                  rng=jax.random.PRNGKey(0))
+
+
+def test_generate_with_tp_sharded_params():
+    """Distributed inference: Megatron-sharded params produce token-
+    identical generations (GSPMD propagates through the decode path).
+    Dims divisible by the model axis (the TP sharding precondition)."""
+    from petastorm_tpu.models.transformer import param_shardings
+    from petastorm_tpu.parallel import make_mesh
+
+    model = TransformerLM(vocab_size=64, d_model=32, num_heads=4,
+                          num_layers=2, d_ff=64, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(7),
+                        jnp.zeros((1, 8), jnp.int32))['params']
+    rng = np.random.default_rng(5)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    ref = np.asarray(generate(model, params, prompt, 6))
+
+    mesh = make_mesh({'data': 4, 'model': 2})
+    sharded = jax.device_put(params, param_shardings(params, mesh))
+    got = np.asarray(generate(model, sharded, prompt, 6))
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_truncate_logits_handles_ties():
+    """Flat distributions: selection is by sort position, so top_k=1 keeps
+    exactly one token and a tiny nucleus keeps exactly one token."""
+    from petastorm_tpu.models.decoding import _truncate_logits
+
+    def n_kept(a):   # masked entries sit at finfo.min, kept ones at 0
+        return (a > -1e30).sum(axis=-1)
+
+    flat = jnp.zeros((2, 7), jnp.float32)
+    k1 = np.asarray(_truncate_logits(flat, 1, None))
+    assert (n_kept(k1) == 1).all(), k1
+    p_tiny = np.asarray(_truncate_logits(flat, None, 1e-9))
+    assert (n_kept(p_tiny) == 1).all(), p_tiny
+    # combined knobs: nucleus computed within the top-k slice
+    both = np.asarray(_truncate_logits(flat, 3, 0.5))
+    kept = n_kept(both)
+    assert (kept >= 1).all() and (kept <= 3).all(), both
+    # untouched when both knobs off
+    np.testing.assert_array_equal(
+        np.asarray(_truncate_logits(flat, None, None)), np.asarray(flat))
