@@ -33,11 +33,15 @@ from petastorm_tpu import make_reader
 from petastorm_tpu.codecs import NdarrayCodec
 from petastorm_tpu.etl.dataset_metadata import DatasetWriter
 from petastorm_tpu.jax import PackedDataLoader, packing
+from petastorm_tpu.models.decoding import generate as lm_generate
 from petastorm_tpu.models.transformer import TransformerLM
 from petastorm_tpu.unischema import Unischema, UnischemaField
 
 VOCAB = 1024
 MAX_LEN = 512
+#: one source of truth for the architecture — train() and sample() share it
+MODEL_KW = dict(vocab_size=VOCAB, d_model=128, num_heads=4, num_layers=2,
+                d_ff=256, max_seq_len=MAX_LEN)
 
 VarTokenSchema = Unischema('VarTokenSchema', [
     UnischemaField('doc_id', np.int64, (), None, False),
@@ -57,8 +61,7 @@ def generate(url, num_docs=512, seed=0):
 
 
 def train(dataset_url, steps=20, rows_per_batch=4, lr=3e-3):
-    model_kw = dict(vocab_size=VOCAB, d_model=128, num_heads=4, num_layers=2,
-                    d_ff=256, max_seq_len=MAX_LEN)
+    model_kw = MODEL_KW
 
     def make_step():
         tx = optax.adamw(lr)
@@ -122,7 +125,24 @@ def train(dataset_url, steps=20, rows_per_batch=4, lr=3e-3):
     print('steps=%d loss=%.3f packing_utilization=%.0f%% tokens/s=%.0f'
           % (done, loss, 100 * util, stats['real'] / dt))
     assert np.isfinite(loss)
-    return loss, util
+    return params, loss, util
+
+
+def sample(params, prompt_len=8, max_new=16, seed=0):
+    """Continue a corpus-style prompt with the compiled KV-cache decoder
+    (models.decoding): one batched prefill, then a lax.scan token loop."""
+    from petastorm_tpu.ops import flash_attention
+
+    model = TransformerLM(attn_fn=flash_attention, **MODEL_KW)
+    params = params.get('params', params)  # train() carries full variables
+    rng = np.random.default_rng(seed)
+    prompt = jnp.asarray(
+        (rng.zipf(1.4, (2, prompt_len)) % VOCAB).astype(np.int32))
+    out = lm_generate(model, params, prompt, max_new, temperature=0.8,
+                      top_p=0.95, rng=jax.random.PRNGKey(seed))
+    for r in range(out.shape[0]):
+        print('prompt %s -> %s' % (np.asarray(prompt[r]).tolist(),
+                                   np.asarray(out[r]).tolist()))
 
 
 if __name__ == '__main__':
@@ -132,7 +152,12 @@ if __name__ == '__main__':
     parser.add_argument('--dataset-url', default='file:///tmp/lc_var_tokens')
     parser.add_argument('--steps', type=int, default=20)
     parser.add_argument('--skip-generate', action='store_true')
+    parser.add_argument('--sample', action='store_true',
+                        help='after training, sample continuations with the '
+                             'compiled KV-cache decoder')
     args = parser.parse_args()
     if not args.skip_generate:
         generate(args.dataset_url)
-    train(args.dataset_url, steps=args.steps)
+    params, _, _ = train(args.dataset_url, steps=args.steps)
+    if args.sample:
+        sample(params)

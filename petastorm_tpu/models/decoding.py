@@ -31,9 +31,10 @@ def _truncate_logits(logits, top_k, top_p):
     Cost is one ``lax.top_k`` of size k (k = vocab only when nucleus-only),
     not a full-vocab sort per knob.
     """
-    if top_k is None and top_p is None:
-        return logits
     b, vocab = logits.shape
+    if ((top_k is None or top_k >= vocab)
+            and (top_p is None or top_p >= 1.0)):
+        return logits   # no-op knobs: skip the sort+scatter entirely
     neg_inf = jnp.finfo(logits.dtype).min
     k = top_k if (top_k is not None and top_k < vocab) else vocab
     vals, idx = jax.lax.top_k(logits, k)        # descending, [b, k]
