@@ -17,5 +17,5 @@ from petastorm_tpu.models.mlp import MLP  # noqa: F401
 from petastorm_tpu.models.resnet import ResNet50  # noqa: F401
 from petastorm_tpu.models.transformer import (  # noqa: F401
     TransformerLM, param_shardings, make_attn_fn)
-from petastorm_tpu.models.decoding import generate  # noqa: F401
+from petastorm_tpu.models.decoding import beam_search, generate  # noqa: F401
 from petastorm_tpu.models.vit import ViT  # noqa: F401

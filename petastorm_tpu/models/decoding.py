@@ -14,12 +14,34 @@ Greedy by default; pass ``temperature > 0`` with ``rng`` to sample.
 import jax
 import jax.numpy as jnp
 
-__all__ = ['generate']
+__all__ = ['generate', 'beam_search']
 
 
 def _decode_variant(model):
     """The same architecture flipped into KV-cache mode."""
     return model.clone(decode=True)
+
+
+def _prefill(dec, params, prompt):
+    """Fresh zero cache + ONE batched causal forward over the prompt.
+
+    Returns ``(cache, last_logits)``.  The single place that encodes the
+    fresh-cache contract with ``Attention._decode_step`` (zeros + index 0,
+    broadcast positions) — greedy and beam decoding share it so they can
+    never drift apart.
+    """
+    b, prompt_len = prompt.shape
+    cache_shapes = jax.eval_shape(
+        lambda: dec.init(jax.random.PRNGKey(0), prompt[:, :1],
+                         positions=jnp.zeros((b, 1), jnp.int32)))['cache']
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+    logits, mutated = dec.apply(
+        {'params': params, 'cache': cache}, prompt,
+        positions=jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32),
+                                   (b, prompt_len)),
+        mutable=['cache'])
+    return mutated['cache'], logits[:, -1]
 
 
 def _truncate_logits(logits, top_k, top_p):
@@ -86,30 +108,13 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         raise ValueError('top_p must be in (0, 1]')
 
     dec = _decode_variant(model)
-    # Cache SHAPES only — eval_shape runs no compute and no param init;
-    # a fresh cache is zeros with index 0 (init never mutates it).
-    cache_shapes = jax.eval_shape(
-        lambda: dec.init(jax.random.PRNGKey(0), prompt[:, :1],
-                         positions=jnp.zeros((b, 1), jnp.int32)))['cache']
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+    cache, last_logits = _prefill(dec, params, prompt)
 
     def step(cache, token, position):
         logits, mutated = dec.apply(
             {'params': params, 'cache': cache}, token[:, None],
             positions=position[:, None], mutable=['cache'])
         return mutated['cache'], logits[:, 0]  # [b, vocab]
-
-    # Prefill: ONE batched causal forward over the whole prompt fills every
-    # layer's cache (seq>1 path of Attention._decode_step) — MXU-efficient,
-    # not L sequential steps.  Its last logits predict the first new token.
-    prefill_logits, mutated = dec.apply(
-        {'params': params, 'cache': cache}, prompt,
-        positions=jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32),
-                                   (b, prompt_len)),
-        mutable=['cache'])
-    cache = mutated['cache']
-    last_logits = prefill_logits[:, -1]
 
     def pick(logits, key):
         if temperature <= 0:
@@ -134,3 +139,115 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     _, tokens = jax.lax.scan(
         gen_body, (cache, last_logits, key0, done0), steps)
     return tokens.T  # [b, max_new_tokens]
+
+
+def beam_search(model, params, prompt, max_new_tokens, num_beams=4,
+                eos_id=None, pad_id=0, length_penalty=1.0):
+    """Beam-search decoding: the ``num_beams`` highest-likelihood
+    continuations, returning the best.
+
+    Returns ``(tokens [b, max_new_tokens], scores [b])`` where ``scores``
+    is the best beam's sum of token log-probs divided by
+    ``length**length_penalty`` (>1 favors longer sequences).  Static
+    shapes throughout: beams fold into the batch axis (``b*num_beams``
+    rows through the model), each scan step re-orders every layer's KV
+    cache by the surviving beams' parents with one batched gather.
+    ``eos_id`` freezes a finished beam: it keeps emitting ``pad_id`` at
+    zero additional log-prob.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2:
+        raise ValueError('prompt must be [batch, len], got %r'
+                         % (prompt.shape,))
+    if num_beams < 1:
+        raise ValueError('num_beams must be >= 1')
+    b, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > model.max_seq_len:
+        raise ValueError('prompt+new = %d exceeds max_seq_len %d'
+                         % (prompt_len + max_new_tokens, model.max_seq_len))
+    k = num_beams
+    neg_inf = jnp.float32(jnp.finfo(jnp.float32).min)
+
+    dec = _decode_variant(model)
+    # Prefill ONCE at batch b (all beams share the prompt), then fold beams
+    # into the batch axis by repeating the cache rows — 1/k the prompt
+    # compute of prefilling the tiled batch.
+    cache_b, last_logits_b = _prefill(dec, params, prompt)
+    cache = jax.tree_util.tree_map(
+        lambda v: (jnp.repeat(v, k, axis=0)
+                   if v.ndim >= 1 and v.shape[0] == b else v), cache_b)
+    log_probs = jnp.repeat(
+        jax.nn.log_softmax(last_logits_b.astype(jnp.float32), axis=-1),
+        k, axis=0)                                          # [b*k, V]
+    vocab = log_probs.shape[-1]
+
+    # Only beam 0 is live initially (all beams hold the same prompt —
+    # without this the top-k would pick k copies of the same token).
+    beam_mask = jnp.where(jnp.arange(k) == 0, 0.0, neg_inf)  # [k]
+    scores0 = jnp.broadcast_to(beam_mask, (b, k))
+
+    def step_fn(carry, t):
+        cache, scores, done, lengths, last_lp = carry
+        # candidate scores over [b, k, V]; finished beams may only emit pad
+        # at zero cost.
+        cand = last_lp.reshape(b, k, vocab) + scores[:, :, None]
+        if eos_id is not None:
+            pad_only = jnp.full((vocab,), neg_inf).at[pad_id].set(0.0)
+            cand = jnp.where(done[:, :, None],
+                             scores[:, :, None] + pad_only[None, None, :],
+                             cand)
+        flat = cand.reshape(b, k * vocab)
+        top_scores, top_idx = jax.lax.top_k(flat, k)       # [b, k]
+        parent = top_idx // vocab                          # [b, k]
+        token = (top_idx % vocab).astype(jnp.int32)        # [b, k]
+        if eos_id is not None:
+            parent_done = jnp.take_along_axis(done, parent, axis=1)
+            done = parent_done | (token == eos_id)
+            token = jnp.where(parent_done, jnp.int32(pad_id), token)
+            # a beam's length counts its real tokens (incl. its eos)
+            lengths = (jnp.take_along_axis(lengths, parent, axis=1)
+                       + (~parent_done).astype(jnp.int32))
+        else:
+            lengths = lengths + 1
+        # Re-order every layer's cache rows to the surviving parents.
+        flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        cache = jax.tree_util.tree_map(
+            lambda v: (jnp.take(v, flat_parent, axis=0)
+                       if v.ndim >= 1 and v.shape[0] == b * k else v),
+            cache)
+        next_logits, mutated = dec.apply(
+            {'params': params, 'cache': cache}, token.reshape(b * k, 1),
+            positions=jnp.full((b * k, 1), t, jnp.int32), mutable=['cache'])
+        last_lp = jax.nn.log_softmax(
+            next_logits[:, 0].astype(jnp.float32), axis=-1)
+        return ((mutated['cache'], top_scores, done, lengths, last_lp),
+                (token, parent))
+
+    done0 = jnp.zeros((b, k), bool)
+    lengths0 = jnp.zeros((b, k), jnp.int32)
+    steps = prompt_len + jnp.arange(max_new_tokens, dtype=jnp.int32)
+    (cache, scores, done, lengths, _), (tokens, parents) = jax.lax.scan(
+        step_fn, (cache, scores0, done0, lengths0, log_probs), steps)
+    # tokens/parents: [T, b, k].  Walk parents backwards to reconstruct
+    # each beam's token path (the cache was re-ordered in place, the
+    # recorded tokens were not).
+    def backtrace(carry, xs):
+        beam = carry                       # [b, k] current beam index
+        token_t, parent_t = xs
+        tok = jnp.take_along_axis(token_t, beam, axis=1)
+        beam = jnp.take_along_axis(parent_t, beam, axis=1)
+        return beam, tok
+
+    init_beam = jnp.broadcast_to(jnp.arange(k), (b, k))
+    _, path = jax.lax.scan(backtrace, init_beam, (tokens, parents),
+                           reverse=True)
+    path = jnp.moveaxis(path, 0, 2)        # [b, k, T]
+    # Per-BEAM length normalization (early-finishing beams divide by their
+    # real emitted length), so length_penalty genuinely trades short
+    # high-density hypotheses against longer ones.
+    norm = jnp.maximum(1, lengths).astype(jnp.float32) ** length_penalty
+    final = scores / norm
+    best = jnp.argmax(final, axis=1)       # [b]
+    best_tokens = jnp.take_along_axis(
+        path, best[:, None, None], axis=1)[:, 0]
+    return best_tokens, jnp.take_along_axis(final, best[:, None], 1)[:, 0]

@@ -180,3 +180,58 @@ def test_truncate_logits_handles_ties():
     # untouched when both knobs off
     np.testing.assert_array_equal(
         np.asarray(_truncate_logits(flat, None, None)), np.asarray(flat))
+
+
+# -- beam search -------------------------------------------------------------
+
+def _sequence_log_prob(model, params, prompt, continuation):
+    """Sum of per-token log-probs of `continuation` under the model."""
+    seq = jnp.concatenate([prompt, continuation], axis=1)
+    logits = model.apply({'params': params}, seq).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    total = 0.0
+    L = prompt.shape[1]
+    for t in range(continuation.shape[1]):
+        tok = continuation[:, t]
+        total = total + jnp.take_along_axis(
+            logp[:, L + t - 1], tok[:, None], axis=1)[:, 0]
+    return np.asarray(total)
+
+
+def test_beam_one_equals_greedy(lm):
+    from petastorm_tpu.models.decoding import beam_search
+
+    model, params = lm
+    rng = np.random.default_rng(8)
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 4)), jnp.int32)
+    greedy = np.asarray(generate(model, params, prompt, 6))
+    beam, _ = beam_search(model, params, prompt, 6, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(beam), greedy)
+
+
+def test_beam_scores_are_model_log_probs(lm):
+    """The reported score must equal the returned path's model log-prob
+    (length-normalized) — the verifiable invariant.  NOTE: beam search
+    does NOT guarantee beating greedy in general (prefix pruning), so no
+    such inequality is asserted."""
+    from petastorm_tpu.models.decoding import beam_search
+
+    model, params = lm
+    rng = np.random.default_rng(9)
+    prompt = jnp.asarray(rng.integers(0, 61, (3, 4)), jnp.int32)
+    beams, scores = beam_search(model, params, prompt, 5, num_beams=4)
+    lp_beam = _sequence_log_prob(model, params, prompt, beams)
+    # no eos: every beam's length is max_new_tokens
+    np.testing.assert_allclose(np.asarray(scores), lp_beam / 5.0 ** 1.0,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_beam_search_validation(lm):
+    from petastorm_tpu.models.decoding import beam_search
+
+    model, params = lm
+    with pytest.raises(ValueError, match='num_beams'):
+        beam_search(model, params, jnp.zeros((1, 4), jnp.int32), 2,
+                    num_beams=0)
+    with pytest.raises(ValueError, match='max_seq_len'):
+        beam_search(model, params, jnp.zeros((1, 30), jnp.int32), 8)
