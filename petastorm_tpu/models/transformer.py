@@ -49,6 +49,12 @@ class Attention(nn.Module):
     causal: bool = True  # False for encoder use (e.g. models.vit)
     decode: bool = False  # autoregressive KV-cache mode (see models.decoding)
     max_decode_len: int = 2048
+    #: Grouped-query attention: K/V projected to this many heads (must
+    #: divide num_heads); each K/V head serves num_heads//num_kv_heads
+    #: query heads.  The decode cache stores only the KV heads — the
+    #: long-context memory win.  None = classic MHA (fused qkv projection,
+    #: parameter tree unchanged).
+    num_kv_heads: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -57,15 +63,33 @@ class Attention(nn.Module):
             raise ValueError('d_model %d not divisible by %d heads'
                              % (d_model, self.num_heads))
         head_dim = d_model // self.num_heads
-        qkv = nn.DenseGeneral((3, self.num_heads, head_dim), axis=-1,
-                              dtype=self.dtype, name='qkv')(x)
-        q, k, v = jnp.moveaxis(qkv, -3, 0)  # each [b, s, h, hd]
+        if self.num_kv_heads is None:
+            qkv = nn.DenseGeneral((3, self.num_heads, head_dim), axis=-1,
+                                  dtype=self.dtype, name='qkv')(x)
+            q, k, v = jnp.moveaxis(qkv, -3, 0)  # each [b, s, h, hd]
+        else:
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError('num_heads %d not divisible by num_kv_heads %d'
+                                 % (self.num_heads, self.num_kv_heads))
+            q = nn.DenseGeneral((self.num_heads, head_dim), axis=-1,
+                                dtype=self.dtype, name='q')(x)
+            kv = nn.DenseGeneral((2, self.num_kv_heads, head_dim), axis=-1,
+                                 dtype=self.dtype, name='kv')(x)
+            k, v = jnp.moveaxis(kv, -3, 0)      # [b, s, h_kv, hd]
         if self.decode:
             out = self._decode_step(q, k, v)
         else:
+            k, v = self._expand_kv(k, v)
             out = self.attn_fn(q, k, v, causal=self.causal)
         return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
                                name='out')(out)
+
+    def _expand_kv(self, k, v):
+        """Broadcast KV heads to the query head count (GQA no-op for MHA)."""
+        if self.num_kv_heads is None or self.num_kv_heads == self.num_heads:
+            return k, v
+        g = self.num_heads // self.num_kv_heads
+        return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
 
     def _decode_step(self, q, k, v):
         """Attention against a fixed-size KV cache (incremental decoding).
@@ -82,10 +106,11 @@ class Attention(nn.Module):
         initialized cache is all-zeros with index 0.
         """
         b, seq, h, hd = q.shape
+        h_kv = k.shape[2]   # < h under GQA: the cache memory win
         cache_k = self.variable('cache', 'key', jnp.zeros,
-                                (b, self.max_decode_len, h, hd), self.dtype)
+                                (b, self.max_decode_len, h_kv, hd), self.dtype)
         cache_v = self.variable('cache', 'value', jnp.zeros,
-                                (b, self.max_decode_len, h, hd), self.dtype)
+                                (b, self.max_decode_len, h_kv, hd), self.dtype)
         index = self.variable('cache', 'index', jnp.zeros, (), jnp.int32)
         i = index.value
         if not self.is_initializing():
@@ -96,17 +121,22 @@ class Attention(nn.Module):
             index.value = i + seq
         if seq > 1:
             # prefill (fresh cache): plain causal attention over the prompt
+            k, v = self._expand_kv(k, v)
             return self.attn_fn(q, k, v, causal=True)
-        keys = cache_k.value.astype(jnp.float32)
-        values = cache_v.value.astype(jnp.float32)
-        scores = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32), keys,
+        # Grouped einsum against the UNEXPANDED cache: per-step HBM reads
+        # stay at h_kv heads (the actual GQA bandwidth win), accumulation
+        # in fp32 via preferred_element_type — no repeated/casted copies.
+        g = h // h_kv
+        q_g = q.astype(jnp.float32).reshape(b, seq, h_kv, g, hd)
+        scores = jnp.einsum('bqkgd,blkd->bkgql', q_g, cache_k.value,
                             preferred_element_type=jnp.float32) * hd ** -0.5
-        mask = jnp.arange(self.max_decode_len)[None, None, None, :] <= i
+        mask = (jnp.arange(self.max_decode_len) <= i)[None, None, None, None, :]
         from petastorm_tpu.parallel.ring_attention import NEG_INF
         scores = jnp.where(mask, scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum('bhqk,bkhd->bqhd', probs, values)
-        return out.astype(q.dtype)
+        out = jnp.einsum('bkgql,blkd->bqkgd', probs, cache_v.value,
+                         preferred_element_type=jnp.float32)
+        return out.reshape(b, seq, h, hd).astype(q.dtype)
 
 
 class Block(nn.Module):
@@ -117,12 +147,14 @@ class Block(nn.Module):
     causal: bool = True
     decode: bool = False
     max_decode_len: int = 2048
+    num_kv_heads: Any = None
 
     @nn.compact
     def __call__(self, x):
         x = x + Attention(self.num_heads, self.dtype, self.attn_fn,
                           causal=self.causal, decode=self.decode,
                           max_decode_len=self.max_decode_len,
+                          num_kv_heads=self.num_kv_heads,
                           name='attn')(RMSNorm(name='ln1')(x))
         h = nn.Dense(self.d_ff, dtype=self.dtype, name='ffw_in')(RMSNorm(name='ln2')(x))
         h = nn.gelu(h)
@@ -142,6 +174,7 @@ class TransformerLM(nn.Module):
     attn_fn: Callable = flash_attention
     remat: bool = False  # jax.checkpoint each block: FLOPs for HBM
     decode: bool = False  # KV-cache incremental mode (models.decoding)
+    num_kv_heads: Any = None  # GQA: KV heads < query heads (see Attention)
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -162,6 +195,7 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = block(self.num_heads, self.d_ff, self.dtype, self.attn_fn,
                       decode=self.decode, max_decode_len=self.max_seq_len,
+                      num_kv_heads=self.num_kv_heads,
                       name='block_%d' % i)(x)
         x = RMSNorm(name='ln_f')(x)
         # Tied output head: attend() reuses the (vocab-sharded) embedding.
@@ -183,6 +217,16 @@ def _spec_for(path, model_axis):
         # kernel [d_model, 3, heads, head_dim] — shard heads.
         return P(None, None, model_axis, None) if leaf == 'kernel' \
             else P(None, model_axis, None)     # bias [3, heads, head_dim]
+    if parent == 'q':
+        # GQA query proj: kernel [d_model, heads, head_dim] — shard heads.
+        return P(None, model_axis, None) if leaf == 'kernel' \
+            else P(model_axis, None)
+    if parent == 'kv':
+        # GQA kv proj: kernel [d_model, 2, kv_heads, head_dim].  The model
+        # axis size must divide kv_heads; param_shardings falls back to
+        # replication per leaf when it doesn't (e.g. MQA with kv_heads=1).
+        return P(None, None, model_axis, None) if leaf == 'kernel' \
+            else P(None, model_axis, None)
     if parent == 'out':
         # kernel [heads, head_dim, d_model] — shard input heads.
         return P(model_axis, None, None) if leaf == 'kernel' else P(None)
@@ -209,8 +253,19 @@ def param_shardings(params, mesh, model_axis='model'):
     """
     if model_axis not in mesh.axis_names:
         return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), params)
-    return jax.tree_util.tree_map_with_path(
-        lambda path, _: NamedSharding(mesh, _spec_for(path, model_axis)), params)
+    axis_size = mesh.shape[model_axis]
+
+    def leaf_sharding(path, leaf):
+        spec = _spec_for(path, model_axis)
+        # A dim the rule would shard must be divisible by the axis size;
+        # otherwise fall back to replication for this leaf (e.g. MQA
+        # kv_heads=1 under 2-way TP, or an odd vocab).
+        for dim, axis in zip(leaf.shape, spec):
+            if axis == model_axis and dim % axis_size:
+                return NamedSharding(mesh, P())
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, params)
 
 
 def make_attn_fn(mesh=None, strategy='flash', seq_axis='seq',

@@ -137,3 +137,70 @@ def test_make_attn_fn_packed_strategies():
         got = fn(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5, err_msg=strategy)
+
+
+def test_gqa_forward_and_train():
+    """Grouped-query attention: fewer KV heads, same interface; trains."""
+    model = TransformerLM(vocab_size=50, d_model=32, num_heads=4,
+                          num_layers=1, d_ff=64, max_seq_len=16,
+                          num_kv_heads=2, dtype=jnp.float32)
+    tokens = jnp.asarray(np.arange(16, dtype=np.int32)[None, :] % 50)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    # separate q/kv projections replace the fused qkv
+    attn_params = params['params']['block_0']['attn']
+    assert 'q' in attn_params and 'kv' in attn_params and 'qkv' not in attn_params
+    assert attn_params['kv']['kernel'].shape == (32, 2, 2, 8)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (1, 16, 50)
+    grads = jax.grad(lambda p: model.apply(p, tokens).sum())(params)
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+
+def test_gqa_rejects_indivisible():
+    model = TransformerLM(vocab_size=50, d_model=32, num_heads=4,
+                          num_layers=1, d_ff=64, max_seq_len=16,
+                          num_kv_heads=3)
+    with pytest.raises(ValueError, match='num_kv_heads'):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_gqa_tp_sharding():
+    from petastorm_tpu.models.transformer import param_shardings
+    from petastorm_tpu.parallel import make_mesh
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({'data': 4, 'model': 2})
+    model = TransformerLM(vocab_size=64, d_model=32, num_heads=4,
+                          num_layers=1, d_ff=64, max_seq_len=16,
+                          num_kv_heads=2, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))['params']
+    shardings = param_shardings(params, mesh)
+    attn = shardings['block_0']['attn']
+    assert attn['q']['kernel'].spec == P(None, 'model', None)
+    assert attn['kv']['kernel'].spec == P(None, None, 'model', None)
+    sharded = jax.device_put(params, shardings)
+    out = jax.jit(lambda p, t: model.apply({'params': p}, t))(
+        sharded, jnp.zeros((4, 8), jnp.int32))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_mqa_sharding_falls_back_to_replication():
+    """MQA (kv_heads=1) under 2-way TP: the kv leaf replicates instead of
+    producing an invalid sharding."""
+    from petastorm_tpu.models.transformer import param_shardings
+    from petastorm_tpu.parallel import make_mesh
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({'data': 4, 'model': 2})
+    model = TransformerLM(vocab_size=64, d_model=32, num_heads=4,
+                          num_layers=1, d_ff=64, max_seq_len=16,
+                          num_kv_heads=1, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))['params']
+    shardings = param_shardings(params, mesh)
+    attn = shardings['block_0']['attn']
+    assert attn['kv']['kernel'].spec == P()         # replicated fallback
+    assert attn['q']['kernel'].spec == P(None, 'model', None)
+    jax.device_put(params, shardings)               # must not raise
