@@ -32,6 +32,38 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from petastorm_tpu.ops import flash_attention
 
 
+def rope_cos_sin(positions, head_dim, base=10000.0):
+    """RoPE rotation tables for ``positions`` [b, s]: cos/sin, each
+    [b, s, 1, head_dim/2] — compute once, rotate q AND k with them."""
+    if head_dim % 2:
+        raise ValueError('RoPE needs an even head_dim, got %d' % head_dim)
+    half = head_dim // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # [b, s, half]
+    return (jnp.cos(angles)[:, :, None, :],
+            jnp.sin(angles)[:, :, None, :])
+
+
+def rope(x, positions=None, base=10000.0, cos_sin=None):
+    """Rotary position embedding (GPT-NeoX split-half convention).
+
+    ``x``: [batch, seq, heads, head_dim]; ``positions``: [batch, seq] (or
+    pass a precomputed ``cos_sin`` from :func:`rope_cos_sin`).  Rotation
+    happens BEFORE the attention delegation, so every attn_fn (dense,
+    flash, ring, Ulysses — packed or not) inherits it untouched; with
+    ``packing`` positions that restart per document, each packed document
+    is rotated as if it started at 0.
+    """
+    if cos_sin is None:
+        cos_sin = rope_cos_sin(positions, x.shape[-1], base)
+    cos, sin = cos_sin
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin,
+                               x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
 
@@ -55,9 +87,13 @@ class Attention(nn.Module):
     #: long-context memory win.  None = classic MHA (fused qkv projection,
     #: parameter tree unchanged).
     num_kv_heads: Any = None
+    #: 'rope' rotates q/k by position before delegation (cached keys are
+    #: stored rotated — standard practice); None = positions handled
+    #: upstream (learned table in TransformerLM).
+    pos_mode: Any = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         d_model = x.shape[-1]
         if d_model % self.num_heads:
             raise ValueError('d_model %d not divisible by %d heads'
@@ -76,6 +112,18 @@ class Attention(nn.Module):
             kv = nn.DenseGeneral((2, self.num_kv_heads, head_dim), axis=-1,
                                  dtype=self.dtype, name='kv')(x)
             k, v = jnp.moveaxis(kv, -3, 0)      # [b, s, h_kv, hd]
+        if self.pos_mode == 'rope':
+            if positions is None:
+                if self.decode:
+                    # arange(seq) would rotate every 1-token step at
+                    # position 0 — silently wrong; demand real positions.
+                    raise ValueError('decode mode with RoPE requires '
+                                     'explicit positions')
+                positions = jnp.broadcast_to(jnp.arange(x.shape[1]),
+                                             x.shape[:2])
+            cs = rope_cos_sin(positions, q.shape[-1])  # once for q AND k
+            q = rope(q, cos_sin=cs)
+            k = rope(k, cos_sin=cs)
         if self.decode:
             out = self._decode_step(q, k, v)
         else:
@@ -148,14 +196,16 @@ class Block(nn.Module):
     decode: bool = False
     max_decode_len: int = 2048
     num_kv_heads: Any = None
+    pos_mode: Any = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         x = x + Attention(self.num_heads, self.dtype, self.attn_fn,
                           causal=self.causal, decode=self.decode,
                           max_decode_len=self.max_decode_len,
                           num_kv_heads=self.num_kv_heads,
-                          name='attn')(RMSNorm(name='ln1')(x))
+                          pos_mode=self.pos_mode,
+                          name='attn')(RMSNorm(name='ln1')(x), positions)
         h = nn.Dense(self.d_ff, dtype=self.dtype, name='ffw_in')(RMSNorm(name='ln2')(x))
         h = nn.gelu(h)
         return x + nn.Dense(x.shape[-1], dtype=self.dtype, name='ffw_out')(h)
@@ -175,28 +225,36 @@ class TransformerLM(nn.Module):
     remat: bool = False  # jax.checkpoint each block: FLOPs for HBM
     decode: bool = False  # KV-cache incremental mode (models.decoding)
     num_kv_heads: Any = None  # GQA: KV heads < query heads (see Attention)
+    pos_embed: str = 'learned'  # 'learned' table | 'rope' rotary q/k
 
     @nn.compact
     def __call__(self, tokens, positions=None):
         """``positions`` overrides the default row-absolute ``arange``
         positions — pass ``packing.pack_*``'s per-segment ``positions`` so
-        each packed document is embedded as if it started at 0."""
+        each packed document is embedded (or RoPE-rotated) as if it
+        started at 0."""
+        if self.pos_embed not in ('learned', 'rope'):
+            raise ValueError("pos_embed must be 'learned' or 'rope', got %r"
+                             % (self.pos_embed,))
         embed = nn.Embed(self.vocab_size, self.d_model, name='embed',
                          dtype=self.dtype)
         x = embed(tokens)
         if positions is None:
-            positions = jnp.arange(tokens.shape[1])[None, :]
-        pos = nn.Embed(self.max_seq_len, self.d_model, name='pos_embed',
-                       dtype=self.dtype)(positions)
-        x = x + pos
+            positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]),
+                                         tokens.shape)
+        if self.pos_embed == 'learned':
+            pos = nn.Embed(self.max_seq_len, self.d_model, name='pos_embed',
+                           dtype=self.dtype)(positions)
+            x = x + pos
         block = Block
         if self.remat:
             block = nn.remat(Block)
+        rope_mode = 'rope' if self.pos_embed == 'rope' else None
         for i in range(self.num_layers):
             x = block(self.num_heads, self.d_ff, self.dtype, self.attn_fn,
                       decode=self.decode, max_decode_len=self.max_seq_len,
-                      num_kv_heads=self.num_kv_heads,
-                      name='block_%d' % i)(x)
+                      num_kv_heads=self.num_kv_heads, pos_mode=rope_mode,
+                      name='block_%d' % i)(x, positions)
         x = RMSNorm(name='ln_f')(x)
         # Tied output head: attend() reuses the (vocab-sharded) embedding.
         return embed.attend(x.astype(self.dtype)).astype(jnp.float32)

@@ -263,3 +263,24 @@ def test_gqa_cached_decode_matches_full_forward():
                          positions=jnp.zeros((2, 1), jnp.int32)))['cache']
     key_shape = cache['block_0']['attn']['key'].shape
     assert key_shape == (2, 24, 2, 8), key_shape
+
+
+def test_rope_cached_decode_matches_full_forward():
+    """RoPE + GQA: cached decoding (rotated keys cached) must match the
+    stepwise full forward exactly."""
+    model = TransformerLM(vocab_size=47, d_model=32, num_heads=4,
+                          num_layers=2, d_ff=64, max_seq_len=24,
+                          num_kv_heads=2, pos_embed='rope',
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(13),
+                        jnp.zeros((1, 6), jnp.int32))['params']
+    rng = np.random.default_rng(14)
+    prompt = jnp.asarray(rng.integers(0, 47, (2, 5)), jnp.int32)
+    got = np.asarray(generate(model, params, prompt, 6))
+    seq = np.asarray(prompt)
+    for t in range(6):
+        logits = model.apply({'params': params}, jnp.asarray(seq))
+        nxt = np.argmax(np.asarray(logits)[:, -1], axis=-1)
+        np.testing.assert_array_equal(got[:, t], nxt,
+                                      err_msg='RoPE diverged at step %d' % t)
+        seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], axis=1)
