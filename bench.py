@@ -287,15 +287,10 @@ def _device_probe_ok(timeout_s=90):
 
     Probed in a subprocess because a wedged TPU tunnel makes backend init
     block indefinitely (observed: even ``jax.devices()`` hangs) — a hang in
-    a child is a timeout here, not a hang there."""
-    import subprocess
-    try:
-        probe = subprocess.run(
-            [sys.executable, '-c', 'import jax; jax.devices()'],
-            timeout=timeout_s, capture_output=True)
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    a child is a timeout here, not a hang there.  Single implementation
+    lives in ``petastorm_tpu.utils._backend_probe_ok``."""
+    from petastorm_tpu.utils import _backend_probe_ok
+    return _backend_probe_ok(timeout_s)
 
 
 def _reexec_cpu_fallback():
@@ -322,6 +317,8 @@ def main():
         _reexec_cpu_fallback()
     ensure_dataset()
     import jax
+    from petastorm_tpu.utils import apply_jax_platforms_env
+    apply_jax_platforms_env()  # resolve JAX_PLATFORMS exactly like the probe child
     jax.jit(lambda x: x + 1)(np.zeros(8))  # backend warmup outside timing
 
     tpu_native_epoch()           # warmup (page cache, pools)

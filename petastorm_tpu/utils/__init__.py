@@ -17,6 +17,19 @@ __all__ = ['decode_row', 'run_in_subprocess', 'ensure_jax_backend',
 logger = logging.getLogger(__name__)
 
 
+def _backend_initialized():
+    """Has any JAX backend already been initialized in this process?
+
+    Single home for the (private-API) ``xla_bridge._backends`` peek so a JAX
+    rename only needs fixing here.
+    """
+    try:
+        from jax._src import xla_bridge
+        return bool(getattr(xla_bridge, '_backends', None))
+    except ImportError:
+        return False
+
+
 def apply_jax_platforms_env():
     """Honor an explicit ``JAX_PLATFORMS`` env var via ``jax.config``.
 
@@ -28,24 +41,115 @@ def apply_jax_platforms_env():
     import jax
     if not os.environ.get('JAX_PLATFORMS'):
         return
-    try:
-        from jax._src import xla_bridge
-        if getattr(xla_bridge, '_backends', None):
-            return  # already initialized: too late, and nothing to fix
-    except ImportError:
-        pass
+    if _backend_initialized():
+        return  # already initialized: too late, and nothing to fix
     jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
 
 
-def ensure_jax_backend(fallback='cpu'):
+# The probe child must resolve JAX_PLATFORMS the same way the parent will
+# (via jax.config — see apply_jax_platforms_env: a sitecustomize hook can
+# override the bare env var), but without requiring this package on the
+# child's sys.path.
+_PROBE_CHILD_CODE = (
+    "import os, jax\n"
+    "p = os.environ.get('JAX_PLATFORMS')\n"
+    "if p: jax.config.update('jax_platforms', p)\n"
+    "jax.devices()\n"
+)
+
+
+def _backend_probe_ok(timeout_s):
+    """Can a fresh interpreter initialize the configured JAX backend?
+
+    Probed in a *child process* because an unreachable accelerator can make
+    backend init block indefinitely rather than raise (observed: a wedged
+    device tunnel hangs ``jax.devices()`` forever) — a hang in the child is
+    a timeout here, not a hang in the caller.
+    """
+    try:
+        probe = subprocess.run(
+            [sys.executable, '-c', _PROBE_CHILD_CODE],
+            timeout=timeout_s, capture_output=True)
+        return probe.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _non_cpu_backend_possible(fallback='cpu'):
+    """Could backend init touch anything besides the ``fallback`` platform?
+
+    Ordinary CPU-only machines must not pay a multi-second ``import jax``
+    probe subprocess, so the probe runs only when an accelerator is actually
+    in play: an explicit non-fallback ``JAX_PLATFORMS``, a backend factory
+    registered beyond cpu/fallback (covers sitecustomize-registered plugins
+    — factories register at import time, before any device is touched), or
+    a discoverable ``jax_plugins`` plugin that will register lazily.
+    """
+    requested = (os.environ.get('JAX_PLATFORMS') or '').strip().lower()
+    if requested:
+        # An explicit platform pin decides outright: apply_jax_platforms_env
+        # has already locked it into jax.config, so init touches only it.
+        return requested != fallback
+    try:
+        from jax._src import xla_bridge
+        factories = set(getattr(xla_bridge, '_backend_factories', {}) or {})
+        factories -= {fallback, 'cpu'}
+        if 'tpu' in factories:
+            # Stock jax registers the 'tpu' factory unconditionally
+            # (fail_quietly); without libtpu it cannot initialize anything,
+            # so it only counts as a possible accelerator when libtpu exists.
+            import importlib.util
+            if importlib.util.find_spec('libtpu') is None:
+                factories.discard('tpu')
+        if factories:
+            return True
+    except ImportError:
+        return True  # can't tell — be safe and probe
+    try:
+        from importlib.metadata import entry_points
+        if list(entry_points(group='jax_plugins')):
+            return True
+    except Exception:
+        pass
+    try:
+        import pkgutil
+
+        import jax_plugins
+        if any(pkgutil.iter_modules(jax_plugins.__path__)):
+            return True
+    except Exception:
+        pass
+    return False
+
+
+def _fall_back(fallback):
+    import jax
+    jax.config.update('jax_platforms', fallback)
+    # Children must inherit both the platform choice and skip-probe: the env
+    # var alone can be overridden by a sitecustomize hook, but any child that
+    # calls ensure_jax_backend re-applies it via jax.config.
+    os.environ['JAX_PLATFORMS'] = fallback
+    os.environ['PETASTORM_TPU_SKIP_BACKEND_PROBE'] = '1'
+    return jax.devices()
+
+
+def ensure_jax_backend(fallback='cpu', probe_timeout_s=90):
     """Make JAX usable on this host; returns ``jax.devices()``.
 
     Honors an explicit ``JAX_PLATFORMS`` env var via ``jax.config`` (on some
     hosts a ``sitecustomize`` hook registers an accelerator plugin at
     interpreter start and the env var alone is ignored), then probes the
-    backend; if initialization fails (e.g. a TPU plugin is registered but no
-    device is reachable), falls back to ``fallback`` so library examples and
-    host-side tooling run on any machine.
+    backend *in a subprocess with a timeout*: an unreachable accelerator can
+    either raise (``RuntimeError``) or hang backend init forever, and only a
+    child-process probe turns the hang into a recoverable timeout.  On either
+    failure mode the process falls back to ``fallback`` so library examples
+    and host-side tooling run on any machine.
+
+    The probe is skipped when the backend is already initialized (too late to
+    change, and ``jax.devices()`` returns instantly), when no non-``fallback``
+    backend is even possible on this host (plain CPU boxes), or when
+    ``PETASTORM_TPU_SKIP_BACKEND_PROBE`` is set (children of a probed process
+    inherit it and must not pay the probe again).
 
     Call this BEFORE any other JAX use but AFTER ``jax.distributed``
     initialization if you use one — probing initializes the backend.
@@ -53,13 +157,26 @@ def ensure_jax_backend(fallback='cpu'):
     """
     import jax
     apply_jax_platforms_env()
+    skip_flag = os.environ.get('PETASTORM_TPU_SKIP_BACKEND_PROBE', '')
+    skip_probe = (_backend_initialized()
+                  or skip_flag.strip().lower() not in ('', '0', 'false', 'no')
+                  or not _non_cpu_backend_possible(fallback))
+    if not skip_probe and not _backend_probe_ok(probe_timeout_s):
+        logger.warning(
+            'JAX backend init did not complete within %ss in a probe '
+            'subprocess (accelerator unreachable or hung); falling back to '
+            '%r for this process', probe_timeout_s, fallback)
+        return _fall_back(fallback)
     try:
-        return jax.devices()
+        devices = jax.devices()
     except RuntimeError as e:
         logger.warning('JAX backend unavailable (%s); falling back to %r',
                        e, fallback)
-        jax.config.update('jax_platforms', fallback)
-        return jax.devices()
+        return _fall_back(fallback)
+    # Export skip-probe only after init is known good: a child inheriting it
+    # must never skip straight into a hang the parent didn't see.
+    os.environ['PETASTORM_TPU_SKIP_BACKEND_PROBE'] = '1'
+    return devices
 
 
 def decode_row(row, schema):

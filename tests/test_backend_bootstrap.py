@@ -1,0 +1,197 @@
+"""``ensure_jax_backend`` must survive every way a backend can be absent.
+
+An unreachable accelerator has two failure modes: backend init *raises*
+(``RuntimeError``) or backend init *hangs forever* (observed with a wedged
+device tunnel).  The second can only be detected from outside the process,
+so ``ensure_jax_backend`` probes in a subprocess with a timeout.  These
+tests run each path in a fresh interpreter where the backend is not yet
+initialized — in-process the conftest has already locked in the CPU backend.
+
+No reference equivalent (the reference's torch examples pick devices
+implicitly); this is acceptance-surface hardening for the JAX examples.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_fresh(body, extra_env=None, timeout=120):
+    env = dict(os.environ)
+    env.pop('JAX_PLATFORMS', None)
+    env.pop('PETASTORM_TPU_SKIP_BACKEND_PROBE', None)
+    env.update(extra_env or {})
+    return subprocess.run([sys.executable, '-c', body], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_fallback_when_probe_times_out():
+    # Simulate the wedged-tunnel signature: the subprocess probe reports
+    # failure (as it would on timeout) while the in-process backend is not
+    # yet initialized.  ensure_jax_backend must fall back to CPU, mark the
+    # environment so children skip the probe, and return usable devices.
+    body = (
+        "import petastorm_tpu.utils as u\n"
+        "u._backend_probe_ok = lambda timeout_s: False\n"
+        "devs = u.ensure_jax_backend(probe_timeout_s=1)\n"
+        "import os\n"
+        "assert devs, devs\n"
+        "assert devs[0].platform == 'cpu', devs\n"
+        "assert os.environ['JAX_PLATFORMS'] == 'cpu'\n"
+        "assert os.environ['PETASTORM_TPU_SKIP_BACKEND_PROBE'] == '1'\n"
+        "print('OK')\n"
+    )
+    res = _run_fresh(body)
+    assert res.returncode == 0, res.stderr
+    assert 'OK' in res.stdout
+
+
+def test_probe_skipped_when_platform_already_fallback():
+    # JAX_PLATFORMS=cpu means there is nothing to probe: a hang is
+    # impossible on the CPU backend and examples must not pay ~probe_timeout
+    # of latency.  _backend_probe_ok raising proves it was never called.
+    body = (
+        "import petastorm_tpu.utils as u\n"
+        "def boom(timeout_s):\n"
+        "    raise AssertionError('probe must be skipped')\n"
+        "u._backend_probe_ok = boom\n"
+        "devs = u.ensure_jax_backend()\n"
+        "assert devs[0].platform == 'cpu', devs\n"
+        "print('OK')\n"
+    )
+    res = _run_fresh(body, extra_env={'JAX_PLATFORMS': 'cpu'})
+    assert res.returncode == 0, res.stderr
+    assert 'OK' in res.stdout
+
+
+def test_probe_skipped_for_children_of_probed_process():
+    body = (
+        "import petastorm_tpu.utils as u\n"
+        "def boom(timeout_s):\n"
+        "    raise AssertionError('probe must be skipped')\n"
+        "u._backend_probe_ok = boom\n"
+        "devs = u.ensure_jax_backend()\n"
+        "assert devs, devs\n"
+        "print('OK')\n"
+    )
+    res = _run_fresh(body, extra_env={
+        'JAX_PLATFORMS': 'cpu',  # keep the child deterministic off-TPU
+        'PETASTORM_TPU_SKIP_BACKEND_PROBE': '1'})
+    assert res.returncode == 0, res.stderr
+    assert 'OK' in res.stdout
+
+
+def test_backend_probe_ok_times_out_on_hang():
+    # The probe helper itself must convert a hanging child into False.
+    import petastorm_tpu.utils as u
+    real_run = subprocess.run
+
+    def fake_run(cmd, timeout=None, capture_output=None):
+        raise subprocess.TimeoutExpired(cmd=cmd, timeout=timeout)
+
+    subprocess_run = u.subprocess.run
+    u.subprocess.run = fake_run
+    try:
+        assert u._backend_probe_ok(1) is False
+    finally:
+        u.subprocess.run = subprocess_run
+    assert real_run is subprocess.run  # sanity: global untouched
+
+
+def test_fallback_on_runtime_error_exports_env_for_children():
+    # The raising failure mode: probe passes (monkeypatched True) but
+    # in-process init raises RuntimeError -> fall back to `fallback` AND
+    # export the choice, so a child inheriting SKIP_BACKEND_PROBE never
+    # skips straight into the accelerator the parent just failed on.
+    body = (
+        "import jax, os\n"
+        "import petastorm_tpu.utils as u\n"
+        "u._backend_probe_ok = lambda timeout_s: True\n"
+        "real_devices = jax.devices\n"
+        "calls = []\n"
+        "def devices():\n"
+        "    if not calls:\n"
+        "        calls.append(1)\n"
+        "        raise RuntimeError('no accelerator')\n"
+        "    return real_devices()\n"
+        "jax.devices = devices\n"
+        "devs = u.ensure_jax_backend()\n"
+        "assert devs[0].platform == 'cpu', devs\n"
+        "assert os.environ['JAX_PLATFORMS'] == 'cpu'\n"
+        "assert os.environ['PETASTORM_TPU_SKIP_BACKEND_PROBE'] == '1'\n"
+        "print('OK')\n"
+    )
+    res = _run_fresh(body)
+    assert res.returncode == 0, res.stderr
+    assert 'OK' in res.stdout
+
+
+def test_probe_skipped_on_cpu_only_host():
+    # A stock-jax CPU-only machine looks like: factory table {'cpu', 'tpu'}
+    # ('tpu' is registered unconditionally at import with fail_quietly),
+    # libtpu NOT importable, no jax_plugins discoverable.  That host must
+    # not pay the probe subprocess.
+    body = (
+        "import petastorm_tpu.utils as u\n"
+        "import jax\n"
+        "from jax._src import xla_bridge\n"
+        "keep = {k: v for k, v in xla_bridge._backend_factories.items()\n"
+        "        if k in ('cpu', 'tpu')}\n"
+        "xla_bridge._backend_factories = keep\n"
+        "import importlib.util\n"
+        "real_find = importlib.util.find_spec\n"
+        "importlib.util.find_spec = (\n"
+        "    lambda name, *a: None if name == 'libtpu' else real_find(name, *a))\n"
+        "import importlib.metadata as md\n"
+        "md.entry_points = lambda **kw: []\n"
+        "def boom(timeout_s):\n"
+        "    raise AssertionError('probe must be skipped')\n"
+        "u._backend_probe_ok = boom\n"
+        "assert not u._non_cpu_backend_possible()\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "devs = u.ensure_jax_backend()\n"
+        "assert devs, devs\n"
+        "print('OK')\n"
+    )
+    res = _run_fresh(body)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert 'OK' in res.stdout
+
+
+def test_skip_flag_falsey_values_do_not_skip():
+    # PETASTORM_TPU_SKIP_BACKEND_PROBE=0 must mean "do probe", not presence-
+    # is-truth: an operator forcing probing on a flaky host would otherwise
+    # skip straight into a hangable init.
+    body = (
+        "import os\n"
+        "import petastorm_tpu.utils as u\n"
+        "os.environ['PETASTORM_TPU_SKIP_BACKEND_PROBE'] = '0'\n"
+        "os.environ.pop('JAX_PLATFORMS', None)\n"
+        "u._non_cpu_backend_possible = lambda fallback='cpu': True\n"
+        "calls = []\n"
+        "u._backend_probe_ok = lambda timeout_s: (calls.append(1), False)[1]\n"
+        "devs = u.ensure_jax_backend(probe_timeout_s=1)\n"
+        "assert calls, 'probe was skipped despite flag=0'\n"
+        "assert devs[0].platform == 'cpu', devs\n"
+        "print('OK')\n"
+    )
+    res = _run_fresh(body)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert 'OK' in res.stdout
+
+
+def test_explicit_non_cpu_platform_forces_probe_path():
+    import petastorm_tpu.utils as u
+    old = os.environ.get('JAX_PLATFORMS')
+    try:
+        os.environ['JAX_PLATFORMS'] = 'tpu'
+        assert u._non_cpu_backend_possible()
+        os.environ['JAX_PLATFORMS'] = 'cpu'
+        assert not u._non_cpu_backend_possible()
+    finally:
+        if old is None:
+            os.environ.pop('JAX_PLATFORMS', None)
+        else:
+            os.environ['JAX_PLATFORMS'] = old
