@@ -262,7 +262,11 @@ class DatasetWriter(object):
             self._buffer_nbytes += self._row_nbytes(encoded)
             self._accounted += 1
         if self._rowgroup_ready():
-            self._flush_rowgroup()
+            # Size-triggered flushes write only the accounted prefix so the
+            # group lands at the target size; row-count mode needs the whole
+            # buffer (its trigger counts every buffered row).
+            self._flush_rowgroup(
+                only_accounted=self._rows_per_rowgroup is None)
 
     def write_many(self, rows):
         for row in rows:
@@ -286,15 +290,43 @@ class DatasetWriter(object):
     def _rowgroup_ready(self):
         if self._rows_per_rowgroup is not None:
             return len(self._buffer) >= self._rows_per_rowgroup
-        limit_mb = self._rowgroup_size_mb if self._rowgroup_size_mb is not None else 32
-        return self._buffer_nbytes >= limit_mb * (1 << 20)
+        limit = ((self._rowgroup_size_mb if self._rowgroup_size_mb is not None
+                  else 32)) * (1 << 20)
+        if self._executor is not None and self._accounted:
+            # Size-based flushing needs a current byte count, but blocking on
+            # every pending future would serialize the pipeline.  Only when
+            # the running per-row average says the limit is within reach do
+            # we block-resolve until the resolved bytes actually confirm it
+            # (or the estimate falls back under) — otherwise lagging
+            # encoders would let the buffer overshoot the target row-group
+            # size by the whole backpressure window.
+            avg = self._buffer_nbytes / self._accounted
+            while (self._buffer_nbytes < limit
+                   and self._accounted < len(self._buffer)
+                   and self._buffer_nbytes
+                   + avg * (len(self._buffer) - self._accounted) >= limit):
+                self._account_resolved(block_one=True)
+                avg = self._buffer_nbytes / self._accounted
+        return self._buffer_nbytes >= limit
 
-    def _flush_rowgroup(self):
-        if not self._buffer:
+    def _flush_rowgroup(self, only_accounted=False):
+        """Write buffered rows as one row group.
+
+        ``only_accounted`` (size-triggered flushes with ``workers > 0``)
+        writes just the byte-accounted prefix — the still-pending tail
+        stays buffered for the next group, so the written group honors the
+        size target instead of swallowing the whole backpressure window.
+        """
+        if only_accounted and self._executor is not None:
+            rows = [f.result() for f in self._buffer[:self._accounted]]
+            rest = self._buffer[self._accounted:]
+        elif self._executor is not None:
+            rows, rest = [f.result() for f in self._buffer], []
+        else:
+            rows, rest = self._buffer, []
+        if not rows:
             return
-        if self._executor is not None:
-            self._buffer = [f.result() for f in self._buffer]
-        columns = {name: [row.get(name) for row in self._buffer]
+        columns = {name: [row.get(name) for row in rows]
                    for name in self._schema.fields}
         table = pa.table(
             {name: pa.array(columns[name], type=self._arrow_schema.field(name).type)
@@ -304,8 +336,8 @@ class DatasetWriter(object):
                                     and self._rows_in_file >= self._rows_per_file):
             self._roll_file()
         self._writer.write_table(table)  # one write_table call == one row group
-        self._rows_in_file += len(self._buffer)
-        self._buffer = []
+        self._rows_in_file += len(rows)
+        self._buffer = rest
         self._buffer_nbytes = 0
         self._accounted = 0
 

@@ -116,11 +116,17 @@ def pack_stream(seq_iter, max_len, rows_per_batch, pad_id=0,
         seqs = (row.tokens for row in make_reader(url, ...))
         for batch in pack_stream(seqs, max_len=4096, rows_per_batch=8):
             step(batch['tokens'], batch['segment_ids'])
+
+    The token dtype is STICKY: each batch is emitted in the promotion of
+    every sequence dtype seen so far, so a stream mixing e.g. int32 and
+    int64 widens once and stays wide instead of alternating batch dtypes
+    (which would retrigger XLA compilation in a jitted step).
     """
     if rows_per_batch < 1 or open_rows < 1:
         raise ValueError('rows_per_batch and open_rows must be >= 1')
     open_ = []      # list of (room, [seqs])
     closed = []
+    dtype = None    # promoted over everything seen; never narrows
 
     def close_fullest():
         i = min(range(len(open_)), key=lambda j: open_[j][0])
@@ -130,6 +136,7 @@ def pack_stream(seq_iter, max_len, rows_per_batch, pad_id=0,
         seq = np.asarray(seq)
         if seq.ndim != 1:
             raise ValueError('expected 1-D sequences, got %r' % (seq.shape,))
+        dtype = seq.dtype if dtype is None else np.result_type(dtype, seq.dtype)
         if len(seq) > max_len:
             raise ValueError('sequence of length %d exceeds max_len=%d'
                              % (len(seq), max_len))
@@ -150,16 +157,16 @@ def pack_stream(seq_iter, max_len, rows_per_batch, pad_id=0,
                 if len(open_) > open_rows:
                     close_fullest()
         while len(closed) >= rows_per_batch:
-            yield _emit(closed[:rows_per_batch], max_len, None, pad_id)
+            yield _emit(closed[:rows_per_batch], max_len, dtype, pad_id)
             closed = closed[rows_per_batch:]
     # drain
     closed.extend(seqs for _, seqs in sorted(open_, key=lambda e: e[0]))
     while len(closed) >= rows_per_batch:
-        yield _emit(closed[:rows_per_batch], max_len, None, pad_id)
+        yield _emit(closed[:rows_per_batch], max_len, dtype, pad_id)
         closed = closed[rows_per_batch:]
     if closed and not drop_last:
         pad_rows = rows_per_batch - len(closed)
-        batch = _emit(closed, max_len, None, pad_id)
+        batch = _emit(closed, max_len, dtype, pad_id)
         if pad_rows:
             batch = {k: np.concatenate(
                 [v, np.zeros((pad_rows,) + v.shape[1:], v.dtype)])

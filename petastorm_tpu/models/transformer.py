@@ -146,11 +146,15 @@ class Attention(nn.Module):
         buffer updated in place with ``dynamic_update_slice``; one-token
         queries attend the whole buffer with future positions masked — no
         shape ever depends on the step index, so the generate loop compiles
-        once (``lax.scan`` in ``models.decoding``).  A multi-token call is
-        the PREFILL path: it assumes a fresh cache (index 0), writes the
-        whole prompt's K/V, and runs ordinary causal attention over it —
-        one MXU-batched forward instead of L sequential steps.  Flax init
-        never mutates the cache (``is_initializing`` guard), so a freshly
+        once (``lax.scan`` in ``models.decoding``).  A multi-token call on a
+        FRESH cache (index 0) is the classic prefill: it writes the whole
+        prompt's K/V and runs ordinary causal attention over just the prompt
+        — one MXU-batched forward instead of L sequential steps.  On a WARM
+        cache (index > 0 — chunked prefill, cache reuse) the chunk instead
+        attends the full cache buffer with absolute-position causal masking,
+        so cached history is honored; ``lax.cond`` picks the branch at run
+        time without breaking the compile-once property.  Flax init never
+        mutates the cache (``is_initializing`` guard), so a freshly
         initialized cache is all-zeros with index 0.
         """
         b, seq, h, hd = q.shape
@@ -167,22 +171,38 @@ class Attention(nn.Module):
             cache_v.value = jax.lax.dynamic_update_slice(
                 cache_v.value, v.astype(self.dtype), (0, i, 0, 0))
             index.value = i + seq
+        q_pos = i + jnp.arange(seq)
         if seq > 1:
-            # prefill (fresh cache): plain causal attention over the prompt
-            k, v = self._expand_kv(k, v)
-            return self.attn_fn(q, k, v, causal=True)
-        # Grouped einsum against the UNEXPANDED cache: per-step HBM reads
-        # stay at h_kv heads (the actual GQA bandwidth win), accumulation
-        # in fp32 via preferred_element_type — no repeated/casted copies.
+            def fresh_prefill(q, k, v):
+                # fresh cache: causal attention over just the prompt —
+                # cheaper than attending the (empty) full buffer
+                k, v = self._expand_kv(k, v)
+                return self.attn_fn(q, k, v, causal=True)
+
+            def warm_prefill(q, k, v):
+                return self._attend_cache(q, cache_k.value, cache_v.value,
+                                          q_pos)
+            return jax.lax.cond(i == 0, fresh_prefill, warm_prefill, q, k, v)
+        return self._attend_cache(q, cache_k.value, cache_v.value, q_pos)
+
+    def _attend_cache(self, q, ck, cv, q_pos):
+        """Attend the static cache buffer at absolute query positions.
+
+        Grouped einsum against the UNEXPANDED cache: per-step HBM reads
+        stay at h_kv heads (the actual GQA bandwidth win), accumulation
+        in fp32 via preferred_element_type — no repeated/casted copies.
+        """
+        b, seq, h, hd = q.shape
+        h_kv = ck.shape[2]
         g = h // h_kv
         q_g = q.astype(jnp.float32).reshape(b, seq, h_kv, g, hd)
-        scores = jnp.einsum('bqkgd,blkd->bkgql', q_g, cache_k.value,
+        scores = jnp.einsum('bqkgd,blkd->bkgql', q_g, ck,
                             preferred_element_type=jnp.float32) * hd ** -0.5
-        mask = (jnp.arange(self.max_decode_len) <= i)[None, None, None, None, :]
+        mask = jnp.arange(self.max_decode_len)[None, :] <= q_pos[:, None]
         from petastorm_tpu.parallel.ring_attention import NEG_INF
-        scores = jnp.where(mask, scores, NEG_INF)
+        scores = jnp.where(mask[None, None, None, :, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum('bkgql,blkd->bqkgd', probs, cache_v.value,
+        out = jnp.einsum('bkgql,blkd->bqkgd', probs, cv,
                          preferred_element_type=jnp.float32)
         return out.reshape(b, seq, h, hd).astype(q.dtype)
 

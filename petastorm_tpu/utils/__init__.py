@@ -92,7 +92,10 @@ def _non_cpu_backend_possible(fallback='cpu'):
         return requested != fallback
     try:
         from jax._src import xla_bridge
-        factories = set(getattr(xla_bridge, '_backend_factories', {}) or {})
+        raw = getattr(xla_bridge, '_backend_factories', None)
+        if raw is None:
+            return True  # private attr renamed — can't tell, be safe and probe
+        factories = set(raw)
         factories -= {fallback, 'cpu'}
         if 'tpu' in factories:
             # Stock jax registers the 'tpu' factory unconditionally

@@ -273,3 +273,49 @@ def test_part_prefix_rejects_hidden_names(tmp_path):
         with pytest.raises(ValueError, match='must not start'):
             DatasetWriter('file://' + str(tmp_path / 'x'), _image_schema(),
                           part_prefix=bad)
+
+
+def test_parallel_writer_size_mode_does_not_overshoot(tmp_path, monkeypatch):
+    """Lagging encoders must not inflate size-triggered row groups.
+
+    Encode is slowed so the backpressure window stays full; the written
+    groups must still land near the byte target (accounted-prefix flush),
+    not swallow the whole pending window.
+    """
+    import time
+    from petastorm_tpu.etl import dataset_metadata as dm
+    real_encode = dm.encode_row
+
+    def slow_encode(schema, row):
+        time.sleep(0.005)
+        return real_encode(schema, row)
+    monkeypatch.setattr(dm, 'encode_row', slow_encode)
+
+    from petastorm_tpu.codecs import NdarrayCodec
+    schema = Unischema('RawS', [
+        UnischemaField('idx', np.int64, (), None, False),
+        UnischemaField('blob', np.uint8, (16384,), NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(0)
+    url = 'file://' + str(tmp_path / 'sized_lag')
+    # ~16 KiB/row, 0.125 MiB target -> ~8 rows/group; backpressure window
+    # is max(8, 4*workers) = 8 pending rows, i.e. a 2x overshoot if the
+    # flush swallowed it.
+    with DatasetWriter(url, schema, rowgroup_size_mb=0.125, workers=2) as w:
+        for i in range(64):
+            w.write({'idx': np.int64(i),
+                     'blob': rng.integers(0, 256, 16384).astype(np.uint8)})
+    import pyarrow.parquet as pq_
+    files = sorted((tmp_path / 'sized_lag').glob('part_*.parquet'))
+    group_rows = [pq_.ParquetFile(str(f)).metadata.row_group(g).num_rows
+                  for f in files
+                  for g in range(pq_.ParquetFile(str(f)).metadata.num_row_groups)]
+    assert sum(group_rows) == 64
+    # Non-final groups must hit the target (>=8 rows).  The upper bound
+    # tolerates one full backpressure window of late-accounted rows (a
+    # descheduled producer folds them in at once) but the AVERAGE must sit
+    # near the target, not at the old ~2x overshoot.
+    for rows in group_rows[:-1]:
+        assert 8 <= rows <= 16, group_rows
+    body = group_rows[:-1]
+    assert sum(body) / len(body) <= 11, group_rows

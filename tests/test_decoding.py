@@ -284,3 +284,44 @@ def test_rope_cached_decode_matches_full_forward():
         np.testing.assert_array_equal(got[:, t], nxt,
                                       err_msg='RoPE diverged at step %d' % t)
         seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], axis=1)
+
+
+def test_chunked_prefill_matches_single_prefill(lm):
+    """A multi-token call on a WARM cache must honor cached history.
+
+    Prefill an 8-token prompt in one shot vs 5+3 chunks: the second
+    chunk's logits and the resulting caches must agree (the warm branch
+    attends the cache prefix with absolute-position causal masking).
+    """
+    model, params = lm
+    dec = model.clone(decode=True)
+    rng = np.random.default_rng(3)
+    b, L, split = 2, 8, 5
+    prompt = jnp.asarray(rng.integers(0, 61, (b, L)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (b, L))
+
+    def zero_cache():
+        shapes = jax.eval_shape(
+            lambda: dec.init(jax.random.PRNGKey(0), prompt[:, :1],
+                             positions=jnp.zeros((b, 1), jnp.int32)))['cache']
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    full_logits, m_full = dec.apply(
+        {'params': params, 'cache': zero_cache()}, prompt,
+        positions=pos, mutable=['cache'])
+
+    _, m1 = dec.apply(
+        {'params': params, 'cache': zero_cache()}, prompt[:, :split],
+        positions=pos[:, :split], mutable=['cache'])
+    tail_logits, m2 = dec.apply(
+        {'params': params, 'cache': m1['cache']}, prompt[:, split:],
+        positions=pos[:, split:], mutable=['cache'])
+
+    np.testing.assert_allclose(np.asarray(tail_logits),
+                               np.asarray(full_logits[:, split:]),
+                               rtol=2e-5, atol=2e-5)
+    jax.tree_util.tree_map(
+        lambda a, c: np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                                rtol=2e-5, atol=2e-5),
+        m_full['cache'], m2['cache'])

@@ -374,3 +374,20 @@ def test_packed_loader_over_dataset_mixture(var_token_dataset, tmp_path):
                     else:
                         from_a += 1
     assert from_a > 5 and from_b > 5, (from_a, from_b)
+
+
+def test_pack_stream_dtype_is_sticky_across_batches():
+    """Once promoted, later all-narrow batches keep the wide dtype.
+
+    A stream mixing int32/int64 must not alternate batch dtypes — each
+    dtype flip would retrigger XLA compilation in a jitted train step.
+    """
+    seqs = [np.arange(64, dtype=np.int32),          # batch 1: int32 only
+            np.array([2 ** 40] * 64, np.int64),     # batch 2: promotes
+            np.arange(64, dtype=np.int32),          # batch 3: int32 rows...
+            np.arange(64, dtype=np.int32)]          # ...must STAY int64
+    batches = list(packing.pack_stream(iter(seqs), max_len=64,
+                                       rows_per_batch=1))
+    assert batches[0]['tokens'].dtype == np.int32
+    assert all(b['tokens'].dtype == np.int64 for b in batches[1:]), \
+        [b['tokens'].dtype for b in batches]
