@@ -14,9 +14,13 @@ sequence attention after the all-to-all) — the composition that makes long
 context cheap: Ulysses moves the data, this kernel keeps HBM traffic at
 O(seq · head_dim).
 
-K and V live whole in VMEM per (batch·head) grid step, so the practical
-per-device sequence limit is ~8k at head_dim 128 fp32 (half the ~16 MB
-VMEM); shard longer sequences with ring/Ulysses first.
+K and V are CHUNKED: each kernel call holds one ``kv_chunk`` (default 8k
+rows) of K/V in VMEM, and chunks are folded at the XLA level with the same
+normalized-(output, lse) merge the ring fold uses — so a single device
+streams arbitrary ``seq_len`` (the old ~8k VMEM cliff is gone; beyond one
+device's FLOPs, shard with ring/Ulysses).  The backward pass streams the
+same way: dQ accumulates over K/V chunks, dK/dV over Q chunks, all against
+the global lse/delta.
 
 No reference equivalent (the reference has no compute kernels at all,
 SURVEY.md §2.6).
@@ -41,7 +45,7 @@ def _auto_interpret():
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
-                seq_len, block_q, block_k, packed):
+                seq_len, block_q, block_k, packed, k_start, kv_blocks):
     if packed:
         sq_ref, sk_ref, o_ref, lse_ref = refs
     else:
@@ -50,10 +54,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
     q = q_ref[0].astype(jnp.float32)  # [block_q, d]
     d = q.shape[-1]
 
-    num_kv = pl.cdiv(seq_len, block_k)
+    # ``k_ref`` holds one K/V CHUNK starting at absolute position
+    # ``k_start`` (k_start=0, kv_blocks=whole-sequence for the unchunked
+    # call); all masks work in absolute positions so chunked calls fold
+    # into exactly the unchunked result.
+    num_kv = jnp.minimum(kv_blocks,
+                         jnp.maximum(0, pl.cdiv(seq_len - k_start, block_k)))
     if causal:
         # Blocks strictly above the diagonal contribute nothing.
-        num_kv = jnp.minimum(num_kv, pl.cdiv((qi + 1) * block_q, block_k))
+        num_kv = jnp.minimum(num_kv, jnp.maximum(
+            0, pl.cdiv((qi + 1) * block_q - k_start, block_k)))
 
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
@@ -63,7 +73,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        k_pos = k_start + kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         mask = k_pos < seq_len  # padded keys never attend
         if causal:
             mask &= q_pos >= k_pos
@@ -95,14 +106,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
     lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
-def _fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k, packed,
-         heads, interpret):
+def _fwd(q3, k3, v3, seg3, seg3_k, scale, causal, seq_len, block_q, block_k,
+         packed, heads, interpret, k_start=0):
+    """One forward kernel call: full Q against the K/V chunk ``k3``/``v3``
+    (absolute start ``k_start``).  ``seg3`` is the q-side segment array
+    (full length), ``seg3_k`` the k-side chunk slice."""
     bh, seq_pad, d = q3.shape
+    kv_pad = k3.shape[1]
     grid = (bh, seq_pad // block_q)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, kv_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, kv_pad, d), lambda i, j: (i, 0, 0)),
     ]
     args = [q3, k3, v3]
     if packed:
@@ -110,13 +125,14 @@ def _fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k, packed,
         # so the index map folds the (batch*heads) grid axis back down.
         in_specs += [
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i // heads, 0, j)),
-            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i // heads, 0, 0)),
+            pl.BlockSpec((1, 1, kv_pad), lambda i, j: (i // heads, 0, 0)),
         ]
-        args += [seg3, seg3]
+        args += [seg3, seg3_k]
     return pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           seq_len=seq_len, block_q=block_q, block_k=block_k,
-                          packed=packed),
+                          packed=packed, k_start=k_start,
+                          kv_blocks=kv_pad // block_k),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -131,12 +147,59 @@ def _fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k, packed,
     )(*args)
 
 
+def _fold_normalized(o1, lse1, o2, lse2):
+    """Merge two normalized partial attentions (softmax weight exp(lse)).
+
+    The chunk-level analog of the ring hop fold: o = Σ o_i·exp(lse_i) /
+    Σ exp(lse_i), with fully-masked (lse == NEG_INF) parts contributing
+    exactly zero.  ``o*`` are [bh, seq, d] fp32, ``lse*`` [bh, 1, seq]."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m == NEG_INF, 0.0, m)
+    w1 = jnp.where(lse1 == NEG_INF, 0.0, jnp.exp(lse1 - m_safe))
+    w2 = jnp.where(lse2 == NEG_INF, 0.0, jnp.exp(lse2 - m_safe))
+    denom = w1 + w2
+    safe = jnp.where(denom == 0.0, 1.0, denom)
+    wa = jnp.swapaxes(w1 / safe, 1, 2)          # [bh, seq, 1]
+    wb = jnp.swapaxes(w2 / safe, 1, 2)
+    o = o1 * wa + o2 * wb
+    lse = jnp.where(denom == 0.0, NEG_INF, m_safe + jnp.log(safe))
+    return o, lse
+
+
+def _fwd_chunked(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k,
+                 packed, heads, interpret, kv_chunk):
+    """Stream K/V through the forward kernel in ``kv_chunk`` slices.
+
+    VMEM per call is one chunk instead of the whole sequence — the piece
+    that removes the single-device seq-length cliff.  Accumulation stays
+    fp32 across folds; the final cast matches the unchunked kernel."""
+    bh, seq_pad, d = q3.shape
+    o = None
+    lse = None
+    for c0 in range(0, seq_pad, kv_chunk):
+        c1 = min(c0 + kv_chunk, seq_pad)
+        k_c = jax.lax.slice_in_dim(k3, c0, c1, axis=1)
+        v_c = jax.lax.slice_in_dim(v3, c0, c1, axis=1)
+        seg_k = (jax.lax.slice_in_dim(seg3, c0, c1, axis=2)
+                 if packed else None)
+        o_c, lse_c = _fwd(q3, k_c, v_c, seg3, seg_k, scale, causal, seq_len,
+                          block_q, block_k, packed, heads, interpret,
+                          k_start=c0)
+        o_c = o_c.astype(jnp.float32)
+        if o is None:
+            o, lse = o_c, lse_c
+        else:
+            o, lse = _fold_normalized(o, lse, o_c, lse_c)
+    return o.astype(q3.dtype), lse
+
+
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                   scale, causal, seq_len, block_q, block_k, packed):
+                   scale, causal, seq_len, block_q, block_k, packed,
+                   k_start, kv_blocks):
     if packed:
         sq_ref, sk_ref, dq_ref = refs
     else:
@@ -148,9 +211,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
     delta = delta_ref[0, 0]   # [block_q]
     d = q.shape[-1]
 
-    num_kv = pl.cdiv(seq_len, block_k)
+    # Chunk-relative K/V (absolute start ``k_start``): dq contributions
+    # against the GLOBAL lse/delta are additive across chunks.
+    num_kv = jnp.minimum(kv_blocks,
+                         jnp.maximum(0, pl.cdiv(seq_len - k_start, block_k)))
     if causal:
-        num_kv = jnp.minimum(num_kv, pl.cdiv((qi + 1) * block_q, block_k))
+        num_kv = jnp.minimum(num_kv, jnp.maximum(
+            0, pl.cdiv((qi + 1) * block_q - k_start, block_k)))
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     def body(kb, dq):
@@ -158,7 +225,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        k_pos = k_start + kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         # Padded query rows carry lse == NEG_INF; without the q_pos guard
         # exp(s - NEG_INF) overflows to inf and poisons ds with NaNs.
         mask = (k_pos < seq_len) & (q_pos < seq_len)
@@ -182,7 +250,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                    scale, causal, seq_len, block_q, block_k, packed):
+                    scale, causal, seq_len, block_q, block_k, packed,
+                    q_start, k_start, q_blocks):
     if packed:
         sq_ref, sk_ref, dk_ref, dv_ref = refs
     else:
@@ -192,9 +261,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
     v = v_ref[0].astype(jnp.float32)
     d = k.shape[-1]
 
-    num_q = pl.cdiv(seq_len, block_q)
-    q_start = (ki * block_k) // block_q if causal else 0
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    # ``q_ref``/``do_ref``/``lse_ref``/``delta_ref`` hold one Q chunk
+    # (absolute start ``q_start``); k blocks are chunk-relative with
+    # absolute start ``k_start``.  dk/dv contributions against the global
+    # lse/delta are additive across Q chunks.
+    num_q = jnp.minimum(q_blocks,
+                        jnp.maximum(0, pl.cdiv(seq_len - q_start, block_q)))
+    if causal:
+        q_begin = jnp.clip((k_start + ki * block_k - q_start) // block_q,
+                           0, num_q)
+    else:
+        q_begin = 0
+    k_pos = k_start + ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
 
     def body(qb, carry):
         dk, dv = carry
@@ -204,7 +283,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         delta_blk = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        q_pos = q_start + qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
             mask &= q_pos >= k_pos
@@ -223,36 +303,37 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         return dk, dv
 
     zeros = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(q_start, num_q, body, (zeros, zeros))
+    dk, dv = jax.lax.fori_loop(q_begin, num_q, body, (zeros, zeros))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, seg3, o3, lse, do3, scale, causal, seq_len, block_q,
-         block_k, packed, heads, interpret):
+def _bwd_dq_call(q3, k_c, v_c, seg3, seg_k, do3, lse, delta, scale, causal,
+                 seq_len, block_q, block_k, packed, heads, interpret,
+                 k_start):
+    """dQ contribution of one K/V chunk (full Q streamed block-by-block)."""
     bh, seq_pad, d = q3.shape
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
-                    axis=-1)[:, None, :]  # [bh, 1, seq] like lse
-
+    kv_pad = k_c.shape[1]
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, kv_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, kv_pad, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
     ]
-    dq_args = [q3, k3, v3, do3, lse, delta]
+    dq_args = [q3, k_c, v_c, do3, lse, delta]
     if packed:
         dq_specs += [
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i // heads, 0, j)),
-            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i // heads, 0, 0)),
+            pl.BlockSpec((1, 1, kv_pad), lambda i, j: (i // heads, 0, 0)),
         ]
-        dq_args += [seg3, seg3]
-    dq = pl.pallas_call(
+        dq_args += [seg3, seg_k]
+    return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           seq_len=seq_len, block_q=block_q, block_k=block_k,
-                          packed=packed),
+                          packed=packed, k_start=k_start,
+                          kv_blocks=kv_pad // block_k),
         grid=(bh, seq_pad // block_q),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -260,66 +341,136 @@ def _bwd(q3, k3, v3, seg3, o3, lse, do3, scale, causal, seq_len, block_q,
         interpret=interpret,
     )(*dq_args)
 
+
+def _bwd_dkv_call(q_c, k_c, v_c, seg_q, seg_k, do_c, lse_c, delta_c, scale,
+                  causal, seq_len, block_q, block_k, packed, heads, interpret,
+                  q_start, k_start):
+    """dK/dV contribution of one Q chunk against one K/V chunk."""
+    bh, q_pad, d = q_c.shape
+    kv_pad = k_c.shape[1]
     dkv_specs = [
-        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, q_pad, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, seq_pad, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, q_pad, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, 1, q_pad), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, 1, q_pad), lambda i, j: (i, 0, 0)),
     ]
-    dkv_args = [q3, k3, v3, do3, lse, delta]
+    dkv_args = [q_c, k_c, v_c, do_c, lse_c, delta_c]
     if packed:
         dkv_specs += [
-            pl.BlockSpec((1, 1, seq_pad), lambda i, j: (i // heads, 0, 0)),
+            pl.BlockSpec((1, 1, q_pad), lambda i, j: (i // heads, 0, 0)),
             pl.BlockSpec((1, 1, block_k), lambda i, j: (i // heads, 0, j)),
         ]
-        dkv_args += [seg3, seg3]
-    dk, dv = pl.pallas_call(
+        dkv_args += [seg_q, seg_k]
+    return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           seq_len=seq_len, block_q=block_q, block_k=block_k,
-                          packed=packed),
-        grid=(bh, seq_pad // block_k),
+                          packed=packed, q_start=q_start, k_start=k_start,
+                          q_blocks=q_pad // block_q),
+        grid=(bh, kv_pad // block_k),
         in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq_pad, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, seq_pad, d), v3.dtype),
+            jax.ShapeDtypeStruct((bh, kv_pad, d), k_c.dtype),
+            jax.ShapeDtypeStruct((bh, kv_pad, d), v_c.dtype),
         ],
         interpret=interpret,
     )(*dkv_args)
-    return dq, dk, dv
+
+
+def _bwd(q3, k3, v3, seg3, o3, lse, do3, scale, causal, seq_len, block_q,
+         block_k, packed, heads, interpret, kv_chunk=None):
+    """Backward pass, K/V (and Q, for dK/dV) streamed in chunks.
+
+    Per-chunk contributions computed against the GLOBAL lse/delta are
+    plain sums — no softmax refold needed in the backward direction."""
+    bh, seq_pad, d = q3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]  # [bh, 1, seq] like lse
+    chunk = kv_chunk if kv_chunk is not None else seq_pad
+    chunk = min(chunk, seq_pad)
+
+    def sl(x, lo, hi, axis=1):
+        return jax.lax.slice_in_dim(x, lo, hi, axis=axis)
+
+    dq = None
+    dk_parts, dv_parts = [], []
+    for c0 in range(0, seq_pad, chunk):
+        c1 = min(c0 + chunk, seq_pad)
+        k_c, v_c = sl(k3, c0, c1), sl(v3, c0, c1)
+        seg_k = sl(seg3, c0, c1, axis=2) if packed else None
+        dq_c = _bwd_dq_call(q3, k_c, v_c, seg3, seg_k, do3, lse, delta,
+                            scale, causal, seq_len, block_q, block_k, packed,
+                            heads, interpret, k_start=c0)
+        # Partials accumulate in fp32 at the XLA level (the single-call
+        # path accumulates in fp32 inside the kernel; chunking must not
+        # lose that).
+        dq_c = dq_c.astype(jnp.float32)
+        dq = dq_c if dq is None else dq + dq_c
+        dk_c = None
+        dv_c = None
+        for r0 in range(0, seq_pad, chunk):
+            r1 = min(r0 + chunk, seq_pad)
+            if causal and r1 <= c0:
+                continue  # whole Q chunk above the diagonal: contributes 0
+            dkc, dvc = _bwd_dkv_call(
+                sl(q3, r0, r1), k_c, v_c,
+                sl(seg3, r0, r1, axis=2) if packed else None, seg_k,
+                sl(do3, r0, r1), sl(lse, r0, r1, axis=2),
+                sl(delta, r0, r1, axis=2), scale, causal, seq_len, block_q,
+                block_k, packed, heads, interpret, q_start=r0, k_start=c0)
+            dkc = dkc.astype(jnp.float32)
+            dvc = dvc.astype(jnp.float32)
+            dk_c = dkc if dk_c is None else dk_c + dkc
+            dv_c = dvc if dv_c is None else dv_c + dvc
+        if dk_c is None:  # every Q chunk skipped (can't happen, but safe)
+            dk_c = jnp.zeros(k_c.shape, jnp.float32)
+            dv_c = jnp.zeros(v_c.shape, jnp.float32)
+        dk_parts.append(dk_c)
+        dv_parts.append(dv_c)
+    dk = dk_parts[0] if len(dk_parts) == 1 else jnp.concatenate(dk_parts, axis=1)
+    dv = dv_parts[0] if len(dv_parts) == 1 else jnp.concatenate(dv_parts, axis=1)
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
 # ---------------------------------------------------------------------------
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k, packed,
-           heads):
+           heads, kv_chunk):
     out, _ = _flash_fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q,
-                        block_k, packed, heads)
+                        block_k, packed, heads, kv_chunk)
     return out
 
 
 def _flash_fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q, block_k,
-               packed, heads):
-    out, lse = _fwd(q3, k3, v3, seg3, scale, causal, seq_len, block_q,
-                    block_k, packed, heads, interpret=_auto_interpret())
+               packed, heads, kv_chunk):
+    seq_pad = q3.shape[1]
+    if kv_chunk is None or kv_chunk >= seq_pad:
+        out, lse = _fwd(q3, k3, v3, seg3, seg3, scale, causal, seq_len,
+                        block_q, block_k, packed, heads,
+                        interpret=_auto_interpret())
+    else:
+        out, lse = _fwd_chunked(q3, k3, v3, seg3, scale, causal, seq_len,
+                                block_q, block_k, packed, heads,
+                                interpret=_auto_interpret(),
+                                kv_chunk=kv_chunk)
     return out, (q3, k3, v3, seg3, out, lse)
 
 
-def _flash_bwd(scale, causal, seq_len, block_q, block_k, packed, heads, res,
-               g):
+def _flash_bwd(scale, causal, seq_len, block_q, block_k, packed, heads,
+               kv_chunk, res, g):
     import numpy as _np
     q3, k3, v3, seg3, out, lse = res
     dq, dk, dv = _bwd(q3, k3, v3, seg3, out, lse, g, scale, causal, seq_len,
                       block_q, block_k, packed, heads,
-                      interpret=_auto_interpret())
+                      interpret=_auto_interpret(), kv_chunk=kv_chunk)
     # Integer operands take a float0 cotangent (segment ids are labels);
     # the non-packed path carries seg3=None (empty pytree, no cotangent).
     dseg = (None if seg3 is None
@@ -330,8 +481,14 @@ def _flash_bwd(scale, causal, seq_len, block_q, block_k, packed, heads, res,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+#: Above this padded length the forward/backward default to streaming K/V
+#: in chunks of this many rows (fp32 d=128: ~8 MB K+V per call — well under
+#: VMEM).  Explicit ``kv_chunk`` overrides.
+KV_CHUNK_DEFAULT = 8192
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
-                    segment_ids=None):
+                    segment_ids=None, kv_chunk=None):
     """Flash attention over ``[batch, seq, heads, head_dim]`` inputs.
 
     Drop-in for ``petastorm_tpu.parallel.full_attention`` (same signature and
@@ -343,6 +500,13 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
     to same-nonzero-segment pairs — the O(seq)-memory path for
     ``petastorm_tpu.jax.packing`` packed rows (same semantics as
     ``packing.packed_attention``, which is the dense oracle).
+
+    ``kv_chunk`` streams K/V through VMEM in chunks of that many rows
+    (auto-enabled above ``KV_CHUNK_DEFAULT`` padded rows; ``0`` forces the
+    old whole-K/V residency), so a single
+    device handles arbitrary sequence lengths instead of capping where
+    whole-K/V VMEM residency ran out (~8k rows fp32).  The backward pass
+    streams the same way (dQ over K/V chunks, dK/dV over Q chunks).
 
     Compiles to Mosaic on TPU; on CPU/GPU backends it runs the same kernels
     through the Pallas interpreter (tests, dry runs).
@@ -391,7 +555,17 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
     else:
         seg3 = None
 
+    if kv_chunk is None and seq_pad > KV_CHUNK_DEFAULT:
+        kv_chunk = KV_CHUNK_DEFAULT
+    if kv_chunk == 0:
+        kv_chunk = None      # explicit 0: whole-K/V residency, no streaming
+    elif kv_chunk is not None:
+        # chunk boundaries must land on both block grids
+        kv_chunk = max(lcm, (int(kv_chunk) // lcm) * lcm)
+        if kv_chunk >= seq_pad:
+            kv_chunk = None
+
     out = _flash(to3(q), to3(k), to3(v), seg3, scale, causal, seq_len,
-                 block_q, block_k, packed, h)
+                 block_q, block_k, packed, h, kv_chunk)
     out = out[:, :seq_len].reshape(b, h, seq_len, d)
     return jnp.moveaxis(out, 1, 2)

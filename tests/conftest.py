@@ -29,6 +29,7 @@ def pytest_configure(config):
     # pytest-timeout is not installed in the TPU image; register the mark so
     # the suite stays warning-free (the marks document intent either way).
     config.addinivalue_line('markers', 'timeout(seconds): per-test time budget')
+    config.addinivalue_line('markers', 'slow: long-running correctness test')
 
 
 @pytest.fixture(scope='session')

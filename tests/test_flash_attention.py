@@ -212,3 +212,78 @@ def test_packed_in_jit(rng):
 
     out = f(q, k, v, seg)
     assert np.isfinite(np.asarray(out)).all()
+
+
+# -- K/V chunking (streaming long sequences through VMEM-sized chunks) -------
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_chunked_matches_oracle(rng, causal):
+    """kv_chunk folding must reproduce the dense oracle exactly (fwd)."""
+    q, k, v = _qkv(rng, s=96)
+    want = full_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          kv_chunk=32)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_chunked_backward_matches_oracle(rng, causal):
+    q, k, v = _qkv(rng, s=96)
+    dout = jnp.asarray(np.random.default_rng(5).standard_normal(q.shape),
+                       jnp.float32)
+
+    def loss(fn, extra):
+        return lambda t: (fn(*t, causal=causal, **extra) * dout).sum()
+
+    want = jax.grad(loss(full_attention, {}))((q, k, v))
+    got = jax.grad(loss(flash_attention,
+                        dict(block_q=32, block_k=32, kv_chunk=32)))((q, k, v))
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, atol=3e-5, rtol=3e-5)
+
+
+def test_chunked_packed_matches_oracle(rng):
+    q, k, v = _qkv(rng, s=96)
+    seg = np.zeros((2, 96), np.int32)
+    seg[:, :40] = 1
+    seg[:, 40:80] = 2          # tail [80:] stays 0 = padding
+    seg = jnp.asarray(seg)
+    dout = jnp.asarray(np.random.default_rng(7).standard_normal(q.shape),
+                       jnp.float32)
+    want = full_attention(q, k, v, causal=True, segment_ids=seg)
+    got = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                          block_q=32, block_k=32, kv_chunk=32)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def loss(fn, extra):
+        return lambda t: (fn(*t, causal=True, segment_ids=seg,
+                             **extra) * dout).sum()
+
+    gw = jax.grad(loss(full_attention, {}))((q, k, v))
+    gg = jax.grad(loss(flash_attention,
+                       dict(block_q=32, block_k=32, kv_chunk=32)))((q, k, v))
+    for g, w in zip(gg, gw):
+        np.testing.assert_allclose(g, w, atol=3e-5, rtol=3e-5)
+
+
+def test_chunk_boundaries_respect_block_lcm(rng):
+    """A kv_chunk that isn't a block multiple is rounded, not crashed."""
+    q, k, v = _qkv(rng, s=128)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                          kv_chunk=50)   # rounds down to 32
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_32k_tokens_stream_through_chunks(rng):
+    """The old cliff: >8k rows required whole-K/V VMEM residency.  32k rows
+    must now run chunked, and agree with the (interpreter-resident)
+    unchunked kernel."""
+    b, s, h, d = 1, 32768, 1, 32
+    qkv = [jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+           for _ in range(3)]
+    kw = dict(causal=True, block_q=512, block_k=512)
+    got = flash_attention(*qkv, kv_chunk=4096, **kw)
+    want = flash_attention(*qkv, kv_chunk=0, **kw)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
