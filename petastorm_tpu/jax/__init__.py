@@ -7,5 +7,6 @@ row-group sharding by ``jax.process_index()``.
 
 from petastorm_tpu.jax import augment, packing  # noqa: F401
 from petastorm_tpu.jax.loader import (DataLoader,  # noqa: F401
-                                      DeviceInMemDataLoader, InMemDataLoader,
+                                      DeviceInMemDataLoader,
+                                      DiskCachedDataLoader, InMemDataLoader,
                                       PackedDataLoader, make_jax_loader)

@@ -40,7 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ['pack_sequences', 'pack_stream', 'segment_mask',
+__all__ = ['pack_sequences', 'pack_stream', 'StreamPacker', 'segment_mask',
            'packed_attention', 'next_token_targets']
 
 
@@ -122,58 +122,116 @@ def pack_stream(seq_iter, max_len, rows_per_batch, pad_id=0,
     int64 widens once and stays wide instead of alternating batch dtypes
     (which would retrigger XLA compilation in a jitted step).
     """
-    if rows_per_batch < 1 or open_rows < 1:
-        raise ValueError('rows_per_batch and open_rows must be >= 1')
-    open_ = []      # list of (room, [seqs])
-    closed = []
-    dtype = None    # promoted over everything seen; never narrows
-
-    def close_fullest():
-        i = min(range(len(open_)), key=lambda j: open_[j][0])
-        closed.append(open_.pop(i)[1])
-
+    packer = StreamPacker(max_len, rows_per_batch, pad_id=pad_id,
+                          open_rows=open_rows, drop_last=drop_last)
     for seq in seq_iter:
+        for batch in packer.add(seq):
+            yield batch
+    for batch in packer.flush():
+        yield batch
+
+
+class StreamPacker(object):
+    """The stateful engine under :func:`pack_stream`.
+
+    ``add(seq)`` returns the batches that became ready; ``flush()`` drains
+    the tail.  Exposed as a class (not just a generator) so loaders can
+    snapshot the residue — open rows, closed rows, sticky dtype — for
+    exact mid-epoch checkpoint/resume
+    (``petastorm_tpu.jax.PackedDataLoader.state_dict``).
+    """
+
+    def __init__(self, max_len, rows_per_batch, pad_id=0, open_rows=32,
+                 drop_last=False):
+        if rows_per_batch < 1 or open_rows < 1:
+            raise ValueError('rows_per_batch and open_rows must be >= 1')
+        self._max_len = max_len
+        self._rows_per_batch = rows_per_batch
+        self._pad_id = pad_id
+        self._open_rows = open_rows
+        self._drop_last = drop_last
+        self._open = []      # list of (room, [seqs])
+        self._closed = []
+        self._dtype = None   # promoted over everything seen; never narrows
+
+    def _close_fullest(self):
+        i = min(range(len(self._open)), key=lambda j: self._open[j][0])
+        self._closed.append(self._open.pop(i)[1])
+
+    def _ready_batches(self):
+        out = []
+        while len(self._closed) >= self._rows_per_batch:
+            out.append(_emit(self._closed[:self._rows_per_batch],
+                             self._max_len, self._dtype, self._pad_id))
+            self._closed = self._closed[self._rows_per_batch:]
+        return out
+
+    def add(self, seq):
+        """Fold one sequence in; returns the batches that became ready."""
         seq = np.asarray(seq)
         if seq.ndim != 1:
             raise ValueError('expected 1-D sequences, got %r' % (seq.shape,))
-        dtype = seq.dtype if dtype is None else np.result_type(dtype, seq.dtype)
+        self._dtype = (seq.dtype if self._dtype is None
+                       else np.result_type(self._dtype, seq.dtype))
+        max_len = self._max_len
         if len(seq) > max_len:
             raise ValueError('sequence of length %d exceeds max_len=%d'
                              % (len(seq), max_len))
         if len(seq) == max_len:     # exactly-full row: close it now
-            closed.append([seq])
+            self._closed.append([seq])
         else:
-            fits = [i for i, (room, _) in enumerate(open_)
+            fits = [i for i, (room, _) in enumerate(self._open)
                     if room >= len(seq)]
             if fits:
-                i = min(fits, key=lambda j: open_[j][0])   # best fit
-                room, seqs = open_[i]
+                i = min(fits, key=lambda j: self._open[j][0])   # best fit
+                room, seqs = self._open[i]
                 seqs.append(seq)
-                open_[i] = (room - len(seq), seqs)
-                if open_[i][0] == 0:
-                    closed.append(open_.pop(i)[1])
+                self._open[i] = (room - len(seq), seqs)
+                if self._open[i][0] == 0:
+                    self._closed.append(self._open.pop(i)[1])
             else:
-                open_.append((max_len - len(seq), [seq]))
-                if len(open_) > open_rows:
-                    close_fullest()
-        while len(closed) >= rows_per_batch:
-            yield _emit(closed[:rows_per_batch], max_len, dtype, pad_id)
-            closed = closed[rows_per_batch:]
-    # drain
-    closed.extend(seqs for _, seqs in sorted(open_, key=lambda e: e[0]))
-    while len(closed) >= rows_per_batch:
-        yield _emit(closed[:rows_per_batch], max_len, dtype, pad_id)
-        closed = closed[rows_per_batch:]
-    if closed and not drop_last:
-        pad_rows = rows_per_batch - len(closed)
-        batch = _emit(closed, max_len, dtype, pad_id)
-        if pad_rows:
-            batch = {k: np.concatenate(
-                [v, np.zeros((pad_rows,) + v.shape[1:], v.dtype)])
-                for k, v in batch.items()}
-            if pad_id != 0:
-                batch['tokens'][-pad_rows:] = pad_id
-        yield batch
+                self._open.append((max_len - len(seq), [seq]))
+                if len(self._open) > self._open_rows:
+                    self._close_fullest()
+        return self._ready_batches()
+
+    def flush(self):
+        """Drain open rows; returns the final batches (tail short-padded
+        to full shape unless ``drop_last``)."""
+        self._closed.extend(
+            seqs for _, seqs in sorted(self._open, key=lambda e: e[0]))
+        self._open = []
+        out = self._ready_batches()
+        if self._closed and not self._drop_last:
+            pad_rows = self._rows_per_batch - len(self._closed)
+            batch = _emit(self._closed, self._max_len, self._dtype,
+                          self._pad_id)
+            if pad_rows:
+                batch = {k: np.concatenate(
+                    [v, np.zeros((pad_rows,) + v.shape[1:], v.dtype)])
+                    for k, v in batch.items()}
+                if self._pad_id != 0:
+                    batch['tokens'][-pad_rows:] = self._pad_id
+            out.append(batch)
+        self._closed = []
+        return out
+
+    # -- exact-checkpoint support --------------------------------------------
+
+    def state_dict(self):
+        return {
+            'open': [(room, [np.asarray(s) for s in seqs])
+                     for room, seqs in self._open],
+            'closed': [[np.asarray(s) for s in seqs]
+                       for seqs in self._closed],
+            'dtype': None if self._dtype is None else np.dtype(self._dtype).str,
+        }
+
+    def load_state_dict(self, state):
+        self._open = [(room, list(seqs)) for room, seqs in state['open']]
+        self._closed = [list(seqs) for seqs in state['closed']]
+        self._dtype = (None if state['dtype'] is None
+                       else np.dtype(state['dtype']))
 
 
 def segment_mask(segment_ids_q, segment_ids_kv, causal=False):

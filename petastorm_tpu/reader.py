@@ -423,7 +423,9 @@ class Reader(object):
                 self.last_row_consumed = True
                 raise StopIteration from None
             self._row_buffer = list(rows)
-        row = self._row_buffer.pop(0)
+        return self._convert_row(self._row_buffer.pop(0))
+
+    def _convert_row(self, row):
         if self.ngram is not None:
             # NGram rows are {offset: row-dict}; each offset gets its own
             # namedtuple type (the fields requested at that timestep).
@@ -433,6 +435,60 @@ class Reader(object):
 
     def next(self):
         return self.__next__()
+
+    # -- exact-checkpoint support ---------------------------------------------
+
+    def drain_in_flight(self):
+        """Pause dispatch and consume EVERY in-flight result; returns them.
+
+        After this returns, no row group is outstanding and no published
+        row sits in a pool queue, so :meth:`state_dict` is an EXACT
+        position: nothing delivered so far will replay, nothing undelivered
+        is skipped.  (Without draining, the token is row-group granular:
+        groups acked by workers whose rows still sit in the results queue
+        would be lost, and partially-consumed groups would replay.)
+
+        Returns a list of rows (row readers) or columnar batches (batch
+        readers) in delivery order.  Call :meth:`resume_dispatch` to
+        continue reading afterwards — the checkpoint-then-keep-training
+        pattern.  Used by ``petastorm_tpu.jax.DataLoader.state_dict``.
+        """
+        from petastorm_tpu.workers_pool import TimeoutWaitingForResultError
+        self._ventilator.pause()
+        drained = []
+        if self._result_converter is None and self._row_buffer:
+            drained.extend(self._convert_row(r) for r in self._row_buffer)
+            self._row_buffer = []
+        while self._ventilator.has_outstanding():
+            try:
+                results = self._pool.get_results(timeout=0.2)
+            except TimeoutWaitingForResultError:
+                continue   # trailing ack still in flight; re-check
+            except EmptyResultError:
+                self.last_row_consumed = True
+                return drained
+            drained.extend(self._to_drained(results))
+        # Final sweep: results published by groups that were acked before
+        # the loop observed them (ack always follows publish, so once no
+        # group is outstanding, everything published is already queued).
+        try:
+            while True:
+                results = self._pool.get_results(timeout=0.05)
+                drained.extend(self._to_drained(results))
+        except TimeoutWaitingForResultError:
+            pass
+        except EmptyResultError:
+            self.last_row_consumed = True
+        return drained
+
+    def _to_drained(self, results):
+        if self._result_converter is not None:
+            return [self._result_converter.convert(results)]
+        return [self._convert_row(r) for r in results]
+
+    def resume_dispatch(self):
+        """Resume ventilation after :meth:`drain_in_flight`."""
+        self._ventilator.unpause()
 
     # -- lifecycle -----------------------------------------------------------
 

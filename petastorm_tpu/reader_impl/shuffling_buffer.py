@@ -41,6 +41,15 @@ class NoopShufflingBuffer(object):
     def finished(self):
         return self._done and not self._items
 
+    # -- exact-checkpoint support --------------------------------------------
+
+    def state_dict(self):
+        return {'items': list(self._items), 'done': self._done}
+
+    def load_state_dict(self, state):
+        self._items = deque(state['items'])
+        self._done = bool(state['done'])
+
 
 class RandomShufflingBuffer(object):
     """Uniform-without-replacement reservoir.
@@ -90,3 +99,16 @@ class RandomShufflingBuffer(object):
     @property
     def finished(self):
         return self._done and not self._items
+
+    # -- exact-checkpoint support --------------------------------------------
+
+    def state_dict(self):
+        """Contents + rng state: restoring reproduces the exact remaining
+        draw sequence a seeded uninterrupted run would have made."""
+        return {'items': list(self._items), 'done': self._done,
+                'rng_state': self._rng.bit_generator.state}
+
+    def load_state_dict(self, state):
+        self._items = list(state['items'])
+        self._done = bool(state['done'])
+        self._rng.bit_generator.state = state['rng_state']

@@ -35,7 +35,8 @@ class DummyPool(object):
     def ventilate(self, *args, **kwargs):
         self._pending.append((args, kwargs))
 
-    def get_results(self):
+    def get_results(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
         while not self._results:
             if self._pending:
                 args, kwargs = self._pending.popleft()
@@ -51,7 +52,15 @@ class DummyPool(object):
                 if self._ventilator is not None:
                     self._ventilator.processed_item(position)
             elif self._ventilator is not None and not self._ventilator.completed():
-                # Ventilator thread may still be filling us; spin briefly.
+                # Ventilator thread may still be filling us; spin briefly —
+                # but honor the timeout (a PAUSED ventilator never completes,
+                # and drain_in_flight probes with short timeouts).
+                if deadline is not None and time.monotonic() >= deadline:
+                    from petastorm_tpu.workers_pool import \
+                        TimeoutWaitingForResultError
+                    raise TimeoutWaitingForResultError(
+                        'no results within %ss (ventilator idle or paused)'
+                        % timeout)
                 time.sleep(0.001)
             else:
                 raise EmptyResultError()

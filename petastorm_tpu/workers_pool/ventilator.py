@@ -13,6 +13,7 @@ workers but not yet consumed downstream are re-read on resume.
 
 import logging
 import threading
+import time
 
 import numpy as np
 
@@ -66,6 +67,7 @@ class ConcurrentVentilator(Ventilator):
         self._cursor = start_cursor  # index into the current epoch's permutation
         self._inflight = threading.Semaphore(self._max_inflight)
         self._completed = threading.Event()
+        self._paused = threading.Event()
         self._stop_requested = threading.Event()
         self._thread = None
         self._lock = threading.Lock()
@@ -109,13 +111,23 @@ class ConcurrentVentilator(Ventilator):
             while cursor < n:
                 if self._stop_requested.is_set():
                     return
+                if self._paused.is_set():
+                    time.sleep(0.02)
+                    continue
                 # Bounded in-flight: block until a worker acks something.
                 if not self._inflight.acquire(timeout=0.1):
                     continue
-                item = order[cursor]
-                position = epoch * n + cursor
-                cursor += 1
                 with self._lock:
+                    # Re-check under the lock: pause() also takes it, so
+                    # after pause() returns, either this item is already in
+                    # _outstanding (drain will consume it) or it will not be
+                    # dispatched — no window where it is in neither state.
+                    if self._paused.is_set():
+                        self._inflight.release()
+                        continue
+                    item = order[cursor]
+                    position = epoch * n + cursor
+                    cursor += 1
                     self._cursor = cursor
                     self._outstanding.add(position)
                     self.ventilated_count += 1
@@ -130,6 +142,25 @@ class ConcurrentVentilator(Ventilator):
             with self._lock:
                 self._outstanding.discard(position)
         self._inflight.release()
+
+    # -- pause/drain (exact checkpointing) -----------------------------------
+
+    def pause(self):
+        """Stop dispatching new items; in-flight items keep processing.
+
+        Taken with the dispatch lock so that once this returns, every item
+        is either visible in the outstanding set or will never dispatch —
+        the invariant :meth:`has_outstanding`-based draining relies on.
+        """
+        with self._lock:
+            self._paused.set()
+
+    def unpause(self):
+        self._paused.clear()
+
+    def has_outstanding(self):
+        with self._lock:
+            return bool(self._outstanding)
 
     def completed(self):
         """True once every item of every iteration has been ventilated."""

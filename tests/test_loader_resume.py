@@ -1,0 +1,325 @@
+"""Exact mid-epoch loader resume (SURVEY §5.4 build obligation).
+
+The contract under test: ``DataLoader.state_dict()`` at step k, restore in
+a FRESH PROCESS, and the resumed loader yields exactly what the
+uninterrupted run had left — the same row multiset for concurrent pools
+(thread/process: delivery order is scheduling-dependent), and the same
+batch-for-batch order for deterministic seeded runs (dummy pool).
+
+Exactness needs more than the reader's row-group token: the snapshot
+drains in-flight results (which the bare token would replay or lose),
+and captures the shuffling buffer (+ rng state), the partial batch, the
+prefetched device batches, and the packer residue.
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.jax import DataLoader, PackedDataLoader
+
+from test_common import create_test_dataset
+
+BATCH = 10
+ROWS = 64
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('resumeds')
+    return create_test_dataset('file://' + str(path), num_rows=ROWS,
+                               rows_per_rowgroup=8)
+
+
+def _reader(url, pool, **kw):
+    kw.setdefault('num_epochs', 2)
+    kw.setdefault('shuffle_row_groups', True)
+    kw.setdefault('seed', 7)
+    if pool != 'dummy':
+        kw.setdefault('workers_count', 3)
+    return make_reader(url, reader_pool_type=pool, **kw)
+
+
+_CHILD = r"""
+import pickle, sys
+import numpy as np
+import jax
+jax.config.update('jax_platforms', 'cpu')
+payload = pickle.load(open(sys.argv[1], 'rb'))
+sys.path.insert(0, payload['repo'])
+sys.path.insert(0, payload['testdir'])
+from petastorm_tpu import make_reader
+from petastorm_tpu.jax import DataLoader
+
+state = payload['state']
+kw = dict(payload['reader_kwargs'])
+reader = make_reader(payload['url'], resume_state=state['reader'], **kw)
+loader = DataLoader(reader, batch_size=payload['batch'],
+                    resume_state=state, **payload['loader_kwargs'])
+with loader:
+    ids = [np.asarray(b['id']).tolist() for b in loader]
+pickle.dump(ids, open(sys.argv[2], 'wb'))
+"""
+
+
+def _resume_in_fresh_process(tmp_path, dataset, state, pool, reader_kwargs,
+                             loader_kwargs):
+    payload = {
+        'repo': os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'testdir': os.path.dirname(os.path.abspath(__file__)),
+        'url': dataset.url,
+        'state': state,
+        'batch': BATCH,
+        'reader_kwargs': dict({'reader_pool_type': pool, 'num_epochs': 2,
+                               'shuffle_row_groups': True, 'seed': 7},
+                              **reader_kwargs),
+        'loader_kwargs': loader_kwargs,
+    }
+    if pool != 'dummy':
+        payload['reader_kwargs'].setdefault('workers_count', 3)
+    pin = tmp_path / 'payload.pkl'
+    pout = tmp_path / 'out.pkl'
+    with open(pin, 'wb') as f:
+        pickle.dump(payload, f)
+    script = tmp_path / 'child.py'
+    script.write_text(_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    res = subprocess.run([sys.executable, str(script), str(pin), str(pout)],
+                         capture_output=True, text=True, timeout=300, env=env)
+    assert res.returncode == 0, res.stderr[-3000:]
+    with open(pout, 'rb') as f:
+        return pickle.load(f)
+
+
+def _run_uninterrupted(dataset, pool, loader_kwargs):
+    with DataLoader(_reader(dataset.url, pool), batch_size=BATCH,
+                    **loader_kwargs) as loader:
+        return [np.asarray(b['id']).tolist() for b in loader]
+
+
+def _run_interrupted(dataset, pool, k, loader_kwargs):
+    reader = _reader(dataset.url, pool)
+    loader = DataLoader(reader, batch_size=BATCH, **loader_kwargs)
+    consumed = []
+    it = iter(loader)
+    for _ in range(k):
+        consumed.append(np.asarray(next(it)['id']).tolist())
+    state = loader.state_dict()
+    # simulate the crash: abandon this loader entirely
+    reader.stop()
+    reader.join()
+    return consumed, state
+
+
+@pytest.mark.parametrize('pool', ['dummy', 'thread', 'process'])
+def test_multiset_exactness_across_pools(dataset, pool, tmp_path):
+    """consumed ⊎ resumed == every row exactly twice (2 epochs) — nothing
+    lost, nothing doubled, even with rows in flight in the pool at snapshot
+    time.  drop_last=False so the invariant is order-independent (with a
+    concurrent pool the *which-rows-land-in-the-tail* varies per run)."""
+    loader_kwargs = {'seed': 5, 'shuffling_queue_capacity': 24,
+                     'drop_last': False}
+    consumed, state = _run_interrupted(dataset, pool, 3, loader_kwargs)
+    resumed = _resume_in_fresh_process(tmp_path, dataset, state, pool, {},
+                                       loader_kwargs)
+    got = sorted(sum(consumed, []) + sum(resumed, []))
+    assert got == sorted(list(range(ROWS)) * 2)
+
+
+def test_exact_order_for_seeded_dummy_pool(dataset, tmp_path):
+    """Deterministic pipeline: the resumed stream must be batch-for-batch
+    identical to what the uninterrupted run had left."""
+    loader_kwargs = {'seed': 5, 'shuffling_queue_capacity': 24}
+    full = _run_uninterrupted(dataset, 'dummy', loader_kwargs)
+    consumed, state = _run_interrupted(dataset, 'dummy', 3, loader_kwargs)
+    assert consumed == full[:3]
+    resumed = _resume_in_fresh_process(tmp_path, dataset, state, 'dummy', {},
+                                       loader_kwargs)
+    assert resumed == full[3:]
+
+
+def test_resume_without_shuffle_buffer(dataset, tmp_path):
+    loader_kwargs = {}
+    full = _run_uninterrupted(dataset, 'dummy', loader_kwargs)
+    consumed, state = _run_interrupted(dataset, 'dummy', 2, loader_kwargs)
+    resumed = _resume_in_fresh_process(tmp_path, dataset, state, 'dummy', {},
+                                       loader_kwargs)
+    assert consumed + resumed == full
+
+
+def test_checkpoint_then_keep_training(dataset):
+    """state_dict must not disturb the live run: the in-process stream
+    continues exactly as if no snapshot had been taken."""
+    loader_kwargs = {'seed': 5, 'shuffling_queue_capacity': 24}
+    full = _run_uninterrupted(dataset, 'dummy', loader_kwargs)
+    reader = _reader(dataset.url, 'dummy')
+    with DataLoader(reader, batch_size=BATCH, **loader_kwargs) as loader:
+        it = iter(loader)
+        got = [np.asarray(next(it)['id']).tolist() for _ in range(3)]
+        loader.state_dict()   # snapshot mid-stream ...
+        for b in it:          # ... and keep consuming
+            got.append(np.asarray(b['id']).tolist())
+    assert got == full
+
+
+def test_columnar_reader_resume(dataset, tmp_path):
+    """make_batch_reader path: chunk residue rides the snapshot."""
+    with DataLoader(make_batch_reader(dataset.url, reader_pool_type='dummy',
+                                      shuffle_row_groups=False, num_epochs=1),
+                    batch_size=BATCH) as loader:
+        full = [np.asarray(b['id']).tolist() for b in loader]
+
+    reader = make_batch_reader(dataset.url, reader_pool_type='dummy',
+                               shuffle_row_groups=False, num_epochs=1)
+    loader = DataLoader(reader, batch_size=BATCH)
+    it = iter(loader)
+    consumed = [np.asarray(next(it)['id']).tolist() for _ in range(2)]
+    state = loader.state_dict()
+    reader.stop()
+    reader.join()
+
+    payload_kwargs = {'reader_pool_type': 'dummy', 'shuffle_row_groups': False,
+                      'num_epochs': 1}
+    # child uses make_reader; drive make_batch_reader inline instead
+    reader2 = make_batch_reader(dataset.url, resume_state=state['reader'],
+                                **payload_kwargs)
+    with DataLoader(reader2, batch_size=BATCH, resume_state=state) as loader2:
+        resumed = [np.asarray(b['id']).tolist() for b in loader2]
+    assert consumed + resumed == full
+
+
+class _SeqReader:
+    """Adapt dataset rows to variable-length int sequences (len = id%13+1)
+    while forwarding the exact-checkpoint reader protocol."""
+
+    num_epochs = 1
+    ngram = None
+    batched_output = False
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    @staticmethod
+    def _to_seq(row):
+        rid = int(row.id)
+        return {'tokens': np.full(rid % 13 + 1, rid, np.int32)}
+
+    def __iter__(self):
+        return (self._to_seq(row) for row in self._inner)
+
+    def drain_in_flight(self):
+        return [self._to_seq(r) for r in self._inner.drain_in_flight()]
+
+    def resume_dispatch(self):
+        self._inner.resume_dispatch()
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def stop(self):
+        self._inner.stop()
+
+    def join(self):
+        self._inner.join()
+
+
+def test_packed_loader_resume_preserves_tokens(dataset):
+    """Packer residue (open rows) must survive: token multiset across the
+    remaining packed batches equals the uninterrupted run's remainder."""
+    def seqs_of(batches):
+        toks = []
+        for b in batches:
+            t, s = np.asarray(b['tokens']), np.asarray(b['segment_ids'])
+            toks.extend(t[s > 0].tolist())
+        return sorted(toks)
+
+    def build_loader(resume=None, reader_resume=None):
+        reader = _SeqReader(make_reader(
+            dataset.url, reader_pool_type='dummy', shuffle_row_groups=False,
+            num_epochs=1, resume_state=reader_resume))
+        return reader, PackedDataLoader(reader, 'tokens', max_len=16,
+                                        rows_per_batch=4, drop_last=False,
+                                        resume_state=resume)
+
+    _, loader = build_loader()
+    with loader:
+        full = seqs_of(list(loader))
+
+    wrapped, loader = build_loader()
+    it = iter(loader)
+    consumed = [next(it) for _ in range(2)]
+    state = loader.state_dict()
+    wrapped.stop()
+    wrapped.join()
+
+    _, loader2 = build_loader(resume=state, reader_resume=state['reader'])
+    with loader2:
+        resumed = list(loader2)
+    assert seqs_of(consumed + resumed) == full
+
+
+def test_disk_cached_loader_exact_resume(dataset, tmp_path):
+    """DiskCachedDataLoader: (epoch, offset, order, rng) over the on-disk
+    cache gives exact order-preserving resume regardless of pool type."""
+    from petastorm_tpu.jax import DiskCachedDataLoader
+
+    cache = str(tmp_path / 'dcache')
+
+    def build(resume=None):
+        reader = make_reader(dataset.url, reader_pool_type='thread',
+                             workers_count=3, shuffle_row_groups=False,
+                             num_epochs=1)
+        return DiskCachedDataLoader(reader, batch_size=BATCH,
+                                    decoded_cache_dir=cache, num_epochs=3,
+                                    seed=11, resume_state=resume)
+
+    with build() as loader:
+        full = [np.asarray(b['id']).tolist() for b in loader]
+
+    # epoch 0 rebuilds nothing (cache complete); interrupt inside epoch 2
+    with build() as loader:
+        it = iter(loader)
+        consumed = [np.asarray(next(it)['id']).tolist() for _ in range(9)]
+        state = loader.state_dict()
+
+    state = pickle.loads(pickle.dumps(state))   # fresh-process equivalence
+    with build(resume=state) as loader2:
+        resumed = [np.asarray(b['id']).tolist() for b in loader2]
+
+    # The second loader serves all 3 epochs from the complete cache with
+    # the same seed, so its uninterrupted stream would be cache epochs
+    # 1..3-equivalent; compare against its own uninterrupted twin instead.
+    with build() as loader3:
+        twin = [np.asarray(b['id']).tolist() for b in loader3]
+    assert consumed + resumed == twin
+
+
+
+def test_state_dict_before_first_batch_preserves_restored_state(dataset,
+                                                                tmp_path):
+    """A checkpoint-every-N loop can land right after a restore, before the
+    first next(): the re-snapshot must carry the restored rows forward, not
+    silently drop them."""
+    loader_kwargs = {'seed': 5, 'shuffling_queue_capacity': 24,
+                     'drop_last': False}
+    consumed, state = _run_interrupted(dataset, 'dummy', 3, loader_kwargs)
+
+    # restore, immediately re-checkpoint without consuming anything
+    reader = make_reader(dataset.url, reader_pool_type='dummy', num_epochs=2,
+                         shuffle_row_groups=True, seed=7,
+                         resume_state=state['reader'])
+    loader = DataLoader(reader, batch_size=BATCH,
+                        resume_state=state, **loader_kwargs)
+    state2 = loader.state_dict()
+    reader.stop()
+    reader.join()
+
+    resumed = _resume_in_fresh_process(tmp_path, dataset, state2, 'dummy', {},
+                                       loader_kwargs)
+    got = sorted(sum(consumed, []) + sum(resumed, []))
+    assert got == sorted(list(range(ROWS)) * 2)
