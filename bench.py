@@ -38,9 +38,10 @@ BENCH_DIR = os.environ.get('PETASTORM_TPU_BENCH_DIR', '/tmp/petastorm_tpu_bench'
 DATASET_URL = 'file://' + BENCH_DIR + '/imagenet_like_v2'  # v2: image column
 # stored with parquet compression NONE (JPEG bytes are incompressible; the
 # writer now defaults codec-compressed columns to NONE)
+RAW_DATASET_URL = 'file://' + BENCH_DIR + '/imagenet_raw_v1'  # pre-decoded u8
 NUM_IMAGES = int(os.environ.get('PETASTORM_TPU_BENCH_ROWS', '768'))
 IMAGE_HW = (224, 224)
-BATCH = 64
+BATCH = int(os.environ.get('PETASTORM_TPU_BENCH_BATCH', '64'))
 # Decode threads scale with host cores (TPU-VM hosts have many); measured on
 # a 1-core sandbox, 8 still beats 4 because pyarrow/libjpeg release the GIL
 # during I/O waits, while >12 thrashes.
@@ -76,6 +77,42 @@ def ensure_dataset():
             yield {'noun_id': np.int64(i), 'image': img}
 
     with DatasetWriter(DATASET_URL, schema, rows_per_rowgroup=64) as w:
+        w.write_many(rows())
+
+
+def ensure_raw_dataset():
+    """Pre-decoded uint8 tensors in parquet (no JPEG, compression NONE).
+
+    The delivery-bound leg reads this through the full streaming path:
+    row-group read -> columnar collate -> double-buffered device_put, with
+    zero image-decode work.  It isolates the delivery plane (the
+    framework's own machinery) from decode economics (host-core bound) —
+    SURVEY §7's "data-stall <=2%" risk split into its two causes.
+    """
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    fs, path = get_filesystem_and_path_or_paths(RAW_DATASET_URL)
+    if fs.exists(path + '/_common_metadata'):
+        return
+
+    schema = Unischema('ImagenetRaw', [
+        UnischemaField('noun_id', np.int64, (), None, False),
+        UnischemaField('image', np.uint8, (IMAGE_HW[0], IMAGE_HW[1], 3),
+                       NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(0)
+
+    def rows():
+        for i in range(NUM_IMAGES):
+            yield {'noun_id': np.int64(i),
+                   'image': rng.integers(0, 256, (IMAGE_HW[0], IMAGE_HW[1], 3),
+                                         np.uint8)}
+
+    with DatasetWriter(RAW_DATASET_URL, schema, rows_per_rowgroup=64,
+                       compression='none') as w:
         w.write_many(rows())
 
 
@@ -208,17 +245,38 @@ def _run_stall(loader, state, max_steps, floor_ms):
     return round(stall_pct, 2), wall_ms
 
 
+def _device_hbm_bytes():
+    """Best-effort device memory capacity; conservative 16 GiB fallback
+    (v5e) when the backend doesn't expose memory_stats."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats()
+        cap = stats.get('bytes_limit') or stats.get('bytes_reservable_limit')
+        if cap:
+            return int(cap)
+    except Exception:
+        pass
+    return 16 * (1 << 30)
+
+
 def train_stall_legs():
-    """North-star metric, two regimes:
+    """North-star metric, three regimes — all reported, top-level
+    ``stall_pct`` is the regime this dataset actually REQUIRES (a decoded
+    epoch that fits device HBM may use the cached loader; one that doesn't
+    must stream):
 
     * **streaming** — thread-pool JPEG decode feeding the step live.  Whether
       this stalls is a host-cores : chip-speed ratio; on a 1-core sandbox
       host with a datacenter chip it necessarily will (no host decode plane
       sustains tens of kimg/s on one core) — reported for transparency.
-    * **hbm-cached** — DeviceInMemDataLoader: decode once, epoch cache in
+    * **delivery_bound** — the same streaming loader over PRE-DECODED uint8
+      parquet (no JPEG): isolates the framework's delivery plane from
+      decode economics.  If this leg is fast, a streaming stall is decode
+      cost, not the loader.
+    * **hbm_cached** — DeviceInMemDataLoader: decode once, epoch cache in
       device HBM, per-epoch device-side reshuffle, jnp.take per batch.  Zero
       host work per step: the framework's TPU-native answer when the decoded
-      shard fits in HBM, and the headline stall number on this host.
+      shard fits in HBM.
     """
     from petastorm_tpu import make_reader
     from petastorm_tpu.jax import DataLoader, DeviceInMemDataLoader
@@ -226,8 +284,8 @@ def train_stall_legs():
     state = _make_resnet_step()
     # The cached leg and the floor are cheap (~28 ms/step, no host work):
     # run 2x the steps so the wall-vs-floor difference — the stall signal —
-    # sits above run-to-run timer noise.  The streaming leg pays full host
-    # decode per step, so it keeps the base count.
+    # sits above run-to-run timer noise.  The streaming legs pay full host
+    # work per step, so they keep the base count.
     cached_steps = 2 * TRAIN_STEPS
     floor_ms = _device_floor_ms(state, cached_steps)
 
@@ -241,6 +299,13 @@ def train_stall_legs():
         stream_stall, stream_step_ms = _run_stall(loader, state, TRAIN_STEPS,
                                                   floor_ms)
 
+    ensure_raw_dataset()
+    with make_reader(RAW_DATASET_URL, num_epochs=epochs, workers_count=WORKERS,
+                     shuffle_row_groups=False, columnar_decode=True) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+        deliv_stall, deliv_step_ms = _run_stall(loader, state, TRAIN_STEPS,
+                                                floor_ms)
+
     with make_reader(DATASET_URL, num_epochs=1, workers_count=WORKERS,
                      shuffle_row_groups=False, columnar_decode=True) as reader:
         loader = DeviceInMemDataLoader(reader, batch_size=BATCH,
@@ -248,13 +313,131 @@ def train_stall_legs():
         cached_stall, cached_step_ms = _run_stall(loader, state, cached_steps,
                                                   floor_ms)
 
+    # decoded-cache tier: epoch 0 decodes JPEG once and spills raw tensors
+    # to local disk (untimed build pass); the measured epochs stream from
+    # the mmap'd cache — the multi-epoch answer for datasets >> HBM.
+    import shutil
+    from petastorm_tpu.jax import DiskCachedDataLoader
+    cache_dir = os.path.join(BENCH_DIR, 'decoded_cache_v1')
+    shutil.rmtree(cache_dir, ignore_errors=True)
+    with make_reader(DATASET_URL, num_epochs=1, workers_count=WORKERS,
+                     shuffle_row_groups=False, columnar_decode=True) as reader:
+        build = DiskCachedDataLoader(reader, batch_size=BATCH,
+                                     decoded_cache_dir=cache_dir,
+                                     num_epochs=1, shuffle=False)
+        for _ in build:
+            pass
+    # Measured leg over the complete cache with reader=None: no worker pool
+    # decoding JPEG in the background to contaminate the timing.
+    loader = DiskCachedDataLoader(None, batch_size=BATCH,
+                                  decoded_cache_dir=cache_dir,
+                                  num_epochs=None, seed=0)
+    disk_stall, disk_step_ms = _run_stall(loader, state, cached_steps,
+                                          floor_ms)
+
+    decoded_epoch_bytes = NUM_IMAGES * IMAGE_HW[0] * IMAGE_HW[1] * 3
+    hbm = _device_hbm_bytes()
+    fits_hbm = decoded_epoch_bytes < 0.6 * hbm  # leave room for model+step
+    regime = 'hbm_cached' if fits_hbm else 'decoded_cache'
+    flops = _model_flops_per_step(state)
     return {
-        'stall_pct': cached_stall,
-        'step_ms': round(cached_step_ms, 2),
+        'stall_pct': cached_stall if fits_hbm else disk_stall,
+        'stall_regime': '%s (decoded epoch %.2f GiB %s %.0f GiB device HBM; '
+                        'multi-epoch > HBM runs the decoded disk cache, '
+                        'single-pass runs streaming)'
+                        % (regime, decoded_epoch_bytes / 2**30,
+                           'fits in' if fits_hbm else 'exceeds', hbm / 2**30),
+        'stall_pct_hbm_cached': cached_stall,
+        'step_ms_hbm_cached': round(cached_step_ms, 2),
         'device_step_ms': round(floor_ms, 2),
         'stall_pct_streaming': stream_stall,
         'step_ms_streaming': round(stream_step_ms, 2),
+        'stall_pct_delivery_bound': deliv_stall,
+        'step_ms_delivery_bound': round(deliv_step_ms, 2),
+        'stall_pct_decoded_cache': disk_stall,
+        'step_ms_decoded_cache': round(disk_step_ms, 2),
+        'model_step_tflop': round(flops / 1e12, 4),
+        'model_tflops_per_s': round(flops / 1e12 / (floor_ms / 1000.0), 2),
     }
+
+
+def _model_flops_per_step(state):
+    """Exact per-step FLOPs from XLA's own cost model — the absolute anchor
+    for stall% (a slow device floor would otherwise flatter the loader)."""
+    train_step, params, batch_stats, opt_state = state
+    x = np.zeros((BATCH, IMAGE_HW[0], IMAGE_HW[1], 3), np.uint8)
+    y = np.zeros((BATCH,), np.int64)
+    try:
+        compiled = train_step.lower(params, batch_stats, opt_state,
+                                    x, y).compile()
+        return float(compiled.cost_analysis().get('flops', 0.0))
+    except Exception:
+        # Analytic fallback: ResNet-50 fwd ~4.1 GFLOP/img at 224², train
+        # step ~3x fwd.
+        return 3 * 2 * 4.1e9 / 2 * BATCH
+
+
+def kernel_certification():
+    """Certify the attention kernels on THIS backend, numbers into the JSON.
+
+    Flash (fwd+bwd, dense and packed) runs the real Mosaic kernels on TPU
+    (the Pallas interpreter elsewhere); ring/Ulysses run their shard_map
+    wrappers over the full device mesh.  All compared against the dense
+    oracle at highest matmul precision — CI runs the same asserts
+    (tests/test_flash_attention.py), but only a driver-visible on-chip run
+    proves the Mosaic lowering (block alignment etc.) every round.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import flash_attention
+    from petastorm_tpu.parallel import full_attention, make_mesh
+    from petastorm_tpu.parallel.ring_attention import (make_ring_attention,
+                                                       make_ulysses_attention)
+
+    errs = {}
+    prev = jax.config.jax_default_matmul_precision
+    jax.config.update('jax_default_matmul_precision', 'highest')
+    try:
+        rng = np.random.default_rng(0)
+        b, s, h, d = 2, 256, 2, 64
+        q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+                   for _ in range(3))
+        dout = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+        def max_err(a, b_):
+            return float(jnp.max(jnp.abs(a - b_)))
+
+        want = full_attention(q, k, v, causal=True)
+        errs['flash_fwd'] = max_err(flash_attention(q, k, v, causal=True),
+                                    want)
+        g_want = jax.grad(
+            lambda t: (full_attention(*t, causal=True) * dout).sum())((q, k, v))
+        g_got = jax.grad(
+            lambda t: (flash_attention(*t, causal=True) * dout).sum())((q, k, v))
+        errs['flash_bwd'] = max(max_err(a, w) for a, w in zip(g_got, g_want))
+
+        seg = jnp.asarray(
+            np.repeat([1, 2], s // 2)[None, :].repeat(b, 0), jnp.int32)
+        want_p = full_attention(q, k, v, causal=True, segment_ids=seg)
+        errs['flash_packed_fwd'] = max_err(
+            flash_attention(q, k, v, causal=True, segment_ids=seg), want_p)
+        gp_want = jax.grad(lambda t: (full_attention(
+            *t, causal=True, segment_ids=seg) * dout).sum())((q, k, v))
+        gp_got = jax.grad(lambda t: (flash_attention(
+            *t, causal=True, segment_ids=seg) * dout).sum())((q, k, v))
+        errs['flash_packed_bwd'] = max(
+            max_err(a, w) for a, w in zip(gp_got, gp_want))
+
+        n_dev = len(jax.devices())
+        mesh = make_mesh({'data': 1, 'seq': n_dev})
+        ring_fn, _ = make_ring_attention(mesh, causal=True)
+        errs['ring_fwd'] = max_err(ring_fn(q, k, v), want)
+        ulys_fn, _ = make_ulysses_attention(mesh, causal=True)
+        errs['ulysses_fwd'] = max_err(ulys_fn(q, k, v), want)
+    finally:
+        jax.config.update('jax_default_matmul_precision', prev)
+    return {name: round(e, 8) for name, e in errs.items()}
 
 
 def _start_watchdog(budget_s):
@@ -307,14 +490,35 @@ def _reexec_cpu_fallback():
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
+def _wait_for_device(recovery_s, interval_s=60):
+    """Bounded wait-for-recovery: the wedged tunnel sometimes comes back
+    within minutes.  Probe now; on failure re-probe every ``interval_s``
+    until the budget is spent.  Hang-safe throughout (every probe is a
+    subprocess with a timeout), so this runs BEFORE the watchdog starts."""
+    if _device_probe_ok():
+        return True
+    deadline = time.monotonic() + recovery_s
+    while time.monotonic() < deadline:
+        sys.stderr.write('bench: TPU backend init wedged; re-probing in '
+                         '%ds (%.0fs of recovery budget left)\n'
+                         % (interval_s, deadline - time.monotonic()))
+        time.sleep(min(interval_s, max(0.0, deadline - time.monotonic())))
+        if _device_probe_ok():
+            sys.stderr.write('bench: TPU backend recovered\n')
+            return True
+    return False
+
+
 def main():
+    cpu_fallback = bool(os.environ.get('PETASTORM_TPU_BENCH_CPU_FALLBACK'))
+    if not cpu_fallback and not _wait_for_device(
+            int(os.environ.get('PETASTORM_TPU_BENCH_RECOVERY_WAIT_S', '300'))):
+        sys.stderr.write('bench: TPU backend init wedged past the recovery '
+                         'budget; re-running the host-pipeline legs on the '
+                         'CPU backend\n')
+        _reexec_cpu_fallback()
     watchdog = _start_watchdog(
         int(os.environ.get('PETASTORM_TPU_BENCH_BUDGET_S', '900')))
-    cpu_fallback = bool(os.environ.get('PETASTORM_TPU_BENCH_CPU_FALLBACK'))
-    if not cpu_fallback and not _device_probe_ok():
-        sys.stderr.write('bench: TPU backend init wedged; re-running the '
-                         'host-pipeline legs on the CPU backend\n')
-        _reexec_cpu_fallback()
     ensure_dataset()
     import jax
     from petastorm_tpu.utils import apply_jax_platforms_env
@@ -335,6 +539,8 @@ def main():
     if cpu_fallback:
         # ResNet-50 train legs need the chip (~30 s/step on host CPU);
         # report the host-pipeline comparison and say what's missing.
+        # Kernel certification still runs (Pallas interpreter on CPU —
+        # algebra-correct, labeled as such; Mosaic lowering needs the chip).
         result = {
             'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
             'value': round(ours, 1),
@@ -346,6 +552,9 @@ def main():
                        'is backend-independent)',
             'baseline': 'reference delivery strategy, %.1f images/s' % theirs,
             'stall_pct': None,
+            'kernel_max_err': kernel_certification(),
+            'kernel_backend': 'cpu (Pallas interpreter; Mosaic untested '
+                              'this run)',
         }
         watchdog.cancel()
         print(json.dumps(result))
@@ -359,16 +568,23 @@ def main():
         'unit': 'images/s',
         'vs_baseline': round(ours / theirs, 2),
         'host_cores': os.cpu_count(),
+        'backend': jax.default_backend(),
         'baseline': 'same dataset+hardware via reference delivery strategy: '
                     'per-row cv2 decode (native plane disabled), per-row '
                     'python collate, sync device_put, no prefetch '
                     '(%.1f images/s)' % theirs,
-        'stall_note': 'stall_pct = ResNet-50 train loop fed from the HBM '
-                      'epoch cache (DeviceInMemDataLoader); '
-                      'stall_pct_streaming = live thread-pool JPEG decode, '
-                      'bounded by host_cores vs chip speed',
+        'stall_note': 'stall_pct = the regime stall_regime names; '
+                      'stall_pct_hbm_cached = HBM epoch cache '
+                      '(DeviceInMemDataLoader); stall_pct_streaming = live '
+                      'thread-pool JPEG decode (host_cores-bound); '
+                      'stall_pct_delivery_bound = same streaming loader, '
+                      'pre-decoded uint8 parquet (no JPEG) — isolates the '
+                      'delivery plane from decode economics',
     }
     result.update(stall)
+    result['kernel_max_err'] = kernel_certification()
+    result['kernel_backend'] = ('tpu (Mosaic)' if jax.default_backend() == 'tpu'
+                                else jax.default_backend() + ' (Pallas interpreter)')
     watchdog.cancel()
     print(json.dumps(result))
 
