@@ -14,7 +14,7 @@ Greedy by default; pass ``temperature > 0`` with ``rng`` to sample.
 import jax
 import jax.numpy as jnp
 
-__all__ = ['generate', 'beam_search']
+__all__ = ['generate', 'beam_search', 'speculative_generate']
 
 
 def _decode_variant(model):
@@ -139,6 +139,136 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     _, tokens = jax.lax.scan(
         gen_body, (cache, last_logits, key0, done0), steps)
     return tokens.T  # [b, max_new_tokens]
+
+
+def _set_cache_index(cache, value):
+    """Roll every layer's cache write index to ``value`` (tree surgery).
+
+    Entries beyond the index become stale; they are harmless because
+    ``Attention._attend_cache`` masks ``l <= q_pos`` with absolute
+    positions, and subsequent writes overwrite them in place — the
+    rollback primitive speculative decoding relies on.
+    """
+    def set_leaf(path, leaf):
+        last = path[-1] if path else None
+        if getattr(last, 'key', None) == 'index':
+            return jnp.full_like(leaf, value)
+        return leaf
+    return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+
+def speculative_generate(model, params, draft_model, draft_params, prompt,
+                         max_new_tokens, draft_len=4):
+    """Greedy speculative decoding: a cheap draft proposes ``draft_len``
+    tokens per round, the target model verifies them all in ONE batched
+    forward, and the accepted prefix plus the target's own correction are
+    emitted.  Output is EXACTLY ``generate(model, params, prompt,
+    max_new_tokens)`` (greedy) — speculation changes the schedule, never
+    the tokens — while the target model runs ``~max_new/(accepted+1)``
+    forwards instead of ``max_new``.
+
+    The verify step is ``Attention._decode_step``'s warm-cache multi-token
+    path (chunked prefill): ``draft_len + 1`` tokens attend the cache
+    prefix with absolute-position causal masking in one MXU-batched call.
+    Rejection rolls the static cache's write index back (stale entries are
+    masked and later overwritten), so shapes never depend on how many
+    tokens were accepted — the whole loop is one compiled
+    ``lax.while_loop``.
+
+    Acceptance is the batch-min prefix: a draft position is accepted only
+    when EVERY row's target argmax equals its draft token.  Rows that
+    accepted more are unaffected (for them the correction equals the
+    draft), so per-row outputs remain exact; batch-min only costs speed
+    on mixed batches.
+
+    Requires ``prompt_len + max_new_tokens + draft_len <= max_seq_len``
+    on both models (verify writes up to ``draft_len`` positions past the
+    accepted point before rolling back).  Greedy only; ``eos_id`` early
+    stopping is not supported — use :func:`generate` for sampling/eos.
+    Returns ``[b, max_new_tokens]`` int32 tokens.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2:
+        raise ValueError('prompt must be [batch, len], got %r'
+                         % (prompt.shape,))
+    if draft_len < 1:
+        raise ValueError('draft_len must be >= 1')
+    b, prompt_len = prompt.shape
+    k = int(draft_len)
+    for name, m in (('model', model), ('draft_model', draft_model)):
+        if prompt_len + max_new_tokens + k > m.max_seq_len:
+            raise ValueError(
+                '%s: prompt+new+draft_len = %d exceeds max_seq_len %d'
+                % (name, prompt_len + max_new_tokens + k, m.max_seq_len))
+
+    dec = _decode_variant(model)
+    dft = _decode_variant(draft_model)
+    t_cache, t_logits = _prefill(dec, params, prompt)
+    d_cache, _ = _prefill(dft, draft_params, prompt)
+    c0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # first token
+
+    buf = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
+    buf = buf.at[:, 0].set(c0)
+
+    def draft_step(cache, token, position):
+        logits, mutated = dft.apply(
+            {'params': draft_params, 'cache': cache}, token[:, None],
+            positions=jnp.full((b, 1), position, jnp.int32),
+            mutable=['cache'])
+        return mutated['cache'], jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+
+    def round_body(carry):
+        buf, g, c, t_cache, d_cache = carry
+        pos = prompt_len + g - 1          # absolute position c is consumed at
+
+        # 1. draft k+1 steps (the extra step fills the cache entry for the
+        #    last proposal; its own output is discarded)
+        def scan_body(state, j):
+            d_cache, token = state
+            d_cache, nxt = draft_step(d_cache, token, pos + j)
+            return (d_cache, nxt), nxt
+        (d_cache, _), proposals = jax.lax.scan(
+            scan_body, (d_cache, c), jnp.arange(k + 1, dtype=jnp.int32))
+        drafts = proposals[:k].T                       # [b, k]
+
+        # 2. verify [c, d1..dk] in one warm-cache multi-token forward
+        chunk = jnp.concatenate([c[:, None], drafts], axis=1)   # [b, k+1]
+        positions = pos + jnp.broadcast_to(
+            jnp.arange(k + 1, dtype=jnp.int32), (b, k + 1))
+        logits, mutated = dec.apply(
+            {'params': params, 'cache': t_cache}, chunk,
+            positions=positions, mutable=['cache'])
+        t_cache = mutated['cache']
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [b, k+1]
+
+        # 3. batch-min accepted prefix + correction
+        match = jnp.all(preds[:, :k] == drafts, axis=0)         # [k]
+        a = jnp.argmin(jnp.concatenate(
+            [match.astype(jnp.int32), jnp.zeros((1,), jnp.int32)]))
+        correction = jnp.take_along_axis(
+            preds, jnp.full((b, 1), a), axis=1)[:, 0]           # [b]
+
+        # 4. emit d1..d_a then the correction (garbage beyond is
+        #    overwritten by later rounds and sliced off at the end)
+        j = jnp.arange(k + 1)
+        padded = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], 1)
+        emit = jnp.where(j[None, :] < a, padded,
+                         jnp.where(j[None, :] == a, correction[:, None], 0))
+        buf = jax.lax.dynamic_update_slice(buf, emit, (0, g))
+
+        # 5. roll both caches back to the accepted position
+        new_index = pos + a + 1
+        t_cache = _set_cache_index(t_cache, new_index)
+        d_cache = _set_cache_index(d_cache, new_index)
+        return buf, g + a + 1, correction, t_cache, d_cache
+
+    def cond(carry):
+        return carry[1] < max_new_tokens
+
+    g0 = jnp.int32(1)
+    buf, _, _, _, _ = jax.lax.while_loop(
+        cond, round_body, (buf, g0, c0, t_cache, d_cache))
+    return buf[:, :max_new_tokens]
 
 
 def beam_search(model, params, prompt, max_new_tokens, num_beams=4,

@@ -325,3 +325,63 @@ def test_chunked_prefill_matches_single_prefill(lm):
         lambda a, c: np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                                 rtol=2e-5, atol=2e-5),
         m_full['cache'], m2['cache'])
+
+
+# -- speculative decoding -----------------------------------------------------
+
+def test_speculative_matches_greedy_exactly(lm):
+    """Speculation changes the schedule, never the tokens: output must be
+    bit-identical to plain greedy generate, even with a bad draft."""
+    from petastorm_tpu.models.decoding import speculative_generate
+    model, params = lm
+    draft = TransformerLM(vocab_size=61, d_model=16, num_heads=2,
+                          num_layers=1, d_ff=32, max_seq_len=32,
+                          dtype=jnp.float32)
+    draft_params = draft.init(jax.random.PRNGKey(99),
+                              jnp.zeros((1, 4), jnp.int32))['params']
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 5)), jnp.int32)
+    want = np.asarray(generate(model, params, prompt, max_new_tokens=8))
+    got = np.asarray(speculative_generate(model, params, draft, draft_params,
+                                          prompt, max_new_tokens=8,
+                                          draft_len=3))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_with_perfect_draft(lm):
+    """Draft == target: every proposal accepted, still exact."""
+    from petastorm_tpu.models.decoding import speculative_generate
+    model, params = lm
+    prompt = jnp.asarray(np.random.default_rng(4).integers(0, 61, (1, 4)),
+                         jnp.int32)
+    want = np.asarray(generate(model, params, prompt, max_new_tokens=10))
+    got = np.asarray(speculative_generate(model, params, model, params,
+                                          prompt, max_new_tokens=10,
+                                          draft_len=4))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_jits_once(lm):
+    from petastorm_tpu.models.decoding import speculative_generate
+    model, params = lm
+    traces = []
+
+    @jax.jit
+    def gen(params, prompt):
+        traces.append(1)
+        return speculative_generate(model, params, model, params, prompt,
+                                    max_new_tokens=4, draft_len=2)
+
+    a = gen(params, jnp.zeros((1, 5), jnp.int32))
+    b = gen(params, jnp.ones((1, 5), jnp.int32))
+    assert a.shape == b.shape == (1, 4)
+    assert len(traces) == 1, 'speculative_generate retraced'
+
+
+def test_speculative_validates_lengths(lm):
+    from petastorm_tpu.models.decoding import speculative_generate
+    model, params = lm
+    with pytest.raises(ValueError, match='max_seq_len'):
+        speculative_generate(model, params, model, params,
+                             jnp.zeros((1, 20), jnp.int32),
+                             max_new_tokens=12, draft_len=4)
