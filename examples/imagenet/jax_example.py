@@ -16,6 +16,7 @@ if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
     _sys.path.insert(0, _d)
 
 import argparse
+import os
 import time
 
 import numpy as np
@@ -52,7 +53,7 @@ def make_transform(image_hw):
 
 
 def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
-          model_name='resnet50'):
+          model_name='resnet50', decoded_cache_dir=None):
     mesh = make_mesh()
     sharding = data_parallel_sharding(mesh)
     stateless = model_name == 'vit'
@@ -103,10 +104,27 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
     monitor = StallMonitor(warmup_steps=2)
     done = 0
     t0 = time.monotonic()
-    with make_reader(dataset_url, schema_fields=['image', 'noun_id'],
-                     transform_spec=make_transform(image_hw), columnar_decode=True,
-                     num_epochs=None, workers_count=8) as reader:
-        loader = DataLoader(reader, batch_size=batch_size, sharding=sharding)
+    # Multi-epoch beyond-HBM datasets: --decoded-cache-dir spills decoded
+    # tensors to local disk on epoch 0 and streams later epochs from the
+    # mmap'd cache — no parquet/JPEG work after the first pass.  A cache
+    # that is already complete needs NO reader at all (no background
+    # decode pool).
+    import contextlib
+    from petastorm_tpu.jax import DiskCachedDataLoader
+    cache_done = decoded_cache_dir and os.path.exists(
+        os.path.join(decoded_cache_dir, '_COMPLETE'))
+    reader_cm = contextlib.nullcontext(None) if cache_done else make_reader(
+        dataset_url, schema_fields=['image', 'noun_id'],
+        transform_spec=make_transform(image_hw), columnar_decode=True,
+        num_epochs=1 if decoded_cache_dir else None, workers_count=8)
+    with reader_cm as reader:
+        if decoded_cache_dir:
+            loader = DiskCachedDataLoader(reader, batch_size=batch_size,
+                                          decoded_cache_dir=decoded_cache_dir,
+                                          num_epochs=None, sharding=sharding)
+        else:
+            loader = DataLoader(reader, batch_size=batch_size,
+                                sharding=sharding)
         step_key = jax.random.PRNGKey(17)
         for batch in monitor.wrap(loader):
             step_key, key = jax.random.split(step_key)
@@ -133,6 +151,10 @@ if __name__ == '__main__':
     parser.add_argument('--batch-size', type=int, default=64)
     parser.add_argument('--model', choices=['resnet50', 'vit'],
                         default='resnet50')
+    parser.add_argument('--decoded-cache-dir', default=None,
+                        help='decode once, stream later epochs from this '
+                             'local decoded-tensor cache (multi-epoch '
+                             'datasets bigger than HBM)')
     args = parser.parse_args()
     train(args.dataset_url, args.steps, args.batch_size,
-          model_name=args.model)
+          model_name=args.model, decoded_cache_dir=args.decoded_cache_dir)

@@ -1,0 +1,60 @@
+"""The examples ARE the acceptance surface (BASELINE configs) — run them.
+
+Each example executes in a fresh subprocess exactly as a user would run it
+(its self-bootstrap finds the repo), pinned to CPU both ways the sandbox
+requires (env var for the probe child + the example's own
+``ensure_jax_backend``).  Sizes are minimal: the point is that the entry
+points keep working, not throughput.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PETASTORM_TPU_SKIP_BACKEND_PROBE', None)
+    res = subprocess.run([sys.executable] + args, capture_output=True,
+                         text=True, timeout=timeout, env=env,
+                         cwd=REPO)
+    assert res.returncode == 0, '%s\n--- stderr ---\n%s' % (
+        ' '.join(args), res.stderr[-4000:])
+    return res.stdout
+
+
+def test_hello_world_petastorm(tmp_path):
+    url = 'file://' + str(tmp_path / 'hw')
+    _run(['examples/hello_world/petastorm_dataset/'
+          'generate_petastorm_dataset.py', '--output-url', url])
+    out = _run(['examples/hello_world/petastorm_dataset/jax_hello_world.py',
+                '--dataset-url', url])
+    assert 'image1' in out
+
+
+def test_mnist(tmp_path):
+    url = 'file://' + str(tmp_path / 'mnist')
+    _run(['examples/mnist/generate_petastorm_mnist.py', '-o', url,
+          '-n', '256'])
+    out = _run(['examples/mnist/jax_example.py', '--epochs', '1',
+                '--dataset-url', url])
+    assert 'final accuracy' in out
+
+
+def test_imagenet_with_decoded_cache(tmp_path):
+    # 16 rows = 2 batches/epoch <= DataLoader prefetch: the epoch-0 cache
+    # build is fully drained (and _COMPLETE written) before the first
+    # batch is even yielded, so steps=2 deterministically completes it.
+    _run(['examples/imagenet/generate_petastorm_imagenet.py',
+          '--output-url', 'file://' + str(tmp_path / 'inet'), '-n', '16'])
+    out = _run(['examples/imagenet/jax_example.py',
+                '--dataset-url', 'file://' + str(tmp_path / 'inet'),
+                '--steps', '2', '--batch-size', '8',
+                '--decoded-cache-dir', str(tmp_path / 'inet_cache')],
+               timeout=600)
+    assert 'steps=2' in out
+    assert os.path.exists(str(tmp_path / 'inet_cache' / '_COMPLETE'))
