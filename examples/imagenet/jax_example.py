@@ -16,7 +16,6 @@ if _os.path.isdir(_os.path.join(_d, 'petastorm_tpu')) and _d not in _sys.path:
     _sys.path.insert(0, _d)
 
 import argparse
-import os
 import time
 
 import numpy as np
@@ -111,8 +110,8 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
     # decode pool).
     import contextlib
     from petastorm_tpu.jax import DiskCachedDataLoader
-    cache_done = decoded_cache_dir and os.path.exists(
-        os.path.join(decoded_cache_dir, '_COMPLETE'))
+    cache_done = decoded_cache_dir and DiskCachedDataLoader.cache_complete(
+        decoded_cache_dir)
     reader_cm = contextlib.nullcontext(None) if cache_done else make_reader(
         dataset_url, schema_fields=['image', 'noun_id'],
         transform_spec=make_transform(image_hw), columnar_decode=True,

@@ -693,9 +693,17 @@ class DiskCachedDataLoader(DataLoader):
 
     # -- cache files ---------------------------------------------------------
 
-    def _cache_complete(self):
+    @classmethod
+    def cache_complete(cls, decoded_cache_dir):
+        """True when ``decoded_cache_dir`` holds a finished cache — i.e.
+        a loader over it may be built with ``reader=None`` (no parquet or
+        decode work at all).  Public so callers share the loader's own
+        completeness rule instead of hardcoding marker names."""
         import os
-        return os.path.exists(os.path.join(self._cache_dir, self._COMPLETE))
+        return os.path.exists(os.path.join(decoded_cache_dir, cls._COMPLETE))
+
+    def _cache_complete(self):
+        return self.cache_complete(self._cache_dir)
 
     def _manifest(self):
         import json

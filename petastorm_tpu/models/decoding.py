@@ -158,18 +158,29 @@ def _set_cache_index(cache, value):
 
 
 def speculative_generate(model, params, draft_model, draft_params, prompt,
-                         max_new_tokens, draft_len=4):
-    """Greedy speculative decoding: a cheap draft proposes ``draft_len``
-    tokens per round, the target model verifies them all in ONE batched
-    forward, and the accepted prefix plus the target's own correction are
-    emitted.  Output is token-identical to ``generate(model, params,
-    prompt, max_new_tokens)`` (greedy) up to floating-point argmax
-    tie-breaks — the verify forward is a differently-ordered reduction
-    than per-step decode, so logits agree only to numerical noise
-    (~1e-5 fp32); a near-exact top-2 tie can resolve differently.  The
-    tests assert identity on fp32 models; treat bf16 reproducibility
-    against step-wise decode as approximate.  The target model runs
-    ``~max_new/(accepted+1)`` forwards instead of ``max_new``.
+                         max_new_tokens, draft_len=4, temperature=0.0,
+                         rng=None):
+    """Speculative decoding: a cheap draft proposes ``draft_len`` tokens
+    per round, the target model verifies them all in ONE batched forward,
+    and the accepted prefix plus a correction token are emitted.
+
+    * ``temperature=0`` (default): greedy.  Output is token-identical to
+      greedy ``generate(model, params, prompt, max_new_tokens)`` up to
+      floating-point argmax tie-breaks — the verify forward is a
+      differently-ordered reduction than per-step decode, so logits agree
+      only to numerical noise (~1e-5 fp32); a near-exact top-2 tie can
+      resolve differently.  The tests assert identity on fp32 models;
+      treat bf16 reproducibility against step-wise decode as approximate.
+    * ``temperature>0`` (``rng`` required): standard speculative
+      SAMPLING (Leviathan et al.) — drafts are sampled from the draft
+      model, each is accepted with probability ``min(1, p_t/p_d)``, and
+      the first rejection resamples from the normalized residual
+      ``max(p_t - p_d, 0)``.  The output distribution is exactly the
+      target model's temperature-``T`` sampling distribution, whatever
+      the draft proposes (a bad draft costs speed, never correctness).
+
+    Either way the target model runs ``~max_new/(accepted+1)`` forwards
+    instead of ``max_new``.
 
     The verify step is ``Attention._decode_step``'s warm-cache multi-token
     path (chunked prefill): ``draft_len + 1`` tokens attend the cache
@@ -179,16 +190,16 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
     tokens were accepted — the whole loop is one compiled
     ``lax.while_loop``.
 
-    Acceptance is the batch-min prefix: a draft position is accepted only
-    when EVERY row's target argmax equals its draft token.  Rows that
-    accepted more are unaffected (for them the correction equals the
-    draft), so per-row outputs remain exact; batch-min only costs speed
-    on mixed batches.
+    Acceptance is the batch-min prefix: each round emits
+    ``min_over_rows(accepted) + 1`` tokens.  Rows that accepted more emit
+    their (already-accepted) draft at the cut position, so per-row
+    outputs remain greedy-exact / distribution-exact; batch-min only
+    costs speed on mixed batches.
 
     Requires ``prompt_len + max_new_tokens + draft_len <= max_seq_len``
     on both models (verify writes up to ``draft_len`` positions past the
-    accepted point before rolling back).  Greedy only; ``eos_id`` early
-    stopping is not supported — use :func:`generate` for sampling/eos.
+    accepted point before rolling back).  ``eos_id`` early stopping is
+    not supported — use :func:`generate` for that.
     Returns ``[b, max_new_tokens]`` int32 tokens.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -197,6 +208,9 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
                          % (prompt.shape,))
     if draft_len < 1:
         raise ValueError('draft_len must be >= 1')
+    if temperature > 0 and rng is None:
+        raise ValueError('temperature > 0 needs an rng key')
+    sampled = temperature > 0
     b, prompt_len = prompt.shape
     k = int(draft_len)
     for name, m in (('model', model), ('draft_model', draft_model)):
@@ -209,30 +223,47 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
     dft = _decode_variant(draft_model)
     t_cache, t_logits = _prefill(dec, params, prompt)
     d_cache, _ = _prefill(dft, draft_params, prompt)
-    c0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # first token
+    key0 = rng if rng is not None else jax.random.PRNGKey(0)
+    if sampled:
+        key0, sub = jax.random.split(key0)
+        c0 = jax.random.categorical(
+            sub, t_logits / temperature, axis=-1).astype(jnp.int32)
+    else:
+        c0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # first token
 
     buf = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
     buf = buf.at[:, 0].set(c0)
 
-    def draft_step(cache, token, position):
+    def draft_step(cache, token, position, key):
         logits, mutated = dft.apply(
             {'params': draft_params, 'cache': cache}, token[:, None],
             positions=jnp.full((b, 1), position, jnp.int32),
             mutable=['cache'])
-        return mutated['cache'], jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+        logits = logits[:, 0]
+        if sampled:
+            nxt = jax.random.categorical(key, logits / temperature, axis=-1)
+            probs = jax.nn.softmax(logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, -1)
+            probs = jnp.zeros_like(logits)   # unused in the greedy path
+        return mutated['cache'], nxt.astype(jnp.int32), probs
 
     def round_body(carry):
-        buf, g, c, t_cache, d_cache = carry
+        buf, g, c, t_cache, d_cache, key = carry
         pos = prompt_len + g - 1          # absolute position c is consumed at
+        key, k_draft, k_accept, k_resample = jax.random.split(key, 4)
 
         # 1. draft k+1 steps (the extra step fills the cache entry for the
         #    last proposal; its own output is discarded)
-        def scan_body(state, j):
+        def scan_body(state, xs):
+            j, subkey = xs
             d_cache, token = state
-            d_cache, nxt = draft_step(d_cache, token, pos + j)
-            return (d_cache, nxt), nxt
-        (d_cache, _), proposals = jax.lax.scan(
-            scan_body, (d_cache, c), jnp.arange(k + 1, dtype=jnp.int32))
+            d_cache, nxt, probs = draft_step(d_cache, token, pos + j, subkey)
+            return (d_cache, nxt), (nxt, probs)
+        (d_cache, _), (proposals, q_probs) = jax.lax.scan(
+            scan_body, (d_cache, c),
+            (jnp.arange(k + 1, dtype=jnp.int32),
+             jax.random.split(k_draft, k + 1)))
         drafts = proposals[:k].T                       # [b, k]
 
         # 2. verify [c, d1..dk] in one warm-cache multi-token forward
@@ -243,19 +274,53 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
             {'params': params, 'cache': t_cache}, chunk,
             positions=positions, mutable=['cache'])
         t_cache = mutated['cache']
-        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [b, k+1]
 
-        # 3. batch-min accepted prefix + correction
-        match = jnp.all(preds[:, :k] == drafts, axis=0)         # [k]
-        a = jnp.argmin(jnp.concatenate(
-            [match.astype(jnp.int32), jnp.zeros((1,), jnp.int32)]))
-        correction = jnp.take_along_axis(
-            preds, jnp.full((b, 1), a), axis=1)[:, 0]           # [b]
+        # 3. accepted prefix (per row), batch-min cut, correction token
+        j = jnp.arange(k + 1)
+        padded = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], 1)
+        if sampled:
+            p_t = jax.nn.softmax(logits / temperature, axis=-1)  # [b,k+1,V]
+            # q_probs[j] is the dist d_{j+1} was drawn from; p_t[:, j] is
+            # the target dist for the same slot.
+            q = jnp.moveaxis(q_probs[:k], 0, 1)                  # [b, k, V]
+            p_at_d = jnp.take_along_axis(
+                p_t[:, :k], drafts[:, :, None], axis=2)[:, :, 0]
+            q_at_d = jnp.take_along_axis(
+                q, drafts[:, :, None], axis=2)[:, :, 0]
+            u = jax.random.uniform(k_accept, (b, k))
+            accept = u * q_at_d < p_at_d                         # [b, k]
+            a_r = jnp.argmin(jnp.concatenate(
+                [accept.astype(jnp.int32),
+                 jnp.zeros((b, 1), jnp.int32)], axis=1), axis=1)  # [b]
+            a = jnp.min(a_r)
+            # Residual at the cut: max(p_t - q, 0) normalized; with a == k
+            # there is no draft there (q row is zero) and this reduces to
+            # sampling p_t directly — the all-accepted bonus token.
+            q_pad = jnp.concatenate(
+                [q, jnp.zeros((b, 1, q.shape[-1]))], axis=1)      # [b,k+1,V]
+            p_t_a = jnp.take_along_axis(
+                p_t, jnp.full((b, 1, 1), a).astype(jnp.int32),
+                axis=1)[:, 0]                                     # [b, V]
+            q_a = jnp.take_along_axis(
+                q_pad, jnp.full((b, 1, 1), a).astype(jnp.int32),
+                axis=1)[:, 0]
+            res = jnp.maximum(p_t_a - q_a, 0.0)
+            res = jnp.where(res.sum(-1, keepdims=True) > 0, res, p_t_a)
+            resampled = jax.random.categorical(
+                k_resample, jnp.log(res + 1e-30), axis=-1).astype(jnp.int32)
+            # Rows that accepted beyond the cut emit their accepted draft.
+            correction = jnp.where(a_r > a, jnp.take(padded, a, axis=1),
+                                   resampled)
+        else:
+            preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b,k+1]
+            match = jnp.all(preds[:, :k] == drafts, axis=0)        # [k]
+            a = jnp.argmin(jnp.concatenate(
+                [match.astype(jnp.int32), jnp.zeros((1,), jnp.int32)]))
+            correction = jnp.take_along_axis(
+                preds, jnp.full((b, 1), a), axis=1)[:, 0]          # [b]
 
         # 4. emit d1..d_a then the correction (garbage beyond is
         #    overwritten by later rounds and sliced off at the end)
-        j = jnp.arange(k + 1)
-        padded = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], 1)
         emit = jnp.where(j[None, :] < a, padded,
                          jnp.where(j[None, :] == a, correction[:, None], 0))
         buf = jax.lax.dynamic_update_slice(buf, emit, (0, g))
@@ -264,14 +329,14 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
         new_index = pos + a + 1
         t_cache = _set_cache_index(t_cache, new_index)
         d_cache = _set_cache_index(d_cache, new_index)
-        return buf, g + a + 1, correction, t_cache, d_cache
+        return buf, g + a + 1, correction, t_cache, d_cache, key
 
     def cond(carry):
         return carry[1] < max_new_tokens
 
     g0 = jnp.int32(1)
-    buf, _, _, _, _ = jax.lax.while_loop(
-        cond, round_body, (buf, g0, c0, t_cache, d_cache))
+    buf, _, _, _, _, _ = jax.lax.while_loop(
+        cond, round_body, (buf, g0, c0, t_cache, d_cache, key0))
     return buf[:, :max_new_tokens]
 
 
