@@ -22,7 +22,8 @@ class WeightedSamplingReader(object):
     reader.
     """
 
-    def __init__(self, readers, probabilities, seed=None, exhaust='stop'):
+    def __init__(self, readers, probabilities, seed=None, exhaust='stop',
+                 resume_state=None):
         if len(readers) < 1:
             raise ValueError('Need at least one reader')
         if len(readers) != len(probabilities):
@@ -49,6 +50,14 @@ class WeightedSamplingReader(object):
                 raise ValueError('All readers must have the same '
                                  'batched_output mode')
         self.last_row_consumed = False
+        if resume_state is not None:
+            # Constituents are resumed by the CALLER (construct each with
+            # resume_state=state['constituents'][i]); the mixer restores
+            # its own draw stream + surviving-reader set here.
+            self._rng.bit_generator.state = resume_state['rng_state']
+            self._weights = np.asarray(resume_state['weights'], np.float64)
+            self._readers = [self._all_readers[i]
+                             for i in resume_state['active']]
 
     def __iter__(self):
         return self
@@ -89,6 +98,42 @@ class WeightedSamplingReader(object):
         self._readers = list(self._all_readers)  # drop mode: restore mix
         self._weights = self._orig_weights.copy()
         self.last_row_consumed = False
+
+    # -- exact-checkpoint protocol (DataLoader.state_dict support) -----------
+
+    def drain_in_flight(self):
+        """Drain every constituent; returns their in-flight rows (grouped
+        per reader — the mixed interleave of in-flight rows is not
+        preserved, so resumed streams are multiset-exact, order-exact only
+        from the first post-snapshot draw onward)."""
+        drained = []
+        for reader in self._readers:
+            drained.extend(reader.drain_in_flight())
+        return drained
+
+    def resume_dispatch(self):
+        for reader in self._readers:
+            reader.resume_dispatch()
+
+    def state_dict(self):
+        """Mixer token: constituent tokens + the draw rng + surviving set.
+
+        Resume by rebuilding each constituent with its token
+        (``state['constituents'][i]``) and the mixer with
+        ``resume_state=state``.  With ``exhaust='drop'`` the resumed
+        stream is multiset-exact (every constituent row delivered exactly
+        once overall); with ``exhaust='stop'`` the stream's truncation
+        point is draw-aligned, and draining shifts which tail rows fall
+        past it — rows before the cut are never lost or duplicated, but
+        the cut itself may move by up to the drained window.
+        """
+        return {
+            'constituents': [r.state_dict() for r in self._all_readers],
+            'rng_state': self._rng.bit_generator.state,
+            'weights': self._weights.tolist(),
+            'active': [i for i, r in enumerate(self._all_readers)
+                       if r in self._readers],
+        }
 
     def __enter__(self):
         return self

@@ -387,6 +387,7 @@ def test_speculative_validates_lengths(lm):
                              max_new_tokens=12, draft_len=4)
 
 
+@pytest.mark.slow
 def test_speculative_sampling_matches_target_distribution(lm):
     """Rejection-sampling correctness: whatever the draft proposes, the
     emitted token's distribution equals the target's temperature
@@ -404,7 +405,7 @@ def test_speculative_sampling_matches_target_distribution(lm):
     draft_params = draft.init(jax.random.PRNGKey(123),
                               jnp.zeros((1, 4), jnp.int32))['params']
     prompt_row = np.random.default_rng(6).integers(0, 61, (1, 4))
-    n = 4096
+    n = 1024   # empirical TV noise ~0.09 here; a wrong rule shows ~0.46
     V = 61
     prompt = jnp.asarray(np.repeat(prompt_row, n, axis=0), jnp.int32)
 
@@ -427,7 +428,7 @@ def test_speculative_sampling_matches_target_distribution(lm):
         draft_len=3, temperature=1.0, rng=jax.random.PRNGKey(2000)))[:, 1]
     counts = np.bincount(got, minlength=V) / n
     tv = 0.5 * np.abs(counts - p_true).sum()
-    assert tv < 0.15, tv
+    assert tv < 0.2, tv
 
 
 def test_speculative_sampling_requires_rng(lm):

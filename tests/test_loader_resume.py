@@ -323,3 +323,42 @@ def test_state_dict_before_first_batch_preserves_restored_state(dataset,
                                        loader_kwargs)
     got = sorted(sum(consumed, []) + sum(resumed, []))
     assert got == sorted(list(range(ROWS)) * 2)
+
+
+def test_weighted_sampling_reader_resume_multiset(dataset, tmp_path):
+    """The mixed stream checkpoints too: constituent tokens + the draw
+    rng + surviving-reader set.  exhaust='drop' delivers every row of
+    every constituent exactly once, so consumed + resumed must equal the
+    full union (exhaust='stop' truncates at a draw-aligned point that
+    draining legitimately shifts — see state_dict docstring)."""
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    path2 = tmp_path / 'ds2'
+    ds2 = create_test_dataset('file://' + str(path2), num_rows=32,
+                              rows_per_rowgroup=8)
+
+    def build(mix_resume=None):
+        tokens = (mix_resume or {}).get('constituents', [None, None])
+        r1 = make_reader(dataset.url, reader_pool_type='dummy',
+                         shuffle_row_groups=False, num_epochs=1,
+                         resume_state=tokens[0])
+        r2 = make_reader(ds2.url, reader_pool_type='dummy',
+                         shuffle_row_groups=False, num_epochs=1,
+                         resume_state=tokens[1])
+        return WeightedSamplingReader([r1, r2], [0.7, 0.3], seed=13,
+                                      exhaust='drop', resume_state=mix_resume)
+
+    full = sorted(list(range(64)) + list(range(32)))
+
+    mixed = build()
+    loader = DataLoader(mixed, batch_size=8, drop_last=False)
+    it = iter(loader)
+    consumed = [int(x) for _ in range(2) for x in np.asarray(next(it)['id'])]
+    state = pickle.loads(pickle.dumps(loader.state_dict()))
+    mixed.stop()
+    mixed.join()
+
+    with DataLoader(build(mix_resume=state['reader']), batch_size=8,
+                    drop_last=False, resume_state=state) as loader2:
+        resumed = [int(x) for b in loader2 for x in np.asarray(b['id'])]
+    assert sorted(consumed + resumed) == full
