@@ -24,6 +24,8 @@ _LAZY = {
     'UnischemaField': 'petastorm_tpu.unischema',
     'NoDataAvailableError': 'petastorm_tpu.errors',
     'PoisonedRowGroupError': 'petastorm_tpu.errors',
+    'reshard_reader_states': 'petastorm_tpu.elastic',
+    'reshard_loader_states': 'petastorm_tpu.elastic',
 }
 
 __all__ = list(_LAZY)

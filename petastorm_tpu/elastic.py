@@ -1,0 +1,266 @@
+"""Elastic re-sharding of reader / loader checkpoints.
+
+The reference has **no elasticity** (SURVEY.md §5.3: "No retry, no
+elasticity"): its sharding is static ``cur_shard/shard_count`` kwargs
+(``petastorm/reader.py :: make_reader``), so a training job checkpointed on
+K hosts can only resume on exactly K hosts.  On TPU pods that is a real
+constraint — slices get resized, preemptions reschedule jobs onto a
+different topology.  This module removes it: a set of K reader tokens
+(:meth:`petastorm_tpu.reader.Reader.state_dict`) can be re-mapped onto any
+new shard count M, preserving the at-least-once contract (every remaining
+row group is read by exactly one new shard; row groups in flight at
+snapshot time may repeat — identical to same-topology resume semantics).
+
+How it works
+------------
+
+A reader token carries its shard topology (``cur_shard``, ``shard_count``,
+``num_global_pieces``, ``drop_partitions``, ``shuffle``, ``seed``,
+``num_epochs``) in addition to the ventilator position ``(epoch, cursor)``.
+Because the per-epoch work order is a pure function of ``(seed, epoch)``
+over a deterministic item list, the *remaining* work of every old shard can
+be reconstructed offline — no reader needs to be alive:
+
+1. For each old shard, rebuild its item list (global piece indices
+   ``i % K == s`` × drop partitions) and replay its epoch permutations up
+   to the resume horizon; everything past the token is "remaining".
+2. Epochs every old shard has fully ahead of it (``>= e_cont``) resume as
+   REGULAR epochs under the new topology — new shards permute their own
+   item lists exactly as a fresh run would.
+3. The ragged part — current-epoch tails and any epochs some shards
+   already finished — becomes a **prologue**: a flat list of global work
+   items distributed round-robin across the M new tokens.  The new
+   readers dispatch prologue work first (``ConcurrentVentilator``
+   prologue positions), then fall into the regular epochs.
+
+The new tokens plug straight into ``make_reader(..., cur_shard=m,
+shard_count=M, resume_state=token_m)``.  Readers keep the GLOBAL piece
+list in their worker args precisely so a prologue can reference pieces
+outside the new shard's own residency.
+
+Loader-level states (``DataLoader.state_dict``) additionally carry decoded
+rows drained out of the worker pool; :func:`reshard_loader_states`
+redistributes those too, so nothing is lost even when checkpoints are
+taken mid-stream through the exact-resume path.
+"""
+
+import numpy as np
+
+_TOPOLOGY_KEYS = ('num_global_pieces', 'drop_partitions', 'shuffle')
+
+
+def _as_int(value):
+    return None if value is None else int(value)
+
+
+def _local_items(num_global_pieces, drop_partitions, cur_shard, shard_count):
+    """Reconstruct the work-item list of one shard — MUST mirror
+    ``reader.py`` (items = sharded global indices × drop partitions)."""
+    if shard_count is None:
+        indices = range(num_global_pieces)
+    else:
+        indices = [i for i in range(num_global_pieces)
+                   if i % shard_count == cur_shard]
+    return [(i, p) for i in indices for p in range(max(1, drop_partitions))]
+
+
+def _epoch_order(items, shuffle, seed, epoch):
+    """MUST mirror ``ConcurrentVentilator._epoch_order``."""
+    if not shuffle:
+        return list(items)
+    rng = np.random.default_rng((seed or 0, epoch))
+    return [items[i] for i in rng.permutation(len(items))]
+
+
+def _normalized(states):
+    """Validate + order the K old tokens by cur_shard; returns (ordered
+    states, shared topology dict)."""
+    if not states:
+        raise ValueError('need at least one reader state')
+    for s in states:
+        missing = [k for k in _TOPOLOGY_KEYS + ('shard_count', 'cur_shard')
+                   if k not in s]
+        if missing:
+            raise ValueError(
+                'state lacks topology keys %s — tokens must come from '
+                'Reader.state_dict() of this framework (the reference-style '
+                'bare (epoch, cursor) token is not re-shardable)' % missing)
+    shard_count = _as_int(states[0]['shard_count'])
+    if shard_count is None and len(states) != 1:
+        raise ValueError('unsharded readers (shard_count=None) checkpoint '
+                         'as a single state')
+    if shard_count is not None and len(states) != shard_count:
+        raise ValueError('got %d states for shard_count=%s — pass every '
+                         'shard\'s token' % (len(states), shard_count))
+    shared = {k: states[0][k] for k in _TOPOLOGY_KEYS}
+    shared['num_epochs'] = states[0].get('num_epochs')
+    for s in states:
+        if _as_int(s['shard_count']) != shard_count:
+            raise ValueError('states disagree on shard_count')
+        if bool(s['shuffle']) != bool(shared['shuffle']) \
+                or _as_int(s['num_global_pieces']) != _as_int(shared['num_global_pieces']) \
+                or _as_int(s['drop_partitions']) != _as_int(shared['drop_partitions']):
+            raise ValueError('states disagree on dataset topology')
+        if s.get('num_epochs') != shared['num_epochs']:
+            raise ValueError('states disagree on num_epochs')
+    if shard_count is None:
+        return list(states), shared
+    by_shard = {}
+    for s in states:
+        cs = _as_int(s['cur_shard'])
+        if cs in by_shard:
+            raise ValueError('duplicate state for shard %d' % cs)
+        by_shard[cs] = s
+    if sorted(by_shard) != list(range(shard_count)):
+        raise ValueError('states cover shards %s, expected 0..%d'
+                         % (sorted(by_shard), shard_count - 1))
+    return [by_shard[s] for s in range(shard_count)], shared
+
+
+def reshard_reader_states(states, new_shard_count):
+    """Map the K tokens of one checkpoint onto ``new_shard_count`` tokens.
+
+    Args:
+        states: one ``Reader.state_dict()`` per old shard (any order).
+            For a no-loss handoff take them after ``drain_in_flight()`` —
+            or reshard the loader states (:func:`reshard_loader_states`),
+            which are drained by construction.
+        new_shard_count: the new topology's shard count (M >= 1).
+
+    Returns:
+        A list of M resume-state dicts; build the new readers with
+        ``make_reader(url, cur_shard=m, shard_count=M,
+        resume_state=result[m], ...)`` and the SAME dataset-shaping
+        arguments (``rowgroup_selector`` / ``filters`` /
+        ``shuffle_row_drop_partitions`` / ``num_epochs``) as the original
+        readers — the global piece list must be identical for global
+        indices to line up.
+
+    Every remaining (epoch, row-group) work item lands in exactly one new
+    token: ragged current-epoch tails as prologue work, fully-unstarted
+    epochs as regular epochs under the new sharding.
+    """
+    if new_shard_count < 1:
+        raise ValueError('new_shard_count must be >= 1')
+    ordered, shared = _normalized(states)
+    num_pieces = _as_int(shared['num_global_pieces'])
+    drop = _as_int(shared['drop_partitions'])
+    shuffle = bool(shared['shuffle'])
+    num_epochs = shared['num_epochs']
+    num_epochs = None if num_epochs is None else int(num_epochs)
+    old_count = _as_int(ordered[0]['shard_count'])
+
+    # First epoch that NO old shard has touched: those resume as regular
+    # epochs under the new topology.
+    def _touched_through(s):
+        e, c = int(s['epoch']), int(s['cursor'])
+        return e + 1 if (c > 0 or s.get('prologue')) else e
+
+    e_cont = max(_touched_through(s) for s in ordered)
+    if num_epochs is not None:
+        e_cont = min(e_cont, num_epochs)
+
+    prologue = []
+    for idx, s in enumerate(ordered):
+        cur_shard = None if old_count is None else idx
+        items = _local_items(num_pieces, drop, cur_shard, old_count)
+        seed = s.get('seed') or 0
+        prologue.extend(tuple(map(int, it)) for it in (s.get('prologue') or ()))
+        epoch, cursor = int(s['epoch']), int(s['cursor'])
+        for e in range(epoch, e_cont):
+            order = _epoch_order(items, shuffle, seed, e)
+            prologue.extend(order[cursor if e == epoch else 0:])
+
+    seed = ordered[0].get('seed')
+    out = []
+    for m in range(new_shard_count):
+        token = {'epoch': e_cont, 'cursor': 0, 'seed': seed,
+                 'prologue': prologue[m::new_shard_count],
+                 'cur_shard': m, 'shard_count': new_shard_count,
+                 'num_epochs': num_epochs}
+        token.update({k: shared[k] for k in _TOPOLOGY_KEYS})
+        out.append(token)
+    return out
+
+
+def reshard_loader_states(states, new_shard_count, batched=None):
+    """Re-shard ``DataLoader.state_dict()`` checkpoints onto M loaders.
+
+    Loader states are exact (the reader was drained into them), so this is
+    the no-loss elastic path: reader tokens go through
+    :func:`reshard_reader_states`; every buffered datum is redistributed
+    round-robin — prefetched device batches stay whole batches (they were
+    already filtered to numeric fields for transfer, so they re-enter
+    through the new loaders' ``pending``), while host-side row/chunk
+    buffers (drained pushback, the partial batch, shuffling-buffer
+    contents, columnar chunk residue) re-enter through ``pushback``.
+
+    Args:
+        states: one ``DataLoader.state_dict()`` per old shard.
+        new_shard_count: M.
+        batched: True for columnar loaders (``make_batch_reader`` /
+            ``columnar_decode`` underneath), False for row loaders.
+            Defaults to the ``'batched'`` flag stored in the states.
+
+    Returns M loader resume-state dicts: pass ``resume_state=result[m]``
+    to the new ``DataLoader`` built over
+    ``make_reader(..., cur_shard=m, shard_count=M,
+    resume_state=result[m]['reader'])``.
+
+    Redistribution necessarily changes delivery order (rows buffered on
+    one host may now surface on another), so seeded same-order resume is
+    only guaranteed when the topology is unchanged; the no-loss /
+    at-least-once multiset contract holds for any M.  NGram loader states
+    are rejected (windows are not flat rows).
+    """
+    for s in states:
+        if 'reader' not in s:
+            raise ValueError('not a DataLoader state (no reader token); for '
+                             'bare reader tokens use reshard_reader_states')
+    if batched is None:
+        flags = {bool(s.get('batched', False)) for s in states}
+        if len(flags) != 1:
+            raise ValueError('states disagree on batched=; pass it explicitly')
+        batched = flags.pop()
+
+    new_readers = reshard_reader_states([s['reader'] for s in states],
+                                        new_shard_count)
+
+    loose = []    # row dicts (row mode) or chunk dicts (columnar mode)
+    pending = []  # whole prefetched batches, redistributed batch-wise
+    for s in states:
+        loose.extend(s.get('pushback') or ())
+        pending.extend(s.get('pending') or ())
+        if not batched:
+            loose.extend(s.get('partial_rows') or ())
+            buf = s.get('shuffle_buffer')
+            if buf:
+                loose.extend(buf.get('items') or ())
+        else:
+            for chunk in s.get('chunks') or ():
+                loose.append(chunk)
+            colsh = s.get('col_shuffle')
+            if colsh and colsh.get('columns') is not None:
+                loose.append(dict(colsh['columns']))
+    if not batched:
+        for item in loose:
+            if isinstance(item, dict) \
+                    and any(isinstance(v, dict) for v in item.values()):
+                raise ValueError('elastic reshard does not support NGram '
+                                 'loader states (windows are nested, not '
+                                 'flat rows)')
+
+    out = []
+    for m in range(new_shard_count):
+        out.append({
+            'version': 1,
+            'batched': batched,
+            'reader': new_readers[m],
+            'pushback': loose[m::new_shard_count],
+            'pending': pending[m::new_shard_count],
+            'partial_rows': [],
+            'shuffle_buffer': None,
+            'chunks': [],
+            'col_shuffle': None,
+        })
+    return out

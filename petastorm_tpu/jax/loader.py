@@ -78,6 +78,13 @@ class DataLoader(object):
         #: rows/chunks to serve BEFORE pulling from the reader: restored
         #: snapshot data first, then drained-but-unconsumed results that
         #: state_dict() reinjects so checkpointing never skips data locally.
+        if resume_state is not None and 'batched' in resume_state \
+                and bool(resume_state['batched']) != self._batched_input:
+            raise ValueError(
+                'resume_state came from a %s loader but this reader is %s — '
+                'buffered data would be misinterpreted'
+                % ('columnar' if resume_state['batched'] else 'row',
+                   'columnar' if self._batched_input else 'row'))
         self._pushback = list((resume_state or {}).get('pushback', []))
         self._resume_state = resume_state
         self._pending = deque()
@@ -361,6 +368,7 @@ class DataLoader(object):
             or self._colsh is not None
         state = {
             'version': 1,
+            'batched': self._batched_input,
             'reader': self.reader.state_dict(),
             'pending': ([jax.device_get(b) for b in self._pending]
                         + list(rs.get('pending', []))),

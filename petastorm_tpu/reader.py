@@ -80,14 +80,18 @@ def _resolve_cache(cache_type, cache_location, cache_size_limit, cache_row_size_
     raise ValueError("cache_type must be 'null' or 'local-disk', got %r" % (cache_type,))
 
 
-def _shard_pieces(pieces, cur_shard, shard_count):
+def _shard_indices(num_pieces, cur_shard, shard_count):
+    """Global piece indices belonging to this shard (``i % shard_count ==
+    cur_shard``).  Workers keep the GLOBAL piece list and work items carry
+    global indices, so an elastic-reshard prologue (``elastic.py``) can hand
+    any reader work from any former shard."""
     if shard_count is None:
         if cur_shard is not None:
             raise ValueError('cur_shard requires shard_count')
-        return pieces
+        return list(range(num_pieces))
     if cur_shard is None or not 0 <= cur_shard < shard_count:
         raise ValueError('cur_shard must be in [0, %d), got %r' % (shard_count, cur_shard))
-    return [p for i, p in enumerate(pieces) if i % shard_count == cur_shard]
+    return [i for i in range(num_pieces) if i % shard_count == cur_shard]
 
 
 def make_reader(dataset_url,
@@ -176,8 +180,8 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
         if shard_count is not None:
             logger.info('Auto-sharding by JAX process topology: shard %d of %d',
                         cur_shard, shard_count)
-    pieces = _shard_pieces(pieces, cur_shard, shard_count)
-    if not pieces:
+    local_indices = _shard_indices(len(pieces), cur_shard, shard_count)
+    if not local_indices and 'prologue' not in (resume_state or {}):
         raise NoDataAvailableError(
             'No row groups to read from %r after sharding/selection' % (dataset_url,))
 
@@ -193,9 +197,13 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
         columnar_output=columnar_decode, read_retries=read_retries,
         retry_backoff_s=retry_backoff_s)
 
-    # Work items: (piece_index, row_drop_partition).
-    items = [(i, p) for i in range(len(pieces))
-             for p in range(max(1, shuffle_row_drop_partitions))]
+    # Work items: (global_piece_index, row_drop_partition).
+    drop_partitions = max(1, shuffle_row_drop_partitions)
+    items = [(i, p) for i in local_indices for p in range(drop_partitions)]
+    topology = {'cur_shard': cur_shard, 'shard_count': shard_count,
+                'num_global_pieces': len(pieces),
+                'drop_partitions': drop_partitions,
+                'shuffle': bool(shuffle_row_groups)}
 
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size, zmq_copy_buffers)
     result_schema = transform_schema(schema_view, transform_spec) \
@@ -206,7 +214,7 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
                   items=items, schema=result_schema, ngram=ngram,
                   shuffle_items=shuffle_row_groups, num_epochs=num_epochs,
                   seed=seed, resume_state=resume_state, cache=cache,
-                  result_converter=converter)
+                  result_converter=converter, topology=topology)
 
 
 class _ColumnarDictConverter(object):
@@ -264,8 +272,8 @@ def make_batch_reader(dataset_url_or_urls,
 
     if cur_shard is None and shard_count is None:
         cur_shard, shard_count = _jax_default_shard()
-    pieces = _shard_pieces(pieces, cur_shard, shard_count)
-    if not pieces:
+    local_indices = _shard_indices(len(pieces), cur_shard, shard_count)
+    if not local_indices and 'prologue' not in (resume_state or {}):
         raise NoDataAvailableError(
             'No row groups to read from %r after sharding/selection' % (dataset_url_or_urls,))
 
@@ -276,7 +284,10 @@ def make_batch_reader(dataset_url_or_urls,
                                   predicate=predicate, cache=cache,
                                   read_retries=read_retries,
                                   retry_backoff_s=retry_backoff_s)
-    items = [(i, 0) for i in range(len(pieces))]
+    items = [(i, 0) for i in local_indices]
+    topology = {'cur_shard': cur_shard, 'shard_count': shard_count,
+                'num_global_pieces': len(pieces), 'drop_partitions': 1,
+                'shuffle': bool(shuffle_row_groups)}
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size, zmq_copy_buffers)
     result_schema = transform_schema(schema_view, transform_spec) \
         if transform_spec is not None else schema_view
@@ -285,7 +296,8 @@ def make_batch_reader(dataset_url_or_urls,
                   items=items, schema=result_schema, ngram=None,
                   shuffle_items=shuffle_row_groups, num_epochs=num_epochs,
                   seed=seed, resume_state=resume_state, cache=cache,
-                  result_converter=ArrowResultConverter(result_schema))
+                  result_converter=ArrowResultConverter(result_schema),
+                  topology=topology)
 
 
 class Reader(object):
@@ -298,7 +310,7 @@ class Reader(object):
 
     def __init__(self, *, pool, worker_class, worker_args, items, schema, ngram,
                  shuffle_items, num_epochs, seed, resume_state, cache,
-                 result_converter=None):
+                 result_converter=None, topology=None):
         self.schema = schema
         self.ngram = ngram
         #: True for the columnar (make_batch_reader) path: __next__ yields
@@ -321,7 +333,9 @@ class Reader(object):
     # Deferred so reset() can rebuild the ventilator with the same args.
         self._worker_class = worker_class
         self._worker_args = worker_args
+        self._topology = topology
         start_epoch = start_cursor = 0
+        prologue = ()
         if resume_state is not None:
             # Checkpoint round-trips (orbax) restore int leaves as 0-d numpy
             # arrays; normalize here so callers pass tokens back verbatim.
@@ -331,9 +345,37 @@ class Reader(object):
             start_cursor = as_int(resume_state.get('cursor'), 0)
             seed = resume_state.get('seed', self._seed)
             self._seed = seed if seed is None else int(seed)
-        self._start(start_epoch, start_cursor)
+            prologue = [(int(i), int(p)) for i, p in
+                        (resume_state.get('prologue') or ())]
+            self._check_resume_topology(resume_state)
+        self._start(start_epoch, start_cursor, prologue)
 
-    def _start(self, start_epoch=0, start_cursor=0):
+    def _check_resume_topology(self, resume_state):
+        """A token's position indexes a specific shard's permutation: resuming
+        it under a different topology silently skips/rereads data.  Tokens
+        carry their topology since the elastic-reshard work — compare it
+        (tokens predating it, or foreign tokens, validate nothing)."""
+        if self._topology is None or 'shard_count' not in resume_state:
+            return
+        def norm(v):
+            return None if v is None else int(v)
+        mismatches = [
+            k for k in ('cur_shard', 'shard_count', 'num_global_pieces',
+                        'drop_partitions')
+            if norm(resume_state.get(k, self._topology[k])) != norm(self._topology[k])]
+        if bool(resume_state.get('shuffle', self._topology['shuffle'])) \
+                != bool(self._topology['shuffle']):
+            mismatches.append('shuffle')
+        if mismatches:
+            raise ValueError(
+                'resume_state was taken under a different topology '
+                '(mismatched: %s).  To move a checkpoint across shard '
+                'counts, map ALL shards\' tokens through '
+                'petastorm_tpu.elastic.reshard_reader_states — resuming a '
+                'foreign token directly would silently skip or re-read '
+                'data.' % ', '.join(mismatches))
+
+    def _start(self, start_epoch=0, start_cursor=0, prologue=()):
         # Small in-flight window: keeps resume tokens tight and bounds memory;
         # large enough to never starve the workers.
         window = max(2 * self._pool.workers_count, 4)
@@ -343,16 +385,33 @@ class Reader(object):
             iterations=self._num_epochs,
             randomize_item_order=self._shuffle_items,
             random_seed=self._seed,
-            max_ventilation_queue_size=min(len(self._items), window),
-            start_epoch=start_epoch, start_cursor=start_cursor)
+            max_ventilation_queue_size=max(
+                1, min(len(self._items) + len(prologue), window)),
+            start_epoch=start_epoch, start_cursor=start_cursor,
+            prologue_items=prologue)
         self._pool.start(self._worker_class, self._worker_args, ventilator=self._ventilator)
 
     # -- resume --------------------------------------------------------------
 
     def state_dict(self):
-        """Serializable mid-stream position (row-group granularity; rows in
-        flight at snapshot time are re-read on resume)."""
-        return self._ventilator.state_dict()
+        """Serializable mid-stream position (row-group granularity).
+
+        For an EXACT no-loss snapshot, call :meth:`drain_in_flight` first
+        (or use ``DataLoader.state_dict``, which does): the bare token
+        replays any row group still outstanding, but results already
+        published to the pool queue and not yet consumed are past the token.
+
+        The token also carries the shard topology (``cur_shard``,
+        ``shard_count``, ``num_global_pieces``, ``drop_partitions``,
+        ``shuffle``, ``num_epochs``), which makes it re-shardable:
+        ``petastorm_tpu.elastic.reshard_reader_states`` maps the tokens of
+        K readers onto any new shard count.
+        """
+        state = self._ventilator.state_dict()
+        if self._topology is not None:
+            state.update(self._topology)
+            state['num_epochs'] = self._num_epochs
+        return state
 
     # -- introspection -------------------------------------------------------
 
@@ -366,9 +425,13 @@ class Reader(object):
         if getattr(self, '_num_local_rows', None) is not None:
             return self._num_local_rows
         from petastorm_tpu.etl.dataset_metadata import read_row_group_num_rows
+        # worker_args.pieces is the GLOBAL list (elastic prologues may touch
+        # any piece); this shard's regular epoch covers only its own items.
+        local = sorted({i for i, _ in self._items})
         total = 0
         unknown = {}
-        for piece in self._worker_args.pieces:
+        for idx in local:
+            piece = self._worker_args.pieces[idx]
             if piece.num_rows >= 0:
                 total += piece.num_rows
             else:
@@ -520,7 +583,10 @@ class Reader(object):
     def diagnostics(self):
         d = dict(self._pool.diagnostics)
         d['ventilated_count'] = self._ventilator.ventilated_count
-        d.update(self._ventilator.state_dict())
+        token = self._ventilator.state_dict()
+        # the prologue item list is data, not a gauge — report its length
+        d['prologue_remaining'] = len(token.pop('prologue', ()))
+        d.update(token)
         return d
 
     def __enter__(self):

@@ -53,7 +53,7 @@ class ConcurrentVentilator(Ventilator):
     def __init__(self, ventilate_fn, items, iterations=1,
                  randomize_item_order=False, random_seed=0,
                  max_ventilation_queue_size=None,
-                 start_epoch=0, start_cursor=0):
+                 start_epoch=0, start_cursor=0, prologue_items=None):
         super(ConcurrentVentilator, self).__init__(ventilate_fn)
         if iterations is not None and iterations <= 0:
             raise ValueError('iterations must be positive or None, got %r' % (iterations,))
@@ -63,8 +63,18 @@ class ConcurrentVentilator(Ventilator):
         self._seed = random_seed if random_seed is not None else 0
         self._max_inflight = max_ventilation_queue_size or max(2 * len(self._items), 1)
 
+        #: One-shot work dispatched BEFORE the regular epochs, in list order
+        #: and un-shuffled — the elastic-reshard handoff (epoch tails
+        #: inherited from a previous shard topology, see
+        #: ``petastorm_tpu/elastic.py``).  Prologue positions are negative
+        #: (``idx - len(prologue)``) so the oldest-position resume math
+        #: orders them strictly before every epoch position.
+        self._prologue = list(prologue_items or [])
+        self._prologue_cursor = 0
         self._epoch = start_epoch
         self._cursor = start_cursor  # index into the current epoch's permutation
+        self._start_epoch = start_epoch      # resume target while prologue runs
+        self._start_cursor = start_cursor
         self._inflight = threading.Semaphore(self._max_inflight)
         self._completed = threading.Event()
         self._paused = threading.Event()
@@ -81,11 +91,26 @@ class ConcurrentVentilator(Ventilator):
 
         Restoring replays from that position — items after it that already
         completed are re-read (at-least-once; no item is ever lost).
+
+        While prologue work is not fully processed the token additionally
+        carries ``'prologue'`` (the remaining prologue items), and the
+        epoch/cursor fields point at the regular-epoch start position —
+        replaying both reproduces every remaining item.
         """
         n = max(len(self._items), 1)
+        P = len(self._prologue)
         with self._lock:
-            current = self._epoch * n + self._cursor
+            if self._prologue_cursor < P:
+                current = self._prologue_cursor - P
+            else:
+                current = self._epoch * n + self._cursor
             oldest = min(self._outstanding) if self._outstanding else current
+            oldest = min(oldest, current)
+            if oldest < 0:
+                return {'epoch': self._start_epoch, 'cursor': self._start_cursor,
+                        'seed': self._seed,
+                        'prologue': [tuple(it) if isinstance(it, (list, tuple)) else it
+                                     for it in self._prologue[oldest + P:]]}
             return {'epoch': oldest // n, 'cursor': oldest % n, 'seed': self._seed}
 
     def _epoch_order(self, epoch):
@@ -101,6 +126,33 @@ class ConcurrentVentilator(Ventilator):
         self._thread.start()
 
     def _run(self):
+        # Prologue first: inherited work from an elastic reshard, dispatched
+        # in list order under the same pause/backpressure gates as epochs.
+        P = len(self._prologue)
+        while self._prologue_cursor < P:
+            if self._stop_requested.is_set():
+                return
+            if self._paused.is_set():
+                time.sleep(0.02)
+                continue
+            if not self._inflight.acquire(timeout=0.1):
+                continue
+            with self._lock:
+                if self._paused.is_set():
+                    self._inflight.release()
+                    continue
+                j = self._prologue_cursor
+                item = self._prologue[j]
+                self._prologue_cursor = j + 1
+                self._outstanding.add(j - P)
+                self.ventilated_count += 1
+            self._ventilate_fn(VentilatedItem(j - P, item))
+        if not self._items:
+            # Prologue-only ventilator (elastic reshard onto more shards
+            # than row groups): nothing to iterate — spinning the epoch
+            # loop with n=0 would busy-wait forever under iterations=None.
+            self._completed.set()
+            return
         while not self._stop_requested.is_set():
             with self._lock:
                 if self._iterations is not None and self._epoch >= self._iterations:

@@ -1,0 +1,268 @@
+"""Elastic reshard: resume a K-shard checkpoint on M shards (K != M).
+
+SURVEY.md §5.3 — the reference has no elasticity: static
+``cur_shard/shard_count`` means a job checkpointed on K hosts resumes only
+on K hosts.  ``petastorm_tpu.elastic`` maps K reader/loader tokens onto any
+M.  Contract under test:
+
+* **no-loss**: every row the old topology had not yet delivered is
+  delivered by exactly the new topology (union over new shards covers the
+  remaining multiset; at-least-once means row groups in flight at snapshot
+  time may repeat).
+* **exactness through loader states**: loader states are drained, so the
+  combined old-consumed + new-delivered multiset equals the full run's
+  multiset exactly.
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.elastic import reshard_loader_states, reshard_reader_states
+from petastorm_tpu.jax import DataLoader
+
+from test_common import create_test_dataset
+
+ROWS = 60
+GROUP = 5  # 12 row groups
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('elasticds')
+    return create_test_dataset('file://' + str(path), num_rows=ROWS,
+                               rows_per_rowgroup=GROUP)
+
+
+def _readers(url, shard_count, **kw):
+    kw.setdefault('num_epochs', 2)
+    kw.setdefault('shuffle_row_groups', True)
+    kw.setdefault('seed', 11)
+    kw.setdefault('reader_pool_type', 'dummy')
+    return [make_reader(url, cur_shard=s, shard_count=shard_count, **kw)
+            for s in range(shard_count)]
+
+
+def _ids(rows):
+    return [int(r.id if hasattr(r, 'id') else r['id']) for r in rows]
+
+
+@pytest.mark.parametrize('old_k,new_m', [(2, 3), (3, 2), (2, 1), (1, 4)])
+def test_reader_reshard_no_loss(dataset, old_k, new_m):
+    """Consume part of the stream on K shards, reshard tokens to M shards,
+    assert delivered-before + delivered-after covers every (row, epoch)."""
+    num_epochs = 2
+    readers = _readers(dataset.url, old_k, num_epochs=num_epochs)
+    consumed = []
+    states = []
+    for s, reader in enumerate(readers):
+        # uneven progress per shard: shard s consumes (s+1)*7 rows
+        for _ in range((s + 1) * 7):
+            consumed.append(next(iter(reader)))
+        # drain-then-token = the no-loss snapshot discipline
+        drained = reader.drain_in_flight()
+        consumed.extend(drained)
+        states.append(reader.state_dict())
+        reader.stop()
+        reader.join()
+
+    tokens = reshard_reader_states(states, new_m)
+    assert len(tokens) == new_m
+    after = []
+    for m, token in enumerate(tokens):
+        with make_reader(dataset.url, cur_shard=m, shard_count=new_m,
+                         num_epochs=num_epochs, shuffle_row_groups=True,
+                         seed=11, reader_pool_type='dummy',
+                         resume_state=token) as r:
+            after.extend(list(r))
+
+    total = Counter(_ids(consumed)) + Counter(_ids(after))
+    # Every row must appear >= num_epochs times (no loss); at-least-once
+    # allows replays of groups in flight at snapshot time.
+    for i in range(ROWS):
+        assert total[i] >= num_epochs, 'row %d lost: %r' % (i, total[i])
+    # Replays are bounded by the in-flight window, not the whole stream.
+    assert sum(total.values()) <= ROWS * num_epochs + ROWS, total
+
+
+def test_reader_reshard_exact_with_dummy_pool(dataset):
+    """Dummy pool + drained tokens: the combined multiset is EXACT."""
+    num_epochs = 2
+    readers = _readers(dataset.url, 2, num_epochs=num_epochs)
+    consumed, states = [], []
+    for s, reader in enumerate(readers):
+        for _ in range(8 * (s + 1)):
+            consumed.append(next(iter(reader)))
+        consumed.extend(reader.drain_in_flight())
+        states.append(reader.state_dict())
+        reader.stop()
+        reader.join()
+
+    tokens = reshard_reader_states(states, 3)
+    after = []
+    for m, token in enumerate(tokens):
+        with make_reader(dataset.url, cur_shard=m, shard_count=3,
+                         num_epochs=num_epochs, shuffle_row_groups=True,
+                         seed=11, reader_pool_type='dummy',
+                         resume_state=token) as r:
+            after.extend(list(r))
+    total = Counter(_ids(consumed)) + Counter(_ids(after))
+    assert total == Counter({i: num_epochs for i in range(ROWS)})
+
+
+def test_reader_reshard_mid_epoch_boundaries(dataset):
+    """Shards parked at different epochs still reshard without loss."""
+    readers = _readers(dataset.url, 2, num_epochs=3, shuffle_row_groups=False)
+    consumed, states = [], []
+    # shard 0: deep into epoch 1; shard 1: still in epoch 0
+    for count, reader in zip((40, 3), readers):
+        for _ in range(count):
+            consumed.append(next(iter(reader)))
+        consumed.extend(reader.drain_in_flight())
+        states.append(reader.state_dict())
+        reader.stop()
+        reader.join()
+    epochs = [s['epoch'] for s in states]
+    assert epochs[0] >= 1 and epochs[1] == 0, epochs
+
+    tokens = reshard_reader_states(states, 2)
+    after = []
+    for m, token in enumerate(tokens):
+        with make_reader(dataset.url, cur_shard=m, shard_count=2,
+                         num_epochs=3, shuffle_row_groups=False, seed=11,
+                         reader_pool_type='dummy', resume_state=token) as r:
+            after.extend(list(r))
+    total = Counter(_ids(consumed)) + Counter(_ids(after))
+    assert total == Counter({i: 3 for i in range(ROWS)})
+
+
+def test_reshard_validation_errors(dataset):
+    readers = _readers(dataset.url, 2)
+    states = [r.state_dict() for r in readers]
+    for r in readers:
+        r.stop()
+        r.join()
+    with pytest.raises(ValueError, match='every shard'):
+        reshard_reader_states(states[:1], 2)
+    with pytest.raises(ValueError, match='new_shard_count'):
+        reshard_reader_states(states, 0)
+    bare = {'epoch': 0, 'cursor': 0, 'seed': 0}
+    with pytest.raises(ValueError, match='topology'):
+        reshard_reader_states([bare, bare], 2)
+
+
+def test_foreign_token_rejected(dataset):
+    """Resuming a K-topology token directly on an M-topology reader must
+    fail loudly (the silent-skip failure mode elastic exists to prevent)."""
+    readers = _readers(dataset.url, 2)
+    token = readers[0].state_dict()
+    for r in readers:
+        r.stop()
+        r.join()
+    with pytest.raises(ValueError, match='reshard_reader_states'):
+        make_reader(dataset.url, cur_shard=0, shard_count=4,
+                    reader_pool_type='dummy', resume_state=token)
+
+
+def test_batched_state_rejected_on_row_loader(dataset):
+    with make_reader(dataset.url, reader_pool_type='dummy') as reader:
+        with pytest.raises(ValueError, match='columnar loader'):
+            DataLoader(reader, batch_size=4,
+                       resume_state={'batched': True, 'pushback': []})
+
+
+def test_more_shards_than_row_groups(dataset):
+    """M > num row groups: some new shards are prologue-only readers with
+    an empty regular item list — they must serve the prologue and then
+    complete (not spin)."""
+    num_epochs = 1
+    readers = _readers(dataset.url, 2, num_epochs=num_epochs)
+    states = []
+    consumed = []
+    for reader in readers:
+        consumed.append(next(iter(reader)))
+        consumed.extend(reader.drain_in_flight())
+        states.append(reader.state_dict())
+        reader.stop()
+        reader.join()
+    big = 16  # > 12 row groups
+    tokens = reshard_reader_states(states, big)
+    after = []
+    for m, token in enumerate(tokens):
+        with make_reader(dataset.url, cur_shard=m, shard_count=big,
+                         num_epochs=num_epochs, shuffle_row_groups=True,
+                         seed=11, reader_pool_type='dummy',
+                         resume_state=token) as r:
+            after.extend(list(r))
+    total = Counter(_ids(consumed)) + Counter(_ids(after))
+    assert total == Counter({i: num_epochs for i in range(ROWS)})
+
+
+def test_reshard_exhausted_states(dataset):
+    """Resharding fully-consumed readers yields readers with nothing left."""
+    readers = _readers(dataset.url, 2, num_epochs=1)
+    for r in readers:
+        list(r)
+    states = [r.state_dict() for r in readers]
+    for r in readers:
+        r.stop()
+        r.join()
+    tokens = reshard_reader_states(states, 2)
+    leftover = []
+    for m, token in enumerate(tokens):
+        if not token['prologue'] and token['epoch'] >= 1:
+            continue  # nothing to resume — make_reader would read nothing
+        with make_reader(dataset.url, cur_shard=m, shard_count=2,
+                         num_epochs=1, shuffle_row_groups=True, seed=11,
+                         reader_pool_type='dummy', resume_state=token) as r:
+            leftover.extend(list(r))
+    assert _ids(leftover) == []
+
+
+@pytest.mark.parametrize('pool', ['dummy', 'thread'])
+def test_loader_reshard_exact(dataset, pool):
+    """DataLoader states (drained by construction) reshard exactly: rows
+    buffered in one loader surface from another, none lost, none forged."""
+    num_epochs = 2
+    kw = dict(num_epochs=num_epochs, shuffle_row_groups=True, seed=11,
+              reader_pool_type=pool)
+    if pool != 'dummy':
+        kw['workers_count'] = 2
+    readers = [make_reader(dataset.url, cur_shard=s, shard_count=2, **kw)
+               for s in range(2)]
+    loaders = [DataLoader(r, batch_size=4, prefetch=1) for r in readers]
+    consumed = []
+    states = []
+    for s, loader in enumerate(loaders):
+        it = iter(loader)
+        for _ in range(2 + s):
+            consumed.extend(_ids(_batch_rows(next(it))))
+        states.append(loader.state_dict())
+        loader.__exit__(None, None, None)
+
+    new_states = reshard_loader_states(states, 3)
+    after = []
+    for m, state in enumerate(new_states):
+        reader = make_reader(dataset.url, cur_shard=m, shard_count=3,
+                             resume_state=state['reader'], **kw)
+        loader = DataLoader(reader, batch_size=4, prefetch=1,
+                            drop_last=False, resume_state=state)
+        with loader:
+            for batch in loader:
+                after.extend(_ids(_batch_rows(batch)))
+
+    total = Counter(consumed) + Counter(after)
+    if pool == 'dummy':
+        assert total == Counter({i: num_epochs for i in range(ROWS)})
+    else:
+        for i in range(ROWS):
+            assert total[i] >= num_epochs, 'row %d lost' % i
+
+
+def _batch_rows(batch):
+    import jax
+    batch = jax.device_get(batch)
+    n = len(next(iter(batch.values())))
+    return [{k: v[i] for k, v in batch.items()} for i in range(n)]
