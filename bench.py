@@ -245,6 +245,36 @@ def _run_stall(loader, state, max_steps, floor_ms):
     return round(stall_pct, 2), wall_ms
 
 
+def _run_scan_stall(loader, state, max_steps, floor_ms):
+    """Stall of the fused driver: ``DeviceInMemDataLoader.scan_epochs``
+    runs gather + step as one ``lax.scan`` dispatch per epoch.  Epoch 0
+    is the compile+settle warmup; the timed window covers enough whole
+    epochs to reach ``max_steps`` steps, closed by one terminal D2H."""
+    train_step, params, batch_stats, opt_state = state
+
+    def scan_step(carry, batch):
+        p, bs, opt = carry
+        p, bs, opt, loss = train_step(p, bs, opt, batch['image'],
+                                      batch['noun_id'])
+        return (p, bs, opt), loss
+
+    steps_per_epoch = max(1, NUM_IMAGES // BATCH)
+    epochs_needed = -(-max_steps // steps_per_epoch)
+    gen = loader.scan_epochs(scan_step, (params, batch_stats, opt_state),
+                             donate_carry=False)
+    _, outs = next(gen)                      # compile + epoch 0
+    float(np.asarray(outs)[-1])              # settle the warmup chain
+    t0 = time.monotonic()
+    last = None
+    for _ in range(epochs_needed):
+        _, last = next(gen)
+    final = np.asarray(last)                 # terminal D2H forces the chain
+    wall_ms = 1000.0 * (time.monotonic() - t0) / (epochs_needed * steps_per_epoch)
+    assert np.isfinite(final).all(), 'non-finite loss in scan epochs'
+    stall_pct = max(0.0, 100.0 * (wall_ms - floor_ms) / wall_ms)
+    return round(stall_pct, 2), wall_ms
+
+
 def _device_hbm_bytes():
     """Best-effort device memory capacity; conservative 16 GiB fallback
     (v5e) when the backend doesn't expose memory_stats."""
@@ -313,6 +343,15 @@ def train_stall_legs():
         cached_stall, cached_step_ms = _run_stall(loader, state, cached_steps,
                                                   floor_ms)
 
+    # hbm_scan: same HBM cache, but gather + train step fused into ONE
+    # lax.scan dispatch per epoch (DeviceInMemDataLoader.scan_epochs) —
+    # zero per-step host dispatch, so per-dispatch transport latency
+    # (pronounced on tunneled backends, nonzero even on PCIe) cannot
+    # become data stall.  The recommended consumption pattern for an
+    # HBM-resident epoch and the headline for this regime.
+    scan_stall, scan_step_ms = _run_scan_stall(loader, state, cached_steps,
+                                               floor_ms)
+
     # decoded-cache tier: epoch 0 decodes JPEG once and spills raw tensors
     # to local disk (untimed build pass); the measured epochs stream from
     # the mmap'd cache — the multi-epoch answer for datasets >> HBM.
@@ -340,8 +379,17 @@ def train_stall_legs():
     fits_hbm = decoded_epoch_bytes < 0.6 * hbm  # leave room for model+step
     regime = 'hbm_cached' if fits_hbm else 'decoded_cache'
     flops = _model_flops_per_step(state)
+    if fits_hbm:
+        # Both supported consumption patterns for the HBM cache are
+        # measured; the headline is the better one, NAMED in
+        # stall_pct_source so the number is traceable to its driver.
+        headline, source = min((cached_stall, 'hbm_cached'),
+                               (scan_stall, 'hbm_scan'))
+    else:
+        headline, source = disk_stall, 'decoded_cache'
     return {
-        'stall_pct': cached_stall if fits_hbm else disk_stall,
+        'stall_pct': headline,
+        'stall_pct_source': source,
         'stall_regime': '%s (decoded epoch %.2f GiB %s %.0f GiB device HBM; '
                         'multi-epoch > HBM runs the decoded disk cache, '
                         'single-pass runs streaming)'
@@ -349,6 +397,8 @@ def train_stall_legs():
                            'fits in' if fits_hbm else 'exceeds', hbm / 2**30),
         'stall_pct_hbm_cached': cached_stall,
         'step_ms_hbm_cached': round(cached_step_ms, 2),
+        'stall_pct_hbm_scan': scan_stall,
+        'step_ms_hbm_scan': round(scan_step_ms, 2),
         'device_step_ms': round(floor_ms, 2),
         'stall_pct_streaming': stream_stall,
         'step_ms_streaming': round(stream_step_ms, 2),
@@ -576,13 +626,19 @@ def main():
                     'per-row cv2 decode (native plane disabled), per-row '
                     'python collate, sync device_put, no prefetch '
                     '(%.1f images/s)' % theirs,
-        'stall_note': 'stall_pct = the regime stall_regime names; '
-                      'stall_pct_hbm_cached = HBM epoch cache '
-                      '(DeviceInMemDataLoader); stall_pct_streaming = live '
-                      'thread-pool JPEG decode (host_cores-bound); '
-                      'stall_pct_delivery_bound = same streaming loader, '
-                      'pre-decoded uint8 parquet (no JPEG) — isolates the '
-                      'delivery plane from decode economics',
+        'stall_note': 'stall_pct = the regime stall_regime names, from the '
+                      'leg stall_pct_source names (the better of the two '
+                      'HBM-cache drivers when both apply); '
+                      'stall_pct_hbm_cached = HBM epoch cache, per-step '
+                      'iterator (DeviceInMemDataLoader); stall_pct_hbm_scan '
+                      '= same cache, gather+step fused into one lax.scan '
+                      'dispatch per epoch (scan_epochs — the recommended '
+                      'pattern; immune to per-dispatch transport latency); '
+                      'stall_pct_streaming = live thread-pool JPEG decode '
+                      '(host_cores-bound); stall_pct_delivery_bound = same '
+                      'streaming loader, pre-decoded uint8 parquet (no '
+                      'JPEG) — isolates the delivery plane from decode '
+                      'economics',
     }
     result.update(stall)
     result['kernel_max_err'] = kernel_certification()

@@ -92,6 +92,7 @@ class DataLoader(object):
         self._partial_rows = []
         self._col_chunks = None
         self._colsh = None
+        self._scan_chunk = None  # scan_batches fill buffer (see state_dict)
         #: Per-stage wall time (SURVEY.md §5.1 obligation): 'host_batch_s'
         #: covers waiting on the decode plane + collate, 'transform_s' the
         #: user hook, 'device_put_s' the H2D *dispatch* (the DMA itself is
@@ -330,6 +331,99 @@ class DataLoader(object):
             return jax.device_put(numeric, self._device)
         return jax.device_put(numeric)
 
+    # -- fused multi-step consumption ----------------------------------------
+
+    def scan_batches(self, step_fn, carry, steps_per_call=8,
+                     donate_carry=True):
+        """Consume the stream with ONE jitted dispatch per ``steps_per_call``
+        steps instead of two per step.
+
+        Host batches are collected in chunks of ``steps_per_call``, stacked
+        to ``(k, batch, ...)``, transferred in a single ``device_put`` (same
+        bytes, 1/k the transfer dispatches), and run through
+        ``lax.scan(step_fn, carry, chunk)`` as one executable.  Per-step
+        dispatch overhead — python + transport round-trips, the dominant
+        stall for fast steps or high-latency links — shrinks by k×, while
+        host decode of the next chunk still overlaps device compute (the
+        scan call is async).
+
+        ``step_fn(carry, batch) -> (carry, out)`` sees exactly the batches
+        ``__iter__`` would deliver.  Yields ``(carry, outs)`` per chunk
+        (``outs`` stacked along a leading axis of length k).  A trailing
+        chunk shorter than ``steps_per_call`` triggers one extra compile
+        for its size.  With ``sharding=``, each stacked leaf is assembled
+        as a global array with a leading unsharded step axis.
+
+        The HBM-cached sibling (``DeviceInMemDataLoader.scan_epochs``)
+        removes host work entirely; this is the streaming-regime analog
+        where data must flow host→device every step regardless.
+
+        Checkpointing composes: batches restored from ``resume_state``
+        (prefetched by the previous run) are served first, and a
+        ``state_dict()`` taken between yields captures the partially
+        filled chunk, so the exact-resume contract survives switching
+        between ``__iter__`` and ``scan_batches`` consumption.
+        """
+        from jax import lax
+
+        if steps_per_call < 1:
+            raise ValueError('steps_per_call must be >= 1')
+        fn = jax.jit(lambda c, xs: lax.scan(step_fn, c, xs),
+                     donate_argnums=(0,) if donate_carry else ())
+
+        def put_stacked(chunk):
+            if self._transform_fn is not None:
+                chunk = [self._transform_fn(b) for b in chunk]
+            stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *chunk)
+            numeric = _filter_numeric(stacked, self._warned_fields)
+            if self._sharding is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                spec = PartitionSpec(None, *self._sharding.spec)
+                return global_batch_from_local(
+                    numeric, NamedSharding(self._sharding.mesh, spec))
+            if self._device is not None:
+                return jax.device_put(numeric, self._device)
+            return jax.device_put(numeric)
+
+        def rows_of(batch):
+            return len(next(iter(jax.tree_util.tree_leaves(batch))))
+
+        # Batches the interrupted run had already prefetched come first —
+        # one 1-step scan each (they are already transformed + filtered;
+        # sizes may vary, and mixing their numeric-only structure into a
+        # fresh chunk would break stacking).
+        if self._resume_state and self._resume_state.get('pending'):
+            restored = self._resume_state['pending']
+            self._resume_state = dict(self._resume_state, pending=[])
+            for host_batch in restored:
+                self.stats['batches'] += 1
+                carry, outs = fn(carry, put_stacked([host_batch]))
+                yield carry, outs
+
+        # The fill buffer lives on self so state_dict() between yields can
+        # spill it back into the snapshot (nothing in flight is invisible).
+        self._scan_chunk = chunk = []
+        try:
+            for host_batch in self._host_batches():
+                if chunk and rows_of(host_batch) != rows_of(chunk[0]):
+                    # ragged tail (drop_last=False): flush so stacking stays
+                    # rectangular — the tail becomes its own (shorter) chunk
+                    carry, outs = fn(carry, put_stacked(list(chunk)))
+                    del chunk[:]
+                    yield carry, outs
+                chunk.append(host_batch)
+                self.stats['batches'] += 1
+                if len(chunk) == steps_per_call:
+                    carry, outs = fn(carry, put_stacked(list(chunk)))
+                    del chunk[:]
+                    yield carry, outs
+            if chunk:
+                carry, outs = fn(carry, put_stacked(list(chunk)))
+                del chunk[:]
+                yield carry, outs
+        finally:
+            self._scan_chunk = None
+
     # -- exact mid-epoch checkpoint/resume -----------------------------------
 
     def state_dict(self):
@@ -392,6 +486,20 @@ class DataLoader(object):
                             {k: (np.concatenate(v) if len(v) > 1 else v[0])
                              for k, v in cols.items()}),
             }
+        if self._scan_chunk:
+            # scan_batches mid-stream: its partially-filled chunk holds raw
+            # (pre-transform) host batches — spill them as pushback entries
+            # (rows for row mode, chunk dicts for columnar) so neither
+            # consumption style loses them on resume.
+            spill = []
+            for host_batch in self._scan_chunk:
+                if self._batched_input:
+                    spill.append(host_batch)
+                else:
+                    spill.extend(_unstack_batch(host_batch))
+            state['pushback'] = spill + state['pushback']
+            self._pushback[:0] = spill
+            del self._scan_chunk[:]
         self._pushback.extend(drained)
         self.reader.resume_dispatch()
         return state
@@ -422,6 +530,19 @@ def _stack_dicts(dicts):
         out[key] = _stack_dicts(values) if isinstance(values[0], dict) \
             else _stack_cells(values)
     return out
+
+
+def _unstack_batch(batch):
+    """Inverse of ``_stack_dicts``: a stacked (B, ...) dict pytree back to
+    B row dicts (nested dicts — ngram offsets — preserved)."""
+    n = len(next(iter(jax.tree_util.tree_leaves(batch))))
+
+    def row(i, node):
+        if isinstance(node, dict):
+            return {k: row(i, v) for k, v in node.items()}
+        return node[i]
+
+    return [row(i, batch) for i in range(n)]
 
 
 def _stack_cells(cells):
@@ -580,21 +701,28 @@ class DeviceInMemDataLoader(InMemDataLoader):
         self._dev_cache = None
         self._gather_fn = None
 
-    def __iter__(self):
-        import jax.numpy as jnp
-
+    def _materialize(self):
+        """Build the HBM-resident epoch cache (idempotent); returns the
+        device pytree or None when the dataset is empty."""
         if self._dev_cache is None:
             # Build the host cache via the parent's one-time read, then move
             # it to HBM wholesale (one transfer for the whole dataset).
             if self._build_cache() is None:
-                return iter(())
+                return None
             numeric = _filter_numeric(self._cache, self._warned_fields)
             place = (lambda x: jax.device_put(x, self._device)) \
                 if self._device is not None else jax.device_put
             self._dev_cache = jax.tree_util.tree_map(place, numeric)
             # The host copy is never read again — release dataset-sized RAM.
             self._cache = None
-        cache = self._dev_cache
+        return self._dev_cache
+
+    def __iter__(self):
+        import jax.numpy as jnp
+
+        cache = self._materialize()
+        if cache is None:
+            return iter(())
         n = len(next(iter(jax.tree_util.tree_leaves(cache))))
 
         if self._gather_fn is None:
@@ -612,18 +740,7 @@ class DeviceInMemDataLoader(InMemDataLoader):
             self._gather_fn = jax.jit(_gather)
 
         def gen():
-            # Same seed semantics as the host-RAM sibling: an explicit seed
-            # reproduces, seed=None draws fresh entropy per loader.
-            seed = self._seed if self._seed is not None \
-                else int(np.random.default_rng().integers(2 ** 31))
-            key = jax.random.PRNGKey(seed)
-            epoch = 0
-            while self._num_epochs is None or epoch < self._num_epochs:
-                if self._shuffle:
-                    key, sub = jax.random.split(key)
-                    order = jax.random.permutation(sub, n)
-                else:
-                    order = jnp.arange(n)
+            for order in self._epoch_orders(n):
                 stop = n - self.batch_size + 1 if self._drop_last else n
                 for start in range(0, max(stop, 0), self.batch_size):
                     if start + self.batch_size <= n:
@@ -633,8 +750,82 @@ class DeviceInMemDataLoader(InMemDataLoader):
                         yield jax.tree_util.tree_map(
                             lambda v: jnp.take(v, idx, axis=0), cache)
                     self.stats['batches'] += 1
-                epoch += 1
         return gen()
+
+    def _epoch_orders(self, n):
+        """Per-epoch index order stream shared by the per-step iterator and
+        ``scan_epochs`` — one place owns num_epochs/shuffle/seed semantics
+        (an explicit seed reproduces, seed=None draws fresh entropy per
+        loader, same as the host-RAM sibling)."""
+        import jax.numpy as jnp
+
+        seed = self._seed if self._seed is not None \
+            else int(np.random.default_rng().integers(2 ** 31))
+        key = jax.random.PRNGKey(seed)
+        epoch = 0
+        while self._num_epochs is None or epoch < self._num_epochs:
+            if self._shuffle:
+                key, sub = jax.random.split(key)
+                yield jax.random.permutation(sub, n)
+            else:
+                yield jnp.arange(n)
+            epoch += 1
+
+    def scan_epochs(self, step_fn, carry, donate_carry=True):
+        """Consume the epochs as ONE ``lax.scan`` dispatch per epoch.
+
+        The per-step iterator (``__iter__``) costs two host dispatches per
+        step (gather + user step); on high-latency transports (tunneled
+        devices) or very fast steps that dispatch overhead IS the data
+        stall.  This folds the whole epoch — on-device batch gather and
+        the training step — into a single jitted ``lax.scan``: zero host
+        work and zero dispatch latency between steps, the idiomatic XLA
+        consumption pattern for an HBM-resident epoch.
+
+        Args:
+            step_fn: ``step_fn(carry, batch) -> (carry, out)``; ``batch``
+                is the same dict pytree a per-step iteration would yield
+                (leading dim ``batch_size``).  Traced once, so it must be
+                jittable.
+            carry: initial carry pytree (params/optimizer state/...).
+            donate_carry: donate the carry buffers to each epoch call
+                (halves peak param memory; the yielded carry replaces it).
+
+        Yields ``(carry, outs)`` per epoch, where ``outs`` stacks the
+        per-step ``out`` along a leading ``steps_per_epoch`` axis.  Epoch
+        count and shuffling follow the loader's ``num_epochs`` / ``shuffle``
+        / ``seed`` exactly like the per-step iterator; partial trailing
+        batches are always dropped (``lax.scan`` needs static shapes).
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        cache = self._materialize()
+        if cache is None:
+            return
+        n = len(next(iter(jax.tree_util.tree_leaves(cache))))
+        steps = n // self.batch_size
+        if steps == 0:
+            logger.warning('epoch cache holds %d rows < batch_size=%d: no '
+                           'batches to scan', n, self.batch_size)
+            return
+        batch_size = self.batch_size
+
+        def run_epoch(carry, cache, order):
+            def body(c, i):
+                idx = lax.dynamic_slice_in_dim(order, i * batch_size,
+                                               batch_size)
+                batch = jax.tree_util.tree_map(
+                    lambda v: jnp.take(v, idx, axis=0), cache)
+                return step_fn(c, batch)
+            return lax.scan(body, carry, jnp.arange(steps))
+
+        fn = jax.jit(run_epoch, donate_argnums=(0,) if donate_carry else ())
+
+        for order in self._epoch_orders(n):
+            carry, outs = fn(carry, cache, order)
+            self.stats['batches'] += steps
+            yield carry, outs
 
 
 class DiskCachedDataLoader(DataLoader):
