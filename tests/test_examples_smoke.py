@@ -58,3 +58,9 @@ def test_imagenet_with_decoded_cache(tmp_path):
                timeout=600)
     assert 'steps=2' in out
     assert os.path.exists(str(tmp_path / 'inet_cache' / '_COMPLETE'))
+    # --hbm-cache (scan_epochs) is NOT smoked here: compiling
+    # lax.scan-of-ResNet on the CPU backend takes minutes (XLA:CPU
+    # conv-grad-in-loop compile), which would dominate the suite.  Its
+    # mechanics are unit-tested in test_jax_loader.py (scan_epochs legs)
+    # and the example path is exercised on real TPU hardware (see
+    # BENCH_NOTES.md on-chip runs).

@@ -306,6 +306,32 @@ def test_device_inmem_scan_epochs(dataset):
     assert loader.stats['batches'] == 12
 
 
+def test_device_inmem_scan_epochs_grouped(dataset):
+    """epochs_per_call folds several epochs into one dispatch; a trailing
+    partial group yields with its smaller epoch count."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    def step(carry, batch):
+        return carry + 1, batch['id']
+
+    with make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        loader = DeviceInMemDataLoader(reader, batch_size=16, num_epochs=5,
+                                       seed=7)
+        calls = list(loader.scan_epochs(step, np.int32(0), donate_carry=False,
+                                        epochs_per_call=3))
+    assert len(calls) == 2
+    first_outs = np.asarray(calls[0][1])
+    assert first_outs.shape == (3, 4, 16)     # (epochs, steps, batch)
+    assert np.asarray(calls[1][1]).shape == (2, 4, 16)
+    for epoch_ids in first_outs:
+        np.testing.assert_array_equal(np.sort(epoch_ids.ravel()),
+                                      np.arange(64))
+    # carry counted every step of every epoch
+    assert int(np.asarray(calls[-1][0])) == 5 * 4
+    assert loader.stats['batches'] == 20
+
+
 def test_device_inmem_scan_epochs_no_shuffle_order(dataset):
     from petastorm_tpu.jax import DeviceInMemDataLoader
 
@@ -372,6 +398,41 @@ def test_scan_batches_checkpoint_roundtrip(dataset):
                                            donate_carry=False):
             seen.extend(np.asarray(outs).ravel().tolist())
     assert sorted(seen) == list(range(64))
+
+
+def test_scan_batches_resume_pending_not_retransformed(dataset):
+    """Pending batches in a snapshot are post-transform; scan_batches must
+    not run transform_fn on them again."""
+    def double_ids(batch):
+        out = dict(batch)
+        out['id'] = np.asarray(batch['id']) * 2
+        return out
+
+    def step(carry, batch):
+        return carry, batch['id']
+
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=8, prefetch=2,
+                            transform_fn=double_ids)
+        it = iter(loader)
+        first = next(it)           # leaves pending batches behind
+        state = loader.state_dict()
+        assert state['pending'], 'test needs prefetched batches in the state'
+        seen = list(np.asarray(first['id']))
+        loader.__exit__(None, None, None)
+
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False,
+                     resume_state=state['reader']) as reader:
+        loader = DataLoader(reader, batch_size=8, transform_fn=double_ids,
+                            resume_state=state)
+        for _, outs in loader.scan_batches(step, np.int32(0),
+                                           donate_carry=False,
+                                           steps_per_call=3):
+            seen.extend(np.asarray(outs).ravel().tolist())
+    # every id delivered exactly once, exactly doubled (never quadrupled)
+    assert sorted(seen) == [2 * i for i in range(64)]
 
 
 def test_scan_batches_sharded_global_arrays(dataset):
