@@ -336,6 +336,27 @@ def train_stall_legs():
         deliv_stall, deliv_step_ms = _run_stall(loader, state, TRAIN_STEPS,
                                                 floor_ms)
 
+    # Host delivery plane in ISOLATION (no device in the loop): the same
+    # streaming loader over pre-decoded uint8, consumed at the host
+    # boundary.  Proves whether the framework's own machinery (parquet
+    # read -> columnar collate -> batch assembly) sustains chip rate
+    # (value/BATCH steps/s vs the device floor) independent of transport
+    # bandwidth — on tunneled sandboxes the device-transfer legs above
+    # are tunnel-bound, which says nothing about the delivery plane.
+    with make_reader(RAW_DATASET_URL, num_epochs=epochs, workers_count=WORKERS,
+                     shuffle_row_groups=False, columnar_decode=True) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+        n_host = 0
+        warmup_batches = 2  # pool spin-up + first row-group latency are
+        t0 = None           # not steady-state delivery; exclude them
+        for i, host_batch in enumerate(loader.iter_host_batches()):
+            if i == warmup_batches:
+                t0 = time.monotonic()
+            elif i > warmup_batches:
+                n_host += len(host_batch['noun_id'])
+        host_plane_rate = (n_host / (time.monotonic() - t0)
+                           if t0 is not None and n_host else 0.0)
+
     with make_reader(DATASET_URL, num_epochs=1, workers_count=WORKERS,
                      shuffle_row_groups=False, columnar_decode=True) as reader:
         loader = DeviceInMemDataLoader(reader, batch_size=BATCH,
@@ -404,6 +425,12 @@ def train_stall_legs():
         'step_ms_streaming': round(stream_step_ms, 2),
         'stall_pct_delivery_bound': deliv_stall,
         'step_ms_delivery_bound': round(deliv_step_ms, 2),
+        # images/s the host delivery plane sustains with NO device in the
+        # loop; >= BATCH/floor_ms implies streaming stalls above are
+        # decode- or transport-bound, not loader-bound.
+        'delivery_plane_images_per_sec_host': round(host_plane_rate, 1),
+        'delivery_plane_keeps_chip_fed': bool(
+            host_plane_rate >= 1000.0 * BATCH / floor_ms),
         'stall_pct_decoded_cache': disk_stall,
         'step_ms_decoded_cache': round(disk_step_ms, 2),
         'model_step_tflop': round(flops / 1e12, 4),

@@ -330,6 +330,37 @@ class DataLoader(object):
             return jax.device_put(numeric, self._device)
         return jax.device_put(numeric)
 
+    def iter_host_batches(self):
+        """Yield the host-side numpy batch pytrees WITHOUT device transfer.
+
+        The same batches ``__iter__`` would stage (shuffling, batching,
+        ``transform_fn``, resume all apply) but stopping at the host
+        boundary: for feeding non-JAX consumers, writing derived datasets,
+        or measuring the host delivery plane in isolation (``bench.py``'s
+        ``delivery_plane_images_per_sec_host`` leg uses this to prove the
+        consumer path sustains chip rate independent of the transport).
+
+        Caveat on resume: batches restored from ``resume_state`` were
+        snapshotted AFTER the device-transfer filter, so they carry only
+        numeric fields (string/object columns are gone) — fresh batches
+        that follow carry every field.  Consumers that need non-numeric
+        columns for every row should checkpoint with the prefetch queue
+        drained, or tolerate the narrower leading batches.
+        """
+        # Restored prefetched batches first (already transformed when
+        # snapshotted — do not run the transform twice).
+        if self._resume_state and self._resume_state.get('pending'):
+            restored = self._resume_state['pending']
+            self._resume_state = dict(self._resume_state, pending=[])
+            for host_batch in restored:
+                self.stats['batches'] += 1
+                yield host_batch
+        for host_batch in self._host_batches():
+            if self._transform_fn is not None:
+                host_batch = self._transform_fn(host_batch)
+            self.stats['batches'] += 1
+            yield host_batch
+
     # -- fused multi-step consumption ----------------------------------------
 
     def scan_batches(self, step_fn, carry, steps_per_call=8,
@@ -359,10 +390,15 @@ class DataLoader(object):
 
         Checkpointing composes: batches restored from ``resume_state``
         (prefetched by the previous run) are served first, and every
-        ``yield`` point has an empty fill buffer (each yield follows a
-        flush), so a ``state_dict()`` taken between yields loses nothing —
-        the exact-resume contract survives switching between ``__iter__``
-        and ``scan_batches`` consumption.
+        full-chunk ``yield`` has an empty fill buffer (each yield follows
+        a flush), so a ``state_dict()`` taken between yields loses
+        nothing under the default ``drop_last=True`` — the exact-resume
+        contract survives switching between ``__iter__`` and
+        ``scan_batches`` consumption.  One carve-out: with
+        ``drop_last=False``, the yield forced by the ragged tail batch
+        holds that tail outside the snapshot — checkpointing at exactly
+        that yield (the stream's final flush) drops the tail rows; keep
+        ``drop_last=True`` when mid-stream checkpoints must be exact.
         """
         from jax import lax
 
@@ -817,9 +853,12 @@ class DeviceInMemDataLoader(InMemDataLoader):
             group = list(itertools.islice(orders, epochs_per_call))
             if not group:
                 return
-            if len(group) == 1:
+            if epochs_per_call == 1:
                 carry, outs = fn_one(carry, cache, group[0])
             else:
+                # Always the (E, steps, ...) shape when grouping was
+                # requested — a trailing 1-epoch group must not silently
+                # drop the epochs axis consumers index by.
                 carry, outs = fn_many(carry, cache, jnp.stack(group))
             self.stats['batches'] += steps * len(group)
             yield carry, outs

@@ -138,6 +138,30 @@ def test_reader_reshard_mid_epoch_boundaries(dataset):
     assert total == Counter({i: 3 for i in range(ROWS)})
 
 
+def test_reshard_after_pickle_roundtrip(dataset):
+    """Tokens survive checkpoint serialization (pickle, as orbax stores
+    them) before resharding — the realistic elastic-restart flow."""
+    import pickle
+    readers = _readers(dataset.url, 2, num_epochs=1)
+    consumed, states = [], []
+    for reader in readers:
+        consumed.append(next(iter(reader)))
+        consumed.extend(reader.drain_in_flight())
+        states.append(reader.state_dict())
+        reader.stop()
+        reader.join()
+    states = pickle.loads(pickle.dumps(states))
+    tokens = pickle.loads(pickle.dumps(reshard_reader_states(states, 3)))
+    after = []
+    for m, token in enumerate(tokens):
+        with make_reader(dataset.url, cur_shard=m, shard_count=3,
+                         num_epochs=1, shuffle_row_groups=True, seed=11,
+                         reader_pool_type='dummy', resume_state=token) as r:
+            after.extend(list(r))
+    total = Counter(_ids(consumed)) + Counter(_ids(after))
+    assert total == Counter({i: 1 for i in range(ROWS)})
+
+
 def test_reshard_validation_errors(dataset):
     readers = _readers(dataset.url, 2)
     states = [r.state_dict() for r in readers]

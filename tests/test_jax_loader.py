@@ -316,20 +316,21 @@ def test_device_inmem_scan_epochs_grouped(dataset):
 
     with make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1,
                      shuffle_row_groups=False) as reader:
-        loader = DeviceInMemDataLoader(reader, batch_size=16, num_epochs=5,
+        loader = DeviceInMemDataLoader(reader, batch_size=16, num_epochs=4,
                                        seed=7)
         calls = list(loader.scan_epochs(step, np.int32(0), donate_carry=False,
                                         epochs_per_call=3))
     assert len(calls) == 2
     first_outs = np.asarray(calls[0][1])
     assert first_outs.shape == (3, 4, 16)     # (epochs, steps, batch)
-    assert np.asarray(calls[1][1]).shape == (2, 4, 16)
+    # a trailing 1-epoch group keeps the epochs axis (consumers index it)
+    assert np.asarray(calls[1][1]).shape == (1, 4, 16)
     for epoch_ids in first_outs:
         np.testing.assert_array_equal(np.sort(epoch_ids.ravel()),
                                       np.arange(64))
     # carry counted every step of every epoch
-    assert int(np.asarray(calls[-1][0])) == 5 * 4
-    assert loader.stats['batches'] == 20
+    assert int(np.asarray(calls[-1][0])) == 4 * 4
+    assert loader.stats['batches'] == 16
 
 
 def test_device_inmem_scan_epochs_no_shuffle_order(dataset):
@@ -345,6 +346,20 @@ def test_device_inmem_scan_epochs_no_shuffle_order(dataset):
         (carry, outs), = list(loader.scan_epochs(step, np.int32(0),
                                                  donate_carry=False))
     np.testing.assert_array_equal(np.asarray(outs).ravel(), np.arange(64))
+
+
+def test_iter_host_batches_stops_at_host_boundary(dataset):
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=16)
+        batches = list(loader.iter_host_batches())
+    assert len(batches) == 4
+    ids = np.concatenate([np.asarray(b['id']) for b in batches])
+    np.testing.assert_array_equal(np.sort(ids), np.arange(64))
+    # host numpy, not device arrays; strings still present (no transfer
+    # filter ran)
+    assert not isinstance(batches[0]['id'], jax.Array)
+    assert 'sensor_name' in batches[0]
 
 
 def test_scan_batches_matches_iteration(dataset):
