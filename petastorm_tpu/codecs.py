@@ -31,7 +31,30 @@ __all__ = [
     'NdarrayCodec',
     'CompressedNdarrayCodec',
     'CompressedImageCodec',
+    'resize_image_cell',
 ]
+
+
+def resize_image_cell(arr, h, w):
+    """THE semantic reference for every resize path (``ResizeImages`` row
+    func, columnar fallback, ``decode_resized_into``): cv2.resize
+    INTER_LINEAR, with cv2's dropped trailing 1-channel dim restored.  All
+    python paths call this one function so they stay bit-identical; the
+    native fused path (``pt_decode.cc``) approximates it — within a couple
+    of LSB when it resizes a full decode (<=2x reductions, upscales,
+    no-ops), but diverging by tens of LSB on high-frequency content when
+    the DCT-scaled decode engages (>=4x reductions): scaled decode is
+    anti-aliased where INTER_LINEAR downsampling aliases.  That is a
+    quality difference (arguably in the native path's favor), not noise —
+    documented so nobody expects cross-path bit-equality there."""
+    import cv2
+    if arr is None or not isinstance(arr, np.ndarray) \
+            or arr.shape[:2] == (h, w):
+        return arr
+    out = cv2.resize(arr, (w, h), interpolation=cv2.INTER_LINEAR)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        out = out[:, :, None]  # cv2 drops the 1-channel dim
+    return out
 
 
 class DataframeColumnCodec(object):
@@ -366,6 +389,29 @@ class CompressedImageCodec(DataframeColumnCodec):
         if self._image_codec == '.png':
             return native.png_decode_batch(cells, dst)
         return False
+
+    def decode_batch_into_resized(self, unischema_field, cells, dst):
+        """Fused whole-column decode+resize: JPEGs of ANY source size land
+        as exactly ``dst[i]``-shaped images.  Accuracy vs the cv2
+        fallback: see :func:`resize_image_cell` (bilinear-only regimes
+        agree within a couple of LSB; >=4x reductions use DCT-scaled
+        decode, which is ANTI-ALIASED and diverges by tens of LSB on
+        high-frequency content — a quality difference, not an error).
+        False -> caller resizes per cell with cv2."""
+        from petastorm_tpu import native
+        if self._image_codec in ('.jpg', '.jpeg'):
+            return native.jpeg_decode_resize_batch(cells, dst)
+        return False
+
+    def decode_resized_into(self, unischema_field, value, dst):
+        """Per-cell fallback for the fused path: full decode +
+        :func:`resize_image_cell` into ``dst`` — the semantic reference
+        the native fused path approximates."""
+        arr = resize_image_cell(self.decode(unischema_field, value),
+                                dst.shape[0], dst.shape[1])
+        if arr.ndim == 2 and dst.ndim == 3:
+            arr = arr[:, :, None]
+        np.copyto(dst, arr, casting='same_kind')
 
     def decode_into(self, unischema_field, value, dst):
         import cv2

@@ -79,6 +79,11 @@ def _load():
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
         ctypes.c_int, ctypes.c_void_p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.pt_jpeg_decode_resize_batch.restype = ctypes.c_int
+    lib.pt_jpeg_decode_resize_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.pt_zlib_npy_decompress_batch.restype = ctypes.c_int
     lib.pt_zlib_npy_decompress_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
@@ -199,6 +204,39 @@ def jpeg_decode_batch(cells, dst):
     ptrs, lens, n, keep = marshalled
     rc = lib.pt_jpeg_decode_batch(ptrs, lens, n,
                                   dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
+    del keep
+    return rc == 0
+
+
+def jpeg_decode_resize_batch(cells, dst):
+    """Fused decode+resize: JPEGs of ANY source size -> the (N, H, W, 3) /
+    (N, H, W) uint8 batch, decoded at the coarsest DCT scale covering
+    (H, W) and bilinear-resampled to exactly (H, W).
+
+    Sampling grid matches cv2.resize INTER_LINEAR (half-pixel centers).
+    Accuracy vs the cv2 decode+resize fallback: a couple of LSB when the
+    source decodes full-size (<=2x reductions, upscales, same-size); for
+    >=4x reductions the DCT-scaled decode (what makes huge sources cheap)
+    is anti-aliased where INTER_LINEAR aliases, so textured content
+    diverges by tens of LSB — a documented quality difference, not noise.
+    Same True/False contract as :func:`jpeg_decode_batch`.
+    """
+    lib = get_lib()
+    if lib is None or dst.dtype.kind != 'u' or dst.itemsize != 1 \
+            or not dst.flags['C_CONTIGUOUS']:
+        return False
+    if dst.ndim == 4 and dst.shape[3] in (1, 3):
+        h, w, c = dst.shape[1], dst.shape[2], dst.shape[3]
+    elif dst.ndim == 3:
+        h, w, c = dst.shape[1], dst.shape[2], 1
+    else:
+        return False
+    marshalled = _marshal_cells(cells)
+    if marshalled is None:
+        return False
+    ptrs, lens, n, keep = marshalled
+    rc = lib.pt_jpeg_decode_resize_batch(
+        ptrs, lens, n, dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
     del keep
     return rc == 0
 

@@ -52,7 +52,13 @@ class PyDictReaderWorker(ParquetWorkerBase):
         cache_key = '%s:%d:%d:%s' % (piece.path, piece.row_group, row_drop_partition,
                                      ','.join(sorted(self._a.schema_view.fields)))
         if self._a.columnar_output and self._a.ngram is None:
-            if self._a.transform_spec is None or self._a.transform_spec.func is None:
+            ts = self._a.transform_spec
+            # A declared-resize spec (ResizeImages) fuses into the columnar
+            # decode instead of forcing the per-row path an opaque func does.
+            fusable = ts is not None and getattr(ts, 'columnar_fusable', False)
+            if ts is None or ts.func is None or fusable:
+                if fusable:
+                    cache_key += ':rz%s' % sorted(ts.resize_targets.items())
                 # True columnar decode: no intermediate row dicts at all.
                 columns = self._a.cache.get(
                     cache_key + ':c',
@@ -131,6 +137,13 @@ class PyDictReaderWorker(ParquetWorkerBase):
                     out[key] = col
         return out
 
+    def _resize_target(self, name):
+        """(h, w) for fields a fusable declared-resize transform covers."""
+        ts = self._a.transform_spec
+        if ts is None or not getattr(ts, 'columnar_fusable', False):
+            return None
+        return ts.resize_targets.get(name)
+
     def _decode_columns(self, pf, piece, names):
         if not names:
             return {}
@@ -139,6 +152,29 @@ class PyDictReaderWorker(ParquetWorkerBase):
         for name in names:
             f = self._a.schema.fields.get(name) or self._a.schema_view.fields.get(name)
             column = table.column(name)
+            target = self._resize_target(name) if f is not None else None
+            if target is not None and hasattr(f.codec_or_default,
+                                              'decode_batch_into_resized') \
+                    and column.null_count == 0:
+                # Fused decode+resize: the batch shape comes from the
+                # DECLARED target, so even wildcard-shape (variable-size)
+                # image fields take the preallocated zero-per-row path.
+                shape = f.shape if f.shape is not None else ()
+                channels = tuple(shape[2:]) if len(shape) > 2 else ()
+                if all(s is not None for s in channels):
+                    codec = f.codec_or_default
+                    dst = np.empty((len(column),) + tuple(target) + channels,
+                                   dtype=f.numpy_dtype)
+                    try:
+                        if not codec.decode_batch_into_resized(f, column, dst):
+                            for i, cell in enumerate(column.to_pylist()):
+                                codec.decode_resized_into(f, cell, dst[i])
+                    except Exception as e:
+                        raise DecodeFieldError(
+                            'Failed to decode+resize field %r: %s'
+                            % (name, e)) from e
+                    out[name] = dst
+                    continue
             if f is not None and f.codec is None and not f.nullable:
                 # Native scalar column: vectorized arrow -> numpy.
                 arr = column.to_numpy(zero_copy_only=False)
@@ -178,6 +214,18 @@ class PyDictReaderWorker(ParquetWorkerBase):
             except Exception as e:
                 raise DecodeFieldError('Failed to decode field %r: %s' % (name, e)) from e
             out[name] = _stack_cells_np(decoded)
+        # Declared-resize targets that could NOT fuse (nullable cells,
+        # non-image codecs, object batches): resize post-decode so
+        # ResizeImages semantics hold on every columnar branch.
+        for name in out:
+            target = self._resize_target(name)
+            if target is None:
+                continue
+            batch = out[name]
+            needs = batch.dtype == object or (
+                batch.ndim >= 3 and tuple(batch.shape[1:3]) != tuple(target))
+            if needs:
+                out[name] = _resize_cells(batch, target)
         return out
 
     def _load_rows(self, piece, row_drop_partition):
@@ -248,6 +296,16 @@ class PyDictReaderWorker(ParquetWorkerBase):
         if n <= 1:
             return rows
         return rows[row_drop_partition::n]
+
+
+def _resize_cells(batch, target):
+    """Per-cell resize of a decoded batch (ndarray or object array of
+    variable-size cells) to ``target`` (h, w); the columnar fallback for
+    declared resizes that couldn't fuse natively.  Delegates to the one
+    semantic reference (``codecs.resize_image_cell``)."""
+    from petastorm_tpu.codecs import resize_image_cell
+    h, w = target
+    return _stack_cells_np([resize_image_cell(a, h, w) for a in batch])
 
 
 def _stack_columnar(rows):
