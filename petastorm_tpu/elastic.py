@@ -183,6 +183,60 @@ def reshard_reader_states(states, new_shard_count):
     return out
 
 
+def reshard_weighted_states(states, new_shard_count, seed=None):
+    """Re-shard ``WeightedSamplingReader.state_dict()`` checkpoints.
+
+    Each constituent source's K tokens reshard independently through
+    :func:`reshard_reader_states`; the mixer's draw stream restarts fresh
+    on every new host (seeded ``(seed, shard)`` when ``seed`` is given) —
+    mixing is probabilistic, so the contractual object is the
+    constituent-row multiset, which the resharded tokens preserve exactly
+    as in the single-reader case.  A source stays active if ANY old host
+    still had it active; relative weights are recovered from the old
+    states (every host renormalizes the same original probabilities, so
+    overlapping actives agree on ratios).
+
+    Build each new mixer as ``WeightedSamplingReader(readers, probs,
+    resume_state=result[m])`` where ``readers[j]`` is constructed with
+    ``resume_state=result[m]['constituents'][j]`` and the new shard
+    topology.
+    """
+    if not states:
+        raise ValueError('need at least one mixer state')
+    n_sources = {len(s['constituents']) for s in states}
+    if len(n_sources) != 1:
+        raise ValueError('mixer states disagree on constituent count')
+    n = n_sources.pop()
+    new_constituents = [
+        reshard_reader_states([s['constituents'][j] for s in states],
+                              new_shard_count)
+        for j in range(n)]
+    active = sorted({int(i) for s in states for i in s['active']})
+    # Ratios come from the pre-normalization mixture (identical across
+    # hosts).  Per-host 'weights' are renormalized over that host's own
+    # surviving set, so mixing values from hosts with different survivors
+    # would skew the ratios (order-dependently, even).
+    orig = next((s.get('orig_weights') for s in states
+                 if s.get('orig_weights') is not None), None)
+    if orig is None:
+        raise ValueError(
+            "mixer states lack 'orig_weights' (pre-dating the elastic "
+            'protocol); re-checkpoint with a current '
+            'WeightedSamplingReader before resharding')
+    weights = np.asarray([float(orig[i]) for i in active], np.float64)
+    weights = (weights / weights.sum()).tolist() if len(weights) else []
+    out = []
+    for m in range(new_shard_count):
+        rng = np.random.default_rng(None if seed is None else (seed, m))
+        out.append({
+            'constituents': [new_constituents[j][m] for j in range(n)],
+            'rng_state': rng.bit_generator.state,
+            'weights': weights,
+            'active': list(active),
+        })
+    return out
+
+
 def reshard_loader_states(states, new_shard_count, batched=None):
     """Re-shard ``DataLoader.state_dict()`` checkpoints onto M loaders.
 

@@ -58,9 +58,11 @@ class DataLoader(object):
     def __init__(self, reader, batch_size, shuffling_queue_capacity=0,
                  min_after_retrieve=None, transform_fn=None, drop_last=True,
                  prefetch=2, device=None, sharding=None, seed=None,
-                 resume_state=None):
+                 resume_state=None, echo=1):
         if batch_size <= 0:
             raise ValueError('batch_size must be positive')
+        if echo < 1:
+            raise ValueError('echo must be >= 1')
         self.reader = reader
         self.batch_size = int(batch_size)
         self._shuffle_capacity = shuffling_queue_capacity
@@ -68,6 +70,7 @@ class DataLoader(object):
                                     else shuffling_queue_capacity // 2)
         self._transform_fn = transform_fn
         self._drop_last = drop_last
+        self._echo = int(echo)
         self._prefetch = max(1, int(prefetch))
         self._device = device
         self._sharding = sharding
@@ -119,7 +122,7 @@ class DataLoader(object):
                 self._pending.append(self._to_device(host_batch))
             self._resume_state = dict(self._resume_state, pending=[])
         pending = self._pending
-        batches = self._host_batches()
+        batches = self._echoed_host_batches()
         while True:
             t0 = time.monotonic()
             try:
@@ -148,6 +151,32 @@ class DataLoader(object):
         if self._batched_input:
             return self._columnar_batches()
         return self._row_batches()
+
+    def _echoed_host_batches(self):
+        """Host batches with data echoing: each decoded batch repeats
+        ``echo`` times consecutively (Choi et al., "Faster Neural Network
+        Training with Data Echoing") — when the decode plane, not the
+        chip, is the bottleneck, e echoes cut the required decode rate
+        e-fold; device-side augmentation (``petastorm_tpu.jax.augment``
+        inside the step, fresh rng per step) keeps echoes from being
+        exact repeats.  A mid-echo checkpoint resumes at the batch, not
+        the echo repeat (echo is a schedule over data, not data).
+
+        Echo repeats are shallow dict copies, so a ``transform_fn`` that
+        REBINDS keys is applied freshly per echo (host augmentation
+        varies across echoes).  Transforms must not mutate input arrays
+        in place — with echo the same arrays are visible to every
+        repeat, so in-place mutation would compound."""
+        if self._echo <= 1:
+            return self._host_batches()
+
+        def gen():
+            for host_batch in self._host_batches():
+                yield host_batch
+                for _ in range(self._echo - 1):
+                    yield dict(host_batch) if isinstance(host_batch, dict) \
+                        else host_batch
+        return gen()
 
     def _source(self, convert):
         """Pushback (restored/drained) items first, then converted reader
@@ -355,7 +384,7 @@ class DataLoader(object):
             for host_batch in restored:
                 self.stats['batches'] += 1
                 yield host_batch
-        for host_batch in self._host_batches():
+        for host_batch in self._echoed_host_batches():
             if self._transform_fn is not None:
                 host_batch = self._transform_fn(host_batch)
             self.stats['batches'] += 1
@@ -440,7 +469,7 @@ class DataLoader(object):
                 yield carry, outs
 
         chunk = []
-        for host_batch in self._host_batches():
+        for host_batch in self._echoed_host_batches():
             if chunk and rows_of(host_batch) != rows_of(chunk[0]):
                 # ragged tail (drop_last=False): flush so stacking stays
                 # rectangular — the tail becomes its own (shorter) chunk
@@ -608,6 +637,14 @@ class InMemDataLoader(DataLoader):
                  seed=None, **kwargs):
         if getattr(reader, 'ngram', None) is not None:
             raise ValueError('InMemDataLoader does not support NGram readers')
+        if kwargs.get('echo', 1) != 1:
+            # Epochs serve from the cache — nothing decodes per step, so
+            # echo would just duplicate cached batches silently.  (Covers
+            # DeviceInMemDataLoader too; echo addresses decode-bound
+            # STREAMING, where DataLoader and DiskCachedDataLoader keep it.)
+            raise ValueError('%s does not support echo (epochs serve from '
+                             'an in-memory cache; echo addresses '
+                             'decode-bound streaming)' % type(self).__name__)
         reader_epochs = getattr(reader, 'num_epochs', 1)
         if reader_epochs != 1:
             # num_epochs=None (infinite) would hang the one-time cache build

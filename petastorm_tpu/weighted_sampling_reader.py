@@ -131,6 +131,11 @@ class WeightedSamplingReader(object):
             'constituents': [r.state_dict() for r in self._all_readers],
             'rng_state': self._rng.bit_generator.state,
             'weights': self._weights.tolist(),
+            # The pre-normalization mixture (identical on every host):
+            # elastic resharding recovers ratios from THIS, because the
+            # renormalized 'weights' of hosts with different surviving
+            # sets are not mutually comparable.
+            'orig_weights': self._orig_weights.tolist(),
             'active': [i for i, r in enumerate(self._all_readers)
                        if r in self._readers],
         }

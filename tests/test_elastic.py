@@ -301,6 +301,80 @@ def test_reshard_exhausted_states(dataset):
     assert _ids(leftover) == []
 
 
+def test_weighted_mixer_reshard(dataset, tmp_path_factory):
+    """WeightedSamplingReader checkpoints reshard: each source's tokens
+    independently, mixer draw stream restarted — combined multiset over
+    both sources is exact."""
+    from petastorm_tpu.elastic import reshard_weighted_states
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    small_url = 'file://' + str(tmp_path_factory.mktemp('elasticmix'))
+    create_test_dataset(small_url, num_rows=20, rows_per_rowgroup=5)
+
+    def sources(shard, count, tokens=None):
+        kw = dict(num_epochs=1, shuffle_row_groups=True, seed=3,
+                  reader_pool_type='dummy')
+        return [make_reader(dataset.url, cur_shard=shard, shard_count=count,
+                            resume_state=tokens[0] if tokens else None, **kw),
+                make_reader(small_url, cur_shard=shard, shard_count=count,
+                            resume_state=tokens[1] if tokens else None, **kw)]
+
+    consumed, states = [], []
+    for s in range(2):
+        mixer = WeightedSamplingReader(sources(s, 2), [0.7, 0.3], seed=s,
+                                       exhaust='drop')
+        for _ in range(5):
+            consumed.append(next(mixer))
+        consumed.extend(mixer.drain_in_flight())
+        states.append(mixer.state_dict())
+        mixer.stop()
+        mixer.join()
+
+    new_states = reshard_weighted_states(states, 3, seed=9)
+    for m in range(3):
+        tokens = new_states[m]['constituents']
+        mixer = WeightedSamplingReader(sources(m, 3, tokens), [0.7, 0.3],
+                                       exhaust='drop',
+                                       resume_state=new_states[m])
+        consumed.extend(list(mixer))
+        mixer.stop()
+        mixer.join()
+
+    total = Counter(_ids(consumed))
+    # ROWS=60 rows once from the big source + 20 ids twice (both sources
+    # contribute ids 0..19)
+    expected = Counter({i: (2 if i < 20 else 1) for i in range(ROWS)})
+    assert total == expected
+
+
+def test_weighted_reshard_weights_order_independent(dataset):
+    """Hosts with different surviving sets renormalize differently; the
+    resharded mixture must come from the shared original probabilities,
+    identical for any input order."""
+    from petastorm_tpu.elastic import reshard_weighted_states
+
+    def token(shard):
+        readers = _readers(dataset.url, 2, num_epochs=1)
+        states = [r.state_dict() for r in readers]
+        for r in readers:
+            r.stop()
+            r.join()
+        return states[shard]
+
+    host_a = {'constituents': [token(0), token(0)],
+              'rng_state': np.random.default_rng(0).bit_generator.state,
+              'weights': [1.0], 'orig_weights': [0.7, 0.3], 'active': [0]}
+    host_b = {'constituents': [token(1), token(1)],
+              'rng_state': np.random.default_rng(1).bit_generator.state,
+              'weights': [0.7, 0.3], 'orig_weights': [0.7, 0.3],
+              'active': [0, 1]}
+    for order in ([host_a, host_b], [host_b, host_a]):
+        out = reshard_weighted_states(order, 2, seed=5)
+        for s in out:
+            assert s['active'] == [0, 1]
+            np.testing.assert_allclose(s['weights'], [0.7, 0.3])
+
+
 @pytest.mark.parametrize('pool', ['dummy', 'thread'])
 def test_loader_reshard_exact(dataset, pool):
     """DataLoader states (drained by construction) reshard exactly: rows

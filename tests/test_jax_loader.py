@@ -348,6 +348,36 @@ def test_device_inmem_scan_epochs_no_shuffle_order(dataset):
     np.testing.assert_array_equal(np.asarray(outs).ravel(), np.arange(64))
 
 
+def test_echo_repeats_batches(dataset):
+    """echo=2: every decoded batch is served twice consecutively (data
+    echoing for decode-bound pipelines); works through __iter__ and
+    scan_batches alike."""
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=16, echo=2)
+        ids = [np.asarray(b['id']) for b in loader]
+    assert len(ids) == 8  # 4 batches x 2 echoes
+    for i in range(0, 8, 2):
+        np.testing.assert_array_equal(ids[i], ids[i + 1])
+    all_ids = np.concatenate(ids)
+    assert sorted(set(all_ids.tolist())) == list(range(64))
+
+    def step(carry, batch):
+        return carry + 1, batch['id']
+
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=16, echo=3)
+        chunks = list(loader.scan_batches(step, np.int32(0),
+                                          steps_per_call=6,
+                                          donate_carry=False))
+    assert int(np.asarray(chunks[-1][0])) == 12  # 4 batches x 3 echoes
+    with pytest.raises(ValueError, match='echo'):
+        with make_reader(dataset.url, reader_pool_type='dummy') as reader:
+            from petastorm_tpu.jax import DeviceInMemDataLoader
+            DeviceInMemDataLoader(reader, batch_size=16, echo=2)
+
+
 def test_iter_host_batches_stops_at_host_boundary(dataset):
     with make_reader(dataset.url, reader_pool_type='dummy',
                      shuffle_row_groups=False) as reader:
