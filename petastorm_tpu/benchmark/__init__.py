@@ -1,4 +1,6 @@
-"""Measurement harnesses: reader throughput + training data-stall profiling."""
+"""Measurement harnesses: reader throughput, training data-stall profiling,
+and the bottleneck advisor."""
 
+from petastorm_tpu.benchmark.advisor import diagnose, format_report  # noqa: F401
 from petastorm_tpu.benchmark.stall_profiler import StallMonitor  # noqa: F401
 from petastorm_tpu.benchmark.throughput import BenchmarkResult, reader_throughput  # noqa: F401

@@ -232,6 +232,9 @@ def reshard_weighted_states(states, new_shard_count, seed=None):
             'constituents': [new_constituents[j][m] for j in range(n)],
             'rng_state': rng.bit_generator.state,
             'weights': weights,
+            # keep the output closed under re-resharding (another topology
+            # change before training resumes is legal)
+            'orig_weights': [float(v) for v in orig],
             'active': list(active),
         })
     return out

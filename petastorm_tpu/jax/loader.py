@@ -162,20 +162,25 @@ class DataLoader(object):
         exact repeats.  A mid-echo checkpoint resumes at the batch, not
         the echo repeat (echo is a schedule over data, not data).
 
-        Echo repeats are shallow dict copies, so a ``transform_fn`` that
-        REBINDS keys is applied freshly per echo (host augmentation
+        Echo repeats are dict-level-recursive copies, so a ``transform_fn``
+        that REBINDS keys (at any nesting level — ngram batches are
+        dict-of-dicts) is applied freshly per echo (host augmentation
         varies across echoes).  Transforms must not mutate input arrays
         in place — with echo the same arrays are visible to every
         repeat, so in-place mutation would compound."""
         if self._echo <= 1:
             return self._host_batches()
 
+        def copy_tree(node):
+            if isinstance(node, dict):
+                return {k: copy_tree(v) for k, v in node.items()}
+            return node
+
         def gen():
             for host_batch in self._host_batches():
                 yield host_batch
                 for _ in range(self._echo - 1):
-                    yield dict(host_batch) if isinstance(host_batch, dict) \
-                        else host_batch
+                    yield copy_tree(host_batch)
         return gen()
 
     def _source(self, convert):

@@ -373,6 +373,11 @@ def test_weighted_reshard_weights_order_independent(dataset):
         for s in out:
             assert s['active'] == [0, 1]
             np.testing.assert_allclose(s['weights'], [0.7, 0.3])
+        # closed under re-resharding (a second topology change before any
+        # training resumed is legal)
+        again = reshard_weighted_states(out, 3, seed=6)
+        assert len(again) == 3
+        np.testing.assert_allclose(again[0]['weights'], [0.7, 0.3])
 
 
 @pytest.mark.parametrize('pool', ['dummy', 'thread'])

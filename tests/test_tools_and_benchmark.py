@@ -135,3 +135,78 @@ def test_dlrm_forward_shapes():
                                jnp.ones((4, 3), jnp.int32))
     assert out.shape == (4,)
     assert np.isfinite(np.asarray(out)).all()
+
+
+class _FakeMonitor:
+    def __init__(self, stall_pct, steps=10, step_s=1.0):
+        self._r = {'stall_pct': stall_pct, 'steps': steps, 'step_s': step_s,
+                   'data_wait_s': 0.0}
+
+    def report(self):
+        return dict(self._r)
+
+
+class _FakeLoader:
+    def __init__(self, host=0.0, transform=0.0, put=0.0, batches=10,
+                 decode_util=None):
+        self.stats = {'host_batch_s': host, 'transform_s': transform,
+                      'device_put_s': put, 'batches': batches}
+        if decode_util is None:
+            self.reader = None
+        else:
+            class _R:
+                diagnostics = {'decode_utilization': decode_util,
+                               'pool': 'thread'}
+            self.reader = _R()
+
+
+def test_advisor_regimes():
+    from petastorm_tpu.benchmark import diagnose, format_report
+
+    healthy = diagnose(_FakeLoader(host=0.1), _FakeMonitor(1.2))
+    assert healthy['regime'] == 'chip_bound'
+
+    decode = diagnose(_FakeLoader(host=5.0, put=0.2, decode_util=0.95),
+                      _FakeMonitor(60.0))
+    assert decode['regime'] == 'decode_bound'
+    assert any('ResizeImages' in s for s in decode['suggestions'])
+
+    io = diagnose(_FakeLoader(host=5.0, put=0.2, decode_util=0.2),
+                  _FakeMonitor(60.0))
+    assert io['regime'] == 'io_bound'
+    assert any('workers_count' in s for s in io['suggestions'])
+
+    transform = diagnose(_FakeLoader(host=0.5, transform=4.0, put=0.2),
+                         _FakeMonitor(40.0))
+    assert transform['regime'] == 'transform_bound'
+
+    transport = diagnose(_FakeLoader(host=0.5, put=6.0), _FakeMonitor(50.0))
+    assert transport['regime'] == 'transport_bound'
+    assert any('scan_batches' in s for s in transport['suggestions'])
+
+    empty = diagnose(_FakeLoader(batches=0))
+    assert empty['regime'] == 'unknown'
+    assert 'pipeline regime' in format_report(transport)
+
+
+def test_advisor_on_live_loader(tmp_path):
+    """End to end: iterate a real loader under a StallMonitor, diagnose."""
+    import numpy as np
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.benchmark import StallMonitor, diagnose
+    from petastorm_tpu.jax import DataLoader
+    from test_common import create_test_dataset
+
+    create_test_dataset('file://' + str(tmp_path / 'adv'), num_rows=40,
+                        rows_per_rowgroup=8)
+    monitor = StallMonitor(warmup_steps=1)
+    with make_reader('file://' + str(tmp_path / 'adv'),
+                     reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=8)
+        for batch in monitor.wrap(loader):
+            np.asarray(batch['id']).sum()
+        result = diagnose(loader, monitor)
+    assert result['regime'] in ('chip_bound', 'decode_bound', 'io_bound',
+                                'transport_bound', 'transform_bound')
+    assert result['evidence']['batches'] == 5
