@@ -174,6 +174,9 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
     report = monitor.report()
     print('steps=%d loss=%.3f images/s=%.1f stall=%.2f%%'
           % (done, float(loss), done * batch_size / dt, report['stall_pct']))
+    # Name the bottleneck regime and what to do about it (benchmark.diagnose)
+    from petastorm_tpu.benchmark import diagnose, format_report
+    print(format_report(diagnose(loader, monitor)))
     return report
 
 

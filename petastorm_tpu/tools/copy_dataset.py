@@ -15,12 +15,19 @@ from petastorm_tpu.unischema import Unischema
 
 def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
                  overwrite_output=False, partitions_count=None, row_group_size_mb=None,
-                 rows_per_rowgroup=None, predicate=None, storage_options=None):
+                 rows_per_rowgroup=None, predicate=None, storage_options=None,
+                 resize=None):
     """Stream rows from ``source_url`` into a fresh dataset at ``target_url``.
 
     ``field_regex``: keep only matching columns. ``not_null_fields``: drop
-    rows with nulls in these fields. ``partitions_count`` is accepted for
-    signature parity (Spark partition count) and maps to ``rows_per_file``.
+    rows with nulls in these fields. ``partitions_count`` (signature
+    parity: the Spark output-partition count) maps to ``rows_per_file`` ≈
+    source_rows / partitions_count — approximate when a predicate or
+    ``not_null_fields`` drops rows.
+    ``resize``: ``{field: (h, w)}`` re-encodes the named image fields at a
+    new resolution during the copy (``transform.ResizeImages`` — the
+    store-once-at-training-resolution ETL step; the copied schema records
+    the fixed shape, so readers of the copy get static-shape batches).
     """
     from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
     fs, target_path = get_filesystem_and_path_or_paths(target_url,
@@ -37,6 +44,20 @@ def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
         schema = stored_schema
     schema = Unischema(stored_schema.name, list(schema.fields.values()))
 
+    transform_spec = None
+    if resize:
+        from petastorm_tpu.transform import ResizeImages, transform_schema
+        missing = set(resize) - set(schema.fields)
+        if missing:
+            raise ValueError('resize fields %s not in copied schema'
+                             % sorted(missing))
+        if any(h <= 0 or w <= 0 for h, w in resize.values()):
+            raise ValueError('resize dimensions must be positive, got %r'
+                             % (resize,))
+        transform_spec = ResizeImages(resize)
+        schema = Unischema(schema.name, list(
+            transform_schema(schema, transform_spec).fields.values()))
+
     not_null_fields = set(not_null_fields or [])
     missing = not_null_fields - set(schema.fields)
     if missing:
@@ -52,15 +73,26 @@ def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
     copied = 0
     with make_reader(source_url, schema_fields=list(schema.fields), predicate=predicate,
                      shuffle_row_groups=False, num_epochs=1,
-                     storage_options=storage_options) as reader, \
-            DatasetWriter(target_url, schema, rows_per_file=rows_per_file,
-                          storage_options=storage_options, **writer_kwargs) as writer:
-        for row in reader:
-            row_dict = row._asdict()
-            if not_null_fields and any(row_dict.get(f) is None for f in not_null_fields):
-                continue
-            writer.write(row_dict)
-            copied += 1
+                     transform_spec=transform_spec,
+                     storage_options=storage_options) as reader:
+        if partitions_count:
+            # Spark-parity knob: N output partitions ~= N files.  Row count
+            # comes from the source footers; approximate when predicate /
+            # not_null_fields drop rows.  Files roll at row-group flushes,
+            # so row groups must not exceed the per-file budget (unless the
+            # caller pinned them explicitly).
+            rows_per_file = max(1, -(-reader.num_local_rows() // partitions_count))
+            if not writer_kwargs:
+                writer_kwargs['rows_per_rowgroup'] = rows_per_file
+        with DatasetWriter(target_url, schema, rows_per_file=rows_per_file,
+                           storage_options=storage_options, **writer_kwargs) as writer:
+            for row in reader:
+                row_dict = row._asdict()
+                if not_null_fields and any(row_dict.get(f) is None
+                                           for f in not_null_fields):
+                    continue
+                writer.write(row_dict)
+                copied += 1
     return copied
 
 
@@ -75,12 +107,30 @@ def main(argv=None):
     parser.add_argument('--overwrite-output', action='store_true')
     parser.add_argument('--rows-per-rowgroup', type=int, default=None)
     parser.add_argument('--row-group-size-mb', type=int, default=None)
+    parser.add_argument('--resize', nargs='*', default=None,
+                        metavar='FIELD=HxW',
+                        help='Re-encode image fields at a new resolution '
+                             "during the copy (e.g. --resize image=224x224)")
     args = parser.parse_args(argv)
+    resize = None
+    if args.resize:
+        resize = {}
+        for spec in args.resize:
+            try:
+                field, hw = spec.split('=', 1)
+                h, w = hw.lower().split('x')
+                resize[field] = (int(h), int(w))
+                if resize[field][0] <= 0 or resize[field][1] <= 0:
+                    raise ValueError(spec)
+            except ValueError:
+                parser.error('--resize expects FIELD=HxW with positive '
+                             'dims, got %r' % (spec,))
     n = copy_dataset(args.source_url, args.target_url, field_regex=args.field_regex,
                      not_null_fields=args.not_null_fields,
                      overwrite_output=args.overwrite_output,
                      rows_per_rowgroup=args.rows_per_rowgroup,
-                     row_group_size_mb=args.row_group_size_mb)
+                     row_group_size_mb=args.row_group_size_mb,
+                     resize=resize)
     print('Copied %d rows to %s' % (n, args.target_url))
 
 

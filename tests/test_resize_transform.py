@@ -153,6 +153,20 @@ def test_resize_images_on_batch_reader_dataframe_path(jpeg_dataset):
     assert out['image'][0].shape == TARGET + (3,)
 
 
+def test_resize_survives_process_pool(jpeg_dataset):
+    """ResizeImages pickles into ZeroMQ pool children (bound-method func +
+    self-cycle) and fuses there too."""
+    spec = ResizeImages({'image': TARGET})
+    with make_reader(jpeg_dataset, transform_spec=spec, columnar_decode=True,
+                     shuffle_row_groups=False, reader_pool_type='process',
+                     workers_count=2) as reader:
+        total = 0
+        for batch in reader:
+            assert batch.image.shape[1:] == TARGET + (3,)
+            total += batch.image.shape[0]
+    assert total == ROWS
+
+
 def test_transform_schema_derivation(jpeg_dataset):
     schema = Unischema('S', [
         UnischemaField('image', np.uint8, (None, None, 3),
@@ -164,3 +178,36 @@ def test_transform_schema_derivation(jpeg_dataset):
                                                  'gray': (32, 32)}))
     assert out.fields['image'].shape == (64, 48, 3)
     assert out.fields['gray'].shape == (32, 32)
+
+
+def test_copy_dataset_with_resize(jpeg_dataset, tmp_path):
+    """petastorm-copy-dataset --resize: re-encode variable-size images at a
+    fixed training resolution; the copy's schema records the static shape."""
+    from petastorm_tpu.tools.copy_dataset import copy_dataset
+
+    target = 'file://' + str(tmp_path / 'resized_copy')
+    n = copy_dataset(jpeg_dataset, target, resize={'image': TARGET})
+    assert n == ROWS
+    with make_reader(target, shuffle_row_groups=False,
+                     reader_pool_type='dummy') as reader:
+        assert reader.schema.fields['image'].shape == TARGET + (3,)
+        rows = list(reader)
+    assert len(rows) == ROWS
+    for r in rows:
+        assert r.image.shape == TARGET + (3,)
+    with pytest.raises(ValueError, match='resize fields'):
+        copy_dataset(jpeg_dataset, 'file://' + str(tmp_path / 'x'),
+                     resize={'nope': (4, 4)})
+
+
+def test_copy_dataset_partitions_count(jpeg_dataset, tmp_path):
+    """partitions_count (Spark parity) maps to ~N output files."""
+    import glob
+    from petastorm_tpu.tools.copy_dataset import copy_dataset
+
+    target_dir = tmp_path / 'parts'
+    n = copy_dataset(jpeg_dataset, 'file://' + str(target_dir),
+                     partitions_count=3)
+    assert n == ROWS
+    files = glob.glob(str(target_dir / '*.parquet'))
+    assert len(files) == 3
