@@ -328,6 +328,19 @@ def train_stall_legs():
         loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
         stream_stall, stream_step_ms = _run_stall(loader, state, TRAIN_STEPS,
                                                   floor_ms)
+        # The advisor's verdict on the streaming leg goes into the
+        # artifact: WHICH regime caused whatever stall was measured.  The
+        # bare stage-balance diagnosis can't see the chip side, so gate it
+        # on the stall this leg just measured (a healthy leg IS chip_bound
+        # regardless of which host stage dominates its tiny host time).
+        from petastorm_tpu.benchmark import HEALTHY_STALL_PCT, diagnose
+        if stream_stall <= HEALTHY_STALL_PCT:
+            streaming_diag = {'regime': 'chip_bound',
+                              'evidence': {'stall_pct': stream_stall}}
+        else:
+            diag = diagnose(loader)
+            streaming_diag = {'regime': diag['regime'],
+                              'evidence': diag['evidence']}
 
     ensure_raw_dataset()
     with make_reader(RAW_DATASET_URL, num_epochs=epochs, workers_count=WORKERS,
@@ -423,6 +436,7 @@ def train_stall_legs():
         'device_step_ms': round(floor_ms, 2),
         'stall_pct_streaming': stream_stall,
         'step_ms_streaming': round(stream_step_ms, 2),
+        'streaming_diagnosis': streaming_diag,
         'stall_pct_delivery_bound': deliv_stall,
         'step_ms_delivery_bound': round(deliv_step_ms, 2),
         # images/s the host delivery plane sustains with NO device in the

@@ -391,3 +391,39 @@ def test_pack_stream_dtype_is_sticky_across_batches():
     assert batches[0]['tokens'].dtype == np.int32
     assert all(b['tokens'].dtype == np.int64 for b in batches[1:]), \
         [b['tokens'].dtype for b in batches]
+
+
+def test_packed_loader_scan_batches(tmp_path):
+    """PackedDataLoader composes with the fused scan driver: packed
+    variable-length batches stream through one dispatch per k steps."""
+    import numpy as np
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.jax import PackedDataLoader
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    url = 'file://' + str(tmp_path / 'packscan')
+    schema = Unischema('Docs', [
+        UnischemaField('tokens', np.int32, (None,), NdarrayCodec(), False)])
+    rng = np.random.default_rng(0)
+    total_tokens = 0
+    with DatasetWriter(url, schema, rows_per_rowgroup=8) as w:
+        for _ in range(48):
+            tokens = np.arange(1, 1 + rng.integers(4, 30), dtype=np.int32)
+            total_tokens += len(tokens)
+            w.write({'tokens': tokens})
+
+    def step(carry, batch):
+        real = (batch['segment_ids'] > 0).sum()
+        return carry + real, batch['tokens'].max()
+
+    with make_reader(url, shuffle_row_groups=False,
+                     reader_pool_type='dummy') as reader:
+        loader = PackedDataLoader(reader, 'tokens', max_len=64,
+                                  rows_per_batch=4, drop_last=False)
+        carry = np.int32(0)
+        for carry, _ in loader.scan_batches(step, carry, steps_per_call=2,
+                                            donate_carry=False):
+            pass
+    assert int(np.asarray(carry)) == total_tokens  # every token packed once
