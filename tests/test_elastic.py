@@ -301,6 +301,53 @@ def test_reshard_exhausted_states(dataset):
     assert _ids(leftover) == []
 
 
+def test_reshard_with_rowgroup_selector(tmp_path_factory):
+    """Global piece indices refer to the post-selector list; resharding
+    with the SAME selector reproduces the remaining work exactly."""
+    from petastorm_tpu.etl.rowgroup_indexers import SingleFieldIndexer
+    from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index
+    from petastorm_tpu.selectors import SingleIndexSelector
+
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from test_common import TestSchema, make_test_rows
+
+    url = 'file://' + str(tmp_path_factory.mktemp('elasticsel'))
+    rows = make_test_rows(60)
+    for i, row in enumerate(rows):
+        row['id2'] = np.int32(i // 5 % 3)  # constant per 5-row group
+    with DatasetWriter(url, TestSchema, rows_per_rowgroup=5) as w:
+        w.write_many(rows)
+    build_rowgroup_index(url, indexers=[SingleFieldIndexer('id2_idx', 'id2')])
+    selector = SingleIndexSelector('id2_idx', [0, 1])  # prunes id2==2 groups
+
+    def rd(shard, count, token=None):
+        return make_reader(url, cur_shard=shard, shard_count=count,
+                           rowgroup_selector=selector, num_epochs=1,
+                           shuffle_row_groups=True, seed=4,
+                           reader_pool_type='dummy', resume_state=token)
+
+    # ground truth: rows in row groups containing any id2 in {0, 1}
+    with make_reader(url, rowgroup_selector=selector, num_epochs=1,
+                     shuffle_row_groups=False,
+                     reader_pool_type='dummy') as r:
+        truth = Counter(_ids(list(r)))
+    assert truth and sum(truth.values()) < 60  # the selector really pruned
+
+    consumed, states = [], []
+    for s in range(2):
+        reader = rd(s, 2)
+        consumed.append(next(iter(reader)))
+        consumed.extend(reader.drain_in_flight())
+        states.append(reader.state_dict())
+        reader.stop()
+        reader.join()
+    tokens = reshard_reader_states(states, 3)
+    for m, token in enumerate(tokens):
+        with rd(m, 3, token) as reader:
+            consumed.extend(list(reader))
+    assert Counter(_ids(consumed)) == truth
+
+
 def test_weighted_mixer_reshard(dataset, tmp_path_factory):
     """WeightedSamplingReader checkpoints reshard: each source's tokens
     independently, mixer draw stream restarted — combined multiset over
