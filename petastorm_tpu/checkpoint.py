@@ -1,0 +1,76 @@
+"""One-call train-state checkpointing: model pytree + data-plane state.
+
+SURVEY.md §5.4's build obligation is that the input pipeline checkpoints
+*alongside* orbax model state.  The tokens themselves are plain picklable
+dicts (``Reader.state_dict`` / ``DataLoader.state_dict`` /
+``WeightedSamplingReader.state_dict`` — and the elastic reshard outputs),
+but they mix numpy arrays, rng ``bit_generator`` states, and python
+scalars, which a pytree checkpointer won't round-trip leaf-for-leaf.
+These helpers pin the working recipe: the model state rides as a normal
+orbax pytree (sharded arrays restore as such), the data-plane state rides
+as one pickled-bytes leaf.
+
+    from petastorm_tpu import checkpoint as pt_ckpt
+
+    pt_ckpt.save_train_state(path, {'params': params, 'opt': opt_state},
+                             data_state=loader.state_dict())
+    ...
+    model, data_state = pt_ckpt.restore_train_state(path)
+    reader = make_reader(url, ..., resume_state=data_state['reader'])
+    loader = DataLoader(reader, B, resume_state=data_state)
+
+Multi-host: tokens are PER HOST — save each host's ``data_state`` under
+its own directory (e.g. ``f'{path}/host_{jax.process_index()}'``) or
+gather all hosts' tokens first and save the list from process 0; the
+elastic reshard functions consume exactly such a list
+(``docs/deployment.md`` §4).  Pass ``checkpointer=ocp.AsyncCheckpointer(
+ocp.PyTreeCheckpointHandler())`` for async saves (call ``wait_until_
+finished()`` before relying on the files).
+"""
+
+import pickle
+
+import numpy as np
+
+__all__ = ['save_train_state', 'restore_train_state']
+
+_DATA_KEY = 'petastorm_tpu_data_state'
+
+
+def _default_checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save_train_state(path, model_state, data_state=None, checkpointer=None):
+    """Save ``model_state`` (any orbax-compatible pytree) plus the data
+    plane's resume state (any picklable token structure) in one checkpoint.
+
+    ``data_state`` accepts whatever the framework's ``state_dict`` methods
+    produce — reader tokens, exact loader snapshots, weighted-mixer states,
+    elastic reshard outputs, or a dict/list combining several.
+    """
+    payload = dict(model_state) if isinstance(model_state, dict) \
+        else {'model': model_state}
+    if _DATA_KEY in payload:
+        raise ValueError('model_state already uses the reserved key %r'
+                         % _DATA_KEY)
+    if data_state is not None:
+        blob = np.frombuffer(pickle.dumps(data_state), np.uint8).copy()
+        payload[_DATA_KEY] = blob
+    (checkpointer or _default_checkpointer()).save(str(path), payload)
+
+
+def restore_train_state(path, checkpointer=None):
+    """Returns ``(model_state, data_state)``; ``data_state`` is None when
+    the checkpoint was saved without one.  ``model_state`` comes back with
+    the same top-level structure it was saved with (a dict stays a dict;
+    a non-dict pytree comes back under its original structure)."""
+    restored = (checkpointer or _default_checkpointer()).restore(str(path))
+    data_state = None
+    blob = restored.pop(_DATA_KEY, None)
+    if blob is not None:
+        data_state = pickle.loads(np.asarray(blob, np.uint8).tobytes())
+    if set(restored) == {'model'}:
+        return restored['model'], data_state
+    return restored, data_state

@@ -124,3 +124,46 @@ def test_resume_across_real_processes(tmp_path):
     assert rest[-len(expected_rest):] == expected_rest
     replay = rest[:len(rest) - len(expected_rest)]
     assert set(replay) <= set(seen)
+
+
+def test_save_restore_train_state_helper(tmp_path):
+    """checkpoint.save_train_state: model pytree + EXACT loader snapshot in
+    one call; restore resumes the stream precisely."""
+    ocp = pytest.importorskip('orbax.checkpoint')  # noqa: F841
+    from collections import Counter
+
+    from petastorm_tpu import checkpoint as pt_ckpt
+    from petastorm_tpu.jax import DataLoader
+
+    ds = create_test_dataset('file://' + str(tmp_path / 'ds2'), num_rows=48,
+                             rows_per_rowgroup=6)
+    reader = make_reader(ds.url, reader_pool_type='dummy', num_epochs=1,
+                         shuffle_row_groups=True, seed=5)
+    params = {'w': jnp.full((3,), 2.0), 'step': jnp.int32(7)}
+    with DataLoader(reader, batch_size=6, prefetch=1) as loader:
+        it = iter(loader)
+        seen = [int(i) for i in np.asarray(next(it)['id'])]
+        pt_ckpt.save_train_state(tmp_path / 'ckpt2', params,
+                                 data_state=loader.state_dict())
+
+    model, data_state = pt_ckpt.restore_train_state(tmp_path / 'ckpt2')
+    np.testing.assert_array_equal(model['w'], params['w'])
+    assert int(model['step']) == 7
+    reader = make_reader(ds.url, reader_pool_type='dummy', num_epochs=1,
+                         shuffle_row_groups=True, seed=5,
+                         resume_state=data_state['reader'])
+    with DataLoader(reader, batch_size=6, prefetch=1,
+                    resume_state=data_state) as resumed:
+        for batch in resumed:
+            seen.extend(int(i) for i in np.asarray(batch['id']))
+    assert Counter(seen) == Counter({i: 1 for i in range(48)})
+
+
+def test_save_restore_without_data_state(tmp_path):
+    pytest.importorskip('orbax.checkpoint')
+    from petastorm_tpu import checkpoint as pt_ckpt
+    params = {'a': jnp.arange(4)}
+    pt_ckpt.save_train_state(tmp_path / 'ckpt3', params)
+    model, data_state = pt_ckpt.restore_train_state(tmp_path / 'ckpt3')
+    np.testing.assert_array_equal(model['a'], np.arange(4))
+    assert data_state is None
