@@ -35,6 +35,7 @@ import numpy as np
 __all__ = ['save_train_state', 'restore_train_state']
 
 _DATA_KEY = 'petastorm_tpu_data_state'
+_WRAP_KEY = 'petastorm_tpu_wrapped_model'
 
 
 def _default_checkpointer():
@@ -50,11 +51,17 @@ def save_train_state(path, model_state, data_state=None, checkpointer=None):
     produce — reader tokens, exact loader snapshots, weighted-mixer states,
     elastic reshard outputs, or a dict/list combining several.
     """
-    payload = dict(model_state) if isinstance(model_state, dict) \
-        else {'model': model_state}
-    if _DATA_KEY in payload:
-        raise ValueError('model_state already uses the reserved key %r'
-                         % _DATA_KEY)
+    # Non-dict pytrees wrap under a RESERVED sentinel key so restore can
+    # unwrap unambiguously — inferring from ordinary key names would
+    # mangle a user dict that happens to use them (e.g. {'model': ...}).
+    if isinstance(model_state, dict):
+        clash = {_DATA_KEY, _WRAP_KEY} & set(model_state)
+        if clash:
+            raise ValueError('model_state uses reserved key(s) %s'
+                             % sorted(clash))
+        payload = dict(model_state)
+    else:
+        payload = {_WRAP_KEY: model_state}
     if data_state is not None:
         blob = np.frombuffer(pickle.dumps(data_state), np.uint8).copy()
         payload[_DATA_KEY] = blob
@@ -71,6 +78,6 @@ def restore_train_state(path, checkpointer=None):
     blob = restored.pop(_DATA_KEY, None)
     if blob is not None:
         data_state = pickle.loads(np.asarray(blob, np.uint8).tobytes())
-    if set(restored) == {'model'}:
-        return restored['model'], data_state
+    if set(restored) == {_WRAP_KEY}:
+        return restored[_WRAP_KEY], data_state
     return restored, data_state

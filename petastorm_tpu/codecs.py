@@ -401,6 +401,8 @@ class CompressedImageCodec(DataframeColumnCodec):
         from petastorm_tpu import native
         if self._image_codec in ('.jpg', '.jpeg'):
             return native.jpeg_decode_resize_batch(cells, dst)
+        if self._image_codec == '.png':
+            return native.png_decode_resize_batch(cells, dst)
         return False
 
     def decode_resized_into(self, unischema_field, value, dst):

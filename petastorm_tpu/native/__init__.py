@@ -84,6 +84,11 @@ def _load():
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
         ctypes.c_int, ctypes.c_void_p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.pt_png_decode_resize_batch.restype = ctypes.c_int
+    lib.pt_png_decode_resize_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.pt_zlib_npy_decompress_batch.restype = ctypes.c_int
     lib.pt_zlib_npy_decompress_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
@@ -236,6 +241,32 @@ def jpeg_decode_resize_batch(cells, dst):
         return False
     ptrs, lens, n, keep = marshalled
     rc = lib.pt_jpeg_decode_resize_batch(
+        ptrs, lens, n, dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
+    del keep
+    return rc == 0
+
+
+def png_decode_resize_batch(cells, dst):
+    """PNG sibling of :func:`jpeg_decode_resize_batch`: full decode (no
+    scaled decode exists for PNG) + the same fixed-point bilinear into the
+    (N, H, W, 3)/(N, H, W) batch — keeps PNG columns on the fused
+    zero-per-row columnar path.  Same contract and same 8-bit/no-alpha
+    rejections as :func:`png_decode_batch`."""
+    lib = get_lib()
+    if lib is None or dst.dtype.kind != 'u' or dst.itemsize != 1 \
+            or not dst.flags['C_CONTIGUOUS']:
+        return False
+    if dst.ndim == 4 and dst.shape[3] in (1, 3):
+        h, w, c = dst.shape[1], dst.shape[2], dst.shape[3]
+    elif dst.ndim == 3:
+        h, w, c = dst.shape[1], dst.shape[2], 1
+    else:
+        return False
+    marshalled = _marshal_cells(cells)
+    if marshalled is None:
+        return False
+    ptrs, lens, n, keep = marshalled
+    rc = lib.pt_png_decode_resize_batch(
         ptrs, lens, n, dst.ctypes.data_as(ctypes.c_void_p), h, w, c)
     del keep
     return rc == 0

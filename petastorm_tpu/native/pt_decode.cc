@@ -201,6 +201,39 @@ void resize_bilinear(const uint8_t* src, unsigned sh, unsigned sw,
   }
 }
 
+// Grow-on-demand scratch buffer (shared by the fused resize paths).
+// Returns false on allocation failure; existing contents are discarded.
+bool grow_scratch(uint8_t** scratch, size_t* cap, size_t need) {
+  if (need <= *cap) return true;
+  delete[] *scratch;
+  *scratch = new (std::nothrow) uint8_t[need];
+  *cap = (*scratch == nullptr) ? 0 : need;
+  return *scratch != nullptr;
+}
+
+// Shared PNG header validation: begin_read + the 8-bit/no-alpha/channel
+// rejections BOTH png entry points must agree on, and the output format
+// request.  On false the image has been freed and the cell must fall
+// back to python.
+bool png_begin_validated(png_image* image, const uint8_t* src, size_t len,
+                         int c) {
+  std::memset(image, 0, sizeof(*image));
+  image->version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(image, src, len)) {
+    png_image_free(image);
+    return false;
+  }
+  const bool src_color = (image->format & PNG_FORMAT_FLAG_COLOR) != 0;
+  const bool src_alpha = (image->format & PNG_FORMAT_FLAG_ALPHA) != 0;
+  const bool src_16bit = (image->format & PNG_FORMAT_FLAG_LINEAR) != 0;
+  if (src_16bit || src_alpha || src_color != (c == 3)) {
+    png_image_free(image);
+    return false;
+  }
+  image->format = (c == 1) ? PNG_FORMAT_GRAY : PNG_FORMAT_RGB;
+  return true;
+}
+
 // Decode one JPEG of ANY source size at the coarsest DCT scale that still
 // covers (target_h, target_w), into a growable scratch buffer.  DCT-domain
 // scaling makes a 1/2-scale decode cost ~1/4 of a full decode — the fused
@@ -253,15 +286,10 @@ bool decode_one_scaled(const uint8_t* src, size_t len, uint8_t** scratch,
   *sw = cinfo.output_width;
   const size_t need =
       static_cast<size_t>(*sh) * *sw * cinfo.output_components;
-  if (need > *scratch_cap) {
-    delete[] *scratch;
-    *scratch = new (std::nothrow) uint8_t[need];
-    *scratch_cap = (*scratch == nullptr) ? 0 : need;
-    if (*scratch == nullptr) {
-      jpeg_abort_decompress(&cinfo);
-      jpeg_destroy_decompress(&cinfo);
-      return false;
-    }
+  if (!grow_scratch(scratch, scratch_cap, need)) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return false;
   }
   const size_t stride = static_cast<size_t>(*sw) * cinfo.output_components;
   while (cinfo.output_scanline < cinfo.output_height) {
@@ -333,22 +361,14 @@ int pt_png_decode_batch(const uint8_t** srcs, const size_t* lens, int n,
   const size_t img_bytes = static_cast<size_t>(h) * w * c;
   for (int i = 0; i < n; ++i) {
     png_image image;
-    std::memset(&image, 0, sizeof(image));
-    image.version = PNG_IMAGE_VERSION;
-    if (!png_image_begin_read_from_memory(&image, srcs[i], lens[i])) {
-      png_image_free(&image);
+    if (!png_begin_validated(&image, srcs[i], lens[i], c)) {
       return i + 1;
     }
-    const bool src_color = (image.format & PNG_FORMAT_FLAG_COLOR) != 0;
-    const bool src_alpha = (image.format & PNG_FORMAT_FLAG_ALPHA) != 0;
-    const bool src_16bit = (image.format & PNG_FORMAT_FLAG_LINEAR) != 0;
     if (image.width != static_cast<png_uint_32>(w) ||
-        image.height != static_cast<png_uint_32>(h) || src_16bit ||
-        src_alpha || src_color != (c == 3)) {
+        image.height != static_cast<png_uint_32>(h)) {
       png_image_free(&image);
       return i + 1;
     }
-    image.format = (c == 1) ? PNG_FORMAT_GRAY : PNG_FORMAT_RGB;
     if (!png_image_finish_read(&image, nullptr, dst + img_bytes * i,
                                static_cast<png_int_32>(w * c), nullptr)) {
       png_image_free(&image);
@@ -356,6 +376,47 @@ int pt_png_decode_batch(const uint8_t** srcs, const size_t* lens, int n,
     }
   }
   return 0;
+}
+
+// PNG sibling of pt_jpeg_decode_resize_batch: libpng has no scaled
+// decode, so this is a full decode into scratch + the shared fixed-point
+// bilinear — the point is keeping PNG columns on the fused zero-per-row
+// columnar path, not decode savings.  Same rejections as
+// pt_png_decode_batch (16-bit, alpha, channel mismatch).
+int pt_png_decode_resize_batch(const uint8_t** srcs, const size_t* lens,
+                               int n, uint8_t* dst, int h, int w, int c) {
+  const size_t img_bytes = static_cast<size_t>(h) * w * c;
+  uint8_t* scratch = nullptr;
+  size_t scratch_cap = 0;
+  ResizeScratch rs(static_cast<unsigned>(w), static_cast<unsigned>(c));
+  if (!rs.ok) return -1;
+  int failed = 0;
+  for (int i = 0; i < n; ++i) {
+    png_image image;
+    if (!png_begin_validated(&image, srcs[i], lens[i], c)) {
+      failed = i + 1;
+      break;
+    }
+    const size_t need =
+        static_cast<size_t>(image.height) * image.width * c;
+    if (!grow_scratch(&scratch, &scratch_cap, need)) {
+      png_image_free(&image);
+      failed = -1;
+      break;
+    }
+    const unsigned sh = image.height, sw = image.width;
+    if (!png_image_finish_read(&image, nullptr, scratch,
+                               static_cast<png_int_32>(sw * c), nullptr)) {
+      png_image_free(&image);
+      failed = i + 1;
+      break;
+    }
+    resize_bilinear(scratch, sh, sw, dst + img_bytes * i,
+                    static_cast<unsigned>(h), static_cast<unsigned>(w),
+                    static_cast<unsigned>(c), &rs);
+  }
+  delete[] scratch;
+  return failed;
 }
 
 int pt_zlib_npy_decompress_batch(const uint8_t** srcs, const size_t* lens,

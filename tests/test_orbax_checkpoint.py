@@ -167,3 +167,19 @@ def test_save_restore_without_data_state(tmp_path):
     model, data_state = pt_ckpt.restore_train_state(tmp_path / 'ckpt3')
     np.testing.assert_array_equal(model['a'], np.arange(4))
     assert data_state is None
+
+
+def test_model_key_dict_stays_a_dict(tmp_path):
+    """A user dict that happens to use the key 'model' must round-trip as a
+    dict — unwrapping is keyed on a reserved sentinel, not key names."""
+    pytest.importorskip('orbax.checkpoint')
+    from petastorm_tpu import checkpoint as pt_ckpt
+    state = {'model': {'w': jnp.ones((2,))}}
+    pt_ckpt.save_train_state(tmp_path / 'ckpt4', state)
+    model, _ = pt_ckpt.restore_train_state(tmp_path / 'ckpt4')
+    assert set(model) == {'model'}
+    np.testing.assert_array_equal(model['model']['w'], np.ones(2))
+    # non-dict pytrees unwrap back to their original structure
+    pt_ckpt.save_train_state(tmp_path / 'ckpt5', [jnp.zeros(3), jnp.ones(2)])
+    model, _ = pt_ckpt.restore_train_state(tmp_path / 'ckpt5')
+    assert isinstance(model, (list, tuple)) and len(model) == 2

@@ -211,3 +211,39 @@ def test_copy_dataset_partitions_count(jpeg_dataset, tmp_path):
     assert n == ROWS
     files = glob.glob(str(target_dir / '*.parquet'))
     assert len(files) == 3
+
+
+@pytest.fixture(scope='module')
+def png_dataset(tmp_path_factory):
+    url = 'file://' + str(tmp_path_factory.mktemp('resizepng') / 'ds')
+    schema = Unischema('VarPng', [
+        UnischemaField('id', np.int64, (), None, False),
+        UnischemaField('image', np.uint8, (None, None, 3),
+                       CompressedImageCodec('png'), False),
+    ])
+    rng = np.random.default_rng(7)
+    with DatasetWriter(url, schema, rows_per_rowgroup=4) as w:
+        for i in range(8):
+            h, w_ = SIZES[i % len(SIZES)]
+            w.write({'id': np.int64(i), 'image': _image(rng, h, w_)})
+    return url
+
+
+def test_png_fused_resize(png_dataset):
+    """PNG columns keep the fused columnar path (full decode + shared
+    native bilinear); lossless source means tight agreement with cv2."""
+    spec = ResizeImages({'image': TARGET})
+    with make_reader(png_dataset, transform_spec=spec, columnar_decode=True,
+                     shuffle_row_groups=False,
+                     reader_pool_type='dummy') as reader:
+        cols = {int(i): img for b in reader
+                for i, img in zip(b.id, np.asarray(b.image))}
+    with make_reader(png_dataset, transform_spec=spec, columnar_decode=False,
+                     shuffle_row_groups=False,
+                     reader_pool_type='dummy') as reader:
+        rows = {int(r.id): r.image for r in reader}
+    assert set(cols) == set(rows) == set(range(8))
+    for i in range(8):
+        assert cols[i].shape == rows[i].shape == TARGET + (3,)
+        diff = np.abs(cols[i].astype(np.int16) - rows[i].astype(np.int16))
+        assert diff.max() <= 2, 'row %d max diff %d' % (i, diff.max())
