@@ -20,9 +20,13 @@ per-row codec decode (cv2, native plane force-disabled via
 prefetch overlap — its pytorch `DataLoader` hot loop.  Same hardware, same
 process, interleaved runs.
 
-Prints ONE JSON line:
-{"metric", "value", "unit", "vs_baseline", "stall_pct", "step_ms",
- "baseline": <what the denominator measured>}.
+Prints TWO JSON lines — a full-detail line first (also written to
+``BENCH_DETAIL_LAST.json``), then a COMPACT machine line LAST
+({"metric", "value", "unit", "value_spread", "runs", "vs_baseline",
+"stall_pct", "stall_pct_source", "stall_regime", "backend", per-regime
+stall fields, "step_dtype", "mfu_pct"}).  The driver parses the final
+stdout line; keeping it small is what keeps ``BENCH_r{N}.json``
+machine-readable (round 3's one giant line overflowed the tail capture).
 """
 
 import json
@@ -275,6 +279,122 @@ def _run_scan_stall(loader, state, max_steps, floor_ms):
     return round(stall_pct, 2), wall_ms
 
 
+def _run_scan_batches_stall(loader, state, max_steps, floor_ms,
+                            steps_per_call):
+    """Stall of the fused STREAMING driver: ``DataLoader.scan_batches``
+    folds ``steps_per_call`` steps into ONE stacked ``device_put`` + ONE
+    ``lax.scan`` dispatch — per-step dispatch/transport round-trips are
+    amortized k-fold while host decode of the next chunk overlaps the
+    scan.  The first chunk is the compile+fill warmup; the timed window is
+    the following full chunks, closed by one terminal D2H."""
+    train_step, params, batch_stats, opt_state = state
+
+    def scan_step(carry, batch):
+        p, bs, opt = carry
+        p, bs, opt, loss = train_step(p, bs, opt, batch['image'],
+                                      batch['noun_id'])
+        return (p, bs, opt), loss
+
+    gen = loader.scan_batches(scan_step, (params, batch_stats, opt_state),
+                              steps_per_call=steps_per_call,
+                              donate_carry=False)
+    chunks = 0
+    steps_timed = 0
+    t0 = None
+    outs = None
+    for _, outs in gen:
+        chunks += 1
+        if chunks == 1:
+            # drain compile + pipeline fill before opening the timer
+            float(np.asarray(outs).ravel()[-1])
+            t0 = time.monotonic()
+            continue
+        steps_timed += int(outs.shape[0])  # metadata only — no device sync
+        if steps_timed >= max_steps:
+            break
+    assert t0 is not None and steps_timed > 0, 'loader too short for scan run'
+    final = np.asarray(outs)  # terminal D2H forces the whole chained window
+    wall_ms = 1000.0 * (time.monotonic() - t0) / steps_timed
+    assert np.isfinite(final).all(), 'non-finite loss in scan_batches window'
+    stall_pct = max(0.0, 100.0 * (wall_ms - floor_ms) / wall_ms)
+    return round(stall_pct, 2), wall_ms
+
+
+def _h2d_probe(k=4):
+    """Raw tunnel/PCIe H2D bandwidth for one stacked uint8 chunk — the
+    irreducible transport term of the fused streaming path.  At
+    ``steps_per_call`` → ∞ the per-step wall is bounded below by
+    ``max(device_step, batch_bytes / h2d_bytes_per_s)`` (overlapped) and
+    above by their sum (serialized); reporting the measured bandwidth lets
+    the artifact say whether a residual streaming stall is transport-bound
+    physics or framework overhead."""
+    import jax
+
+    x = np.zeros((k, BATCH, IMAGE_HW[0], IMAGE_HW[1], 3), np.uint8)
+    dev = jax.device_put(x)
+    jax.block_until_ready(dev)  # warm the transfer path
+    del dev
+    t0 = time.monotonic()
+    dev = jax.device_put(x)
+    jax.block_until_ready(dev)
+    dt = time.monotonic() - t0
+    bytes_per_s = x.nbytes / dt if dt > 0 else 0.0
+    batch_bytes = BATCH * IMAGE_HW[0] * IMAGE_HW[1] * 3
+    return {
+        'h2d_bytes_per_s': round(bytes_per_s),
+        'transport_ms_per_step': round(1000.0 * batch_bytes / bytes_per_s, 2)
+                                 if bytes_per_s else None,
+    }
+
+
+def _step_dtype_info(state):
+    """Anchor the perf claim at training precision: read the compute dtype
+    off the LOWERED STEP ITSELF (conv/dot op result types in the StableHLO
+    text), not off model-config intent.  Reports how many matmul-class ops
+    run in bf16 so 'the step is bf16' is evidence, not assertion."""
+    train_step, params, batch_stats, opt_state = state
+    x = np.zeros((BATCH, IMAGE_HW[0], IMAGE_HW[1], 3), np.uint8)
+    y = np.zeros((BATCH,), np.int64)
+    try:
+        txt = train_step.lower(params, batch_stats, opt_state, x, y).as_text()
+    except Exception:
+        return {'step_dtype': 'unknown (lowering failed)'}
+    mm_lines = [l for l in txt.splitlines()
+                if 'convolution' in l or 'dot_general' in l]
+    n_bf16 = sum('bf16' in l for l in mm_lines)
+    if mm_lines and n_bf16 >= 0.9 * len(mm_lines):
+        dtype = 'bf16-compute/f32-params'
+    elif n_bf16:
+        dtype = 'mixed bf16/f32'
+    else:
+        dtype = 'f32'
+    return {'step_dtype': dtype,
+            'matmul_class_ops': len(mm_lines),
+            'matmul_class_ops_bf16': n_bf16}
+
+
+# Peak dense bf16 TFLOP/s by device kind (public spec sheets); the MFU
+# denominator.  Substring match on jax Device.device_kind.
+_PEAK_BF16_TFLOPS = (
+    ('v5 lite', 197.0), ('v5litepod', 197.0), ('v5e', 197.0),
+    ('v6 lite', 918.0), ('v6e', 918.0),
+    ('v5p', 459.0), ('v5', 459.0),
+    ('v4', 275.0), ('v3', 123.0), ('v2', 45.0),
+)
+
+
+def _device_peak_tflops():
+    import jax
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None, None
+    for token, peak in _PEAK_BF16_TFLOPS:
+        if token in kind:
+            return peak, kind
+    return None, kind
+
+
 def _device_hbm_bytes():
     """Best-effort device memory capacity; conservative 16 GiB fallback
     (v5e) when the backend doesn't expose memory_stats."""
@@ -342,6 +462,28 @@ def train_stall_legs():
             streaming_diag = {'regime': diag['regime'],
                               'evidence': diag['evidence']}
 
+    # streaming_scan: SAME live-JPEG streaming pipeline, consumed through
+    # scan_batches — k steps per stacked device_put + lax.scan dispatch.
+    # This is the written countermeasure to per-dispatch transport latency
+    # (the diagnosed cause of the round-3 84% streaming stall on the
+    # tunneled backend), measured on the regime it was written for.
+    scan_k = max(1, min(12, TRAIN_STEPS))
+    scan_chunks = 1 + -(-TRAIN_STEPS // scan_k)
+    epochs_scan = -(-(scan_k * scan_chunks + 2) // batches_per_epoch)
+    with make_reader(DATASET_URL, num_epochs=epochs_scan,
+                     workers_count=WORKERS, shuffle_row_groups=False,
+                     columnar_decode=True) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+        stream_scan_stall, stream_scan_step_ms = _run_scan_batches_stall(
+            loader, state, TRAIN_STEPS, floor_ms, steps_per_call=scan_k)
+        if stream_scan_stall <= HEALTHY_STALL_PCT:
+            streaming_scan_diag = {'regime': 'chip_bound',
+                                   'evidence': {'stall_pct': stream_scan_stall}}
+        else:
+            diag = diagnose(loader)
+            streaming_scan_diag = {'regime': diag['regime'],
+                                   'evidence': diag['evidence']}
+
     ensure_raw_dataset()
     with make_reader(RAW_DATASET_URL, num_epochs=epochs, workers_count=WORKERS,
                      shuffle_row_groups=False, columnar_decode=True) as reader:
@@ -408,11 +550,25 @@ def train_stall_legs():
     disk_stall, disk_step_ms = _run_stall(loader, state, cached_steps,
                                           floor_ms)
 
+    # decoded_cache_scan: the same complete cache consumed through
+    # scan_batches — mmap'd batch gather on the host, k steps per fused
+    # dispatch.  The multi-epoch >HBM regime with dispatch amortized.
+    disk_scan_loader = DiskCachedDataLoader(None, batch_size=BATCH,
+                                            decoded_cache_dir=cache_dir,
+                                            num_epochs=None, seed=0)
+    disk_scan_stall, disk_scan_step_ms = _run_scan_batches_stall(
+        disk_scan_loader, state, cached_steps, floor_ms,
+        steps_per_call=scan_k)
+
+    h2d = _h2d_probe()
     decoded_epoch_bytes = NUM_IMAGES * IMAGE_HW[0] * IMAGE_HW[1] * 3
     hbm = _device_hbm_bytes()
     fits_hbm = decoded_epoch_bytes < 0.6 * hbm  # leave room for model+step
     regime = 'hbm_cached' if fits_hbm else 'decoded_cache'
     flops = _model_flops_per_step(state)
+    dtype_info = _step_dtype_info(state)
+    peak_tflops, device_kind = _device_peak_tflops()
+    tflops_per_s = flops / 1e12 / (floor_ms / 1000.0)
     if fits_hbm:
         # Both supported consumption patterns for the HBM cache are
         # measured; the headline is the better one, NAMED in
@@ -420,15 +576,17 @@ def train_stall_legs():
         headline, source = min((cached_stall, 'hbm_cached'),
                                (scan_stall, 'hbm_scan'))
     else:
-        headline, source = disk_stall, 'decoded_cache'
-    return {
+        headline, source = min((disk_stall, 'decoded_cache'),
+                               (disk_scan_stall, 'decoded_cache_scan'))
+    result = {
         'stall_pct': headline,
         'stall_pct_source': source,
-        'stall_regime': '%s (decoded epoch %.2f GiB %s %.0f GiB device HBM; '
-                        'multi-epoch > HBM runs the decoded disk cache, '
-                        'single-pass runs streaming)'
-                        % (regime, decoded_epoch_bytes / 2**30,
-                           'fits in' if fits_hbm else 'exceeds', hbm / 2**30),
+        'stall_regime': regime,
+        'stall_regime_note':
+            'decoded epoch %.2f GiB %s %.0f GiB device HBM; multi-epoch > '
+            'HBM runs the decoded disk cache, single-pass runs streaming'
+            % (decoded_epoch_bytes / 2**30,
+               'fits in' if fits_hbm else 'exceeds', hbm / 2**30),
         'stall_pct_hbm_cached': cached_stall,
         'step_ms_hbm_cached': round(cached_step_ms, 2),
         'stall_pct_hbm_scan': scan_stall,
@@ -437,6 +595,10 @@ def train_stall_legs():
         'stall_pct_streaming': stream_stall,
         'step_ms_streaming': round(stream_step_ms, 2),
         'streaming_diagnosis': streaming_diag,
+        'stall_pct_streaming_scan': stream_scan_stall,
+        'step_ms_streaming_scan': round(stream_scan_step_ms, 2),
+        'streaming_scan_steps_per_call': scan_k,
+        'streaming_scan_diagnosis': streaming_scan_diag,
         'stall_pct_delivery_bound': deliv_stall,
         'step_ms_delivery_bound': round(deliv_step_ms, 2),
         # images/s the host delivery plane sustains with NO device in the
@@ -447,9 +609,27 @@ def train_stall_legs():
             host_plane_rate >= 1000.0 * BATCH / floor_ms),
         'stall_pct_decoded_cache': disk_stall,
         'step_ms_decoded_cache': round(disk_step_ms, 2),
+        'stall_pct_decoded_cache_scan': disk_scan_stall,
+        'step_ms_decoded_cache_scan': round(disk_scan_step_ms, 2),
         'model_step_tflop': round(flops / 1e12, 4),
-        'model_tflops_per_s': round(flops / 1e12 / (floor_ms / 1000.0), 2),
+        'model_tflops_per_s': round(tflops_per_s, 2),
+        'device_kind': device_kind,
+        'device_peak_tflops_bf16': peak_tflops,
+        'mfu_pct': (round(100.0 * tflops_per_s / peak_tflops, 1)
+                    if peak_tflops else None),
     }
+    result.update(dtype_info)
+    result.update(h2d)
+    # Irreducible transport bound of the fused streaming path: even at
+    # steps_per_call -> inf, per-step wall >= max(device_step,
+    # batch_bytes/bandwidth) when transfer overlaps compute.
+    if h2d.get('transport_ms_per_step'):
+        t_ms = h2d['transport_ms_per_step']
+        bound_ms = max(floor_ms, t_ms)
+        result['streaming_scan_floor_stall_pct'] = round(
+            max(0.0, 100.0 * (bound_ms - floor_ms) / bound_ms), 2)
+        result['transport_bound'] = bool(t_ms > floor_ms)
+    return result
 
 
 def _model_flops_per_step(state):
@@ -531,6 +711,40 @@ def kernel_certification():
     return {name: round(e, 8) for name, e in errs.items()}
 
 
+_COMPACT_KEYS = (
+    'metric', 'value', 'unit', 'value_spread', 'runs', 'vs_baseline',
+    'backend', 'stall_pct', 'stall_pct_source', 'stall_regime',
+    'stall_pct_hbm_cached', 'stall_pct_hbm_scan', 'stall_pct_streaming',
+    'stall_pct_streaming_scan', 'stall_pct_delivery_bound',
+    'stall_pct_decoded_cache', 'stall_pct_decoded_cache_scan',
+    'streaming_scan_floor_stall_pct', 'transport_bound', 'device_step_ms',
+    'step_dtype', 'model_tflops_per_s', 'device_peak_tflops_bf16',
+    'mfu_pct', 'error',
+)
+
+
+def _emit(result):
+    """Two JSON lines + a detail file.
+
+    The FULL result (prose notes, diagnoses, kernel table) goes to
+    ``BENCH_DETAIL_LAST.json`` and an early stdout line; the FINAL stdout
+    line is a COMPACT numbers-only subset.  The driver's tail capture
+    parses the last line — round 3's single giant line overflowed it
+    (``BENCH_r03.json "parsed": null``), so the machine-readable line must
+    stay small and LAST."""
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               'BENCH_DETAIL_LAST.json')
+    try:
+        with open(detail_path, 'w') as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    except OSError:
+        pass
+    print(json.dumps(result), flush=True)
+    compact = {k: result[k] for k in _COMPACT_KEYS
+               if result.get(k) is not None}
+    print(json.dumps(compact), flush=True)
+
+
 def _start_watchdog(budget_s):
     """Print a diagnostic JSON line and hard-exit if the run wedges.
 
@@ -608,11 +822,12 @@ def main():
                          'budget; re-running the host-pipeline legs on the '
                          'CPU backend\n')
         _reexec_cpu_fallback()
-    # 1800s: the round-3 leg set (floor + streaming + delivery-bound +
-    # disk-cache build/serve + HBM-cached + 6-kernel certification) compiles
-    # ~8 executables on a cold chip; 900s left no headroom.
+    # 2400s: the round-4 leg set (floor + streaming + streaming_scan +
+    # delivery-bound + disk-cache build/serve/scan + HBM-cached/scan +
+    # 6-kernel certification) compiles ~10 executables on a cold chip;
+    # 1800s left no headroom once the two scan legs joined.
     watchdog = _start_watchdog(
-        int(os.environ.get('PETASTORM_TPU_BENCH_BUDGET_S', '1800')))
+        int(os.environ.get('PETASTORM_TPU_BENCH_BUDGET_S', '2400')))
     ensure_dataset()
     import jax
     from petastorm_tpu.utils import apply_jax_platforms_env
@@ -621,14 +836,22 @@ def main():
 
     tpu_native_epoch()           # warmup (page cache, pools)
     reference_strategy_epoch()   # warm the reference path identically
-    # Interleaved best-of-5 per path: single-host timings are noisy (shared
-    # core, tunneled device); alternating runs equalizes cache/tunnel warmth
-    # and the max approximates steady-state throughput for each strategy.
-    ours, theirs = [], []
-    for _ in range(5):
-        ours.append(tpu_native_epoch())
-        theirs.append(reference_strategy_epoch())
-    ours, theirs = max(ours), max(theirs)
+    # Interleaved repeats: single-host timings are noisy (shared core,
+    # tunneled device); alternating runs equalizes cache/tunnel warmth.
+    # The reported value is the MEDIAN with its spread beside it, and
+    # vs_baseline is the median of PAIRWISE ratios (each ratio compares
+    # two adjacent runs under the same transient host conditions), so the
+    # ±60% swing the round-1..3 artifacts showed silently is now visible
+    # in the artifact itself.
+    repeats = int(os.environ.get('PETASTORM_TPU_BENCH_REPEATS', '5'))
+    ours_runs, theirs_runs = [], []
+    for _ in range(repeats):
+        ours_runs.append(tpu_native_epoch())
+        theirs_runs.append(reference_strategy_epoch())
+    ours = float(np.median(ours_runs))
+    theirs = float(np.median(theirs_runs))
+    ratio = float(np.median([o / t for o, t in zip(ours_runs, theirs_runs)]))
+    spread = max(ours_runs) - min(ours_runs)
 
     if cpu_fallback:
         # ResNet-50 train legs need the chip (~30 s/step on host CPU);
@@ -639,7 +862,11 @@ def main():
             'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
             'value': round(ours, 1),
             'unit': 'images/s',
-            'vs_baseline': round(ours / theirs, 2),
+            'value_spread': round(spread, 1),
+            'runs': repeats,
+            'runs_raw': [round(r, 1) for r in ours_runs],
+            'baseline_runs_raw': [round(r, 1) for r in theirs_runs],
+            'vs_baseline': round(ratio, 2),
             'host_cores': os.cpu_count(),
             'backend': 'cpu-fallback (TPU tunnel wedged at bench time; '
                        'host decode/collate pipeline vs reference strategy '
@@ -651,7 +878,7 @@ def main():
                               'this run)',
         }
         watchdog.cancel()
-        print(json.dumps(result))
+        _emit(result)
         return
 
     stall = train_stall_legs()
@@ -660,33 +887,38 @@ def main():
         'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
         'value': round(ours, 1),
         'unit': 'images/s',
-        'vs_baseline': round(ours / theirs, 2),
+        'value_spread': round(spread, 1),
+        'runs': repeats,
+        'runs_raw': [round(r, 1) for r in ours_runs],
+        'baseline_runs_raw': [round(r, 1) for r in theirs_runs],
+        'vs_baseline': round(ratio, 2),
         'host_cores': os.cpu_count(),
         'backend': jax.default_backend(),
         'baseline': 'same dataset+hardware via reference delivery strategy: '
                     'per-row cv2 decode (native plane disabled), per-row '
                     'python collate, sync device_put, no prefetch '
-                    '(%.1f images/s)' % theirs,
+                    '(%.1f images/s median)' % theirs,
         'stall_note': 'stall_pct = the regime stall_regime names, from the '
                       'leg stall_pct_source names (the better of the two '
-                      'HBM-cache drivers when both apply); '
-                      'stall_pct_hbm_cached = HBM epoch cache, per-step '
-                      'iterator (DeviceInMemDataLoader); stall_pct_hbm_scan '
-                      '= same cache, gather+step fused into one lax.scan '
-                      'dispatch per epoch (scan_epochs — the recommended '
-                      'pattern; immune to per-dispatch transport latency); '
-                      'stall_pct_streaming = live thread-pool JPEG decode '
-                      '(host_cores-bound); stall_pct_delivery_bound = same '
-                      'streaming loader, pre-decoded uint8 parquet (no '
-                      'JPEG) — isolates the delivery plane from decode '
-                      'economics',
+                      'drivers when both apply); stall_pct_hbm_cached = HBM '
+                      'epoch cache, per-step iterator (DeviceInMemDataLoader)'
+                      '; stall_pct_hbm_scan = same cache, gather+step fused '
+                      'into one lax.scan dispatch per epoch (scan_epochs); '
+                      'stall_pct_streaming = live thread-pool JPEG decode, '
+                      'per-step dispatch; stall_pct_streaming_scan = same '
+                      'pipeline via scan_batches (k steps per stacked '
+                      'device_put + scan dispatch); stall_pct_delivery_bound '
+                      '= streaming loader over pre-decoded uint8 parquet '
+                      '(no JPEG) — isolates delivery from decode economics; '
+                      'stall_pct_decoded_cache[_scan] = mmap decoded-tensor '
+                      'disk cache, per-step / fused',
     }
     result.update(stall)
     result['kernel_max_err'] = kernel_certification()
     result['kernel_backend'] = ('tpu (Mosaic)' if jax.default_backend() == 'tpu'
                                 else jax.default_backend() + ' (Pallas interpreter)')
     watchdog.cancel()
-    print(json.dumps(result))
+    _emit(result)
 
 
 if __name__ == '__main__':

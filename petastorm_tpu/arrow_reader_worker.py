@@ -42,6 +42,12 @@ class ArrowReaderWorker(ParquetWorkerBase):
         piece = self._a.pieces[piece_index]
         cache_key = '%s:%d:batch:%s' % (piece.path, piece.row_group,
                                         ','.join(sorted(self._a.schema_view.fields)))
+        # _apply_transform runs before the cache store: the payload is
+        # post-transform, so the key carries the transform identity.
+        token = getattr(self._a.transform_spec, 'cache_token', None) \
+            if self._a.transform_spec is not None else None
+        if token:
+            cache_key += ':t{%s}' % token
         # The retry/poison classifier wraps only the I/O stage: an ArrowInvalid
         # out of a user transform (e.g. from_pandas on a mixed-type column)
         # must surface as the transform's own error, not as a corrupt file.

@@ -413,6 +413,10 @@ class CompressedImageCodec(DataframeColumnCodec):
                                 dst.shape[0], dst.shape[1])
         if arr.ndim == 2 and dst.ndim == 3:
             arr = arr[:, :, None]
+        elif arr.ndim == 3 and arr.shape[2] == 1 and dst.ndim == 2:
+            # resize_image_cell restores a trailing 1-channel dim that a
+            # 2-D dst row doesn't carry
+            arr = arr[:, :, 0]
         np.copyto(dst, arr, casting='same_kind')
 
     def decode_into(self, unischema_field, value, dst):

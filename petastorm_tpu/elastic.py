@@ -103,6 +103,15 @@ def _normalized(states):
             raise ValueError('states disagree on dataset topology')
         if s.get('num_epochs') != shared['num_epochs']:
             raise ValueError('states disagree on num_epochs')
+        if s.get('seed') != states[0].get('seed'):
+            # Resharding stamps every new token with shard 0's seed; under
+            # divergent per-shard seeds that would silently change the
+            # regular-epoch shuffle orders relative to a same-topology
+            # resume (coverage stays exact, order does not).
+            raise ValueError('states disagree on seed (%r vs %r) — '
+                             'per-shard seeds cannot be resharded '
+                             'faithfully' % (s.get('seed'),
+                                             states[0].get('seed')))
     if shard_count is None:
         return list(states), shared
     by_shard = {}

@@ -442,18 +442,38 @@ class DataLoader(object):
                      donate_argnums=(0,) if donate_carry else ())
 
         def put_stacked(chunk, transformed=False):
+            # Same per-stage stats accounting as __iter__ (transform /
+            # stack+upload), so the bottleneck advisor can diagnose a
+            # scan_batches-consumed loader too.
+            t0 = time.monotonic()
             if self._transform_fn is not None and not transformed:
                 chunk = [self._transform_fn(b) for b in chunk]
+            t1 = time.monotonic()
             stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *chunk)
             numeric = _filter_numeric(stacked, self._warned_fields)
             if self._sharding is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
                 spec = PartitionSpec(None, *self._sharding.spec)
-                return global_batch_from_local(
+                out = global_batch_from_local(
                     numeric, NamedSharding(self._sharding.mesh, spec))
-            if self._device is not None:
-                return jax.device_put(numeric, self._device)
-            return jax.device_put(numeric)
+            elif self._device is not None:
+                out = jax.device_put(numeric, self._device)
+            else:
+                out = jax.device_put(numeric)
+            t2 = time.monotonic()
+            self.stats['transform_s'] += t1 - t0
+            self.stats['device_put_s'] += t2 - t1
+            return out
+
+        def timed_pulls(gen):
+            while True:
+                t0 = time.monotonic()
+                try:
+                    host_batch = next(gen)
+                except StopIteration:
+                    return
+                self.stats['host_batch_s'] += time.monotonic() - t0
+                yield host_batch
 
         def rows_of(batch):
             return len(next(iter(jax.tree_util.tree_leaves(batch))))
@@ -474,7 +494,7 @@ class DataLoader(object):
                 yield carry, outs
 
         chunk = []
-        for host_batch in self._echoed_host_batches():
+        for host_batch in timed_pulls(self._echoed_host_batches()):
             if chunk and rows_of(host_batch) != rows_of(chunk[0]):
                 # ragged tail (drop_last=False): flush so stacking stays
                 # rectangular — the tail becomes its own (shorter) chunk
@@ -626,6 +646,34 @@ def _strip_none_leaves(obj):
     return obj
 
 
+def _canonical_row_order(cache):
+    """Reorder an ``(N, ...)`` pytree of rows into a content-defined
+    canonical order: sort by a per-row digest over fields in name order.
+
+    Any worker pool delivers the same row MULTISET; after this sort any
+    pool also yields the same SEQUENCE — which is what makes an exact
+    in-memory resume token valid across a process restart that rebuilds
+    the cache through a differently-ordered pool.  Identical rows tie on
+    digest, and identical rows are interchangeable, so ties are harmless.
+    Cost: one hashing pass over the decoded dataset at build time."""
+    import hashlib
+
+    items = sorted(cache.items()) if isinstance(cache, dict) else None
+    if items is None:  # non-dict pytree: flatten with stable path order
+        paths = jax.tree_util.tree_flatten_with_path(cache)[0]
+        items = [(jax.tree_util.keystr(p), leaf) for p, leaf in paths]
+        items.sort()
+    n = len(items[0][1])
+    digests = []
+    for i in range(n):
+        h = hashlib.blake2b(digest_size=16)
+        for _, leaf in items:
+            h.update(np.ascontiguousarray(leaf[i]).tobytes())
+        digests.append(h.digest())
+    idx = np.asarray(sorted(range(n), key=digests.__getitem__))
+    return jax.tree_util.tree_map(lambda v: v[idx], cache)
+
+
 class InMemDataLoader(DataLoader):
     """Epoch-cached loader: reads the dataset once, then serves ``num_epochs``
     (re)shuffled epochs straight from host RAM — no Parquet re-read, no
@@ -636,10 +684,16 @@ class InMemDataLoader(DataLoader):
     — e.g. MNIST-scale fine-tuning where reader startup would dominate.
     Construct the underlying reader with ``num_epochs=1``; epoch repetition
     happens here.
+
+    ``deterministic_cache_order=True`` sorts the built cache into a
+    content-defined canonical order (:func:`_canonical_row_order`), which
+    makes the epoch sequence a pure function of ``(dataset, seed)`` — any
+    pool, any restart — and unlocks exact mid-epoch ``state_dict`` /
+    ``resume_state``, same contract as :class:`DiskCachedDataLoader`.
     """
 
     def __init__(self, reader, batch_size, num_epochs=1, shuffle=True,
-                 seed=None, **kwargs):
+                 seed=None, deterministic_cache_order=False, **kwargs):
         if getattr(reader, 'ngram', None) is not None:
             raise ValueError('InMemDataLoader does not support NGram readers')
         if kwargs.get('echo', 1) != 1:
@@ -661,7 +715,9 @@ class InMemDataLoader(DataLoader):
         super(InMemDataLoader, self).__init__(reader, batch_size, seed=seed, **kwargs)
         self._num_epochs = num_epochs
         self._shuffle = shuffle
+        self._deterministic = bool(deterministic_cache_order)
         self._cache = None
+        self._im = None  # mid-epoch cursor (deterministic order only)
 
     def _build_cache(self):
         """One-time read of the whole dataset into ``self._cache`` (a dict
@@ -677,8 +733,18 @@ class InMemDataLoader(DataLoader):
                 self._drop_last = drop_last
             if not parts:
                 return None
-            self._cache = jax.tree_util.tree_map(
+            cache = jax.tree_util.tree_map(
                 lambda *xs: np.concatenate(xs), *parts)
+            if self._deterministic:
+                numeric = _filter_numeric(cache, self._warned_fields)
+                if not jax.tree_util.tree_leaves(numeric):
+                    raise ValueError(
+                        'deterministic_cache_order=True requires at least '
+                        'one numeric field (the canonical order hashes '
+                        'numeric row content; every field here is '
+                        'object/string-typed)')
+                cache = _canonical_row_order(numeric)
+            self._cache = cache
         return self._cache
 
     def _host_batches(self):
@@ -692,22 +758,68 @@ class InMemDataLoader(DataLoader):
             return
         rng = np.random.default_rng(self._seed)
         epoch = 0
+        order = None
+        offset = 0
+        resumed = (self._resume_state or {}).get('inmem_cache')
+        if resumed:
+            if not self._deterministic:
+                raise ValueError(
+                    'this resume token requires '
+                    'deterministic_cache_order=True (the rebuilt cache '
+                    'must reproduce the checkpointed row order)')
+            rng.bit_generator.state = resumed['rng_state']
+            epoch = int(resumed['epoch'])
+            offset = int(resumed['offset'])
+            order = (None if resumed['order'] is None
+                     else np.asarray(resumed['order']))
+        if self._deterministic:
+            self._im = {'rng': rng, 'epoch': epoch, 'order': order,
+                        'offset': offset}
         while self._num_epochs is None or epoch < self._num_epochs:
-            order = rng.permutation(n) if self._shuffle else np.arange(n)
+            if order is None:
+                order = rng.permutation(n) if self._shuffle else np.arange(n)
             stop = n - self.batch_size + 1 if self._drop_last else n
-            for start in range(0, max(stop, 0), self.batch_size):
+            for start in range(offset, max(stop, 0), self.batch_size):
+                if self._im is not None:
+                    self._im.update(epoch=epoch, order=order,
+                                    offset=start + self.batch_size)
                 idx = order[start:start + self.batch_size]
                 yield jax.tree_util.tree_map(lambda v: v[idx], self._cache)
             epoch += 1
+            order = None
+            offset = 0
+            if self._im is not None:
+                self._im.update(epoch=epoch, order=None, offset=0)
 
     def state_dict(self):
-        raise NotImplementedError(
-            'In-memory epoch caches are rebuilt from the reader, whose '
-            'delivery order is pool-dependent, so an exact mid-epoch token '
-            'cannot survive a process restart.  Checkpoint at epoch '
-            'boundaries (rebuild with num_epochs reduced), or use '
-            'DiskCachedDataLoader: its on-disk cache preserves row order '
-            'and supports exact mid-epoch resume.')
+        """Exact mid-epoch resume token — requires
+        ``deterministic_cache_order=True`` (the canonical cache order is
+        what survives a restart; a pool-ordered cache does not)."""
+        if not self._deterministic:
+            raise NotImplementedError(
+                'In-memory epoch caches are rebuilt from the reader, whose '
+                'delivery order is pool-dependent, so an exact mid-epoch '
+                'token cannot survive a process restart.  Build the loader '
+                'with deterministic_cache_order=True (content-sorted cache, '
+                'exact resume on any pool), checkpoint at epoch boundaries '
+                '(rebuild with num_epochs reduced), or use '
+                'DiskCachedDataLoader: its on-disk cache preserves row '
+                'order and supports exact mid-epoch resume.')
+        if self._im is None:
+            raise ValueError('state_dict() is supported once iteration has '
+                             'begun; call it between batches')
+        im = self._im
+        return {
+            'version': 1,
+            'pending': [jax.device_get(b) for b in self._pending],
+            'inmem_cache': {
+                'rng_state': im['rng'].bit_generator.state,
+                'epoch': int(im['epoch']),
+                'offset': int(im['offset']),
+                'order': (None if im['order'] is None
+                          else np.asarray(im['order'])),
+            },
+        }
 
 
 class DeviceInMemDataLoader(InMemDataLoader):
@@ -748,6 +860,23 @@ class DeviceInMemDataLoader(InMemDataLoader):
                              'batch assembly')
         self._dev_cache = None
         self._gather_fn = None
+        self._steps_into_epoch = 0
+        #: epochs to SKIP at the head of every pass (from a resume token);
+        #: static — re-iterating the loader replays from this baseline.
+        self._start_epoch = 0
+        #: live position of the CURRENT pass (state_dict reads it); reset
+        #: to the baseline whenever a fresh pass begins.
+        self._epochs_done = 0
+        resumed = (self._resume_state or {}).get('device_inmem')
+        if resumed:
+            if seed is None or int(resumed['seed']) != int(seed):
+                raise ValueError(
+                    'device_inmem resume token was taken with seed=%r; '
+                    'rebuild the loader with that explicit seed (the '
+                    'permutation stream is derived from it)'
+                    % (resumed['seed'],))
+            self._start_epoch = int(resumed['epochs_done'])
+            self._epochs_done = self._start_epoch
 
     def _materialize(self):
         """Build the HBM-resident epoch cache (idempotent); returns the
@@ -788,23 +917,41 @@ class DeviceInMemDataLoader(InMemDataLoader):
             self._gather_fn = jax.jit(_gather)
 
         def gen():
+            self._epochs_done = self._start_epoch  # fresh pass
+            self._steps_into_epoch = 0
             for order in self._epoch_orders(n):
                 stop = n - self.batch_size + 1 if self._drop_last else n
-                for start in range(0, max(stop, 0), self.batch_size):
+                starts = list(range(0, max(stop, 0), self.batch_size))
+                for j, start in enumerate(starts):
                     if start + self.batch_size <= n:
-                        yield self._gather_fn(cache, order, start)
+                        batch = self._gather_fn(cache, order, start)
                     else:  # ragged tail (drop_last=False): plain gather
                         idx = order[start:]
-                        yield jax.tree_util.tree_map(
+                        batch = jax.tree_util.tree_map(
                             lambda v: jnp.take(v, idx, axis=0), cache)
                     self.stats['batches'] += 1
+                    # Account BEFORE the yield: once the consumer holds the
+                    # epoch's last batch, a state_dict() taken there must
+                    # read as an epoch boundary (the generator stays
+                    # suspended at the yield until the next pull).
+                    if j + 1 == len(starts):
+                        self._steps_into_epoch = 0
+                        self._epochs_done += 1
+                    else:
+                        self._steps_into_epoch = j + 1
+                    yield batch
         return gen()
 
     def _epoch_orders(self, n):
         """Per-epoch index order stream shared by the per-step iterator and
         ``scan_epochs`` — one place owns num_epochs/shuffle/seed semantics
         (an explicit seed reproduces, seed=None draws fresh entropy per
-        loader, same as the host-RAM sibling)."""
+        loader, same as the host-RAM sibling).  Starts at
+        ``self._start_epoch``: an epoch-boundary resume burns the earlier
+        permutations so the continuation is exactly the uninterrupted
+        stream's tail.  The baseline is static, so re-iterating the
+        loader replays the same pass (fresh-entropy seeds replay THEIR
+        pass; an explicit seed reproduces across processes)."""
         import jax.numpy as jnp
 
         seed = self._seed if self._seed is not None \
@@ -814,9 +961,11 @@ class DeviceInMemDataLoader(InMemDataLoader):
         while self._num_epochs is None or epoch < self._num_epochs:
             if self._shuffle:
                 key, sub = jax.random.split(key)
-                yield jax.random.permutation(sub, n)
+                order = jax.random.permutation(sub, n)
             else:
-                yield jnp.arange(n)
+                order = jnp.arange(n)
+            if epoch >= self._start_epoch:
+                yield order
             epoch += 1
 
     def scan_epochs(self, step_fn, carry, donate_carry=True,
@@ -890,6 +1039,8 @@ class DeviceInMemDataLoader(InMemDataLoader):
         fn_one = jax.jit(run_epoch, donate_argnums=donate)
         fn_many = jax.jit(run_epochs, donate_argnums=donate)
 
+        self._epochs_done = self._start_epoch  # fresh pass
+        self._steps_into_epoch = 0
         orders = self._epoch_orders(n)
         while True:
             group = list(itertools.islice(orders, epochs_per_call))
@@ -903,7 +1054,33 @@ class DeviceInMemDataLoader(InMemDataLoader):
                 # drop the epochs axis consumers index by.
                 carry, outs = fn_many(carry, cache, jnp.stack(group))
             self.stats['batches'] += steps * len(group)
+            self._epochs_done += len(group)  # group yields ARE boundaries
             yield carry, outs
+
+    def state_dict(self):
+        """Epoch-boundary resume token.  The HBM gather plane keeps no
+        host-visible mid-epoch cursor (that is the point — zero host work
+        per step), but the permutation stream is a pure function of the
+        explicit ``seed``, so '``k`` epochs done' fully determines the
+        continuation: resume with ``DeviceInMemDataLoader(reader', ...,
+        seed=same_seed, num_epochs=same_total, resume_state=token)`` and
+        the remaining epochs replay exactly.  Mid-epoch checkpoints want
+        :class:`InMemDataLoader` with ``deterministic_cache_order=True``
+        or :class:`DiskCachedDataLoader`."""
+        if self._seed is None:
+            raise ValueError('epoch-boundary resume needs an explicit '
+                             'seed= (the device permutation stream must be '
+                             're-derivable after restart)')
+        if self._steps_into_epoch:
+            raise ValueError(
+                'DeviceInMemDataLoader checkpoints at epoch boundaries '
+                'only (%d steps into the current epoch); consume the '
+                'epoch, or use InMemDataLoader('
+                'deterministic_cache_order=True) / DiskCachedDataLoader '
+                'for exact mid-epoch resume' % self._steps_into_epoch)
+        return {'version': 1,
+                'device_inmem': {'epochs_done': int(self._epochs_done),
+                                 'seed': int(self._seed)}}
 
 
 class DiskCachedDataLoader(DataLoader):

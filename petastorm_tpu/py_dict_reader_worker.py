@@ -51,14 +51,21 @@ class PyDictReaderWorker(ParquetWorkerBase):
         piece = self._a.pieces[piece_index]
         cache_key = '%s:%d:%d:%s' % (piece.path, piece.row_group, row_drop_partition,
                                      ','.join(sorted(self._a.schema_view.fields)))
+        ts = self._a.transform_spec
+        # Cached payloads are POST-transform on EVERY branch below (the
+        # fused-columnar resize, the per-row func path, and the opaque-func
+        # columnar fallback alike), so every key carries the transform's
+        # identity — different resize targets / funcs must not share
+        # entries (cache_type='local-disk' would otherwise serve stale
+        # rows at the old resolution across runs).
+        token = getattr(ts, 'cache_token', None) if ts is not None else None
+        if token:
+            cache_key += ':t{%s}' % token
         if self._a.columnar_output and self._a.ngram is None:
-            ts = self._a.transform_spec
             # A declared-resize spec (ResizeImages) fuses into the columnar
             # decode instead of forcing the per-row path an opaque func does.
             fusable = ts is not None and getattr(ts, 'columnar_fusable', False)
             if ts is None or ts.func is None or fusable:
-                if fusable:
-                    cache_key += ':rz%s' % sorted(ts.resize_targets.items())
                 # True columnar decode: no intermediate row dicts at all.
                 columns = self._a.cache.get(
                     cache_key + ':c',

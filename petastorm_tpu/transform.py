@@ -29,6 +29,32 @@ class TransformSpec(object):
         # supports this on the batch path).
         self.selected_fields = list(selected_fields) if selected_fields is not None else None
 
+    @property
+    def cache_token(self):
+        """Stable identity of this transform for result-cache keys.
+
+        Worker result caches store POST-transform payloads, so two readers
+        over the same dataset with different transforms must not share
+        entries.  Opaque funcs are identified by ``module.qualname`` plus
+        the declared schema edits — distinct parameterizations of the SAME
+        function (closures, partials) are indistinguishable at this level;
+        give each its own cache directory, or subclass and override this
+        property with a token that encodes the parameters (as
+        :class:`ResizeImages` does with its targets)."""
+        if self.func is None and not self.removed_fields \
+                and self.selected_fields is None:
+            return None
+        func_id = None if self.func is None else '%s.%s' % (
+            getattr(self.func, '__module__', '?'),
+            getattr(self.func, '__qualname__',
+                    getattr(self.func, '__name__', repr(self.func))))
+        return 'f=%s;e=%s;r=%s;s=%s' % (
+            func_id,
+            sorted(f.name for f in self.edit_fields),
+            sorted(self.removed_fields),
+            None if self.selected_fields is None
+            else sorted(self.selected_fields))
+
     @staticmethod
     def _normalize(field):
         from petastorm_tpu.unischema import UnischemaField
@@ -89,6 +115,17 @@ class ResizeImages(TransformSpec):
         #: columnar plane may fuse it instead of going per-row.
         self.columnar_fusable = True
 
+    @property
+    def cache_token(self):
+        # The resize IS the transform: the targets fully determine the
+        # cached payload (same token on the fused-columnar, per-row, and
+        # batch paths — they cache interchangeable pixels).
+        return 'rz=%s;r=%s;s=%s' % (
+            sorted(self.resize_targets.items()),
+            sorted(self.removed_fields),
+            None if self.selected_fields is None
+            else sorted(self.selected_fields))
+
     def _resize_func(self, row):
         from petastorm_tpu.codecs import resize_image_cell as resize_cell
 
@@ -111,8 +148,14 @@ class ResizeImages(TransformSpec):
             base = schema.fields.get(name)
             if base is None:
                 continue
+            if not base.shape:
+                # Fully-wildcard base (shape=None normalizes to ()): the
+                # channel count — even the rank — is unknown, so asserting
+                # (h, w) would misdeclare 3-channel images.  Keep the
+                # wildcard declaration.
+                continue
             shape = (h, w) + tuple(base.shape[2:]) \
-                if base.shape is not None and len(base.shape) > 2 else (h, w)
+                if len(base.shape) > 2 else (h, w)
             derived.append(UnischemaField(name, base.numpy_dtype, shape,
                                           base.codec, base.nullable))
         return list(self.edit_fields) + derived

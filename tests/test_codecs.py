@@ -162,3 +162,22 @@ def test_bfloat16_shape_dtype_struct():
     bf16, schema = _bf16_schema(NdarrayCodec)
     structs = schema.as_shape_dtype_structs()
     assert structs['emb'].dtype == jnp.bfloat16
+
+
+def test_decode_resized_into_2d_dst(rng):
+    """A grayscale cell resized into a 2-D dst row: resize_image_cell may
+    restore a trailing 1-channel dim the 2-D dst doesn't carry — the fused
+    fallback squeezes it instead of letting np.copyto raise."""
+    codec = CompressedImageCodec('png')
+    f = _field('im', np.uint8, (16, 16), codec)
+    img = rng.integers(0, 255, (16, 16), dtype=np.uint8)
+    enc = codec.encode(f, img)
+    dst = np.zeros((8, 8), np.uint8)
+    codec.decode_resized_into(f, enc, dst)
+    assert dst.any()
+    # and the 3-D single-channel variant still lands in a 2-D dst
+    f1 = _field('im', np.uint8, (16, 16, 1), codec)
+    enc1 = codec.encode(f1, img[:, :, None])
+    dst1 = np.zeros((8, 8), np.uint8)
+    codec.decode_resized_into(f1, enc1, dst1)
+    np.testing.assert_array_equal(dst, dst1)

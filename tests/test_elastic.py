@@ -472,3 +472,18 @@ def _batch_rows(batch):
     batch = jax.device_get(batch)
     n = len(next(iter(batch.values())))
     return [{k: v[i] for k, v in batch.items()} for i in range(n)]
+
+
+def test_reshard_rejects_divergent_seeds(dataset):
+    """Resharding stamps every new token with shard 0's seed; divergent
+    per-shard seeds would silently change regular-epoch shuffle orders, so
+    _normalized refuses them (advisor r3, low)."""
+    readers = [make_reader(dataset.url, cur_shard=s, shard_count=2,
+                           num_epochs=2, shuffle_row_groups=True, seed=s + 1,
+                           reader_pool_type='dummy') for s in range(2)]
+    states = [r.state_dict() for r in readers]
+    for r in readers:
+        r.stop()
+        r.join()
+    with pytest.raises(ValueError, match='seed'):
+        reshard_reader_states(states, 3)

@@ -45,6 +45,106 @@ def test_mnist(tmp_path):
     assert 'final accuracy' in out
 
 
+def test_mnist_pytorch(tmp_path):
+    pytest.importorskip('torch')
+    url = 'file://' + str(tmp_path / 'mnist')
+    _run(['examples/mnist/generate_petastorm_mnist.py', '-o', url,
+          '-n', '256'])
+    out = _run(['examples/mnist/pytorch_example.py', '--epochs', '1',
+                '--dataset-url', url])
+    assert 'final accuracy' in out
+
+
+def test_mnist_tensorflow(tmp_path):
+    pytest.importorskip('tensorflow')
+    url = 'file://' + str(tmp_path / 'mnist')
+    _run(['examples/mnist/generate_petastorm_mnist.py', '-o', url,
+          '-n', '256'])
+    out = _run(['examples/mnist/tf_example.py', '--epochs', '1',
+                '--dataset-url', url], timeout=600)
+    assert 'final accuracy' in out
+
+
+def test_hello_world_external_dataset(tmp_path):
+    """BASELINE config #2: a plain (non-petastorm) parquet dataset read
+    through make_batch_reader — all three hello-world consumers."""
+    url = 'file://' + str(tmp_path / 'ext')
+    _run(['examples/hello_world/external_dataset/'
+          'generate_external_dataset.py', '-o', url])
+    out = _run(['examples/hello_world/external_dataset/python_hello_world.py',
+                '--dataset-url', url])
+    assert 'ids' in out
+    if _importable('torch'):
+        _run(['examples/hello_world/external_dataset/pytorch_hello_world.py',
+              '--dataset-url', url])
+    if _importable('tensorflow'):
+        _run(['examples/hello_world/external_dataset/'
+              'tensorflow_hello_world.py', '--dataset-url', url],
+             timeout=600)
+
+
+def test_hello_world_petastorm_other_consumers(tmp_path):
+    url = 'file://' + str(tmp_path / 'hw')
+    _run(['examples/hello_world/petastorm_dataset/'
+          'generate_petastorm_dataset.py', '--output-url', url])
+    _run(['examples/hello_world/petastorm_dataset/python_hello_world.py',
+          '--dataset-url', url])
+    if _importable('torch'):
+        _run(['examples/hello_world/petastorm_dataset/pytorch_hello_world.py',
+              '--dataset-url', url])
+    if _importable('tensorflow'):
+        _run(['examples/hello_world/petastorm_dataset/'
+              'tensorflow_hello_world.py', '--dataset-url', url],
+             timeout=600)
+
+
+def test_criteo_dlrm(tmp_path):
+    """BASELINE config #4: criteo-shaped parquet -> DLRM."""
+    url = 'file://' + str(tmp_path / 'criteo')
+    _run(['examples/criteo/generate_criteo_parquet.py', '-o', url,
+          '-n', '2048'])
+    out = _run(['examples/criteo/jax_example.py', '--dataset-url', url,
+                '--epochs', '1', '--batch-size', '256'])
+    assert 'loss=' in out
+
+
+def test_ngram_sensor(tmp_path):
+    """BASELINE config #5: NGram window assembly feeding a sequence model."""
+    out = _run(['examples/ngram_sensor/jax_example.py',
+                '--dataset-url', 'file://' + str(tmp_path / 'ngram')],
+               timeout=600)
+    assert 'done' in out
+
+
+def test_dataframe_converter():
+    out = _run(['examples/dataframe_converter/jax_example.py'])
+    assert 'cache deleted' in out
+
+
+def test_long_context(tmp_path):
+    """Long-context LM over token parquet; dense attention for the smoke
+    (the flash/ring strategies run the Pallas interpreter on CPU, minutes
+    per step — certified on-chip by the bench instead)."""
+    url = 'file://' + str(tmp_path / 'lc')
+    _run(['examples/long_context/generate_token_parquet.py', url])
+    out = _run(['examples/long_context/jax_example.py', '--dataset-url', url,
+                '--strategy', 'dense', '--steps', '2', '--batch-size', '2'],
+               timeout=600)
+    assert 'done: 2 steps' in out
+
+
+def test_long_context_packed(tmp_path):
+    out = _run(['examples/long_context/packed_example.py',
+                '--dataset-url', 'file://' + str(tmp_path / 'packed'),
+                '--steps', '2'], timeout=600)
+    assert 'steps=2' in out
+
+
+def _importable(mod):
+    import importlib.util
+    return importlib.util.find_spec(mod) is not None
+
+
 def test_imagenet_with_decoded_cache(tmp_path):
     # 16 rows = 2 batches/epoch <= DataLoader prefetch: the epoch-0 cache
     # build is fully drained (and _COMPLETE written) before the first

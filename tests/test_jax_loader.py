@@ -728,3 +728,31 @@ def test_disk_cache_rejects_multiepoch_reader(dataset, tmp_path):
     finally:
         reader.stop()
         reader.join()
+
+
+def test_device_inmem_reiterable(dataset):
+    """A DeviceInMemDataLoader must replay its epochs on every fresh
+    iteration (the resume baseline is static; the live epoch counter is
+    per-pass) — regression for the round-4 epoch-boundary-resume change."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    reader = make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1)
+    with DeviceInMemDataLoader(reader, batch_size=8, num_epochs=2,
+                               seed=3) as loader:
+        first = [np.asarray(b['id']).tolist() for b in loader]
+        second = [np.asarray(b['id']).tolist() for b in loader]
+    assert first and first == second
+
+
+def test_scan_batches_populates_stage_stats(dataset):
+    """scan_batches must feed the same per-stage stats the advisor reads
+    (host_batch_s / device_put_s), not just the batch count."""
+    reader = make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1,
+                         columnar_decode=True)
+    with DataLoader(reader, batch_size=8) as loader:
+        for _ in loader.scan_batches(lambda c, b: (c, b['id']), 0,
+                                     steps_per_call=2, donate_carry=False):
+            pass
+        assert loader.stats['batches'] > 0
+        assert loader.stats['host_batch_s'] > 0.0
+        assert loader.stats['device_put_s'] > 0.0

@@ -362,3 +362,120 @@ def test_weighted_sampling_reader_resume_multiset(dataset, tmp_path):
                     drop_last=False, resume_state=state) as loader2:
         resumed = [int(x) for b in loader2 for x in np.asarray(b['id'])]
     assert sorted(consumed + resumed) == full
+
+
+def test_inmem_deterministic_exact_resume(dataset):
+    """InMemDataLoader(deterministic_cache_order=True): the content-sorted
+    cache makes the epoch stream a pure function of (dataset, seed), so an
+    exact mid-epoch token survives a rebuild through ANY pool — here the
+    interrupted run caches via a thread pool and the resumed run via the
+    dummy pool, the strongest order-scrambling the contract must absorb."""
+    from petastorm_tpu.jax import InMemDataLoader
+
+    def build(pool, resume=None):
+        reader = make_reader(dataset.url, reader_pool_type=pool,
+                             workers_count=3 if pool == 'thread' else 10,
+                             shuffle_row_groups=(pool == 'thread'),
+                             num_epochs=1)
+        return InMemDataLoader(reader, batch_size=BATCH, num_epochs=3,
+                               seed=11, deterministic_cache_order=True,
+                               resume_state=resume)
+
+    with build('thread') as loader:
+        full = [np.asarray(b['id']).tolist() for b in loader]
+    assert len(full) == 3 * (ROWS // BATCH)
+
+    with build('thread') as loader:
+        it = iter(loader)
+        consumed = [np.asarray(next(it)['id']).tolist() for _ in range(8)]
+        state = loader.state_dict()
+
+    state = pickle.loads(pickle.dumps(state))  # fresh-process equivalence
+    with build('dummy', resume=state) as loader2:
+        resumed = [np.asarray(b['id']).tolist() for b in loader2]
+
+    assert consumed + resumed == full
+
+
+def test_inmem_without_deterministic_order_still_refuses(dataset):
+    from petastorm_tpu.jax import InMemDataLoader
+
+    reader = make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1)
+    with InMemDataLoader(reader, batch_size=BATCH, num_epochs=1) as loader:
+        next(iter(loader))
+        with pytest.raises(NotImplementedError,
+                           match='deterministic_cache_order'):
+            loader.state_dict()
+
+
+def test_device_inmem_epoch_boundary_resume(dataset):
+    """DeviceInMemDataLoader: 'k epochs done' + the explicit seed fully
+    determine the continuation; mid-epoch tokens are refused."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    def build(resume=None):
+        reader = make_reader(dataset.url, reader_pool_type='dummy',
+                             shuffle_row_groups=False, num_epochs=1)
+        return DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=3,
+                                     seed=23, resume_state=resume)
+
+    with build() as loader:
+        full = [np.asarray(b['id']).tolist() for b in loader]
+    steps_per_epoch = ROWS // BATCH
+
+    with build() as loader:
+        it = iter(loader)
+        consumed = []
+        for _ in range(steps_per_epoch):  # exactly one full epoch
+            consumed.append(np.asarray(next(it)['id']).tolist())
+        state = loader.state_dict()
+        # mid-epoch must refuse
+        consumed.append(np.asarray(next(it)['id']).tolist())
+        with pytest.raises(ValueError, match='epoch boundaries'):
+            loader.state_dict()
+
+    state = pickle.loads(pickle.dumps(state))
+    with build(resume=state) as loader2:
+        resumed = [np.asarray(b['id']).tolist() for b in loader2]
+    assert consumed[:steps_per_epoch] + resumed == full
+
+    # wrong/absent seed is refused up front
+    reader = make_reader(dataset.url, reader_pool_type='dummy',
+                         shuffle_row_groups=False, num_epochs=1)
+    with pytest.raises(ValueError, match='seed'):
+        DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=3,
+                              seed=99, resume_state=state)
+    reader.stop(); reader.join()
+
+
+def test_device_inmem_scan_epochs_resume(dataset):
+    """scan_epochs group yields are epoch boundaries: a token taken
+    between groups resumes the remaining epochs exactly."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    def build(resume=None):
+        reader = make_reader(dataset.url, reader_pool_type='dummy',
+                             shuffle_row_groups=False, num_epochs=1)
+        return DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=3,
+                                     seed=31, resume_state=resume)
+
+    def collect(loader, max_groups=None):
+        out = []
+        gen = loader.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                 donate_carry=False)
+        for i, (_, ids) in enumerate(gen):
+            out.append(np.asarray(ids))
+            if max_groups is not None and i + 1 == max_groups:
+                break
+        return out
+
+    with build() as loader:
+        full = np.concatenate(collect(loader))
+
+    with build() as loader:
+        first = collect(loader, max_groups=1)
+        state = loader.state_dict()
+    with build(resume=state) as loader2:
+        rest = collect(loader2)
+    got = np.concatenate(first + rest)
+    np.testing.assert_array_equal(got, full)

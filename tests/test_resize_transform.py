@@ -247,3 +247,73 @@ def test_png_fused_resize(png_dataset):
         assert cols[i].shape == rows[i].shape == TARGET + (3,)
         diff = np.abs(cols[i].astype(np.int16) - rows[i].astype(np.int16))
         assert diff.max() <= 2, 'row %d max diff %d' % (i, diff.max())
+
+
+def test_disk_cache_keys_include_resize_identity(jpeg_dataset, tmp_path):
+    """Re-reading through the SAME local-disk cache with a DIFFERENT resize
+    target must not serve stale rows at the old resolution — cached worker
+    payloads are post-transform, so the key carries the transform identity
+    (advisor r3, medium).  Both the per-row and columnar paths."""
+    def read_shapes(target, columnar):
+        spec = ResizeImages({'image': target})
+        with make_reader(jpeg_dataset, transform_spec=spec,
+                         columnar_decode=columnar, shuffle_row_groups=False,
+                         reader_pool_type='dummy', cache_type='local-disk',
+                         cache_location=str(tmp_path / 'cache'),
+                         cache_size_limit=1 << 26) as reader:
+            if columnar:
+                return {tuple(np.asarray(b.image).shape[1:]) for b in reader}
+            return {r.image.shape for r in reader}
+
+    for columnar in (False, True):
+        assert read_shapes((40, 56), columnar) == {(40, 56, 3)}
+        # warm cache now holds (40, 56) rows; a new target must miss it
+        assert read_shapes((24, 32), columnar) == {(24, 32, 3)}, \
+            'stale cached resolution served (columnar=%s)' % columnar
+
+
+def _shift_id_by_1(row):
+    out = dict(row)
+    out['id'] = out['id'] + 1
+    return out
+
+
+def _shift_id_by_2(row):
+    out = dict(row)
+    out['id'] = out['id'] + 2
+    return out
+
+
+def test_disk_cache_distinguishes_opaque_funcs(jpeg_dataset, tmp_path):
+    """Two different opaque TransformSpec funcs over one cache dir get
+    distinct entries (keyed by module.qualname)."""
+    from petastorm_tpu.transform import TransformSpec
+
+    def read_ids(func):
+        with make_reader(jpeg_dataset, transform_spec=TransformSpec(func),
+                         shuffle_row_groups=False, reader_pool_type='dummy',
+                         cache_type='local-disk',
+                         cache_location=str(tmp_path / 'cache'),
+                         cache_size_limit=1 << 26) as reader:
+            return sorted(int(r.id) for r in reader)
+
+    assert read_ids(_shift_id_by_1) == list(range(1, ROWS + 1))
+    assert read_ids(_shift_id_by_2) == list(range(2, ROWS + 2)), \
+        'cache served rows transformed by a different func'
+
+
+def test_wildcard_shape_resize_keeps_schema_wildcard(jpeg_dataset):
+    """A fully-wildcard base field (shape=None, normalized to ()) gets NO
+    (h, w) schema override — asserting 2-D would misdeclare 3-channel
+    images (advisor r3, low)."""
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('W', [
+        UnischemaField('id', np.int64, (), None, False),
+        UnischemaField('image', np.uint8, None,
+                       CompressedImageCodec('png'), False),
+    ])
+    spec = ResizeImages({'image': (10, 12)})
+    out = transform_schema(schema, spec)
+    assert out.fields['image'].shape == ()  # wildcard declaration survives

@@ -288,3 +288,58 @@ def test_dataset_as_rdd(tmp_path):
                                schema_fields=['id']).collect()
     assert not hasattr(view_rows[0], 'vec')
     assert sorted(int(r.id) for r in view_rows) == list(range(12))
+
+
+def test_pandas_and_spark_paths_read_back_identically(tmp_path):
+    """The Spark and pandas converters are TWIN write paths to one reader
+    contract: the same logical frame materialized through each must read
+    back byte-identically through make_batch_reader — same columns, same
+    post-normalization dtypes (vector/float64 -> float32), same cell
+    values.  The Spark leg necessarily runs over the duck-typed fake
+    (pyspark cannot exist in this sandbox; PARITY.md states the residual
+    risk), so what this pins down is OUR code's converter semantics being
+    the same function of the input frame on both branches — the tightest
+    compat claim available without a live JVM."""
+    import fake_pyspark
+    from fake_pyspark import FakeSparkSession
+
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.spark import make_pandas_converter
+
+    n = 24
+    parent_spark = 'file://' + str(tmp_path / 'spark_cache')
+    parent_pd = 'file://' + str(tmp_path / 'pd_cache')
+
+    session = FakeSparkSession(
+        {SparkDatasetConverter.PARENT_CACHE_DIR_URL_CONF: parent_spark})
+    with fake_pyspark.installed():
+        conv_spark = make_spark_converter(_fake_df(session, n=n))
+
+    pdf = pd.DataFrame({
+        'features': [np.arange(4, dtype=np.float64) + i for i in range(n)],
+        'weight': np.linspace(0.0, 1.0, n),
+        'label': np.arange(n, dtype=np.int64),
+    })
+    conv_pd = make_pandas_converter(pdf, parent_cache_dir_url=parent_pd)
+
+    def read_back(conv):
+        with make_batch_reader(conv.cache_dir_url, num_epochs=1,
+                               reader_pool_type='dummy') as reader:
+            chunks = list(reader)
+        out = {}
+        for name in chunks[0]._fields:
+            col = np.concatenate([np.asarray(getattr(c, name))
+                                  for c in chunks])
+            out[name] = col
+        return out
+
+    a, b = read_back(conv_spark), read_back(conv_pd)
+    assert set(a) == set(b) == {'features', 'weight', 'label'}
+    for name in a:
+        order_a, order_b = np.argsort(a['label']), np.argsort(b['label'])
+        assert a[name].dtype == b[name].dtype, name
+        np.testing.assert_array_equal(a[name][order_a], b[name][order_b],
+                                      err_msg=name)
+    assert len(conv_spark) == len(conv_pd) == n
+    conv_spark.delete()
+    conv_pd.delete()
