@@ -19,6 +19,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(args, timeout=420):
     env = dict(os.environ, JAX_PLATFORMS='cpu')
     env.pop('PETASTORM_TPU_SKIP_BACKEND_PROBE', None)
+    # The axon accelerator hook rides on PYTHONPATH (sitecustomize) and can
+    # segfault at interpreter teardown even when the run itself is pinned
+    # to CPU (observed on the long_context example); examples self-bootstrap
+    # their sys.path, so the variable isn't needed.
+    env.pop('PYTHONPATH', None)
     res = subprocess.run([sys.executable] + args, capture_output=True,
                          text=True, timeout=timeout, env=env,
                          cwd=REPO)
