@@ -32,7 +32,7 @@ import pickle
 
 import numpy as np
 
-__all__ = ['save_train_state', 'restore_train_state']
+__all__ = ['save_train_state', 'restore_train_state', 'TrainStateManager']
 
 _DATA_KEY = 'petastorm_tpu_data_state'
 _WRAP_KEY = 'petastorm_tpu_wrapped_model'
@@ -54,17 +54,7 @@ def save_train_state(path, model_state, data_state=None, checkpointer=None):
     # Non-dict pytrees wrap under a RESERVED sentinel key so restore can
     # unwrap unambiguously — inferring from ordinary key names would
     # mangle a user dict that happens to use them (e.g. {'model': ...}).
-    if isinstance(model_state, dict):
-        clash = {_DATA_KEY, _WRAP_KEY} & set(model_state)
-        if clash:
-            raise ValueError('model_state uses reserved key(s) %s'
-                             % sorted(clash))
-        payload = dict(model_state)
-    else:
-        payload = {_WRAP_KEY: model_state}
-    if data_state is not None:
-        blob = np.frombuffer(pickle.dumps(data_state), np.uint8).copy()
-        payload[_DATA_KEY] = blob
+    payload = _wrap_payload(model_state, data_state)
     (checkpointer or _default_checkpointer()).save(str(path), payload)
 
 
@@ -74,6 +64,26 @@ def restore_train_state(path, checkpointer=None):
     the same top-level structure it was saved with (a dict stays a dict;
     a non-dict pytree comes back under its original structure)."""
     restored = (checkpointer or _default_checkpointer()).restore(str(path))
+    return _split_payload(restored)
+
+
+def _wrap_payload(model_state, data_state):
+    """model pytree + pickled data-plane token -> one orbax payload."""
+    if isinstance(model_state, dict):
+        clash = {_DATA_KEY, _WRAP_KEY} & set(model_state)
+        if clash:
+            raise ValueError('model_state uses reserved key(s) %s'
+                             % sorted(clash))
+        payload = dict(model_state)
+    else:
+        payload = {_WRAP_KEY: model_state}
+    if data_state is not None:
+        payload[_DATA_KEY] = np.frombuffer(pickle.dumps(data_state),
+                                           np.uint8).copy()
+    return payload
+
+
+def _split_payload(restored):
     data_state = None
     blob = restored.pop(_DATA_KEY, None)
     if blob is not None:
@@ -81,3 +91,111 @@ def restore_train_state(path, checkpointer=None):
     if set(restored) == {_WRAP_KEY}:
         return restored[_WRAP_KEY], data_state
     return restored, data_state
+
+
+class TrainStateManager(object):
+    """Periodic train-state checkpointing: cadence, retention, async
+    saves, resume-latest — one object for the whole training-loop story.
+
+    Composes orbax's ``CheckpointManager`` with the data-plane-token
+    convention of :func:`save_train_state`, so every retained step holds
+    the model pytree AND the exact input-pipeline position it was taken
+    at.  Async by default: the TPU keeps training while the previous
+    step's state serializes (the idiomatic overlap on hardware where a
+    save would otherwise stall the step loop)::
+
+        mgr = TrainStateManager(path, save_interval_steps=500,
+                                max_to_keep=3)
+        for step, batch in enumerate(loader):
+            params, opt, loss = train_step(params, opt, batch)
+            mgr.save(step, {'params': params, 'opt': opt},
+                     data_state=loader.state_dict())   # no-op off-cadence
+        mgr.wait_until_finished()
+
+        step, model, data_state = TrainStateManager.restore_latest_from(path)
+
+    ``save`` returns True only on the steps the cadence actually
+    persists, so callers may gate the (possibly costly) ``state_dict``
+    snapshot: ``if mgr.should_save(step): mgr.save(step, ...,
+    data_state=loader.state_dict())``.
+    """
+
+    def __init__(self, directory, save_interval_steps=1, max_to_keep=3,
+                 async_save=True):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._mgr = ocp.CheckpointManager(
+            str(directory),
+            options=ocp.CheckpointManagerOptions(
+                save_interval_steps=save_interval_steps,
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save))
+
+    def should_save(self, step):
+        """True when the cadence would persist ``step`` — gate expensive
+        ``state_dict()`` drains on this."""
+        return self._mgr.should_save(step)
+
+    def save(self, step, model_state, data_state=None, force=False):
+        """Persist ``(model_state, data_state)`` at ``step`` when the
+        cadence says so (or always, with ``force=True``); returns whether
+        a save actually happened.  Async: returns as soon as the arrays
+        are snapshotted; serialization overlaps subsequent steps."""
+        if not force and not self._mgr.should_save(step):
+            # Off-cadence: skip BEFORE building the payload — pickling a
+            # loader token every step would be recurring hot-loop work.
+            return False
+        payload = _wrap_payload(model_state, data_state)
+        return self._mgr.save(step, args=self._ocp.args.PyTreeSave(payload),
+                              force=force)
+
+    def restore(self, step, restore_args=None):
+        """Returns ``(model_state, data_state)`` for a retained step.
+
+        ``restore_args``: an ``ocp.args.*`` instance (e.g.
+        ``ocp.args.PyTreeRestore(target_with_shardings)``) — REQUIRED in
+        practice when restoring sharded arrays on a different device
+        topology than the save (orbax's sharding-from-file fallback is
+        unsafe across topology changes)."""
+        restored = self._mgr.restore(step, args=restore_args) \
+            if restore_args is not None else self._mgr.restore(step)
+        return _split_payload(restored)
+
+    def restore_latest(self, restore_args=None):
+        """Returns ``(step, model_state, data_state)``, or
+        ``(None, None, None)`` when the directory holds no checkpoint."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, None, None
+        model_state, data_state = self.restore(step,
+                                               restore_args=restore_args)
+        return step, model_state, data_state
+
+    @classmethod
+    def restore_latest_from(cls, directory, restore_args=None):
+        """One-shot resume: open, restore the latest step, close.  Use
+        this (not a throwaway instance) outside a training loop — the
+        manager owns background threads that only ``close()`` releases."""
+        with cls(directory) as mgr:
+            return mgr.restore_latest(restore_args=restore_args)
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def wait_until_finished(self):
+        """Block until pending async saves are durable — call before
+        relying on the files (end of training, pre-emption handler)."""
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.wait_until_finished()
+        self.close()

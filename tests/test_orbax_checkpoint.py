@@ -183,3 +183,69 @@ def test_model_key_dict_stays_a_dict(tmp_path):
     pt_ckpt.save_train_state(tmp_path / 'ckpt5', [jnp.zeros(3), jnp.ones(2)])
     model, _ = pt_ckpt.restore_train_state(tmp_path / 'ckpt5')
     assert isinstance(model, (list, tuple)) and len(model) == 2
+
+
+def test_train_state_manager_cadence_retention_resume(tmp_path):
+    """TrainStateManager: save cadence + retention + async + resume-latest,
+    with the data-plane token riding every retained step."""
+    pytest.importorskip('orbax.checkpoint')
+    from petastorm_tpu.checkpoint import TrainStateManager
+
+    ckdir = tmp_path / 'mgr'
+    with TrainStateManager(ckdir, save_interval_steps=2,
+                           max_to_keep=2) as mgr:
+        for step in range(7):
+            mgr.save(step, {'w': np.full(3, step, np.float32)},
+                     data_state={'cursor': step, 'epoch': step // 4})
+        mgr.wait_until_finished()
+        assert mgr.all_steps() == [4, 6]  # cadence 2, keep last 2
+
+    step, model, data = TrainStateManager.restore_latest_from(ckdir)
+    assert step == 6
+    np.testing.assert_array_equal(np.asarray(model['w']),
+                                  np.full(3, 6, np.float32))
+    assert data == {'cursor': 6, 'epoch': 1}
+
+
+def test_train_state_manager_empty_dir(tmp_path):
+    pytest.importorskip('orbax.checkpoint')
+    from petastorm_tpu.checkpoint import TrainStateManager
+
+    step, model, data = TrainStateManager.restore_latest_from(
+        tmp_path / 'none')
+    assert step is None and model is None and data is None
+
+
+def test_train_state_manager_force_and_loader_token(tmp_path):
+    """force=True persists off-cadence; a REAL loader token round-trips and
+    resumes the stream exactly (the manager is the train-loop-facing shell
+    over the same exactness contract)."""
+    pytest.importorskip('orbax.checkpoint')
+    from petastorm_tpu.checkpoint import TrainStateManager
+    from petastorm_tpu.jax import DataLoader
+
+    ds = create_test_dataset('file://' + str(tmp_path / 'ds3'), num_rows=30,
+                             rows_per_rowgroup=5)
+
+    def build(resume=None):
+        reader = make_reader(ds.url, reader_pool_type='dummy',
+                             shuffle_row_groups=False, num_epochs=1,
+                             resume_state=(resume or {}).get('reader'))
+        return DataLoader(reader, batch_size=5, resume_state=resume)
+
+    with build() as loader:
+        full = [np.asarray(b['id']).tolist() for b in loader]
+
+    with TrainStateManager(tmp_path / 'mgr2', save_interval_steps=1000,
+                           async_save=False) as mgr:
+        with build() as loader:
+            it = iter(loader)
+            first = [np.asarray(next(it)['id']).tolist() for _ in range(2)]
+            assert mgr.save(7, {'w': np.zeros(2)},
+                            data_state=loader.state_dict(), force=True)
+
+    step, _, token = TrainStateManager.restore_latest_from(tmp_path / 'mgr2')
+    assert step == 7
+    with build(resume=token) as loader2:
+        rest = [np.asarray(b['id']).tolist() for b in loader2]
+    assert first + rest == full
