@@ -52,7 +52,8 @@ def make_transform(image_hw):
 
 
 def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
-          model_name='resnet50', decoded_cache_dir=None, hbm_cache=False):
+          model_name='resnet50', decoded_cache_dir=None, hbm_cache=False,
+          scan_steps=0):
     mesh = make_mesh()
     sharding = data_parallel_sharding(mesh)
     stateless = model_name == 'vit'
@@ -100,6 +101,17 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
         updates, new_opt = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), new_stats, new_opt, loss
 
+    def scan_step(carry, batch):
+        # Shared by both fused-consumption modes (scan_epochs over the HBM
+        # cache, scan_batches over a stream): per-step augmentation
+        # randomness rides in the carry.
+        params, batch_stats, opt_state, key = carry
+        key, sub = jax.random.split(key)
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, batch['image'], batch['label'],
+            sub)
+        return (params, batch_stats, opt_state, key), loss
+
     if hbm_cache:
         # Decoded shard fits HBM: cache it on device and run whole epochs
         # as ONE lax.scan dispatch each (DeviceInMemDataLoader.scan_epochs)
@@ -112,15 +124,6 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
                          workers_count=8) as reader:
             loader = DeviceInMemDataLoader(reader, batch_size=batch_size,
                                            num_epochs=None, seed=17)
-
-            def scan_step(carry, batch):
-                params, batch_stats, opt_state, key = carry
-                key, sub = jax.random.split(key)
-                params, batch_stats, opt_state, loss = train_step(
-                    params, batch_stats, opt_state, batch['image'],
-                    batch['label'], sub)
-                return (params, batch_stats, opt_state, key), loss
-
             carry = (params, batch_stats, opt_state, jax.random.PRNGKey(17))
             done = 0
             loss = None
@@ -160,6 +163,31 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
         else:
             loader = DataLoader(reader, batch_size=batch_size,
                                 sharding=sharding)
+        if scan_steps >= 1:
+            # Fused streaming consumption: k host batches stack into one
+            # device_put + one lax.scan dispatch (DataLoader.scan_batches)
+            # — the countermeasure when per-dispatch latency, not decode,
+            # is the stall (high-latency links, very fast steps).
+            carry = (params, batch_stats, opt_state, jax.random.PRNGKey(17))
+            loss = None
+            for carry, losses in loader.scan_batches(
+                    scan_step, carry, steps_per_call=scan_steps,
+                    donate_carry=False):
+                done += int(losses.shape[0])
+                loss = losses[-1]
+                if done >= steps:
+                    break
+            jax.block_until_ready(loss)
+            dt = time.monotonic() - t0
+            print('steps=%d loss=%.3f images/s=%.1f (scan_batches k=%d: '
+                  'fused dispatch)'
+                  % (done, float(loss), done * batch_size / dt, scan_steps))
+            # scan_batches populates the same per-stage stats, so the
+            # bottleneck advisor still gets a verdict (no StallMonitor —
+            # per-batch wrapping doesn't apply to fused consumption).
+            from petastorm_tpu.benchmark import diagnose, format_report
+            print(format_report(diagnose(loader)))
+            return {'steps': done, 'stall_pct': None}
         step_key = jax.random.PRNGKey(17)
         for batch in monitor.wrap(loader):
             step_key, key = jax.random.split(step_key)
@@ -197,7 +225,13 @@ if __name__ == '__main__':
                         help='decode once into device HBM and run each '
                              'epoch as one fused lax.scan dispatch '
                              '(single-device; shard per host on pods)')
+    parser.add_argument('--scan-steps', type=int, default=0,
+                        help='consume the streaming (or disk-cached) loader '
+                             'via scan_batches: K steps per stacked '
+                             'device_put + lax.scan dispatch — use when '
+                             'dispatch/transport latency, not decode, is '
+                             'the stall')
     args = parser.parse_args()
     train(args.dataset_url, args.steps, args.batch_size,
           model_name=args.model, decoded_cache_dir=args.decoded_cache_dir,
-          hbm_cache=args.hbm_cache)
+          hbm_cache=args.hbm_cache, scan_steps=args.scan_steps)
