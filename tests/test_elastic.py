@@ -487,3 +487,47 @@ def test_reshard_rejects_divergent_seeds(dataset):
         r.join()
     with pytest.raises(ValueError, match='seed'):
         reshard_reader_states(states, 3)
+
+
+def test_elastic_resume_through_train_state_manager(dataset, tmp_path):
+    """The deployment-story glue (docs/deployment.md §4): each of K hosts
+    checkpoints its model + loader token through TrainStateManager; a new
+    M-host topology restores the latest step, reshards the K tokens, and
+    loses no rows."""
+    pytest.importorskip('orbax.checkpoint')
+    from petastorm_tpu.checkpoint import TrainStateManager
+
+    num_epochs = 2
+    kw = dict(num_epochs=num_epochs, shuffle_row_groups=True, seed=11,
+              reader_pool_type='dummy')
+    consumed = []
+    for s in range(2):  # each "host" saves under its own directory
+        reader = make_reader(dataset.url, cur_shard=s, shard_count=2, **kw)
+        loader = DataLoader(reader, batch_size=4, prefetch=1)
+        it = iter(loader)
+        for _ in range(2 + s):
+            consumed.extend(_ids(_batch_rows(next(it))))
+        with TrainStateManager(tmp_path / ('host_%d' % s),
+                               async_save=False) as mgr:
+            mgr.save(10, {'w': np.zeros(2)},
+                     data_state=loader.state_dict(), force=True)
+        loader.__exit__(None, None, None)
+
+    states = []
+    for s in range(2):
+        step, _, token = TrainStateManager.restore_latest_from(
+            tmp_path / ('host_%d' % s))
+        assert step == 10
+        states.append(token)
+
+    after = []
+    for m, state in enumerate(reshard_loader_states(states, 3)):
+        reader = make_reader(dataset.url, cur_shard=m, shard_count=3,
+                             resume_state=state['reader'], **kw)
+        with DataLoader(reader, batch_size=4, prefetch=1, drop_last=False,
+                        resume_state=state) as loader:
+            for batch in loader:
+                after.extend(_ids(_batch_rows(batch)))
+
+    assert Counter(consumed) + Counter(after) == \
+        Counter({i: num_epochs for i in range(ROWS)})

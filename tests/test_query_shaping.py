@@ -163,3 +163,37 @@ def test_reader_with_disk_cache_consistent(tmp_path):
     first = read_ids()
     second = read_ids()  # all hits
     assert first == second == list(range(20))
+
+
+def test_batch_reader_disk_cache_distinguishes_transforms(tmp_path):
+    """make_batch_reader caches POST-transform tables; two different
+    TransformSpec funcs over one cache dir must not share entries
+    (advisor r3 medium, batch-path leg)."""
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.transform import TransformSpec
+
+    ds = create_test_dataset('file://' + str(tmp_path / 'bds'), num_rows=20,
+                             rows_per_rowgroup=5)
+
+    def read_ids(func):
+        spec = None if func is None else TransformSpec(func)
+        with make_batch_reader(ds.url, reader_pool_type='dummy',
+                               shuffle_row_groups=False, transform_spec=spec,
+                               cache_type='local-disk',
+                               cache_location=str(tmp_path / 'bcache'),
+                               cache_size_limit=1 << 26) as reader:
+            out = []
+            for chunk in reader:
+                out.extend(int(i) for i in chunk.id)
+            return sorted(out)
+
+    assert read_ids(None) == list(range(20))
+    assert read_ids(_df_ids_plus_100) == list(range(100, 120)), \
+        'cache served untransformed tables for a transformed reader'
+    assert read_ids(None) == list(range(20))
+
+
+def _df_ids_plus_100(df):
+    df = df.copy()
+    df['id'] = df['id'] + 100
+    return df
