@@ -94,6 +94,11 @@ def _load():
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
         ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
         ctypes.c_char_p, ctypes.c_size_t]
+    lib.pt_npy_copy_batch.restype = ctypes.c_int
+    lib.pt_npy_copy_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t]
     return lib
 
 
@@ -165,9 +170,15 @@ def _arrow_ptr_arrays(column):
             (ptrs, lens, chunks))
 
 
-def _marshal_cells(cells):
+def _marshal_cells(cells, expected_n=None):
     """Cells (list[bytes] OR pyarrow binary column) -> (char**, size_t*, n,
-    keepalive); None if this cell container can't go native."""
+    keepalive); None if this cell container can't go native.
+
+    ``expected_n``: the destination batch's row count — a cell count that
+    differs must NOT reach the C loop (it would memcpy past the end of
+    dst); mismatches return None so callers take the python fallback."""
+    if expected_n is not None and len(cells) != expected_n:
+        return None
     if isinstance(cells, (list, tuple)):
         if any(c is None for c in cells):
             return None
@@ -203,7 +214,7 @@ def jpeg_decode_batch(cells, dst):
         h, w, c = dst.shape[1], dst.shape[2], 1
     else:
         return False
-    marshalled = _marshal_cells(cells)
+    marshalled = _marshal_cells(cells, expected_n=len(dst))
     if marshalled is None:
         return False
     ptrs, lens, n, keep = marshalled
@@ -236,7 +247,7 @@ def jpeg_decode_resize_batch(cells, dst):
         h, w, c = dst.shape[1], dst.shape[2], 1
     else:
         return False
-    marshalled = _marshal_cells(cells)
+    marshalled = _marshal_cells(cells, expected_n=len(dst))
     if marshalled is None:
         return False
     ptrs, lens, n, keep = marshalled
@@ -262,7 +273,7 @@ def png_decode_resize_batch(cells, dst):
         h, w, c = dst.shape[1], dst.shape[2], 1
     else:
         return False
-    marshalled = _marshal_cells(cells)
+    marshalled = _marshal_cells(cells, expected_n=len(dst))
     if marshalled is None:
         return False
     ptrs, lens, n, keep = marshalled
@@ -287,7 +298,7 @@ def png_decode_batch(cells, dst):
         h, w, c = dst.shape[1], dst.shape[2], 1
     else:
         return False
-    marshalled = _marshal_cells(cells)
+    marshalled = _marshal_cells(cells, expected_n=len(dst))
     if marshalled is None:
         return False
     ptrs, lens, n, keep = marshalled
@@ -297,15 +308,13 @@ def png_decode_batch(cells, dst):
     return rc == 0
 
 
-def zlib_npy_decompress_batch(cells, dst):
-    """Inflate+unpack list[bytes] zlib(.npy) cells into a (N, ...) array.
-
-    Every cell's .npy header must declare exactly the C-ordered dtype+shape
-    of a ``dst`` slice (np.lib.format's key order is fixed, so this is an
-    exact prefix match rendered here); Fortran-ordered / reshaped / foreign-
-    dtype cells are rejected natively and handled by the caller's ``np.load``
-    fallback.  Returns True on full success, False -> caller falls back.
-    """
+def _npy_batch_call(fn_name, cells, dst):
+    """Shared driver for the .npy column fast paths: render the exact
+    header prefix np.save emits for dst's dtype/shape (np.lib.format's
+    key order is fixed, so prefix match is exact), marshal the cells,
+    and run one GIL-free C call over the whole column.  Fortran-ordered /
+    reshaped / foreign-dtype cells are rejected natively and handled by
+    the caller's ``np.load`` fallback.  True on full success."""
     lib = get_lib()
     if lib is None or not dst.flags['C_CONTIGUOUS'] or dst.dtype.hasobject:
         return False
@@ -315,12 +324,26 @@ def zlib_npy_decompress_batch(cells, dst):
     expected = "{'descr': %r, 'fortran_order': False, 'shape': %r," \
         % (dst.dtype.str, tuple(dst.shape[1:]))
     expected = expected.encode('latin1')
-    marshalled = _marshal_cells(cells)
+    marshalled = _marshal_cells(cells, expected_n=len(dst))
     if marshalled is None:
         return False
     ptrs, lens, n, keep = marshalled
-    rc = lib.pt_zlib_npy_decompress_batch(
+    rc = getattr(lib, fn_name)(
         ptrs, lens, n, dst.ctypes.data_as(ctypes.c_void_p),
         ctypes.c_size_t(cell_bytes), expected, ctypes.c_size_t(len(expected)))
     del keep
     return rc == 0
+
+
+def zlib_npy_decompress_batch(cells, dst):
+    """Inflate+unpack list[bytes] zlib(.npy) cells into a (N, ...) array
+    (CompressedNdarrayCodec column); see :func:`_npy_batch_call`."""
+    return _npy_batch_call('pt_zlib_npy_decompress_batch', cells, dst)
+
+
+def npy_copy_batch(cells, dst):
+    """Validate+copy list[bytes] raw .npy cells into a (N, ...) array
+    (NdarrayCodec column — the pre-decoded-tensor delivery plane): one
+    header check + memcpy per cell, whole column per GIL-free call,
+    replacing a python ``np.load`` per cell; see :func:`_npy_batch_call`."""
+    return _npy_batch_call('pt_npy_copy_batch', cells, dst)

@@ -467,4 +467,44 @@ int pt_zlib_npy_decompress_batch(const uint8_t** srcs, const size_t* lens,
   return failed;
 }
 
+// Raw .npy sibling of pt_zlib_npy_decompress_batch: NdarrayCodec cells
+// store np.save bytes UNCOMPRESSED, so the delivery-plane hot path for
+// pre-decoded tensor datasets (the north-star streaming feed once JPEG
+// is out of the loop) is header-validate + one memcpy per cell.  Doing
+// the whole column in one GIL-free call replaces a python np.load
+// (BytesIO + format dispatch + allocation) per cell.  Same contract and
+// same expected-header prefix rejection as the zlib variant.
+int pt_npy_copy_batch(const uint8_t** srcs, const size_t* lens, int n,
+                      uint8_t* dst, size_t cell_bytes,
+                      const char* expected_hdr, size_t expected_hdr_len) {
+  for (int i = 0; i < n; ++i) {
+    const uint8_t* p = srcs[i];
+    const size_t len = lens[i];
+    if (len < 10 || std::memcmp(p, "\x93NUMPY", 6) != 0) return i + 1;
+    const uint8_t major = p[6];
+    size_t hdr_off, hlen;
+    if (major == 1) {
+      hdr_off = 10;
+      hlen = static_cast<size_t>(p[8]) | (static_cast<size_t>(p[9]) << 8);
+    } else if (major == 2 || major == 3) {
+      if (len < 12) return i + 1;
+      hdr_off = 12;
+      hlen = static_cast<size_t>(p[8]) | (static_cast<size_t>(p[9]) << 8) |
+             (static_cast<size_t>(p[10]) << 16) |
+             (static_cast<size_t>(p[11]) << 24);
+    } else {
+      return i + 1;
+    }
+    if (len < hdr_off + hlen) return i + 1;
+    const size_t data_off = hdr_off + hlen;
+    if (len != data_off + cell_bytes ||     // payload size mismatch
+        hlen < expected_hdr_len ||          // header can't hold the prefix
+        std::memcmp(p + hdr_off, expected_hdr, expected_hdr_len) != 0) {
+      return i + 1;  // fortran_order / shape / dtype differs from schema
+    }
+    std::memcpy(dst + cell_bytes * i, p + data_off, cell_bytes);
+  }
+  return 0;
+}
+
 }  // extern "C"
