@@ -413,6 +413,18 @@ def _device_hbm_bytes():
 #: so the watchdog can emit everything measured so far when a later leg
 #: wedges the tunnel past recovery.
 _PARTIAL = {}
+#: throughput-phase results, stashed by main() the moment they're measured
+#: (train_stall_legs clears _PARTIAL for retries; the watchdog merges both)
+_PARTIAL_BASE = {}
+_T0 = time.monotonic()
+_BUDGET_S = None
+
+
+def _budget_left_s():
+    """Seconds before the watchdog fires (inf when no watchdog is armed)."""
+    if _BUDGET_S is None:
+        return float('inf')
+    return _BUDGET_S - (time.monotonic() - _T0)
 
 
 def train_stall_legs():
@@ -448,13 +460,37 @@ def train_stall_legs():
     def leg(name, fn):
         """Containment boundary: run 1 of round 4 died mid-run when the
         tunnel threw UNAVAILABLE inside the HBM-cache transfer — a mid-run
-        tunnel death must cost THAT leg, not the whole artifact."""
+        tunnel death must cost THAT leg, not the whole artifact.  After a
+        backend-unavailability failure the device is PROBED (subprocess —
+        a wedged tunnel hangs in-process calls) and the remaining legs are
+        skipped while it stays dead: run 2 of this round wasted its last
+        15 min hanging in a leg the probe would have refused.  A leg is
+        also skipped when less than ~2 min of watchdog budget remains —
+        better an explicit skip than a truncated artifact."""
+        if out.get('device_unhealthy'):
+            errors[name] = 'skipped: ' + out['device_unhealthy']
+            return
+        if _budget_left_s() < 120:
+            errors[name] = ('skipped: %.0fs of watchdog budget left'
+                            % _budget_left_s())
+            return
+        t_leg = time.monotonic()
         try:
             out.update(fn())
         except Exception as e:  # noqa: BLE001 — record and keep measuring
             errors[name] = '%s: %s' % (type(e).__name__, str(e)[:160])
             sys.stderr.write('bench: leg %r failed: %s\n'
                              % (name, errors[name]))
+            if ('UNAVAILABLE' in errors[name] or 'DEADLINE' in errors[name]) \
+                    and not _device_probe_ok(timeout_s=60):
+                out['device_unhealthy'] = (
+                    'tunnel unhealthy after leg %r (fresh-interpreter '
+                    'probe failed)' % name)
+                sys.stderr.write('bench: device probe failed after %r; '
+                                 'skipping remaining device legs\n' % name)
+        finally:
+            out.setdefault('leg_elapsed_s', {})[name] = round(
+                time.monotonic() - t_leg, 1)
 
     def diag_of(stall, loader):
         # The advisor's verdict goes into the artifact: WHICH regime
@@ -468,11 +504,15 @@ def train_stall_legs():
         return {'regime': d['regime'], 'evidence': d['evidence']}
 
     state = _make_resnet_step()
-    # The cached leg and the floor are cheap (~28 ms/step, no host work):
-    # run 2x the steps so the wall-vs-floor difference — the stall signal —
-    # sits above run-to-run timer noise.  The streaming legs pay full host
-    # work per step, so they keep the base count.
-    cached_steps = 2 * TRAIN_STEPS
+    # The cached leg and the floor are cheap (~26 ms/step, no host work):
+    # run 4x the steps so (a) the wall-vs-floor difference — the stall
+    # signal — sits above run-to-run timer noise, and (b) the ONE dispatch
+    # round-trip the fused scan window pays is amortized over a window
+    # long enough that tunnel latency can't read as phantom stall (at 72
+    # steps a ~100 ms degraded-tunnel round-trip alone is ~5% of wall; at
+    # 144 it is half that).  The streaming legs pay full host work per
+    # step, so they keep the base count.
+    cached_steps = 4 * TRAIN_STEPS
     # No containment for the floor: every stall% needs this denominator.
     floor_ms = _device_floor_ms(state, cached_steps)
     out['device_step_ms'] = round(floor_ms, 2)
@@ -762,7 +802,8 @@ _COMPACT_KEYS = (
     'stall_pct_decoded_cache', 'stall_pct_decoded_cache_scan',
     'streaming_scan_floor_stall_pct', 'transport_bound', 'device_step_ms',
     'step_dtype', 'model_tflops_per_s', 'device_peak_tflops_bf16',
-    'mfu_pct', 'legs_failed', 'throughput_error', 'error',
+    'mfu_pct', 'legs_failed', 'throughput_error', 'device_unhealthy',
+    'error',
 )
 
 
@@ -788,6 +829,29 @@ def _emit(result):
     print(json.dumps(compact), flush=True)
 
 
+def _certify_into(result, backend_label, unhealthy=None):
+    """Run kernel certification into ``result`` — or record WHY not.
+
+    Certification compiles ~8 more executables (minutes on a cold chip)
+    and, on a wedged tunnel, HANGS rather than fails — run 2 of round 4
+    burned its last 15 min inside it.  Only start it with the budget to
+    finish and a device the probe still likes."""
+    if unhealthy:
+        result['kernel_cert_error'] = 'skipped: %s' % unhealthy
+        return
+    if _budget_left_s() < 420:
+        result['kernel_cert_error'] = (
+            'skipped: %.0fs of watchdog budget left (certs need ~7 min '
+            'of compiles)' % _budget_left_s())
+        return
+    try:
+        result['kernel_max_err'] = kernel_certification()
+        result['kernel_backend'] = backend_label
+    except Exception as e:  # noqa: BLE001 — certs must not cost the artifact
+        result['kernel_cert_error'] = '%s: %s' % (type(e).__name__,
+                                                  str(e)[:160])
+
+
 def _start_watchdog(budget_s):
     """Print a diagnostic JSON line and hard-exit if the run wedges.
 
@@ -800,11 +864,18 @@ def _start_watchdog(budget_s):
     def fire():
         # Everything measured before the wedge still ships: merge the
         # compact subset of the partial leg results into the error line.
-        partial = {k: _PARTIAL[k] for k in _COMPACT_KEYS
-                   if _PARTIAL.get(k) is not None}
+        # The throughput phase stashes into _PARTIAL_BASE the moment its
+        # medians exist (run 2 of round 4 lost a fully measured value to
+        # this handler's old unconditional 0.0).
+        merged = dict(_PARTIAL_BASE)
+        merged.update(_PARTIAL)
+        partial = {k: merged[k] for k in _COMPACT_KEYS
+                   if merged.get(k) is not None}
+        partial.setdefault('value', 0.0)
+        partial.setdefault('vs_baseline', 0.0)
         partial.update({
             'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
-            'value': 0.0, 'unit': 'images/s', 'vs_baseline': 0.0,
+            'unit': 'images/s',
             'error': 'watchdog: run exceeded %ds — TPU tunnel likely wedged; '
                      'stacks on stderr; stall fields above are the legs '
                      'that completed' % budget_s,
@@ -813,6 +884,9 @@ def _start_watchdog(budget_s):
         faulthandler.dump_traceback(file=sys.stderr)
         os._exit(3)
 
+    global _T0, _BUDGET_S
+    _T0 = time.monotonic()
+    _BUDGET_S = budget_s
     timer = threading.Timer(budget_s, fire)
     timer.daemon = True
     timer.start()
@@ -909,6 +983,14 @@ def main():
     theirs = float(np.median(theirs_runs)) if theirs_runs else 0.0
     ratio = float(np.median([o / t for o, t in pairs])) if pairs else 0.0
     spread = (max(ours_runs) - min(ours_runs)) if ours_runs else 0.0
+    # Stash NOW: a watchdog partial fired during the train legs must still
+    # carry the (already measured) throughput phase.
+    _PARTIAL_BASE.update({
+        'value': round(ours, 1), 'value_spread': round(spread, 1),
+        'runs': repeats, 'vs_baseline': round(ratio, 2),
+        'backend': jax.default_backend(),
+        'throughput_error': throughput_error,
+    })
 
     if cpu_fallback:
         # ResNet-50 train legs need the chip (~30 s/step on host CPU);
@@ -932,13 +1014,8 @@ def main():
             'throughput_error': throughput_error,
             'stall_pct': None,
         }
-        try:
-            result['kernel_max_err'] = kernel_certification()
-            result['kernel_backend'] = ('cpu (Pallas interpreter; Mosaic '
-                                        'untested this run)')
-        except Exception as e:  # noqa: BLE001 — certs must not cost the line
-            result['kernel_cert_error'] = '%s: %s' % (type(e).__name__,
-                                                      str(e)[:160])
+        _certify_into(result, 'cpu (Pallas interpreter; Mosaic untested '
+                              'this run)')
         watchdog.cancel()
         _emit(result)
         return
@@ -985,14 +1062,10 @@ def main():
                       'disk cache, per-step / fused',
     }
     result.update(stall)
-    try:
-        result['kernel_max_err'] = kernel_certification()
-        result['kernel_backend'] = (
-            'tpu (Mosaic)' if jax.default_backend() == 'tpu'
-            else jax.default_backend() + ' (Pallas interpreter)')
-    except Exception as e:  # noqa: BLE001 — certs must not cost the artifact
-        result['kernel_cert_error'] = '%s: %s' % (type(e).__name__,
-                                                  str(e)[:160])
+    _certify_into(result,
+                  'tpu (Mosaic)' if jax.default_backend() == 'tpu'
+                  else jax.default_backend() + ' (Pallas interpreter)',
+                  unhealthy=stall.get('device_unhealthy'))
     watchdog.cancel()
     _emit(result)
 

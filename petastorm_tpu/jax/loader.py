@@ -861,9 +861,10 @@ class DeviceInMemDataLoader(InMemDataLoader):
         self._dev_cache = None
         self._gather_fn = None
         self._steps_into_epoch = 0
-        #: epochs to SKIP at the head of every pass (from a resume token);
-        #: static — re-iterating the loader replays from this baseline.
+        #: (epochs, steps) to SKIP at the head of every pass (from a resume
+        #: token); static — re-iterating the loader replays this baseline.
         self._start_epoch = 0
+        self._start_step = 0
         #: live position of the CURRENT pass (state_dict reads it); reset
         #: to the baseline whenever a fresh pass begins.
         self._epochs_done = 0
@@ -875,8 +876,24 @@ class DeviceInMemDataLoader(InMemDataLoader):
                     'rebuild the loader with that explicit seed (the '
                     'permutation stream is derived from it)'
                     % (resumed['seed'],))
+            token_bs = resumed.get('batch_size')
+            if token_bs is not None and int(token_bs) != int(batch_size):
+                raise ValueError(
+                    'device_inmem resume token was taken with batch_size=%d '
+                    '(got %d); the step cursor counts batches of that size'
+                    % (int(token_bs), int(batch_size)))
             self._start_epoch = int(resumed['epochs_done'])
+            self._start_step = int(resumed.get('steps_into_epoch', 0))
+            if self._start_step and not self._deterministic:
+                raise ValueError(
+                    'mid-epoch device_inmem resume requires '
+                    'deterministic_cache_order=True: the step cursor indexes '
+                    'into the cached row order, which only the canonical '
+                    'content-sorted cache reproduces across restarts')
             self._epochs_done = self._start_epoch
+            # A state_dict() taken BEFORE the first next() must re-emit the
+            # restored cursor, not an epoch-start rewind of it.
+            self._steps_into_epoch = self._start_step
 
     def _materialize(self):
         """Build the HBM-resident epoch cache (idempotent); returns the
@@ -918,11 +935,19 @@ class DeviceInMemDataLoader(InMemDataLoader):
 
         def gen():
             self._epochs_done = self._start_epoch  # fresh pass
-            self._steps_into_epoch = 0
+            self._steps_into_epoch = self._start_step
+            skip = self._start_step  # mid-epoch baseline: first epoch only
             for order in self._epoch_orders(n):
                 stop = n - self.batch_size + 1 if self._drop_last else n
                 starts = list(range(0, max(stop, 0), self.batch_size))
+                if skip and skip >= len(starts):
+                    raise ValueError(
+                        'device_inmem resume token is %d steps into an epoch '
+                        'of %d steps — the dataset or batch geometry changed '
+                        'since the checkpoint' % (skip, len(starts)))
                 for j, start in enumerate(starts):
+                    if j < skip:
+                        continue
                     if start + self.batch_size <= n:
                         batch = self._gather_fn(cache, order, start)
                     else:  # ragged tail (drop_last=False): plain gather
@@ -940,6 +965,7 @@ class DeviceInMemDataLoader(InMemDataLoader):
                     else:
                         self._steps_into_epoch = j + 1
                     yield batch
+                skip = 0
         return gen()
 
     def _epoch_orders(self, n):
@@ -1011,6 +1037,13 @@ class DeviceInMemDataLoader(InMemDataLoader):
 
         if epochs_per_call < 1:
             raise ValueError('epochs_per_call must be >= 1')
+        if self._start_step:
+            raise ValueError(
+                'scan_epochs folds whole epochs into each dispatch and '
+                'cannot start %d steps into one; finish the partial epoch '
+                'with the per-step iterator first, then checkpoint at the '
+                'boundary and resume scan_epochs from that token'
+                % self._start_step)
         cache = self._materialize()
         if cache is None:
             return
@@ -1058,28 +1091,37 @@ class DeviceInMemDataLoader(InMemDataLoader):
             yield carry, outs
 
     def state_dict(self):
-        """Epoch-boundary resume token.  The HBM gather plane keeps no
-        host-visible mid-epoch cursor (that is the point — zero host work
-        per step), but the permutation stream is a pure function of the
-        explicit ``seed``, so '``k`` epochs done' fully determines the
-        continuation: resume with ``DeviceInMemDataLoader(reader', ...,
-        seed=same_seed, num_epochs=same_total, resume_state=token)`` and
-        the remaining epochs replay exactly.  Mid-epoch checkpoints want
-        :class:`InMemDataLoader` with ``deterministic_cache_order=True``
-        or :class:`DiskCachedDataLoader`."""
+        """Resume token.  The permutation stream is a pure function of the
+        explicit ``seed``, so ``(epochs_done, steps_into_epoch)`` fully
+        determines the continuation: resume with
+        ``DeviceInMemDataLoader(reader', ..., seed=same_seed,
+        num_epochs=same_total, resume_state=token)`` and the remaining
+        stream replays exactly.
+
+        Exactness across a process restart also needs the rebuilt cache to
+        hold the rows in the checkpointed order (the permutation indexes
+        into it): at an **epoch boundary** any complete cache works (the
+        continuation is a seed-exact permutation over the same row set);
+        **mid-epoch** the row order itself must reproduce, so a mid-epoch
+        token requires ``deterministic_cache_order=True`` — without it,
+        checkpoint at a boundary or use :class:`DiskCachedDataLoader`."""
         if self._seed is None:
-            raise ValueError('epoch-boundary resume needs an explicit '
-                             'seed= (the device permutation stream must be '
-                             're-derivable after restart)')
-        if self._steps_into_epoch:
+            raise ValueError('resume needs an explicit seed= (the device '
+                             'permutation stream must be re-derivable '
+                             'after restart)')
+        if self._steps_into_epoch and not self._deterministic:
             raise ValueError(
-                'DeviceInMemDataLoader checkpoints at epoch boundaries '
-                'only (%d steps into the current epoch); consume the '
-                'epoch, or use InMemDataLoader('
-                'deterministic_cache_order=True) / DiskCachedDataLoader '
-                'for exact mid-epoch resume' % self._steps_into_epoch)
+                'mid-epoch checkpoint (%d steps into the current epoch) '
+                'needs deterministic_cache_order=True — the step cursor '
+                'indexes into the cached row order, which a pool-ordered '
+                'rebuild does not reproduce; consume the epoch, rebuild '
+                'with deterministic_cache_order=True, or use '
+                'DiskCachedDataLoader' % self._steps_into_epoch)
         return {'version': 1,
                 'device_inmem': {'epochs_done': int(self._epochs_done),
+                                 'steps_into_epoch':
+                                     int(self._steps_into_epoch),
+                                 'batch_size': int(self.batch_size),
                                  'seed': int(self._seed)}}
 
 

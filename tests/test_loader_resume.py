@@ -429,9 +429,9 @@ def test_device_inmem_epoch_boundary_resume(dataset):
         for _ in range(steps_per_epoch):  # exactly one full epoch
             consumed.append(np.asarray(next(it)['id']).tolist())
         state = loader.state_dict()
-        # mid-epoch must refuse
+        # mid-epoch without a deterministic cache order must refuse
         consumed.append(np.asarray(next(it)['id']).tolist())
-        with pytest.raises(ValueError, match='epoch boundaries'):
+        with pytest.raises(ValueError, match='deterministic_cache_order'):
             loader.state_dict()
 
     state = pickle.loads(pickle.dumps(state))
@@ -479,3 +479,71 @@ def test_device_inmem_scan_epochs_resume(dataset):
         rest = collect(loader2)
     got = np.concatenate(first + rest)
     np.testing.assert_array_equal(got, full)
+
+
+def test_device_inmem_mid_epoch_resume_deterministic(dataset):
+    """deterministic_cache_order=True unlocks EXACT mid-epoch resume on the
+    HBM loader: (epochs_done, steps_into_epoch) + seed replay the
+    uninterrupted stream's tail, through a pickle round-trip, on any pool
+    (the canonical cache order is what survives the restart)."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    def build(pool, resume=None):
+        reader = make_reader(dataset.url, reader_pool_type=pool,
+                             shuffle_row_groups=False, num_epochs=1)
+        return DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=3,
+                                     seed=47, deterministic_cache_order=True,
+                                     resume_state=resume)
+
+    with build('dummy') as loader:
+        full = [np.asarray(b['id']).tolist() for b in loader]
+    steps_per_epoch = ROWS // BATCH
+    cut = steps_per_epoch + 2  # two steps into epoch 1
+
+    with build('dummy') as loader:
+        it = iter(loader)
+        consumed = [np.asarray(next(it)['id']).tolist() for _ in range(cut)]
+        state = loader.state_dict()
+    assert state['device_inmem']['steps_into_epoch'] == 2
+
+    state = pickle.loads(pickle.dumps(state))
+    # resume on a DIFFERENT pool: delivery order changes, canonical
+    # cache order (and therefore the continuation) must not
+    with build('thread', resume=state) as loader2:
+        # a snapshot BEFORE the first pull must re-emit the restored
+        # cursor, not an epoch-start rewind of it (double-training bug)
+        assert loader2.state_dict()['device_inmem']['steps_into_epoch'] == 2
+        resumed = [np.asarray(b['id']).tolist() for b in loader2]
+    assert consumed + resumed == full
+
+    # the step cursor counts batches of the checkpointed size
+    reader = make_reader(dataset.url, reader_pool_type='dummy',
+                         shuffle_row_groups=False, num_epochs=1)
+    with pytest.raises(ValueError, match='batch_size'):
+        DeviceInMemDataLoader(reader, batch_size=BATCH + 1, num_epochs=3,
+                              seed=47, deterministic_cache_order=True,
+                              resume_state=state)
+    reader.stop(); reader.join()
+
+    # scan_epochs folds whole epochs and must refuse a mid-epoch baseline
+    with build('dummy', resume=state) as loader3:
+        with pytest.raises(ValueError, match='whole epochs'):
+            next(loader3.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                     donate_carry=False))
+
+
+def test_device_inmem_mid_epoch_token_requires_deterministic(dataset):
+    """A mid-epoch token is refused at RESUME time too when the rebuilding
+    loader lacks deterministic_cache_order (the cursor would index into an
+    unreproduced row order)."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    reader = make_reader(dataset.url, reader_pool_type='dummy',
+                         shuffle_row_groups=False, num_epochs=1)
+    token = {'version': 1,
+             'device_inmem': {'epochs_done': 0, 'steps_into_epoch': 3,
+                              'batch_size': BATCH, 'seed': 47}}
+    with pytest.raises(ValueError, match='deterministic_cache_order'):
+        DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=3,
+                              seed=47, resume_state=token)
+    reader.stop(); reader.join()
