@@ -715,6 +715,161 @@ def train_stall_legs():
     return out
 
 
+CRITEO_URL = 'file://' + BENCH_DIR + '/criteo_like_v1'
+DLRM_ROWS = int(os.environ.get('PETASTORM_TPU_BENCH_DLRM_ROWS', '65536'))
+DLRM_BATCH = int(os.environ.get('PETASTORM_TPU_BENCH_DLRM_BATCH', '4096'))
+DLRM_DENSE, DLRM_CAT = 13, 26
+DLRM_VOCAB = int(os.environ.get('PETASTORM_TPU_BENCH_DLRM_VOCAB', '100000'))
+
+
+def ensure_criteo_dataset():
+    """Criteo-shaped plain Parquet (13 dense f32 + 26 hashed-categorical
+    i32 + click label), read through ``make_batch_reader`` — the
+    BASELINE config-#4 acceptance surface (``examples/criteo``)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+
+    fs, path = get_filesystem_and_path_or_paths(CRITEO_URL)
+    if fs.exists(path + '/data.parquet'):
+        return
+    fs.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(1)
+    cols = {'dense_%d' % i: rng.standard_normal(DLRM_ROWS).astype(np.float32)
+            for i in range(DLRM_DENSE)}
+    cols.update({'cat_%d' % i: rng.integers(0, DLRM_VOCAB, DLRM_ROWS)
+                                  .astype(np.int32)
+                 for i in range(DLRM_CAT)})
+    cols['clicked'] = (rng.random(DLRM_ROWS) < 0.03).astype(np.int32)
+    pq.write_table(pa.table(cols), path + '/data.parquet',
+                   row_group_size=2 * DLRM_BATCH)
+
+
+def dlrm_stall_leg():
+    """Criteo->DLRM stall: a gather-bound step (26 vocab-100k embedding
+    tables + small MLPs — memory traffic, not MXU FLOPs) consuming the
+    columnar plane (``make_batch_reader`` -> ``DataLoader(transform_fn=)``),
+    per-step and fused.  The regime the ResNet legs can't show: tiny
+    device step, wide rows, host work = pure column stacking."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.jax import DataLoader
+    from petastorm_tpu.models.dlrm import DLRM
+
+    ensure_criteo_dataset()
+    model = DLRM(vocab_sizes=(DLRM_VOCAB,) * DLRM_CAT, embedding_dim=16,
+                 bottom_mlp=(64, 16), top_mlp=(64, 1), dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, DLRM_DENSE)),
+                        jnp.zeros((1, DLRM_CAT), jnp.int32))['params']
+    tx = optax.adagrad(0.01)  # the canonical DLRM optimizer
+    opt_state = tx.init(params)
+
+    def pack_columns(batch):
+        dense = np.stack([batch['dense_%d' % i] for i in range(DLRM_DENSE)],
+                         axis=1).astype(np.float32)
+        cat = np.stack([batch['cat_%d' % i] for i in range(DLRM_CAT)],
+                       axis=1).astype(np.int32)
+        return {'dense': dense, 'cat': cat,
+                'clicked': batch['clicked'].astype(np.float32)}
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        def loss_fn(p):
+            # model output is already (B,) — see models/dlrm.py __call__
+            logits = model.apply({'params': p}, batch['dense'], batch['cat'])
+            return optax.sigmoid_binary_cross_entropy(
+                logits, batch['clicked']).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    # Device floor: the same step chained on one resident batch.
+    gen = np.random.default_rng(2)
+    resident = jax.device_put({
+        'dense': gen.standard_normal((DLRM_BATCH, DLRM_DENSE))
+                    .astype(np.float32),
+        'cat': gen.integers(0, DLRM_VOCAB, (DLRM_BATCH, DLRM_CAT))
+                  .astype(np.int32),
+        'clicked': (gen.random(DLRM_BATCH) < 0.03).astype(np.float32),
+    })
+    floor_steps = 48
+    p, o, loss = params, opt_state, None
+    for i in range(floor_steps + 8):
+        p, o, loss = train_step(p, o, resident)
+        if i == 7:
+            float(loss)  # compile + pipeline fill drained; open the timer
+            t0 = time.monotonic()
+    float(loss)
+    floor_ms = 1000.0 * (time.monotonic() - t0) / floor_steps
+
+    steps_per_epoch = DLRM_ROWS // DLRM_BATCH
+    max_steps = 2 * steps_per_epoch
+
+    def run(fused):
+        warmup = 2
+        epochs = -(-(max_steps + warmup + 1) // steps_per_epoch)
+        with make_batch_reader(CRITEO_URL, num_epochs=epochs,
+                               workers_count=WORKERS,
+                               shuffle_row_groups=False) as reader:
+            loader = DataLoader(reader, batch_size=DLRM_BATCH, prefetch=2,
+                                transform_fn=pack_columns)
+            if fused:
+                def scan_step(carry, batch):
+                    p, o = carry
+                    p, o, loss = train_step(p, o, batch)
+                    return (p, o), loss
+                gen = loader.scan_batches(scan_step, (params, opt_state),
+                                          steps_per_call=8,
+                                          donate_carry=False)
+                t0 = None
+                steps = 0
+                for _, outs in gen:
+                    if t0 is None:
+                        float(np.asarray(outs).ravel()[-1])  # compile+fill
+                        t0 = time.monotonic()
+                        continue
+                    steps += int(outs.shape[0])
+                    if steps >= max_steps:
+                        break
+                final = np.asarray(outs)
+            else:
+                p, o, loss = params, opt_state, None
+                t0 = None
+                steps = -warmup
+                for batch in loader:
+                    p, o, loss = train_step(p, o, batch)
+                    steps += 1
+                    if steps == 0:
+                        float(loss)
+                        t0 = time.monotonic()
+                    if steps >= max_steps:
+                        break
+                final = np.asarray(float(loss))
+            assert t0 is not None and steps > 0, 'criteo stream too short'
+            assert np.isfinite(final).all(), 'non-finite DLRM loss'
+            wall_ms = 1000.0 * (time.monotonic() - t0) / steps
+            return max(0.0, 100.0 * (wall_ms - floor_ms) / wall_ms), wall_ms
+
+    stall, wall_ms = run(fused=False)
+    scan_stall, scan_ms = run(fused=True)
+    best_ms = min(wall_ms, scan_ms)
+    return {
+        'stall_pct_dlrm': round(stall, 2),
+        'stall_pct_dlrm_scan': round(scan_stall, 2),
+        'dlrm_step_ms_floor': round(floor_ms, 2),
+        'dlrm_rows_per_s': round(DLRM_BATCH / (best_ms / 1000.0)),
+        'dlrm_config': '%dx dense, %dx cat vocab=%d emb=16, batch=%d '
+                       '(make_batch_reader columnar plane)'
+                       % (DLRM_DENSE, DLRM_CAT, DLRM_VOCAB, DLRM_BATCH),
+    }
+
+
 def _model_flops_per_step(state):
     """Exact per-step FLOPs from XLA's own cost model — the absolute anchor
     for stall% (a slow device floor would otherwise flatter the loader)."""
@@ -800,6 +955,7 @@ _COMPACT_KEYS = (
     'stall_pct_hbm_cached', 'stall_pct_hbm_scan', 'stall_pct_streaming',
     'stall_pct_streaming_scan', 'stall_pct_delivery_bound',
     'stall_pct_decoded_cache', 'stall_pct_decoded_cache_scan',
+    'stall_pct_dlrm', 'stall_pct_dlrm_scan', 'dlrm_rows_per_s',
     'streaming_scan_floor_stall_pct', 'transport_bound', 'device_step_ms',
     'step_dtype', 'model_tflops_per_s', 'device_peak_tflops_bf16',
     'mfu_pct', 'legs_failed', 'throughput_error', 'device_unhealthy',
@@ -867,22 +1023,58 @@ def _start_watchdog(budget_s):
         # The throughput phase stashes into _PARTIAL_BASE the moment its
         # medians exist (run 2 of round 4 lost a fully measured value to
         # this handler's old unconditional 0.0).
-        merged = dict(_PARTIAL_BASE)
-        merged.update(_PARTIAL)
-        partial = {k: merged[k] for k in _COMPACT_KEYS
-                   if merged.get(k) is not None}
-        partial.setdefault('value', 0.0)
-        partial.setdefault('vs_baseline', 0.0)
-        partial.update({
-            'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
-            'unit': 'images/s',
-            'error': 'watchdog: run exceeded %ds — TPU tunnel likely wedged; '
-                     'stacks on stderr; stall fields above are the legs '
-                     'that completed' % budget_s,
-        })
-        print(json.dumps(partial), flush=True)
-        faulthandler.dump_traceback(file=sys.stderr)
-        os._exit(3)
+        #
+        # This runs on the timer THREAD while the main thread may still be
+        # mutating _PARTIAL (budget expiring on a slow-but-alive leg), so
+        # every step is contained: a failed snapshot/serialization must
+        # still print SOMETHING and must still os._exit — a dead handler
+        # on a wedged run would mean no artifact and no exit at all.
+        err = ('watchdog: run exceeded %ds — TPU tunnel likely wedged; '
+               'stacks on stderr; stall fields above are the legs '
+               'that completed' % budget_s)
+        try:
+            try:
+                merged = dict(_PARTIAL_BASE)
+                merged.update(_PARTIAL)
+            except RuntimeError:  # dict resized mid-copy by the main thread
+                merged = {}
+                for src in (_PARTIAL_BASE, _PARTIAL):
+                    for k in list(src):
+                        try:
+                            merged[k] = src[k]
+                        except KeyError:
+                            pass
+            partial = {k: merged[k] for k in _COMPACT_KEYS
+                       if merged.get(k) is not None}
+            partial.setdefault('value', 0.0)
+            partial.setdefault('vs_baseline', 0.0)
+            partial.update({
+                'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
+                'unit': 'images/s',
+                'error': err,
+            })
+            print(json.dumps(partial, default=str), flush=True)
+            # The detail file must reflect THIS run too — otherwise a
+            # wedged run leaves the previous run's detail on disk, silently
+            # stale.  AFTER the compact line: the line is the artifact.
+            try:
+                detail_path = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    'BENCH_DETAIL_LAST.json')
+                with open(detail_path, 'w') as f:
+                    json.dump(dict(merged, **partial), f, indent=1,
+                              sort_keys=True, default=str)
+            except Exception:  # noqa: BLE001 — detail is best-effort
+                pass
+            faulthandler.dump_traceback(file=sys.stderr)
+        except Exception:  # noqa: BLE001 — minimal line beats no line
+            print(json.dumps({
+                'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
+                'value': 0.0, 'unit': 'images/s', 'vs_baseline': 0.0,
+                'error': err + ' (partial assembly failed)',
+            }), flush=True)
+        finally:
+            os._exit(3)
 
     global _T0, _BUDGET_S
     _T0 = time.monotonic()
@@ -1062,6 +1254,23 @@ def main():
                       'disk cache, per-step / fused',
     }
     result.update(stall)
+    # Criteo->DLRM leg (BASELINE config #4): a second model family and
+    # regime (gather-bound embeddings over the columnar plane).  Gated
+    # like certification — it compiles 2 more executables and streams two
+    # full passes, and must never cost the imagenet artifact.
+    if stall.get('device_unhealthy'):
+        result['dlrm_error'] = 'skipped: %s' % stall['device_unhealthy']
+    elif _budget_left_s() < 600:
+        result['dlrm_error'] = ('skipped: %.0fs of watchdog budget left'
+                                % _budget_left_s())
+    else:
+        try:
+            dlrm = dlrm_stall_leg()
+            result.update(dlrm)
+            _PARTIAL.update(dlrm)  # a later cert wedge must not lose it
+        except Exception as e:  # noqa: BLE001 — must not cost the artifact
+            result['dlrm_error'] = '%s: %s' % (type(e).__name__,
+                                               str(e)[:160])
     _certify_into(result,
                   'tpu (Mosaic)' if jax.default_backend() == 'tpu'
                   else jax.default_backend() + ' (Pallas interpreter)',

@@ -876,14 +876,20 @@ class DeviceInMemDataLoader(InMemDataLoader):
                     'rebuild the loader with that explicit seed (the '
                     'permutation stream is derived from it)'
                     % (resumed['seed'],))
-            token_bs = resumed.get('batch_size')
-            if token_bs is not None and int(token_bs) != int(batch_size):
-                raise ValueError(
-                    'device_inmem resume token was taken with batch_size=%d '
-                    '(got %d); the step cursor counts batches of that size'
-                    % (int(token_bs), int(batch_size)))
             self._start_epoch = int(resumed['epochs_done'])
             self._start_step = int(resumed.get('steps_into_epoch', 0))
+            token_bs = resumed.get('batch_size')
+            if self._start_step and token_bs is not None \
+                    and int(token_bs) != int(batch_size):
+                # Only the MID-epoch cursor counts batches of a particular
+                # size; an epoch-boundary token stays batch-size-independent
+                # (resuming with a different batch_size there is valid).
+                raise ValueError(
+                    'device_inmem resume token was taken %d steps into an '
+                    'epoch of batch_size=%d batches; resume with that '
+                    'batch_size (got %d), or checkpoint at an epoch '
+                    'boundary to change it'
+                    % (self._start_step, int(token_bs), int(batch_size)))
             if self._start_step and not self._deterministic:
                 raise ValueError(
                     'mid-epoch device_inmem resume requires '

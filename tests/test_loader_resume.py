@@ -439,6 +439,17 @@ def test_device_inmem_epoch_boundary_resume(dataset):
         resumed = [np.asarray(b['id']).tolist() for b in loader2]
     assert consumed[:steps_per_epoch] + resumed == full
 
+    # an epoch-boundary token is batch-size-independent: resuming with a
+    # different batch_size is valid (only the mid-epoch cursor pins it)
+    reader = make_reader(dataset.url, reader_pool_type='dummy',
+                         shuffle_row_groups=False, num_epochs=1)
+    from petastorm_tpu.jax import DeviceInMemDataLoader as DIML
+    with DIML(reader, batch_size=BATCH * 2, num_epochs=3, seed=23,
+              drop_last=False, resume_state=state) as loader3:
+        rows = sorted(sum((np.asarray(b['id']).tolist() for b in loader3),
+                          []))
+    assert rows == sorted(list(range(ROWS)) * 2)  # 2 remaining epochs
+
     # wrong/absent seed is refused up front
     reader = make_reader(dataset.url, reader_pool_type='dummy',
                          shuffle_row_groups=False, num_epochs=1)
