@@ -809,6 +809,10 @@ def dlrm_stall_leg():
     floor_ms = 1000.0 * (time.monotonic() - t0) / floor_steps
 
     steps_per_epoch = DLRM_ROWS // DLRM_BATCH
+    if steps_per_epoch == 0:
+        raise ValueError('DLRM_ROWS=%d < DLRM_BATCH=%d: no full batch per '
+                         'epoch (drop_last) — raise rows or lower batch'
+                         % (DLRM_ROWS, DLRM_BATCH))
     max_steps = 2 * steps_per_epoch
 
     def run(fused):
@@ -837,6 +841,9 @@ def dlrm_stall_leg():
                     steps += int(outs.shape[0])
                     if steps >= max_steps:
                         break
+                # Guard BEFORE touching outs/loss: a too-short stream must
+                # say so, not die UnboundLocalError below.
+                assert t0 is not None and steps > 0, 'criteo stream too short'
                 final = np.asarray(outs)
             else:
                 p, o, loss = params, opt_state, None
@@ -850,8 +857,8 @@ def dlrm_stall_leg():
                         t0 = time.monotonic()
                     if steps >= max_steps:
                         break
+                assert t0 is not None and steps > 0, 'criteo stream too short'
                 final = np.asarray(float(loss))
-            assert t0 is not None and steps > 0, 'criteo stream too short'
             assert np.isfinite(final).all(), 'non-finite DLRM loss'
             wall_ms = 1000.0 * (time.monotonic() - t0) / steps
             return max(0.0, 100.0 * (wall_ms - floor_ms) / wall_ms), wall_ms
@@ -1066,7 +1073,6 @@ def _start_watchdog(budget_s):
                               sort_keys=True, default=str)
             except Exception:  # noqa: BLE001 — detail is best-effort
                 pass
-            faulthandler.dump_traceback(file=sys.stderr)
         except Exception:  # noqa: BLE001 — minimal line beats no line
             print(json.dumps({
                 'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
@@ -1074,6 +1080,13 @@ def _start_watchdog(budget_s):
                 'error': err + ' (partial assembly failed)',
             }), flush=True)
         finally:
+            # The stacks are the only diagnostic of WHERE the run wedged —
+            # they must ship on the fallback path too (the line promises
+            # them).
+            try:
+                faulthandler.dump_traceback(file=sys.stderr)
+            except Exception:  # noqa: BLE001
+                pass
             os._exit(3)
 
     global _T0, _BUDGET_S
@@ -1258,8 +1271,9 @@ def main():
     # regime (gather-bound embeddings over the columnar plane).  Gated
     # like certification — it compiles 2 more executables and streams two
     # full passes, and must never cost the imagenet artifact.
-    if stall.get('device_unhealthy'):
-        result['dlrm_error'] = 'skipped: %s' % stall['device_unhealthy']
+    unhealthy = stall.get('device_unhealthy')
+    if unhealthy:
+        result['dlrm_error'] = 'skipped: %s' % unhealthy
     elif _budget_left_s() < 600:
         result['dlrm_error'] = ('skipped: %.0fs of watchdog budget left'
                                 % _budget_left_s())
@@ -1271,10 +1285,19 @@ def main():
         except Exception as e:  # noqa: BLE001 — must not cost the artifact
             result['dlrm_error'] = '%s: %s' % (type(e).__name__,
                                                str(e)[:160])
+            # Same containment as train_stall_legs.leg(): a backend
+            # unavailability here means certification would HANG next.
+            if ('UNAVAILABLE' in result['dlrm_error']
+                    or 'DEADLINE' in result['dlrm_error']) \
+                    and not _device_probe_ok(timeout_s=60):
+                unhealthy = ('tunnel unhealthy after the DLRM leg '
+                             '(fresh-interpreter probe failed)')
+                result['device_unhealthy'] = unhealthy
+                _PARTIAL['device_unhealthy'] = unhealthy
     _certify_into(result,
                   'tpu (Mosaic)' if jax.default_backend() == 'tpu'
                   else jax.default_backend() + ' (Pallas interpreter)',
-                  unhealthy=stall.get('device_unhealthy'))
+                  unhealthy=unhealthy)
     watchdog.cancel()
     _emit(result)
 

@@ -53,9 +53,15 @@ def make_transform(image_hw):
 
 def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
           model_name='resnet50', decoded_cache_dir=None, hbm_cache=False,
-          scan_steps=0):
+          scan_steps=0, trace_path=None):
     mesh = make_mesh()
     sharding = data_parallel_sharding(mesh)
+    # --trace: record every host-side span (host_batch/transform/device_put
+    # from the loader, data_wait/step from the monitor) into a
+    # chrome://tracing timeline — the per-event view of the same time the
+    # stall report aggregates.
+    from petastorm_tpu.benchmark import TraceRecorder
+    tracer = TraceRecorder() if trace_path else None
     stateless = model_name == 'vit'
     if stateless:
         # ViT-S/16 on the same pipeline; no BatchNorm state, so batch_stats
@@ -139,7 +145,7 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
               'work)' % (done, float(loss), done * batch_size / dt))
         return {'stall_pct': 0.0, 'steps': done}
 
-    monitor = StallMonitor(warmup_steps=2)
+    monitor = StallMonitor(warmup_steps=2, trace_recorder=tracer)
     done = 0
     t0 = time.monotonic()
     # Multi-epoch beyond-HBM datasets: --decoded-cache-dir spills decoded
@@ -159,10 +165,11 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
         if decoded_cache_dir:
             loader = DiskCachedDataLoader(reader, batch_size=batch_size,
                                           decoded_cache_dir=decoded_cache_dir,
-                                          num_epochs=None, sharding=sharding)
+                                          num_epochs=None, sharding=sharding,
+                                          trace_recorder=tracer)
         else:
             loader = DataLoader(reader, batch_size=batch_size,
-                                sharding=sharding)
+                                sharding=sharding, trace_recorder=tracer)
         if scan_steps >= 1:
             # Fused streaming consumption: k host batches stack into one
             # device_put + one lax.scan dispatch (DataLoader.scan_batches)
@@ -187,6 +194,9 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
             # per-batch wrapping doesn't apply to fused consumption).
             from petastorm_tpu.benchmark import diagnose, format_report
             print(format_report(diagnose(loader)))
+            if tracer is not None:
+                print('trace: %d spans -> %s (open in chrome://tracing)'
+                      % (tracer.dump(trace_path), trace_path))
             return {'steps': done, 'stall_pct': None}
         step_key = jax.random.PRNGKey(17)
         for batch in monitor.wrap(loader):
@@ -205,6 +215,9 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
     # Name the bottleneck regime and what to do about it (benchmark.diagnose)
     from petastorm_tpu.benchmark import diagnose, format_report
     print(format_report(diagnose(loader, monitor)))
+    if tracer is not None:
+        print('trace: %d spans -> %s (open in chrome://tracing)'
+              % (tracer.dump(trace_path), trace_path))
     return report
 
 
@@ -231,7 +244,14 @@ if __name__ == '__main__':
                              'device_put + lax.scan dispatch — use when '
                              'dispatch/transport latency, not decode, is '
                              'the stall')
+    parser.add_argument('--trace', default=None, metavar='PATH',
+                        help='dump a chrome://tracing timeline of every '
+                             'host-side span (loader stages + data_wait/'
+                             'step) to PATH — per-event view of the stall '
+                             'report (not applicable to --hbm-cache, whose '
+                             'epochs have no host-side work to trace)')
     args = parser.parse_args()
     train(args.dataset_url, args.steps, args.batch_size,
           model_name=args.model, decoded_cache_dir=args.decoded_cache_dir,
-          hbm_cache=args.hbm_cache, scan_steps=args.scan_steps)
+          hbm_cache=args.hbm_cache, scan_steps=args.scan_steps,
+          trace_path=args.trace)

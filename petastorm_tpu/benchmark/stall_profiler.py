@@ -20,9 +20,13 @@ import time
 
 
 class StallMonitor(object):
-    def __init__(self, annotate=False, warmup_steps=1):
+    def __init__(self, annotate=False, warmup_steps=1, trace_recorder=None):
         self._annotate = annotate
         self._warmup_steps = warmup_steps
+        #: optional ``benchmark.TraceRecorder``: every wait/step pair is
+        #: also recorded as chrome-trace spans (``data_wait`` / ``step``),
+        #: composing with the loader's spans into one host timeline.
+        self._trace = trace_recorder
         self.reset()
 
     def reset(self):
@@ -50,6 +54,9 @@ class StallMonitor(object):
             wait_end = time.monotonic()
             yield batch
             step_end = time.monotonic()
+            if self._trace is not None:
+                self._trace.event('data_wait', wait_start, wait_end)
+                self._trace.event('step', wait_end, step_end)
             if self._skipped < self._warmup_steps:
                 # First pulls pay pipeline fill + compile; not steady state.
                 self._skipped += 1

@@ -1,0 +1,97 @@
+"""Chrome-trace timeline export for the data plane (SURVEY.md §5.1).
+
+The loader's ``stats`` and the reader's ``diagnostics`` are AGGREGATE
+counters — enough to name the bottleneck regime (``benchmark.diagnose``)
+but not to see its shape over time (a periodic GC pause, a cold cache
+tier warming up, one slow row group poisoning an epoch's tail all
+average away).  ``TraceRecorder`` captures the same instrumented
+sections as per-event spans and dumps them in the Chrome Trace Event
+format, viewable in ``chrome://tracing`` / Perfetto — the idiomatic
+timeline surface next to ``jax.profiler``'s device-side traces (the
+loader already emits ``TraceAnnotation`` spans into those; this file is
+the HOST-side, dependency-free view).
+
+    rec = TraceRecorder()
+    loader = DataLoader(reader, batch_size=64, trace_recorder=rec)
+    mon = StallMonitor(trace_recorder=rec)
+    for batch in mon.wrap(loader):
+        train_step(batch)
+    rec.dump('timeline.json')        # open in chrome://tracing
+
+Spans recorded (one 'X' event each): ``host_batch`` (decode-plane wait),
+``transform`` (user hook), ``device_put`` (H2D dispatch) from every
+loader in the family, plus ``data_wait`` / ``step`` from
+``StallMonitor.wrap``.  The reference has no equivalent (its
+observability is logging only); this is a build-obligation extension.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ['TraceRecorder']
+
+
+class TraceRecorder(object):
+    """Bounded, thread-safe recorder of Chrome Trace Event spans.
+
+    Appends are O(1) dict+deque ops (~1 µs) so recording is safe to leave
+    on around a training loop; the ring keeps the LAST ``max_events``
+    spans (the steady state near an incident is what a timeline is for —
+    keeping the head would freeze the warmup and drop the incident).
+    """
+
+    def __init__(self, max_events=100_000):
+        self._events = deque(maxlen=int(max_events))
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()  # trace origin: construction time
+
+    def event(self, name, t_start_s, t_end_s, **args):
+        """Record one complete span; timestamps are ``time.monotonic()``
+        seconds (the clock every instrumented section already reads)."""
+        ev = {
+            'name': name,
+            'ph': 'X',
+            'ts': round(1e6 * (t_start_s - self._t0), 1),
+            'dur': round(1e6 * max(0.0, t_end_s - t_start_s), 1),
+            'pid': os.getpid(),
+            'tid': threading.get_ident(),
+        }
+        if args:
+            ev['args'] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name, **args):
+        """Record a point-in-time marker (epoch boundary, checkpoint, ...)."""
+        ev = {
+            'name': name,
+            'ph': 'i',
+            's': 't',  # thread-scoped instant
+            'ts': round(1e6 * (time.monotonic() - self._t0), 1),
+            'pid': os.getpid(),
+            'tid': threading.get_ident(),
+        }
+        if args:
+            ev['args'] = args
+        with self._lock:
+            self._events.append(ev)
+
+    @property
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def dump(self, path):
+        """Write ``{"traceEvents": [...]}`` — the Chrome/Perfetto JSON
+        object form — and return the event count."""
+        events = self.events
+        with open(path, 'w') as f:
+            json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+        return len(events)

@@ -53,12 +53,16 @@ class DataLoader(object):
         device / sharding: target placement. ``sharding`` wins and assembles
             global arrays from per-host local data.
         seed: shuffling seed.
+        trace_recorder: optional ``benchmark.TraceRecorder`` — every timed
+            section (host_batch / transform / device_put) is additionally
+            recorded as a chrome-trace span (timeline view of the same
+            time ``stats`` aggregates).
     """
 
     def __init__(self, reader, batch_size, shuffling_queue_capacity=0,
                  min_after_retrieve=None, transform_fn=None, drop_last=True,
                  prefetch=2, device=None, sharding=None, seed=None,
-                 resume_state=None, echo=1):
+                 resume_state=None, echo=1, trace_recorder=None):
         if batch_size <= 0:
             raise ValueError('batch_size must be positive')
         if echo < 1:
@@ -104,6 +108,7 @@ class DataLoader(object):
         #: busy time back on each ack).
         self.stats = {'host_batch_s': 0.0, 'transform_s': 0.0,
                       'device_put_s': 0.0, 'batches': 0}
+        self._trace = trace_recorder
 
     # -- iteration -----------------------------------------------------------
 
@@ -142,6 +147,12 @@ class DataLoader(object):
             self.stats['transform_s'] += t2 - t1
             self.stats['device_put_s'] += t3 - t2
             self.stats['batches'] += 1
+            if self._trace is not None:
+                n = self.stats['batches']
+                self._trace.event('host_batch', t0, t1, batch=n)
+                if self._transform_fn is not None:
+                    self._trace.event('transform', t1, t2, batch=n)
+                self._trace.event('device_put', t2, t3, batch=n)
             if len(pending) > self._prefetch:
                 yield pending.popleft()
         while pending:
@@ -463,6 +474,10 @@ class DataLoader(object):
             t2 = time.monotonic()
             self.stats['transform_s'] += t1 - t0
             self.stats['device_put_s'] += t2 - t1
+            if self._trace is not None:
+                if self._transform_fn is not None and not transformed:
+                    self._trace.event('transform', t0, t1, chunk=len(chunk))
+                self._trace.event('device_put', t1, t2, chunk=len(chunk))
             return out
 
         def timed_pulls(gen):
@@ -472,7 +487,10 @@ class DataLoader(object):
                     host_batch = next(gen)
                 except StopIteration:
                     return
-                self.stats['host_batch_s'] += time.monotonic() - t0
+                t1 = time.monotonic()
+                self.stats['host_batch_s'] += t1 - t0
+                if self._trace is not None:
+                    self._trace.event('host_batch', t0, t1)
                 yield host_batch
 
         def rows_of(batch):

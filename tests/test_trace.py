@@ -1,0 +1,127 @@
+"""Chrome-trace timeline export (`benchmark.trace`, SURVEY §5.1 extension).
+
+Contract: every instrumented section the aggregate ``stats`` counters
+cover also lands as a chrome-trace 'X' span when a ``TraceRecorder`` is
+attached — loader stages via ``DataLoader(trace_recorder=)``, consumer
+wait/step via ``StallMonitor(trace_recorder=)`` — and ``dump`` writes
+the ``{"traceEvents": [...]}`` object form Perfetto/chrome://tracing load.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.benchmark import StallMonitor, TraceRecorder
+from petastorm_tpu.jax import DataLoader
+
+from test_common import create_test_dataset
+
+ROWS = 48
+BATCH = 8
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('traceds')
+    return create_test_dataset('file://' + str(path), num_rows=ROWS,
+                               rows_per_rowgroup=8)
+
+
+def _spans_by_name(events):
+    out = {}
+    for ev in events:
+        out.setdefault(ev['name'], []).append(ev)
+    return out
+
+
+def test_loader_and_monitor_spans_compose(dataset, tmp_path):
+    rec = TraceRecorder()
+    mon = StallMonitor(warmup_steps=0, trace_recorder=rec)
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     num_epochs=1) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, trace_recorder=rec,
+                            transform_fn=lambda b: b)
+        n = sum(1 for _ in mon.wrap(loader))
+    assert n == ROWS // BATCH
+
+    spans = _spans_by_name(rec.events)
+    # one span per batch per loader stage (transform_fn present -> traced)
+    assert len(spans['host_batch']) == n
+    assert len(spans['transform']) == n
+    assert len(spans['device_put']) == n
+    # monitor view: one wait + one step per consumed batch
+    assert len(spans['data_wait']) == n
+    assert len(spans['step']) == n
+
+    for ev in rec.events:
+        assert ev['ph'] == 'X'
+        assert ev['ts'] >= 0 and ev['dur'] >= 0
+        assert ev['pid'] and ev['tid']
+
+    # stage spans nest inside the data_wait that pulled them: every
+    # host_batch start falls within [first wait start, last wait end]
+    waits = spans['data_wait']
+    lo = min(w['ts'] for w in waits)
+    hi = max(w['ts'] + w['dur'] for w in waits)
+    for ev in spans['host_batch']:
+        assert lo <= ev['ts'] <= hi
+
+    path = tmp_path / 'timeline.json'
+    count = rec.dump(str(path))
+    doc = json.loads(path.read_text())
+    assert count == len(doc['traceEvents']) == len(rec.events)
+    assert doc['displayTimeUnit'] == 'ms'
+
+
+def test_scan_batches_spans(dataset):
+    import jax.numpy as jnp
+
+    rec = TraceRecorder()
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     num_epochs=1) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, trace_recorder=rec)
+        chunks = sum(1 for _ in loader.scan_batches(
+            lambda c, b: (c, jnp.sum(b['id'])), 0, steps_per_call=2,
+            donate_carry=False))
+    assert chunks == (ROWS // BATCH) // 2
+    spans = _spans_by_name(rec.events)
+    assert len(spans['host_batch']) == ROWS // BATCH  # per pulled batch
+    assert len(spans['device_put']) == chunks         # per stacked chunk
+    assert all(ev['args']['chunk'] == 2 for ev in spans['device_put'])
+
+
+def test_ring_keeps_latest_and_instant_markers():
+    rec = TraceRecorder(max_events=10)
+    for i in range(25):
+        rec.event('e', 0.0, 0.001, i=i)
+    events = rec.events
+    assert len(events) == 10
+    assert [ev['args']['i'] for ev in events] == list(range(15, 25))
+    rec.instant('epoch_boundary', epoch=3)
+    assert rec.events[-1]['ph'] == 'i'
+    assert rec.events[-1]['args'] == {'epoch': 3}
+    rec.clear()
+    assert rec.events == []
+
+
+def test_thread_safety_under_concurrent_append():
+    rec = TraceRecorder(max_events=50_000)
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(5_000):
+                rec.event('t', 0.0, 0.001)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(rec.events) == 20_000
