@@ -1055,6 +1055,12 @@ def _start_watchdog(budget_s):
                        if merged.get(k) is not None}
             partial.setdefault('value', 0.0)
             partial.setdefault('vs_baseline', 0.0)
+            for k in ('value', 'vs_baseline'):
+                # The machine line CONTRACTS these as numbers; a stray
+                # non-numeric (half-built state mid-wedge) must not ship.
+                if not isinstance(partial[k], (int, float)) \
+                        or isinstance(partial[k], bool):
+                    partial[k] = 0.0
             partial.update({
                 'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
                 'unit': 'images/s',

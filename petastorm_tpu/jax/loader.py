@@ -400,10 +400,27 @@ class DataLoader(object):
             for host_batch in restored:
                 self.stats['batches'] += 1
                 yield host_batch
-        for host_batch in self._echoed_host_batches():
+        batches = self._echoed_host_batches()
+        while True:
+            # Same per-stage accounting as __iter__ (minus device_put —
+            # there is none here), so the bottleneck advisor and the
+            # doctor can diagnose a host-boundary consumer too.
+            t0 = time.monotonic()
+            try:
+                host_batch = next(batches)
+            except StopIteration:
+                return
+            t1 = time.monotonic()
             if self._transform_fn is not None:
                 host_batch = self._transform_fn(host_batch)
+            t2 = time.monotonic()
+            self.stats['host_batch_s'] += t1 - t0
+            self.stats['transform_s'] += t2 - t1
             self.stats['batches'] += 1
+            if self._trace is not None:
+                self._trace.event('host_batch', t0, t1)
+                if self._transform_fn is not None:
+                    self._trace.event('transform', t1, t2)
             yield host_batch
 
     # -- fused multi-step consumption ----------------------------------------

@@ -1,0 +1,213 @@
+"""``petastorm-tpu-doctor`` — one-command pipeline diagnostics.
+
+The reference leaves operators to correlate logs by hand when a training
+job starves; this framework already measures every plane separately
+(backend probe, native decode plane, host delivery, H2D transport, the
+bottleneck advisor).  The doctor runs them in dependency order and emits
+one report, so "why is my chip idle" is a single command on any host:
+
+    petastorm-tpu-doctor                         # environment planes only
+    petastorm-tpu-doctor --dataset-url file:///data/imagenet --json
+
+Sections (each contained — a dead plane is reported, not fatal):
+
+* **backend** — can a fresh interpreter initialize the configured JAX
+  backend (subprocess probe: a wedged TPU tunnel hangs in-process calls,
+  see ``utils.ensure_jax_backend``)?  Device kind when alive.
+* **native** — is the C++ decode plane (``native/pt_decode.cc``) loaded,
+  and what does it accelerate?
+* **host_plane** — with ``--dataset-url``: images(rows)/s of the pure
+  host pipeline (reader -> decode -> collate, no device), the number the
+  chip's feed rate is bounded by.
+* **h2d** — device_put bandwidth of one training-shaped batch (needs a
+  live backend): the transport term of streaming stall.
+* **advisor** — with both planes measured: the bottleneck verdict +
+  prescriptions (``benchmark.diagnose``) for a short stall-free pass.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+__all__ = ['run_doctor', 'main']
+
+
+def _contained(report, name, fn):
+    t0 = time.monotonic()
+    try:
+        report[name] = fn()
+    except Exception as e:  # noqa: BLE001 — a dead plane is a FINDING
+        report[name] = {'error': '%s: %s' % (type(e).__name__, str(e)[:200])}
+    report[name]['elapsed_s'] = round(time.monotonic() - t0, 2)
+
+
+def _check_backend(probe_timeout_s):
+    from petastorm_tpu.utils import _backend_probe_ok, apply_jax_platforms_env
+    ok = _backend_probe_ok(probe_timeout_s)
+    out = {'probe_ok': bool(ok)}
+    if ok:
+        # Honor the caller's JAX_PLATFORMS in-process too (the axon
+        # sitecustomize hook would otherwise re-route to the tunneled
+        # backend the probe didn't test — and hang there).
+        apply_jax_platforms_env()
+        import jax
+        devices = jax.devices()
+        out.update({'backend': jax.default_backend(),
+                    'device_kind': devices[0].device_kind,
+                    'device_count': len(devices)})
+    else:
+        out['note'] = ('fresh-interpreter backend init failed/hung within '
+                       '%ds — a tunneled TPU is unreachable or wedged; '
+                       'host-plane sections still run' % probe_timeout_s)
+    return out
+
+
+def _check_native():
+    from petastorm_tpu import native
+    lib = native.get_lib()
+    out = {'loaded': lib is not None}
+    if lib is not None:
+        out['accelerates'] = ['jpeg_decode_batch (fused resize)',
+                              'png_decode_batch',
+                              'zlib_npy_decompress_batch',
+                              'npy_copy_batch']
+    else:
+        out['note'] = ('C++ plane unavailable (no compiler or build '
+                       'failure); python/cv2 fallbacks active — expect a '
+                       'slower delivery plane')
+    return out
+
+
+def _check_host_plane(dataset_url, seconds, batch_size, advisor_out=None):
+    """Rows/s of reader -> decode -> collate with NO device in the loop.
+
+    The same pass feeds the bottleneck advisor (``advisor_out`` receives
+    its verdict): one dataset open, one decode window, two sections —
+    remote URLs must not pay the read twice.  ``num_epochs=None`` so a
+    dataset smaller than one batch still produces full (wrapping)
+    batches; the deadline bounds the pass either way.
+    """
+    from petastorm_tpu import make_batch_reader, make_reader
+    from petastorm_tpu.benchmark import diagnose
+    from petastorm_tpu.errors import MetadataError
+    from petastorm_tpu.jax import DataLoader
+
+    try:
+        reader = make_reader(dataset_url, num_epochs=None,
+                             shuffle_row_groups=False, columnar_decode=True)
+        kind = 'make_reader (codec decode)'
+    except MetadataError:
+        reader = make_batch_reader(dataset_url, num_epochs=None,
+                                   shuffle_row_groups=False)
+        kind = 'make_batch_reader (plain parquet)'
+    rows = 0
+    with reader:
+        loader = DataLoader(reader, batch_size=batch_size)
+        t0 = time.monotonic()
+        deadline = t0 + seconds
+        for batch in loader.iter_host_batches():
+            rows += len(next(iter(batch.values())))
+            if time.monotonic() >= deadline:
+                break
+        dt = time.monotonic() - t0
+        stats = dict(loader.stats)
+        if advisor_out is not None:
+            verdict = diagnose(loader)
+            advisor_out.update({
+                'regime': verdict['regime'],
+                'evidence': verdict['evidence'],
+                'suggestions': verdict.get('suggestions', []),
+                'note': 'host-boundary pass (no chip in the loop); '
+                        'chip-side regimes need a training loop — see '
+                        'examples/imagenet',
+            })
+    out = {'reader': kind, 'rows_per_s': round(rows / dt, 1), 'rows': rows,
+           'stage_seconds': {k: round(v, 3) for k, v in stats.items()
+                             if k.endswith('_s')}}
+    return out
+
+
+def _check_h2d(batch_mb):
+    import jax
+    x = np.zeros((int(batch_mb) << 20,), np.uint8)
+    jax.block_until_ready(jax.device_put(x))  # warm the path
+    t0 = time.monotonic()
+    jax.block_until_ready(jax.device_put(x))
+    dt = time.monotonic() - t0
+    return {'bytes_per_s': round(x.nbytes / dt) if dt > 0 else None,
+            'mb': int(batch_mb),
+            'note': 'streaming feed rate is bounded by '
+                    'min(host_plane.rows_per_s, h2d/bytes_per_row)'}
+
+
+def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
+               batch_size=64, h2d_mb=32):
+    """Run every applicable section; returns the report dict."""
+    report = {}
+    _contained(report, 'backend', lambda: _check_backend(probe_timeout_s))
+    _contained(report, 'native', _check_native)
+    if dataset_url:
+        advisor = {}
+        _contained(report, 'host_plane',
+                   lambda: _check_host_plane(dataset_url, sample_seconds,
+                                             batch_size,
+                                             advisor_out=advisor))
+        if advisor:  # empty when the host-plane pass itself failed
+            report['advisor'] = advisor
+    if report['backend'].get('probe_ok'):
+        _contained(report, 'h2d', lambda: _check_h2d(h2d_mb))
+    return report
+
+
+def _format(report):
+    lines = []
+    for section, data in report.items():
+        data = dict(data)
+        elapsed = data.pop('elapsed_s', None)
+        failed = 'error' in data or (section == 'backend'
+                                     and not data.get('probe_ok'))
+        status = 'FAIL' if failed else 'ok'
+        lines.append('%-11s %-5s %s' % (section, status,
+                                        '(%.1fs)' % elapsed
+                                        if elapsed is not None else ''))
+        for k, v in data.items():
+            lines.append('    %s: %s' % (k, v))
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split('\n\n')[0])
+    parser.add_argument('--dataset-url', default=None,
+                        help='petastorm or plain-parquet URL to exercise '
+                             'the host plane + advisor against')
+    parser.add_argument('--json', action='store_true',
+                        help='emit one machine-readable JSON line instead '
+                             'of the human report')
+    parser.add_argument('--probe-timeout', type=int, default=60,
+                        help='seconds to wait for the backend probe child')
+    parser.add_argument('--seconds', type=float, default=5.0,
+                        help='host-plane sampling window')
+    parser.add_argument('--batch-size', type=int, default=64)
+    args = parser.parse_args(argv)
+
+    report = run_doctor(dataset_url=args.dataset_url,
+                        probe_timeout_s=args.probe_timeout,
+                        sample_seconds=args.seconds,
+                        batch_size=args.batch_size)
+    if args.json:
+        print(json.dumps(report, default=str))
+    else:
+        print(_format(report))
+    # Exit 1 when ANY plane failed — a dead backend probe IS a failed
+    # plane (the scriptable `doctor && launch` contract must not launch
+    # against a wedged tunnel).
+    failed = any('error' in v for v in report.values()) \
+        or not report['backend'].get('probe_ok')
+    return 1 if failed else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
