@@ -400,27 +400,35 @@ class DataLoader(object):
             for host_batch in restored:
                 self.stats['batches'] += 1
                 yield host_batch
-        batches = self._echoed_host_batches()
-        while True:
-            # Same per-stage accounting as __iter__ (minus device_put —
-            # there is none here), so the bottleneck advisor and the
-            # doctor can diagnose a host-boundary consumer too.
-            t0 = time.monotonic()
-            try:
-                host_batch = next(batches)
-            except StopIteration:
-                return
+        # Same per-stage accounting as __iter__ (minus device_put — there
+        # is none here), so the bottleneck advisor and the doctor can
+        # diagnose a host-boundary consumer too.
+        for host_batch in self._timed_pulls(self._echoed_host_batches()):
             t1 = time.monotonic()
             if self._transform_fn is not None:
                 host_batch = self._transform_fn(host_batch)
-            t2 = time.monotonic()
-            self.stats['host_batch_s'] += t1 - t0
-            self.stats['transform_s'] += t2 - t1
+                t2 = time.monotonic()
+                self.stats['transform_s'] += t2 - t1
+                if self._trace is not None:
+                    self._trace.event('transform', t1, t2)
             self.stats['batches'] += 1
+            yield host_batch
+
+    def _timed_pulls(self, gen):
+        """Yield from ``gen``, accounting the wait on the decode plane
+        into ``stats['host_batch_s']`` (+ a trace span) — the one place
+        that owns pull accounting for every host-boundary consumer
+        (``iter_host_batches``, ``scan_batches``)."""
+        while True:
+            t0 = time.monotonic()
+            try:
+                host_batch = next(gen)
+            except StopIteration:
+                return
+            t1 = time.monotonic()
+            self.stats['host_batch_s'] += t1 - t0
             if self._trace is not None:
                 self._trace.event('host_batch', t0, t1)
-                if self._transform_fn is not None:
-                    self._trace.event('transform', t1, t2)
             yield host_batch
 
     # -- fused multi-step consumption ----------------------------------------
@@ -497,19 +505,6 @@ class DataLoader(object):
                 self._trace.event('device_put', t1, t2, chunk=len(chunk))
             return out
 
-        def timed_pulls(gen):
-            while True:
-                t0 = time.monotonic()
-                try:
-                    host_batch = next(gen)
-                except StopIteration:
-                    return
-                t1 = time.monotonic()
-                self.stats['host_batch_s'] += t1 - t0
-                if self._trace is not None:
-                    self._trace.event('host_batch', t0, t1)
-                yield host_batch
-
         def rows_of(batch):
             return len(next(iter(jax.tree_util.tree_leaves(batch))))
 
@@ -529,7 +524,7 @@ class DataLoader(object):
                 yield carry, outs
 
         chunk = []
-        for host_batch in timed_pulls(self._echoed_host_batches()):
+        for host_batch in self._timed_pulls(self._echoed_host_batches()):
             if chunk and rows_of(host_batch) != rows_of(chunk[0]):
                 # ragged tail (drop_last=False): flush so stacking stays
                 # rectangular — the tail becomes its own (shorter) chunk

@@ -254,3 +254,40 @@ def test_doctor_plain_parquet_and_human_format(tmp_path, capsys):
     out = capsys.readouterr().out
     assert 'host_plane' in out and 'make_batch_reader' in out
     assert rc in (0, 1)
+
+
+def test_check_reference_empty_and_populated(tmp_path, capsys):
+    """SURVEY §0 protocol tool: exit 2 on the (current) empty mount; on a
+    populated tree it locates anchors, verifies footer-key byte-identity,
+    diffs the make_reader kwarg surface, and writes the report."""
+    from petastorm_tpu.tools.check_reference import main as check_main
+
+    empty = tmp_path / 'empty_ref'
+    empty.mkdir()
+    assert check_main(['--reference-root', str(empty)]) == 2
+
+    ref = tmp_path / 'ref'
+    (ref / 'petastorm' / 'etl').mkdir(parents=True)
+    (ref / 'petastorm' / 'reader.py').write_text(
+        "def make_reader(dataset_url, schema_fields=None, "
+        "reader_pool_type='thread', workers_count=10, cur_shard=None, "
+        "shard_count=None, frobnicate_rows=False):\n    pass\n"
+        "def make_batch_reader(dataset_url):\n    pass\n")
+    (ref / 'petastorm' / 'etl' / 'dataset_metadata.py').write_text(
+        "UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'\n"
+        "ROW_GROUPS_PER_FILE_KEY = "
+        "b'dataset-toolkit.num_row_groups_per_file.v1'\n"
+        "def materialize_dataset():\n    pass\n")
+    report = tmp_path / 'check.md'
+    rc = check_main(['--reference-root', str(ref),
+                     '--report', str(report)])
+    assert rc == 0
+    text = report.read_text()
+    # found anchors check off; absent ones flag as MISSING
+    assert '- [x] `def make_reader`' in text
+    assert 'MISSING' in text and 'class NGram' in text
+    # byte-identical footer keys verified
+    assert '- [x] `UNISCHEMA_KEY` = `dataset-toolkit.unischema.v1`' in text
+    # a reference kwarg we don't accept is surfaced as a parity gap
+    assert 'frobnicate_rows' in text
+    capsys.readouterr()
