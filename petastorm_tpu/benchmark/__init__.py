@@ -5,4 +5,5 @@ from petastorm_tpu.benchmark.advisor import (HEALTHY_STALL_PCT, diagnose,  # noq
                                              format_report)
 from petastorm_tpu.benchmark.stall_profiler import StallMonitor  # noqa: F401
 from petastorm_tpu.benchmark.throughput import BenchmarkResult, reader_throughput  # noqa: F401
+from petastorm_tpu.benchmark.autotune import autotune  # noqa: F401
 from petastorm_tpu.benchmark.trace import TraceRecorder  # noqa: F401

@@ -9,13 +9,13 @@ real tree.  This tool makes that turnkey::
     python -m petastorm_tpu.tools.check_reference [--reference-root DIR]
 
 * exit 2 — mount still empty/absent: nothing to verify (today's state).
-* exit 0 — mount populated: every SURVEY §2 anchor symbol is grepped,
-  the footer-key strings are compared byte-for-byte against ours, and
-  the ``make_reader`` kwarg surface is diffed against the reference
-  signature.  A markdown report is written (default
-  ``REFERENCE_CHECK.md`` in the CWD) for the session to act on: any
-  MISSING anchor or key mismatch means SURVEY/PARITY claims need
-  amending against the mount, which outranks this document.
+* exit 0 — mount populated and every check passed: SURVEY §2 anchor
+  symbols found, footer-key strings byte-identical, every reference
+  ``make_reader`` kwarg accepted.
+* exit 1 — mount populated with DISCREPANCIES: the markdown report
+  (default ``REFERENCE_CHECK.md`` in the CWD) names each one; SURVEY/
+  PARITY claims need amending against the mount, which outranks this
+  document.
 """
 
 import argparse
@@ -161,7 +161,10 @@ def check_reference(reference_root, report_path):
         f.write('\n'.join(lines) + '\n')
     print('\n'.join(lines))
     print('\nreport -> %s' % report_path)
-    return 0
+    # Scriptable: 0 = verified clean, 1 = discrepancies found (the report
+    # names them), 2 = nothing to verify.  A gate on this tool must not
+    # read a failed verification as a pass.
+    return 1 if missing else 0
 
 
 def main(argv=None):

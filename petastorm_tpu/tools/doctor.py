@@ -90,29 +90,17 @@ def _check_host_plane(dataset_url, seconds, batch_size, advisor_out=None):
     dataset smaller than one batch still produces full (wrapping)
     batches; the deadline bounds the pass either way.
     """
-    from petastorm_tpu import make_batch_reader, make_reader
     from petastorm_tpu.benchmark import diagnose
-    from petastorm_tpu.errors import MetadataError
+    from petastorm_tpu.benchmark.hostplane import (open_host_reader,
+                                                   pump_host_batches)
     from petastorm_tpu.jax import DataLoader
 
-    try:
-        reader = make_reader(dataset_url, num_epochs=None,
-                             shuffle_row_groups=False, columnar_decode=True)
-        kind = 'make_reader (codec decode)'
-    except MetadataError:
-        reader = make_batch_reader(dataset_url, num_epochs=None,
-                                   shuffle_row_groups=False)
-        kind = 'make_batch_reader (plain parquet)'
-    rows = 0
+    reader, info = open_host_reader(dataset_url, num_epochs=None,
+                                    shuffle_row_groups=False)
+    kind = info['kind']
     with reader:
         loader = DataLoader(reader, batch_size=batch_size)
-        t0 = time.monotonic()
-        deadline = t0 + seconds
-        for batch in loader.iter_host_batches():
-            rows += len(next(iter(batch.values())))
-            if time.monotonic() >= deadline:
-                break
-        dt = time.monotonic() - t0
+        rows, dt = pump_host_batches(loader, seconds)
         stats = dict(loader.stats)
         if advisor_out is not None:
             verdict = diagnose(loader)
@@ -162,6 +150,12 @@ def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
     return report
 
 
+def _check_autotune(dataset_url, batch_size, seconds_per_config):
+    from petastorm_tpu.benchmark import autotune
+    return autotune(dataset_url, batch_size=batch_size,
+                    seconds_per_config=seconds_per_config)
+
+
 def _format(report):
     lines = []
     for section, data in report.items():
@@ -191,12 +185,23 @@ def main(argv=None):
     parser.add_argument('--seconds', type=float, default=5.0,
                         help='host-plane sampling window')
     parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--autotune', action='store_true',
+                        help='also sweep reader configurations '
+                             '(workers_count grid) on this host and '
+                             'recommend the fastest — needs --dataset-url')
     args = parser.parse_args(argv)
+    if args.autotune and not args.dataset_url:
+        parser.error('--autotune needs --dataset-url')
 
     report = run_doctor(dataset_url=args.dataset_url,
                         probe_timeout_s=args.probe_timeout,
                         sample_seconds=args.seconds,
                         batch_size=args.batch_size)
+    if args.autotune:
+        _contained(report, 'autotune',
+                   lambda: _check_autotune(args.dataset_url,
+                                           args.batch_size,
+                                           max(1.0, args.seconds / 2)))
     if args.json:
         print(json.dumps(report, default=str))
     else:

@@ -281,7 +281,7 @@ def test_check_reference_empty_and_populated(tmp_path, capsys):
     report = tmp_path / 'check.md'
     rc = check_main(['--reference-root', str(ref),
                      '--report', str(report)])
-    assert rc == 0
+    assert rc == 1  # populated WITH discrepancies (missing anchors)
     text = report.read_text()
     # found anchors check off; absent ones flag as MISSING
     assert '- [x] `def make_reader`' in text
@@ -291,3 +291,36 @@ def test_check_reference_empty_and_populated(tmp_path, capsys):
     # a reference kwarg we don't accept is surfaced as a parity gap
     assert 'frobnicate_rows' in text
     capsys.readouterr()
+
+
+def test_autotune_recommends_fastest_config(dataset):
+    """benchmark.autotune: measures the host plane under a workers grid
+    and recommends make_reader kwargs matching its fastest measurement."""
+    from petastorm_tpu.benchmark import autotune
+
+    result = autotune(dataset.url, batch_size=4, seconds_per_config=0.3,
+                      workers_grid=(1, 2))
+    ms = result['measurements']
+    assert len(ms) == 2
+    assert all(m['rows_per_s'] > 0 for m in ms)
+    assert ms[0]['rows_per_s'] >= ms[1]['rows_per_s']  # fastest first
+    rec = result['recommendation']
+    assert rec['workers_count'] == ms[0]['workers_count']
+    assert rec['reader_pool_type'] == ms[0]['pool']
+    # the recommendation is directly usable as make_reader kwargs
+    with make_reader(dataset.url, num_epochs=1, **rec) as reader:
+        assert sum(1 for _ in reader) > 0
+
+
+def test_doctor_autotune_section(dataset, capsys):
+    import json as _json
+
+    from petastorm_tpu.tools.doctor import main as doctor_main
+
+    rc = doctor_main(['--dataset-url', dataset.url, '--json',
+                      '--seconds', '0.6', '--batch-size', '4',
+                      '--autotune'])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = _json.loads(line)
+    assert 'recommendation' in parsed['autotune']
+    assert rc in (0, 1)
