@@ -66,7 +66,7 @@ def autotune(dataset_url, batch_size=64, seconds_per_config=3.0,
 
     if workers_grid is None:
         cores = os.cpu_count() or 4
-        workers_grid = sorted({2, cores, min(32, 2 * cores)})
+        workers_grid = sorted({2, min(32, cores), min(32, 2 * cores)})
     measurements = []
     extra_kwargs = {}
     for pool in pools:

@@ -129,8 +129,12 @@ def check_reference(reference_root, report_path):
                               (a.posonlyargs + a.args + a.kwonlyargs)}
                     break
         except SyntaxError as e:
-            lines.append('- reference %s failed to parse (%s) — diff the '
-                         'signature manually' % (sig_hit[0], e))
+            # An unparseable signature is an UNVERIFIED check, which must
+            # not read as a pass at the exit code.
+            missing += 1
+            lines.append('- [ ] reference %s failed to parse (%s) — the '
+                         'kwarg surface is UNVERIFIED; diff the signature '
+                         'manually' % (sig_hit[0], e))
     if theirs is not None:
         import inspect
 

@@ -249,3 +249,42 @@ def test_train_state_manager_force_and_loader_token(tmp_path):
     with build(resume=token) as loader2:
         rest = [np.asarray(b['id']).tolist() for b in loader2]
     assert first + rest == full
+
+
+def test_train_state_manager_device_inmem_mid_epoch_token(tmp_path):
+    """Composition: the HBM loader's MID-epoch token (deterministic cache
+    order) rides TrainStateManager and resumes the stream exactly — the
+    full deployment story for DeviceInMemDataLoader checkpointing."""
+    pytest.importorskip('orbax.checkpoint')
+    from petastorm_tpu.checkpoint import TrainStateManager
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    ds = create_test_dataset('file://' + str(tmp_path / 'dsd'), num_rows=40,
+                             rows_per_rowgroup=8)
+
+    def build(resume=None):
+        reader = make_reader(ds.url, reader_pool_type='dummy',
+                             shuffle_row_groups=False, num_epochs=1)
+        return DeviceInMemDataLoader(reader, batch_size=8, num_epochs=3,
+                                     seed=5, deterministic_cache_order=True,
+                                     resume_state=resume)
+
+    with build() as loader:
+        full = [np.asarray(b['id']).tolist() for b in loader]
+
+    ckdir = tmp_path / 'mgr_dim'
+    cut = 7  # 5 steps/epoch: 2 steps into epoch 1
+    with build() as loader:
+        it = iter(loader)
+        consumed = [np.asarray(next(it)['id']).tolist() for _ in range(cut)]
+        with TrainStateManager(ckdir, save_interval_steps=1,
+                               max_to_keep=1) as mgr:
+            assert mgr.save(cut, {'w': np.ones(2, np.float32)},
+                            data_state=loader.state_dict())
+
+    step, model, token = TrainStateManager.restore_latest_from(ckdir)
+    assert step == cut
+    assert token['device_inmem']['steps_into_epoch'] == 2
+    with build(resume=token) as loader2:
+        resumed = [np.asarray(b['id']).tolist() for b in loader2]
+    assert consumed + resumed == full
