@@ -135,6 +135,15 @@ def check_reference(reference_root, report_path):
             lines.append('- [ ] reference %s failed to parse (%s) — the '
                          'kwarg surface is UNVERIFIED; diff the signature '
                          'manually' % (sig_hit[0], e))
+        else:
+            if theirs is None:
+                # Parsed fine but no module-level `def make_reader` (async
+                # def / assignment / method): same UNVERIFIED rule.
+                missing += 1
+                lines.append('- [ ] `def make_reader` text found in %s but '
+                             'no function definition parsed — the kwarg '
+                             'surface is UNVERIFIED; diff the signature '
+                             'manually' % sig_hit[0])
     if theirs is not None:
         import inspect
 

@@ -1,0 +1,151 @@
+"""``petastorm-tpu-pack-dataset`` — materialize a packed token dataset.
+
+Long-context training wants static shapes (XLA compiles once per shape)
+and zero wasted FLOPs on padding.  The framework packs variable-length
+documents two ways: **online** (``PackedDataLoader`` packs while
+streaming — flexible, but the packer runs every epoch) and — this tool —
+**offline**: pack ONCE into a new petastorm dataset of fixed-shape rows
+``{'tokens', 'segment_ids', 'positions'}`` and stream it with any plain
+loader at zero train-time packing cost::
+
+    petastorm-tpu-pack-dataset file:///data/docs file:///data/docs_packed \\
+        --field tokens --max-len 4096
+
+The packed rows are exactly what ``jax/packing.py``'s consumers take:
+``segment_ids`` (1-based, 0 = padding) feed ``make_attn_fn(segment_ids=)``
+/ the Pallas flash kernels / ring attention so attention never crosses
+document boundaries, and ``next_token_targets`` derives LM labels that
+never cross packing boundaries.
+
+The reference's nearest machinery is the NGram host-side windowing
+(``petastorm/ngram.py``) and the copy tool
+(``petastorm/tools/copy_dataset.py``); sequence packing is a TPU-first
+extension (static shapes are an XLA requirement before they are an
+optimization).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+__all__ = ['pack_dataset', 'main']
+
+
+def pack_dataset(source_url, output_url, field, max_len, pad_id=0,
+                 rows_per_batch=64, rows_per_rowgroup=None,
+                 reader_kwargs=None):
+    """Pack ``field`` of every row in ``source_url`` into fixed-shape rows
+    written to a NEW petastorm dataset at ``output_url``.
+
+    Streaming end to end (``pack_stream`` keeps only open rows + one
+    emit batch in memory), so datasets far larger than RAM pack fine.
+    Returns a summary dict: rows in/out, token counts, and
+    ``packing_efficiency`` (non-pad fraction of the written tokens —
+    what dense attention FLOPs stop being wasted on).
+    """
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.jax.packing import pack_stream
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    reader_kwargs = dict(reader_kwargs or {})
+    reader_kwargs.setdefault('num_epochs', 1)
+    reader_kwargs.setdefault('shuffle_row_groups', False)
+    reader_kwargs.setdefault('schema_fields', [field])
+
+    stats = {'sequences_in': 0, 'tokens_in': 0, 'rows_out': 0}
+
+    with make_reader(source_url, **reader_kwargs) as reader:
+        def sequences():
+            for row in reader:
+                seq = np.asarray(getattr(row, field))
+                stats['sequences_in'] += 1
+                stats['tokens_in'] += int(seq.size)
+                yield seq
+
+        batches = pack_stream(sequences(), max_len=max_len,
+                              rows_per_batch=rows_per_batch, pad_id=pad_id)
+        first = next(batches, None)
+        if first is None:
+            raise ValueError('source dataset yielded no sequences in '
+                             'field %r' % field)
+        token_dtype = first['tokens'].dtype
+        schema = Unischema('Packed_%s' % field, [
+            UnischemaField('tokens', token_dtype, (max_len,),
+                           NdarrayCodec(), False),
+            UnischemaField('segment_ids', np.int32, (max_len,),
+                           NdarrayCodec(), False),
+            UnischemaField('positions', np.int32, (max_len,),
+                           NdarrayCodec(), False),
+        ])
+
+        def emit(batch):
+            for i in range(len(batch['tokens'])):
+                if not batch['segment_ids'][i].any():
+                    # StreamPacker pads the FINAL batch up to
+                    # rows_per_batch with all-pad rows — a train-time
+                    # static-shape concern that must not be baked into an
+                    # offline dataset (they would stream forever as
+                    # zero-weight steps, and an all-empty segment mask is
+                    # a NaN risk for masked attention).
+                    continue
+                stats['rows_out'] += 1
+                yield {'tokens':
+                           batch['tokens'][i].astype(token_dtype,
+                                                     copy=False),
+                       'segment_ids':
+                           batch['segment_ids'][i].astype(np.int32,
+                                                          copy=False),
+                       'positions':
+                           batch['positions'][i].astype(np.int32,
+                                                        copy=False)}
+
+        with DatasetWriter(output_url, schema,
+                           rows_per_rowgroup=rows_per_rowgroup
+                           or rows_per_batch) as writer:
+            writer.write_many(emit(first))
+            for batch in batches:
+                writer.write_many(emit(batch))
+
+    tokens_out = stats['rows_out'] * max_len
+    stats.update({
+        'max_len': max_len,
+        'tokens_out': tokens_out,
+        'packing_efficiency': round(stats['tokens_in'] / tokens_out, 4)
+        if tokens_out else 0.0,
+        'output_url': output_url,
+    })
+    return stats
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split('\n\n')[0])
+    parser.add_argument('source_url')
+    parser.add_argument('output_url')
+    parser.add_argument('--field', required=True,
+                        help='variable-length token column to pack')
+    parser.add_argument('--max-len', type=int, required=True,
+                        help='packed row length (the static train shape)')
+    parser.add_argument('--pad-id', type=int, default=0)
+    parser.add_argument('--rows-per-batch', type=int, default=64,
+                        help='packer emission granularity (also the '
+                             'default output row-group size)')
+    parser.add_argument('--rows-per-rowgroup', type=int, default=None)
+    args = parser.parse_args(argv)
+    stats = pack_dataset(args.source_url, args.output_url,
+                         field=args.field, max_len=args.max_len,
+                         pad_id=args.pad_id,
+                         rows_per_batch=args.rows_per_batch,
+                         rows_per_rowgroup=args.rows_per_rowgroup)
+    print('packed %d sequences (%d tokens) -> %d rows of %d; %.1f%% of '
+          'written tokens are real (rest is pad)'
+          % (stats['sequences_in'], stats['tokens_in'], stats['rows_out'],
+             stats['max_len'], 100 * stats['packing_efficiency']))
+    print('-> %s' % stats['output_url'])
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

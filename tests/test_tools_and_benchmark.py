@@ -324,3 +324,70 @@ def test_doctor_autotune_section(dataset, capsys):
     parsed = _json.loads(line)
     assert 'recommendation' in parsed['autotune']
     assert rc in (0, 1)
+
+
+def test_pack_dataset_tool_roundtrip(tmp_path):
+    """petastorm-tpu-pack-dataset: variable-length docs -> fixed-shape
+    packed petastorm dataset.  Every input token appears exactly once in
+    the output with consistent segment/position bookkeeping, the written
+    dataset reads back through plain make_reader with static shapes, and
+    next_token_targets composes (labels never cross packing boundaries)."""
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import write_dataset
+    from petastorm_tpu.jax.packing import next_token_targets
+    from petastorm_tpu.tools.pack_dataset import main as pack_main, pack_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    src = 'file://' + str(tmp_path / 'docs')
+    out = 'file://' + str(tmp_path / 'packed')
+    rng = np.random.default_rng(3)
+    schema = Unischema('Docs', [
+        UnischemaField('tokens', np.int32, (None,), NdarrayCodec(), False),
+    ])
+    docs = [rng.integers(1, 90, rng.integers(3, 14)).astype(np.int32)
+            for _ in range(37)]
+    write_dataset(schema, [{'tokens': d} for d in docs], src,
+                  rows_per_rowgroup=8)
+
+    stats = pack_dataset(src, out, field='tokens', max_len=16,
+                         rows_per_batch=4)
+    assert stats['sequences_in'] == 37
+    assert stats['tokens_in'] == sum(len(d) for d in docs)
+    assert 0.5 < stats['packing_efficiency'] <= 1.0
+
+    with make_reader(out, reader_pool_type='dummy', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        rows = list(reader)
+    assert len(rows) == stats['rows_out']
+    # no all-pad filler rows may be baked into the offline dataset
+    assert all(int(np.asarray(r.segment_ids).max()) > 0 for r in rows)
+    seen = []
+    for row in rows:
+        assert row.tokens.shape == (16,)
+        assert row.segment_ids.shape == (16,)
+        for seg in range(1, int(row.segment_ids.max()) + 1):
+            mask = row.segment_ids == seg
+            seen.append(row.tokens[mask].tolist())
+            # positions restart per segment
+            np.testing.assert_array_equal(row.positions[mask],
+                                          np.arange(mask.sum()))
+        assert (row.tokens[row.segment_ids == 0] == 0).all()
+        # LM labels derived from packed rows stay within segments
+        targets, weights = next_token_targets(row.tokens[None],
+                                              row.segment_ids[None])
+        assert targets.shape == (1, 16) and weights.shape == (1, 16)
+    # every document appears exactly once (packing is a permutation)
+    assert sorted(map(tuple, seen)) == sorted(map(tuple, (d.tolist() for d in docs)))
+
+    # CLI form over a fresh output
+    rc = pack_main([src, 'file://' + str(tmp_path / 'packed2'),
+                    '--field', 'tokens', '--max-len', '16'])
+    assert rc == 0
+
+    # oversized sequence -> the packer's named refusal propagates
+    write_dataset(schema, [{'tokens': np.arange(99, dtype=np.int32)}],
+                  'file://' + str(tmp_path / 'big'), rows_per_rowgroup=4)
+    with pytest.raises(ValueError, match='exceeds'):
+        pack_dataset('file://' + str(tmp_path / 'big'),
+                     'file://' + str(tmp_path / 'packed3'),
+                     field='tokens', max_len=16)
