@@ -53,14 +53,15 @@ def _as_int(value):
     return None if value is None else int(value)
 
 
-def _local_items(num_global_pieces, drop_partitions, cur_shard, shard_count):
-    """Reconstruct the work-item list of one shard — MUST mirror
-    ``reader.py`` (items = sharded global indices × drop partitions)."""
-    if shard_count is None:
-        indices = range(num_global_pieces)
-    else:
-        indices = [i for i in range(num_global_pieces)
-                   if i % shard_count == cur_shard]
+def _local_items(num_global_pieces, drop_partitions, cur_shard, shard_count,
+                 shard_seed=None):
+    """Reconstruct the work-item list of one shard — THE one sharding
+    implementation (``reader._shard_indices``) derives the indices, so the
+    reconstruction can never drift from what the readers actually ran
+    (items = sharded global indices × drop partitions)."""
+    from petastorm_tpu.reader import _shard_indices
+    indices = _shard_indices(num_global_pieces, cur_shard, shard_count,
+                             shard_seed=shard_seed)
     return [(i, p) for i in indices for p in range(max(1, drop_partitions))]
 
 
@@ -94,6 +95,8 @@ def _normalized(states):
                          'shard\'s token' % (len(states), shard_count))
     shared = {k: states[0][k] for k in _TOPOLOGY_KEYS}
     shared['num_epochs'] = states[0].get('num_epochs')
+    # Tokens predating shard_seed simply lack the key (None = unpermuted).
+    shared['shard_seed'] = _as_int(states[0].get('shard_seed'))
     for s in states:
         if _as_int(s['shard_count']) != shard_count:
             raise ValueError('states disagree on shard_count')
@@ -103,6 +106,9 @@ def _normalized(states):
             raise ValueError('states disagree on dataset topology')
         if s.get('num_epochs') != shared['num_epochs']:
             raise ValueError('states disagree on num_epochs')
+        if _as_int(s.get('shard_seed')) != shared['shard_seed']:
+            raise ValueError('states disagree on shard_seed — the shard '
+                             'partition itself would differ')
         if s.get('seed') != states[0].get('seed'):
             # Resharding stamps every new token with shard 0's seed; under
             # divergent per-shard seeds that would silently change the
@@ -172,7 +178,8 @@ def reshard_reader_states(states, new_shard_count):
     prologue = []
     for idx, s in enumerate(ordered):
         cur_shard = None if old_count is None else idx
-        items = _local_items(num_pieces, drop, cur_shard, old_count)
+        items = _local_items(num_pieces, drop, cur_shard, old_count,
+                             shard_seed=shared['shard_seed'])
         seed = s.get('seed') or 0
         prologue.extend(tuple(map(int, it)) for it in (s.get('prologue') or ()))
         epoch, cursor = int(s['epoch']), int(s['cursor'])
@@ -188,6 +195,7 @@ def reshard_reader_states(states, new_shard_count):
                  'cur_shard': m, 'shard_count': new_shard_count,
                  'num_epochs': num_epochs}
         token.update({k: shared[k] for k in _TOPOLOGY_KEYS})
+        token['shard_seed'] = shared['shard_seed']
         out.append(token)
     return out
 

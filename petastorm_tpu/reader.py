@@ -80,18 +80,32 @@ def _resolve_cache(cache_type, cache_location, cache_size_limit, cache_row_size_
     raise ValueError("cache_type must be 'null' or 'local-disk', got %r" % (cache_type,))
 
 
-def _shard_indices(num_pieces, cur_shard, shard_count):
+def _shard_indices(num_pieces, cur_shard, shard_count, shard_seed=None):
     """Global piece indices belonging to this shard (``i % shard_count ==
-    cur_shard``).  Workers keep the GLOBAL piece list and work items carry
-    global indices, so an elastic-reshard prologue (``elastic.py``) can hand
-    any reader work from any former shard."""
+    cur_shard`` over a ``shard_seed``-permuted order).  Workers keep the
+    GLOBAL piece list and work items carry global indices, so an
+    elastic-reshard prologue (``elastic.py``) can hand any reader work
+    from any former shard.
+
+    ``shard_seed`` (reference parity: ``petastorm/reader.py ::
+    make_reader(shard_seed=)``) deterministically permutes the row-group
+    order BEFORE the modulo split, de-correlating shard membership from
+    on-disk layout (e.g. time-ordered writes putting one class's row
+    groups on one host).  Every host must pass the SAME value — shards
+    stay disjoint and complete by construction, but only within one
+    permutation.  ``elastic._local_items`` mirrors this exactly.
+    """
     if shard_count is None:
         if cur_shard is not None:
             raise ValueError('cur_shard requires shard_count')
         return list(range(num_pieces))
     if cur_shard is None or not 0 <= cur_shard < shard_count:
         raise ValueError('cur_shard must be in [0, %d), got %r' % (shard_count, cur_shard))
-    return [i for i in range(num_pieces) if i % shard_count == cur_shard]
+    order = list(range(num_pieces))
+    if shard_seed is not None:
+        import numpy as _np
+        _np.random.default_rng(int(shard_seed)).shuffle(order)
+    return [order[i] for i in range(num_pieces) if i % shard_count == cur_shard]
 
 
 def make_reader(dataset_url,
@@ -100,7 +114,7 @@ def make_reader(dataset_url,
                 shuffle_row_groups=True, shuffle_row_drop_partitions=1,
                 predicate=None, rowgroup_selector=None,
                 num_epochs=1,
-                cur_shard=None, shard_count=None,
+                cur_shard=None, shard_count=None, shard_seed=None,
                 cache_type='null', cache_location=None, cache_size_limit=None,
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None,
@@ -132,7 +146,7 @@ def make_reader(dataset_url,
         shuffle_row_drop_partitions=shuffle_row_drop_partitions,
         predicate=predicate, rowgroup_selector=rowgroup_selector,
         num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
-        cache_type=cache_type, cache_location=cache_location,
+        shard_seed=shard_seed, cache_type=cache_type, cache_location=cache_location,
         cache_size_limit=cache_size_limit,
         cache_row_size_estimate=cache_row_size_estimate,
         cache_extra_settings=cache_extra_settings,
@@ -146,7 +160,8 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
                         reader_pool_type, workers_count, results_queue_size,
                         shuffle_row_groups, shuffle_row_drop_partitions,
                         predicate, rowgroup_selector, num_epochs, cur_shard,
-                        shard_count, cache_type, cache_location, cache_size_limit,
+                        shard_count, shard_seed,
+                        cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings,
                         transform_spec, filters, seed, resume_state, zmq_copy_buffers,
                         columnar_decode=False, read_retries=2, retry_backoff_s=0.1):
@@ -180,7 +195,8 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
         if shard_count is not None:
             logger.info('Auto-sharding by JAX process topology: shard %d of %d',
                         cur_shard, shard_count)
-    local_indices = _shard_indices(len(pieces), cur_shard, shard_count)
+    local_indices = _shard_indices(len(pieces), cur_shard, shard_count,
+                                   shard_seed=shard_seed)
     if not local_indices and 'prologue' not in (resume_state or {}):
         raise NoDataAvailableError(
             'No row groups to read from %r after sharding/selection' % (dataset_url,))
@@ -201,6 +217,7 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
     drop_partitions = max(1, shuffle_row_drop_partitions)
     items = [(i, p) for i in local_indices for p in range(drop_partitions)]
     topology = {'cur_shard': cur_shard, 'shard_count': shard_count,
+                'shard_seed': None if shard_seed is None else int(shard_seed),
                 'num_global_pieces': len(pieces),
                 'drop_partitions': drop_partitions,
                 'shuffle': bool(shuffle_row_groups)}
@@ -233,7 +250,7 @@ def make_batch_reader(dataset_url_or_urls,
                       shuffle_row_groups=True,
                       predicate=None,
                       num_epochs=1,
-                      cur_shard=None, shard_count=None,
+                      cur_shard=None, shard_count=None, shard_seed=None,
                       cache_type='null', cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None,
@@ -272,7 +289,8 @@ def make_batch_reader(dataset_url_or_urls,
 
     if cur_shard is None and shard_count is None:
         cur_shard, shard_count = _jax_default_shard()
-    local_indices = _shard_indices(len(pieces), cur_shard, shard_count)
+    local_indices = _shard_indices(len(pieces), cur_shard, shard_count,
+                                   shard_seed=shard_seed)
     if not local_indices and 'prologue' not in (resume_state or {}):
         raise NoDataAvailableError(
             'No row groups to read from %r after sharding/selection' % (dataset_url_or_urls,))
@@ -286,6 +304,7 @@ def make_batch_reader(dataset_url_or_urls,
                                   retry_backoff_s=retry_backoff_s)
     items = [(i, 0) for i in local_indices]
     topology = {'cur_shard': cur_shard, 'shard_count': shard_count,
+                'shard_seed': None if shard_seed is None else int(shard_seed),
                 'num_global_pieces': len(pieces), 'drop_partitions': 1,
                 'shuffle': bool(shuffle_row_groups)}
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size, zmq_copy_buffers)
@@ -362,7 +381,14 @@ class Reader(object):
         mismatches = [
             k for k in ('cur_shard', 'shard_count', 'num_global_pieces',
                         'drop_partitions')
-            if norm(resume_state.get(k, self._topology[k])) != norm(self._topology[k])]
+            if norm(resume_state.get(k, self._topology.get(k))) != norm(self._topology.get(k))]
+        # shard_seed: a token MISSING the key predates the feature and
+        # indexes the UNPERMUTED order (None) — it must not default to the
+        # reader's own seed, or the guard would wave through exactly the
+        # mismatch it exists to catch.
+        if norm(resume_state.get('shard_seed')) \
+                != norm(self._topology.get('shard_seed')):
+            mismatches.append('shard_seed')
         if bool(resume_state.get('shuffle', self._topology['shuffle'])) \
                 != bool(self._topology['shuffle']):
             mismatches.append('shuffle')

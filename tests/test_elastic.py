@@ -531,3 +531,40 @@ def test_elastic_resume_through_train_state_manager(dataset, tmp_path):
 
     assert Counter(consumed) + Counter(after) == \
         Counter({i: num_epochs for i in range(ROWS)})
+
+
+def test_reshard_with_shard_seed(dataset):
+    """shard_seed partitions reshard faithfully: the permuted membership is
+    reconstructed from the tokens (elastic._local_items mirrors
+    reader._shard_indices), coverage stays exact, and mismatched seeds
+    across tokens refuse."""
+    num_epochs = 2
+    readers = _readers(dataset.url, 2, num_epochs=num_epochs, shard_seed=42)
+    consumed, states = [], []
+    for s, reader in enumerate(readers):
+        for _ in range((s + 1) * 5):
+            consumed.append(next(iter(reader)))
+        consumed.extend(reader.drain_in_flight())
+        states.append(reader.state_dict())
+        reader.stop(); reader.join()
+    assert all(st['shard_seed'] == 42 for st in states)
+
+    tokens = reshard_reader_states(states, 3)
+    after = []
+    for m, token in enumerate(tokens):
+        assert token['shard_seed'] == 42  # rides the new tokens
+        with make_reader(dataset.url, cur_shard=m, shard_count=3,
+                         shard_seed=42, num_epochs=num_epochs,
+                         shuffle_row_groups=True, seed=11,
+                         reader_pool_type='dummy',
+                         resume_state=token) as r:
+            after.extend(list(r))
+    total = Counter(_ids(consumed)) + Counter(_ids(after))
+    for i in range(ROWS):
+        assert total[i] >= num_epochs, 'row %d lost: %r' % (i, total[i])
+    assert sum(total.values()) <= ROWS * num_epochs + ROWS, total
+
+    # tokens disagreeing on shard_seed must refuse
+    bad = [dict(states[0]), dict(states[1], shard_seed=7)]
+    with pytest.raises(ValueError, match='shard_seed'):
+        reshard_reader_states(bad, 3)

@@ -321,3 +321,53 @@ def test_auto_shard_uses_real_jax_api(monkeypatch):
     monkeypatch.setattr(jax, 'process_count', lambda: 4)
     monkeypatch.setattr(jax, 'process_index', lambda: 3)
     assert reader_mod._jax_default_shard() == (3, 4)
+
+
+def test_shard_seed_permutes_membership(dataset):
+    """shard_seed (reference parity kwarg) deterministically permutes
+    row-group order before the modulo split: shards stay disjoint and
+    complete, membership de-correlates from on-disk order, and the same
+    seed reproduces the same partition."""
+    def shards(seed):
+        out = []
+        for shard in range(3):
+            with make_reader(dataset.url, cur_shard=shard, shard_count=3,
+                             shard_seed=seed, shuffle_row_groups=False,
+                             reader_pool_type='dummy') as reader:
+                out.append(frozenset(int(r.id) for r in reader))
+        return out
+
+    seeded = shards(123)
+    assert seeded[0] | seeded[1] | seeded[2] == set(range(30))
+    assert seeded[0].isdisjoint(seeded[1]) and seeded[1].isdisjoint(seeded[2])
+    assert shards(123) == seeded                  # deterministic
+    assert set(shards(None)) != set(seeded)       # permutation applied
+    assert set(shards(7)) != set(seeded)          # seed-dependent
+
+
+def test_shard_seed_resume_topology_guard(dataset):
+    """A token taken under one shard_seed indexes THAT partition; resuming
+    under another must refuse."""
+    with make_reader(dataset.url, cur_shard=0, shard_count=2, shard_seed=5,
+                     reader_pool_type='dummy', num_epochs=2) as reader:
+        next(iter(reader))
+        state = reader.state_dict()
+    assert state['shard_seed'] == 5
+    with pytest.raises(ValueError, match='topology'):
+        make_reader(dataset.url, cur_shard=0, shard_count=2, shard_seed=9,
+                    reader_pool_type='dummy', num_epochs=2,
+                    resume_state=state)
+    # same seed resumes fine
+    r = make_reader(dataset.url, cur_shard=0, shard_count=2, shard_seed=5,
+                    reader_pool_type='dummy', num_epochs=2,
+                    resume_state=state)
+    r.stop(); r.join()
+
+    # a token PREDATING shard_seed (key absent) indexes the unpermuted
+    # order and must refuse on a seeded reader — absence is None, not
+    # 'whatever the new reader uses'
+    legacy = {k: v for k, v in state.items() if k != 'shard_seed'}
+    with pytest.raises(ValueError, match='shard_seed'):
+        make_reader(dataset.url, cur_shard=0, shard_count=2, shard_seed=5,
+                    reader_pool_type='dummy', num_epochs=2,
+                    resume_state=legacy)
