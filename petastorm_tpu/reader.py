@@ -104,7 +104,12 @@ def _shard_indices(num_pieces, cur_shard, shard_count, shard_seed=None):
     order = list(range(num_pieces))
     if shard_seed is not None:
         import numpy as _np
-        _np.random.default_rng(int(shard_seed)).shuffle(order)
+        # RandomState, not default_rng: the partition must be a pure
+        # function of the seed ACROSS numpy versions (hosts in one job, or
+        # a resume after an upgrade, may differ) — NumPy's stream-compat
+        # guarantee covers the legacy RandomState, not Generator.
+        order = _np.random.RandomState(int(shard_seed) & 0xffffffff) \
+            .permutation(num_pieces).tolist()
     return [order[i] for i in range(num_pieces) if i % shard_count == cur_shard]
 
 

@@ -111,6 +111,11 @@ def test_criteo_dlrm(tmp_path):
     out = _run(['examples/criteo/jax_example.py', '--dataset-url', url,
                 '--epochs', '1', '--batch-size', '256'])
     assert 'loss=' in out
+    # fused consumption flag (the bench's stall_pct_dlrm_scan pattern)
+    out = _run(['examples/criteo/jax_example.py', '--dataset-url', url,
+                '--epochs', '1', '--batch-size', '256',
+                '--scan-steps', '2'])
+    assert 'loss=' in out and 'fused scan' in out
 
 
 def test_ngram_sensor(tmp_path):
