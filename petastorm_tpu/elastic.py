@@ -66,11 +66,10 @@ def _local_items(num_global_pieces, drop_partitions, cur_shard, shard_count,
 
 
 def _epoch_order(items, shuffle, seed, epoch):
-    """MUST mirror ``ConcurrentVentilator._epoch_order``."""
-    if not shuffle:
-        return list(items)
-    rng = np.random.default_rng((seed or 0, epoch))
-    return [items[i] for i in rng.permutation(len(items))]
+    """Delegates to the ventilator's canonical implementation (the seed
+    normalization mirrors ``ConcurrentVentilator.__init__``'s default)."""
+    from petastorm_tpu.workers_pool.ventilator import epoch_order
+    return epoch_order(items, shuffle, seed or 0, epoch)
 
 
 def _normalized(states):
@@ -97,6 +96,14 @@ def _normalized(states):
     shared['num_epochs'] = states[0].get('num_epochs')
     # Tokens predating shard_seed simply lack the key (None = unpermuted).
     shared['shard_seed'] = _as_int(states[0].get('shard_seed'))
+    shared['shard_scheme'] = states[0].get('shard_scheme')
+    if shared['shard_seed'] is not None \
+            and shared['shard_scheme'] != 'rs-perm-v1':
+        raise ValueError(
+            'tokens carry shard_seed=%r under permutation scheme %r, but '
+            'this build computes rs-perm-v1 — resharding them would '
+            'reconstruct the wrong old-shard partitions'
+            % (shared['shard_seed'], shared['shard_scheme']))
     for s in states:
         if _as_int(s['shard_count']) != shard_count:
             raise ValueError('states disagree on shard_count')
@@ -196,6 +203,7 @@ def reshard_reader_states(states, new_shard_count):
                  'num_epochs': num_epochs}
         token.update({k: shared[k] for k in _TOPOLOGY_KEYS})
         token['shard_seed'] = shared['shard_seed']
+        token['shard_scheme'] = shared['shard_scheme']
         out.append(token)
     return out
 

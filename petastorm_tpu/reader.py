@@ -223,6 +223,7 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
     items = [(i, p) for i in local_indices for p in range(drop_partitions)]
     topology = {'cur_shard': cur_shard, 'shard_count': shard_count,
                 'shard_seed': None if shard_seed is None else int(shard_seed),
+                'shard_scheme': None if shard_seed is None else 'rs-perm-v1',
                 'num_global_pieces': len(pieces),
                 'drop_partitions': drop_partitions,
                 'shuffle': bool(shuffle_row_groups)}
@@ -310,6 +311,7 @@ def make_batch_reader(dataset_url_or_urls,
     items = [(i, 0) for i in local_indices]
     topology = {'cur_shard': cur_shard, 'shard_count': shard_count,
                 'shard_seed': None if shard_seed is None else int(shard_seed),
+                'shard_scheme': None if shard_seed is None else 'rs-perm-v1',
                 'num_global_pieces': len(pieces), 'drop_partitions': 1,
                 'shuffle': bool(shuffle_row_groups)}
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size, zmq_copy_buffers)
@@ -394,6 +396,14 @@ class Reader(object):
         if norm(resume_state.get('shard_seed')) \
                 != norm(self._topology.get('shard_seed')):
             mismatches.append('shard_seed')
+        elif norm(resume_state.get('shard_seed')) is not None \
+                and resume_state.get('shard_scheme') \
+                != self._topology.get('shard_scheme'):
+            # Same seed value but a different (or unmarked) PERMUTATION
+            # SCHEME computes a different partition — the marker exists so
+            # a future scheme change refuses old tokens instead of
+            # silently mis-sharding.
+            mismatches.append('shard_scheme')
         if bool(resume_state.get('shuffle', self._topology['shuffle'])) \
                 != bool(self._topology['shuffle']):
             mismatches.append('shuffle')

@@ -21,6 +21,20 @@ from petastorm_tpu.workers_pool import VentilatedItem
 
 logger = logging.getLogger(__name__)
 
+def epoch_order(items, shuffle, seed, epoch):
+    """Canonical per-epoch work-item order — THE one implementation.
+
+    Both the live ventilator and ``elastic.reshard_reader_states`` (which
+    reconstructs what a checkpointed ventilator WOULD have dispatched)
+    derive from this function; duplicating it would let the two silently
+    drift and make resharded tokens skip/replay work.
+    """
+    if not shuffle:
+        return list(items)
+    rng = np.random.default_rng((seed, epoch))
+    return [items[i] for i in rng.permutation(len(items))]
+
+
 
 class Ventilator(object):
     """Base: something that injects work items into a pool."""
@@ -114,10 +128,7 @@ class ConcurrentVentilator(Ventilator):
             return {'epoch': oldest // n, 'cursor': oldest % n, 'seed': self._seed}
 
     def _epoch_order(self, epoch):
-        if not self._randomize:
-            return self._items
-        rng = np.random.default_rng((self._seed, epoch))
-        return [self._items[i] for i in rng.permutation(len(self._items))]
+        return epoch_order(self._items, self._randomize, self._seed, epoch)
 
     # -- lifecycle -----------------------------------------------------------
 
