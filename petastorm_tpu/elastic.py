@@ -113,6 +113,14 @@ def _normalized(states):
             raise ValueError('states disagree on dataset topology')
         if s.get('num_epochs') != shared['num_epochs']:
             raise ValueError('states disagree on num_epochs')
+        if s.get('shard_scheme') != shared['shard_scheme']:
+            # Agreement must hold for EVERY state, not just states[0] —
+            # otherwise input order decides whether an unmarked token
+            # (which the reader's own guard would refuse) gets laundered
+            # into a marked output token.
+            raise ValueError('states disagree on shard_scheme (%r vs %r)'
+                             % (s.get('shard_scheme'),
+                                shared['shard_scheme']))
         if _as_int(s.get('shard_seed')) != shared['shard_seed']:
             raise ValueError('states disagree on shard_seed — the shard '
                              'partition itself would differ')

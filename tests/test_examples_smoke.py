@@ -48,6 +48,23 @@ def test_mnist(tmp_path):
     out = _run(['examples/mnist/jax_example.py', '--epochs', '1',
                 '--dataset-url', url])
     assert 'final accuracy' in out
+    # checkpoint story: a run with --checkpoint-dir persists train state
+    # (params as orbax pytree, opt state + loader token as the data
+    # blob); a rerun over the same dir restores the final step and has
+    # nothing left to train
+    ck = str(tmp_path / 'ck')
+    out = _run(['examples/mnist/jax_example.py', '--epochs', '1',
+                '--dataset-url', url, '--checkpoint-dir', ck,
+                '--save-every', '1'])
+    assert 'final accuracy' in out
+    out = _run(['examples/mnist/jax_example.py', '--epochs', '1',
+                '--dataset-url', url, '--checkpoint-dir', ck])
+    assert 'resumed at step' in out
+    assert 'already covers all 1 epochs' in out
+    # raising --epochs over the same dir continues from the restored state
+    out = _run(['examples/mnist/jax_example.py', '--epochs', '2',
+                '--dataset-url', url, '--checkpoint-dir', ck])
+    assert 'resumed at step' in out and 'epoch 1:' in out
 
 
 def test_mnist_pytorch(tmp_path):
