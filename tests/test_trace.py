@@ -125,3 +125,23 @@ def test_thread_safety_under_concurrent_append():
         t.join()
     assert not errs
     assert len(rec.events) == 20_000
+
+
+def test_disk_cached_loader_traces(dataset, tmp_path):
+    """trace_recorder flows through the cache-tier loaders' **loader_kwargs
+    (DiskCachedDataLoader builds + serves through the base pipeline)."""
+    rec = TraceRecorder()
+    from petastorm_tpu.jax import DiskCachedDataLoader
+
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     num_epochs=1) as reader:
+        loader = DiskCachedDataLoader(reader, batch_size=BATCH,
+                                      decoded_cache_dir=str(tmp_path / 'dc'),
+                                      num_epochs=2, shuffle=False,
+                                      trace_recorder=rec)
+        n = sum(1 for _ in loader)
+    assert n == 2 * (ROWS // BATCH)
+    spans = _spans_by_name(rec.events)
+    # epoch 0 (decode+spill) and epoch 1 (mmap serve) both record
+    assert len(spans['host_batch']) == n
+    assert len(spans['device_put']) == n
