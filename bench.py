@@ -31,6 +31,7 @@ machine-readable (round 3's one giant line overflowed the tail capture).
 
 import json
 import os
+import re
 import sys
 import time
 
@@ -965,9 +966,112 @@ _COMPACT_KEYS = (
     'stall_pct_dlrm', 'stall_pct_dlrm_scan', 'dlrm_rows_per_s',
     'streaming_scan_floor_stall_pct', 'transport_bound', 'device_step_ms',
     'step_dtype', 'model_tflops_per_s', 'device_peak_tflops_bf16',
-    'mfu_pct', 'legs_failed', 'throughput_error', 'device_unhealthy',
+    'mfu_pct', 'delivery_plane_images_per_sec_host', 'h2d_bytes_per_s',
+    'kernel_backend', 'kernel_max_err',
+    'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',
     'error',
 )
+
+
+#: Where the artifact's MEMORY lives.  Twice in four rounds (r02, r04) the
+#: driver's end-of-round bench hit a wedged tunnel and the round's on-chip
+#: evidence — measured hours earlier in THIS repo by THIS script — shipped
+#: nowhere.  Every completed on-chip run now persists its evidence subset
+#: here; a CPU-fallback run re-emits it as a labeled ``last_tpu`` block.
+_TPU_LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              'BENCH_TPU_LAST.json')
+
+_DETAIL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            'BENCH_DETAIL_LAST.json')
+
+#: The on-chip evidence worth remembering across runs: stall family, step
+#: floor/precision/MFU, DLRM, kernel certs, and the tunnel-condition tags
+#: (h2d bandwidth, device health) that say what regime the numbers were
+#: measured under.  Derived from _COMPACT_KEYS (minus the label/plumbing
+#: keys that describe THIS run, not the chip) so a new compact field can't
+#: silently miss the memory; plus the detail-only transport tag.
+_TPU_EVIDENCE_KEYS = tuple(
+    k for k in _COMPACT_KEYS
+    if k not in ('metric', 'unit', 'value_spread', 'runs', 'backend',
+                 'throughput_error', 'last_tpu', 'error')
+) + ('transport_ms_per_step',)
+
+#: Evidence gate: a record with none of these measured is a label, not a
+#: number, and must not overwrite a real one.
+_TPU_EVIDENCE_CORE = (
+    'stall_pct', 'device_step_ms', 'mfu_pct', 'dlrm_rows_per_s',
+    'stall_pct_streaming', 'stall_pct_streaming_scan', 'stall_pct_hbm_scan',
+)
+
+
+import threading as _threading  # noqa: E402 — stdlib, needed at module scope
+
+#: Created once at import: the watchdog timer thread and the main thread can
+#: both reach _persist_tpu_evidence; a lazily check-then-set lock could hand
+#: each its own Lock and serialize nothing.
+_TPU_LAST_LOCK = _threading.Lock()
+
+
+def _persist_tpu_evidence(result, complete):
+    """Write an on-chip run's evidence subset to ``BENCH_TPU_LAST.json``.
+
+    ``complete=False`` records a watchdog/wedge partial; it is stored under
+    its own key so a later partial can never clobber a complete record.
+    Write is atomic (tmp + rename) and serialized against the watchdog
+    thread — the exact environment this exists for is one where the
+    process can be killed mid-write.  Contained: persistence must never
+    cost the artifact being emitted.  Returns True iff a record landed."""
+    try:
+        rec = {k: result[k] for k in _TPU_EVIDENCE_KEYS
+               if result.get(k) is not None}
+        if not any(rec.get(k) is not None for k in _TPU_EVIDENCE_CORE):
+            return False
+        rec['ts'] = time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())
+        rec['complete'] = bool(complete)
+        with _TPU_LAST_LOCK:
+            try:
+                with open(_TPU_LAST_PATH) as f:
+                    store = json.load(f)
+                if not isinstance(store, dict):
+                    store = {}
+            except (OSError, ValueError):
+                store = {}
+            store['complete' if complete else 'partial'] = rec
+            tmp = _TPU_LAST_PATH + '.tmp'
+            with open(tmp, 'w') as f:
+                # default=str: the watchdog persists a merged dict that can
+                # hold half-built values mid-wedge (np scalars etc.) — the
+                # record must land anyway, stringly-typed beats absent.
+                json.dump(store, f, indent=1, sort_keys=True, default=str)
+            os.replace(tmp, _TPU_LAST_PATH)
+        return True
+    except Exception:  # noqa: BLE001 — memory is best-effort, artifact first
+        return False
+
+
+def _load_last_tpu():
+    """The best remembered on-chip evidence record, or None.
+
+    Prefers the newest record; ties and unparseable timestamps fall back to
+    preferring the complete record over the wedge partial."""
+    try:
+        with open(_TPU_LAST_PATH) as f:
+            store = json.load(f)
+        recs = [store[k] for k in ('complete', 'partial')
+                if isinstance(store.get(k), dict)]
+        if not recs:
+            return None
+
+        def key(r):
+            ts = str(r.get('ts', ''))
+            # A malformed ts must sort BELOW every valid ISO stamp (a
+            # lexicographic 'unknown' would beat any '2026-…'); validity
+            # first, then recency, then complete-beats-partial.
+            valid = bool(re.match(r'^\d{4}-\d{2}-\d{2}T', ts))
+            return (valid, ts if valid else '', bool(r.get('complete')))
+        return max(recs, key=key)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def _emit(result):
@@ -979,10 +1083,30 @@ def _emit(result):
     parses the last line — round 3's single giant line overflowed it
     (``BENCH_r03.json "parsed": null``), so the machine-readable line must
     stay small and LAST."""
-    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               'BENCH_DETAIL_LAST.json')
+    if result.get('backend') == 'tpu':
+        # A completed on-chip run IS the evidence — remember it before
+        # anything else can go wrong.  "Complete" means every leg actually
+        # ran: a degraded run (legs failed, device died mid-run) records as
+        # a partial so it can never clobber a genuinely healthy record.
+        degraded = bool(result.get('device_unhealthy')
+                        or result.get('legs_failed')
+                        or result.get('throughput_error')
+                        or result.get('error'))
+        _persist_tpu_evidence(result, complete=not degraded)
+    else:
+        # Not on chip this run (wedged tunnel → cpu-fallback, or a CPU
+        # sandbox): re-emit the last remembered on-chip evidence, clearly
+        # labeled, so a capture-time wedge can't erase the round's TPU story.
+        last = _load_last_tpu()
+        if last is not None:
+            result['last_tpu'] = last
+            result['last_tpu_note'] = (
+                'prior on-chip run of THIS bench, persisted to '
+                'BENCH_TPU_LAST.json at last_tpu.ts; complete=false means a '
+                'watchdog partial. Present because this run had no healthy '
+                'TPU at capture time.')
     try:
-        with open(detail_path, 'w') as f:
+        with open(_DETAIL_PATH, 'w') as f:
             json.dump(result, f, indent=1, sort_keys=True)
     except OSError:
         pass
@@ -1066,15 +1190,26 @@ def _start_watchdog(budget_s):
                 'unit': 'images/s',
                 'error': err,
             })
+            # The artifact memory works on the wedge path too: legs that
+            # completed on chip before the wedge are persisted (as a
+            # partial record), and — whether or not THIS run was on chip —
+            # a partial carrying no on-chip evidence of its own (wedged
+            # before the first train leg finished) still re-emits the last
+            # remembered record.  Persist-then-load, so a just-persisted
+            # partial isn't echoed back beside its own live fields.
+            persisted = False
+            if merged.get('backend') == 'tpu':
+                persisted = _persist_tpu_evidence(merged, complete=False)
+            if not persisted:
+                last = _load_last_tpu()
+                if last is not None:
+                    partial['last_tpu'] = last
             print(json.dumps(partial, default=str), flush=True)
             # The detail file must reflect THIS run too — otherwise a
             # wedged run leaves the previous run's detail on disk, silently
             # stale.  AFTER the compact line: the line is the artifact.
             try:
-                detail_path = os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    'BENCH_DETAIL_LAST.json')
-                with open(detail_path, 'w') as f:
+                with open(_DETAIL_PATH, 'w') as f:
                     json.dump(dict(merged, **partial), f, indent=1,
                               sort_keys=True, default=str)
             except Exception:  # noqa: BLE001 — detail is best-effort

@@ -1,0 +1,205 @@
+"""The bench artifact's memory: ``BENCH_TPU_LAST.json`` persistence.
+
+Twice (rounds 2 and 4) the driver's end-of-round ``bench.py`` run met a
+wedged TPU tunnel and the round's on-chip evidence — measured hours earlier
+by the same script — shipped in no artifact.  ``bench.py`` now persists
+every completed on-chip run's evidence subset and re-emits it as a labeled
+``last_tpu`` block whenever a later run has no healthy TPU.
+
+These tests exercise the mechanism itself (persist / load / merge-on-emit);
+they never touch a device.  Reference parity note: the reference's harness
+(`petastorm/benchmark/throughput.py :: reader_throughput`) has no artifact
+persistence at all — this subsystem is an extension forced by the sandbox's
+tunneled device.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def mem(tmp_path, monkeypatch):
+    """Redirect the artifact memory + detail file into a tmpdir."""
+    monkeypatch.setattr(bench, '_TPU_LAST_PATH', str(tmp_path / 'last.json'))
+    monkeypatch.setattr(bench, '_DETAIL_PATH', str(tmp_path / 'detail.json'))
+    return tmp_path
+
+
+def _tpu_result(**extra):
+    out = {
+        'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
+        'value': 3500.0, 'unit': 'images/s', 'vs_baseline': 1.5,
+        'backend': 'tpu', 'stall_pct': 1.2, 'stall_pct_source': 'hbm_scan',
+        'stall_regime': 'hbm_cached', 'device_step_ms': 26.0,
+        'step_dtype': 'bf16-compute/f32-params', 'mfu_pct': 29.9,
+        'h2d_bytes_per_s': 400000000,
+    }
+    out.update(extra)
+    return out
+
+
+def test_persist_then_load_roundtrip(mem):
+    bench._persist_tpu_evidence(_tpu_result(), complete=True)
+    rec = bench._load_last_tpu()
+    assert rec is not None
+    assert rec['complete'] is True
+    assert rec['stall_pct'] == 1.2
+    assert rec['device_step_ms'] == 26.0
+    assert rec['ts']  # timestamped
+    # Only the evidence subset is stored — not the whole result dict.
+    assert 'metric' not in rec
+    assert 'unit' not in rec
+
+
+def test_persist_requires_actual_evidence(mem):
+    # A run that measured nothing on-chip-shaped (labels only) must not
+    # create a record a fallback could mistake for evidence.
+    bench._persist_tpu_evidence(
+        {'backend': 'tpu', 'value': 100.0, 'vs_baseline': 1.0},
+        complete=True)
+    assert bench._load_last_tpu() is None
+
+
+def test_partial_never_clobbers_complete(mem):
+    bench._persist_tpu_evidence(_tpu_result(stall_pct=0.6), complete=True)
+    bench._persist_tpu_evidence(
+        _tpu_result(stall_pct=40.0, legs_failed=['transport']),
+        complete=False)
+    store = json.load(open(str(mem / 'last.json')))
+    assert store['complete']['stall_pct'] == 0.6   # survived
+    assert store['partial']['stall_pct'] == 40.0   # recorded separately
+
+
+def test_load_malformed_ts_never_beats_valid_iso(mem):
+    store = {
+        'complete': dict(_tpu_result(), ts='2026-07-31T03:50:00Z',
+                         complete=True),
+        'partial': dict(_tpu_result(stall_pct=99.0), ts='unknown',
+                        complete=False),
+    }
+    json.dump(store, open(str(mem / 'last.json'), 'w'))
+    assert bench._load_last_tpu()['complete'] is True
+
+
+def test_persist_handles_numpy_scalars_in_wedge_merged_dict(mem):
+    import numpy as np
+    ok = bench._persist_tpu_evidence(
+        _tpu_result(stall_pct=np.float32(3.5), device_step_ms=np.float64(26)),
+        complete=False)
+    assert ok
+    assert bench._load_last_tpu() is not None
+
+
+def test_throughput_error_demotes_tpu_run_to_partial(mem, capsys):
+    bench._persist_tpu_evidence(_tpu_result(stall_pct=0.6), complete=True)
+    bench._emit(_tpu_result(value=0.0, stall_pct=1.1,
+                            throughput_error='UNAVAILABLE: flaky'))
+    capsys.readouterr()
+    store = json.load(open(bench._TPU_LAST_PATH))
+    assert store['complete']['stall_pct'] == 0.6
+    assert store['partial']['stall_pct'] == 1.1
+
+
+def test_load_prefers_newest_record(mem):
+    # A wedge partial measured AFTER the last complete run is newer
+    # evidence of the tunnel's state; ties prefer the complete record.
+    store = {
+        'complete': dict(_tpu_result(), ts='2026-07-30T10:00:00Z',
+                         complete=True),
+        'partial': dict(_tpu_result(stall_pct=5.36),
+                        ts='2026-07-31T04:05:00Z', complete=False),
+    }
+    json.dump(store, open(str(mem / 'last.json'), 'w'))
+    assert bench._load_last_tpu()['stall_pct'] == 5.36
+    store['partial']['ts'] = '2026-07-29T00:00:00Z'
+    json.dump(store, open(str(mem / 'last.json'), 'w'))
+    assert bench._load_last_tpu()['complete'] is True
+
+
+def test_emit_degraded_tpu_run_records_partial_not_complete(mem, capsys):
+    # A run that reached _emit on backend tpu but lost legs to a mid-run
+    # wedge must not overwrite the 'complete' slot with degraded numbers.
+    bench._persist_tpu_evidence(_tpu_result(stall_pct=0.6), complete=True)
+    bench._emit(_tpu_result(stall_pct=44.0,
+                            legs_failed=['streaming', 'transport'],
+                            device_unhealthy='tunnel died after leg hbm'))
+    capsys.readouterr()
+    store = json.load(open(bench._TPU_LAST_PATH))
+    assert store['complete']['stall_pct'] == 0.6       # healthy record kept
+    assert store['partial']['stall_pct'] == 44.0
+    assert store['partial']['complete'] is False
+
+
+def test_evidence_keys_track_compact_keys(mem):
+    # The memory must remember every numeric field the compact line carries
+    # (minus run labels/plumbing) — a new compact field added next round
+    # must not silently miss persistence.
+    for k in ('stall_pct_streaming_scan', 'streaming_scan_floor_stall_pct',
+              'dlrm_rows_per_s', 'kernel_backend', 'kernel_max_err',
+              'h2d_bytes_per_s', 'delivery_plane_images_per_sec_host'):
+        assert k in bench._TPU_EVIDENCE_KEYS
+    for k in ('metric', 'unit', 'backend', 'error', 'last_tpu'):
+        assert k not in bench._TPU_EVIDENCE_KEYS
+
+
+def test_emit_on_tpu_persists_and_has_no_last_tpu_block(mem, capsys):
+    bench._emit(_tpu_result())
+    compact = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert 'last_tpu' not in compact          # live numbers need no memory
+    assert bench._load_last_tpu() is not None  # but the memory was written
+
+
+def test_emit_on_fallback_merges_last_tpu_into_compact_line(mem, capsys):
+    bench._persist_tpu_evidence(_tpu_result(), complete=True)
+    bench._emit({
+        'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
+        'value': 3400.0, 'unit': 'images/s', 'vs_baseline': 1.4,
+        'backend': 'cpu-fallback (TPU tunnel wedged at bench time; ...)',
+        'stall_pct': None,
+    })
+    lines = capsys.readouterr().out.strip().splitlines()
+    compact = json.loads(lines[-1])
+    assert compact['last_tpu']['stall_pct'] == 1.2
+    assert compact['last_tpu']['ts']
+    assert compact['last_tpu']['complete'] is True
+    # The detail file carries the provenance note beside the block.
+    detail = json.load(open(str(mem / 'detail.json')))
+    assert 'BENCH_TPU_LAST.json' in detail['last_tpu_note']
+    # The compact line must stay tail-capture sized even with the block.
+    assert len(lines[-1]) < 4000
+
+
+def test_emit_on_fallback_without_memory_is_unchanged(mem, capsys):
+    bench._emit({
+        'metric': 'imagenet_jpeg_parquet_images_per_sec_host',
+        'value': 3400.0, 'unit': 'images/s', 'vs_baseline': 1.4,
+        'backend': 'cpu-fallback (...)',
+    })
+    compact = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert 'last_tpu' not in compact
+
+
+def test_persist_survives_corrupt_store(mem):
+    with open(str(mem / 'last.json'), 'w') as f:
+        f.write('{not json')
+    bench._persist_tpu_evidence(_tpu_result(), complete=True)
+    assert bench._load_last_tpu()['stall_pct'] == 1.2
+
+
+def test_load_survives_corrupt_store(mem):
+    with open(str(mem / 'last.json'), 'w') as f:
+        f.write('[]')
+    assert bench._load_last_tpu() is None
+
+
+def test_checked_in_seed_record_is_loadable():
+    """The committed BENCH_TPU_LAST.json (seeded from round-4's on-chip run,
+    transcribed out of BENCH_NOTES.md) must parse through the real loader so
+    a driver-time fallback actually re-emits it."""
+    rec = bench._load_last_tpu()
+    assert rec is not None
+    assert rec['ts'] >= '2026-07-31'
+    assert 'note' in rec or 'tunnel_condition' in rec or rec.get('complete')
