@@ -990,10 +990,12 @@ _DETAIL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: measured under.  Derived from _COMPACT_KEYS (minus the label/plumbing
 #: keys that describe THIS run, not the chip) so a new compact field can't
 #: silently miss the memory; plus the detail-only transport tag.
+#: throughput_error stays IN: on a partial record it is the reason the
+#: record is partial, and a re-emitted block must say why.
 _TPU_EVIDENCE_KEYS = tuple(
     k for k in _COMPACT_KEYS
     if k not in ('metric', 'unit', 'value_spread', 'runs', 'backend',
-                 'throughput_error', 'last_tpu', 'error')
+                 'last_tpu', 'error')
 ) + ('transport_ms_per_step',)
 
 #: Evidence gate: a record with none of these measured is a label, not a
