@@ -1065,6 +1065,18 @@ class DeviceInMemDataLoader(InMemDataLoader):
         ``num_epochs`` / ``shuffle`` / ``seed`` exactly like the per-step
         iterator; partial trailing batches are always dropped
         (``lax.scan`` needs static shapes).
+
+        **Mid-epoch resume**: a loader restored from a mid-epoch token
+        (taken by the per-step iterator; needs
+        ``deterministic_cache_order=True`` + the same explicit ``seed``)
+        finishes the partial epoch as its own first dispatch — ``outs``
+        carries the remaining ``steps - start_step`` steps (one extra
+        compile) — then continues in full ``epochs_per_call`` groups.  A
+        token taken inside an epoch's ragged tail (every full batch
+        consumed) resumes at the next epoch: scan always drops partial
+        trailing batches.  Checkpoints taken *between scan yields* are
+        epoch-group boundaries — ``scan_epochs`` never exposes an
+        intra-dispatch cursor (the whole group is one XLA execution).
         """
         import itertools
 
@@ -1073,13 +1085,6 @@ class DeviceInMemDataLoader(InMemDataLoader):
 
         if epochs_per_call < 1:
             raise ValueError('epochs_per_call must be >= 1')
-        if self._start_step:
-            raise ValueError(
-                'scan_epochs folds whole epochs into each dispatch and '
-                'cannot start %d steps into one; finish the partial epoch '
-                'with the per-step iterator first, then checkpoint at the '
-                'boundary and resume scan_epochs from that token'
-                % self._start_step)
         cache = self._materialize()
         if cache is None:
             return
@@ -1091,14 +1096,17 @@ class DeviceInMemDataLoader(InMemDataLoader):
             return
         batch_size = self.batch_size
 
-        def run_epoch(carry, cache, order):
+        def body_for(cache, order):
             def body(c, i):
                 idx = lax.dynamic_slice_in_dim(order, i * batch_size,
                                                batch_size)
                 batch = jax.tree_util.tree_map(
                     lambda v: jnp.take(v, idx, axis=0), cache)
                 return step_fn(c, batch)
-            return lax.scan(body, carry, jnp.arange(steps))
+            return body
+
+        def run_epoch(carry, cache, order):
+            return lax.scan(body_for(cache, order), carry, jnp.arange(steps))
 
         def run_epochs(carry, cache, orders):  # orders: (E, n)
             return lax.scan(lambda c, order: run_epoch(c, cache, order),
@@ -1111,6 +1119,37 @@ class DeviceInMemDataLoader(InMemDataLoader):
         self._epochs_done = self._start_epoch  # fresh pass
         self._steps_into_epoch = 0
         orders = self._epoch_orders(n)
+        start = self._start_step
+        if start:
+            # Finish the token's partial epoch as its own dispatch: the
+            # remaining steps of epoch 0 scan from the step cursor.  The
+            # cursor counts per-step-iterator batches, which (only under
+            # drop_last=False, only when a ragged tail exists) include one
+            # tail batch scan would drop — a cursor AT the full-batch count
+            # then means every scannable step is done and the epoch
+            # completes with no dispatch.  Any cursor past the geometry's
+            # legitimate maximum is a changed dataset/batch shape, the same
+            # error the per-step iterator raises for it.
+            max_cursor = steps if n % self.batch_size else steps - 1
+            if start > max_cursor:
+                raise ValueError(
+                    'device_inmem resume token is %d steps into an epoch '
+                    'of %d full batches — the dataset or batch geometry '
+                    'changed since the checkpoint' % (start, steps))
+            first = list(itertools.islice(orders, 1))
+            if not first:
+                return
+            if start < steps:
+                def run_epoch_tail(carry, cache, order):
+                    return lax.scan(body_for(cache, order), carry,
+                                    jnp.arange(start, steps))
+                fn_tail = jax.jit(run_epoch_tail, donate_argnums=donate)
+                carry, outs = fn_tail(carry, cache, first[0])
+                self.stats['batches'] += steps - start
+                self._epochs_done += 1
+                yield carry, outs
+            else:
+                self._epochs_done += 1
         while True:
             group = list(itertools.islice(orders, epochs_per_call))
             if not group:

@@ -536,11 +536,123 @@ def test_device_inmem_mid_epoch_resume_deterministic(dataset):
                               resume_state=state)
     reader.stop(); reader.join()
 
-    # scan_epochs folds whole epochs and must refuse a mid-epoch baseline
+    # scan_epochs composes with the mid-epoch token (fused epochs × exact
+    # resume): the partial epoch finishes as its own first dispatch, then
+    # full epochs follow — together exactly the per-step continuation.
     with build('dummy', resume=state) as loader3:
-        with pytest.raises(ValueError, match='whole epochs'):
-            next(loader3.scan_epochs(lambda c, b: (c, b['id']), 0,
-                                     donate_carry=False))
+        groups = [np.asarray(ids) for _, ids in
+                  loader3.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                      donate_carry=False)]
+    assert [g.shape[0] for g in groups] == [steps_per_epoch - 2,
+                                            steps_per_epoch]
+    got = np.concatenate(groups).reshape(-1, BATCH).tolist()
+    assert got == full[cut:]
+
+
+def test_device_inmem_scan_epochs_mid_epoch_grouped_resume(dataset):
+    """Mid-epoch resume into scan_epochs(epochs_per_call=2): the partial
+    epoch is its own first (ungrouped) dispatch, later epochs keep the
+    requested grouping and the stream equals the uninterrupted one."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    def build(resume=None):
+        reader = make_reader(dataset.url, reader_pool_type='dummy',
+                             shuffle_row_groups=False, num_epochs=1)
+        return DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=3,
+                                     seed=53, deterministic_cache_order=True,
+                                     resume_state=resume)
+
+    steps_per_epoch = ROWS // BATCH
+    with build() as loader:
+        full = [np.asarray(b['id']).tolist() for b in loader]
+
+    cut = 2  # two steps into epoch 0
+    with build() as loader:
+        it = iter(loader)
+        for _ in range(cut):
+            next(it)
+        state = loader.state_dict()
+
+    with build(resume=state) as loader2:
+        shapes, flat = [], []
+        for _, ids in loader2.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                          donate_carry=False,
+                                          epochs_per_call=2):
+            ids = np.asarray(ids)
+            shapes.append(ids.shape)
+            flat.append(ids.reshape(-1, BATCH))
+    # tail of epoch 0 (no epochs axis), then epochs 1+2 as one group
+    assert shapes == [(steps_per_epoch - cut, BATCH),
+                      (2, steps_per_epoch, BATCH)]
+    assert np.concatenate(flat).tolist() == full[cut:]
+
+
+def test_device_inmem_scan_epochs_ragged_tail_token_resumes_next_epoch(
+        dataset):
+    """A token taken past the last FULL batch (inside the ragged tail a
+    drop_last=False per-step pass exposes) resumes scan_epochs at the next
+    epoch with no partial dispatch — scan always drops partial batches."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    steps_per_epoch = ROWS // BATCH  # full batches only
+    assert ROWS % BATCH, 'test needs a ragged tail'
+
+    def build(resume=None, **kw):
+        reader = make_reader(dataset.url, reader_pool_type='dummy',
+                             shuffle_row_groups=False, num_epochs=1)
+        return DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=2,
+                                     seed=59, deterministic_cache_order=True,
+                                     resume_state=resume, **kw)
+
+    # scan baseline: both epochs, full batches only
+    with build() as loader:
+        base = [np.asarray(ids) for _, ids in
+                loader.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                   donate_carry=False)]
+
+    with build(drop_last=False) as loader:
+        it = iter(loader)
+        for _ in range(steps_per_epoch):  # all full batches of epoch 0
+            next(it)
+        state = loader.state_dict()
+    assert state['device_inmem']['steps_into_epoch'] == steps_per_epoch
+
+    with build(resume=state) as loader2:
+        groups = [np.asarray(ids) for _, ids in
+                  loader2.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                      donate_carry=False)]
+    assert [g.shape for g in groups] == [(steps_per_epoch, BATCH)]
+    np.testing.assert_array_equal(groups[0], base[1])
+
+
+def test_device_inmem_scan_epochs_rejects_geometry_changed_token(dataset):
+    """A cursor past the geometry's legitimate maximum is a changed
+    dataset/batch shape and must raise — same contract as __iter__ — not
+    silently skip the rest of the checkpointed epoch."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    def build(batch_size, steps_into_epoch):
+        reader = make_reader(dataset.url, reader_pool_type='dummy',
+                             shuffle_row_groups=False, num_epochs=1)
+        token = {'version': 1,
+                 'device_inmem': {'epochs_done': 0,
+                                  'steps_into_epoch': steps_into_epoch,
+                                  'batch_size': batch_size, 'seed': 61}}
+        return DeviceInMemDataLoader(reader, batch_size=batch_size,
+                                     num_epochs=2, seed=61,
+                                     deterministic_cache_order=True,
+                                     resume_state=token)
+
+    # ROWS=64, BATCH=10: ragged tail exists, max legitimate cursor is 6
+    with build(BATCH, 50) as loader:
+        with pytest.raises(ValueError, match='geometry'):
+            next(loader.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                    donate_carry=False))
+    # batch_size=8 divides 64: no ragged tail, cursor==steps is impossible
+    with build(8, 8) as loader:
+        with pytest.raises(ValueError, match='geometry'):
+            next(loader.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                    donate_carry=False))
 
 
 def test_device_inmem_mid_epoch_token_requires_deterministic(dataset):
