@@ -143,6 +143,13 @@ def train(dataset_url, steps=50, batch_size=64, image_hw=(224, 224), lr=0.1,
         dt = time.monotonic() - t0
         print('steps=%d loss=%.3f images/s=%.1f (hbm scan: no per-step host '
               'work)' % (done, float(loss), done * batch_size / dt))
+        if tracer is not None:
+            # Say it out loud rather than leaving the user waiting for a
+            # file that never appears: the fused path has no host-side
+            # spans to record.
+            print('trace skipped: --hbm-cache folds whole epochs into '
+                  'on-device scans (no host-side spans); no trace file '
+                  'written to %s' % trace_path)
         return {'stall_pct': 0.0, 'steps': done}
 
     monitor = StallMonitor(warmup_steps=2, trace_recorder=tracer)

@@ -68,19 +68,24 @@ def autotune(dataset_url, batch_size=64, seconds_per_config=3.0,
         cores = os.cpu_count() or 4
         workers_grid = sorted({2, min(32, cores), min(32, 2 * cores)})
     measurements = []
-    extra_kwargs = {}
     for pool in pools:
         for workers in workers_grid:
             rows_per_s, extra_kwargs = _measure(
                 dataset_url, pool, workers, batch_size, seconds_per_config)
             measurements.append({'pool': pool, 'workers_count': workers,
-                                 'rows_per_s': round(rows_per_s, 1)})
+                                 'rows_per_s': round(rows_per_s, 1),
+                                 'extra_kwargs': extra_kwargs})
     measurements.sort(key=lambda m: -m['rows_per_s'])
     best = measurements[0]
+    # The recommendation reproduces the WINNING measurement — its
+    # extra_kwargs, not whichever config happened to be measured last.
+    best_extra = best['extra_kwargs']
+    for m in measurements:  # today a per-dataset constant; don't repeat it
+        m.pop('extra_kwargs')
     recommendation = dict({'reader_pool_type': best['pool'],
                            'workers_count': best['workers_count']},
-                          **extra_kwargs)
-    factory = 'make_reader' if extra_kwargs else 'make_batch_reader'
+                          **best_extra)
+    factory = 'make_reader' if best_extra else 'make_batch_reader'
     return {
         'measurements': measurements,
         'recommendation': recommendation,

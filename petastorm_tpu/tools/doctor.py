@@ -100,7 +100,10 @@ def _check_host_plane(dataset_url, seconds, batch_size, advisor_out=None):
     kind = info['kind']
     with reader:
         loader = DataLoader(reader, batch_size=batch_size)
-        rows, dt = pump_host_batches(loader, seconds)
+        # warmup_batches=1 matches benchmark.autotune, so a doctor report's
+        # host-plane rows/s and an autotune sweep's are comparable (pool
+        # spin-up + first row-group read excluded from both).
+        rows, dt = pump_host_batches(loader, seconds, warmup_batches=1)
         stats = dict(loader.stats)
         if advisor_out is not None:
             verdict = diagnose(loader)

@@ -16,7 +16,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(args, timeout=420):
+def _run(args, timeout=420, retries=0):
     env = dict(os.environ, JAX_PLATFORMS='cpu')
     env.pop('PETASTORM_TPU_SKIP_BACKEND_PROBE', None)
     # The axon accelerator hook rides on PYTHONPATH (sitecustomize) and can
@@ -24,9 +24,16 @@ def _run(args, timeout=420):
     # to CPU (observed on the long_context example); examples self-bootstrap
     # their sys.path, so the variable isn't needed.
     env.pop('PYTHONPATH', None)
-    res = subprocess.run([sys.executable] + args, capture_output=True,
-                         text=True, timeout=timeout, env=env,
-                         cwd=REPO)
+    for attempt in range(retries + 1):
+        res = subprocess.run([sys.executable] + args, capture_output=True,
+                             text=True, timeout=timeout, env=env,
+                             cwd=REPO)
+        if res.returncode == 0:
+            return res.stdout
+        if attempt < retries:
+            sys.stderr.write('%s exited %d (suite-load flake?); retrying '
+                             'once\n--- stderr tail ---\n%s\n'
+                             % (args[0], res.returncode, res.stderr[-1500:]))
     assert res.returncode == 0, '%s\n--- stderr ---\n%s' % (
         ' '.join(args), res.stderr[-4000:])
     return res.stdout
@@ -154,9 +161,12 @@ def test_long_context(tmp_path):
     per step — certified on-chip by the bench instead)."""
     url = 'file://' + str(tmp_path / 'lc')
     _run(['examples/long_context/generate_token_parquet.py', url])
+    # retries=1: passes in isolation but has failed when the whole suite
+    # loads the host (many JAX-heavy subprocesses); one retry keeps the
+    # acceptance surface signal clean without masking a real regression.
     out = _run(['examples/long_context/jax_example.py', '--dataset-url', url,
                 '--strategy', 'dense', '--steps', '2', '--batch-size', '2'],
-               timeout=600)
+               timeout=600, retries=1)
     assert 'done: 2 steps' in out
 
 
