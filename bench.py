@@ -958,7 +958,8 @@ def kernel_certification():
 
 
 _COMPACT_KEYS = (
-    'metric', 'value', 'unit', 'value_spread', 'runs', 'vs_baseline',
+    'metric', 'value', 'unit', 'value_spread', 'value_iqr', 'runs',
+    'vs_baseline', 'vs_baseline_range',
     'backend', 'stall_pct', 'stall_pct_source', 'stall_regime',
     'stall_pct_hbm_cached', 'stall_pct_hbm_scan', 'stall_pct_streaming',
     'stall_pct_streaming_scan', 'stall_pct_delivery_bound',
@@ -994,7 +995,8 @@ _DETAIL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: record is partial, and a re-emitted block must say why.
 _TPU_EVIDENCE_KEYS = tuple(
     k for k in _COMPACT_KEYS
-    if k not in ('metric', 'unit', 'value_spread', 'runs', 'backend',
+    if k not in ('metric', 'unit', 'value_spread', 'value_iqr',
+                 'vs_baseline_range', 'runs', 'backend',
                  'last_tpu', 'error')
 ) + ('transport_ms_per_step',)
 
@@ -1074,6 +1076,18 @@ def _load_last_tpu():
         return max(recs, key=key)
     except Exception:  # noqa: BLE001
         return None
+
+
+#: Honest labeling of the headline: on a 1-core shared host the whole-epoch
+#: img/s number swings with transient load even at 9 repeats; the host-plane
+#: field is the stable perf statement (no device in the loop, bandwidth-
+#: bound).  vs_baseline should be read with its IQR range beside it.
+_VALUE_NOTE = (
+    'value = median of `runs` interleaved whole-epoch measurements; NOISY '
+    'on shared 1-core hosts (see value_iqr / runs_raw). '
+    'delivery_plane_images_per_sec_host is the stable host-pipeline number '
+    '(bandwidth-bound, no device transfer in the loop); read vs_baseline '
+    'with vs_baseline_range ([q25, q75] of pairwise ratios).')
 
 
 def _emit(result):
@@ -1307,13 +1321,15 @@ def main():
 
     # Interleaved repeats: single-host timings are noisy (shared core,
     # tunneled device); alternating runs equalizes cache/tunnel warmth.
-    # The reported value is the MEDIAN with its spread beside it, and
-    # vs_baseline is the median of PAIRWISE ratios (each ratio compares
-    # two adjacent runs under the same transient host conditions), so the
-    # ±60% swing the round-1..3 artifacts showed silently is now visible
+    # The reported value is the MEDIAN of 9 repeats (sub-second epochs on
+    # this dataset size make extra repeats nearly free; round 4's 5-repeat
+    # median still swung ±30%) with the IQR beside it, and vs_baseline is
+    # the median of PAIRWISE ratios (each ratio compares two adjacent runs
+    # under the same transient host conditions) with its own IQR range —
+    # the ±60% swing the round-1..3 artifacts showed silently is visible
     # in the artifact itself.  Contained: a tunnel death mid-phase must
-    # not cost the stall legs (run 1 of this round died mid-run).
-    repeats = int(os.environ.get('PETASTORM_TPU_BENCH_REPEATS', '5'))
+    # not cost the stall legs (run 1 of round 4 died mid-run).
+    repeats = int(os.environ.get('PETASTORM_TPU_BENCH_REPEATS', '9'))
     ours_runs, theirs_runs = [], []
     throughput_error = None
     try:
@@ -1327,15 +1343,22 @@ def main():
         sys.stderr.write('bench: throughput phase failed: %s\n'
                          % throughput_error)
     pairs = list(zip(ours_runs, theirs_runs))
+    ratios = [o / t for o, t in pairs]
     ours = float(np.median(ours_runs)) if ours_runs else 0.0
     theirs = float(np.median(theirs_runs)) if theirs_runs else 0.0
-    ratio = float(np.median([o / t for o, t in pairs])) if pairs else 0.0
+    ratio = float(np.median(ratios)) if ratios else 0.0
     spread = (max(ours_runs) - min(ours_runs)) if ours_runs else 0.0
+    iqr = (float(np.subtract(*np.percentile(ours_runs, [75, 25])))
+           if ours_runs else 0.0)
+    ratio_range = ([round(float(r), 2)
+                    for r in np.percentile(ratios, [25, 75])]
+                   if ratios else None)
     # Stash NOW: a watchdog partial fired during the train legs must still
     # carry the (already measured) throughput phase.
     _PARTIAL_BASE.update({
         'value': round(ours, 1), 'value_spread': round(spread, 1),
-        'runs': repeats, 'vs_baseline': round(ratio, 2),
+        'value_iqr': round(iqr, 1), 'runs': repeats,
+        'vs_baseline': round(ratio, 2), 'vs_baseline_range': ratio_range,
         'backend': jax.default_backend(),
         'throughput_error': throughput_error,
     })
@@ -1350,10 +1373,13 @@ def main():
             'value': round(ours, 1),
             'unit': 'images/s',
             'value_spread': round(spread, 1),
+            'value_iqr': round(iqr, 1),
             'runs': repeats,
             'runs_raw': [round(r, 1) for r in ours_runs],
             'baseline_runs_raw': [round(r, 1) for r in theirs_runs],
             'vs_baseline': round(ratio, 2),
+            'vs_baseline_range': ratio_range,
+            'value_note': _VALUE_NOTE,
             'host_cores': os.cpu_count(),
             'backend': 'cpu-fallback (TPU tunnel wedged at bench time; '
                        'host decode/collate pipeline vs reference strategy '
@@ -1383,10 +1409,13 @@ def main():
         'value': round(ours, 1),
         'unit': 'images/s',
         'value_spread': round(spread, 1),
+        'value_iqr': round(iqr, 1),
         'runs': repeats,
         'runs_raw': [round(r, 1) for r in ours_runs],
         'baseline_runs_raw': [round(r, 1) for r in theirs_runs],
         'vs_baseline': round(ratio, 2),
+        'vs_baseline_range': ratio_range,
+        'value_note': _VALUE_NOTE,
         'throughput_error': throughput_error,
         'host_cores': os.cpu_count(),
         'backend': jax.default_backend(),

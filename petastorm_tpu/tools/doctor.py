@@ -117,7 +117,12 @@ def _check_host_plane(dataset_url, seconds, batch_size, advisor_out=None):
             })
     out = {'reader': kind, 'rows_per_s': round(rows / dt, 1), 'rows': rows,
            'stage_seconds': {k: round(v, 3) for k, v in stats.items()
-                             if k.endswith('_s')}}
+                             if k.endswith('_s')},
+           # rows_per_s is measured AFTER the one-batch warmup;
+           # stage_seconds accumulates over the whole loader lifetime
+           # (warmup included) — don't cross-divide the two windows.
+           'stage_seconds_window': 'loader lifetime incl. warmup batch '
+                                   '(rows_per_s window excludes it)'}
     return out
 
 
