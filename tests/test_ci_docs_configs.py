@@ -1,0 +1,66 @@
+"""Static validation of the CI and docs configs.
+
+Neither can EXECUTE in this sandbox (no CI runner, sphinx not installed —
+SURVEY §2.5 packaging row), so this pins what is checkable: the YAML
+parses with the structure GitHub Actions requires, every command it runs
+refers to files that exist, and ``docs/conf.py`` compiles and exposes the
+settings sphinx reads.  A syntax error in either would otherwise survive
+until the first run in a real environment.
+"""
+
+import os
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_ci():
+    with open(os.path.join(REPO, '.github', 'workflows', 'ci.yml')) as f:
+        return yaml.safe_load(f)
+
+
+def test_ci_yaml_parses_with_actions_structure():
+    ci = _load_ci()
+    # PyYAML parses the `on:` key as boolean True (YAML 1.1) — accept both.
+    assert 'on' in ci or True in ci
+    assert 'jobs' in ci and ci['jobs']
+    for name, job in ci['jobs'].items():
+        assert 'runs-on' in job, name
+        assert 'steps' in job and job['steps'], name
+        for step in job['steps']:
+            assert 'uses' in step or 'run' in step, (name, step)
+
+
+def test_ci_matrix_and_commands_reference_real_things():
+    ci = _load_ci()
+    [job] = [j for j in ci['jobs'].values() if 'strategy' in j] or \
+        list(ci['jobs'].values())[:1]
+    pys = job.get('strategy', {}).get('matrix', {}).get('python-version', [])
+    assert len(pys) >= 3, 'VERDICT recorded a 3-python matrix: %r' % pys
+    run_text = '\n'.join(s['run'] for j in ci['jobs'].values()
+                         for s in j['steps'] if 'run' in s)
+    # Every repo path a run step mentions must exist.
+    for token in ('tests/', 'petastorm_tpu/native', 'pyproject.toml'):
+        if token in run_text:
+            assert os.path.exists(os.path.join(REPO, token.rstrip('/'))), token
+    assert 'pytest' in run_text
+
+
+def test_docs_conf_compiles_and_has_sphinx_settings():
+    path = os.path.join(REPO, 'docs', 'conf.py')
+    src = open(path).read()
+    code = compile(src, path, 'exec')  # a SyntaxError fails the suite
+    ns = {}
+    exec(code, ns)  # executes without sphinx imports or dies trying
+    assert ns.get('project')
+    assert isinstance(ns.get('extensions', []), list)
+    # every doc page conf/index reference exists
+    for page in ('index.md', 'api.md', 'architecture.md', 'performance.md',
+                 'migration.md', 'deployment.md'):
+        assert os.path.exists(os.path.join(REPO, 'docs', page)), page
+
+
+def test_docs_makefile_targets():
+    mk = open(os.path.join(REPO, 'docs', 'Makefile')).read()
+    assert 'html' in mk and 'sphinx' in mk.lower()
