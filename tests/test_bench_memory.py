@@ -195,6 +195,60 @@ def test_load_survives_corrupt_store(mem):
     assert bench._load_last_tpu() is None
 
 
+def test_watchdog_fire_carries_last_tpu_on_fallback_wedge(tmp_path):
+    """The wedge path end-to-end in a child process: a run on a non-TPU
+    backend that exceeds its watchdog budget must still emit a compact
+    line carrying the remembered on-chip record (and exit 3)."""
+    import subprocess
+    import sys
+
+    store = tmp_path / 'last.json'
+    json.dump({'complete': dict(_tpu_result(), ts='2026-07-31T05:00:00Z',
+                                complete=True)}, open(str(store), 'w'))
+    child = (
+        "import bench, json, time\n"
+        "bench._TPU_LAST_PATH = %r\n"
+        "bench._DETAIL_PATH = %r\n"
+        "bench._PARTIAL_BASE.update({'value': 123.0, 'vs_baseline': 1.1,"
+        " 'backend': 'cpu'})\n"
+        "bench._start_watchdog(1)\n"
+        "time.sleep(30)\n" % (str(store), str(tmp_path / 'detail.json')))
+    res = subprocess.run([sys.executable, '-c', child], capture_output=True,
+                         text=True, timeout=25, cwd='/root/repo')
+    assert res.returncode == 3, res.stderr[-1000:]
+    line = json.loads(res.stdout.strip().splitlines()[-1])
+    assert 'watchdog' in line['error']
+    assert line['value'] == 123.0          # measured phase survived
+    assert line['last_tpu']['stall_pct'] == 1.2  # memory survived the wedge
+
+
+def test_watchdog_fire_persists_partial_on_tpu_wedge(tmp_path):
+    """A wedged TPU-backend run persists its completed legs as a partial
+    record instead of echoing the old memory beside live fields."""
+    import subprocess
+    import sys
+
+    store = tmp_path / 'last.json'
+    child = (
+        "import bench, json, time\n"
+        "bench._TPU_LAST_PATH = %r\n"
+        "bench._DETAIL_PATH = %r\n"
+        "bench._PARTIAL_BASE.update({'value': 3500.0, 'vs_baseline': 1.5,"
+        " 'backend': 'tpu'})\n"
+        "bench._PARTIAL.update({'stall_pct_hbm_scan': 2.2,"
+        " 'device_step_ms': 26.0})\n"
+        "bench._start_watchdog(1)\n"
+        "time.sleep(30)\n" % (str(store), str(tmp_path / 'detail.json')))
+    res = subprocess.run([sys.executable, '-c', child], capture_output=True,
+                         text=True, timeout=25, cwd='/root/repo')
+    assert res.returncode == 3, res.stderr[-1000:]
+    line = json.loads(res.stdout.strip().splitlines()[-1])
+    assert 'last_tpu' not in line  # live fields, not an echo
+    saved = json.load(open(str(store)))
+    assert saved['partial']['stall_pct_hbm_scan'] == 2.2
+    assert saved['partial']['complete'] is False
+
+
 def test_checked_in_seed_record_is_loadable():
     """The committed BENCH_TPU_LAST.json (seeded from round-4's on-chip run,
     transcribed out of BENCH_NOTES.md) must parse through the real loader so
