@@ -665,13 +665,18 @@ def train_stall_legs():
             h2d['transport_bound'] = bool(t_ms > floor_ms)
         return h2d
 
+    # transport FIRST: it is one device_put (~seconds) and its
+    # h2d_bytes_per_s is the tunnel-condition tag that makes every other
+    # leg's number legible (healthy ~22 ms/batch vs degraded ~90 ms).
+    # Round 4 ran it LAST and lost it when the tunnel died mid-run —
+    # the one field that would have labeled that run's regime.
+    leg('transport', leg_transport)
     leg('streaming', leg_streaming)
     leg('streaming_scan', leg_streaming_scan)
     leg('delivery_bound', leg_delivery_bound)
     leg('host_plane', leg_host_plane)
     leg('hbm', leg_hbm)
     leg('decoded_cache', leg_decoded_cache)
-    leg('transport', leg_transport)
 
     decoded_epoch_bytes = NUM_IMAGES * IMAGE_HW[0] * IMAGE_HW[1] * 3
     hbm = _device_hbm_bytes()
