@@ -752,6 +752,38 @@ def ensure_criteo_dataset():
                    row_group_size=2 * DLRM_BATCH)
 
 
+def _dlrm_pack_columns(batch):
+    """Columnar host work of the DLRM pipeline: stack 13 dense + 26
+    categorical columns into the model's two input arrays."""
+    dense = np.stack([batch['dense_%d' % i] for i in range(DLRM_DENSE)],
+                     axis=1).astype(np.float32)
+    cat = np.stack([batch['cat_%d' % i] for i in range(DLRM_CAT)],
+                   axis=1).astype(np.int32)
+    return {'dense': dense, 'cat': cat,
+            'clicked': batch['clicked'].astype(np.float32)}
+
+
+def dlrm_host_plane_leg(seconds=6.0):
+    """Host-boundary DLRM delivery (no device in the loop): the criteo
+    columnar plane (``make_batch_reader`` -> 39-column stack) consumed at
+    ``iter_host_batches`` — BASELINE config #4's analog of
+    ``delivery_plane_images_per_sec_host``.  Backend-independent, so a
+    CPU-fallback artifact still carries a measured DLRM delivery number
+    when the chip-coupled stall legs can't run."""
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.benchmark.hostplane import pump_host_batches
+    from petastorm_tpu.jax import DataLoader
+
+    ensure_criteo_dataset()
+    with make_batch_reader(CRITEO_URL, num_epochs=None,
+                           workers_count=WORKERS,
+                           shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=DLRM_BATCH, prefetch=2,
+                            transform_fn=_dlrm_pack_columns)
+        rows, dt = pump_host_batches(loader, seconds, warmup_batches=1)
+    return {'dlrm_host_rows_per_s': round(rows / dt)}
+
+
 def dlrm_stall_leg():
     """Criteo->DLRM stall: a gather-bound step (26 vocab-100k embedding
     tables + small MLPs — memory traffic, not MXU FLOPs) consuming the
@@ -774,14 +806,6 @@ def dlrm_stall_leg():
                         jnp.zeros((1, DLRM_CAT), jnp.int32))['params']
     tx = optax.adagrad(0.01)  # the canonical DLRM optimizer
     opt_state = tx.init(params)
-
-    def pack_columns(batch):
-        dense = np.stack([batch['dense_%d' % i] for i in range(DLRM_DENSE)],
-                         axis=1).astype(np.float32)
-        cat = np.stack([batch['cat_%d' % i] for i in range(DLRM_CAT)],
-                       axis=1).astype(np.int32)
-        return {'dense': dense, 'cat': cat,
-                'clicked': batch['clicked'].astype(np.float32)}
 
     @jax.jit
     def train_step(params, opt_state, batch):
@@ -828,7 +852,7 @@ def dlrm_stall_leg():
                                workers_count=WORKERS,
                                shuffle_row_groups=False) as reader:
             loader = DataLoader(reader, batch_size=DLRM_BATCH, prefetch=2,
-                                transform_fn=pack_columns)
+                                transform_fn=_dlrm_pack_columns)
             if fused:
                 def scan_step(carry, batch):
                     p, o = carry
@@ -970,6 +994,7 @@ _COMPACT_KEYS = (
     'stall_pct_streaming_scan', 'stall_pct_delivery_bound',
     'stall_pct_decoded_cache', 'stall_pct_decoded_cache_scan',
     'stall_pct_dlrm', 'stall_pct_dlrm_scan', 'dlrm_rows_per_s',
+    'dlrm_host_rows_per_s',
     'streaming_scan_floor_stall_pct', 'transport_bound', 'device_step_ms',
     'step_dtype', 'model_tflops_per_s', 'device_peak_tflops_bf16',
     'mfu_pct', 'delivery_plane_images_per_sec_host', 'h2d_bytes_per_s',
@@ -1393,6 +1418,19 @@ def main():
             'throughput_error': throughput_error,
             'stall_pct': None,
         }
+        # BASELINE config #4 still gets a measured number on fallback: the
+        # DLRM host delivery plane is backend-independent (no device in
+        # the loop), like the imagenet host-plane comparison above it.
+        if _budget_left_s() > 300:
+            try:
+                host_leg = dlrm_host_plane_leg()
+                result.update(host_leg)
+                # A cert wedge after this point must not lose it: the
+                # watchdog partial merges _PARTIAL_BASE + _PARTIAL only.
+                _PARTIAL.update(host_leg)
+            except Exception as e:  # noqa: BLE001 — must not cost the line
+                result['dlrm_host_error'] = '%s: %s' % (type(e).__name__,
+                                                        str(e)[:160])
         _certify_into(result, 'cpu (Pallas interpreter; Mosaic untested '
                               'this run)')
         watchdog.cancel()
@@ -1471,6 +1509,17 @@ def main():
                              '(fresh-interpreter probe failed)')
                 result['device_unhealthy'] = unhealthy
                 _PARTIAL['device_unhealthy'] = unhealthy
+    # Host-boundary DLRM delivery — needs no device, so it runs even when
+    # the chip-coupled legs above were skipped; AFTER them so its cost can
+    # never flip their budget gate.
+    if _budget_left_s() > 300:
+        try:
+            host_leg = dlrm_host_plane_leg()
+            result.update(host_leg)
+            _PARTIAL.update(host_leg)  # a later cert wedge must not lose it
+        except Exception as e:  # noqa: BLE001 — must not cost the artifact
+            result['dlrm_host_error'] = '%s: %s' % (type(e).__name__,
+                                                    str(e)[:160])
     _certify_into(result,
                   'tpu (Mosaic)' if jax.default_backend() == 'tpu'
                   else jax.default_backend() + ' (Pallas interpreter)',
