@@ -80,6 +80,23 @@ def test_docs_conf_compiles_and_has_sphinx_settings():
         assert os.path.exists(os.path.join(REPO, 'docs', page)), page
 
 
+def test_console_script_entry_points_resolve():
+    """Every [project.scripts] target must import and be callable — a typo
+    there only surfaces at install time otherwise (pip builds the shim
+    without validating the reference)."""
+    import importlib
+
+    src = open(os.path.join(REPO, 'pyproject.toml')).read()
+    block = re.search(r'\[project\.scripts\](.*?)(\n\[|$)', src, re.S)
+    assert block, 'no [project.scripts] section'
+    lines = [l for l in block.group(1).strip().splitlines() if '=' in l]
+    assert len(lines) >= 7, lines  # the reference-parity CLI surface
+    for line in lines:
+        _, target = [s.strip().strip('"') for s in line.split('=', 1)]
+        mod, fn = target.split(':')
+        assert callable(getattr(importlib.import_module(mod), fn)), target
+
+
 def test_docs_makefile_targets():
     mk = open(os.path.join(REPO, 'docs', 'Makefile')).read()
     assert 'html' in mk and 'sphinx' in mk.lower()
