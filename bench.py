@@ -773,6 +773,68 @@ def imagenet_host_plane_leg(epochs=4):
     return {'delivery_plane_images_per_sec_host': round(rate, 1)}
 
 
+def delivery_plane_service_leg(worker_counts=(1, 2, 4)):
+    """Disaggregated delivery plane (``petastorm_tpu/service``): host
+    images/s of ONE consumer fed by N in-process decode workers over the
+    pre-decoded uint8 dataset, at N = 1 -> 2 -> 4.  The horizontal-scaling
+    answer to the delivery-bound regime r05 measured
+    (``stall_pct_delivery_bound`` ~95%: one host's decode/collate plane
+    can't feed the chip) — the slope across worker counts is the evidence
+    that the decode plane now scales independently of the training host.
+    Backend-independent (no device in the loop); in-process workers, so
+    this measures the service machinery (lease protocol, ZMQ streaming,
+    credit flow, client reassembly), not extra silicon."""
+    from petastorm_tpu.service import (Dispatcher, ServiceConfig,
+                                       ServiceDataLoader, Worker)
+
+    ensure_raw_dataset()
+    fields = {}
+    # Split the fixed decode-thread budget across the worker fleet so a
+    # bigger fleet wins on service-plane parallelism, not on extra threads.
+    for n_workers in worker_counts:
+        config = ServiceConfig(
+            RAW_DATASET_URL, num_consumers=1, rowgroups_per_split=2,
+            lease_ttl_s=30.0,
+            reader_kwargs={'workers_count':
+                           max(2, WORKERS // max(n_workers, 1))})
+        with Dispatcher(config) as dispatcher:
+            workers = [Worker(dispatcher.addr).start()
+                       for _ in range(n_workers)]
+            try:
+                loader = ServiceDataLoader(dispatcher.addr, batch_size=BATCH,
+                                           consumer=0, drop_last=False,
+                                           prefetch=2)
+                n_host = 0
+                warmup_batches = 2  # worker registration + first leases
+                t0 = t_end = None   # are not steady-state; exclude them
+                with loader:
+                    for i, batch in enumerate(loader.iter_host_batches()):
+                        if i == warmup_batches:
+                            t0 = time.monotonic()
+                        elif i > warmup_batches:
+                            n_host += len(batch['noun_id'])
+                            # window closes at the last counted batch, NOT
+                            # after __exit__: teardown (recv-thread join,
+                            # ZMQ context term) is not delivery time and
+                            # would skew the w1->w4 scaling slope.
+                            t_end = time.monotonic()
+                rate = (n_host / (t_end - t0)
+                        if n_host and t_end is not None and t_end > t0
+                        else 0.0)
+                churn = dispatcher._op_stats({})['lease_churn']
+            finally:
+                for w in workers:
+                    w.stop()
+                for w in workers:
+                    w.join()
+        fields['delivery_plane_service_images_per_sec_host_w%d'
+               % n_workers] = round(rate, 1)
+        if churn:
+            fields['delivery_plane_service_lease_churn_w%d'
+                   % n_workers] = churn
+    return fields
+
+
 def dlrm_host_plane_leg(seconds=6.0):
     """Host-boundary DLRM delivery (no device in the loop): the criteo
     columnar plane (``make_batch_reader`` -> 39-column stack) consumed at
@@ -1007,7 +1069,10 @@ _COMPACT_KEYS = (
     'dlrm_host_rows_per_s',
     'streaming_scan_floor_stall_pct', 'transport_bound', 'device_step_ms',
     'step_dtype', 'model_tflops_per_s', 'device_peak_tflops_bf16',
-    'mfu_pct', 'delivery_plane_images_per_sec_host', 'h2d_bytes_per_s',
+    'mfu_pct', 'delivery_plane_images_per_sec_host',
+    'delivery_plane_service_images_per_sec_host_w1',
+    'delivery_plane_service_images_per_sec_host_w2',
+    'delivery_plane_service_images_per_sec_host_w4', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',
     'error',
@@ -1118,6 +1183,17 @@ def _load_last_tpu():
         return None
 
 
+def _last_tpu_compact(last):
+    """The ``last_tpu`` block trimmed for the compact machine line: core
+    evidence numbers plus the ``ts``/``complete`` provenance tags.  The
+    full ~20-key record (notes, regime tags, kernel table) stays in
+    ``BENCH_DETAIL_LAST.json`` / ``BENCH_TPU_LAST.json`` — ADVICE r05:
+    nesting it whole into the single-line record recreates the round-3
+    oversized-last-line failure the compact line exists to prevent."""
+    return {k: last[k] for k in _TPU_EVIDENCE_CORE + ('ts', 'complete')
+            if last.get(k) is not None}
+
+
 #: Honest labeling of the headline: on a 1-core shared host the whole-epoch
 #: img/s number swings with transient load even at 9 repeats; the host-plane
 #: field is the stable perf statement (no device in the loop, bandwidth-
@@ -1169,6 +1245,10 @@ def _emit(result):
     print(json.dumps(result), flush=True)
     compact = {k: result[k] for k in _COMPACT_KEYS
                if result.get(k) is not None}
+    if 'last_tpu' in compact:
+        # The full re-emitted record already shipped on the detail line
+        # and file above; the machine line carries only its evidence core.
+        compact['last_tpu'] = _last_tpu_compact(compact['last_tpu'])
     print(json.dumps(compact), flush=True)
 
 
@@ -1254,19 +1334,25 @@ def _start_watchdog(budget_s):
             # remembered record.  Persist-then-load, so a just-persisted
             # partial isn't echoed back beside its own live fields.
             persisted = False
+            last = None
             if merged.get('backend') == 'tpu':
                 persisted = _persist_tpu_evidence(merged, complete=False)
             if not persisted:
                 last = _load_last_tpu()
                 if last is not None:
-                    partial['last_tpu'] = last
+                    # Machine line stays small (ADVICE r05): evidence core
+                    # only; the detail file below carries the full record.
+                    partial['last_tpu'] = _last_tpu_compact(last)
             print(json.dumps(partial, default=str), flush=True)
             # The detail file must reflect THIS run too — otherwise a
             # wedged run leaves the previous run's detail on disk, silently
             # stale.  AFTER the compact line: the line is the artifact.
             try:
+                detail = dict(merged, **partial)
+                if last is not None:
+                    detail['last_tpu'] = last
                 with open(_DETAIL_PATH, 'w') as f:
-                    json.dump(dict(merged, **partial), f, indent=1,
+                    json.dump(detail, f, indent=1,
                               sort_keys=True, default=str)
             except Exception:  # noqa: BLE001 — detail is best-effort
                 pass
@@ -1433,8 +1519,10 @@ def main():
         # img/s headline is noisy) and BASELINE config #4's DLRM analog.
         # A cert wedge after this point must not lose them: the watchdog
         # partial merges _PARTIAL_BASE + _PARTIAL only.
-        for leg_name, leg_fn in (('host_plane', imagenet_host_plane_leg),
-                                 ('dlrm_host', dlrm_host_plane_leg)):
+        for leg_name, leg_fn in (
+                ('host_plane', imagenet_host_plane_leg),
+                ('dlrm_host', dlrm_host_plane_leg),
+                ('delivery_plane_service', delivery_plane_service_leg)):
             if _budget_left_s() <= 300:
                 break
             try:
@@ -1533,6 +1621,17 @@ def main():
         except Exception as e:  # noqa: BLE001 — must not cost the artifact
             result['dlrm_host_error'] = '%s: %s' % (type(e).__name__,
                                                     str(e)[:160])
+    # Disaggregated delivery plane (worker counts 1 -> 2 -> 4) — host-only
+    # like the leg above, and the direct countermeasure evidence for the
+    # delivery-bound regime this round targets.
+    if _budget_left_s() > 300:
+        try:
+            svc_leg = delivery_plane_service_leg()
+            result.update(svc_leg)
+            _PARTIAL.update(svc_leg)
+        except Exception as e:  # noqa: BLE001 — must not cost the artifact
+            result['delivery_plane_service_error'] = \
+                '%s: %s' % (type(e).__name__, str(e)[:160])
     _certify_into(result,
                   'tpu (Mosaic)' if jax.default_backend() == 'tpu'
                   else jax.default_backend() + ' (Pallas interpreter)',

@@ -53,3 +53,16 @@ class PoisonedRowGroupError(PetastormTpuError):
         # Default Exception reduction would replay __init__ with one arg
         # (the message) and break ProcessPool error propagation.
         return (type(self), (self.path, self.row_group, self.attempts, self.cause))
+
+
+class ServiceError(PetastormTpuError):
+    """A disaggregated data-service RPC was rejected by its peer (e.g. the
+    dispatcher refused a request, or a resume token's partition geometry
+    does not match the running job)."""
+
+
+class ServiceRpcTimeoutError(ServiceError):
+    """A control-plane RPC got no reply within its timeout — the peer is
+    down or unreachable.  The underlying REQ socket has been recycled, so
+    retrying the call is safe."""
+

@@ -898,6 +898,13 @@ class DeviceInMemDataLoader(InMemDataLoader):
         #: live position of the CURRENT pass (state_dict reads it); reset
         #: to the baseline whenever a fresh pass begins.
         self._epochs_done = 0
+        #: ``drop_last`` of the run that TOOK the resume token (None when
+        #: not resuming, or for pre-drop_last tokens).  The step cursor's
+        #: meaning depends on it: only a drop_last=False per-step pass can
+        #: legitimately park the cursor AT the full-batch count (inside the
+        #: ragged tail), so scan_epochs keys its max-cursor bound off this,
+        #: not off the resuming loader's own flag.
+        self._token_drop_last = None
         resumed = (self._resume_state or {}).get('device_inmem')
         if resumed:
             if seed is None or int(resumed['seed']) != int(seed):
@@ -908,6 +915,8 @@ class DeviceInMemDataLoader(InMemDataLoader):
                     % (resumed['seed'],))
             self._start_epoch = int(resumed['epochs_done'])
             self._start_step = int(resumed.get('steps_into_epoch', 0))
+            if resumed.get('drop_last') is not None:
+                self._token_drop_last = bool(resumed['drop_last'])
             token_bs = resumed.get('batch_size')
             if self._start_step and token_bs is not None \
                     and int(token_bs) != int(batch_size):
@@ -1073,10 +1082,22 @@ class DeviceInMemDataLoader(InMemDataLoader):
         carries the remaining ``steps - start_step`` steps (one extra
         compile) — then continues in full ``epochs_per_call`` groups.  A
         token taken inside an epoch's ragged tail (every full batch
-        consumed) resumes at the next epoch: scan always drops partial
-        trailing batches.  Checkpoints taken *between scan yields* are
-        epoch-group boundaries — ``scan_epochs`` never exposes an
-        intra-dispatch cursor (the whole group is one XLA execution).
+        consumed; only a ``drop_last=False`` pass parks the cursor there)
+        resumes at the next epoch: scan always drops partial trailing
+        batches.  Checkpoints taken *between scan yields* are epoch-group
+        boundaries — ``scan_epochs`` never exposes an intra-dispatch
+        cursor (the whole group is one XLA execution).
+
+        **Shape foot-gun with** ``epochs_per_call > 1``: the resume-tail
+        yield is a single partial epoch, so its ``outs`` has shape
+        ``(steps - start_step, ...)`` — NO leading epochs axis — while
+        every subsequent yield is ``(E, steps, ...)``.  Consumers that
+        index ``outs`` by epoch must special-case the first yield after a
+        mid-epoch resume (e.g. treat ``outs.ndim`` relative to a probe of
+        ``out``'s per-step shape, or reshape the tail to
+        ``(1, steps - start_step, ...)`` themselves).  Trailing partial
+        epoch groups keep the ``(E, steps, ...)`` shape with a smaller
+        ``E``; only the resume tail drops the axis.
         """
         import itertools
 
@@ -1127,15 +1148,25 @@ class DeviceInMemDataLoader(InMemDataLoader):
             # drop_last=False, only when a ragged tail exists) include one
             # tail batch scan would drop — a cursor AT the full-batch count
             # then means every scannable step is done and the epoch
-            # completes with no dispatch.  Any cursor past the geometry's
-            # legitimate maximum is a changed dataset/batch shape, the same
-            # error the per-step iterator raises for it.
-            max_cursor = steps if n % self.batch_size else steps - 1
+            # completes with no dispatch.  Only a drop_last=False pass can
+            # legitimately produce that cursor, so the token must have been
+            # TAKEN under drop_last=False to accept it (ADVICE r05: a stale
+            # token from a drop_last=True run would otherwise silently
+            # complete the epoch with zero dispatched steps); tokens
+            # predating the recorded flag keep the lax acceptance.  Any
+            # cursor past the geometry's legitimate maximum is a changed
+            # dataset/batch shape, the same error the per-step iterator
+            # raises for it.
+            ragged_tail = (bool(n % self.batch_size)
+                           and self._token_drop_last is not True)
+            max_cursor = steps if ragged_tail else steps - 1
             if start > max_cursor:
                 raise ValueError(
                     'device_inmem resume token is %d steps into an epoch '
-                    'of %d full batches — the dataset or batch geometry '
-                    'changed since the checkpoint' % (start, steps))
+                    'of %d full batches (max legitimate cursor %d for a '
+                    'token taken with drop_last=%r) — the dataset or batch '
+                    'geometry changed since the checkpoint'
+                    % (start, steps, max_cursor, self._token_drop_last))
             first = list(itertools.islice(orders, 1))
             if not first:
                 return
@@ -1197,6 +1228,7 @@ class DeviceInMemDataLoader(InMemDataLoader):
                                  'steps_into_epoch':
                                      int(self._steps_into_epoch),
                                  'batch_size': int(self.batch_size),
+                                 'drop_last': bool(self._drop_last),
                                  'seed': int(self._seed)}}
 
 

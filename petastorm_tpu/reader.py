@@ -125,7 +125,8 @@ def make_reader(dataset_url,
                 transform_spec=None, filters=None,
                 storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                 seed=None, resume_state=None, zmq_copy_buffers=True,
-                columnar_decode=False, read_retries=2, retry_backoff_s=0.1):
+                columnar_decode=False, read_retries=2, retry_backoff_s=0.1,
+                piece_indices=None):
     """Reader over a petastorm-format dataset (codec-decoded rows).
 
     Parity: ``petastorm/reader.py :: make_reader`` (argument names kept,
@@ -137,6 +138,13 @@ def make_reader(dataset_url,
     arrays (like ``make_batch_reader``, but with codec decoding) — the fast
     path for ``petastorm_tpu.jax.DataLoader``; no per-row python on the
     consumer thread.
+
+    ``piece_indices`` (extension): read EXACTLY these global row-group
+    indices (the ``load_row_groups`` order) instead of sharding — the
+    hook the data-service decode workers use to turn a leased split into
+    a reader.  Mutually exclusive with ``cur_shard``/``shard_count`` and
+    with ``rowgroup_selector``/``filters`` (both renumber or prune the
+    global piece list the indices refer to).
     """
     fs, path = get_filesystem_and_path_or_paths(
         dataset_url, storage_options=storage_options, filesystem=filesystem,
@@ -158,7 +166,7 @@ def make_reader(dataset_url,
         transform_spec=transform_spec, filters=filters, seed=seed,
         resume_state=resume_state, zmq_copy_buffers=zmq_copy_buffers,
         columnar_decode=columnar_decode, read_retries=read_retries,
-        retry_backoff_s=retry_backoff_s)
+        retry_backoff_s=retry_backoff_s, piece_indices=piece_indices)
 
 
 def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
@@ -169,7 +177,8 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
                         cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings,
                         transform_spec, filters, seed, resume_state, zmq_copy_buffers,
-                        columnar_decode=False, read_retries=2, retry_backoff_s=0.1):
+                        columnar_decode=False, read_retries=2, retry_backoff_s=0.1,
+                        piece_indices=None):
     from petastorm_tpu.ngram import NGram
     from petastorm_tpu.py_dict_reader_worker import PyDictReaderWorker, RowWorkerArgs
 
@@ -195,13 +204,18 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
         from petastorm_tpu.etl.rowgroup_filtering import apply_arrow_filters
         pieces = apply_arrow_filters(fs, pieces, filters, stored_schema)
 
-    if cur_shard is None and shard_count is None:
-        cur_shard, shard_count = _jax_default_shard()
-        if shard_count is not None:
-            logger.info('Auto-sharding by JAX process topology: shard %d of %d',
-                        cur_shard, shard_count)
-    local_indices = _shard_indices(len(pieces), cur_shard, shard_count,
-                                   shard_seed=shard_seed)
+    if piece_indices is not None:
+        local_indices = _explicit_piece_indices(
+            piece_indices, len(pieces), cur_shard, shard_count,
+            pruned=(rowgroup_selector is not None or filters is not None))
+    else:
+        if cur_shard is None and shard_count is None:
+            cur_shard, shard_count = _jax_default_shard()
+            if shard_count is not None:
+                logger.info('Auto-sharding by JAX process topology: shard %d of %d',
+                            cur_shard, shard_count)
+        local_indices = _shard_indices(len(pieces), cur_shard, shard_count,
+                                       shard_seed=shard_seed)
     if not local_indices and 'prologue' not in (resume_state or {}):
         raise NoDataAvailableError(
             'No row groups to read from %r after sharding/selection' % (dataset_url,))
@@ -250,6 +264,29 @@ class _ColumnarDictConverter(object):
         return self._schema.make_namedtuple_from_dict(columns)
 
 
+def _explicit_piece_indices(piece_indices, num_pieces, cur_shard, shard_count,
+                            pruned=False):
+    """Validate an explicit row-group assignment (``piece_indices=``).
+
+    The indices are positions in the GLOBAL ``load_row_groups`` order —
+    the coordinate system the data-service dispatcher partitions — so any
+    option that renumbers or prunes that list, or any concurrent sharding
+    request, is a contract violation rather than a silent re-read.
+    """
+    if cur_shard is not None or shard_count is not None:
+        raise ValueError('piece_indices is an explicit row-group assignment; '
+                         'cur_shard/shard_count do not compose with it')
+    if pruned:
+        raise ValueError('piece_indices indexes the full load_row_groups '
+                         'order; rowgroup_selector/filters would renumber it')
+    indices = [int(i) for i in piece_indices]
+    bad = [i for i in indices if not 0 <= i < num_pieces]
+    if bad:
+        raise ValueError('piece_indices %s out of range [0, %d)'
+                         % (bad[:5], num_pieces))
+    return indices
+
+
 def make_batch_reader(dataset_url_or_urls,
                       schema_fields=None,
                       reader_pool_type='thread', workers_count=10, results_queue_size=50,
@@ -262,11 +299,14 @@ def make_batch_reader(dataset_url_or_urls,
                       transform_spec=None, filters=None,
                       storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                       seed=None, resume_state=None, zmq_copy_buffers=True,
-                      read_retries=2, retry_backoff_s=0.1):
+                      read_retries=2, retry_backoff_s=0.1, piece_indices=None):
     """Columnar reader over *any* Parquet store (no petastorm metadata needed).
 
     Parity: ``petastorm/reader.py :: make_batch_reader``.  Yields namedtuples
     of numpy arrays, one element per row-group-sized batch.
+
+    ``piece_indices`` (extension): read exactly these global row-group
+    indices instead of sharding — see :func:`make_reader`.
     """
     from petastorm_tpu.arrow_reader_worker import (ArrowReaderWorker,
                                                    BatchWorkerArgs,
@@ -293,10 +333,15 @@ def make_batch_reader(dataset_url_or_urls,
         from petastorm_tpu.etl.rowgroup_filtering import apply_arrow_filters
         pieces = apply_arrow_filters(fs, pieces, filters, stored_schema)
 
-    if cur_shard is None and shard_count is None:
-        cur_shard, shard_count = _jax_default_shard()
-    local_indices = _shard_indices(len(pieces), cur_shard, shard_count,
-                                   shard_seed=shard_seed)
+    if piece_indices is not None:
+        local_indices = _explicit_piece_indices(
+            piece_indices, len(pieces), cur_shard, shard_count,
+            pruned=filters is not None)
+    else:
+        if cur_shard is None and shard_count is None:
+            cur_shard, shard_count = _jax_default_shard()
+        local_indices = _shard_indices(len(pieces), cur_shard, shard_count,
+                                       shard_seed=shard_seed)
     if not local_indices and 'prologue' not in (resume_state or {}):
         raise NoDataAvailableError(
             'No row groups to read from %r after sharding/selection' % (dataset_url_or_urls,))

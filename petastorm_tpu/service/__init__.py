@@ -1,0 +1,30 @@
+"""Disaggregated data-loading service: decode on fleet hosts, train on TPUs.
+
+Round-5 evidence (``BENCH_r05.json``) put the framework in the
+delivery-bound regime: one host's decode/collate plane cannot feed the
+chips (~95% stall).  This subsystem scales the decode plane horizontally
+and independently of the training hosts — the architecture of tf.data's
+data service (arxiv 2101.12127) realized over this repo's own reader/pool
+machinery:
+
+* :class:`~petastorm_tpu.service.dispatcher.Dispatcher` — control plane:
+  partitions the row-group list into splits, leases them to workers,
+  reassigns on lease expiry (worker death).
+* :class:`~petastorm_tpu.service.worker.Worker` — decode plane: wraps the
+  existing readers over each leased split and streams serialized batches
+  (Arrow IPC / pickle, the ProcessPool wire formats) under credit-based
+  backpressure.
+* :class:`~petastorm_tpu.service.client.ServiceDataLoader` — delivery
+  plane: a drop-in ``petastorm_tpu.jax.DataLoader`` peer with the same
+  sharding default (``jax.process_index()``) and resume-token contract,
+  committing whole splits exactly once.
+
+Console entry point: ``petastorm-tpu-data-service`` (see
+``petastorm_tpu/service/cli.py``).
+"""
+
+from petastorm_tpu.service.client import (ServiceDataLoader,  # noqa: F401
+                                          ServiceReader)
+from petastorm_tpu.service.config import ServiceConfig  # noqa: F401
+from petastorm_tpu.service.dispatcher import Dispatcher  # noqa: F401
+from petastorm_tpu.service.worker import Worker  # noqa: F401

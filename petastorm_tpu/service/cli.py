@@ -1,0 +1,136 @@
+"""``petastorm-tpu-data-service`` — run/inspect the data service.
+
+Three-command quickstart (one dispatcher, N decode hosts, then point
+``ServiceDataLoader`` at the dispatcher from the training job)::
+
+    petastorm-tpu-data-service dispatcher \
+        --bind tcp://0.0.0.0:7777 --dataset-url file:///data/train \
+        --num-consumers 4
+    petastorm-tpu-data-service worker --dispatcher tcp://dispatch:7777
+    petastorm-tpu-data-service status --dispatcher tcp://dispatch:7777
+"""
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+
+def _build_parser():
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-data-service',
+        description='Disaggregated data-loading service '
+                    '(petastorm_tpu.service)')
+    sub = parser.add_subparsers(dest='command', required=True)
+
+    d = sub.add_parser('dispatcher', help='run the control plane')
+    d.add_argument('--bind', default='tcp://127.0.0.1:7777',
+                   help='REP endpoint to serve on (tcp://host:port; '
+                        'port * picks a free one)')
+    d.add_argument('--dataset-url', required=True)
+    d.add_argument('--num-consumers', type=int, default=1,
+                   help='number of consuming training hosts '
+                        '(split i belongs to consumer i %% N)')
+    d.add_argument('--rowgroups-per-split', type=int, default=2)
+    d.add_argument('--lease-ttl-s', type=float, default=10.0)
+    d.add_argument('--credits', type=int, default=8)
+    d.add_argument('--reader-factory', default='auto',
+                   choices=('auto', 'reader', 'batch_reader'))
+    d.add_argument('--workers-count', type=int, default=None,
+                   help='decode threads per split reader on each worker')
+
+    w = sub.add_parser('worker', help='run one decode worker')
+    w.add_argument('--dispatcher', required=True,
+                   help='dispatcher endpoint (tcp://host:port)')
+    w.add_argument('--data-bind', default='tcp://127.0.0.1:*',
+                   help='ROUTER endpoint to stream batches from; the '
+                        'resolved address is advertised to the dispatcher, '
+                        'so bind an address the training hosts can reach')
+    w.add_argument('--advertise-host', default=None,
+                   help='hostname/IP published to the dispatcher instead '
+                        'of the bind host — required when binding '
+                        '0.0.0.0 (unroutable from the training hosts); '
+                        'defaults to the machine hostname for wildcard '
+                        'binds')
+    w.add_argument('--max-inflight-splits', type=int, default=3)
+    w.add_argument('--max-buffered-chunks', type=int, default=32)
+
+    s = sub.add_parser('status', help='print dispatcher stats as JSON')
+    s.add_argument('--dispatcher', required=True)
+
+    p = sub.add_parser('stop', help='ask the dispatcher to shut down')
+    p.add_argument('--dispatcher', required=True)
+    return parser
+
+
+def _rpc_once(addr, request):
+    import zmq
+
+    from petastorm_tpu.service.worker import _Rpc
+    context = zmq.Context()
+    rpc = _Rpc(context, addr)
+    try:
+        return rpc.call(request)
+    finally:
+        rpc.close()
+        context.term()
+
+
+def main(argv=None):
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(name)s %(levelname)s %(message)s')
+    args = _build_parser().parse_args(argv)
+
+    if args.command == 'dispatcher':
+        from petastorm_tpu.service import Dispatcher, ServiceConfig
+        reader_kwargs = {}
+        if args.workers_count is not None:
+            reader_kwargs['workers_count'] = args.workers_count
+        config = ServiceConfig(
+            dataset_url=args.dataset_url,
+            num_consumers=args.num_consumers,
+            rowgroups_per_split=args.rowgroups_per_split,
+            lease_ttl_s=args.lease_ttl_s,
+            credits=args.credits,
+            reader_factory=args.reader_factory,
+            reader_kwargs=reader_kwargs)
+        with Dispatcher(config, bind=args.bind) as dispatcher:
+            print('dispatcher serving %s (%d splits, %d consumers)'
+                  % (dispatcher.addr, dispatcher._job['num_splits'],
+                     args.num_consumers), flush=True)
+            try:
+                while dispatcher._thread.is_alive():
+                    time.sleep(0.5)
+            except KeyboardInterrupt:
+                pass
+        return 0
+
+    if args.command == 'worker':
+        from petastorm_tpu.service import Worker
+        worker = Worker(args.dispatcher, data_bind=args.data_bind,
+                        advertise_host=args.advertise_host,
+                        max_inflight_splits=args.max_inflight_splits,
+                        max_buffered_chunks=args.max_buffered_chunks)
+        try:
+            worker.run()  # blocks until stop()/SIGTERM
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    if args.command == 'status':
+        print(json.dumps(_rpc_once(args.dispatcher, {'op': 'stats'}),
+                         indent=1, sort_keys=True))
+        return 0
+
+    if args.command == 'stop':
+        _rpc_once(args.dispatcher, {'op': 'stop'})
+        print('dispatcher at %s stopped' % args.dispatcher)
+        return 0
+
+    return 2  # unreachable: argparse enforces the command set
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,443 @@
+"""JAX-side client of the data service: ``ServiceDataLoader``.
+
+A drop-in peer of ``petastorm_tpu.jax.DataLoader`` whose "reader" is the
+service instead of a local decode pool: the connection subscribes to
+every registered decode worker (rotated by consumer index so hosts
+spread their first pulls — the ``jax.process_index()``-keyed round-robin
+of the sharding contract), pulls serialized chunks under credit-based
+backpressure, and commits *whole splits*:
+
+* chunks of a split buffer until the worker's ``end`` marker arrives —
+  a worker death mid-split leaves only a discarded partial buffer, never
+  half-delivered rows;
+* a completed split is ACKed to the worker (which only then reports
+  ``complete`` to the dispatcher) and deduped by split id, so a split
+  re-streamed after lease reassignment is delivered exactly once;
+* ``ordered=True`` releases splits in ascending split-id order; the
+  default releases them as workers finish (lowest latency).  Row order
+  WITHIN a split follows the worker's per-split reader, so full
+  determinism additionally needs a deterministic split reader
+  (``reader_kwargs={'workers_count': 1}`` in the job config).
+
+Resume follows the existing loader contract: ``state_dict()`` →
+``resume_state=``.  The service part of the token is the set of split
+ids this consumer has committed plus the partition-geometry fingerprint;
+restoring against a fresh service run retires those splits at the
+dispatcher (no re-decode) and the DataLoader machinery restores the
+sub-split residue (partial batches, buffered chunks) exactly as the
+local loaders do.
+"""
+
+import logging
+import pickle
+import queue
+import threading
+
+from petastorm_tpu.errors import ServiceError
+from petastorm_tpu.jax.loader import DataLoader
+from petastorm_tpu.service.worker import _Rpc, deserialize_chunk
+
+logger = logging.getLogger(__name__)
+
+
+class _ServiceConnection(object):
+    """One consumer's connection: dispatcher RPCs + a DEALER per worker."""
+
+    def __init__(self, dispatcher_addr, consumer=None, resume=None,
+                 ordered=False, queue_splits=4, credits=None,
+                 rpc_timeout_s=20.0):
+        import zmq
+
+        self._zmq = zmq
+        self._dispatcher_addr = dispatcher_addr
+        self._context = zmq.Context()
+        self._rpc_timeout_s = rpc_timeout_s
+        try:
+            self._init(consumer, resume or {}, ordered, queue_splits,
+                       credits)
+        except Exception:
+            self._context.term()
+            raise
+
+    def _init(self, consumer, resume, ordered, queue_splits, credits):
+        rpc = _Rpc(self._context, self._dispatcher_addr,
+                   timeout_s=self._rpc_timeout_s)
+        try:
+            self.job = rpc.call({'op': 'job'})['job']
+        finally:
+            rpc.close()
+        if consumer is None:
+            consumer = _default_consumer(self.job['num_consumers'])
+        if not 0 <= consumer < self.job['num_consumers']:
+            raise ServiceError('consumer must be in [0, %d), got %r'
+                               % (self.job['num_consumers'], consumer))
+        self.consumer = int(consumer)
+        # Geometry FIRST: a mismatched token's split ids index a different
+        # partition, and the mark_consumed below would permanently retire
+        # live splits of THIS job before the error could raise.
+        _check_resume_geometry(resume, self)
+        self._credits = int(credits if credits is not None
+                            else self.job['credits'])
+        self._ordered = bool(ordered)
+        self._my_splits = [sid for sid in range(self.job['num_splits'])
+                           if sid % self.job['num_consumers'] == self.consumer]
+        self.consumed = set(int(s) for s in resume.get('consumed') or ())
+        unknown = self.consumed - set(self._my_splits)
+        if unknown:
+            raise ServiceError(
+                'resume token holds split ids %s that do not belong to '
+                'consumer %d of this job' % (sorted(unknown)[:5],
+                                             self.consumer))
+        if self.consumed:
+            rpc = _Rpc(self._context, self._dispatcher_addr,
+                       timeout_s=self._rpc_timeout_s)
+            try:
+                rpc.call({'op': 'mark_consumed',
+                          'split_ids': sorted(self.consumed)})
+            finally:
+                rpc.close()
+        #: complete splits ready for the reader: (split_id, [chunk dicts]);
+        #: bounded — a full queue stops the receiver from reading sockets,
+        #: which stops credit replenishment, which stalls the workers.
+        self._ready = queue.Queue(maxsize=max(1, int(queue_splits)))
+        self._error = None
+        self._ended = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._recv_loop,
+                                        name='service-client-recv',
+                                        daemon=True)
+        self._thread.start()
+
+    # -- consumption (reader thread) -----------------------------------------
+
+    def next_split(self):
+        """Next complete, not-yet-delivered split: ``(split_id, chunks)``;
+        None at end of stream.  A receive-loop failure raises here — a
+        dead receiver must not masquerade as a clean (rows-missing) end
+        of stream."""
+        while True:
+            if self._ended.is_set() and self._ready.empty():
+                if self._error is not None:
+                    raise ServiceError(
+                        'service receive loop died: %s: %s'
+                        % (type(self._error).__name__, self._error))
+                return None
+            try:
+                return self._ready.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return None
+
+    def drain_ready(self):
+        """Pop every split currently buffered client-side (non-blocking) —
+        the service leg of the loader's exact-checkpoint drain."""
+        drained = []
+        while True:
+            try:
+                drained.append(self._ready.get_nowait())
+            except queue.Empty:
+                return drained
+
+    def commit(self, split_id):
+        self.consumed.add(int(split_id))
+
+    def stop(self):
+        self._stop.set()
+
+    def join(self):
+        self._thread.join()
+        self._context.term()
+
+    # -- receive loop --------------------------------------------------------
+
+    def _recv_loop(self):
+        zmq = self._zmq
+        rpc = _Rpc(self._context, self._dispatcher_addr,
+                   timeout_s=self._rpc_timeout_s)
+        sockets = {}            # worker data addr -> DEALER
+        poller = zmq.Poller()
+        buffers = {}            # (split_id, attempt) -> {seq: (tag, payload)}
+        received = set(self.consumed)
+        remaining = set(self._my_splits) - received
+        held = {}               # ordered mode: completed, awaiting turn
+        order = [sid for sid in self._my_splits if sid not in received]
+        next_refresh = 0.0
+        try:
+            import time
+            while remaining and not self._stop.is_set():
+                now = time.monotonic()
+                if now >= next_refresh:
+                    next_refresh = now + 1.0
+                    try:
+                        reply = rpc.call({'op': 'workers'})
+                        workers = reply['workers']
+                    except ServiceError:
+                        workers, reply = [], {}
+                    failed = set(reply.get('failed_splits') or ()) & remaining
+                    if failed:
+                        # The dispatcher gave up on these (attempt ceiling):
+                        # surface a terminal error instead of waiting on
+                        # rows that will never stream.
+                        raise ServiceError(
+                            'split(s) %s of consumer %d failed every decode '
+                            'attempt at the dispatcher'
+                            % (sorted(failed)[:5], self.consumer))
+                    # Rotate by consumer index: host c starts its pulls at
+                    # worker c % W instead of every host hammering worker 0.
+                    if workers:
+                        c = self.consumer % len(workers)
+                        workers = workers[c:] + workers[:c]
+                    for worker in workers:
+                        addr = worker['addr']
+                        if addr in sockets:
+                            continue
+                        sock = self._context.socket(zmq.DEALER)
+                        sock.setsockopt(zmq.LINGER, 0)
+                        sock.set_hwm(0)
+                        sock.connect(addr)
+                        sock.send(pickle.dumps(
+                            {'type': 'subscribe', 'consumer': self.consumer,
+                             'credits': self._credits}, protocol=4))
+                        sockets[addr] = sock
+                        poller.register(sock, zmq.POLLIN)
+                for sock in dict(poller.poll(100)):
+                    while True:
+                        try:
+                            frames = sock.recv_multipart(zmq.NOBLOCK)
+                        except zmq.Again:
+                            break
+                        header = pickle.loads(frames[0])
+                        sid = int(header['split'])
+                        attempt = int(header['attempt'])
+                        if header['type'] == 'chunk':
+                            # replenish immediately: in-flight chunks stay
+                            # bounded by the credit window; backpressure
+                            # comes from this loop blocking on _ready.put
+                            sock.send(pickle.dumps({'type': 'credit', 'n': 1},
+                                                   protocol=4))
+                            if sid in received:
+                                continue  # duplicate stream: drop quietly
+                            buffers.setdefault((sid, attempt), {})[
+                                int(header['seq'])] = (header['tag'],
+                                                       frames[1])
+                        elif header['type'] == 'end':
+                            if sid in received:
+                                # Duplicate stream: re-ack so the worker's
+                                # completion bookkeeping settles (the
+                                # dispatcher side is idempotent).
+                                sock.send(pickle.dumps(
+                                    {'type': 'ack', 'split': sid,
+                                     'attempt': attempt}, protocol=4))
+                                continue
+                            parts = buffers.get((sid, attempt), {})
+                            if len(parts) != int(header['chunks']):
+                                # Chunks lost (routed to a stale identity
+                                # across a client reconnect): NOT acked —
+                                # an ack here would let the worker report
+                                # complete on rows we never got.  Ask for
+                                # a re-decode instead.
+                                logger.warning(
+                                    'split %d attempt %d: %d/%d chunks — '
+                                    'discarding partial buffer and '
+                                    'requesting resend', sid, attempt,
+                                    len(parts), int(header['chunks']))
+                                buffers.pop((sid, attempt), None)
+                                sock.send(pickle.dumps(
+                                    {'type': 'resend', 'split': sid,
+                                     'attempt': attempt}, protocol=4))
+                                continue
+                            # Complete: ack — only now may the worker
+                            # report the split complete to the dispatcher.
+                            sock.send(pickle.dumps(
+                                {'type': 'ack', 'split': sid,
+                                 'attempt': attempt}, protocol=4))
+                            chunks = [deserialize_chunk(*parts[i])
+                                      for i in sorted(parts)]
+                            received.add(sid)
+                            remaining.discard(sid)
+                            for key in [k for k in buffers if k[0] == sid]:
+                                del buffers[key]
+                            if self._ordered:
+                                held[sid] = chunks
+                                while order and order[0] in held:
+                                    nxt = order.pop(0)
+                                    self._put((nxt, held.pop(nxt)))
+                            else:
+                                self._put((sid, chunks))
+        except Exception as e:  # noqa: BLE001 — re-raised in next_split
+            # Without this, a crashed receiver would look exactly like a
+            # clean (rows-missing!) end of stream to the consumer.
+            self._error = e
+        finally:
+            self._ended.set()
+            rpc.close()
+            # Clean end of stream: the LAST split's ack may still sit in
+            # ZMQ's outbound queue — a zero-linger close would discard it
+            # and leave the worker replaying an already-delivered split.
+            # User abort keeps the instant close.
+            linger_ms = 0 if self._stop.is_set() else 1000
+            for sock in sockets.values():
+                sock.close(linger_ms)
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._ready.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+
+def _default_consumer(num_consumers):
+    """The sharding contract's default: this training host's index."""
+    try:
+        import jax
+
+        from petastorm_tpu.utils import apply_jax_platforms_env
+        apply_jax_platforms_env()
+        return jax.process_index() % num_consumers
+    except Exception:  # noqa: BLE001 — jax absent/uninitialized: consumer 0
+        return 0
+
+
+class ServiceReader(object):
+    """Reader-shaped adapter over a service connection.
+
+    Implements exactly the surface ``petastorm_tpu.jax.DataLoader``
+    consumes (iteration, ``batched_output``, ``stop``/``join``,
+    ``drain_in_flight``/``resume_dispatch``/``state_dict``), yielding
+    columnar chunk dicts.  A split's chunks are committed to the consumed
+    set the moment they enter the loader machinery — from then on the
+    loader's own snapshot carries any not-yet-yielded residue, which is
+    what makes the combined token exact.
+    """
+
+    batched_output = True
+    ngram = None
+    num_epochs = 1
+
+    def __init__(self, connection):
+        self._conn = connection
+        self._current = []
+        self.last_row_consumed = False
+
+    @property
+    def job(self):
+        return self._conn.job
+
+    @property
+    def consumer(self):
+        return self._conn.consumer
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._current:
+            item = self._conn.next_split()
+            if item is None:
+                self.last_row_consumed = True
+                raise StopIteration
+            split_id, chunks = item
+            self._conn.commit(split_id)
+            self._current = list(chunks)
+        return self._current.pop(0)
+
+    # -- exact-checkpoint support -------------------------------------------
+
+    def drain_in_flight(self):
+        drained = list(self._current)
+        self._current = []
+        for split_id, chunks in self._conn.drain_ready():
+            self._conn.commit(split_id)
+            drained.extend(chunks)
+        return drained
+
+    def resume_dispatch(self):
+        pass  # dispatch is remote; nothing was paused
+
+    def state_dict(self):
+        return {'service': {
+            'version': 1,
+            'consumer': self._conn.consumer,
+            'consumed': sorted(self._conn.consumed),
+            'num_splits': self._conn.job['num_splits'],
+            'num_consumers': self._conn.job['num_consumers'],
+            'fingerprint': self._conn.job['fingerprint'],
+        }}
+
+    def stop(self):
+        self._conn.stop()
+
+    def join(self):
+        self._conn.join()
+
+
+class ServiceDataLoader(DataLoader):
+    """``petastorm_tpu.jax.DataLoader`` fed by the data service.
+
+    Same constructor surface as ``DataLoader`` minus the reader (the
+    service is the reader), plus:
+
+    Args:
+        dispatcher_addr: the dispatcher's control endpoint
+            (``tcp://host:port``).
+        consumer: which consumer shard this host is; defaults to
+            ``jax.process_index() % num_consumers`` — the service analog
+            of the readers' JAX auto-sharding.
+        ordered: release splits in split-id order (deterministic) instead
+            of completion order.
+        queue_splits / credits / rpc_timeout_s: client-side flow control;
+            ``credits`` defaults to the job's configured window.
+
+    Everything else (``batch_size``, ``transform_fn``, ``drop_last``,
+    ``prefetch``, ``device``/``sharding``, ``resume_state``, ``echo``,
+    ``trace_recorder``) behaves exactly as on ``DataLoader``; resume
+    tokens round-trip through ``state_dict()`` with the service position
+    (committed split ids) in place of the ventilator cursor.
+    """
+
+    def __init__(self, dispatcher_addr, batch_size, consumer=None,
+                 ordered=False, queue_splits=4, credits=None,
+                 rpc_timeout_s=20.0, resume_state=None, **kwargs):
+        svc = ((resume_state or {}).get('reader') or {}).get('service') or {}
+        if svc and consumer is None:
+            consumer = svc.get('consumer')
+        connection = _ServiceConnection(
+            dispatcher_addr, consumer=consumer, resume=svc,
+            ordered=ordered, queue_splits=queue_splits, credits=credits,
+            rpc_timeout_s=rpc_timeout_s)
+        super(ServiceDataLoader, self).__init__(
+            ServiceReader(connection), batch_size,
+            resume_state=resume_state, **kwargs)
+
+    def service_diagnostics(self):
+        """Fleet-wide service metrics (dispatcher ``stats`` RPC): split
+        queue depths, lease churn, per-worker rows/s."""
+        conn = self.reader._conn
+        rpc = _Rpc(conn._context, conn._dispatcher_addr,
+                   timeout_s=conn._rpc_timeout_s)
+        try:
+            return rpc.call({'op': 'stats'})
+        finally:
+            rpc.close()
+
+
+def _check_resume_geometry(svc, connection):
+    """Service analog of ``Reader._check_resume_topology``: a token's
+    split ids index one partition geometry; any drift (dataset, split
+    size, consumer count) must raise, not silently skip/replay rows."""
+    if not svc:
+        return
+    mismatches = [
+        key for key, current in (
+            ('fingerprint', connection.job['fingerprint']),
+            ('num_splits', connection.job['num_splits']),
+            ('num_consumers', connection.job['num_consumers']),
+            ('consumer', connection.consumer))
+        if svc.get(key) is not None and svc[key] != current]
+    if mismatches:
+        raise ServiceError(
+            'resume token was taken under a different service job '
+            '(mismatched: %s) — its split ids do not index this '
+            'partition geometry' % ', '.join(mismatches))

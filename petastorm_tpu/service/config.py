@@ -1,0 +1,114 @@
+"""Configuration surface of the disaggregated data-loading service.
+
+One :class:`ServiceConfig` describes a *job*: the dataset, how its
+row-group list is cut into splits, how splits map onto consumers, and the
+control-plane timing (lease TTL, heartbeat cadence) plus the data-plane
+flow control (credit window, worker buffer bound).  The dispatcher owns
+the authoritative copy; workers and clients fetch the fields they need
+over the ``job`` RPC, so every process in the service agrees on the same
+partition geometry without sharing files.
+"""
+
+import dataclasses
+import hashlib
+
+
+@dataclasses.dataclass
+class ServiceConfig:
+    """Job description + tuning knobs for dispatcher/worker/client.
+
+    Args:
+        dataset_url: the dataset every decode worker reads (petastorm
+            format or plain Parquet — workers auto-detect, see
+            ``reader_factory``).
+        num_consumers: number of consuming training hosts.  Split ``i``
+            belongs to consumer ``i % num_consumers`` — the same modulo
+            contract ``reader._shard_indices`` uses, so the service shards
+            exactly like the local loaders do.
+        rowgroups_per_split: consecutive row groups per split.  A split is
+            the unit of lease/reassignment AND of exactly-once delivery
+            (clients commit whole splits), so it bounds both re-decode
+            work after a worker death and client-side buffering.
+        lease_ttl_s: a split lease not renewed (by worker heartbeat)
+            within this window is considered orphaned and reassigned.
+        max_split_attempts: a split whose lease expires this many times is
+            marked ``failed`` instead of requeued — every worker that
+            touched it walked away (undecodable data), and clients raise a
+            ``ServiceError`` rather than silently waiting forever.
+        heartbeat_interval_s: worker heartbeat cadence; defaults to
+            ``lease_ttl_s / 3`` when None.
+        credits: initial per-client credit window, counted in chunks.
+            The client replenishes one credit per chunk it pulls off the
+            socket; when its delivery queue fills, it stops reading and
+            the worker's sends stall at this bound — credit-based
+            backpressure end to end.
+        max_buffered_chunks: decode pauses on a worker once this many
+            serialized chunks wait for credits (bounds worker memory when
+            a consumer is slow or absent).
+        max_inflight_splits: leases a worker holds at once (one decoding
+            + the rest streaming/awaiting ack).
+        reader_factory: ``'auto'`` probes the dataset once per worker
+            (petastorm metadata -> codec-decoding ``make_reader`` with
+            ``columnar_decode=True``; plain Parquet ->
+            ``make_batch_reader``); ``'reader'`` / ``'batch_reader'``
+            force the choice.
+        reader_kwargs: extra kwargs for the per-split reader (e.g.
+            ``workers_count``, ``transform_spec``).  Must be picklable —
+            they cross the control plane.
+    """
+
+    dataset_url: str
+    num_consumers: int = 1
+    rowgroups_per_split: int = 2
+    lease_ttl_s: float = 10.0
+    max_split_attempts: int = 5
+    heartbeat_interval_s: float = None
+    credits: int = 8
+    max_buffered_chunks: int = 32
+    max_inflight_splits: int = 3
+    reader_factory: str = 'auto'
+    reader_kwargs: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.num_consumers < 1:
+            raise ValueError('num_consumers must be >= 1')
+        if self.rowgroups_per_split < 1:
+            raise ValueError('rowgroups_per_split must be >= 1')
+        if self.lease_ttl_s <= 0:
+            raise ValueError('lease_ttl_s must be positive')
+        if self.max_split_attempts < 1:
+            raise ValueError('max_split_attempts must be >= 1')
+        if self.credits < 1:
+            raise ValueError('credits must be >= 1')
+        if self.reader_factory not in ('auto', 'reader', 'batch_reader'):
+            raise ValueError("reader_factory must be 'auto', 'reader' or "
+                             "'batch_reader', got %r" % (self.reader_factory,))
+        if self.heartbeat_interval_s is None:
+            self.heartbeat_interval_s = self.lease_ttl_s / 3.0
+
+    def fingerprint(self, num_splits):
+        """Identity of the partition geometry a resume token indexes into.
+
+        A client token's ``consumed`` split ids are only meaningful
+        against the same (dataset, split size, consumer count, split
+        count); the fingerprint rides in both the job info and the token
+        so a mismatch raises instead of silently skipping data — the
+        service analog of ``Reader._check_resume_topology``.
+        """
+        key = '%s|%d|%d|%d' % (self.dataset_url, self.num_consumers,
+                               self.rowgroups_per_split, num_splits)
+        return hashlib.blake2b(key.encode(), digest_size=8).hexdigest()
+
+    def job_info(self, num_splits):
+        """The subset workers and clients need, shippable over the wire."""
+        return {
+            'dataset_url': self.dataset_url,
+            'num_consumers': int(self.num_consumers),
+            'num_splits': int(num_splits),
+            'rowgroups_per_split': int(self.rowgroups_per_split),
+            'lease_ttl_s': float(self.lease_ttl_s),
+            'credits': int(self.credits),
+            'reader_factory': self.reader_factory,
+            'reader_kwargs': dict(self.reader_kwargs),
+            'fingerprint': self.fingerprint(num_splits),
+        }

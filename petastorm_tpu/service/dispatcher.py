@@ -1,0 +1,383 @@
+"""Control plane of the disaggregated data service.
+
+The dispatcher never touches row data.  It enumerates the dataset's
+row groups once, cuts them into splits (``ServiceConfig
+.rowgroups_per_split`` consecutive groups each, split ``i`` owned by
+consumer ``i % num_consumers``), and runs a single REP socket serving
+short pickled RPCs:
+
+  ``register_worker`` worker announces its data-plane address -> worker_id
+  ``heartbeat``       liveness + metrics; renews every lease the worker holds
+  ``lease``           hand out one pending split under a TTL lease
+  ``complete``        worker finished streaming a split (client acked it)
+  ``mark_consumed``   a resuming client retires splits its token already holds
+  ``job`` / ``workers`` / ``stats``  discovery + metrics surface
+  ``stop``            remote shutdown (CLI convenience)
+
+Lease expiry is the failure path: a worker that stops heartbeating has
+all its leases returned to the pending queue (attempt+1) on the next
+serve-loop tick, exactly once — a split is always in exactly one of
+pending/leased/done, and a late ``complete`` from the presumed-dead
+worker is rejected once the split has moved on.  Exactly-once *delivery*
+is finished on the client side (whole-split commit + dedupe by split id);
+the dispatcher guarantees exactly-once *assignment* per attempt and
+at-least-once decode.
+"""
+
+import collections
+import logging
+import pickle
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+_PENDING, _LEASED, _DONE, _FAILED = 'pending', 'leased', 'done', 'failed'
+
+
+class Split(object):
+    """One leasable unit of decode work: consecutive row-group indices."""
+
+    __slots__ = ('split_id', 'indices', 'consumer', 'attempt', 'state',
+                 'worker_id', 'lease_expires')
+
+    def __init__(self, split_id, indices, consumer):
+        self.split_id = split_id
+        self.indices = list(indices)
+        self.consumer = consumer
+        self.attempt = 0
+        self.state = _PENDING
+        self.worker_id = None
+        self.lease_expires = 0.0
+
+    def describe(self):
+        return {'split_id': self.split_id, 'indices': list(self.indices),
+                'consumer': self.consumer, 'attempt': self.attempt}
+
+
+def build_splits(num_pieces, rowgroups_per_split, num_consumers):
+    """Cut ``num_pieces`` row groups into Split objects.
+
+    Consecutive grouping keeps each split's reads sequential on disk;
+    the consumer assignment is the ``_shard_indices`` modulo contract
+    over SPLITS (not row groups), so consumers own disjoint, covering
+    subsets by construction.
+    """
+    splits = []
+    for start in range(0, num_pieces, rowgroups_per_split):
+        sid = len(splits)
+        indices = range(start, min(start + rowgroups_per_split, num_pieces))
+        splits.append(Split(sid, indices, sid % num_consumers))
+    return splits
+
+
+class Dispatcher(object):
+    """Serve the control plane for one job.  Thread-hosted::
+
+        config = ServiceConfig('file:///data/train', num_consumers=2)
+        with Dispatcher(config, bind='tcp://127.0.0.1:7777') as d:
+            ...  # workers and clients connect to d.addr
+
+    ``bind`` may end in ``:*`` (or ``:0``) to pick a free TCP port; the
+    resolved address is ``.addr``.  ``trace_recorder`` (a
+    ``benchmark.TraceRecorder``) receives instant markers for every
+    lease grant / expiry / completion — the control-plane timeline next
+    to the loaders' span streams.
+    """
+
+    def __init__(self, config, bind='tcp://127.0.0.1:*', num_pieces=None,
+                 trace_recorder=None):
+        self._config = config
+        self._bind = bind
+        self._trace = trace_recorder
+        if num_pieces is None:
+            num_pieces = _count_row_groups(config.dataset_url,
+                                           config.reader_kwargs)
+        if num_pieces < 1:
+            raise ValueError('dataset %r has no row groups'
+                             % (config.dataset_url,))
+        self._splits = build_splits(num_pieces, config.rowgroups_per_split,
+                                    config.num_consumers)
+        self._job = config.job_info(len(self._splits))
+        self._pending = collections.deque(self._splits)
+        self._workers = {}   # worker_id -> {'addr', 'last_heartbeat', 'stats'}
+        self._next_worker_id = 0
+        self.lease_churn = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._started = threading.Event()
+        self.addr = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._serve,
+                                        name='service-dispatcher', daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError('dispatcher failed to bind %r' % (self._bind,))
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def join(self):
+        if self._thread is not None:
+            self._thread.join()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.stop()
+        self.join()
+
+    # -- serve loop ----------------------------------------------------------
+
+    def _serve(self):
+        import zmq
+
+        context = zmq.Context()
+        socket = context.socket(zmq.REP)
+        try:
+            if self._bind.startswith('tcp') and (
+                    self._bind.endswith(':*') or self._bind.endswith(':0')):
+                port = socket.bind_to_random_port(
+                    self._bind.rsplit(':', 1)[0])
+                self.addr = '%s:%d' % (self._bind.rsplit(':', 1)[0], port)
+            else:
+                socket.bind(self._bind)
+                self.addr = self._bind
+        except Exception:
+            socket.close(0)
+            context.term()
+            self._started.set()  # unblock start(); addr stays None
+            raise
+        self._started.set()
+        poller = zmq.Poller()
+        poller.register(socket, zmq.POLLIN)
+        try:
+            while not self._stop.is_set():
+                self._expire_leases()
+                if not dict(poller.poll(100)):
+                    continue
+                request = pickle.loads(socket.recv())
+                try:
+                    reply = self._dispatch(request)
+                except Exception as e:  # noqa: BLE001 — reply, don't die
+                    logger.exception('dispatcher RPC %r failed',
+                                     request.get('op'))
+                    reply = {'error': '%s: %s' % (type(e).__name__, e)}
+                socket.send(pickle.dumps(reply, protocol=4))
+                if request.get('op') == 'stop':
+                    break
+        finally:
+            socket.close(0)
+            context.term()
+
+    # -- lease bookkeeping ---------------------------------------------------
+
+    def _expire_leases(self):
+        now = time.monotonic()
+        max_attempts = self._config.max_split_attempts
+        with self._lock:
+            for split in self._splits:
+                if split.state == _LEASED and split.lease_expires < now:
+                    split.worker_id = None
+                    split.attempt += 1
+                    self.lease_churn += 1
+                    if split.attempt >= max_attempts:
+                        # Every worker that touched this split walked away
+                        # (undecodable row group, poisoned data): a terminal
+                        # state the clients can SEE beats an infinite
+                        # pending->leased->expired loop they silently hang
+                        # behind.
+                        logger.error(
+                            'split %d failed %d lease attempts; marking '
+                            'failed', split.split_id, split.attempt)
+                        split.state = _FAILED
+                    else:
+                        logger.warning(
+                            'lease on split %d (attempt %d) expired; '
+                            'requeueing', split.split_id, split.attempt)
+                        split.state = _PENDING
+                        self._pending.append(split)
+                    if self._trace is not None:
+                        self._trace.instant('service/lease_expired',
+                                            split=split.split_id)
+
+    def _dispatch(self, request):
+        op = request.get('op')
+        handler = getattr(self, '_op_' + str(op), None)
+        if handler is None:
+            return {'error': 'unknown op %r' % (op,)}
+        return handler(request)
+
+    # -- RPC handlers --------------------------------------------------------
+
+    def _op_register_worker(self, request):
+        with self._lock:
+            worker_id = 'w%d' % self._next_worker_id
+            self._next_worker_id += 1
+            self._workers[worker_id] = {
+                'addr': request['data_addr'],
+                'last_heartbeat': time.monotonic(),
+                'stats': {},
+            }
+        logger.info('registered worker %s at %s', worker_id,
+                    request['data_addr'])
+        return {'worker_id': worker_id, 'job': self._job}
+
+    def _op_heartbeat(self, request):
+        worker_id = request['worker_id']
+        # ``held``: the split ids the worker still claims.  Renewing ONLY
+        # those lets a split the worker abandoned (decode error) expire and
+        # reassign while the worker itself stays alive; a heartbeat without
+        # the field (older workers) renews every lease it holds.
+        held = request.get('held')
+        if held is not None:
+            held = {int(s) for s in held}
+        now = time.monotonic()
+        with self._lock:
+            worker = self._workers.get(worker_id)
+            if worker is None:
+                return {'ok': False, 'error': 'unknown worker %r' % worker_id}
+            worker['last_heartbeat'] = now
+            if request.get('stats'):
+                worker['stats'] = dict(request['stats'])
+            for split in self._splits:
+                if split.state == _LEASED and split.worker_id == worker_id \
+                        and (held is None or split.split_id in held):
+                    split.lease_expires = now + self._config.lease_ttl_s
+        return {'ok': True}
+
+    def _op_lease(self, request):
+        worker_id = request['worker_id']
+        # ``consumers``: the consumer indices with a live subscriber on the
+        # requesting worker.  Leasing only their splits keeps a worker from
+        # decoding splits whose training host is absent (they would stall
+        # its shared send buffer); a request without the field leases
+        # anything.
+        consumers = request.get('consumers')
+        if consumers is not None:
+            consumers = {int(c) for c in consumers}
+        with self._lock:
+            if worker_id not in self._workers:
+                return {'error': 'unknown worker %r' % worker_id}
+            self._workers[worker_id]['last_heartbeat'] = time.monotonic()
+            chosen, skipped = None, []
+            while self._pending:
+                split = self._pending.popleft()
+                if split.state != _PENDING:
+                    continue  # completed via mark_consumed while queued
+                if consumers is not None and split.consumer not in consumers:
+                    skipped.append(split)
+                    continue
+                chosen = split
+                break
+            self._pending.extend(skipped)
+            if chosen is not None:
+                chosen.state = _LEASED
+                chosen.worker_id = worker_id
+                chosen.lease_expires = (time.monotonic()
+                                        + self._config.lease_ttl_s)
+                if self._trace is not None:
+                    self._trace.instant('service/lease_grant',
+                                        split=chosen.split_id,
+                                        worker=worker_id,
+                                        attempt=chosen.attempt)
+                return {'split': chosen.describe(),
+                        'ttl': self._config.lease_ttl_s}
+            if all(s.state in (_DONE, _FAILED) for s in self._splits):
+                return {'done': True}
+            return {'wait': True}
+
+    def _op_complete(self, request):
+        worker_id, split_id = request['worker_id'], request['split_id']
+        with self._lock:
+            split = self._splits[split_id]
+            if split.state == _DONE:
+                return {'ok': True}  # idempotent (e.g. duplicate delivery)
+            if split.state != _LEASED or split.worker_id != worker_id \
+                    or split.attempt != request.get('attempt', split.attempt):
+                # The lease moved on (expired + reassigned): this worker's
+                # stream either already reached the client (who deduped it)
+                # or died with the worker — either way this completion has
+                # no standing.
+                return {'ok': False}
+            split.state = _DONE
+            split.worker_id = None
+            if self._trace is not None:
+                self._trace.instant('service/split_done', split=split_id,
+                                    worker=worker_id)
+        return {'ok': True}
+
+    def _op_mark_consumed(self, request):
+        """A resuming client already holds these splits' rows (its resume
+        token committed them); retire them so no worker re-decodes.  A
+        split already streaming stays leased — the client drops the
+        duplicate, so marking here is an optimization, not a correctness
+        requirement."""
+        retired = 0
+        with self._lock:
+            for split_id in request['split_ids']:
+                split = self._splits[int(split_id)]
+                if split.state == _PENDING:
+                    split.state = _DONE
+                    retired += 1
+        return {'ok': True, 'retired': retired}
+
+    def _op_job(self, request):
+        return {'job': self._job}
+
+    def _op_workers(self, request):
+        stale = 3.0 * self._config.lease_ttl_s
+        now = time.monotonic()
+        with self._lock:
+            workers = [
+                {'worker_id': wid, 'addr': w['addr'],
+                 'alive': (now - w['last_heartbeat']) < stale}
+                for wid, w in sorted(self._workers.items())]
+            # Terminally-failed splits ride on the discovery poll so a
+            # waiting client can raise instead of hanging forever.
+            failed = sorted(s.split_id for s in self._splits
+                            if s.state == _FAILED)
+        return {'workers': workers, 'failed_splits': failed}
+
+    def _op_stats(self, request):
+        with self._lock:
+            states = collections.Counter(s.state for s in self._splits)
+            workers = {wid: dict(w['stats'],
+                                 age_s=round(time.monotonic()
+                                             - w['last_heartbeat'], 3))
+                       for wid, w in self._workers.items()}
+        return {
+            'num_splits': len(self._splits),
+            'pending': states[_PENDING],
+            'leased': states[_LEASED],
+            'done': states[_DONE],
+            'failed': states[_FAILED],
+            'lease_churn': self.lease_churn,
+            'workers': workers,
+        }
+
+    def _op_stop(self, request):
+        self._stop.set()
+        return {'ok': True}
+
+
+def _count_row_groups(dataset_url, reader_kwargs):
+    """Row-group count of the dataset — the only dataset fact the control
+    plane needs (workers re-enumerate the same footer metadata, so indices
+    agree by construction)."""
+    from petastorm_tpu.etl.dataset_metadata import load_row_groups
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+
+    fs, path_or_paths = get_filesystem_and_path_or_paths(
+        dataset_url,
+        storage_options=reader_kwargs.get('storage_options'),
+        filesystem=reader_kwargs.get('filesystem'))
+    paths = (path_or_paths if isinstance(path_or_paths, list)
+             else [path_or_paths])
+    return sum(len(load_row_groups(fs, p)) for p in paths)

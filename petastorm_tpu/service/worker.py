@@ -1,0 +1,515 @@
+"""Decode worker: leases splits, decodes them, streams batches to clients.
+
+A worker is a thin shell around the existing reader machinery: each
+leased split becomes a short-lived ``make_reader(columnar_decode=True)``
+/ ``make_batch_reader`` over exactly that split's row groups
+(``piece_indices=``), so the L2–L4 decode plane (pools, codecs, retries,
+predicates, transform specs) runs unchanged — just on a different machine
+than the accelerators.
+
+Threads:
+
+* the **event loop** owns every ZeroMQ socket: a ROUTER data socket that
+  clients subscribe to, and a REQ control socket to the dispatcher
+  (register / lease / heartbeat / complete).  Heartbeats renew all held
+  leases; losing them (process death) is the failure signal the
+  dispatcher acts on.
+* the **decode thread** turns split descriptions into serialized chunks
+  (Arrow IPC via ``reader_impl/arrow_table_serializer.py`` when the
+  chunk is a flat table, pickle otherwise — the same dual framing the
+  ProcessPool wire uses) through a bounded queue, which is what pauses
+  decode when clients stop granting credits.
+
+Delivery is credit-based: each subscriber grants a chunk budget and
+replenishes it as it pulls chunks off its socket; ``end``-of-split
+markers ride for free.  A split counts as done only after the owning
+client ACKS the complete split — only then does the worker report
+``complete`` to the dispatcher.  A worker killed at ANY point before the
+ack therefore leaves the split leased, the lease expires, and the split
+is reassigned: at-least-once streaming, which the client's whole-split
+dedupe turns into exactly-once delivery.
+"""
+
+import logging
+import pickle
+import queue
+import threading
+import time
+import traceback
+
+import numpy as np
+
+from petastorm_tpu.errors import ServiceError, ServiceRpcTimeoutError
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_RPC_TIMEOUT_S = 20.0
+
+
+class _Rpc(object):
+    """REQ-socket RPC client with timeout + socket recycling.
+
+    A REQ socket wedges in send-state when a reply never comes; on
+    timeout the socket is rebuilt so the caller can simply retry."""
+
+    def __init__(self, context, addr, timeout_s=_DEFAULT_RPC_TIMEOUT_S):
+        import zmq
+        self._zmq = zmq
+        self._context = context
+        self._addr = addr
+        self._timeout_s = timeout_s
+        self._socket = None
+        self._connect()
+
+    def _connect(self):
+        self._socket = self._context.socket(self._zmq.REQ)
+        self._socket.setsockopt(self._zmq.LINGER, 0)
+        self._socket.connect(self._addr)
+
+    def call(self, request, timeout_s=None):
+        from petastorm_tpu.errors import ServiceError
+        timeout_s = self._timeout_s if timeout_s is None else timeout_s
+        self._socket.send(pickle.dumps(request, protocol=4))
+        if not self._socket.poll(int(timeout_s * 1000)):
+            self._socket.close(0)
+            self._connect()
+            raise ServiceRpcTimeoutError(
+                'no reply from %s to %r within %.1fs'
+                % (self._addr, request.get('op'), timeout_s))
+        reply = pickle.loads(self._socket.recv())
+        if isinstance(reply, dict) and reply.get('error'):
+            raise ServiceError('%s rejected %r: %s'
+                               % (self._addr, request.get('op'),
+                                  reply['error']))
+        return reply
+
+    def close(self):
+        if self._socket is not None:
+            self._socket.close(0)
+            self._socket = None
+
+
+def serialize_chunk(chunk):
+    """dict-of-arrays -> (tag, bytes): Arrow IPC for flat tables (the
+    zero-copy-able format every Arrow consumer can read), pickle for
+    multi-dim/ragged columns Arrow tables can't hold losslessly."""
+    import pyarrow as pa
+
+    from petastorm_tpu.reader_impl.arrow_table_serializer import \
+        ArrowTableSerializer
+
+    flat = all(isinstance(v, np.ndarray) and v.ndim == 1
+               and v.dtype != np.dtype(object) for v in chunk.values())
+    if flat:
+        try:
+            table = pa.table({k: pa.array(v) for k, v in chunk.items()})
+            return b'A', ArrowTableSerializer().serialize(table).to_pybytes()
+        except pa.ArrowInvalid:
+            pass
+    return b'R', pickle.dumps(chunk, protocol=4)
+
+
+def deserialize_chunk(tag, payload):
+    """Inverse of :func:`serialize_chunk`; always returns dict-of-numpy."""
+    from petastorm_tpu.reader_impl.arrow_table_serializer import \
+        ArrowTableSerializer
+
+    if tag == b'A':
+        table = ArrowTableSerializer().deserialize(payload)
+        return {name: table.column(name).to_numpy(zero_copy_only=False)
+                for name in table.column_names}
+    return pickle.loads(payload)
+
+
+class Worker(object):
+    """One decode worker process/thread.
+
+    Args:
+        dispatcher_addr: the dispatcher's REP endpoint.
+        data_bind: bind spec for this worker's ROUTER data socket;
+            ``tcp://host:*`` picks a free port (the resolved address is
+            advertised to the dispatcher, so clients can connect).
+        advertise_host: hostname/IP published to the dispatcher in place
+            of the bind host.  Required in spirit whenever ``data_bind``
+            uses a wildcard host: ``tcp://0.0.0.0:PORT`` is unroutable
+            from other machines, so without this the worker substitutes
+            ``socket.gethostname()`` and logs what it chose.
+        max_inflight_splits / max_buffered_chunks: see ``ServiceConfig``.
+        trace_recorder: optional ``benchmark.TraceRecorder`` — each
+            decoded split is recorded as a ``service/decode_split`` span.
+    """
+
+    def __init__(self, dispatcher_addr, data_bind='tcp://127.0.0.1:*',
+                 advertise_host=None, max_inflight_splits=3,
+                 max_buffered_chunks=32, trace_recorder=None):
+        self._dispatcher_addr = dispatcher_addr
+        self._data_bind = data_bind
+        self._advertise_host = advertise_host
+        self._max_inflight = int(max_inflight_splits)
+        self._max_buffered = int(max_buffered_chunks)
+        self._trace = trace_recorder
+        self._stop = threading.Event()
+        self._thread = None
+        self._reader_factory = None
+        self._rows_decoded = 0
+        self._splits_decoded = 0
+        self._t_start = None
+        self._decode_out = None
+        self.worker_id = None
+        self.data_addr = None
+        self._ready = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Run the worker in a daemon thread (in-process deployments:
+        tests, the bench's service leg).  The CLI calls :meth:`run`."""
+        self._thread = threading.Thread(target=self.run,
+                                        name='service-worker', daemon=True)
+        self._thread.start()
+        # _ready is also set on an early run() failure (so start() never
+        # hangs); a set event with no worker_id means registration failed.
+        if not self._ready.wait(timeout=30) or self.worker_id is None:
+            raise RuntimeError('worker failed to register with %r'
+                               % (self._dispatcher_addr,))
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def join(self):
+        if self._thread is not None:
+            self._thread.join()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.stop()
+        self.join()
+
+    # -- main loop -----------------------------------------------------------
+
+    def run(self):
+        import zmq
+
+        context = zmq.Context()
+        data = context.socket(zmq.ROUTER)
+        data.setsockopt(zmq.LINGER, 0)
+        data.set_hwm(0)  # credits bound in-flight data, not the HWM
+        if self._data_bind.startswith('tcp') and (
+                self._data_bind.endswith(':*')
+                or self._data_bind.endswith(':0')):
+            base = self._data_bind.rsplit(':', 1)[0]
+            port = data.bind_to_random_port(base)
+            self.data_addr = '%s:%d' % (base, port)
+        else:
+            data.bind(self._data_bind)
+            self.data_addr = self._data_bind
+        self.data_addr = self._advertised(self.data_addr)
+        rpc = _Rpc(context, self._dispatcher_addr)
+        decode_in = queue.Queue()
+        decode_out = queue.Queue(maxsize=self._max_buffered)
+        self._decode_out = decode_out
+        decode_thread = None
+        try:
+            reply = rpc.call({'op': 'register_worker',
+                              'data_addr': self.data_addr})
+            self.worker_id = reply['worker_id']
+            job = reply['job']
+            self._t_start = time.monotonic()
+            self._ready.set()
+            decode_thread = threading.Thread(
+                target=self._decode_loop, args=(job, decode_in, decode_out),
+                name='service-worker-decode', daemon=True)
+            decode_thread.start()
+            self._event_loop(zmq, data, rpc, job, decode_in, decode_out)
+        finally:
+            self._ready.set()  # unblock start() on early failure
+            decode_in.put(None)
+            if decode_thread is not None:
+                # Unstick a decode blocked on the bounded output queue.
+                while decode_thread.is_alive():
+                    try:
+                        decode_out.get_nowait()
+                    except queue.Empty:
+                        decode_thread.join(timeout=0.05)
+            rpc.close()
+            data.close(0)
+            context.term()
+
+    def _advertised(self, addr):
+        """The address published to the dispatcher: clients on OTHER
+        machines connect to it, so a wildcard bind host must be replaced
+        with something routable."""
+        scheme, rest = addr.split('://', 1)
+        host, port = rest.rsplit(':', 1)
+        if self._advertise_host is not None:
+            host = self._advertise_host
+        elif host in ('0.0.0.0', '*', '::'):
+            import socket
+            host = socket.gethostname()
+            logger.warning(
+                'data_bind host %r is unroutable from other machines; '
+                'advertising %r instead (pass advertise_host/'
+                '--advertise-host to override)', '0.0.0.0', host)
+        return '%s://%s:%s' % (scheme, host, port)
+
+    def _event_loop(self, zmq, data, rpc, job, decode_in, decode_out):
+        heartbeat_every = max(0.2, job['lease_ttl_s'] / 3.0)
+        last_heartbeat = 0.0
+        next_lease_probe = 0.0
+        subscribers = {}      # consumer -> identity
+        credits = {}          # identity -> remaining chunk budget
+        sendq = {}            # consumer -> deque of (header, payload|None)
+        inflight = {}         # split_id -> split description
+        awaiting_ack = {}     # (split_id, attempt) -> split description
+        ack_deadline = {}     # (split_id, attempt) -> monotonic deadline
+        ack_timeout = 3.0 * job['lease_ttl_s']
+        decoding = set()      # split ids queued/being decoded
+
+        def replay(key):
+            """Re-decode a streamed-but-never-acked split: its frames went
+            to an identity that is gone (client restart) or the ack was
+            lost; without this it would sit in inflight forever, its lease
+            renewing on every heartbeat."""
+            split = awaiting_ack.pop(key, None)
+            ack_deadline.pop(key, None)
+            if split is not None and split['split_id'] not in decoding:
+                decoding.add(split['split_id'])
+                decode_in.put(split)
+        poller = zmq.Poller()
+        poller.register(data, zmq.POLLIN)
+        from collections import deque
+
+        while not self._stop.is_set():
+            now = time.monotonic()
+            # 1. client control messages (subscribe / credit / ack)
+            if dict(poller.poll(20)):
+                while True:
+                    try:
+                        identity, raw = data.recv_multipart(zmq.NOBLOCK)
+                    except zmq.Again:
+                        break
+                    msg = pickle.loads(raw)
+                    kind = msg.get('type')
+                    if kind == 'subscribe':
+                        consumer = int(msg['consumer'])
+                        previous = subscribers.get(consumer)
+                        if previous is not None and previous != identity:
+                            # The consumer reconnected under a new ZMQ
+                            # identity: anything streamed to the old one
+                            # (including 'end' markers) is gone — replay
+                            # its un-acked splits to the new identity.
+                            credits.pop(previous, None)
+                            for key in [k for k, s in awaiting_ack.items()
+                                        if s['consumer'] == consumer]:
+                                replay(key)
+                        subscribers[consumer] = identity
+                        credits[identity] = int(msg.get('credits', 8))
+                    elif kind == 'credit':
+                        if identity in credits:
+                            credits[identity] += int(msg.get('n', 1))
+                    elif kind == 'ack':
+                        key = (int(msg['split']), int(msg['attempt']))
+                        split = awaiting_ack.pop(key, None)
+                        ack_deadline.pop(key, None)
+                        if split is not None:
+                            inflight.pop(split['split_id'], None)
+                            try:
+                                rpc.call({'op': 'complete',
+                                          'worker_id': self.worker_id,
+                                          'split_id': split['split_id'],
+                                          'attempt': split['attempt']})
+                            except ServiceError as e:
+                                logger.warning('complete(%d) RPC failed: %s',
+                                               split['split_id'], e)
+                    elif kind == 'resend':
+                        # The client lost chunks of this stream and
+                        # discarded its partial buffer: decode + stream the
+                        # split again.  It stays in inflight, so the lease
+                        # keeps renewing.
+                        replay((int(msg['split']), int(msg['attempt'])))
+            # 2. move decoded chunks into per-consumer send queues — but
+            # only while fewer than max_buffered_chunks wait for credits:
+            # leaving the rest in the bounded decode_out queue is what
+            # pauses _decode_loop when consumers are slow or absent.
+            while sum(len(q) for q in sendq.values()) < self._max_buffered:
+                try:
+                    item = decode_out.get_nowait()
+                except queue.Empty:
+                    break
+                kind, split = item[0], item[1]
+                consumer = split['consumer']
+                if kind == 'chunk':
+                    _, _, seq, tag, payload = item
+                    header = {'type': 'chunk', 'split': split['split_id'],
+                              'attempt': split['attempt'], 'seq': seq,
+                              'tag': tag}
+                    sendq.setdefault(consumer, deque()).append(
+                        (header, payload))
+                elif kind == 'end':
+                    _, _, nchunks, nrows = item
+                    decoding.discard(split['split_id'])
+                    header = {'type': 'end', 'split': split['split_id'],
+                              'attempt': split['attempt'],
+                              'chunks': nchunks, 'rows': nrows}
+                    sendq.setdefault(consumer, deque()).append((header, None))
+                    key = (split['split_id'], split['attempt'])
+                    awaiting_ack[key] = split
+                    ack_deadline[key] = time.monotonic() + ack_timeout
+                else:  # decode error: log, drop — the lease will expire
+                    decoding.discard(split['split_id'])
+                    inflight.pop(split['split_id'], None)
+                    logger.error('decode of split %d failed:\n%s',
+                                 split['split_id'], item[2])
+            # 3. flush send queues under credit control
+            for consumer, q in sendq.items():
+                identity = subscribers.get(consumer)
+                if identity is None:
+                    continue
+                while q:
+                    header, payload = q[0]
+                    if header['type'] == 'chunk':
+                        if credits.get(identity, 0) < 1:
+                            break
+                        credits[identity] -= 1
+                        data.send_multipart(
+                            [identity, pickle.dumps(header, protocol=4),
+                             payload])
+                    else:
+                        data.send_multipart(
+                            [identity, pickle.dumps(header, protocol=4)])
+                    q.popleft()
+            # 3b. acks that never came (lost to a vanished identity with no
+            # re-subscribe): replay to the current subscriber rather than
+            # holding the split — and its lease — forever.
+            if ack_deadline:
+                for key in [k for k, d in ack_deadline.items() if now > d]:
+                    split = awaiting_ack.get(key)
+                    if split is None or \
+                            subscribers.get(split['consumer']) is None:
+                        # no subscriber to replay to: push the deadline out
+                        # instead of spinning on decode
+                        ack_deadline[key] = now + ack_timeout
+                        continue
+                    logger.warning('split %d attempt %d un-acked for %.0fs; '
+                                   'replaying', key[0], key[1], ack_timeout)
+                    replay(key)
+            # 4. heartbeat (renews the leases this worker still claims)
+            if now - last_heartbeat >= heartbeat_every:
+                try:
+                    rpc.call({'op': 'heartbeat', 'worker_id': self.worker_id,
+                              'stats': self.diagnostics,
+                              'held': list(inflight)})
+                except ServiceRpcTimeoutError:
+                    logger.warning('heartbeat to %s timed out',
+                                   self._dispatcher_addr)
+                except ServiceError:
+                    # The dispatcher lost our registration (restart):
+                    # re-register under a fresh id rather than dying.
+                    try:
+                        reply = rpc.call({'op': 'register_worker',
+                                          'data_addr': self.data_addr})
+                        logger.warning('re-registered with %s as %s (was %s)',
+                                       self._dispatcher_addr,
+                                       reply['worker_id'], self.worker_id)
+                        self.worker_id = reply['worker_id']
+                    except ServiceError:  # incl. timeout; retry next beat
+                        pass
+                last_heartbeat = now  # retry next interval, don't spin
+            # 5. lease more work — only for consumers with a live
+            # subscriber here, so an absent training host's splits don't
+            # occupy this worker's decode plane and send buffer.
+            if subscribers and len(inflight) < self._max_inflight \
+                    and now >= next_lease_probe:
+                try:
+                    reply = rpc.call({'op': 'lease',
+                                      'worker_id': self.worker_id,
+                                      'consumers': sorted(subscribers)})
+                except ServiceError:  # timeout or not-yet-re-registered
+                    reply = {'wait': True}
+                if reply.get('split'):
+                    split = reply['split']
+                    inflight[split['split_id']] = split
+                    decoding.add(split['split_id'])
+                    decode_in.put(split)
+                else:
+                    # nothing assignable right now (all leased or all done)
+                    next_lease_probe = now + min(
+                        1.0, max(0.05, job['lease_ttl_s'] / 10.0))
+
+    # -- decode --------------------------------------------------------------
+
+    def _resolve_factory(self, job):
+        """'auto': petastorm metadata -> codec reader (columnar output),
+        plain Parquet -> batch reader.  Resolved once per worker."""
+        from petastorm_tpu.errors import MetadataError
+        from petastorm_tpu.reader import make_batch_reader, make_reader
+
+        def codec_reader(url, **kwargs):
+            return make_reader(url, columnar_decode=True, **kwargs)
+
+        choice = job['reader_factory']
+        if choice == 'reader':
+            return codec_reader
+        if choice == 'batch_reader':
+            return make_batch_reader
+        try:
+            reader = codec_reader(job['dataset_url'], num_epochs=1,
+                                  piece_indices=[0], shuffle_row_groups=False,
+                                  **job['reader_kwargs'])
+            reader.stop()
+            reader.join()
+            return codec_reader
+        except MetadataError:
+            return make_batch_reader
+
+    def _decode_loop(self, job, decode_in, decode_out):
+        while True:
+            split = decode_in.get()
+            if split is None:
+                return
+            t0 = time.monotonic()
+            try:
+                if self._reader_factory is None:
+                    self._reader_factory = self._resolve_factory(job)
+                reader = self._reader_factory(
+                    job['dataset_url'], piece_indices=split['indices'],
+                    num_epochs=1, shuffle_row_groups=False,
+                    **job['reader_kwargs'])
+                seq = 0
+                rows = 0
+                with reader:
+                    for item in reader:
+                        chunk = (item._asdict() if hasattr(item, '_asdict')
+                                 else dict(item))
+                        tag, payload = serialize_chunk(chunk)
+                        rows += len(next(iter(chunk.values())))
+                        decode_out.put(('chunk', split, seq, tag, payload))
+                        seq += 1
+                decode_out.put(('end', split, seq, rows))
+                self._rows_decoded += rows
+                self._splits_decoded += 1
+                if self._trace is not None:
+                    self._trace.event('service/decode_split', t0,
+                                      time.monotonic(),
+                                      split=split['split_id'], rows=rows)
+            except Exception:  # noqa: BLE001 — shipped to the event loop
+                decode_out.put(('error', split, traceback.format_exc()))
+
+    # -- metrics -------------------------------------------------------------
+
+    @property
+    def diagnostics(self):
+        """Per-worker metrics, also shipped to the dispatcher on every
+        heartbeat (``stats`` RPC surfaces them fleet-wide)."""
+        elapsed = (time.monotonic() - self._t_start) if self._t_start else 0.0
+        return {
+            'rows_decoded': int(self._rows_decoded),
+            'splits_decoded': int(self._splits_decoded),
+            'rows_per_s': round(self._rows_decoded / elapsed, 1)
+                          if elapsed > 0 else 0.0,
+            'queue_depth': (self._decode_out.qsize()
+                            if self._decode_out is not None else 0),
+        }

@@ -75,35 +75,47 @@ class ThreadPool(object):
                 continue
 
     def _worker_loop(self, worker):
-        while not self._stop_event.is_set():
-            try:
-                item = self._input_queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            if item is _SENTINEL:
-                break
-            args, kwargs = item
-            position = None
-            if len(args) == 1 and isinstance(args[0], VentilatedItem):
-                position, args = args[0].position, tuple(args[0].args)
-            started = time.monotonic()
-            sleep_before = getattr(worker, 'retry_sleep_s', 0.0)
-            try:
-                worker.process(*args, **kwargs)
-            except Exception as e:  # noqa: BLE001 — travels to the caller
-                import traceback
-                self._results_queue.put(_WorkerError(e, traceback.format_exc()))
-            finally:
-                # Retry-backoff sleeps are waiting, not decoding — excluding
-                # them keeps decode_utilization an honest decode-work measure.
-                slept = getattr(worker, 'retry_sleep_s', 0.0) - sleep_before
-                elapsed = max(0.0, time.monotonic() - started - slept)
-                with self._inflight_lock:
-                    self._inflight -= 1
-                    self.items_processed += 1
-                    self.busy_time += elapsed
-                if self._ventilator is not None:
-                    self._ventilator.processed_item(position)
+        try:
+            while not self._stop_event.is_set():
+                try:
+                    item = self._input_queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if item is _SENTINEL:
+                    break
+                args, kwargs = item
+                position = None
+                if len(args) == 1 and isinstance(args[0], VentilatedItem):
+                    position, args = args[0].position, tuple(args[0].args)
+                started = time.monotonic()
+                sleep_before = getattr(worker, 'retry_sleep_s', 0.0)
+                try:
+                    worker.process(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001 — travels to the caller
+                    import traceback
+                    # Same stop-responsive put as results: a bare put on the
+                    # bounded queue could block forever during teardown and
+                    # keep this thread (and its worker's files) alive.
+                    self._publish(_WorkerError(e, traceback.format_exc()))
+                finally:
+                    # Retry-backoff sleeps are waiting, not decoding —
+                    # excluding them keeps decode_utilization an honest
+                    # decode-work measure.
+                    slept = getattr(worker, 'retry_sleep_s', 0.0) - sleep_before
+                    elapsed = max(0.0, time.monotonic() - started - slept)
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                        self.items_processed += 1
+                        self.busy_time += elapsed
+                    if self._ventilator is not None:
+                        self._ventilator.processed_item(position)
+        finally:
+            # The owning thread closes its own worker's files: shutdown from
+            # any other thread (stop() used to do it) can close an
+            # mmap-backed ParquetFile while process() is still inside a
+            # native pyarrow read on it — a use-after-unmap segfault, not an
+            # exception.
+            worker.shutdown()
 
     def get_results(self, timeout=DEFAULT_TIMEOUT_S):
         """Next result; EmptyResultError when all work is drained.
@@ -143,12 +155,14 @@ class ThreadPool(object):
         self._stop_event.set()
         for _ in self._threads:
             self._input_queue.put(_SENTINEL)
-        for worker in self._workers:
-            worker.shutdown()
+        # Workers shut themselves down as their threads exit (_worker_loop's
+        # finally) — closing their files here would race in-flight reads.
 
     def join(self):
         for thread in self._threads:
             thread.join()
+        for worker in self._workers:
+            worker.shutdown()  # idempotent; covers never-started threads
 
     @property
     def results_qsize(self):

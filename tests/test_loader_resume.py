@@ -655,6 +655,42 @@ def test_device_inmem_scan_epochs_rejects_geometry_changed_token(dataset):
                                     donate_carry=False))
 
 
+def test_device_inmem_scan_epochs_ragged_cursor_honors_token_drop_last(
+        dataset):
+    """A cursor AT the full-batch count is only reachable by a
+    drop_last=False per-step pass; the token records which run took it.
+    A drop_last=True token parked there means the geometry changed and
+    must raise, while the drop_last=False twin resumes at the next epoch
+    (ADVICE r05 item 1)."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    steps_per_epoch = ROWS // BATCH
+    assert ROWS % BATCH, 'test needs a ragged tail'
+
+    def build(token_drop_last):
+        reader = make_reader(dataset.url, reader_pool_type='dummy',
+                             shuffle_row_groups=False, num_epochs=1)
+        token = {'version': 1,
+                 'device_inmem': {'epochs_done': 0,
+                                  'steps_into_epoch': steps_per_epoch,
+                                  'batch_size': BATCH,
+                                  'drop_last': token_drop_last, 'seed': 67}}
+        return DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=2,
+                                     seed=67, deterministic_cache_order=True,
+                                     resume_state=token)
+
+    with build(token_drop_last=True) as loader:
+        with pytest.raises(ValueError, match='drop_last'):
+            next(loader.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                    donate_carry=False))
+    with build(token_drop_last=False) as loader:
+        groups = [np.asarray(ids) for _, ids in
+                  loader.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                     donate_carry=False)]
+    # the whole checkpointed epoch is behind the cursor: one epoch remains
+    assert [g.shape for g in groups] == [(steps_per_epoch, BATCH)]
+
+
 def test_device_inmem_mid_epoch_token_requires_deterministic(dataset):
     """A mid-epoch token is refused at RESUME time too when the rebuilding
     loader lacks deterministic_cache_order (the cursor would index into an
