@@ -773,28 +773,164 @@ def imagenet_host_plane_leg(epochs=4):
     return {'delivery_plane_images_per_sec_host': round(rate, 1)}
 
 
-def delivery_plane_service_leg(worker_counts=(1, 2, 4)):
+def ipc_microbench(n_batches=24):
+    """Same-host IPC result plane in isolation: bytes/s of one
+    64×224×224×3 uint8 batch stream crossing a REAL ProcessPool process
+    boundary, shm descriptors (``workers_pool/shm_plane.py``) vs the
+    serialized pickle-over-ZMQ byte path.  The consumer touches one byte
+    per 4 KiB page of every delivered batch — the cost of making the
+    bytes resident (the shm number pays its page faults there, where a
+    real consumer's first pass pays them) without a full-bandwidth read
+    that would swamp the delivery-plane difference on a
+    memory-bandwidth-bound host."""
+    from petastorm_tpu.benchmark.hostplane import IpcBenchWorker
+    from petastorm_tpu.workers_pool.process_pool import ProcessPool
+
+    shape = (BATCH, IMAGE_HW[0], IMAGE_HW[1], 3)
+    batch_bytes = int(np.prod(shape))
+    fields = {}
+    shm_used = False
+    for label, use_shm in (('shm', True), ('serialized', False)):
+        pool = ProcessPool(workers_count=1, results_queue_size=8,
+                           use_shm=use_shm)
+        pool.start(IpcBenchWorker, worker_setup_args=shape)
+        try:
+            pool.ventilate(2)  # warmup: child imports, allocator, pages
+            for _ in range(2):
+                pool.get_results()[0].ravel()[::4096].sum()
+            t0 = time.monotonic()
+            pool.ventilate(n_batches)
+            for _ in range(n_batches):
+                pool.get_results()[0].ravel()[::4096].sum()
+            dt = time.monotonic() - t0
+        finally:
+            pool.stop()
+            pool.join()
+        fields[label] = round(n_batches * batch_bytes / dt) if dt else 0
+        if use_shm and pool.shm_results:
+            shm_used = True
+    fields['ratio'] = (round(fields['shm'] / fields['serialized'], 2)
+                       if fields.get('serialized') else None)
+    if not shm_used:
+        fields['note'] = 'shm plane unavailable: both legs ran serialized'
+    return {'ipc_bytes_per_s': fields}
+
+
+def processpool_host_plane_leg(seconds=6.0):
+    """ProcessPool host delivery plane, shm result plane ON vs OFF: host
+    images/s of the streaming loader over pre-decoded uint8 parquet with
+    ``reader_pool_type='process'`` — every decoded batch crosses the
+    child→parent boundary, so the delta between the two fields is exactly
+    what the shm descriptors buy on a real pipeline (the thread-pool twin
+    of this leg is ``delivery_plane_images_per_sec_host``)."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.benchmark.hostplane import pump_host_batches
+    from petastorm_tpu.jax import DataLoader
+
+    ensure_raw_dataset()
+    fields = {}
+    shm_used = False
+    for label, no_shm in (('shm', None), ('bytes', '1')):
+        # The 'shm' variant leaves the environment alone: an operator's
+        # PETASTORM_TPU_NO_SHM=1 (the documented kill switch) must win,
+        # in which case both variants run serialized and the note below
+        # says so.  Only the 'bytes' variant forces the flag.
+        prev = os.environ.get('PETASTORM_TPU_NO_SHM')
+        if no_shm:
+            os.environ['PETASTORM_TPU_NO_SHM'] = no_shm
+        try:
+            with make_reader(RAW_DATASET_URL, num_epochs=None,
+                             reader_pool_type='process',
+                             workers_count=min(4, WORKERS),
+                             shuffle_row_groups=False,
+                             columnar_decode=True) as reader:
+                loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+                rows, dt = pump_host_batches(loader, seconds,
+                                             warmup_batches=2)
+                if label == 'shm' and reader.diagnostics['shm_results']:
+                    shm_used = True
+            fields['delivery_plane_processpool_images_per_sec_host_%s'
+                   % label] = round(rows / dt, 1)
+        finally:
+            if no_shm:
+                if prev is not None:
+                    os.environ['PETASTORM_TPU_NO_SHM'] = prev
+                else:
+                    os.environ.pop('PETASTORM_TPU_NO_SHM', None)
+    if not shm_used:
+        # Never present a bytes-vs-bytes ~1.0x as a real shm measurement.
+        fields['delivery_plane_processpool_note'] = \
+            'shm plane unavailable: both variants ran serialized'
+    return fields
+
+
+SVC_ROWS = int(os.environ.get('PETASTORM_TPU_BENCH_SVC_ROWS', '2048'))
+# Row count in the path: changing PETASTORM_TPU_BENCH_SVC_ROWS must build
+# a matching dataset, not silently reuse the cached default-size one.
+SVC_DATASET_URL = 'file://%s/imagenet_raw_svc_v1_r%d' % (BENCH_DIR, SVC_ROWS)
+
+
+def ensure_raw_svc_dataset():
+    """A larger pre-decoded uint8 dataset (default 2048 rows -> 32 host
+    batches) for the service-plane legs: at the base dataset's 768 rows
+    the whole exactly-once stream is ~12 batches, and the measurement
+    window times lease fill + slab first-touch instead of steady-state
+    delivery."""
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    fs, path = get_filesystem_and_path_or_paths(SVC_DATASET_URL)
+    if fs.exists(path + '/_common_metadata'):
+        return
+
+    schema = Unischema('ImagenetRawSvc', [
+        UnischemaField('noun_id', np.int64, (), None, False),
+        UnischemaField('image', np.uint8, (IMAGE_HW[0], IMAGE_HW[1], 3),
+                       NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(0)
+
+    def rows():
+        for i in range(SVC_ROWS):
+            yield {'noun_id': np.int64(i),
+                   'image': rng.integers(0, 256, (IMAGE_HW[0], IMAGE_HW[1], 3),
+                                         np.uint8)}
+
+    with DatasetWriter(SVC_DATASET_URL, schema, rows_per_rowgroup=64,
+                       compression='none') as w:
+        w.write_many(rows())
+
+
+def delivery_plane_service_leg(worker_counts=(1, 2, 4), shm_pairs=3):
     """Disaggregated delivery plane (``petastorm_tpu/service``): host
     images/s of ONE consumer fed by N in-process decode workers over the
-    pre-decoded uint8 dataset, at N = 1 -> 2 -> 4.  The horizontal-scaling
-    answer to the delivery-bound regime r05 measured
+    pre-decoded uint8 service dataset, at N = 1 -> 2 -> 4.  The
+    horizontal-scaling answer to the delivery-bound regime r05 measured
     (``stall_pct_delivery_bound`` ~95%: one host's decode/collate plane
     can't feed the chip) — the slope across worker counts is the evidence
     that the decode plane now scales independently of the training host.
     Backend-independent (no device in the loop); in-process workers, so
     this measures the service machinery (lease protocol, ZMQ streaming,
-    credit flow, client reassembly), not extra silicon."""
+    credit flow, client reassembly), not extra silicon.
+
+    The w1 number is measured as ``shm_pairs`` interleaved pairs against
+    its byte-path twin (``ServiceConfig(shm=False)`` ->
+    ``..._w1_bytes``), medians reported — the same adjacent-runs
+    discipline the headline img/s uses, because single service runs on a
+    shared 1-core host swing 2-3x with transient load."""
     from petastorm_tpu.service import (Dispatcher, ServiceConfig,
                                        ServiceDataLoader, Worker)
 
-    ensure_raw_dataset()
+    ensure_raw_svc_dataset()
     fields = {}
     # Split the fixed decode-thread budget across the worker fleet so a
     # bigger fleet wins on service-plane parallelism, not on extra threads.
-    for n_workers in worker_counts:
+    def measure(n_workers, shm=True):
         config = ServiceConfig(
-            RAW_DATASET_URL, num_consumers=1, rowgroups_per_split=2,
-            lease_ttl_s=30.0,
+            SVC_DATASET_URL, num_consumers=1, rowgroups_per_split=2,
+            lease_ttl_s=30.0, shm=shm,
             reader_kwargs={'workers_count':
                            max(2, WORKERS // max(n_workers, 1))})
         with Dispatcher(config) as dispatcher:
@@ -805,8 +941,10 @@ def delivery_plane_service_leg(worker_counts=(1, 2, 4)):
                                            consumer=0, drop_last=False,
                                            prefetch=2)
                 n_host = 0
-                warmup_batches = 2  # worker registration + first leases
-                t0 = t_end = None   # are not steady-state; exclude them
+                # Worker registration, first leases, and (shm) slab
+                # first-touch faults are not steady-state; exclude them.
+                warmup_batches = 6
+                t0 = t_end = None
                 with loader:
                     for i, batch in enumerate(loader.iter_host_batches()):
                         if i == warmup_batches:
@@ -827,12 +965,43 @@ def delivery_plane_service_leg(worker_counts=(1, 2, 4)):
                     w.stop()
                 for w in workers:
                     w.join()
+        return rate, churn
+
+    # w1 + its byte-path twin (ServiceConfig(shm=False)): interleaved
+    # pairs, medians — the service-plane view of what the shm result
+    # plane buys (vs the serialized TCP framing every cross-host client
+    # pays), measured under the same transient host conditions.
+    shm_rates, byte_rates = [], []
+    churn = 0
+    for _ in range(max(1, int(shm_pairs))):
+        rate, pair_churn = measure(1)
+        shm_rates.append(rate)
+        churn += pair_churn
+        byte_rates.append(measure(1, shm=False)[0])
+    fields['delivery_plane_service_images_per_sec_host_w1'] = \
+        round(float(np.median(shm_rates)), 1)
+    fields['delivery_plane_service_images_per_sec_host_w1_bytes'] = \
+        round(float(np.median(byte_rates)), 1)
+    if churn:
+        fields['delivery_plane_service_lease_churn_w1'] = churn
+    for n_workers in [n for n in worker_counts if n != 1]:
+        rate, churn = measure(n_workers)
         fields['delivery_plane_service_images_per_sec_host_w%d'
                % n_workers] = round(rate, 1)
         if churn:
             fields['delivery_plane_service_lease_churn_w%d'
                    % n_workers] = churn
     return fields
+
+
+#: Host-only IPC-plane legs (the shm result plane's evidence set), wired
+#: identically into the cpu-fallback and on-chip paths of main() — one
+#: table so the two paths cannot drift apart.
+_IPC_PLANE_LEGS = (
+    ('ipc', ipc_microbench),
+    ('processpool_plane', processpool_host_plane_leg),
+    ('delivery_plane_service', delivery_plane_service_leg),
+)
 
 
 def dlrm_host_plane_leg(seconds=6.0):
@@ -1070,9 +1239,13 @@ _COMPACT_KEYS = (
     'streaming_scan_floor_stall_pct', 'transport_bound', 'device_step_ms',
     'step_dtype', 'model_tflops_per_s', 'device_peak_tflops_bf16',
     'mfu_pct', 'delivery_plane_images_per_sec_host',
+    'delivery_plane_processpool_images_per_sec_host_shm',
+    'delivery_plane_processpool_images_per_sec_host_bytes',
     'delivery_plane_service_images_per_sec_host_w1',
+    'delivery_plane_service_images_per_sec_host_w1_bytes',
     'delivery_plane_service_images_per_sec_host_w2',
-    'delivery_plane_service_images_per_sec_host_w4', 'h2d_bytes_per_s',
+    'delivery_plane_service_images_per_sec_host_w4',
+    'ipc_bytes_per_s', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',
     'error',
@@ -1521,8 +1694,7 @@ def main():
         # partial merges _PARTIAL_BASE + _PARTIAL only.
         for leg_name, leg_fn in (
                 ('host_plane', imagenet_host_plane_leg),
-                ('dlrm_host', dlrm_host_plane_leg),
-                ('delivery_plane_service', delivery_plane_service_leg)):
+                ('dlrm_host', dlrm_host_plane_leg)) + _IPC_PLANE_LEGS:
             if _budget_left_s() <= 300:
                 break
             try:
@@ -1621,17 +1793,20 @@ def main():
         except Exception as e:  # noqa: BLE001 — must not cost the artifact
             result['dlrm_host_error'] = '%s: %s' % (type(e).__name__,
                                                     str(e)[:160])
-    # Disaggregated delivery plane (worker counts 1 -> 2 -> 4) — host-only
-    # like the leg above, and the direct countermeasure evidence for the
-    # delivery-bound regime this round targets.
-    if _budget_left_s() > 300:
+    # Host-only IPC-plane legs: the shm-vs-bytes microbench, the
+    # ProcessPool twin of the host plane, and the disaggregated delivery
+    # plane (worker counts 1 -> 2 -> 4, plus the w1 byte-path twin) —
+    # the shm result plane's evidence set.
+    for leg_name, leg_fn in _IPC_PLANE_LEGS:
+        if _budget_left_s() <= 300:
+            break
         try:
-            svc_leg = delivery_plane_service_leg()
-            result.update(svc_leg)
-            _PARTIAL.update(svc_leg)
+            host_leg = leg_fn()
+            result.update(host_leg)
+            _PARTIAL.update(host_leg)
         except Exception as e:  # noqa: BLE001 — must not cost the artifact
-            result['delivery_plane_service_error'] = \
-                '%s: %s' % (type(e).__name__, str(e)[:160])
+            result[leg_name + '_error'] = '%s: %s' % (type(e).__name__,
+                                                      str(e)[:160])
     _certify_into(result,
                   'tpu (Mosaic)' if jax.default_backend() == 'tpu'
                   else jax.default_backend() + ' (Pallas interpreter)',

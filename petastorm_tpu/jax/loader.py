@@ -1088,16 +1088,16 @@ class DeviceInMemDataLoader(InMemDataLoader):
         boundaries — ``scan_epochs`` never exposes an intra-dispatch
         cursor (the whole group is one XLA execution).
 
-        **Shape foot-gun with** ``epochs_per_call > 1``: the resume-tail
-        yield is a single partial epoch, so its ``outs`` has shape
-        ``(steps - start_step, ...)`` — NO leading epochs axis — while
-        every subsequent yield is ``(E, steps, ...)``.  Consumers that
-        index ``outs`` by epoch must special-case the first yield after a
-        mid-epoch resume (e.g. treat ``outs.ndim`` relative to a probe of
-        ``out``'s per-step shape, or reshape the tail to
-        ``(1, steps - start_step, ...)`` themselves).  Trailing partial
-        epoch groups keep the ``(E, steps, ...)`` shape with a smaller
-        ``E``; only the resume tail drops the axis.
+        **Shapes under** ``epochs_per_call > 1`` are uniform: EVERY yield
+        carries the leading epochs axis.  Full groups are
+        ``(E, steps, ...)``, a trailing partial group is the same shape
+        with a smaller ``E``, and the resume-tail yield (one partial
+        epoch) is ``(1, steps - start_step, ...)`` — consumers indexing
+        ``outs`` by epoch need no special case.  (Earlier versions
+        yielded the resume tail WITHOUT the epochs axis — ADVICE r05 #2's
+        shape foot-gun.)  With ``epochs_per_call == 1`` no yield has an
+        epochs axis: full epochs are ``(steps, ...)`` and the resume tail
+        ``(steps - start_step, ...)``.
         """
         import itertools
 
@@ -1176,6 +1176,13 @@ class DeviceInMemDataLoader(InMemDataLoader):
                                     jnp.arange(start, steps))
                 fn_tail = jax.jit(run_epoch_tail, donate_argnums=donate)
                 carry, outs = fn_tail(carry, cache, first[0])
+                if epochs_per_call > 1:
+                    # Grouped consumption: EVERY yield carries the leading
+                    # epochs axis, the resume tail included — it is one
+                    # (partial) epoch, so shape (1, steps - start, ...).
+                    # (ADVICE r05 #2: the bare tail shape was a foot-gun
+                    # for consumers indexing outs by epoch.)
+                    outs = jax.tree_util.tree_map(lambda x: x[None], outs)
                 self.stats['batches'] += steps - start
                 self._epochs_done += 1
                 yield carry, outs

@@ -3,6 +3,13 @@
 Parity: reference ``petastorm/reader_impl/arrow_table_serializer.py ::
 ArrowTableSerializer`` — zero-copy-able framing for ``pyarrow.Table``
 results crossing the ProcessPool boundary.
+
+The shm result plane (``workers_pool/shm_plane.py``) uses the same
+framing written *in place*: ``serialized_size`` sizes the stream with a
+counting pass, ``serialize_into`` IPC-writes the table's buffers
+directly into a caller-provided mapping (one copy total), and
+``deserialize`` opens a ``BufferReader`` over the mapped view — the
+table's buffers then reference the shared pages zero-copy.
 """
 
 import pyarrow as pa
@@ -14,6 +21,20 @@ class ArrowTableSerializer(object):
         with pa.ipc.new_stream(sink, table.schema) as writer:
             writer.write_table(table)
         return sink.getvalue()
+
+    def serialized_size(self, table):
+        """Exact IPC stream size via a counting (no-write) pass."""
+        sink = pa.MockOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        return sink.size()
+
+    def serialize_into(self, table, buf):
+        """IPC-write ``table`` into ``buf`` (writable buffer protocol, at
+        least ``serialized_size(table)`` bytes) — no intermediate buffer."""
+        sink = pa.FixedSizeBufferWriter(pa.py_buffer(buf))
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
 
     def deserialize(self, serialized):
         with pa.ipc.open_stream(pa.BufferReader(serialized)) as reader:

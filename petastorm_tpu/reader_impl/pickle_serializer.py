@@ -1,6 +1,11 @@
 """Pickle wire format for cross-process results.
 
-Parity: reference ``petastorm/reader_impl/pickle_serializer.py :: PickleSerializer``.
+Parity: reference ``petastorm/reader_impl/pickle_serializer.py ::
+PickleSerializer``.  The ``_oob`` pair is the shm-plane variant of the
+same framing: protocol-5 pickling with the large (numpy) buffers
+extracted out-of-band, so ``workers_pool/shm_plane.py`` can place the
+raw bytes in a shared-memory segment and the consumer can reconstruct
+zero-copy views over the mapping.
 """
 
 import pickle
@@ -9,6 +14,18 @@ import pickle
 class PickleSerializer(object):
     def serialize(self, rows):
         return pickle.dumps(rows, protocol=4)
+
+    def serialize_oob(self, rows):
+        """``(head, buffers)``: a small in-band pickle plus the raw
+        out-of-band buffers (C-contiguous array payloads)."""
+        buffers = []
+        head = pickle.dumps(rows, protocol=5, buffer_callback=buffers.append)
+        return head, [b.raw() for b in buffers]
+
+    def deserialize_oob(self, head, buffers):
+        """Inverse of :meth:`serialize_oob`; arrays reconstruct as views
+        over ``buffers`` (zero-copy when the buffers allow it)."""
+        return pickle.loads(head, buffers=buffers)
 
     def deserialize(self, serialized_rows):
         return pickle.loads(serialized_rows)

@@ -56,6 +56,8 @@ class _ServiceConnection(object):
             self._init(consumer, resume or {}, ordered, queue_splits,
                        credits)
         except Exception:
+            from petastorm_tpu.workers_pool import shm_plane
+            shm_plane.remove_probe(getattr(self, '_shm_probe', None))
             self._context.term()
             raise
 
@@ -81,6 +83,20 @@ class _ServiceConnection(object):
         self._ordered = bool(ordered)
         self._my_splits = [sid for sid in range(self.job['num_splits'])
                            if sid % self.job['num_consumers'] == self.consumer]
+        # Same-host shm delivery: create the /dev/shm probe whose
+        # visibility proves to a worker that descriptors will map here.
+        # Workers without sight of it (cross-host) keep the byte path.
+        from petastorm_tpu.workers_pool import shm_plane
+        self._shm_probe = None
+        if self.job.get('shm', True) and shm_plane.available():
+            try:
+                self._shm_probe = shm_plane.make_probe()
+            except OSError as e:
+                # e.g. /dev/shm writable but full (ENOSPC): the fallback
+                # matrix promises byte-path delivery, not a dead client.
+                logger.warning('cannot create shm probe (%s); same-host '
+                               'delivery will use the byte path', e)
+        self.shm_chunks = 0
         self.consumed = set(int(s) for s in resume.get('consumed') or ())
         unknown = self.consumed - set(self._my_splits)
         if unknown:
@@ -151,6 +167,8 @@ class _ServiceConnection(object):
     # -- receive loop --------------------------------------------------------
 
     def _recv_loop(self):
+        from petastorm_tpu.workers_pool import shm_plane
+
         zmq = self._zmq
         rpc = _Rpc(self._context, self._dispatcher_addr,
                    timeout_s=self._rpc_timeout_s)
@@ -197,7 +215,8 @@ class _ServiceConnection(object):
                         sock.connect(addr)
                         sock.send(pickle.dumps(
                             {'type': 'subscribe', 'consumer': self.consumer,
-                             'credits': self._credits}, protocol=4))
+                             'credits': self._credits,
+                             'shm_probe': self._shm_probe}, protocol=4))
                         sockets[addr] = sock
                         poller.register(sock, zmq.POLLIN)
                 for sock in dict(poller.poll(100)):
@@ -216,7 +235,32 @@ class _ServiceConnection(object):
                             sock.send(pickle.dumps({'type': 'credit', 'n': 1},
                                                    protocol=4))
                             if sid in received:
-                                continue  # duplicate stream: drop quietly
+                                # duplicate stream: drop quietly — but a
+                                # dropped shm descriptor must still return
+                                # its segment to the writer.
+                                if header['tag'] == b'S':
+                                    shm_plane.release_descriptor(
+                                        pickle.loads(frames[1]))
+                                continue
+                            if header['tag'] == b'S':
+                                # Map NOW: the arrays are zero-copy views
+                                # over the shared slab pages, and the
+                                # slab returns to the worker the moment
+                                # the last view dies (generation stamp
+                                # from a weakref.finalize).
+                                try:
+                                    chunk = shm_plane.read_payload(
+                                        pickle.loads(frames[1]))
+                                except shm_plane.SegmentVanishedError:
+                                    # Writer stopped/died before we
+                                    # attached: the chunk is lost, the
+                                    # count mismatch at 'end' requests a
+                                    # resend.
+                                    continue
+                                self.shm_chunks += 1
+                                buffers.setdefault((sid, attempt), {})[
+                                    int(header['seq'])] = ('shm', chunk)
+                                continue
                             buffers.setdefault((sid, attempt), {})[
                                 int(header['seq'])] = (header['tag'],
                                                        frames[1])
@@ -251,7 +295,8 @@ class _ServiceConnection(object):
                             sock.send(pickle.dumps(
                                 {'type': 'ack', 'split': sid,
                                  'attempt': attempt}, protocol=4))
-                            chunks = [deserialize_chunk(*parts[i])
+                            chunks = [parts[i][1] if parts[i][0] == 'shm'
+                                      else deserialize_chunk(*parts[i])
                                       for i in sorted(parts)]
                             received.add(sid)
                             remaining.discard(sid)
@@ -278,6 +323,12 @@ class _ServiceConnection(object):
             linger_ms = 0 if self._stop.is_set() else 1000
             for sock in sockets.values():
                 sock.close(linger_ms)
+            shm_plane.remove_probe(self._shm_probe)
+            # Reclaim segments whose writer was SIGKILLed with descriptors
+            # in flight (nothing else will ever unlink them); live
+            # workers' segments are untouched.
+            if self._shm_probe is not None:
+                shm_plane.sweep_orphans()
 
     def _put(self, item):
         while not self._stop.is_set():

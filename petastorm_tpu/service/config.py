@@ -55,6 +55,16 @@ class ServiceConfig:
         reader_kwargs: extra kwargs for the per-split reader (e.g.
             ``workers_count``, ``transform_spec``).  Must be picklable —
             they cross the control plane.
+        shm: allow same-host delivery over the shared-memory result plane
+            (``workers_pool/shm_plane.py``).  A client proves same-host by
+            a ``/dev/shm`` probe file named in its subscribe; chunks to
+            that consumer then travel as segment descriptors instead of
+            serialized bytes, falling back transparently per-chunk
+            (cross-host clients, full arena, small chunks, missing
+            ``/dev/shm``, or ``PETASTORM_TPU_NO_SHM=1``).
+        shm_capacity_bytes: per-worker cap on shm bytes written but not
+            yet mapped by a client; beyond it chunks degrade to the byte
+            path instead of blocking decode.
     """
 
     dataset_url: str
@@ -68,6 +78,8 @@ class ServiceConfig:
     max_inflight_splits: int = 3
     reader_factory: str = 'auto'
     reader_kwargs: dict = dataclasses.field(default_factory=dict)
+    shm: bool = True
+    shm_capacity_bytes: int = 256 << 20
 
     def __post_init__(self):
         if self.num_consumers < 1:
@@ -83,6 +95,8 @@ class ServiceConfig:
         if self.reader_factory not in ('auto', 'reader', 'batch_reader'):
             raise ValueError("reader_factory must be 'auto', 'reader' or "
                              "'batch_reader', got %r" % (self.reader_factory,))
+        if self.shm_capacity_bytes < 1:
+            raise ValueError('shm_capacity_bytes must be positive')
         if self.heartbeat_interval_s is None:
             self.heartbeat_interval_s = self.lease_ttl_s / 3.0
 
@@ -110,5 +124,7 @@ class ServiceConfig:
             'credits': int(self.credits),
             'reader_factory': self.reader_factory,
             'reader_kwargs': dict(self.reader_kwargs),
+            'shm': bool(self.shm),
+            'shm_capacity_bytes': int(self.shm_capacity_bytes),
             'fingerprint': self.fingerprint(num_splits),
         }

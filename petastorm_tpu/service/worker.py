@@ -18,7 +18,13 @@ Threads:
   (Arrow IPC via ``reader_impl/arrow_table_serializer.py`` when the
   chunk is a flat table, pickle otherwise — the same dual framing the
   ProcessPool wire uses) through a bounded queue, which is what pauses
-  decode when clients stop granting credits.
+  decode when clients stop granting credits.  Consumers that proved
+  same-host residence (a ``/dev/shm`` probe named in their subscribe —
+  see ``workers_pool/shm_plane.py``) instead get **shm descriptors**:
+  the chunk's columns are placed in a shared-memory segment and only
+  ``(segment, offset, shape, dtype)`` metadata rides the socket, with
+  transparent per-chunk fallback to the byte path (full arena, tiny
+  chunk, cross-host consumer).
 
 Delivery is credit-based: each subscriber grants a chunk budget and
 replenishes it as it pulls chunks off its socket; ``end``-of-split
@@ -90,9 +96,11 @@ class _Rpc(object):
 
 
 def serialize_chunk(chunk):
-    """dict-of-arrays -> (tag, bytes): Arrow IPC for flat tables (the
+    """dict-of-arrays -> (tag, payload): Arrow IPC for flat tables (the
     zero-copy-able format every Arrow consumer can read), pickle for
-    multi-dim/ragged columns Arrow tables can't hold losslessly."""
+    multi-dim/ragged columns Arrow tables can't hold losslessly.  The
+    Arrow payload is the ``pa.Buffer`` itself (buffer protocol — ZMQ
+    sends it without the full extra copy ``to_pybytes()`` would force)."""
     import pyarrow as pa
 
     from petastorm_tpu.reader_impl.arrow_table_serializer import \
@@ -103,7 +111,7 @@ def serialize_chunk(chunk):
     if flat:
         try:
             table = pa.table({k: pa.array(v) for k, v in chunk.items()})
-            return b'A', ArrowTableSerializer().serialize(table).to_pybytes()
+            return b'A', ArrowTableSerializer().serialize(table)
         except pa.ArrowInvalid:
             pass
     return b'R', pickle.dumps(chunk, protocol=4)
@@ -158,6 +166,14 @@ class Worker(object):
         self.worker_id = None
         self.data_addr = None
         self._ready = threading.Event()
+        #: shm result plane (None when the job or host disables it);
+        #: written only by the decode thread, stopped after it joins.
+        self._arena = None
+        #: consumer -> True when its subscribe proved same-host residence
+        #: (read by the decode thread, written by the event loop — a plain
+        #: dict is safe under the GIL for this flag traffic).
+        self._shm_consumers = {}
+        self._shm_chunks = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -217,6 +233,12 @@ class Worker(object):
                               'data_addr': self.data_addr})
             self.worker_id = reply['worker_id']
             job = reply['job']
+            from petastorm_tpu.workers_pool import shm_plane
+            if job.get('shm', True) and shm_plane.available():
+                self._arena = shm_plane.ShmArena(
+                    capacity_bytes=job.get(
+                        'shm_capacity_bytes',
+                        shm_plane.DEFAULT_CAPACITY_BYTES))
             self._t_start = time.monotonic()
             self._ready.set()
             decode_thread = threading.Thread(
@@ -234,6 +256,11 @@ class Worker(object):
                         decode_out.get_nowait()
                     except queue.Empty:
                         decode_thread.join(timeout=0.05)
+            if self._arena is not None:
+                # After the decode thread: unlink every segment no client
+                # mapped, so a clean shutdown leaves zero /dev/shm residue
+                # (descriptors dropped above go with their segments).
+                self._arena.stop()
             rpc.close()
             data.close(0)
             context.term()
@@ -307,6 +334,15 @@ class Worker(object):
                                 replay(key)
                         subscribers[consumer] = identity
                         credits[identity] = int(msg.get('credits', 8))
+                        # Same-host handshake: the client names a probe
+                        # file it created in ITS /dev/shm; seeing the file
+                        # proves shared shm (hostname checks get
+                        # containers wrong in both directions).
+                        from petastorm_tpu.workers_pool import shm_plane
+                        self._shm_consumers[consumer] = bool(
+                            self._arena is not None
+                            and shm_plane.probe_exists(
+                                msg.get('shm_probe')))
                     elif kind == 'credit':
                         if identity in credits:
                             credits[identity] += int(msg.get('n', 1))
@@ -465,6 +501,20 @@ class Worker(object):
         except MetadataError:
             return make_batch_reader
 
+    def _serialize_split_chunk(self, split, chunk):
+        """(tag, payload) for one chunk: shm descriptors (tag ``b'S'``)
+        for consumers that proved same-host residence, degrading per-chunk
+        to the byte framing (arena full, chunk under the segment-worthy
+        floor, or a cross-host consumer)."""
+        if self._arena is not None \
+                and self._shm_consumers.get(split['consumer']):
+            from petastorm_tpu.workers_pool import shm_plane
+            desc = shm_plane.write_columns(self._arena, chunk)
+            if desc is not None:
+                self._shm_chunks += 1
+                return b'S', pickle.dumps(desc, protocol=4)
+        return serialize_chunk(chunk)
+
     def _decode_loop(self, job, decode_in, decode_out):
         while True:
             split = decode_in.get()
@@ -484,7 +534,8 @@ class Worker(object):
                     for item in reader:
                         chunk = (item._asdict() if hasattr(item, '_asdict')
                                  else dict(item))
-                        tag, payload = serialize_chunk(chunk)
+                        tag, payload = self._serialize_split_chunk(split,
+                                                                   chunk)
                         rows += len(next(iter(chunk.values())))
                         decode_out.put(('chunk', split, seq, tag, payload))
                         seq += 1
@@ -512,4 +563,7 @@ class Worker(object):
                           if elapsed > 0 else 0.0,
             'queue_depth': (self._decode_out.qsize()
                             if self._decode_out is not None else 0),
+            'shm_chunks': int(self._shm_chunks),
+            'shm_degraded': (self._arena.degraded
+                             if self._arena is not None else 0),
         }

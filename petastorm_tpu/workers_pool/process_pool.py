@@ -7,6 +7,14 @@ worker processes are spawned via fresh-interpreter exec
 serialized results (pickle for row lists, Arrow IPC for tables —
 ``petastorm_tpu/reader_impl/*_serializer.py``).
 
+Result delivery defaults to the **shared-memory plane**
+(``workers_pool/shm_plane.py``) when the host supports it: workers place
+payload bytes in ``/dev/shm`` segments and ship only descriptors over the
+sink socket; the parent maps zero-copy views instead of paying the
+pickle/Arrow + ZMQ copy chain.  Small results, a full arena, or
+``PETASTORM_TPU_NO_SHM=1`` fall back to the serialized byte path
+per-message (the sink speaks both framings at all times).
+
 On TPU-VM hosts the ThreadPool is usually the better choice (pyarrow/cv2
 release the GIL; note the pool-choice guidance in SURVEY.md §7 stage 9) —
 the ProcessPool exists for parity and for transform-heavy pure-python
@@ -20,15 +28,24 @@ import uuid
 
 from petastorm_tpu.workers_pool import (DEFAULT_TIMEOUT_S, EmptyResultError,
                                         TimeoutWaitingForResultError, VentilatedItem)
+from petastorm_tpu.workers_pool import shm_plane
 from petastorm_tpu.workers_pool.exec_in_new_process import exec_in_new_process
 from petastorm_tpu.workers_pool.process_worker import worker_main
 
 
 class ProcessPool(object):
-    def __init__(self, workers_count=10, results_queue_size=50, zmq_copy_buffers=True):
+    def __init__(self, workers_count=10, results_queue_size=50, zmq_copy_buffers=True,
+                 use_shm=None, shm_capacity_bytes=None):
         self.workers_count = workers_count
         self.results_queue_size = results_queue_size
         self._zmq_copy_buffers = zmq_copy_buffers
+        #: None = auto (on when /dev/shm is usable and not disabled via
+        #: PETASTORM_TPU_NO_SHM); resolved at start() so the env toggle
+        #: works per-reader.
+        self._use_shm = use_shm
+        self._shm_capacity_bytes = shm_capacity_bytes
+        #: results that arrived as shm descriptors (vs serialized bytes)
+        self.shm_results = 0
         self._context = None
         self._work_socket = None
         self._sink_socket = None
@@ -63,10 +80,14 @@ class ProcessPool(object):
         self._sink_socket.set_hwm(self.results_queue_size)
         self._sink_socket.bind(sink_addr)
 
+        use_shm = (shm_plane.available() if self._use_shm is None
+                   else bool(self._use_shm) and shm_plane.available())
+        capacity = (self._shm_capacity_bytes
+                    or shm_plane.DEFAULT_CAPACITY_BYTES)
         try:
             setup_payload = pickle.dumps(
                 (worker_class, worker_setup_args, work_addr, sink_addr,
-                 self._zmq_copy_buffers), protocol=4)
+                 self._zmq_copy_buffers, use_shm, capacity), protocol=4)
         except Exception:
             # Unpicklable worker args (e.g. a closure transform): fail clean,
             # leaving no bound sockets behind.
@@ -105,6 +126,28 @@ class ProcessPool(object):
                     return self._pickle_ser.deserialize(payload)
                 if tag == b'A':
                     return self._arrow_ser.deserialize(payload)
+                if tag in (b'P', b'T'):
+                    # shm plane: payload is a descriptor; the worker's
+                    # slab maps zero-copy and returns to the worker when
+                    # the result's last view is garbage collected.
+                    try:
+                        result = shm_plane.read_payload(
+                            pickle.loads(payload))
+                    except shm_plane.SegmentVanishedError as e:
+                        # Worker arenas never stale-retire, so a vanished
+                        # slab means its writer died after publishing (or
+                        # an external sweep saw it dead) — the rows are
+                        # unrecoverable.  Re-raise the distinct type, NOT
+                        # TimeoutWaitingForResultError/EmptyResultError:
+                        # the reader's checkpoint drain swallows those,
+                        # which would turn this into a silent row-count
+                        # shortfall in a resume token.
+                        raise shm_plane.SegmentVanishedError(
+                            e.errno, 'shm result slab vanished before the '
+                            'parent read it — worker process died '
+                            'mid-stream? (%s)' % e)
+                    self.shm_results += 1
+                    return result
                 if tag == b'K':
                     position, busy_s = pickle.loads(payload)
                     self._inflight -= 1
@@ -158,6 +201,11 @@ class ProcessPool(object):
                 process.wait(timeout=10)
             except Exception:  # noqa: BLE001
                 process.kill()
+        # Workers unlink their own arenas on a clean STOP; the sweep is
+        # the recovery path for killed/crashed children whose descriptors
+        # never reached (or never left) the sink socket.
+        if self._processes:
+            shm_plane.sweep_orphans()
         if self._work_socket is not None:
             self._work_socket.close(0)
         if self._sink_socket is not None:
@@ -176,6 +224,7 @@ class ProcessPool(object):
             'items_processed': self.items_processed,
             'inflight': self._inflight,
             'workers_alive': sum(p.poll() is None for p in self._processes),
+            'shm_results': self.shm_results,
             'decode_busy_s': round(self.busy_time, 4),
             # Child-side decode fraction of total worker-process wall time —
             # same interpretation as the thread pool's number (low values

@@ -8,10 +8,19 @@ can refill.  Message framing (multipart):
   sink (worker->parent):  [tag, payload]
       tag b'R'  pickle-serialized result
       tag b'A'  arrow-IPC-serialized pyarrow.Table result
+      tag b'P'  shm descriptor for a protocol-5 pickled result (raw array
+                buffers live in a /dev/shm segment; see
+                ``workers_pool/shm_plane.py``)
+      tag b'T'  shm descriptor for an arrow-IPC-written pyarrow.Table
       tag b'K'  ack: pickle((position or None, busy_seconds)) — busy is the
                 worker.process wall time net of retry-backoff sleeps, feeding
                 the parent pool's decode_utilization
       tag b'E'  error: pickle((exception, traceback_str))
+
+The shm tags are best-effort per message: a small result, a full arena
+(parent consuming slowly), or an unavailable ``/dev/shm`` degrade that
+message to the matching byte tag — the parent speaks all four framings
+at all times.
 """
 
 import pickle
@@ -24,9 +33,10 @@ def worker_main(setup_payload, worker_id):
 
     from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
     from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+    from petastorm_tpu.workers_pool import shm_plane
 
-    worker_class, worker_args, work_addr, sink_addr, copy_buffers = \
-        pickle.loads(setup_payload)
+    worker_class, worker_args, work_addr, sink_addr, copy_buffers, \
+        use_shm, shm_capacity = pickle.loads(setup_payload)
 
     context = zmq.Context()
     work_socket = context.socket(zmq.PULL)
@@ -36,12 +46,30 @@ def worker_main(setup_payload, worker_id):
 
     pickle_ser = PickleSerializer()
     arrow_ser = ArrowTableSerializer()
+    # stale_after_s=None: the parent is the single consumer and drains at
+    # user-code pace (it may sit on queued descriptors for minutes); the
+    # pool has no resend path, so retiring an unread slab would lose rows.
+    arena = (shm_plane.ShmArena(capacity_bytes=shm_capacity,
+                                stale_after_s=None)
+             if use_shm and shm_plane.available() else None)
 
     def publish(result):
         if isinstance(result, pa.Table):
+            if arena is not None:
+                desc = shm_plane.write_table(arena, result, arrow_ser)
+                if desc is not None:
+                    sink_socket.send_multipart(
+                        [b'T', pickle.dumps(desc, protocol=4)])
+                    return
             sink_socket.send_multipart([b'A', arrow_ser.serialize(result)],
                                        copy=copy_buffers)
         else:
+            if arena is not None:
+                desc = shm_plane.write_pickled(arena, result, pickle_ser)
+                if desc is not None:
+                    sink_socket.send_multipart(
+                        [b'P', pickle.dumps(desc, protocol=4)])
+                    return
             sink_socket.send_multipart([b'R', pickle_ser.serialize(result)],
                                        copy=copy_buffers)
 
@@ -70,6 +98,12 @@ def worker_main(setup_payload, worker_id):
                 sink_socket.send_multipart([b'K', pickle.dumps((position, busy))])
     finally:
         worker.shutdown()
+        if arena is not None:
+            # Unlink every slab: a clean shutdown must leave zero /dev/shm
+            # residue (the parent's mappings keep any pages it still
+            # reads; in-flight results are dropped with the sockets
+            # either way).
+            arena.stop()
         work_socket.close(0)
         sink_socket.close(0)
         context.term()

@@ -64,6 +64,29 @@ def test_ci_run_commands_reference_real_paths():
             'ci.yml references missing path %r' % p
 
 
+def test_ci_tier1_names_its_slowest_tests():
+    """The tier-1 suite runs against a hard time budget on some hosts;
+    the pytest invocation must carry --durations so every run names its
+    slowest tests (ISSUE 2 satellite)."""
+    job = _load_ci()['jobs']['tests']
+    run_text = '\n'.join(s['run'] for s in job['steps'] if 'run' in s)
+    assert '--durations=25' in run_text
+
+
+def test_bench_compact_line_pins_shm_plane_fields():
+    """The shm result plane's evidence fields must ride the bench's
+    compact machine line — a rename would silently drop them from every
+    future BENCH_r{N}.json."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    for field in ('ipc_bytes_per_s',
+                  'delivery_plane_processpool_images_per_sec_host_shm',
+                  'delivery_plane_processpool_images_per_sec_host_bytes',
+                  'delivery_plane_service_images_per_sec_host_w1_bytes'):
+        assert "'%s'" % field in block.group(1), field
+
+
 def test_docs_conf_compiles_and_has_sphinx_settings():
     path = os.path.join(REPO, 'docs', 'conf.py')
     src = open(path).read()

@@ -74,3 +74,16 @@ def assert_rows_equal(actual_rows, expected_rows, id_field='id'):
                 np.testing.assert_array_equal(got, value, err_msg='field %r row %d' % (field, key))
             else:
                 assert got == value, 'field %r of row %d: %r != %r' % (field, key, got, value)
+
+
+def shm_residue(prefix=None):
+    """Current shm-plane entries in ``/dev/shm`` (one helper for every
+    suite's zero-residue lifecycle assertion — the segment naming scheme
+    must not be duplicated across test files)."""
+    import os
+
+    from petastorm_tpu.workers_pool import shm_plane
+
+    prefix = prefix or shm_plane.PREFIX
+    return {f for f in os.listdir(shm_plane.SHM_DIR)
+            if f.startswith(prefix)}

@@ -17,6 +17,7 @@ import subprocess
 import sys
 import threading
 import time
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -27,7 +28,7 @@ from petastorm_tpu.service import (Dispatcher, ServiceConfig,
 from petastorm_tpu.service.dispatcher import build_splits
 from petastorm_tpu.service.worker import deserialize_chunk, serialize_chunk
 
-from test_common import create_test_dataset
+from test_common import create_test_dataset, shm_residue
 
 ROWS = 96
 ROWS_PER_GROUP = 4          # -> 24 row groups -> 12 splits of 2 groups
@@ -371,6 +372,115 @@ def test_resume_token_rejects_changed_geometry(dataset):
                               resume_state=state)
     finally:
         _shutdown(dispatcher, worker)
+
+
+@pytest.fixture(scope='module')
+def raw_dataset(tmp_path_factory):
+    """Plain-parquet dataset with ~200 KB decoded chunks: big enough to
+    clear the shm plane's MIN_SHM_BYTES floor (the petastorm fixture's
+    4-row chunks degrade to the byte path by design)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = tmp_path_factory.mktemp('serviceraw')
+    n = 192
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (n, 64 * 64 * 3), dtype=np.uint8)
+    pq.write_table(pa.table({'id': np.arange(n), 'img': list(img)}),
+                   str(path) + '/data.parquet', row_group_size=16)
+    return SimpleNamespace(url='file://' + str(path), rows=n)
+
+
+def test_shm_delivery_clean_shutdown_leaves_no_residue(raw_dataset):
+    """Same-host shm delivery end to end: the worker provably streams
+    descriptors (not bytes), the client maps them, every row arrives
+    exactly once, and a CLEAN shutdown unlinks every slab —
+    zero /dev/shm residue without any orphan sweep."""
+    from petastorm_tpu.workers_pool import shm_plane
+    if not shm_plane.available():
+        pytest.skip('no usable /dev/shm on this host')
+    before = shm_residue()
+    config = ServiceConfig(raw_dataset.url, num_consumers=1,
+                           rowgroups_per_split=2, lease_ttl_s=10.0,
+                           reader_kwargs={'workers_count': 2})
+    with Dispatcher(config) as dispatcher:
+        with Worker(dispatcher.addr) as worker:
+            loader = ServiceDataLoader(dispatcher.addr, batch_size=BATCH,
+                                       consumer=0, drop_last=False)
+            connection = loader.reader._conn
+            ids = _collect_ids(loader)
+            assert worker.diagnostics['shm_chunks'] > 0, \
+                'worker never used the shm plane'
+            assert connection.shm_chunks > 0, \
+                'client never mapped a descriptor'
+    assert sorted(ids) == list(range(raw_dataset.rows))
+    assert shm_residue() - before == set(), \
+        'clean shutdown left /dev/shm residue'
+
+
+def test_worker_sigkill_with_shm_descriptors_in_flight_no_residue(
+        raw_dataset):
+    """The ISSUE 2 acceptance scenario: SIGKILL a decode worker while shm
+    descriptors are in flight.  The survivor re-decodes the reassigned
+    splits, the client still sees every row exactly once, and after the
+    client finishes (its end-of-stream sweep reclaims the dead writer's
+    slabs) ZERO segments of the killed worker remain in /dev/shm."""
+    from petastorm_tpu.workers_pool import shm_plane
+    if not shm_plane.available():
+        pytest.skip('no usable /dev/shm on this host')
+    config = ServiceConfig(raw_dataset.url, num_consumers=1,
+                           rowgroups_per_split=2, lease_ttl_s=1.5,
+                           reader_kwargs={'workers_count': 2})
+    with Dispatcher(config) as dispatcher:
+        victim = _spawn_worker_process(dispatcher.addr)
+        survivor = _spawn_worker_process(dispatcher.addr)
+        victim_prefix = '%s%d-' % (shm_plane.PREFIX, victim.pid)
+        try:
+            # Slow client (1-split queue, tiny credit window): splits stay
+            # leased/streaming so the kill lands with descriptors in
+            # flight by construction.
+            loader = ServiceDataLoader(dispatcher.addr, batch_size=BATCH,
+                                       consumer=0, drop_last=False,
+                                       queue_splits=1, credits=2)
+            connection = loader.reader._conn
+            stats = lambda: dispatcher._op_stats({})  # noqa: E731
+            _wait_for(lambda: len(stats()['workers']) == 2, 60,
+                      'both workers to register')
+            _wait_for(lambda: stats()['leased'] >= 2, 60, 'leases in flight')
+            gen = loader.iter_host_batches()
+            ids = list(np.asarray(next(gen)['id']))
+            victim.kill()   # SIGKILL: slabs stay behind in /dev/shm
+            victim.wait(timeout=30)
+
+            def pump_rest():
+                for batch in gen:
+                    ids.extend(np.asarray(batch['id']).tolist())
+
+            watchdog = threading.Thread(target=pump_rest, daemon=True)
+            watchdog.start()
+            watchdog.join(120)
+            alive = watchdog.is_alive()
+            loader.reader.stop()
+            loader.reader.join()
+            assert not alive, ('delivery wedged after worker kill; got %d '
+                               'ids, stats=%r' % (len(ids), stats()))
+            assert sorted(ids) == list(range(raw_dataset.rows)), (
+                'lost=%s dup=%s'
+                % (sorted(set(range(raw_dataset.rows)) - set(ids))[:8],
+                   sorted(i for i in set(ids) if ids.count(i) > 1)[:8]))
+            assert connection.shm_chunks > 0, \
+                'kill scenario never exercised the shm plane'
+            # The acceptance assert: the client's end-of-stream sweep
+            # reclaimed every slab the SIGKILLed writer left behind.
+            assert shm_residue(victim_prefix) == set(), \
+                'orphaned /dev/shm segments of the killed worker remain'
+        finally:
+            for proc in (victim, survivor):
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=30)
+            shm_plane.sweep_orphans()  # the survivor was SIGKILLed too
+    assert shm_residue('%s%d-' % (shm_plane.PREFIX, survivor.pid)) == set()
 
 
 def test_ordered_mode_delivers_in_split_order(dataset):

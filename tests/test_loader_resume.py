@@ -551,8 +551,10 @@ def test_device_inmem_mid_epoch_resume_deterministic(dataset):
 
 def test_device_inmem_scan_epochs_mid_epoch_grouped_resume(dataset):
     """Mid-epoch resume into scan_epochs(epochs_per_call=2): the partial
-    epoch is its own first (ungrouped) dispatch, later epochs keep the
-    requested grouping and the stream equals the uninterrupted one."""
+    epoch is its own first dispatch — yielded WITH the epochs axis as
+    (1, steps - cut, ...) so grouped consumers never see a shape change
+    (ADVICE r05 #2) — and later epochs keep the requested grouping; the
+    stream equals the uninterrupted one."""
     from petastorm_tpu.jax import DeviceInMemDataLoader
 
     def build(resume=None):
@@ -581,8 +583,9 @@ def test_device_inmem_scan_epochs_mid_epoch_grouped_resume(dataset):
             ids = np.asarray(ids)
             shapes.append(ids.shape)
             flat.append(ids.reshape(-1, BATCH))
-    # tail of epoch 0 (no epochs axis), then epochs 1+2 as one group
-    assert shapes == [(steps_per_epoch - cut, BATCH),
+    # tail of epoch 0 as a 1-epoch group (every grouped yield carries the
+    # epochs axis), then epochs 1+2 as one group
+    assert shapes == [(1, steps_per_epoch - cut, BATCH),
                       (2, steps_per_epoch, BATCH)]
     assert np.concatenate(flat).tolist() == full[cut:]
 

@@ -4,13 +4,15 @@ The only true multi-process coverage, mirroring the reference's process-pool
 tests (zmq teardown, exception propagation, both serializer paths).
 """
 
+import os
+
 import numpy as np
 import pytest
 
 from petastorm_tpu import make_batch_reader, make_reader
 from petastorm_tpu.transform import TransformSpec
 
-from test_common import assert_rows_equal, create_test_dataset
+from test_common import assert_rows_equal, create_test_dataset, shm_residue
 
 
 @pytest.fixture(scope='module')
@@ -65,3 +67,43 @@ def test_process_pool_epochs(dataset):
                      num_epochs=2, shuffle_row_groups=False) as reader:
         ids = [int(r.id) for r in reader]
     assert sorted(ids) == sorted(list(range(20)) * 2)
+
+
+# -- shm result plane (ISSUE 2) ----------------------------------------------
+
+@pytest.fixture(scope='module')
+def big_rowgroup_dataset(tmp_path_factory):
+    """Row groups big enough (~95 KB serialized) to clear the shm plane's
+    MIN_SHM_BYTES floor — the module fixture's 5-row groups degrade to
+    the byte path by design."""
+    path = tmp_path_factory.mktemp('procshm')
+    return create_test_dataset('file://' + str(path), num_rows=100,
+                               rows_per_rowgroup=50)
+
+
+@pytest.mark.timeout(180)
+def test_process_pool_shm_round_trip_matches_pickle_path(
+        big_rowgroup_dataset, monkeypatch):
+    """Same dataset through the shm descriptor plane and the serialized
+    byte path: identical rows, the shm leg provably used descriptors, and
+    a clean shutdown leaves zero /dev/shm residue."""
+    from petastorm_tpu.workers_pool import shm_plane
+    if not shm_plane.available():
+        pytest.skip('no usable /dev/shm on this host')
+    before = shm_residue()
+    rows_by_path = {}
+    for label, no_shm in (('shm', None), ('bytes', '1')):
+        if no_shm is None:
+            monkeypatch.delenv('PETASTORM_TPU_NO_SHM', raising=False)
+        else:
+            monkeypatch.setenv('PETASTORM_TPU_NO_SHM', no_shm)
+        with make_reader(big_rowgroup_dataset.url, reader_pool_type='process',
+                         workers_count=2, shuffle_row_groups=False) as reader:
+            rows_by_path[label] = [r._asdict() for r in reader]
+            shm_results = reader.diagnostics['shm_results']
+        assert (shm_results > 0) == (label == 'shm'), \
+            '%s path: %d shm results' % (label, shm_results)
+    assert_rows_equal(rows_by_path['shm'], big_rowgroup_dataset.data)
+    assert_rows_equal(rows_by_path['bytes'], big_rowgroup_dataset.data)
+    assert shm_residue() - before == set(), \
+        'clean shutdown left /dev/shm residue'

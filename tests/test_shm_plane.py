@@ -1,0 +1,219 @@
+"""Unit coverage of the shared-memory result plane (ISSUE 2 tentpole).
+
+Everything here runs in one process (the writer and consumer protocol is
+file+header based, so single-process coverage exercises the real code
+paths); the cross-process lifecycle — clean shutdown and SIGKILL residue
+— is asserted in ``test_process_pool.py`` / ``test_data_service.py``
+against real child processes.
+"""
+
+import gc
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.workers_pool import shm_plane
+
+
+pytestmark = pytest.mark.skipif(not shm_plane.available(),
+                                reason='no usable /dev/shm on this host')
+
+
+def _our_segments():
+    return {f for f in os.listdir(shm_plane.SHM_DIR)
+            if f.startswith(shm_plane.PREFIX)}
+
+
+@pytest.fixture()
+def arena():
+    arena = shm_plane.ShmArena(capacity_bytes=64 << 20)
+    yield arena
+    arena.stop()
+
+
+def test_pickle5_round_trip_releases_on_view_gc(arena):
+    rows = [{'a': np.arange(100000, dtype=np.int64), 'b': 'hello'}]
+    desc = shm_plane.write_pickled(arena, rows)
+    assert desc is not None and desc['kind'] == 'pickle5'
+    back = shm_plane.read_payload(desc)
+    np.testing.assert_array_equal(back[0]['a'], rows[0]['a'])
+    assert back[0]['b'] == 'hello'
+    # The slab is leased while zero-copy views live...
+    arena.reap()
+    assert arena.outstanding_bytes > 0
+    # ...and returns to the writer when the LAST view dies (the
+    # weakref.finalize release — the "back to the writer on consume" of
+    # the arena protocol).
+    del back
+    gc.collect()
+    arena.reap()
+    assert arena.outstanding_bytes == 0
+
+
+def test_slab_reuse_same_segment_new_generation(arena):
+    rows = [np.zeros(100000, np.int64)]
+    first = shm_plane.write_pickled(arena, rows)
+    shm_plane.release_descriptor(first)
+    second = shm_plane.write_pickled(arena, rows)
+    assert second['segment'] == first['segment']
+    assert second['gen'] == first['gen'] + 1
+    assert len(arena._slabs) == 1
+    shm_plane.release_descriptor(second)
+
+
+def test_held_slab_is_never_reused(arena):
+    chunk = {'img': np.random.default_rng(0).integers(
+        0, 255, (64, 32, 32, 3)).astype(np.uint8)}
+    first = shm_plane.write_columns(arena, chunk)
+    held = shm_plane.read_payload(first)
+    second = shm_plane.write_columns(arena, chunk)
+    assert second['segment'] != first['segment'], \
+        'writer reused a slab whose views are alive'
+    np.testing.assert_array_equal(held['img'], chunk['img'])
+    shm_plane.release_descriptor(second)
+    del held
+    gc.collect()
+
+
+def test_columns_round_trip_with_object_extra(arena):
+    chunk = {'img': np.arange(64 * 32 * 32, dtype=np.uint8).reshape(64, 32, 32),
+             'name': np.array(['x', 'y'] * 32, dtype=object)}
+    desc = shm_plane.write_columns(arena, chunk)
+    assert desc['kind'] == 'columns'
+    assert [c[0] for c in desc['columns']] == ['img']  # object col -> extra
+    back = shm_plane.read_payload(desc)
+    np.testing.assert_array_equal(back['img'], chunk['img'])
+    assert list(back['name']) == list(chunk['name'])
+    del back
+    gc.collect()
+
+
+def test_columns_routes_datetime_dtypes_to_extra(arena):
+    """numpy refuses buffer export for 'm'/'M' dtypes — timestamp columns
+    must ride the pickled extra instead of crashing the decode plane."""
+    chunk = {'ts': np.arange(20000).astype('datetime64[s]'),
+             'dt': np.arange(20000).astype('timedelta64[ms]'),
+             'x': np.arange(20000, dtype=np.int64)}
+    desc = shm_plane.write_columns(arena, chunk)
+    assert [c[0] for c in desc['columns']] == ['x']
+    back = shm_plane.read_payload(desc)
+    for key in chunk:
+        np.testing.assert_array_equal(back[key], chunk[key])
+    del back
+    gc.collect()
+
+
+def test_arrow_round_trip(arena):
+    pa = pytest.importorskip('pyarrow')
+    table = pa.table({'x': np.arange(100000), 'y': np.arange(100000) * 0.5})
+    desc = shm_plane.write_table(arena, table)
+    assert desc['kind'] == 'arrow'
+    back = shm_plane.read_payload(desc)
+    assert back.equals(table)
+    del back
+    gc.collect()
+
+
+def test_small_payload_degrades_to_byte_path(arena):
+    assert shm_plane.write_pickled(arena, [np.arange(8)]) is None
+
+
+def test_full_arena_degrades_not_blocks():
+    arena = shm_plane.ShmArena(capacity_bytes=1000, min_bytes=0)
+    try:
+        assert arena.allocate(2000) is None
+        assert arena.degraded == 1
+    finally:
+        arena.stop()
+
+
+def test_stop_unlinks_inflight_slabs():
+    arena = shm_plane.ShmArena(capacity_bytes=64 << 20)
+    desc = shm_plane.write_columns(
+        arena, {'z': np.ones((300, 300), np.float32)})
+    name = desc['segment']
+    assert name in _our_segments()
+    arena.stop()
+    assert name not in _our_segments()
+
+
+def test_stale_inflight_slab_is_retired_not_leaked():
+    """A descriptor whose consumer vanished (client restart, dropped ZMQ
+    identity) is never released; past stale_after_s the writer retires
+    the slab — unlink, budget back — instead of letting abandoned
+    descriptors shrink the arena to permanent byte-path degradation.
+    A late attach then sees the ordinary lost-chunk error."""
+    import time
+    arena = shm_plane.ShmArena(capacity_bytes=64 << 20, stale_after_s=0.2)
+    try:
+        desc = shm_plane.write_columns(
+            arena, {'z': np.ones((300, 300), np.float32)})
+        time.sleep(0.3)
+        arena.reap()
+        assert arena.retired == 1
+        assert arena.outstanding_bytes == 0
+        with pytest.raises(shm_plane.SegmentVanishedError):
+            shm_plane.read_payload(desc)
+        # a held-but-fresh slab is untouched by the same sweep
+        shm_plane.write_columns(arena, {'z': np.ones((300, 300), np.float32)})
+        arena.reap()
+        assert arena.outstanding_bytes > 0
+    finally:
+        arena.stop()
+
+
+def test_read_after_vanished_raises_lost_chunk_error():
+    with pytest.raises(shm_plane.SegmentVanishedError):
+        shm_plane.read_payload({'kind': 'columns', 'gen': 1, 'columns': [],
+                                'segment': shm_plane.PREFIX + '1-gone-9'})
+
+
+def test_sweep_reclaims_dead_pid_segments_only():
+    # pid 1 is init — alive; an impossibly high pid is dead.
+    alive = shm_plane.PREFIX + '1-unit-0'
+    dead = shm_plane.PREFIX + '999999999-unit-0'
+    for name in (alive, dead):
+        open(os.path.join(shm_plane.SHM_DIR, name), 'wb').close()
+    try:
+        removed = shm_plane.sweep_orphans()
+        assert dead in removed
+        assert alive not in removed
+        assert not os.path.exists(os.path.join(shm_plane.SHM_DIR, dead))
+        assert os.path.exists(os.path.join(shm_plane.SHM_DIR, alive))
+    finally:
+        for name in (alive, dead):
+            try:
+                os.unlink(os.path.join(shm_plane.SHM_DIR, name))
+            except OSError:
+                pass
+
+
+def test_probe_lifecycle_and_validation():
+    probe = shm_plane.make_probe()
+    try:
+        assert shm_plane.probe_exists(probe)
+    finally:
+        shm_plane.remove_probe(probe)
+    assert not shm_plane.probe_exists(probe)
+    # a subscribe message must not be able to point the worker at
+    # arbitrary paths
+    assert not shm_plane.probe_exists('../etc/passwd')
+    assert not shm_plane.probe_exists('tmp')
+    assert not shm_plane.probe_exists(None)
+
+
+def test_mapped_views_are_writable(arena):
+    # loaders/transforms may mutate delivered batches in place
+    desc = shm_plane.write_columns(arena,
+                                   {'z': np.zeros((200, 200), np.float32)})
+    back = shm_plane.read_payload(desc)
+    back['z'][0, 0] = 5.0
+    assert back['z'][0, 0] == 5.0
+    del back
+    gc.collect()
+
+
+def test_no_shm_env_disables_plane(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_NO_SHM', '1')
+    assert not shm_plane.available()
