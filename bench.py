@@ -994,6 +994,201 @@ def delivery_plane_service_leg(worker_counts=(1, 2, 4), shm_pairs=3):
     return fields
 
 
+def _make_light_step():
+    """A cheap jitted step with the SAME state/signature as
+    ``_make_resnet_step`` (so ``_device_floor_ms`` / ``_run_stall`` /
+    ``_run_scan_batches_stall`` run unchanged): one flattened matmul over
+    the uint8 batch.  Fast enough to give the scan_batches drivers a
+    measurable device floor on ANY backend — including the CPU fallback,
+    where the ResNet step (~30 s/step) makes the fused-dispatch stall
+    legs unrunnable and `stall_pct_streaming_scan` would otherwise ship
+    written-but-unmeasured."""
+    import jax
+    import jax.numpy as jnp
+
+    features = IMAGE_HW[0] * IMAGE_HW[1] * 3
+    params = jnp.full((features, 8), 0.01, jnp.float32)
+    batch_stats, opt_state = jnp.zeros(()), jnp.zeros(())
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images_u8, labels):
+        x = images_u8.astype(jnp.float32).reshape(
+            (images_u8.shape[0], -1)) / 255.0
+        loss = jnp.mean((x @ params) ** 2) \
+            + 0.0 * jnp.mean(labels.astype(jnp.float32))
+        # Chain the carry through the loss so every step in a scanned /
+        # async-dispatched window must actually execute before the
+        # terminal D2H settles.
+        return params + 0.0 * loss, batch_stats, opt_state, loss
+
+    return train_step, params, batch_stats, opt_state
+
+
+def _wipe_plane(plane_dir):
+    import shutil
+
+    from petastorm_tpu.cache_plane.plane import default_ram_dir
+    shutil.rmtree(plane_dir, ignore_errors=True)
+    shutil.rmtree(default_ram_dir(plane_dir), ignore_errors=True)
+
+
+def _plane_epoch_rate(cache_kwargs):
+    """Host images/s of ONE full epoch of the JPEG (decode-bound) dataset
+    through the streaming loader; the timer opens at the first delivered
+    batch so pool spin-up is excluded identically cold and warm."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import DataLoader
+
+    with make_reader(DATASET_URL, num_epochs=1, workers_count=WORKERS,
+                     shuffle_row_groups=False, columnar_decode=True,
+                     **cache_kwargs) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+        n_host, t0, t_end = 0, None, None
+        for i, batch in enumerate(loader.iter_host_batches()):
+            if i == 0:
+                t0 = time.monotonic()
+            else:
+                n_host += len(batch['noun_id'])
+                t_end = time.monotonic()
+    return (n_host / (t_end - t0)
+            if n_host and t_end is not None and t_end > t0 else 0.0)
+
+
+def _plane_service_epoch_rate(plane_dir):
+    """Host images/s of one service pass over the JPEG dataset with the
+    epoch-cache plane enabled; run once cold and once warm against the
+    same plane dir, the delta is what the plane buys the service path."""
+    from petastorm_tpu.service import (Dispatcher, ServiceConfig,
+                                       ServiceDataLoader, Worker)
+
+    # One decode thread per split reader: the decode-bound regime this
+    # plane exists for (the worker's decode plane saturated, delivery
+    # not) — on the 1-2 core bench host extra threads only time thread
+    # churn, and a deterministic split reader rides along for free.
+    # 4 row groups per split amortizes per-split reader construction,
+    # which warm runs would otherwise pay as protocol noise.
+    config = ServiceConfig(
+        DATASET_URL, num_consumers=1, rowgroups_per_split=4,
+        lease_ttl_s=30.0,
+        reader_kwargs={'workers_count': 1},
+        cache_plane=True, cache_plane_dir=plane_dir)
+    with Dispatcher(config) as dispatcher:
+        worker = Worker(dispatcher.addr).start()
+        try:
+            loader = ServiceDataLoader(dispatcher.addr, batch_size=BATCH,
+                                       consumer=0, drop_last=False,
+                                       prefetch=2)
+            n_host, t0, t_end = 0, None, None
+            with loader:
+                for i, batch in enumerate(loader.iter_host_batches()):
+                    if i == 0:
+                        t0 = time.monotonic()
+                    else:
+                        n_host += len(batch['noun_id'])
+                        t_end = time.monotonic()
+        finally:
+            worker.stop()
+            worker.join()
+    return (n_host / (t_end - t0)
+            if n_host and t_end is not None and t_end > t0 else 0.0)
+
+
+def epoch_cache_plane_leg(pairs=3):
+    """Tiered epoch-cache plane (``petastorm_tpu/cache_plane``): cold
+    (epoch 1, full JPEG decode) vs warm (epoch 2+, plane-served) host
+    throughput on the decode-bound dataset, for the streaming reader
+    (``cache_type='plane'``) and the data service
+    (``ServiceConfig(cache_plane=True)``) — the evidence that epoch >= 2
+    cost is independent of decode cost.  Cold/warm runs are interleaved
+    pairs with medians (single runs on a shared 1-core host swing 2-3x).
+
+    Also measures the ``scan_batches`` fused dispatch on this pipeline
+    with the light step (see ``_make_light_step``): the cold/streaming
+    number fills ``stall_pct_streaming_scan`` when no on-chip leg
+    measured it this run, and the warm-plane twin ships as
+    ``stall_pct_epoch_cache_warm_scan``.
+    """
+    from petastorm_tpu.jax import DataLoader  # noqa: F401 — warm import
+
+    plane_dir = os.path.join(BENCH_DIR, 'epoch_cache_plane_v1')
+    cache_kwargs = {'cache_type': 'plane', 'cache_location': plane_dir}
+    cold_rates, warm_rates = [], []
+    for _ in range(max(1, int(pairs))):
+        _wipe_plane(plane_dir)
+        cold_rates.append(_plane_epoch_rate(cache_kwargs))
+        warm_rates.append(_plane_epoch_rate(cache_kwargs))
+    cold = float(np.median(cold_rates))
+    warm = float(np.median(warm_rates))
+    fields = {
+        'epoch_cache_streaming_cold_images_per_sec': round(cold, 1),
+        'epoch_cache_streaming_warm_images_per_sec': round(warm, 1),
+        'epoch_cache_streaming_warm_over_cold':
+            round(warm / cold, 2) if cold else None,
+    }
+
+    svc_cold, svc_warm = [], []
+    for _ in range(2):
+        _wipe_plane(plane_dir)
+        svc_cold.append(_plane_service_epoch_rate(plane_dir))
+        svc_warm.append(_plane_service_epoch_rate(plane_dir))
+    cold = float(np.median(svc_cold))
+    warm = float(np.median(svc_warm))
+    fields.update({
+        'epoch_cache_service_cold_images_per_sec': round(cold, 1),
+        'epoch_cache_service_warm_images_per_sec': round(warm, 1),
+        'epoch_cache_service_warm_over_cold':
+            round(warm / cold, 2) if cold else None,
+    })
+
+    # scan_batches fused dispatch, measured (not just written): light-step
+    # floor on whatever backend this process has.  Unlike the throughput
+    # halves above, this half IS device-coupled (jit + device_put), so a
+    # wedged tunnel must skip it — the host-only numbers still ship.
+    if _PARTIAL.get('device_unhealthy'):
+        fields['epoch_cache_scan_note'] = (
+            'scan stalls skipped: %s' % _PARTIAL['device_unhealthy'])
+        return fields
+    from petastorm_tpu import make_reader
+    state = _make_light_step()
+    floor_ms = _device_floor_ms(state, 64)
+    scan_k = max(1, min(12, TRAIN_STEPS))
+    scan_steps = 2 * max(1, NUM_IMAGES // BATCH)
+    epochs_scan = -(-(scan_k * (2 + -(-scan_steps // scan_k)))
+                    // max(1, NUM_IMAGES // BATCH))
+    fields['epoch_cache_scan_floor_ms'] = round(floor_ms, 2)
+    # Guarantee warmth for the warm-scan number: one untimed streaming
+    # epoch (re)fills the plane with THIS reader config's keys — the
+    # service pairs above were the last writers and nothing pins their
+    # keys to the streaming reader's across future edits.
+    _plane_epoch_rate(cache_kwargs)
+    with make_reader(DATASET_URL, num_epochs=epochs_scan,
+                     workers_count=WORKERS, shuffle_row_groups=False,
+                     columnar_decode=True, **cache_kwargs) as reader:
+        loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+        stall, step_ms = _run_scan_batches_stall(
+            loader, state, scan_steps, floor_ms, steps_per_call=scan_k)
+    fields.update({'stall_pct_epoch_cache_warm_scan': stall,
+                   'step_ms_epoch_cache_warm_scan': round(step_ms, 2)})
+    if _PARTIAL.get('stall_pct_streaming_scan') is None:
+        # No on-chip streaming_scan this run (CPU fallback, or the leg
+        # died): measure the fused streaming driver against the light
+        # floor so the compact line carries a NUMBER, labeled with its
+        # step (the on-chip ResNet measurement wins when present).
+        with make_reader(DATASET_URL, num_epochs=epochs_scan,
+                         workers_count=WORKERS, shuffle_row_groups=False,
+                         columnar_decode=True) as reader:
+            loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+            stall, step_ms = _run_scan_batches_stall(
+                loader, state, scan_steps, floor_ms, steps_per_call=scan_k)
+        fields.update({
+            'stall_pct_streaming_scan': stall,
+            'step_ms_streaming_scan': round(step_ms, 2),
+            'streaming_scan_step': 'light-matmul (host-plane measurement; '
+                                   'on-chip runs use the ResNet-50 step)',
+        })
+    return fields
+
+
 #: Host-only IPC-plane legs (the shm result plane's evidence set), wired
 #: identically into the cpu-fallback and on-chip paths of main() — one
 #: table so the two paths cannot drift apart.
@@ -1001,6 +1196,7 @@ _IPC_PLANE_LEGS = (
     ('ipc', ipc_microbench),
     ('processpool_plane', processpool_host_plane_leg),
     ('delivery_plane_service', delivery_plane_service_leg),
+    ('epoch_cache_plane', epoch_cache_plane_leg),
 )
 
 
@@ -1245,6 +1441,13 @@ _COMPACT_KEYS = (
     'delivery_plane_service_images_per_sec_host_w1_bytes',
     'delivery_plane_service_images_per_sec_host_w2',
     'delivery_plane_service_images_per_sec_host_w4',
+    'epoch_cache_streaming_cold_images_per_sec',
+    'epoch_cache_streaming_warm_images_per_sec',
+    'epoch_cache_streaming_warm_over_cold',
+    'epoch_cache_service_cold_images_per_sec',
+    'epoch_cache_service_warm_images_per_sec',
+    'epoch_cache_service_warm_over_cold',
+    'stall_pct_epoch_cache_warm_scan',
     'ipc_bytes_per_s', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',

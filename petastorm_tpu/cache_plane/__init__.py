@@ -1,0 +1,40 @@
+"""Tiered epoch-cache plane: a multi-process cache for decoded batches.
+
+Every epoch after the first re-pays the full Parquet read + decode +
+transform cost unless something remembers the decoded result.  The only
+prior cache (``local_disk_cache.LocalDiskCache``) is a per-process
+pickle-per-key store: nothing is shared between ProcessPool workers, the
+data-service decode fleet, or across consumer restarts.  This package is
+the shared answer — one cache *plane* per dataset that every process on
+the host can hit:
+
+* a **hot RAM tier** (``/dev/shm``, the same tmpfs the shm result plane
+  uses — reused files, persistent mappings, flock-guarded reclaim) over
+* an **mmap'd Arrow-IPC disk tier** with size-capped LRU eviction and
+  crash-safe atomic publish (tmp file + rename: readers never see a
+  partial entry, a SIGKILLed writer leaves only a sweepable tmp file),
+
+keyed by a **content fingerprint** (dataset path + mtime, selected
+columns/schema hash, predicate, transform spec, row-group index) so a
+rewritten dataset or a changed transform *misses* instead of serving
+stale rows — entries self-invalidate and age out by LRU.
+
+Entry points:
+
+* ``make_reader(..., cache_type='plane', cache_location=DIR)`` — reader
+  workers consult the plane before hitting Parquet (see
+  ``reader._resolve_cache``).
+* ``ServiceConfig(cache_plane=True, cache_plane_dir=DIR)`` — the data
+  service's decode workers share one plane; the dispatcher's lease is
+  the per-piece decode-ownership grant and the plane's cross-process
+  single-flight lock backs it up across overlapping epochs/runs.
+* :class:`CachePlane` / :class:`PlaneCache` directly for custom stacks.
+"""
+
+from petastorm_tpu.cache_plane.fingerprint import (dataset_fingerprint,
+                                                   spec_token)
+from petastorm_tpu.cache_plane.plane import (CachePlane, PlaneCache,
+                                             sweep_residue)
+
+__all__ = ['CachePlane', 'PlaneCache', 'dataset_fingerprint', 'spec_token',
+           'sweep_residue']

@@ -1,0 +1,211 @@
+"""Content fingerprints for cache-plane keys.
+
+A plane entry must be valid exactly as long as the bytes it was decoded
+from and the code path that decoded them: the fingerprint folds in the
+dataset's *data file identity* (path, size, mtime — a rewritten file
+changes the digest, so stale entries become unreachable and age out by
+LRU) and the *decode identity* (selected columns, predicate, transform
+spec).  The per-piece part of the key (file path, row-group index,
+row-drop partition) is already carried by the worker-built cache keys;
+the fingerprint is the shared prefix mixed into every digest.
+"""
+
+import hashlib
+import logging
+import uuid
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _hash_code(code, h):
+    """Feed a code object's identity into ``h``.
+
+    Three traps, each a silent cache corruption or 0%%-hit bug:
+    ``repr`` of a nested code object embeds a memory address (recurse
+    instead); ``repr`` of set/frozenset constants follows hash
+    randomization (render via ``_stable_value``, which sorts); and
+    ``co_code`` alone is blind to WHICH globals are called —
+    ``lambda r: brighten(r)`` and ``lambda r: darken(r)`` share
+    bytecode and differ only in ``co_names``, so those must be hashed
+    too or one function's cached results serve the other's readers."""
+    h.update(code.co_code)
+    h.update(repr(code.co_names).encode('utf-8', 'replace'))
+    for const in code.co_consts:
+        if hasattr(const, 'co_code'):
+            _hash_code(const, h)
+        else:
+            h.update(_stable_value(const).encode('utf-8', 'replace'))
+
+
+def _stable_value(value):
+    """A process-independent rendering of a predicate/spec attribute.
+
+    ``repr`` alone is NOT stable across processes: set iteration order
+    varies under hash randomization and functions repr their addresses —
+    either would silently give every process its own cache context (0%%
+    cross-process hit rate).  Sets sort; callables render as qualified
+    name + bytecode/constants digest (distinct lambda bodies stay
+    distinct, memory addresses drop out); containers recurse.
+    """
+    if isinstance(value, (set, frozenset)):
+        return 'set:[%s]' % ','.join(sorted(repr(v) for v in value))
+    if isinstance(value, dict):
+        return 'dict:{%s}' % ','.join(
+            '%r:%s' % (k, _stable_value(v))
+            for k, v in sorted(value.items(), key=lambda kv: repr(kv[0])))
+    if isinstance(value, (list, tuple)):
+        return 'seq:[%s]' % ','.join(_stable_value(v) for v in value)
+    if isinstance(value, np.ndarray):
+        # repr truncates arrays >= 1000 elements: two normalization
+        # tables differing only in interior values would share a
+        # fingerprint (and hence cached post-transform rows).  Hash the
+        # actual bytes; object arrays recurse per element.
+        if value.dtype.hasobject:
+            return 'nd-obj:%s:%s' % (value.shape,
+                                     _stable_value(list(value.ravel())))
+        return 'nd:%s:%s:%s' % (
+            value.dtype.str, value.shape,
+            hashlib.blake2b(np.ascontiguousarray(value).tobytes(),
+                            digest_size=8).hexdigest())
+    if callable(value):
+        return _stable_callable(value)
+    return repr(value)
+
+
+def _stable_callable(value, depth=0):
+    """Identity of a callable that distinguishes everything that changes
+    its BEHAVIOR while staying byte-identical across processes: bytecode
+    + names + constants, default args, closure cells — and for
+    code-less callables (``functools.partial``, callable instances) the
+    wrapped function/args/instance state, which qualified name alone
+    cannot see (``partial(adjust, gain=1)`` vs ``gain=2`` must not share
+    cached post-transform rows)."""
+    if depth > 6:  # pathological self-referential callables: type only
+        return 'fn-deep:%s' % type(value).__qualname__
+    h = hashlib.blake2b(digest_size=6)
+
+    def mix(v):
+        h.update(_stable_value(v).encode('utf-8', 'replace'))
+
+    code = getattr(value, '__code__', None)
+    if code is not None:
+        _hash_code(code, h)
+    for cell in getattr(value, '__closure__', None) or ():
+        try:
+            mix(cell.cell_contents)
+        except ValueError:  # empty cell
+            pass
+    for attr in ('__defaults__', '__kwdefaults__'):
+        bound = getattr(value, attr, None)
+        if bound:
+            mix(bound)
+    # functools.partial shape: wrapped callable + pinned args
+    inner = getattr(value, 'func', None)
+    if inner is not None and callable(inner):
+        h.update(_stable_callable(inner, depth + 1).encode())
+        mix(getattr(value, 'args', ()))
+        mix(getattr(value, 'keywords', None) or {})
+    elif code is None:
+        # callable instance: its class's __call__ body + instance state
+        call = getattr(type(value), '__call__', None)
+        call_code = getattr(call, '__code__', None)
+        if call_code is not None:
+            _hash_code(call_code, h)
+        mix(getattr(value, '__dict__', {}))
+    return 'fn:%s.%s:%s' % (getattr(value, '__module__', '?'),
+                            getattr(value, '__qualname__',
+                                    type(value).__qualname__),
+                            h.hexdigest())
+
+
+#: Per-process salt for files whose identity cannot be established (see
+#: ``_file_stamp``): sharing is disabled for them rather than risked.
+_UNSTAT_SALT = uuid.uuid4().hex
+_warned_unstat = set()
+
+
+def _file_stamp(fs, path):
+    """(size, mtime-ish) of one data file, robust across fsspec backends.
+
+    Local filesystems report ``mtime``/``LastModified`` under various
+    names; remote stores at minimum report size + an etag-like field.
+    Anything that changes when the file is rewritten works — the stamp
+    only needs to *differ*, not to be a time.  A file whose identity
+    cannot be established at all (``info`` raises, or reports neither a
+    size nor any mtime/etag field) gets a per-process random stamp:
+    an in-place rewrite of such a file would otherwise keep the old
+    fingerprint and serve STALE cached rows — the plane prefers losing
+    cross-process sharing to that.
+    """
+    try:
+        info = fs.info(path)
+    except Exception:  # noqa: BLE001 — unstattable: don't risk staleness
+        info = {}
+    mtime = None
+    for key in ('mtime', 'LastModified', 'last_modified', 'ETag', 'etag'):
+        if info.get(key) is not None:
+            mtime = str(info[key])
+            break
+    size = info.get('size')
+    if size is None and mtime is None:
+        if path not in _warned_unstat:
+            _warned_unstat.add(path)
+            logger.warning(
+                'cache plane: no size/mtime/etag for %r — its entries '
+                'will not be shared across processes (stale-serve guard)',
+                path)
+        return (path, _UNSTAT_SALT, None)
+    return (path, size, mtime)
+
+
+def dataset_fingerprint(fs, paths):
+    """Digest of the dataset's data-file identity.
+
+    ``paths`` is the set of distinct data files the reader will touch
+    (dedup the piece list before calling — row groups share files).
+    Touching/rewriting any of them changes the digest, which orphans
+    every cached entry decoded from the old bytes.  Deliberately NOT
+    memoized: a stale digest would serve a rewritten dataset's old rows
+    from cache, and the stat pass is no heavier than the footer scan
+    every reader construction already pays (``load_row_groups`` opens
+    each file's metadata).
+    """
+    h = hashlib.blake2b(digest_size=12)
+    for stamp in sorted(_file_stamp(fs, p) for p in set(paths)):
+        h.update(repr(stamp).encode('utf-8', 'replace'))
+    return h.hexdigest()
+
+
+def spec_token(schema_view=None, predicate=None, transform_spec=None):
+    """Digest of the decode identity: which columns, which row filter,
+    which transform.  ``transform_spec.cache_token`` (the declared
+    identity transforms already expose for the disk cache) is honored;
+    an opaque ``func`` without a token is keyed by its qualified name +
+    bytecode/constants digest (``_stable_value``) — distinct lambda
+    bodies get distinct tokens, the same source produces the same token
+    in every process, and editing a function in place re-keys."""
+    parts = []
+    if schema_view is not None:
+        parts.append('cols=%s' % ','.join(sorted(schema_view.fields)))
+    if predicate is not None:
+        fields = sorted(getattr(predicate, 'get_fields', lambda: ())() or ())
+        parts.append('pred=%s:%s:%s' % (
+            type(predicate).__name__, fields,
+            _stable_value(getattr(predicate, '__dict__', {}))))
+    if transform_spec is not None:
+        token = getattr(transform_spec, 'cache_token', None)
+        if not token:
+            func = getattr(transform_spec, 'func', None)
+            # Stable across processes AND distinct across lambda bodies
+            # (name alone would collide every '<lambda>'); editing a
+            # function in place re-keys via its bytecode digest.
+            token = _stable_value(func) if func is not None else 'none'
+        parts.append('tf=%s:%s:%s' % (
+            token,
+            sorted(getattr(transform_spec, 'removed_fields', ()) or ()),
+            sorted(getattr(transform_spec, 'selected_fields', ()) or ())))
+    h = hashlib.blake2b('|'.join(parts).encode('utf-8', 'replace'),
+                        digest_size=8)
+    return h.hexdigest()

@@ -1,0 +1,793 @@
+"""The tiered cache plane: mmap'd entry store, hot shm tier, single-flight.
+
+Layout of one published entry (``<digest>.cpe``)::
+
+    magic(8) | header_len(8) | pickled header | pad to 64 | payload
+
+The header carries the payload *kind* and relative offsets; payloads are
+raw column bytes (``columns``), an Arrow IPC stream (``arrow``), or a
+pickle (anything else), so a lookup rebuilds the decoded batch as
+**zero-copy read-only views over the mapping** — no per-epoch
+deserialize, and (on the hot tier) no per-epoch page re-faulting: one
+``mmap`` per entry file is cached for the process lifetime, the same
+persistent-mapping discipline as ``workers_pool/shm_plane.py`` (on this
+class of virtualized kernel a page fault costs ~20x the memcpy it maps).
+
+Multi-process protocol (no daemon, no sockets — the filesystem is the
+coordination plane):
+
+* **publish** is tmp-file + ``os.replace``: readers see whole entries or
+  nothing.  A SIGKILLed writer leaves only a ``.tmp.<pid>.*`` file whose
+  flock died with it — :func:`sweep_residue` reclaims those.
+* **get-or-fill** is single-flight per key: the first process takes an
+  exclusive flock on ``<digest>.lock`` and decodes; concurrent callers
+  poll the published path (not the lock) and hit the moment it lands.
+  The wait is bounded — past ``fill_wait_s`` (or when the holder dies,
+  which releases the flock instantly) the waiter decodes directly.  A
+  full or unwritable plane likewise degrades to direct decode: the plane
+  **never blocks** an epoch on cache machinery.
+* **reclaim** (LRU eviction past the tier's byte cap) runs under a
+  per-tier flock so two processes don't double-evict; unlinked entries
+  stay readable through any already-held mapping (POSIX keeps the pages
+  until the last munmap).
+"""
+
+import fcntl
+import hashlib
+import logging
+import mmap
+import os
+import pickle
+import struct
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from petastorm_tpu.cache import CacheBase
+# Shared with the result plane: the two modules cooperate on the same
+# /dev/shm sweep protocol, so their liveness logic must not diverge.
+from petastorm_tpu.workers_pool.shm_plane import _pid_alive  # noqa: F401
+
+logger = logging.getLogger(__name__)
+
+#: Lookup sentinel: a cached value may legitimately BE ``None`` (e.g. a
+#: predicate-empty row group), so misses need their own identity.
+MISS = object()
+
+_MAGIC = b'PSTPUCP1'
+_ALIGN = 64
+ENTRY_SUFFIX = '.cpe'
+LOCK_SUFFIX = '.lock'
+#: Hot-tier directories live under this prefix in /dev/shm, next to (but
+#: distinct from) the result plane's ``pstpu-shm-`` slabs.
+SHM_CACHE_PREFIX = 'pstpu-cache-'
+DEFAULT_DISK_CAPACITY = 4 << 30
+DEFAULT_RAM_CAPACITY = 128 << 20
+
+#: root -> monotonic time of this process's last construction-time sweep
+#: (per-split reader churn must not re-listdir the tiers every split).
+_LAST_SWEEP = {}
+
+#: root -> (monotonic, measured byte total): seeds a fresh Tier's
+#: eviction estimator without a per-instance usage() scan — the service
+#: builds one Tier pair per split, and re-statting every entry on each
+#: split's first store would be O(splits x entries) in syscalls.
+_SEED_TOTALS = {}
+
+
+def _align(offset):
+    return (offset + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+# -- entry encode/decode ------------------------------------------------------
+
+def encode_entry(value):
+    """``value`` -> one contiguous bytes blob (the published file body).
+
+    Kinds: ``pa.Table`` -> Arrow IPC stream (mmap readers get the table
+    back zero-copy); dict of buffer-exporting ndarrays -> raw column
+    bytes at aligned offsets (+ one pickled blob for object/datetime
+    columns); anything else -> pickle.
+    """
+    import pyarrow as pa
+    header, parts = None, None
+    if isinstance(value, pa.Table):
+        from petastorm_tpu.reader_impl.arrow_table_serializer import \
+            ArrowTableSerializer
+        header = {'kind': 'arrow'}
+        parts = [ArrowTableSerializer().serialize(value)]
+    raw = None
+    if isinstance(value, dict) and value and all(
+            isinstance(v, np.ndarray) for v in value.values()):
+        raw, rest = {}, {}
+        for key, col in value.items():
+            # Raw-byte columns must round-trip through dtype.str alone:
+            # object dtype has no bytes, 'm'/'M' refuse buffer export,
+            # and structured/void dtypes ('V', .names) lose their field
+            # names through dtype.str — all ride the pickled blob.
+            if not col.dtype.hasobject and col.dtype.kind not in 'mMV' \
+                    and col.dtype.names is None:
+                raw[key] = np.ascontiguousarray(col)
+            else:
+                rest[key] = col
+        parts = list(raw.values())
+        if rest:
+            parts.append(pickle.dumps(rest, protocol=4))
+    if header is None and raw is None:
+        header = {'kind': 'pickle'}
+        parts = [pickle.dumps(value, protocol=4)]
+    # ONE offset computation, shared by the header spans and the writes
+    # below — two copies of this loop would have to stay byte-identical.
+    offset = 0
+    placed = []
+    for part in parts:
+        offset = _align(offset)
+        placed.append((offset, part))
+        offset += memoryview(part).nbytes
+    if header is None:  # columns kind: spans derive from `placed`
+        header = {'kind': 'columns',
+                  'columns': [(k, off, col.shape, col.dtype.str)
+                              for (k, col), (off, _) in zip(raw.items(),
+                                                            placed)],
+                  'extra': ((placed[-1][0],
+                             memoryview(placed[-1][1]).nbytes)
+                            if rest else None)}
+    header_bytes = pickle.dumps(header, protocol=4)
+    base = _align(16 + len(header_bytes))
+    blob = bytearray(base + offset)
+    blob[:8] = _MAGIC
+    struct.pack_into('<Q', blob, 8, len(header_bytes))
+    blob[16:16 + len(header_bytes)] = header_bytes
+    out = np.frombuffer(blob, np.uint8)
+    for off, part in placed:
+        view = memoryview(part)
+        if view.nbytes == 0:
+            continue  # zero-size column: cast('B') rejects 0-in-shape
+        raw = np.frombuffer(view.cast('B'), np.uint8)
+        np.copyto(out[base + off:base + off + raw.nbytes], raw)
+    return blob
+
+
+class CorruptEntryError(ValueError):
+    """The entry file fails structural validation (truncated magic/header)
+    — cannot happen through the atomic-publish path; a lookup treats it
+    as a miss and unlinks the file."""
+
+
+def decode_entry(buf):
+    """Rebuild the cached value from a mapped entry; views are zero-copy
+    (and read-only when the mapping is) over ``buf``."""
+    view = memoryview(buf)
+    if len(view) < 16 or bytes(view[:8]) != _MAGIC:
+        raise CorruptEntryError('bad cache entry magic')
+    header_len = struct.unpack_from('<Q', view, 8)[0]
+    if 16 + header_len > len(view):
+        raise CorruptEntryError('truncated cache entry header')
+    try:
+        header = pickle.loads(view[16:16 + header_len])
+    except Exception as e:  # noqa: BLE001 — treat any unpickle as corrupt
+        raise CorruptEntryError('undecodable cache entry header: %s' % e)
+    payload = view[_align(16 + header_len):]
+    kind = header['kind']
+    if kind == 'arrow':
+        from petastorm_tpu.reader_impl.arrow_table_serializer import \
+            ArrowTableSerializer
+        return ArrowTableSerializer().deserialize(payload)
+    if kind == 'columns':
+        out = {}
+        for key, off, shape, dtype_str in header['columns']:
+            dtype = np.dtype(dtype_str)
+            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            flat = payload[off:off + count * dtype.itemsize]
+            out[key] = np.frombuffer(flat, dtype=dtype,
+                                     count=count).reshape(shape)
+        if header.get('extra'):
+            off, n = header['extra']
+            try:
+                out.update(pickle.loads(payload[off:off + n]))
+            except Exception as e:  # noqa: BLE001 — any unpickle = corrupt
+                raise CorruptEntryError(
+                    'undecodable cache entry extra blob: %s' % e)
+        return out
+    if kind == 'pickle':
+        try:
+            return pickle.loads(payload)
+        except Exception as e:  # noqa: BLE001 — any unpickle is corrupt
+            raise CorruptEntryError('undecodable cache entry payload: %s' % e)
+    raise CorruptEntryError('unknown cache entry kind %r' % (kind,))
+
+
+# -- one tier -----------------------------------------------------------------
+
+class Tier(object):
+    """One directory of entry files with a byte cap and LRU reclaim."""
+
+    def __init__(self, root, capacity_bytes, label):
+        self.root = root
+        self.capacity_bytes = int(capacity_bytes)
+        self.label = label
+        self.evictions = 0
+        self.store_failures = 0
+        #: Eviction-scan amortizer: a full listdir+stat of the tier per
+        #: store would make a cold epoch O(stores x entries) in syscalls
+        #: (worst exactly on the gVisor-class hosts this module targets).
+        #: We scan only when the last measured total plus the bytes THIS
+        #: process has since published could exceed the cap; other
+        #: processes' concurrent writes are caught by their own
+        #: estimates and by our next scan.  The total is seeded from the
+        #: REAL directory contents at the first store (not zero): a
+        #: fresh Tier object over an already-full shared dir — the
+        #: service builds one per split — must not get a whole cap of
+        #: headroom it doesn't have.
+        self._last_known_total = None
+        self._bytes_since_check = 0
+        os.makedirs(root, exist_ok=True)
+        #: digest -> (mmap, ino, size): persistent read mappings (see
+        #: module docstring).  Guarded for the multi-threaded pools.
+        self._mappings = {}
+        self._lock = threading.Lock()
+
+    # pickling: a Tier crosses the ProcessPool boundary inside worker
+    # args; mappings and locks are per-process state.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state['_mappings'] = {}
+        del state['_lock']
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def entry_path(self, digest):
+        return os.path.join(self.root, digest + ENTRY_SUFFIX)
+
+    def _mapping_for(self, path, digest):
+        st = os.stat(path)  # raises FileNotFoundError -> miss
+        with self._lock:
+            cached = self._mappings.get(digest)
+            if cached is not None and cached[1] == (st.st_ino, st.st_size):
+                return cached[0]
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                mapping = mmap.mmap(fd, st.st_size, access=mmap.ACCESS_READ)
+            finally:
+                os.close(fd)
+            if cached is not None:
+                try:
+                    cached[0].close()
+                except BufferError:
+                    pass  # live views keep the old pages; map dies with GC
+            if len(self._mappings) >= 256:
+                self._gc_mappings()
+            self._mappings[digest] = (mapping, (st.st_ino, st.st_size))
+            return mapping
+
+    def _gc_mappings(self):
+        for digest in [d for d, (_, key) in self._mappings.items()
+                       if not os.path.exists(self.entry_path(d))]:
+            mapping, _ = self._mappings.pop(digest)
+            try:
+                mapping.close()
+            except BufferError:
+                pass
+
+    def lookup(self, digest):
+        """Decoded value (zero-copy over the cached mapping), or ``MISS``
+        (an entry may legitimately hold ``None``)."""
+        path = self.entry_path(digest)
+        try:
+            mapping = self._mapping_for(path, digest)
+            value = decode_entry(mapping)
+        except (FileNotFoundError, ValueError, OSError) as e:
+            if not isinstance(e, FileNotFoundError):
+                # Structurally impossible via atomic publish — quarantine.
+                logger.warning('%s tier: dropping corrupt entry %s (%s)',
+                               self.label, digest, e)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            return MISS
+        try:
+            os.utime(path)  # LRU touch
+        except OSError:
+            pass
+        return value
+
+    def store(self, digest, blob):
+        """Atomically publish ``blob``; False degrades (cap/ENOSPC)."""
+        nbytes = len(blob)
+        if nbytes + 4096 > self.capacity_bytes:
+            self.store_failures += 1
+            return False
+        tmp = os.path.join(self.root, '.tmp.%d.%s'
+                           % (os.getpid(), uuid.uuid4().hex[:8]))
+        try:
+            fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            try:
+                # Writer-liveness token for sweep_residue: released by the
+                # kernel on ANY death, so a sweeper can tell a crashed
+                # writer's tmp from one mid-write (same idiom as the shm
+                # result plane's slab locks).
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_SH | fcntl.LOCK_NB)
+                except OSError:
+                    pass
+                # os.write may write SHORT (2 GiB single-write cap,
+                # near-full filesystems) without raising — publishing a
+                # truncated entry would churn decode+rewrite forever.
+                view = memoryview(blob)
+                while len(view):
+                    view = view[os.write(fd, view):]
+                # Publish while the fd — and hence the liveness flock —
+                # is still open (the lock lives on the file, not the
+                # name, so it survives the rename): closing first would
+                # leave a window where a cross-pid-namespace sweeper
+                # sees an unlocked live tmp and reaps it mid-publish.
+                os.replace(tmp, self.entry_path(digest))
+            finally:
+                os.close(fd)
+        except OSError as e:
+            # ENOSPC (a full /dev/shm hot tier especially) must degrade,
+            # never raise into the decode path.
+            self.store_failures += 1
+            logger.debug('%s tier: store of %s failed (%s)', self.label,
+                         digest, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        if self._last_known_total is None:
+            seeded = _SEED_TOTALS.get(self.root)
+            if seeded is not None \
+                    and time.monotonic() - seeded[0] < 30.0:
+                # A sibling Tier over the same root measured recently:
+                # reuse its total (+ this store) instead of re-scanning.
+                self._last_known_total = seeded[1] + nbytes
+            else:
+                # usage() already counts the entry just published above.
+                self._last_known_total = self.usage()[1]
+                _SEED_TOTALS[self.root] = (time.monotonic(),
+                                           self._last_known_total)
+        else:
+            self._bytes_since_check += nbytes
+        if self._last_known_total + self._bytes_since_check \
+                > self.capacity_bytes:
+            self._evict_if_needed()
+        return True
+
+    def _evict_if_needed(self):
+        """LRU-unlink entries past the cap, under the tier's evict flock
+        so concurrent processes don't double-scan; an flock held elsewhere
+        means reclaim is already running — skip, don't wait."""
+        guard = os.path.join(self.root, '.evict' + LOCK_SUFFIX)
+        try:
+            fd = os.open(guard, os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError:
+            return
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return
+            entries, total = [], 0
+            for name in os.listdir(self.root):
+                if not name.endswith(ENTRY_SUFFIX):
+                    continue
+                full = os.path.join(self.root, name)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                entries.append((st.st_atime, st.st_size, full))
+                total += st.st_size
+            self._bytes_since_check = 0
+            if total <= self.capacity_bytes:
+                self._last_known_total = total
+                _SEED_TOTALS[self.root] = (time.monotonic(), total)
+                return
+            for _, size, full in sorted(entries):  # oldest access first
+                try:
+                    os.unlink(full)
+                except OSError:
+                    continue
+                # The key's single-flight lock file goes with its entry.
+                try:
+                    os.unlink(full[:-len(ENTRY_SUFFIX)] + LOCK_SUFFIX)
+                except OSError:
+                    pass
+                self.evictions += 1
+                total -= size
+                if total <= self.capacity_bytes:
+                    break
+            self._last_known_total = total
+            _SEED_TOTALS[self.root] = (time.monotonic(), total)
+        finally:
+            os.close(fd)
+
+    def sweep(self):
+        """Unlink crash/degrade residue; returns the removed names.
+
+        Two classes: ``.tmp.<pid>.*`` files whose writer died
+        mid-publish (pid liveness first, then an flock probe — a writer
+        in another pid namespace holds the shared lock its death
+        releases), and *orphaned single-flight lock files* — a key whose
+        store degraded (full plane) publishes no entry, so eviction
+        never reclaims its lock; left alone they accumulate one inode
+        per distinct missed key forever.  A lock is orphaned when it has
+        no published entry, is at least an hour old (a filler between
+        open and flock must not lose its lock), and its flock is free.
+        """
+        removed = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return removed
+        now = time.time()
+        for name in names:
+            full = os.path.join(self.root, name)
+            if name.startswith('.tmp.'):
+                try:
+                    pid = int(name.split('.')[2])
+                except (IndexError, ValueError):
+                    pid = None
+                if pid is not None and _pid_alive(pid):
+                    continue
+            elif name.endswith(LOCK_SUFFIX) \
+                    and not name.startswith('.evict'):
+                entry = full[:-len(LOCK_SUFFIX)] + ENTRY_SUFFIX
+                try:
+                    if os.path.exists(entry) \
+                            or now - os.stat(full).st_mtime < 3600:
+                        continue
+                except OSError:
+                    continue
+            else:
+                continue
+            try:
+                fd = os.open(full, os.O_RDONLY)
+            except OSError:
+                continue
+            try:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    continue  # owner alive (possibly in another pid ns)
+                os.unlink(full)
+                removed.append(name)
+            except OSError:
+                pass
+            finally:
+                os.close(fd)
+        return removed
+
+    def usage(self):
+        """(entry_count, total_bytes) of published entries."""
+        count = total = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0, 0
+        for name in names:
+            if name.endswith(ENTRY_SUFFIX):
+                try:
+                    total += os.stat(os.path.join(self.root, name)).st_size
+                    count += 1
+                except OSError:
+                    pass
+        return count, total
+
+    def clear(self):
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if name.endswith((ENTRY_SUFFIX, LOCK_SUFFIX)) \
+                    or name.startswith('.tmp.'):
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass
+
+
+# -- the plane ----------------------------------------------------------------
+
+def default_ram_dir(disk_root):
+    """Hot-tier directory derived from the disk root: every process
+    sharing the disk tier lands on the same /dev/shm directory."""
+    digest = hashlib.blake2b(os.path.abspath(disk_root).encode(),
+                             digest_size=6).hexdigest()
+    return os.path.join('/dev/shm', SHM_CACHE_PREFIX + digest)
+
+
+class CachePlane(object):
+    """Hot shm tier over a disk tier, with single-flight get-or-fill.
+
+    Args:
+        disk_dir: the disk tier's directory (shared across processes —
+            this path IS the plane's identity).
+        disk_capacity_bytes / ram_capacity_bytes: per-tier byte caps
+            (LRU past them).  ``ram_capacity_bytes=0`` disables the hot
+            tier; it is also disabled when ``/dev/shm`` is unusable or
+            ``PETASTORM_TPU_NO_SHM=1`` (the result plane's kill switch
+            governs this plane's shm use too).
+        context: digest prefix mixed into every key — the dataset/spec
+            fingerprint (see ``cache_plane.fingerprint``).
+        fill_wait_s: bound on waiting for another process's in-flight
+            fill of the same key before decoding directly.
+    """
+
+    def __init__(self, disk_dir, disk_capacity_bytes=DEFAULT_DISK_CAPACITY,
+                 ram_capacity_bytes=DEFAULT_RAM_CAPACITY, ram_dir=None,
+                 context='', fill_wait_s=30.0):
+        if not disk_dir:
+            raise ValueError("cache_location is required for "
+                             "cache_type='plane'")
+        try:
+            self.disk = Tier(disk_dir, disk_capacity_bytes or
+                             DEFAULT_DISK_CAPACITY, 'disk')
+        except OSError as e:
+            # An uncreatable plane dir must not fail reader/worker
+            # construction — the documented fallback is decode-direct,
+            # not a dead pipeline.  (The single-flight locks live in the
+            # disk root, so no disk tier means no plane at all.)
+            logger.warning('cache plane: disk tier %r unavailable (%s); '
+                           'serving every request uncached', disk_dir, e)
+            self.disk = None
+        self.ram = None
+        from petastorm_tpu.workers_pool import shm_plane
+        if self.disk is not None and ram_capacity_bytes \
+                and shm_plane.available():
+            try:
+                self.ram = Tier(ram_dir or default_ram_dir(disk_dir),
+                                ram_capacity_bytes, 'ram')
+            except OSError as e:
+                logger.warning('cache plane: hot tier unavailable (%s); '
+                               'running disk-only', e)
+        self.context = context
+        self.fill_wait_s = float(fill_wait_s)
+        self.hits = 0
+        self.ram_hits = 0
+        self.misses = 0
+        self.single_flight_hits = 0
+        self.degraded = 0
+        self._promote_backoff_until = 0.0
+        # Construction sweeps crash residue — but per-split reader churn
+        # (the service builds one reader, hence one plane object, per
+        # split) must not listdir both tiers hundreds of times per
+        # epoch; a root swept in the last 30s in this process is clean
+        # enough.
+        now = time.monotonic()
+        for tier in self._tiers():
+            if now - _LAST_SWEEP.get(tier.root, -1e9) >= 30.0:
+                _LAST_SWEEP[tier.root] = now
+                tier.sweep()
+
+    def _tiers(self):
+        return [t for t in (self.ram, self.disk) if t is not None]
+
+    def digest(self, key):
+        return hashlib.blake2b(
+            ('%s|%s' % (self.context, key)).encode('utf-8', 'replace'),
+            digest_size=16).hexdigest()
+
+    def _lookup(self, digest, promote=True):
+        if self.ram is not None:
+            value = self.ram.lookup(digest)
+            if value is not MISS:
+                self.ram_hits += 1
+                return value
+        value = self.disk.lookup(digest)
+        if value is not MISS and promote and self.ram is not None \
+                and time.monotonic() >= self._promote_backoff_until:
+            # Promote via the disk mapping's bytes; a failed store (hot
+            # tier full) simply leaves the entry disk-only.  Gated
+            # against thrash: entries bigger than 1/8 of the hot tier
+            # never promote (they'd evict the whole working set), and a
+            # promotion that itself triggered an eviction means the hot
+            # tier is at capacity churn — back off instead of cycling
+            # multi-MB copies through /dev/shm on every disk hit.
+            # The copy happens under the tier lock (a concurrent
+            # _mapping_for remap closes superseded mmaps under the same
+            # lock; a closed mmap raises ValueError, which must stay
+            # inside cache machinery either way).
+            try:
+                with self.disk._lock:
+                    mapping = self.disk._mappings[digest][0]
+                    blob = (bytes(memoryview(mapping))
+                            if len(mapping) * 8 <= self.ram.capacity_bytes
+                            else None)
+                if blob is not None:
+                    before = self.ram.evictions
+                    self.ram.store(digest, blob)
+                    if self.ram.evictions > before:
+                        self._promote_backoff_until = \
+                            time.monotonic() + 30.0
+            except (KeyError, ValueError, OSError):
+                pass
+        return value
+
+    def get_or_fill(self, key, fill):
+        """The plane's whole contract in one call: hit either tier, or
+        decode exactly once across processes, or degrade to a direct
+        decode — never block past ``fill_wait_s``, never raise from
+        cache machinery into the decode path."""
+        if self.disk is None:  # plane dir unavailable: decode-direct
+            self.degraded += 1
+            self.misses += 1
+            return fill()
+        digest = self.digest(key)
+        value = self._lookup(digest)
+        if value is not MISS:
+            self.hits += 1
+            return value
+        lock_path = os.path.join(self.disk.root, digest + LOCK_SUFFIX)
+        lock_fd = None
+        try:
+            try:
+                lock_fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+            except OSError:
+                # Can't even CREATE the lock file (read-only mount, bad
+                # ownership): nobody is filling — waiting would stall
+                # every miss for fill_wait_s.  Decode directly.
+                self.degraded += 1
+                self.misses += 1
+                return fill()
+            try:
+                fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(lock_fd)
+                lock_fd = None
+                # Another process is filling this key: poll the PUBLISHED
+                # path (it lands before the lock releases) with the
+                # holder's death as the other exit (flock dies with it).
+                deadline = time.monotonic() + self.fill_wait_s
+                while time.monotonic() < deadline:
+                    value = self._lookup(digest)
+                    if value is not MISS:
+                        self.hits += 1
+                        self.single_flight_hits += 1
+                        return value
+                    try:
+                        lock_fd = os.open(lock_path,
+                                          os.O_CREAT | os.O_RDWR, 0o644)
+                    except OSError:
+                        break  # lock file unreachable now: degrade
+                    try:
+                        fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        break  # holder gone (done or dead): our turn
+                    except OSError:
+                        os.close(lock_fd)
+                        lock_fd = None
+                        time.sleep(0.02)
+                if lock_fd is None:
+                    # Still locked past the deadline (or the lock file
+                    # vanished from under us): decode directly — a
+                    # wedged peer must not block this epoch.
+                    self.degraded += 1
+                    self.misses += 1
+                    return fill()
+            # Holding the key lock: re-check (the previous holder may
+            # have published while we acquired), then fill + publish.
+            value = self._lookup(digest)
+            if value is not MISS:
+                self.hits += 1
+                self.single_flight_hits += 1
+                return value
+            self.misses += 1
+            value = fill()
+            try:
+                blob = encode_entry(value)
+            except Exception as e:  # noqa: BLE001 — unencodable: degrade
+                logger.warning('cache plane: cannot encode entry for %r '
+                               '(%s); serving uncached', key, e)
+                self.degraded += 1
+                return value
+            if not self.disk.store(digest, blob):
+                self.degraded += 1
+            # Same thrash gate as the disk->ram promotion in _lookup:
+            # oversized entries never enter the hot tier, and a store
+            # that itself evicts puts hot-tier writes on backoff.
+            if self.ram is not None \
+                    and len(blob) * 8 <= self.ram.capacity_bytes \
+                    and time.monotonic() >= self._promote_backoff_until:
+                before = self.ram.evictions
+                self.ram.store(digest, blob)
+                if self.ram.evictions > before:
+                    self._promote_backoff_until = time.monotonic() + 30.0
+            return value
+        finally:
+            if lock_fd is not None:
+                os.close(lock_fd)  # closing drops the flock
+
+    @property
+    def evictions(self):
+        return sum(t.evictions for t in self._tiers())
+
+    @property
+    def stats(self):
+        """The diagnostics counters surfaced by readers, the service
+        worker heartbeat, and the JAX loader."""
+        out = {'cache_hits': self.hits, 'cache_misses': self.misses,
+               'cache_evictions': self.evictions,
+               'cache_ram_hits': self.ram_hits,
+               'cache_single_flight_hits': self.single_flight_hits,
+               'cache_degraded': self.degraded}
+        return out
+
+    def sweep(self):
+        """Reclaim crash residue in both tiers; returns removed names."""
+        removed = []
+        for tier in self._tiers():
+            removed.extend(tier.sweep())
+        return removed
+
+    def clear(self):
+        for tier in self._tiers():
+            tier.clear()
+
+
+class PlaneCache(CacheBase):
+    """``CacheBase`` adapter over a :class:`CachePlane` — what
+    ``cache_type='plane'`` resolves to.  Workers call ``get`` with their
+    per-piece keys; the plane's context digest carries the dataset/spec
+    fingerprint, so two readers with different transforms (or a
+    rewritten dataset) can share one plane directory safely."""
+
+    def __init__(self, path, size_limit_bytes=None, ram_bytes=None,
+                 context='', cleanup=False, fill_wait_s=30.0,
+                 **_compat_kwargs):
+        self.plane = CachePlane(
+            path,
+            disk_capacity_bytes=size_limit_bytes or DEFAULT_DISK_CAPACITY,
+            ram_capacity_bytes=(DEFAULT_RAM_CAPACITY if ram_bytes is None
+                                else ram_bytes),
+            context=context, fill_wait_s=fill_wait_s)
+        self._cleanup_on_exit = bool(cleanup)
+
+    def get(self, key, fill_cache_func):
+        return self.plane.get_or_fill(str(key), fill_cache_func)
+
+    @property
+    def stats(self):
+        return self.plane.stats
+
+    def cleanup(self):
+        if self._cleanup_on_exit:
+            self.plane.clear()
+
+
+def sweep_residue(disk_dir=None):
+    """Host-wide crash-residue report/reclaim, for the doctor.
+
+    Removes dead writers' tmp files from ``disk_dir`` (when given) and
+    its derived hot tier, plus any orphaned ``pstpu-cache-*`` hot-tier
+    tmp files and orphaned ``pstpu-shm-*`` result-plane slabs in
+    ``/dev/shm``.  Returns ``{'removed': [...], 'shm_slabs': [...]}``.
+    """
+    from petastorm_tpu.workers_pool import shm_plane
+    removed = []
+    roots = []
+    if disk_dir and os.path.isdir(disk_dir):
+        roots.append(('disk', disk_dir))
+        ram_root = default_ram_dir(disk_dir)
+        if os.path.isdir(ram_root):
+            roots.append(('ram', ram_root))
+    try:
+        for name in os.listdir(shm_plane.SHM_DIR):
+            full = os.path.join(shm_plane.SHM_DIR, name)
+            if name.startswith(SHM_CACHE_PREFIX) and os.path.isdir(full) \
+                    and full not in [r for _, r in roots]:
+                roots.append(('ram', full))
+    except OSError:
+        pass
+    for label, root in roots:
+        for name in Tier(root, 1, label).sweep():
+            removed.append(os.path.join(root, name))
+    slabs = shm_plane.sweep_orphans() if shm_plane.available() else []
+    return {'removed': removed, 'shm_slabs': slabs}

@@ -609,6 +609,18 @@ class DataLoader(object):
 
     # -- lifecycle -----------------------------------------------------------
 
+    @property
+    def diagnostics(self):
+        """The loader's per-stage ``stats`` merged with the reader's pool
+        diagnostics — including the epoch-cache plane counters
+        (``cache_hits`` / ``cache_misses`` / ``cache_evictions``) when
+        the underlying reader runs ``cache_type='plane'``, so one gauge
+        read says whether this epoch decoded or served warm."""
+        out = dict(self.stats)
+        if self.reader is not None:
+            out.update(getattr(self.reader, 'diagnostics', None) or {})
+        return out
+
     def __enter__(self):
         return self
 
@@ -1149,16 +1161,16 @@ class DeviceInMemDataLoader(InMemDataLoader):
             # tail batch scan would drop — a cursor AT the full-batch count
             # then means every scannable step is done and the epoch
             # completes with no dispatch.  Only a drop_last=False pass can
-            # legitimately produce that cursor, so the token must have been
-            # TAKEN under drop_last=False to accept it (ADVICE r05: a stale
-            # token from a drop_last=True run would otherwise silently
-            # complete the epoch with zero dispatched steps); tokens
-            # predating the recorded flag keep the lax acceptance.  Any
-            # cursor past the geometry's legitimate maximum is a changed
-            # dataset/batch shape, the same error the per-step iterator
-            # raises for it.
+            # legitimately produce that cursor, so the token must RECORD
+            # drop_last=False to accept it (ADVICE r05 #1): a stale/forged
+            # token from a drop_last=True run — or one predating the
+            # recorded flag, whose provenance cannot be verified — would
+            # otherwise silently complete the epoch with zero dispatched
+            # steps.  Any cursor past the geometry's legitimate maximum is
+            # a changed dataset/batch shape, the same error the per-step
+            # iterator raises for it.
             ragged_tail = (bool(n % self.batch_size)
-                           and self._token_drop_last is not True)
+                           and self._token_drop_last is False)
             max_cursor = steps if ragged_tail else steps - 1
             if start > max_cursor:
                 raise ValueError(

@@ -68,16 +68,39 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, zmq_copy_buf
 
 
 def _resolve_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
-                   cache_extra_settings):
+                   cache_extra_settings, plane_context=''):
     if cache_type in (None, 'null', 'none'):
         return NullCache()
     if cache_type == 'local-disk':
         from petastorm_tpu.local_disk_cache import LocalDiskCache
         return LocalDiskCache(cache_location, cache_size_limit, cache_row_size_estimate,
                               **(cache_extra_settings or {}))
+    if cache_type == 'plane':
+        # The tiered epoch-cache plane: shared across worker processes,
+        # the data service, and consumer restarts; keyed by content
+        # fingerprint so a rewritten dataset or changed transform misses
+        # instead of serving stale rows (petastorm_tpu/cache_plane/).
+        from petastorm_tpu.cache_plane import PlaneCache
+        return PlaneCache(cache_location, cache_size_limit,
+                          context=plane_context,
+                          **(cache_extra_settings or {}))
     if hasattr(cache_type, 'get'):
         return cache_type  # user-provided CacheBase instance
-    raise ValueError("cache_type must be 'null' or 'local-disk', got %r" % (cache_type,))
+    raise ValueError("cache_type must be 'null', 'local-disk' or 'plane', "
+                     "got %r" % (cache_type,))
+
+
+def _plane_context(cache_type, fs, pieces, schema_view, predicate,
+                   transform_spec):
+    """Content-fingerprint prefix for ``cache_type='plane'`` keys: dataset
+    file identity (path+mtime+size) x decode identity (columns, predicate,
+    transform).  Computed only when the plane is in play — it stats every
+    distinct data file once."""
+    if cache_type != 'plane':
+        return ''
+    from petastorm_tpu.cache_plane import dataset_fingerprint, spec_token
+    return '%s:%s' % (dataset_fingerprint(fs, {p.path for p in pieces}),
+                      spec_token(schema_view, predicate, transform_spec))
 
 
 def _shard_indices(num_pieces, cur_shard, shard_count, shard_seed=None):
@@ -221,7 +244,10 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
             'No row groups to read from %r after sharding/selection' % (dataset_url,))
 
     cache = _resolve_cache(cache_type, cache_location, cache_size_limit,
-                           cache_row_size_estimate, cache_extra_settings)
+                           cache_row_size_estimate, cache_extra_settings,
+                           plane_context=_plane_context(
+                               cache_type, fs, pieces, schema_view,
+                               predicate, transform_spec))
 
     if columnar_decode and ngram is not None:
         raise ValueError('columnar_decode is incompatible with NGram windows')
@@ -347,7 +373,10 @@ def make_batch_reader(dataset_url_or_urls,
             'No row groups to read from %r after sharding/selection' % (dataset_url_or_urls,))
 
     cache = _resolve_cache(cache_type, cache_location, cache_size_limit,
-                           cache_row_size_estimate, cache_extra_settings)
+                           cache_row_size_estimate, cache_extra_settings,
+                           plane_context=_plane_context(
+                               cache_type, fs, pieces, schema_view,
+                               predicate, transform_spec))
     worker_args = BatchWorkerArgs(filesystem=fs, pieces=pieces, schema=stored_schema,
                                   schema_view=schema_view, transform_spec=transform_spec,
                                   predicate=predicate, cache=cache,
@@ -668,6 +697,14 @@ class Reader(object):
     @property
     def diagnostics(self):
         d = dict(self._pool.diagnostics)
+        # Epoch-cache plane counters (cache_type='plane'): hit/miss/evict
+        # gauges of THIS process's view of the shared plane (thread-pool
+        # readers see every worker's traffic; ProcessPool children count
+        # in their own processes — use the service/dispatcher stats for a
+        # fleet-wide view).
+        cache_stats = getattr(self._cache, 'stats', None)
+        if cache_stats:
+            d.update(cache_stats)
         d['ventilated_count'] = self._ventilator.ventilated_count
         token = self._ventilator.state_dict()
         # the prologue item list is data, not a gauge — report its length

@@ -39,6 +39,15 @@ def _build_parser():
                    choices=('auto', 'reader', 'batch_reader'))
     d.add_argument('--workers-count', type=int, default=None,
                    help='decode threads per split reader on each worker')
+    d.add_argument('--cache-plane-dir', default=None,
+                   help='enable the tiered epoch-cache plane: decode '
+                        'workers publish decoded batches under this '
+                        '(host-local) directory and serve later '
+                        'epochs/runs from it (petastorm_tpu/cache_plane)')
+    d.add_argument('--cache-plane-ram-bytes', type=int, default=None,
+                   help='hot /dev/shm tier cap (default 128 MiB)')
+    d.add_argument('--cache-plane-disk-bytes', type=int, default=None,
+                   help='disk tier cap (default 4 GiB)')
 
     w = sub.add_parser('worker', help='run one decode worker')
     w.add_argument('--dispatcher', required=True,
@@ -95,7 +104,11 @@ def main(argv=None):
             lease_ttl_s=args.lease_ttl_s,
             credits=args.credits,
             reader_factory=args.reader_factory,
-            reader_kwargs=reader_kwargs)
+            reader_kwargs=reader_kwargs,
+            cache_plane=args.cache_plane_dir is not None,
+            cache_plane_dir=args.cache_plane_dir,
+            cache_plane_ram_bytes=args.cache_plane_ram_bytes,
+            cache_plane_disk_bytes=args.cache_plane_disk_bytes)
         with Dispatcher(config, bind=args.bind) as dispatcher:
             print('dispatcher serving %s (%d splits, %d consumers)'
                   % (dispatcher.addr, dispatcher._job['num_splits'],

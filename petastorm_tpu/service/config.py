@@ -65,6 +65,24 @@ class ServiceConfig:
         shm_capacity_bytes: per-worker cap on shm bytes written but not
             yet mapped by a client; beyond it chunks degrade to the byte
             path instead of blocking decode.
+        cache_plane: opt-in to the tiered epoch-cache plane
+            (``petastorm_tpu/cache_plane/``): every worker's per-split
+            reader runs with ``cache_type='plane'`` over
+            ``cache_plane_dir``, so a split decoded once is served from
+            the shared cache by ANY worker on the host for every later
+            epoch/run against the same dataset bytes.  The dispatcher's
+            lease is the per-piece decode-ownership grant (a split —
+            and hence each of its row groups — is leased to exactly one
+            worker per epoch); the plane's cross-process single-flight
+            lock backs that up across overlapping service runs.  A cold
+            or full plane degrades per-piece to direct decode + the
+            existing byte/shm delivery path — never blocks.
+        cache_plane_dir: the shared plane directory (disk tier root; the
+            hot ``/dev/shm`` tier is derived from it).  Required when
+            ``cache_plane=True``.  Workers on different hosts may point
+            at host-local paths — the plane is a same-host cache.
+        cache_plane_ram_bytes / cache_plane_disk_bytes: per-tier byte
+            caps (None = the plane's defaults: 128 MiB hot, 4 GiB disk).
     """
 
     dataset_url: str
@@ -80,6 +98,10 @@ class ServiceConfig:
     reader_kwargs: dict = dataclasses.field(default_factory=dict)
     shm: bool = True
     shm_capacity_bytes: int = 256 << 20
+    cache_plane: bool = False
+    cache_plane_dir: str = None
+    cache_plane_ram_bytes: int = None
+    cache_plane_disk_bytes: int = None
 
     def __post_init__(self):
         if self.num_consumers < 1:
@@ -97,6 +119,8 @@ class ServiceConfig:
                              "'batch_reader', got %r" % (self.reader_factory,))
         if self.shm_capacity_bytes < 1:
             raise ValueError('shm_capacity_bytes must be positive')
+        if self.cache_plane and not self.cache_plane_dir:
+            raise ValueError('cache_plane=True requires cache_plane_dir')
         if self.heartbeat_interval_s is None:
             self.heartbeat_interval_s = self.lease_ttl_s / 3.0
 
@@ -126,5 +150,9 @@ class ServiceConfig:
             'reader_kwargs': dict(self.reader_kwargs),
             'shm': bool(self.shm),
             'shm_capacity_bytes': int(self.shm_capacity_bytes),
+            'cache_plane': bool(self.cache_plane),
+            'cache_plane_dir': self.cache_plane_dir,
+            'cache_plane_ram_bytes': self.cache_plane_ram_bytes,
+            'cache_plane_disk_bytes': self.cache_plane_disk_bytes,
             'fingerprint': self.fingerprint(num_splits),
         }

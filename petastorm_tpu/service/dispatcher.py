@@ -22,6 +22,16 @@ worker is rejected once the split has moved on.  Exactly-once *delivery*
 is finished on the client side (whole-split commit + dedupe by split id);
 the dispatcher guarantees exactly-once *assignment* per attempt and
 at-least-once decode.
+
+The lease doubles as the **per-piece decode-ownership grant** for the
+epoch-cache plane (``ServiceConfig(cache_plane=True)``): every row group
+belongs to exactly one split and every split is leased to exactly one
+worker per attempt, so exactly one worker decodes (and publishes) each
+piece per epoch; every other worker — this run or the next — serves it
+as a cache hit.  The plane's cross-process single-flight lock covers the
+residual races (lease churn, overlapping epochs/runs), and a cold or
+full plane degrades that piece to direct decode — see
+``docs/data_service.md`` for the ownership/fallback matrix.
 """
 
 import collections
@@ -352,6 +362,13 @@ class Dispatcher(object):
                                  age_s=round(time.monotonic()
                                              - w['last_heartbeat'], 3))
                        for wid, w in self._workers.items()}
+        # Fleet-wide epoch-cache plane counters (jobs with cache_plane):
+        # summed from the per-worker heartbeat stats, so one `status`
+        # call says whether this epoch is being decoded or served warm.
+        cache = {key: sum(int(w.get(key, 0)) for w in workers.values())
+                 for key in ('cache_hits', 'cache_misses',
+                             'cache_evictions', 'cache_ram_hits',
+                             'cache_degraded')}
         return {
             'num_splits': len(self._splits),
             'pending': states[_PENDING],
@@ -359,6 +376,7 @@ class Dispatcher(object):
             'done': states[_DONE],
             'failed': states[_FAILED],
             'lease_churn': self.lease_churn,
+            'cache': cache,
             'workers': workers,
         }
 

@@ -174,6 +174,12 @@ class Worker(object):
         #: dict is safe under the GIL for this flag traffic).
         self._shm_consumers = {}
         self._shm_chunks = 0
+        #: epoch-cache plane counters accumulated across per-split
+        #: readers (job['cache_plane']); shipped in every heartbeat so
+        #: the dispatcher's ``stats`` RPC can aggregate fleet-wide.
+        self._cache_stats = {'cache_hits': 0, 'cache_misses': 0,
+                             'cache_evictions': 0, 'cache_ram_hits': 0,
+                             'cache_degraded': 0}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -515,6 +521,30 @@ class Worker(object):
                 return b'S', pickle.dumps(desc, protocol=4)
         return serialize_chunk(chunk)
 
+    def _reader_kwargs(self, job):
+        """Per-split reader kwargs; with ``job['cache_plane']`` the reader
+        consults the shared epoch-cache plane before hitting Parquet —
+        the cache-hit half of the ownership contract (the dispatcher's
+        lease is the decode half: each piece is DECODED by exactly one
+        worker per epoch, and any worker can SERVE it warm afterwards).
+        Explicit cache settings in ``reader_kwargs`` win."""
+        kwargs = dict(job['reader_kwargs'])
+        if job.get('cache_plane') and 'cache_type' not in kwargs:
+            kwargs['cache_type'] = 'plane'
+            kwargs.setdefault('cache_location', job['cache_plane_dir'])
+            kwargs.setdefault('cache_size_limit',
+                              job.get('cache_plane_disk_bytes'))
+            extra = dict(kwargs.get('cache_extra_settings') or {})
+            extra.setdefault('ram_bytes', job.get('cache_plane_ram_bytes'))
+            kwargs['cache_extra_settings'] = extra
+        return kwargs
+
+    def _accumulate_cache_stats(self, reader):
+        stats = getattr(getattr(reader, '_cache', None), 'stats', None)
+        if stats:
+            for key in self._cache_stats:
+                self._cache_stats[key] += int(stats.get(key, 0))
+
     def _decode_loop(self, job, decode_in, decode_out):
         while True:
             split = decode_in.get()
@@ -527,7 +557,7 @@ class Worker(object):
                 reader = self._reader_factory(
                     job['dataset_url'], piece_indices=split['indices'],
                     num_epochs=1, shuffle_row_groups=False,
-                    **job['reader_kwargs'])
+                    **self._reader_kwargs(job))
                 seq = 0
                 rows = 0
                 with reader:
@@ -540,6 +570,7 @@ class Worker(object):
                         decode_out.put(('chunk', split, seq, tag, payload))
                         seq += 1
                 decode_out.put(('end', split, seq, rows))
+                self._accumulate_cache_stats(reader)
                 self._rows_decoded += rows
                 self._splits_decoded += 1
                 if self._trace is not None:
@@ -566,4 +597,14 @@ class Worker(object):
             'shm_chunks': int(self._shm_chunks),
             'shm_degraded': (self._arena.degraded
                              if self._arena is not None else 0),
+            # Epoch-cache plane traffic of this worker's split readers
+            # (all zero unless the job enables cache_plane).
+            # cache_degraded matters most fleet-wide: it is the only
+            # signal that a plane is silently OFF (unwritable dir, full
+            # tiers) while hits/misses still look plausible.
+            'cache_hits': int(self._cache_stats['cache_hits']),
+            'cache_misses': int(self._cache_stats['cache_misses']),
+            'cache_evictions': int(self._cache_stats['cache_evictions']),
+            'cache_ram_hits': int(self._cache_stats['cache_ram_hits']),
+            'cache_degraded': int(self._cache_stats['cache_degraded']),
         }

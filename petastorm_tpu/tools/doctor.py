@@ -23,6 +23,10 @@ Sections (each contained — a dead plane is reported, not fatal):
   live backend): the transport term of streaming stall.
 * **advisor** — with both planes measured: the bottleneck verdict +
   prescriptions (``benchmark.diagnose``) for a short stall-free pass.
+* **cache_plane** — the tiered epoch-cache plane's environment: tier
+  directories writable (``--cache-plane-dir``), ``/dev/shm`` headroom
+  for the hot tier and the shm result plane, and a crash-residue sweep
+  report (orphaned result-plane slabs, dead writers' tmp files).
 """
 
 import argparse
@@ -139,12 +143,72 @@ def _check_h2d(batch_mb):
                     'min(host_plane.rows_per_s, h2d/bytes_per_row)'}
 
 
+def _check_cache_plane(plane_dir):
+    """Environment of the tiered epoch-cache plane (``cache_plane/``):
+    can the tiers actually be written, is there ``/dev/shm`` headroom
+    for the hot tier, and what crash residue did the sweep reclaim.
+    Runs without ``--cache-plane-dir`` too — the headroom and orphan
+    sweep describe the host, not one plane."""
+    import os
+
+    from petastorm_tpu.cache_plane import sweep_residue
+    from petastorm_tpu.cache_plane.plane import default_ram_dir
+    from petastorm_tpu.workers_pool import shm_plane
+
+    out = {}
+    if shm_plane.available():
+        st = os.statvfs(shm_plane.SHM_DIR)
+        free = st.f_bavail * st.f_frsize
+        out['shm_free_bytes'] = free
+        out['shm_headroom_ok'] = bool(free >= 128 << 20)
+        if not out['shm_headroom_ok']:
+            out['shm_note'] = ('< 128 MiB free in /dev/shm: the hot tier '
+                               'and the shm result plane will degrade; '
+                               'sweep or shrink ram_bytes')
+    else:
+        out['shm_note'] = ('/dev/shm unusable or PETASTORM_TPU_NO_SHM=1: '
+                           'plane runs disk-only')
+    if plane_dir:
+        tiers = {'disk_tier': plane_dir, 'ram_tier': default_ram_dir(plane_dir)}
+        for label, root in tiers.items():
+            try:
+                os.makedirs(root, exist_ok=True)
+                probe = os.path.join(root, '.doctor-probe')
+                with open(probe, 'w'):
+                    pass
+                os.unlink(probe)
+                writable = True
+            except OSError as e:
+                writable = False
+                out[label + '_error'] = str(e)
+            out[label] = root
+            out[label + '_writable'] = writable
+        try:
+            out['disk_tier_entries'] = len(
+                [f for f in os.listdir(plane_dir) if f.endswith('.cpe')])
+        except OSError:
+            # The unwritable/uncreatable dir IS the finding — the probe
+            # results above must survive, not be replaced by this error.
+            pass
+    swept = sweep_residue(plane_dir)
+    out['swept_tmp_files'] = len(swept['removed'])
+    out['swept_orphan_slabs'] = len(swept['shm_slabs'])
+    if swept['removed'] or swept['shm_slabs']:
+        out['sweep_note'] = ('reclaimed crash residue: %d tmp file(s), '
+                             '%d orphaned shm slab(s)'
+                             % (len(swept['removed']),
+                                len(swept['shm_slabs'])))
+    return out
+
+
 def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
-               batch_size=64, h2d_mb=32):
+               batch_size=64, h2d_mb=32, cache_plane_dir=None):
     """Run every applicable section; returns the report dict."""
     report = {}
     _contained(report, 'backend', lambda: _check_backend(probe_timeout_s))
     _contained(report, 'native', _check_native)
+    _contained(report, 'cache_plane',
+               lambda: _check_cache_plane(cache_plane_dir))
     if dataset_url:
         advisor = {}
         _contained(report, 'host_plane',
@@ -193,6 +257,11 @@ def main(argv=None):
     parser.add_argument('--seconds', type=float, default=5.0,
                         help='host-plane sampling window')
     parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--cache-plane-dir', default=None,
+                        help='epoch-cache plane directory to check '
+                             '(tier writability + entry count); the '
+                             '/dev/shm headroom and orphan-sweep report '
+                             'run either way')
     parser.add_argument('--autotune', action='store_true',
                         help='also sweep reader configurations '
                              '(workers_count grid) on this host and '
@@ -204,7 +273,8 @@ def main(argv=None):
     report = run_doctor(dataset_url=args.dataset_url,
                         probe_timeout_s=args.probe_timeout,
                         sample_seconds=args.seconds,
-                        batch_size=args.batch_size)
+                        batch_size=args.batch_size,
+                        cache_plane_dir=args.cache_plane_dir)
     if args.autotune:
         _contained(report, 'autotune',
                    lambda: _check_autotune(args.dataset_url,

@@ -1,0 +1,364 @@
+"""Tiered epoch-cache plane (ISSUE 3 tentpole acceptance surface).
+
+The plane's core promises, each tested against real processes and real
+files: content-fingerprint invalidation (a rewritten dataset MISSES),
+size-capped LRU eviction, cross-process single-flight (one decode, every
+other process hits), crash safety (a SIGKILLed writer leaves no corrupt
+published entry and all residue sweeps clean), and non-blocking
+degradation (a full or contended plane serves direct decodes, never
+stalls an epoch).
+"""
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.cache_plane import (CachePlane, PlaneCache,
+                                       dataset_fingerprint, sweep_residue)
+from petastorm_tpu.cache_plane.plane import (ENTRY_SUFFIX, decode_entry,
+                                             encode_entry)
+
+from test_common import create_test_dataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('planeds')
+    return create_test_dataset('file://' + str(path), num_rows=30,
+                               rows_per_rowgroup=5)
+
+
+def _cache_counters(diag):
+    return {k: v for k, v in diag.items() if k.startswith('cache_')}
+
+
+def _read_ids(url, cache_dir, **extra):
+    with make_reader(url, num_epochs=1, workers_count=2,
+                     shuffle_row_groups=False, cache_type='plane',
+                     cache_location=cache_dir, **extra) as reader:
+        ids = sorted(int(row.id) for row in reader)
+        return ids, _cache_counters(reader.diagnostics)
+
+
+# -- entry codec --------------------------------------------------------------
+
+def test_entry_roundtrip_kinds():
+    import pyarrow as pa
+    cols = {'a': np.arange(6, dtype=np.float32).reshape(2, 3),
+            'b': np.array(['x', None], dtype=object)}
+    out = decode_entry(bytes(encode_entry(cols)))
+    np.testing.assert_array_equal(out['a'], cols['a'])
+    assert list(out['b']) == ['x', None]
+
+    table = pa.table({'x': [1, 2, 3]})
+    assert decode_entry(bytes(encode_entry(table))).equals(table)
+
+    assert decode_entry(bytes(encode_entry(None))) is None
+    assert decode_entry(bytes(encode_entry([{'r': 1}]))) == [{'r': 1}]
+
+
+def test_decoded_views_are_readonly(tmp_path):
+    """Plane hits are zero-copy views over the shared mapping; an
+    in-place mutation must fail loudly instead of corrupting every other
+    consumer's epoch."""
+    plane = CachePlane(str(tmp_path / 'p'), ram_capacity_bytes=0)
+    plane.get_or_fill('k', lambda: {'a': np.arange(8)})
+    hit = plane.get_or_fill('k', lambda: None)
+    assert not hit['a'].flags.writeable
+    with pytest.raises(ValueError):
+        hit['a'][0] = 99
+
+
+# -- fingerprint invalidation -------------------------------------------------
+
+def test_fingerprint_changes_on_mtime(dataset):
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    fs, _ = get_filesystem_and_path_or_paths(dataset.url)
+    files = glob.glob(dataset.path + '/*.parquet')
+    assert files
+    before = dataset_fingerprint(fs, files)
+    future = time.time() + 10
+    os.utime(files[0], (future, future))
+    assert dataset_fingerprint(fs, files) != before
+
+
+def test_reader_misses_after_dataset_mtime_change(tmp_path, dataset):
+    """The acceptance case: a warmed plane serves hits until the dataset
+    bytes change under it — then every key misses (stale entries are
+    unreachable, not served)."""
+    cache_dir = str(tmp_path / 'plane')
+    ids1, cold = _read_ids(dataset.url, cache_dir)
+    ids2, warm = _read_ids(dataset.url, cache_dir)
+    assert ids1 == ids2 == list(range(30))
+    assert cold['cache_misses'] == 6 and cold['cache_hits'] == 0
+    assert warm['cache_hits'] == 6 and warm['cache_misses'] == 0
+
+    future = time.time() + 10
+    for f in glob.glob(dataset.path + '/*.parquet'):
+        os.utime(f, (future, future))
+    ids3, after = _read_ids(dataset.url, cache_dir)
+    assert ids3 == ids1
+    assert after['cache_misses'] == 6 and after['cache_hits'] == 0
+
+
+def test_transform_identity_keys_separately(tmp_path, dataset):
+    """Two readers over one plane dir with different column selections
+    must not share entries (the spec token is part of the context)."""
+    cache_dir = str(tmp_path / 'plane')
+    _, first = _read_ids(dataset.url, cache_dir, schema_fields=['id'])
+    _, second = _read_ids(dataset.url, cache_dir,
+                          schema_fields=['id', 'id2'])
+    assert first['cache_misses'] == 6
+    assert second['cache_misses'] == 6 and second['cache_hits'] == 0
+
+
+def test_spec_token_stable_across_processes_and_distinct_per_func():
+    """The context must be identical in EVERY process (hash randomization
+    must not leak in via set ordering or function reprs — a per-process
+    context means silent 0%% cross-process hit rate) while distinct
+    function bodies/callees/constants stay distinct."""
+    child = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from petastorm_tpu.cache_plane.fingerprint import spec_token\n"
+        "from petastorm_tpu.predicates import in_set, in_lambda\n"
+        "print(spec_token(predicate=in_set({'cat','dog','ox','emu','bee'},"
+        " 'label')),\n"
+        "      spec_token(predicate=in_lambda(['label'],"
+        " lambda d: d['label'] in {'a','b','c','d'})),\n"
+        "      spec_token(predicate=in_lambda(['label'],"
+        " lambda d: d['label'] > 3)))\n" % REPO)
+    lines = set()
+    for seed in ('1', '2', '3'):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS='cpu')
+        out = subprocess.run([sys.executable, '-c', child], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr[-500:]
+        lines.add(out.stdout.strip())
+    assert len(lines) == 1, 'context differs across processes: %s' % lines
+    tokens = lines.pop().split()
+    assert len(set(tokens)) == 3, 'distinct predicates collided: %s' % tokens
+
+
+# -- LRU eviction -------------------------------------------------------------
+
+def test_lru_eviction_under_size_cap(tmp_path):
+    plane = CachePlane(str(tmp_path / 'p'), disk_capacity_bytes=300_000,
+                       ram_capacity_bytes=0)
+    for i in range(10):
+        plane.get_or_fill('key%d' % i,
+                          lambda: {'x': np.zeros(10_000)})  # ~80 KB each
+    entries = [f for f in os.listdir(plane.disk.root)
+               if f.endswith(ENTRY_SUFFIX)]
+    assert 0 < len(entries) < 10
+    assert plane.evictions > 0
+    # the newest key survived; an evicted key refills (miss, not error)
+    hit = plane.get_or_fill('key9', lambda: 'EVICTED')
+    assert isinstance(hit, dict), 'newest key should have survived LRU'
+    calls = []
+    plane.get_or_fill('key0', lambda: calls.append(1) or {'x': np.zeros(4)})
+    assert calls, 'evicted key must refill via the fill function'
+
+
+# -- cross-process single-flight ---------------------------------------------
+
+_FLIGHT_CHILD = r'''
+import os, sys, time
+import numpy as np
+sys.path.insert(0, sys.argv[4])
+from petastorm_tpu.cache_plane import CachePlane
+
+plane = CachePlane(sys.argv[1], ram_capacity_bytes=0)
+marker_dir = sys.argv[2]
+
+def fill():
+    open(os.path.join(marker_dir, 'fill.%d' % os.getpid()), 'w').close()
+    time.sleep(0.4)  # hold the flight long enough that peers must wait
+    return {'x': np.arange(32, dtype=np.int64)}
+
+value = plane.get_or_fill(sys.argv[3], fill)
+assert np.array_equal(value['x'], np.arange(32)), value
+print('HIT' if not os.path.exists(
+    os.path.join(marker_dir, 'fill.%d' % os.getpid())) else 'FILLED')
+'''
+
+
+def test_multiprocess_get_or_fill_single_decode(tmp_path):
+    """N processes race get-or-fill on ONE key: exactly one runs the fill
+    function, the rest serve the published entry."""
+    plane_dir, marker_dir = str(tmp_path / 'p'), str(tmp_path / 'm')
+    os.makedirs(marker_dir)
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    procs = [subprocess.Popen(
+        [sys.executable, '-c', _FLIGHT_CHILD, plane_dir, marker_dir,
+         'shared-key', REPO], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE) for _ in range(4)]
+    outs = [p.communicate(timeout=60) for p in procs]
+    assert all(p.returncode == 0 for p in procs), \
+        [e.decode()[-500:] for _, e in outs]
+    fills = os.listdir(marker_dir)
+    assert len(fills) == 1, 'expected a single decode, got %s' % fills
+    verdicts = sorted(o.decode().strip() for o, _ in outs)
+    assert verdicts == ['FILLED', 'HIT', 'HIT', 'HIT']
+
+
+# -- crash safety -------------------------------------------------------------
+
+_KILL_CHILD = r'''
+import fcntl, os, sys, time
+import numpy as np
+sys.path.insert(0, sys.argv[2])
+from petastorm_tpu.cache_plane import CachePlane
+from petastorm_tpu.cache_plane.plane import encode_entry
+
+plane = CachePlane(sys.argv[1])
+# one good published entry that must survive the crash intact
+plane.get_or_fill('survivor', lambda: {'x': np.arange(16)})
+# mid-publish state in EVERY tier: a partially-written tmp file whose
+# flock dies with this process (exactly what a SIGKILL inside
+# Tier.store leaves behind)
+blob = bytes(encode_entry({'x': np.zeros(4096)}))
+for tier in [t for t in (plane.ram, plane.disk) if t is not None]:
+    tmp = os.path.join(tier.root, '.tmp.%d.dead' % os.getpid())
+    fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    fcntl.flock(fd, fcntl.LOCK_SH | fcntl.LOCK_NB)
+    os.write(fd, blob[:100])  # truncated: mid-write
+    # fd stays open (and locked) until the SIGKILL
+# take the single-flight lock for another key, as a wedged fill would
+fcntl.flock(os.open(os.path.join(plane.disk.root,
+                                 plane.digest('wedged') + '.lock'),
+                    os.O_CREAT | os.O_RDWR), fcntl.LOCK_EX)
+print('READY', flush=True)
+time.sleep(120)
+'''
+
+
+def test_sigkilled_writer_sweeps_clean(tmp_path):
+    """SIGKILL a writer holding mid-publish tmp files (both tiers) and a
+    single-flight lock: published entries stay intact, the sweep removes
+    every tmp, and the orphaned lock never blocks a live filler."""
+    plane_dir = str(tmp_path / 'p')
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    child = subprocess.Popen([sys.executable, '-c', _KILL_CHILD, plane_dir,
+                              REPO], env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE)
+    assert child.stdout.readline().strip() == b'READY', \
+        child.stderr.read().decode()[-500:]
+    os.kill(child.pid, signal.SIGKILL)
+    child.wait(timeout=30)
+
+    plane = CachePlane(plane_dir)  # construction sweeps both tiers
+    tier_roots = [t.root for t in (plane.ram, plane.disk) if t is not None]
+    leftover = sweep_residue(plane_dir)  # idempotent second sweep
+    for root in tier_roots:
+        tmps = [f for f in os.listdir(root) if f.startswith('.tmp.')]
+        assert not tmps, 'un-swept crash residue in %s: %s' % (root, tmps)
+    # the published entry survived, uncorrupted
+    value = plane.get_or_fill('survivor', lambda: 'MISS')
+    np.testing.assert_array_equal(value['x'], np.arange(16))
+    # the dead child's exclusive lock is gone with it: a fill on that key
+    # proceeds immediately (no fill_wait_s stall)
+    t0 = time.monotonic()
+    assert plane.get_or_fill('wedged', lambda: 'fresh') == 'fresh'
+    assert time.monotonic() - t0 < 5.0
+    assert isinstance(leftover, dict)
+
+
+# -- degradation --------------------------------------------------------------
+
+def test_full_plane_degrades_to_direct_decode(tmp_path):
+    """A plane whose tiers cannot hold even one entry serves every call
+    by direct decode — correct values, bounded time, degraded counter."""
+    plane = CachePlane(str(tmp_path / 'p'), disk_capacity_bytes=64,
+                       ram_capacity_bytes=0)
+    t0 = time.monotonic()
+    for i in range(5):
+        value = plane.get_or_fill('k%d' % i, lambda i=i: {'x': np.full(4096, i)})
+        assert value['x'][0] == i
+    assert time.monotonic() - t0 < 5.0
+    assert plane.degraded == 5
+    assert not [f for f in os.listdir(plane.disk.root)
+                if f.endswith(ENTRY_SUFFIX)]
+
+
+def test_wedged_peer_does_not_block_past_deadline(tmp_path):
+    """A LIVE peer sitting on the single-flight lock past fill_wait_s
+    costs this process only the bounded wait, then it decodes directly."""
+    import fcntl
+    plane_dir = str(tmp_path / 'p')
+    plane = CachePlane(plane_dir, fill_wait_s=0.5)
+    digest = plane.digest('stuck-key')
+    fd = os.open(os.path.join(plane.disk.root, digest + '.lock'),
+                 os.O_CREAT | os.O_RDWR)
+    fcntl.flock(fd, fcntl.LOCK_EX)  # this process wedges the key forever
+    try:
+        t0 = time.monotonic()
+        assert plane.get_or_fill('stuck-key', lambda: 'direct') == 'direct'
+        elapsed = time.monotonic() - t0
+        assert 0.4 < elapsed < 5.0
+        assert plane.degraded == 1
+    finally:
+        os.close(fd)
+
+
+def test_unencodable_value_serves_uncached(tmp_path):
+    plane = CachePlane(str(tmp_path / 'p'), ram_capacity_bytes=0)
+    value = plane.get_or_fill('gen', lambda: (lambda: 1))  # unpicklable
+    assert callable(value)
+    assert plane.degraded == 1
+
+
+# -- service integration ------------------------------------------------------
+
+def test_service_warm_epoch_serves_cache_hits(tmp_path, dataset):
+    """Two service runs over one plane dir: run 1 decodes every piece
+    exactly once (the lease is the ownership grant), run 2 serves the
+    whole epoch from the plane — fleet stats say so."""
+    from petastorm_tpu.service import (Dispatcher, ServiceConfig,
+                                      ServiceDataLoader, Worker)
+    plane_dir = str(tmp_path / 'svcplane')
+
+    def run_epoch():
+        config = ServiceConfig(
+            dataset.url, num_consumers=1, rowgroups_per_split=2,
+            lease_ttl_s=2.0, reader_kwargs={'workers_count': 2},
+            cache_plane=True, cache_plane_dir=plane_dir)
+        with Dispatcher(config) as dispatcher:
+            worker = Worker(dispatcher.addr).start()
+            try:
+                loader = ServiceDataLoader(dispatcher.addr, batch_size=8,
+                                           consumer=0, drop_last=False)
+                ids = []
+                with loader:
+                    for batch in loader.iter_host_batches():
+                        ids.extend(np.asarray(batch['id']).tolist())
+                counters = _cache_counters(worker.diagnostics)
+            finally:
+                worker.stop()
+                worker.join()
+        return sorted(ids), counters
+
+    ids1, cold = run_epoch()
+    ids2, warm = run_epoch()
+    assert ids1 == ids2 == list(range(30))
+    assert cold['cache_misses'] == 6 and cold['cache_hits'] == 0
+    assert warm['cache_hits'] == 6 and warm['cache_misses'] == 0
+
+
+def test_plane_cache_pickles_across_pool_boundary(tmp_path):
+    """PlaneCache rides ProcessPool worker args; mappings/locks must not
+    pickle, counters and tier config must."""
+    import pickle
+    cache = PlaneCache(str(tmp_path / 'p'), ram_bytes=0)
+    cache.get('k', lambda: {'x': np.arange(4)})
+    clone = pickle.loads(pickle.dumps(cache))
+    hit = clone.get('k', lambda: 'MISS')
+    np.testing.assert_array_equal(hit['x'], np.arange(4))

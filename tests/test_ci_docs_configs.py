@@ -48,16 +48,26 @@ def test_ci_run_commands_reference_real_paths():
     assert 'pytest' in run_text
     # Every explicit repo path in a run command must exist — including the
     # adapter job's individual test files (renaming one must fail HERE,
-    # not on the first real CI run).  A path = known top dir + at least one
-    # '/' segment, not preceded by a word/-/. character: slash-less prose
-    # words ('docs', 'tests') and the 'petastorm' inside console-script
-    # names like `petastorm-tpu-doctor` can't match (ADVICE r05), while
-    # paths embedded in larger argv tokens (`--ignore=tests/x`,
-    # `tests/test_x.py::test_y`) are still extracted and checked.
-    paths = re.findall(r'(?<![\w./\-])(?:\./)?(?:tests|petastorm_tpu'
-                       r'|petastorm|examples|docs)(?:/[\w.\-]+)+', run_text)
-    paths = [(p[2:] if p.startswith('./') else p).rstrip('/.')
-             for p in paths]
+    # not on the first real CI run).  Paths are extracted ONLY from
+    # whitespace-delimited argv tokens (ADVICE r05 #4): a token is a path
+    # when it starts with a known top dir (after an optional `--opt=` or
+    # `./` prefix) followed by at least one '/' segment.  Slash-less
+    # prose words ('docs', 'tests'), the 'petastorm' inside console-
+    # script names like `petastorm-tpu-doctor`, and substrings buried
+    # mid-token can't match; `--ignore=tests/x` and
+    # `tests/test_x.py::test_y` still are (the '::' selector is cut by
+    # the segment charset).
+    # Optional `name=` prefix (covers `--ignore=...` AND env-var
+    # assignments like `DATA=tests/x.parquet`) and optional quote: such
+    # paths must keep being existence-checked, not silently drop out.
+    token_pattern = re.compile(
+        r'^(?:[\w\-]+=)?[\'"]?(?:\./)?'
+        r'((?:tests|petastorm_tpu|petastorm|examples|docs)(?:/[\w.\-]+)+)')
+    # Sub-split on , and : so multi-path tokens (`--ignore=a.py,b.py`,
+    # PYTHONPATH-style lists, `a.py::test_x`) check EVERY embedded path.
+    paths = [m.group(1).rstrip('/.') for tok in run_text.split()
+             for sub in re.split(r'[,:]', tok)
+             for m in [token_pattern.match(sub)] if m]
     assert paths, 'no repo paths found in ci.yml run commands'
     for p in paths:
         assert os.path.exists(os.path.join(REPO, p)), \
@@ -85,6 +95,27 @@ def test_bench_compact_line_pins_shm_plane_fields():
                   'delivery_plane_processpool_images_per_sec_host_bytes',
                   'delivery_plane_service_images_per_sec_host_w1_bytes'):
         assert "'%s'" % field in block.group(1), field
+
+
+def test_bench_compact_line_pins_epoch_cache_fields():
+    """The epoch-cache plane's cold/warm evidence (ISSUE 3) and the
+    measured scan_batches stall must ride the compact machine line."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    for field in ('epoch_cache_streaming_cold_images_per_sec',
+                  'epoch_cache_streaming_warm_images_per_sec',
+                  'epoch_cache_streaming_warm_over_cold',
+                  'epoch_cache_service_cold_images_per_sec',
+                  'epoch_cache_service_warm_images_per_sec',
+                  'epoch_cache_service_warm_over_cold',
+                  'stall_pct_epoch_cache_warm_scan',
+                  'stall_pct_streaming_scan'):
+        assert "'%s'" % field in block.group(1), field
+    # ...and the leg itself must be wired into BOTH main() paths (the
+    # shared host-leg table), not just defined.
+    assert re.search(r"_IPC_PLANE_LEGS = \((?:.|\n)*?epoch_cache_plane_leg",
+                     src), 'epoch_cache_plane_leg missing from the leg table'
 
 
 def test_docs_conf_compiles_and_has_sphinx_settings():

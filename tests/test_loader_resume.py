@@ -694,6 +694,32 @@ def test_device_inmem_scan_epochs_ragged_cursor_honors_token_drop_last(
     assert [g.shape for g in groups] == [(steps_per_epoch, BATCH)]
 
 
+def test_device_inmem_scan_epochs_rejects_flagless_ragged_cursor(dataset):
+    """ADVICE r05 #1 tightening: ONLY a token that records
+    drop_last=False may park its cursor at the full-batch count.  A
+    forged or stale token that lacks the flag cannot prove the
+    ragged-tail provenance, and accepting it would silently complete the
+    checkpointed epoch with zero dispatched steps — it must raise the
+    geometry error instead."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+
+    steps_per_epoch = ROWS // BATCH
+    assert ROWS % BATCH, 'test needs a ragged tail'
+
+    reader = make_reader(dataset.url, reader_pool_type='dummy',
+                         shuffle_row_groups=False, num_epochs=1)
+    forged = {'version': 1,
+              'device_inmem': {'epochs_done': 0,
+                               'steps_into_epoch': steps_per_epoch,
+                               'batch_size': BATCH, 'seed': 71}}  # no flag
+    with DeviceInMemDataLoader(reader, batch_size=BATCH, num_epochs=2,
+                               seed=71, deterministic_cache_order=True,
+                               resume_state=forged) as loader:
+        with pytest.raises(ValueError, match='drop_last'):
+            next(loader.scan_epochs(lambda c, b: (c, b['id']), 0,
+                                    donate_carry=False))
+
+
 def test_device_inmem_mid_epoch_token_requires_deterministic(dataset):
     """A mid-epoch token is refused at RESUME time too when the rebuilding
     loader lacks deterministic_cache_order (the cursor would index into an

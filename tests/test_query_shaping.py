@@ -150,6 +150,44 @@ def test_disk_cache_eviction(tmp_path):
     assert len(files) < 10  # evicted down toward the limit
 
 
+_DISK_CACHE_RACE_CHILD = r'''
+import os, sys
+import numpy as np
+sys.path.insert(0, sys.argv[2])
+from petastorm_tpu.local_disk_cache import LocalDiskCache
+
+# Small cap + 40KB values: every store triggers eviction, so the two
+# children constantly evict entries out from under each other's reads.
+cache = LocalDiskCache(sys.argv[1], size_limit_bytes=200_000)
+for i in range(250):
+    expected = i % 20
+    value = cache.get('key%d' % expected,
+                      lambda e=expected: np.full(5000, e))
+    assert value.shape == (5000,), value.shape
+    assert (value == expected).all(), 'corrupt read of key%d' % expected
+print('OK')
+'''
+
+
+def test_disk_cache_multiprocess_eviction_race(tmp_path):
+    """Two processes share one cache path with eviction racing (the
+    documented best-effort mode): every read must return either a fresh
+    fill or an INTACT published value — the atomic tmp+rename publish
+    means a concurrent eviction can cost a miss, never a corrupt read."""
+    import os
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache_dir = str(tmp_path / 'shared')
+    procs = [subprocess.Popen(
+        [_sys.executable, '-c', _DISK_CACHE_RACE_CHILD, cache_dir, repo],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE) for _ in range(2)]
+    outs = [p.communicate(timeout=120) for p in procs]
+    assert all(p.returncode == 0 for p in procs), \
+        [e.decode()[-500:] for _, e in outs]
+    assert all(o.decode().strip() == 'OK' for o, _ in outs)
+
+
 def test_reader_with_disk_cache_consistent(tmp_path):
     ds = create_test_dataset('file://' + str(tmp_path / 'ds'), num_rows=20,
                              rows_per_rowgroup=5)

@@ -241,6 +241,27 @@ def test_doctor_report_over_petastorm_dataset(dataset, capsys):
     assert rc in (0, 1)  # 1 only if an environment plane failed
 
 
+def test_doctor_cache_plane_section(tmp_path):
+    """The cache-plane check: tier dirs probed writable, /dev/shm
+    headroom reported, crash residue (a dead writer's tmp file) swept."""
+    import os
+
+    from petastorm_tpu.tools.doctor import _check_cache_plane
+
+    plane_dir = str(tmp_path / 'plane')
+    os.makedirs(plane_dir)
+    # fake crash residue: a tmp file stamped with a certainly-dead pid
+    open(os.path.join(plane_dir, '.tmp.999999999.dead'), 'w').close()
+    out = _check_cache_plane(plane_dir)
+    assert out['disk_tier_writable'] is True
+    assert out['disk_tier_entries'] == 0
+    assert out['swept_tmp_files'] == 1
+    assert not [f for f in os.listdir(plane_dir) if f.startswith('.tmp.')]
+    # without a dir the host-level half still reports
+    host_only = _check_cache_plane(None)
+    assert 'shm_free_bytes' in host_only or 'shm_note' in host_only
+
+
 def test_doctor_plain_parquet_and_human_format(tmp_path, capsys):
     import pyarrow as pa
     import pyarrow.parquet as pq
