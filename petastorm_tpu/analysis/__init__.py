@@ -1,0 +1,13 @@
+"""``petastorm_tpu.analysis`` — the repo-aware concurrency &
+resource-lifecycle linter behind the ``petastorm-tpu-lint`` CLI and the
+CI lint gate.  See :mod:`petastorm_tpu.analysis.framework` for the
+architecture and ``docs/development.md`` for the rule catalogue.
+
+Stdlib-only by design: CI runs it from a bare checkout, before any
+heavy dependency is installed.
+"""
+
+from petastorm_tpu.analysis.framework import (Finding, Module, lint_paths,
+                                              lint_text, main)
+
+__all__ = ['Finding', 'Module', 'lint_paths', 'lint_text', 'main']

@@ -1,0 +1,360 @@
+"""``petastorm-tpu-lint`` — repo-aware concurrency & resource-lifecycle linter.
+
+Generic linters cannot see this codebase's invariants: that every
+``/dev/shm`` slab needs a reachable unlink, that an exclusive flock on a
+plane path must be non-blocking or bounded, that a class holding a
+``threading.Lock`` must exclude it from pickling before it crosses the
+ProcessPool boundary.  Each of those took a human review pass to catch
+(PR 3 needed seven — see CHANGES.md); this package turns them into
+machine-checked rules that run in CI.
+
+Architecture:
+
+* a **rule** is a class with a ``rule_id``, a ``motivation`` (the review
+  finding it encodes), and a ``check(module)`` generator yielding
+  :class:`Finding` objects — see ``analysis/rules/``;
+* the **walker** parses each ``.py`` file once into a :class:`Module`
+  (AST + source lines) and runs every registered rule over it;
+* findings print as ``path:line rule-id message`` and exit the CLI
+  with 1;
+* ``# ptlint: disable=rule-id`` on the offending line suppresses a
+  finding **with the justification expected in the same comment**;
+  ``# ptlint: disable-file=rule-id`` near the top of a file suppresses
+  the rule for the whole file;
+* a **baseline** file (``analysis/baseline.txt``, checked in) lists
+  grandfathered findings by ``(path, rule, message)`` — the gate starts
+  green and only NEW findings fail CI.  ``--write-baseline``
+  regenerates it.
+
+Exit codes: 0 clean (modulo baseline/suppressions), 1 findings,
+2 usage error (bad path, unknown rule).
+
+The package is deliberately stdlib-only: the CI lint job runs it from a
+bare checkout (``python -m petastorm_tpu.analysis petastorm_tpu/``)
+without installing numpy/jax.
+"""
+
+import argparse
+import ast
+import collections
+import os
+import re
+import sys
+
+__all__ = ['Finding', 'Module', 'lint_paths', 'lint_text', 'main']
+
+#: Inline suppression: ``# ptlint: disable=rule-a,rule-b — justification``.
+_DISABLE_RE = re.compile(r'#\s*ptlint:\s*disable=([\w\-,]+)')
+_DISABLE_FILE_RE = re.compile(r'#\s*ptlint:\s*disable-file=([\w\-,]+)')
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                'baseline.txt')
+
+
+class Finding(object):
+    """One rule violation at ``path:line``.
+
+    The message is the finding's identity for baselining, so rules keep
+    messages free of line numbers and other run-varying detail — a pure
+    re-indentation must not churn the baseline.
+    """
+
+    __slots__ = ('path', 'line', 'rule_id', 'message')
+
+    def __init__(self, path, line, rule_id, message):
+        self.path = path
+        self.line = int(line)
+        self.rule_id = rule_id
+        self.message = message
+
+    def __repr__(self):
+        return 'Finding(%r)' % (str(self),)
+
+    def __str__(self):
+        return '%s:%d %s %s' % (self.path, self.line, self.rule_id,
+                                self.message)
+
+    def baseline_key(self):
+        return (self.path, self.rule_id, self.message)
+
+
+class Module(object):
+    """One parsed source file, shared by every rule.
+
+    ``path`` is the *report path*: relative to the scanned root's parent
+    (so ``petastorm_tpu/workers_pool/shm_plane.py`` regardless of the
+    invoking CWD — baseline keys must be invocation-independent).
+    """
+
+    def __init__(self, path, source, tree):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+
+    def line_disables(self, lineno):
+        """Rule ids suppressed on source line ``lineno`` (1-based)."""
+        if 1 <= lineno <= len(self.lines):
+            match = _DISABLE_RE.search(self.lines[lineno - 1])
+            if match:
+                return {r.strip() for r in match.group(1).split(',')
+                        if r.strip()}
+        return set()
+
+    def file_disables(self):
+        disabled = set()
+        for line in self.lines:
+            match = _DISABLE_FILE_RE.search(line)
+            if match:
+                disabled.update(r.strip() for r in match.group(1).split(',')
+                                if r.strip())
+        return disabled
+
+
+def _iter_py_files(root):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != '__pycache__' and not d.startswith('.'))
+        for name in sorted(filenames):
+            if name.endswith('.py'):
+                yield os.path.join(dirpath, name)
+
+
+def _report_path(file_path, root):
+    """Invocation-independent report/baseline path.
+
+    Directory roots key as ``<root basename>/<path under it>`` (a ``.``
+    root keys without the prefix), file roots as the path given — so
+    ``petastorm-tpu-lint petastorm_tpu/`` and ``petastorm-tpu-lint
+    petastorm_tpu/cache_plane/plane.py`` from the repo root produce the
+    SAME key for that file, and the checked-in baseline matches both
+    the CI invocation and the documented one-file workflow.
+    """
+    root = os.path.normpath(root)
+    if os.path.isfile(root):
+        return os.path.normpath(file_path).replace(os.sep, '/')
+    rel = os.path.relpath(os.path.normpath(file_path), root)
+    base = os.path.basename(root)
+    joined = rel if base in ('', '.', '..') else os.path.join(base, rel)
+    return joined.replace(os.sep, '/')
+
+
+def _parse(path, report_path, source=None):
+    """(module, finding): a file that fails to parse is itself a finding
+    (rule ``syntax-error``), not a crash of the gate."""
+    if source is None:
+        with open(path, 'rb') as f:
+            source = f.read().decode('utf-8', 'replace')
+    try:
+        tree = ast.parse(source, filename=report_path)
+    except SyntaxError as e:
+        return None, Finding(report_path, e.lineno or 1, 'syntax-error',
+                             'file does not parse: %s' % e.msg)
+    return Module(report_path, source, tree), None
+
+
+def _run_rules(module, rules):
+    file_disabled = module.file_disables()
+    for rule in rules:
+        if rule.rule_id in file_disabled:
+            continue
+        for finding in rule.check(module):
+            if rule.rule_id in module.line_disables(finding.line):
+                continue
+            yield finding
+
+
+def lint_text(source, rules=None, path='<text>'):
+    """Lint a source string (the fixture-test entry point)."""
+    rules = _resolve_rules(rules)
+    module, finding = _parse(path, path, source=source)
+    if finding is not None:
+        return [finding]
+    return sorted(_run_rules(module, rules),
+                  key=lambda f: (f.path, f.line, f.rule_id))
+
+
+def lint_paths(paths, rules=None):
+    """Lint files/directories; returns findings sorted by location."""
+    rules = _resolve_rules(rules)
+    findings = []
+    for root in paths:
+        for file_path in _iter_py_files(root):
+            report = _report_path(file_path, root)
+            module, finding = _parse(file_path, report)
+            if finding is not None:
+                findings.append(finding)
+                continue
+            findings.extend(_run_rules(module, rules))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule_id))
+
+
+def _resolve_rules(rules):
+    if rules is None:
+        from petastorm_tpu.analysis.rules import ALL_RULES
+        return list(ALL_RULES)
+    resolved = []
+    for rule in rules:
+        if isinstance(rule, str):
+            from petastorm_tpu.analysis.rules import ALL_RULES
+            by_id = {r.rule_id: r for r in ALL_RULES}
+            if rule not in by_id:
+                raise KeyError(rule)
+            resolved.append(by_id[rule])
+        else:
+            resolved.append(rule)
+    return resolved
+
+
+# -- baseline -----------------------------------------------------------------
+
+def load_baseline(path):
+    """``path<TAB>rule<TAB>message`` per line -> Counter of keys.
+
+    Duplicate lines mean the same finding legitimately occurs N times in
+    that file; ``#`` comment lines carry the tracking notes the
+    grandfathered findings are annotated with.
+    """
+    budget = collections.Counter()
+    if not path or not os.path.exists(path):
+        return budget
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            line = line.rstrip('\n')
+            if not line.strip() or line.lstrip().startswith('#'):
+                continue
+            parts = line.split('\t', 2)
+            if len(parts) == 3:
+                budget[tuple(parts)] += 1
+    return budget
+
+
+def write_baseline(path, findings, extra=None):
+    """Write ``findings`` (+ an optional Counter of keys to carry over —
+    the entries for files a partial run did not scan)."""
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write('# petastorm-tpu-lint baseline: grandfathered findings '
+                '(path<TAB>rule<TAB>message).\n'
+                '# Regenerate with: petastorm-tpu-lint --write-baseline '
+                '<paths>.  New findings are NOT\n'
+                '# baselined by default — fix them or justify an inline '
+                '"# ptlint: disable=".\n')
+        lines = [finding.baseline_key() for finding in findings]
+        for key, count in (extra or {}).items():
+            lines.extend([key] * count)
+        for key in sorted(lines):
+            f.write('%s\t%s\t%s\n' % key)
+
+
+def apply_baseline(findings, budget):
+    """Split findings into (new, baselined) against the budget counter."""
+    budget = collections.Counter(budget)
+    new, baselined = [], []
+    for finding in findings:
+        key = finding.baseline_key()
+        if budget[key] > 0:
+            budget[key] -= 1
+            baselined.append(finding)
+        else:
+            new.append(finding)
+    return new, baselined
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def _build_parser():
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-lint',
+        description='Repo-aware concurrency & resource-lifecycle linter '
+                    '(petastorm_tpu.analysis).  Exit codes: 0 clean, '
+                    '1 findings, 2 usage error.')
+    parser.add_argument('paths', nargs='*', default=['petastorm_tpu'],
+                        help='files/directories to lint '
+                             '(default: petastorm_tpu)')
+    parser.add_argument('--baseline', default=DEFAULT_BASELINE,
+                        help='baseline file of grandfathered findings '
+                             '(default: the checked-in analysis/baseline.txt)')
+    parser.add_argument('--no-baseline', action='store_true',
+                        help='ignore the baseline: report every finding')
+    parser.add_argument('--write-baseline', action='store_true',
+                        help='write current findings to --baseline and '
+                             'exit 0 (grandfathering mode)')
+    parser.add_argument('--select', default=None, metavar='RULE[,RULE...]',
+                        help='run only these rule ids')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='print every rule id + motivation and exit')
+    return parser
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    from petastorm_tpu.analysis.rules import ALL_RULES
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print('%-24s %s' % (rule.rule_id, rule.motivation))
+        return 0
+
+    rules = list(ALL_RULES)
+    if args.select:
+        try:
+            rules = _resolve_rules(
+                [r.strip() for r in args.select.split(',') if r.strip()])
+        except KeyError as e:
+            print('petastorm-tpu-lint: unknown rule id %s (see --list-rules)'
+                  % e, file=sys.stderr)
+            return 2
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print('petastorm-tpu-lint: no such path: %s' % ', '.join(missing),
+              file=sys.stderr)
+        return 2
+
+    findings = lint_paths(args.paths, rules=rules)
+
+    if args.write_baseline:
+        if args.select:
+            # A rule-scoped run sees only the selected rules' findings;
+            # writing that as THE baseline would silently drop every
+            # other rule's grandfathered entries and redden the next
+            # full run.
+            print('petastorm-tpu-lint: --write-baseline cannot be combined '
+                  'with --select (it would truncate other rules\' baseline '
+                  'entries)', file=sys.stderr)
+            return 2
+        # Merge, don't overwrite: this run is authoritative only for the
+        # files it scanned — grandfathered entries for files outside the
+        # scanned paths survive, so baselining one new file cannot wipe
+        # the rest of the baseline.
+        scanned = {_report_path(f, root) for root in args.paths
+                   for f in _iter_py_files(root)}
+        kept = collections.Counter(
+            {key: n for key, n in load_baseline(args.baseline).items()
+             if key[0] not in scanned})
+        write_baseline(args.baseline, findings, extra=kept)
+        print('wrote %d finding(s) to %s (%d entr%s for unscanned files '
+              'kept)' % (len(findings), args.baseline, sum(kept.values()),
+                         'y' if sum(kept.values()) == 1 else 'ies'))
+        return 0
+
+    budget = (collections.Counter() if args.no_baseline
+              else load_baseline(args.baseline))
+    new, baselined = apply_baseline(findings, budget)
+    for finding in new:
+        print(finding)
+    stale = sum((budget - collections.Counter(
+        f.baseline_key() for f in baselined)).values())
+    summary = '%d finding(s), %d baselined' % (len(new), len(baselined))
+    if stale:
+        summary += (', %d stale baseline entr%s (fixed findings — prune '
+                    'with --write-baseline)'
+                    % (stale, 'y' if stale == 1 else 'ies'))
+    print(summary)
+    return 1 if new else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""The rule registry for ``petastorm-tpu-lint``.
+
+One instance per rule, ordered roughly by how often the encoded
+invariant has bitten this repo (see each module's docstring for the
+review history).  Adding a rule = add the class, instantiate it here,
+give it a bad/good fixture pair in ``tests/test_analysis_lint.py``,
+and document it in ``docs/development.md``.
+"""
+
+from petastorm_tpu.analysis.rules.contracts import (DegradeContractRule,
+                                                    ReadonlyViewMutationRule)
+from petastorm_tpu.analysis.rules.lifecycle import (ResourceLifecycleRule,
+                                                    ShortWriteRule)
+from petastorm_tpu.analysis.rules.locking import (BlockingUnderLockRule,
+                                                  FlockDisciplineRule,
+                                                  UnboundedRecvRule)
+from petastorm_tpu.analysis.rules.process_safety import (
+    PickleUnsafeAttrsRule, SwallowedExceptionRule)
+
+ALL_RULES = (
+    ResourceLifecycleRule(),
+    FlockDisciplineRule(),
+    PickleUnsafeAttrsRule(),
+    SwallowedExceptionRule(),
+    BlockingUnderLockRule(),
+    UnboundedRecvRule(),
+    ShortWriteRule(),
+    DegradeContractRule(),
+    ReadonlyViewMutationRule(),
+)
+
+__all__ = ['ALL_RULES']

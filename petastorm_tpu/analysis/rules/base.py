@@ -1,0 +1,79 @@
+"""Shared AST plumbing for the lint rules.
+
+Rules are deliberately *syntactic and local*: each one inspects a
+single function/class body for a pattern this repo has been burned by,
+trading completeness for zero-setup precision (no type inference, no
+cross-module dataflow).  Where a rule cannot prove safety it stays
+quiet — the gate's value is that every finding it DOES raise is worth a
+reviewer's time, with ``# ptlint: disable=`` as the documented escape
+hatch for the deliberate exceptions.
+"""
+
+import ast
+
+from petastorm_tpu.analysis.framework import Finding
+
+
+class Rule(object):
+    """One invariant checker: yield :class:`Finding` objects from
+    ``check(module)``.  ``motivation`` names the review finding the rule
+    encodes (surfaced by ``petastorm-tpu-lint --list-rules`` and
+    ``docs/development.md``)."""
+
+    rule_id = ''
+    motivation = ''
+
+    def check(self, module):
+        raise NotImplementedError
+
+    def finding(self, module, node, message):
+        return Finding(module.path, getattr(node, 'lineno', 1),
+                       self.rule_id, message)
+
+
+def call_name(node):
+    """Dotted name of a Call's callee: ``os.write``, ``self._sock.close``
+    -> ``self._sock.close``; '' when the callee is not a name chain."""
+    if not isinstance(node, ast.Call):
+        return ''
+    parts = []
+    func = node.func
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+    elif parts:
+        parts.append('<expr>')
+    else:
+        return ''
+    return '.'.join(reversed(parts))
+
+
+def last_component(dotted):
+    return dotted.rsplit('.', 1)[-1] if dotted else ''
+
+
+def names_in(node):
+    """Every bare Name id in a subtree."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def functions(tree):
+    """Every (async) function in the module, nested ones included."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def iter_calls(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def docstring(node):
+    try:
+        return ast.get_docstring(node) or ''
+    except TypeError:
+        return ''

@@ -1,0 +1,145 @@
+"""Plane-contract rules: degrade semantics and read-only views.
+
+Motivating history (CHANGES.md): the cache/result planes promise
+"degrade, never raise, never block an epoch" — a stray ``raise`` inside
+one of those paths turns a full ``/dev/shm`` into a dead pipeline
+instead of a slow one; and plane lookups return zero-copy READ-ONLY
+views over shared mappings — in-place mutation either raises at
+runtime or (on a writable mapping) corrupts every other consumer's
+cached rows.
+"""
+
+import ast
+import re
+
+from petastorm_tpu.analysis.rules.base import (Rule, call_name, docstring,
+                                               functions, last_component)
+
+#: The degrade-contract rule is scoped to the plane modules: only there
+#: does a "never raises" docstring carry the module-wide degrade
+#: semantics the planes document.
+_PLANE_PATH_RE = re.compile(r'(cache_plane|shm_plane)')
+_NEVER_RE = re.compile(r'never\s+(?:blocks?|raises?)|degrades?[ ,.:]',
+                       re.IGNORECASE)
+#: Raising one of these IS the degrade protocol (lost chunk / corrupt
+#: entry sentinels the callers are contracted to catch).
+_DEGRADE_TYPES = frozenset(('SegmentVanishedError', 'CorruptEntryError',
+                            'StopIteration'))
+
+
+def _raise_type_name(node):
+    exc = node.exc
+    if exc is None:
+        return None  # bare re-raise inside a handler: not a new failure
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    parts = []
+    while isinstance(exc, ast.Attribute):
+        parts.append(exc.attr)
+        exc = exc.value
+    if isinstance(exc, ast.Name):
+        parts.append(exc.id)
+    return parts[0] if parts else '<expr>'
+
+
+class DegradeContractRule(Rule):
+    rule_id = 'degrade-contract'
+    motivation = ('a function documented to degrade/never raise contained '
+                  'an unguarded raise — a full tier must mean a slow '
+                  'epoch, never a dead pipeline (the plane never blocks '
+                  'an epoch on cache machinery)')
+
+    def check(self, module):
+        if not _PLANE_PATH_RE.search(module.path):
+            return
+        for func in functions(module.tree):
+            if not _NEVER_RE.search(docstring(func)):
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Raise):
+                    continue
+                name = _raise_type_name(node)
+                if name is None or name in _DEGRADE_TYPES:
+                    continue
+                yield self.finding(
+                    module, node,
+                    'function %s is documented to degrade/never raise but '
+                    'raises %s — return the degrade sentinel (None/False/'
+                    'MISS) and count it instead of raising into the '
+                    'decode path' % (func.name, name))
+
+
+#: Producers whose return value is a zero-copy read-only view over a
+#: shared mapping (the plane lookup surface).
+_VIEW_PRODUCERS = frozenset(('read_payload', 'decode_entry', 'lookup',
+                             'get_or_fill'))
+#: In-place ndarray mutators.
+_MUTATOR_METHODS = frozenset(('fill', 'sort', 'setflags', 'partition',
+                              'byteswap'))
+
+
+class ReadonlyViewMutationRule(Rule):
+    rule_id = 'readonly-view-mutation'
+    motivation = ('mutating a batch obtained from a plane lookup — those '
+                  'are zero-copy READ-ONLY views over shared mappings; '
+                  'writes either raise at runtime or corrupt every other '
+                  'consumer of the cached entry')
+
+    def check(self, module):
+        for func in functions(module.tree):
+            producers = {}  # name -> [(lineno, producer)]
+            rebinds = {}    # name -> [lineno] of non-producer rebinds
+            for node in ast.walk(func):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name = node.targets[0].id
+                    producer = last_component(call_name(node.value))
+                    if producer in _VIEW_PRODUCERS:
+                        producers.setdefault(name, []).append(
+                            (node.lineno, producer))
+                    else:
+                        rebinds.setdefault(name, []).append(node.lineno)
+            if not producers:
+                continue
+            for node in ast.walk(func):
+                name = self._mutated_name(node)
+                if name not in producers:
+                    continue
+                line = getattr(node, 'lineno', 0)
+                # The name is a view only between a producer assignment
+                # and any later rebind: a mutation BEFORE the producer
+                # bind (or after a rebind to something else) targets a
+                # different value and is fine.
+                last_prod = max(((ln, p) for ln, p in producers[name]
+                                 if ln < line), default=None)
+                if last_prod is None:
+                    continue
+                if any(last_prod[0] < ln < line
+                       for ln in rebinds.get(name, ())):
+                    continue
+                yield self.finding(
+                    module, node,
+                    '`%s` comes from %s() — a zero-copy READ-ONLY view '
+                    'over a shared mapping; copy (np.array/.copy()) '
+                    'before writing' % (name, last_prod[1]))
+
+    @staticmethod
+    def _mutated_name(node):
+        """The root name written to by ``x[...] = ...``, ``x[...] += ...``
+        or an in-place mutator method call."""
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Subscript):
+            target = node.targets[0].value
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Subscript):
+            target = node.target.value
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_METHODS:
+            target = node.func.value
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        if isinstance(target, ast.Name):
+            return target.id
+        return None

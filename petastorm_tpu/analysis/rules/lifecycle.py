@@ -1,0 +1,180 @@
+"""Resource-lifecycle rules: leaked handles and discarded writes.
+
+Motivating history (CHANGES.md): the planes' whole robustness story is
+"a clean shutdown leaves zero residue, a crash is swept" — which only
+holds when every ``SharedMemory``/``mmap``/socket/tempfile created has
+an owner with a reachable teardown, and every ``os.write`` return value
+is checked (PR 3 round 3: a short write published a permanently
+truncated cache entry).
+"""
+
+import ast
+
+from petastorm_tpu.analysis.rules.base import (Rule, call_name, functions,
+                                               last_component, names_in)
+
+#: Callee patterns that create an OS-level resource the caller owns.
+#: Keyed by how the dotted callee matches: full dotted suffix or last
+#: component.
+_CREATOR_LAST = {
+    'SharedMemory': 'shared-memory segment',
+    'NamedTemporaryFile': 'temp file',
+    'mkstemp': 'temp file',
+    'mkdtemp': 'temp directory',
+}
+_CREATOR_DOTTED = {
+    'mmap.mmap': 'mmap',
+    'os.open': 'file descriptor',
+    'zmq.Context': 'zmq context',
+}
+#: ``<ctx>.socket(...)`` — zmq/raw sockets both need a reachable close.
+_SOCKET_LAST = 'socket'
+
+#: ``tracked.close()``-style teardown methods.
+_CLEANUP_METHODS = frozenset((
+    'close', 'unlink', 'stop', 'terminate', 'term', 'release', 'shutdown',
+    'cleanup', 'clear'))
+#: Callee name fragments that make ``f(tracked)`` a teardown/ownership
+#: transfer: ``os.close(fd)``, ``shutil.rmtree(d)``, ``os.fdopen(fd)``,
+#: ``weakref.finalize(obj, ...)``, ``poller.register(sock)``,
+#: ``atexit.register(...)``.
+_CLEANUP_CALL_FRAGMENTS = ('close', 'unlink', 'remove', 'rmtree', 'rmdir',
+                           'finalize', 'fdopen', 'register')
+
+
+def _creator_kind(call):
+    dotted = call_name(call)
+    if not dotted:
+        return None
+    if dotted in _CREATOR_DOTTED:
+        return _CREATOR_DOTTED[dotted]
+    last = last_component(dotted)
+    if last in _CREATOR_LAST:
+        return _CREATOR_LAST[last]
+    if last == _SOCKET_LAST and '.' in dotted:
+        return 'socket'
+    return None
+
+
+def _assign_names(target):
+    """Name targets of an Assign (tuple unpacking included); None when
+    the target stores into an attribute/subscript (owner-managed)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        names = []
+        for elt in target.elts:
+            if isinstance(elt, ast.Name):
+                names.append(elt.id)
+        return names
+    return None
+
+
+class ResourceLifecycleRule(Rule):
+    rule_id = 'resource-lifecycle'
+    motivation = ('SharedMemory/mmap/socket/tempfile created with no '
+                  'reachable teardown in scope — the /dev/shm and /tmp '
+                  'residue class every sweep protocol exists to mop up')
+
+    def check(self, module):
+        for func in functions(module.tree):
+            yield from self._check_function(module, func)
+
+    def _check_function(self, module, func):
+        managed = set()   # names bound by `with creator() as x`
+        for node in ast.walk(func):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if _creator_kind(item.context_expr):
+                        if isinstance(item.optional_vars, ast.Name):
+                            managed.add(item.optional_vars.id)
+        tracked = []      # (name, kind, node)
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            kind = _creator_kind(node.value)
+            if kind is None:
+                continue
+            names = _assign_names(node.targets[0])
+            if not names:
+                continue  # stored straight into an owner attribute
+            for name in names:
+                if name not in managed and name != '_':
+                    tracked.append((name, kind, node))
+        for name, kind, node in tracked:
+            if not self._released(func, name, node):
+                yield self.finding(
+                    module, node,
+                    '%s `%s` has no reachable close/unlink/teardown in this '
+                    'scope and never escapes to an owner — leaked on every '
+                    'call (and on every exception path)' % (kind, name))
+
+    def _released(self, func, name, creation):
+        for node in ast.walk(func):
+            if node is creation:
+                continue
+            # `with x:` — context-managed teardown.
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Name) and expr.id == name:
+                        return True
+            # return/yield x (or a tuple/list carrying x directly) —
+            # ownership moves to the caller.  A name merely consumed by
+            # a returned CALL (`return Popen([.., path])`) does not
+            # transfer ownership of the resource itself.
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and node.value is not None:
+                value = node.value
+                elts = (value.elts if isinstance(value, (ast.Tuple, ast.List))
+                        else [value])
+                if any(isinstance(e, ast.Name) and e.id == name
+                       for e in elts):
+                    return True
+            # self.x = ...name... / container[k] = ...name... — an owner
+            # (or cache with its own GC) now holds it.
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in targets) and name in names_in(node.value):
+                    return True
+            if isinstance(node, ast.Call):
+                dotted = call_name(node)
+                last = last_component(dotted)
+                # x.close() and friends.
+                if last in _CLEANUP_METHODS and isinstance(
+                        node.func, ast.Attribute):
+                    root = node.func.value
+                    if isinstance(root, ast.Name) and root.id == name:
+                        return True
+                # os.close(x), shutil.rmtree(x), weakref.finalize(.., x),
+                # poller.register(x) — teardown or ownership transfer.
+                in_args = any(name in names_in(arg) for arg in
+                              list(node.args)
+                              + [k.value for k in node.keywords])
+                if in_args and any(frag in last.lower()
+                                   for frag in _CLEANUP_CALL_FRAGMENTS):
+                    return True
+                # container.append(x)/put(x)/add(x) — stored for an owner.
+                if in_args and last in ('append', 'add', 'put',
+                                        'setdefault', 'insert', 'extend'):
+                    return True
+        return False
+
+
+class ShortWriteRule(Rule):
+    rule_id = 'short-write'
+    motivation = ('bare os.write with the return value discarded — short '
+                  'writes (2 GiB cap, near-full filesystems) silently '
+                  'truncate; PR 3 round 3 found a cache entry published '
+                  'truncated this way')
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Expr) \
+                    and call_name(node.value) == 'os.write':
+                yield self.finding(
+                    module, node,
+                    'os.write return value discarded — it may write short '
+                    'without raising; loop until the buffer is drained')

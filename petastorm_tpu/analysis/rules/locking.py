@@ -1,0 +1,181 @@
+"""Locking-discipline rules: flock hygiene and blocking while holding.
+
+Motivating history (CHANGES.md): PR 3 rounds 2-5 were dominated by
+exactly these — an unwritable plane dir stalling every miss for
+``fill_wait_s`` behind a lock wait, and the close-then-rename window
+that let a cross-pid-namespace sweeper reap a live tmp file because the
+liveness flock died with the fd before ``os.replace`` ran.
+"""
+
+import ast
+
+from petastorm_tpu.analysis.rules.base import (Rule, call_name, functions,
+                                               iter_calls, last_component)
+
+
+def _is_flock(call):
+    return last_component(call_name(call)) == 'flock'
+
+
+def _flock_flags_src(call):
+    return ast.dump(call.args[1]) if len(call.args) > 1 else ''
+
+
+def _arg_name(call, index=0):
+    if len(call.args) > index and isinstance(call.args[index], ast.Name):
+        return call.args[index].id
+    return None
+
+
+class FlockDisciplineRule(Rule):
+    rule_id = 'flock-discipline'
+    motivation = ('unbounded flock(LOCK_EX) waits wedge whole planes '
+                  'behind one dead/slow peer, and renaming a '
+                  'lock-carrying file after closing its fd opens the '
+                  'sweep-a-live-tmp window (PR 3 rounds 4-5)')
+
+    def check(self, module):
+        for func in functions(module.tree):
+            closes, renames, flocked = {}, [], {}
+            for call in iter_calls(func):
+                dotted = call_name(call)
+                if _is_flock(call):
+                    flags = _flock_flags_src(call)
+                    if 'LOCK_EX' in flags and 'LOCK_NB' not in flags:
+                        yield self.finding(
+                            module, call,
+                            'flock(LOCK_EX) without LOCK_NB — an exclusive '
+                            'wait with no bound wedges every peer behind a '
+                            'dead or slow holder; take LOCK_NB and retry '
+                            'with a deadline')
+                    name = _arg_name(call)
+                    if name:
+                        flocked.setdefault(name, call.lineno)
+                elif dotted == 'os.close':
+                    name = _arg_name(call)
+                    if name:
+                        closes.setdefault(name, call.lineno)
+                elif dotted in ('os.replace', 'os.rename'):
+                    renames.append(call)
+            for call in renames:
+                culprit = [name for name, line in flocked.items()
+                           if closes.get(name) is not None
+                           and line < closes[name] < call.lineno]
+                if culprit:
+                    yield self.finding(
+                        module, call,
+                        'os.replace/os.rename after closing the '
+                        'lock-carrying fd (%s) — the liveness flock died '
+                        'with the fd, so a sweeper can reap the file '
+                        'mid-publish; publish first, close last'
+                        % ', '.join(sorted(culprit)))
+
+
+#: Calls that park the holder: the wedged-peer class.
+_BLOCKING_LAST = frozenset(('sleep', 'join', 'recv', 'recv_multipart',
+                            'recv_pyobj', 'get', 'acquire'))
+
+
+def _is_blocking_call(call):
+    last = last_component(call_name(call))
+    if last not in _BLOCKING_LAST:
+        return False
+    if last == 'sleep':
+        return True
+    # join/recv*/get/acquire block only in their no-argument,
+    # no-timeout form; any argument (timeout, NOBLOCK flags, a key)
+    # means bounded or not-a-blocking-variant.
+    return not call.args and not call.keywords
+
+
+def _lockish_name(expr):
+    """The held-lock display name when ``expr`` reads like a lock
+    acquisition (``self._lock``, ``_MAPPINGS_LOCK``, ``lock.acquire()``)."""
+    node = expr
+    if isinstance(node, ast.Call):
+        node = node.func
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    dotted = '.'.join(reversed(parts))
+    lowered = dotted.lower()
+    if 'lock' in lowered or 'mutex' in lowered:
+        return dotted
+    return None
+
+
+class BlockingUnderLockRule(Rule):
+    rule_id = 'blocking-under-lock'
+    motivation = ('sleep/unbounded join/blocking recv while holding a '
+                  'threading.Lock or flock — one stalled holder wedges '
+                  'every other thread/process on the plane')
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = None
+            for item in node.items:
+                held = held or _lockish_name(item.context_expr)
+            if held is None:
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # defined under the lock, not RUN under it
+                for call in _own_nodes(stmt):  # nested def bodies excluded
+                    if isinstance(call, ast.Call) \
+                            and _is_blocking_call(call):
+                        yield self.finding(
+                            module, call,
+                            'blocking call `%s` while `%s` is held — move '
+                            'the wait outside the lock (holders must stay '
+                            'prompt; a parked holder wedges every waiter)'
+                            % (call_name(call), held))
+
+
+def _own_nodes(func):
+    """The function's OWN subtree — nested function/lambda bodies are a
+    different scope and must neither satisfy nor trigger this rule."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class UnboundedRecvRule(Rule):
+    rule_id = 'unbounded-recv'
+    motivation = ('a worker loop blocked in recv with no poller/timeout '
+                  'outlives a SIGKILLed parent forever, pinning its '
+                  '/dev/shm arena — orphan processes the pool can never '
+                  'reap')
+
+    def check(self, module):
+        for func in functions(module.tree):
+            own = list(_own_nodes(func))
+            if any(isinstance(n, ast.Call)
+                   and last_component(call_name(n)) == 'poll'
+                   for n in own):
+                continue  # a poller bounds every recv in this function
+            loop_calls = {}  # id -> call (nested loops must not dup)
+            for node in own:
+                if isinstance(node, (ast.While, ast.For)):
+                    for sub in _own_nodes(node):  # same scope only
+                        if isinstance(sub, ast.Call):
+                            loop_calls[id(sub)] = sub
+            for call in loop_calls.values():
+                last = last_component(call_name(call))
+                if last in ('recv', 'recv_multipart', 'recv_pyobj') \
+                        and not call.args and not call.keywords:
+                    yield self.finding(
+                        module, call,
+                        'blocking `%s` in a loop with no poller or timeout '
+                        'anywhere in scope — a vanished peer parks this '
+                        'process forever; poll with a timeout and re-check '
+                        'peer liveness' % call_name(call))

@@ -1,0 +1,116 @@
+"""Fork/pickle-safety and exception-hygiene rules.
+
+Motivating history (CHANGES.md): the PlaneCache class of bug — a class
+holding per-process state (locks, mmaps, sockets) crossed the
+ProcessPool pickle boundary and either failed outright or smuggled a
+parent-process lock into the child; and the swallowed-exception class —
+``except Exception: pass`` in a worker loop turned real failures into
+silent row loss until a counter was added.
+"""
+
+import ast
+
+from petastorm_tpu.analysis.rules.base import (Rule, call_name,
+                                               last_component)
+
+#: ``self.x = <these>(...)`` makes the instance unpicklable (or worse:
+#: quietly pickles per-process state into the child).
+_UNPICKLABLE_LAST = frozenset((
+    'Lock', 'RLock', 'Condition', 'Event', 'Semaphore', 'BoundedSemaphore'))
+_UNPICKLABLE_DOTTED = frozenset(('mmap.mmap', 'zmq.Context'))
+
+
+def _unpicklable_kind(call):
+    dotted = call_name(call)
+    if not dotted:
+        return None
+    if dotted in _UNPICKLABLE_DOTTED:
+        return dotted
+    last = last_component(dotted)
+    if last in _UNPICKLABLE_LAST:
+        return dotted
+    if last == 'socket' and '.' in dotted:
+        return dotted
+    return None
+
+
+class PickleUnsafeAttrsRule(Rule):
+    rule_id = 'pickle-unsafe-attrs'
+    motivation = ('a class holding threading.Lock/mmap/socket attributes '
+                  'crossed the ProcessPool pickle boundary without '
+                  '__getstate__/__reduce__ excluding them (the PlaneCache '
+                  'class of bug, PR 3)')
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            defined = {n.name for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if defined & {'__getstate__', '__reduce__', '__reduce_ex__'}:
+                continue
+            held = []
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    target = sub.targets[0]
+                    if isinstance(target, ast.Attribute) \
+                            and isinstance(target.value, ast.Name) \
+                            and target.value.id == 'self':
+                        kind = _unpicklable_kind(sub.value)
+                        if kind:
+                            held.append('%s=%s()' % (target.attr, kind))
+            if held:
+                yield self.finding(
+                    module, node,
+                    'class %s holds per-process state (%s) but defines no '
+                    '__getstate__/__reduce__ — pickling it across a '
+                    'ProcessPool/service boundary fails or smuggles '
+                    'process-local locks into the child; exclude the '
+                    'attrs, or mark the class parent-only with an inline '
+                    'disable' % (node.name, ', '.join(sorted(held))))
+
+
+def _is_broad(handler):
+    node = handler.type
+    if node is None:
+        return True  # bare except:
+    names = []
+    if isinstance(node, ast.Tuple):
+        names = [e.id for e in node.elts if isinstance(e, ast.Name)]
+    elif isinstance(node, ast.Name):
+        names = [node.id]
+    return any(n in ('Exception', 'BaseException') for n in names)
+
+
+def _only_passes(handler):
+    return all(isinstance(stmt, (ast.Pass, ast.Continue))
+               for stmt in handler.body)
+
+
+class SwallowedExceptionRule(Rule):
+    rule_id = 'swallowed-exception'
+    motivation = ('except Exception: pass in a worker loop — failures '
+                  'vanish with the rows; every degrade path must count '
+                  '(a diagnostics counter) or log what it dropped')
+
+    def check(self, module):
+        yield from self._walk(module, module.tree, in_loop=False)
+
+    def _walk(self, module, node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            child_in_loop = in_loop
+            if isinstance(child, (ast.While, ast.For, ast.AsyncFor)):
+                child_in_loop = True
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef, ast.Lambda)):
+                child_in_loop = False  # new scope: loop context resets
+            if isinstance(child, ast.ExceptHandler) and in_loop \
+                    and _is_broad(child) and _only_passes(child):
+                yield self.finding(
+                    module, child,
+                    'broad exception silently swallowed inside a loop — '
+                    'the failure (and its rows) vanish without a counter '
+                    'increment or log call; count it, log it, or narrow '
+                    'the exception type')
+            yield from self._walk(module, child, child_in_loop)

@@ -34,7 +34,7 @@ from collections import deque
 __all__ = ['TraceRecorder']
 
 
-class TraceRecorder(object):
+class TraceRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — recorder lives in the driving process; workers ship spans back over the wire, never the recorder
     """Bounded, thread-safe recorder of Chrome Trace Event spans.
 
     Appends are O(1) dict+deque ops (~1 µs) so recording is safe to leave

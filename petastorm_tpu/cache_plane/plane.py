@@ -46,9 +46,12 @@ import uuid
 import numpy as np
 
 from petastorm_tpu.cache import CacheBase
-# Shared with the result plane: the two modules cooperate on the same
-# /dev/shm sweep protocol, so their liveness logic must not diverge.
-from petastorm_tpu.workers_pool.shm_plane import _pid_alive  # noqa: F401
+# Shared with the result plane: the two planes cooperate on the same
+# /dev/shm sweep protocol, so their liveness logic must not diverge —
+# both import the single audited copy in utils.ipc.
+from petastorm_tpu.utils.ipc import align as _align
+from petastorm_tpu.utils.ipc import flock_probe_unlink
+from petastorm_tpu.utils.ipc import pid_alive as _pid_alive
 
 logger = logging.getLogger(__name__)
 
@@ -57,7 +60,6 @@ logger = logging.getLogger(__name__)
 MISS = object()
 
 _MAGIC = b'PSTPUCP1'
-_ALIGN = 64
 ENTRY_SUFFIX = '.cpe'
 LOCK_SUFFIX = '.lock'
 #: Hot-tier directories live under this prefix in /dev/shm, next to (but
@@ -75,10 +77,6 @@ _LAST_SWEEP = {}
 #: builds one Tier pair per split, and re-statting every entry on each
 #: split's first store would be O(splits x entries) in syscalls.
 _SEED_TOTALS = {}
-
-
-def _align(offset):
-    return (offset + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
 # -- entry encode/decode ------------------------------------------------------
@@ -448,21 +446,8 @@ class Tier(object):
                     continue
             else:
                 continue
-            try:
-                fd = os.open(full, os.O_RDONLY)
-            except OSError:
-                continue
-            try:
-                try:
-                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-                except OSError:
-                    continue  # owner alive (possibly in another pid ns)
-                os.unlink(full)
+            if flock_probe_unlink(full):
                 removed.append(name)
-            except OSError:
-                pass
-            finally:
-                os.close(fd)
         return removed
 
     def usage(self):

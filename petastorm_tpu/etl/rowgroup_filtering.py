@@ -12,7 +12,6 @@ Filter format (pyarrow-compatible DNF): ``[(col, op, value), ...]`` (ANDed)
 or ``[[...], [...]]`` (OR of ANDs); ops: ``= == != < > <= >= in not in``.
 """
 
-from collections import defaultdict
 
 import pyarrow.parquet as pq
 

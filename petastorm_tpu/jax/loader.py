@@ -23,7 +23,6 @@ execution model instead of translated from them:
 
 import logging
 import time
-import warnings
 from collections import deque
 
 import numpy as np

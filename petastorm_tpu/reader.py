@@ -18,7 +18,6 @@ TPU-first differences:
 """
 
 import logging
-import os
 
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.errors import NoDataAvailableError

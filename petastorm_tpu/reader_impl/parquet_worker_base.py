@@ -86,14 +86,19 @@ class ParquetWorkerBase(WorkerBase):
                 pass
 
     def shutdown(self):
-        for handle, parquet_file in self._open_files.values():
+        for path, (handle, parquet_file) in self._open_files.items():
             try:
                 # Local mmap entries have no fsspec handle; close the
                 # ParquetFile itself so the mapped fd is released now, not
                 # at GC time.
                 (handle or parquet_file).close()
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
+            except Exception as e:  # noqa: BLE001 — best-effort teardown
+                # Still best-effort, but never silent (lint
+                # swallowed-exception): a close that fails here usually
+                # means a handle died mid-read — exactly the breadcrumb
+                # wanted when a teardown segfault is being chased.
+                logger.debug('shutdown: closing cached handle for %s '
+                             'failed: %s', path, e)
         self._open_files.clear()
 
     def _read_with_retry(self, piece, read_fn):

@@ -40,7 +40,7 @@ from petastorm_tpu.service.worker import _Rpc, deserialize_chunk
 logger = logging.getLogger(__name__)
 
 
-class _ServiceConnection(object):
+class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one per consumer process; the resume token (state_dict) is the only thing that crosses processes
     """One consumer's connection: dispatcher RPCs + a DEALER per worker."""
 
     def __init__(self, dispatcher_addr, consumer=None, resume=None,

@@ -81,7 +81,7 @@ def build_splits(num_pieces, rowgroups_per_split, num_consumers):
     return splits
 
 
-class Dispatcher(object):
+class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-hosted control plane; peers talk to it over ZMQ, never by pickling it
     """Serve the control plane for one job.  Thread-hosted::
 
         config = ServiceConfig('file:///data/train', num_consumers=2)

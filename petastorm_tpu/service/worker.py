@@ -52,7 +52,7 @@ logger = logging.getLogger(__name__)
 _DEFAULT_RPC_TIMEOUT_S = 20.0
 
 
-class _Rpc(object):
+class _Rpc(object):  # ptlint: disable=pickle-unsafe-attrs — one per owning thread; sockets are rebuilt, never shipped
     """REQ-socket RPC client with timeout + socket recycling.
 
     A REQ socket wedges in send-state when a reply never comes; on
@@ -129,7 +129,7 @@ def deserialize_chunk(tag, payload):
     return pickle.loads(payload)
 
 
-class Worker(object):
+class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a process/thread; jobs reach it via the dispatcher RPC, never by pickling the object
     """One decode worker process/thread.
 
     Args:

@@ -22,7 +22,6 @@ import hashlib
 import logging
 import threading
 import uuid
-from urllib.parse import urlparse
 
 from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
 

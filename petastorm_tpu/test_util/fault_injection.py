@@ -33,6 +33,22 @@ class FlakyOpenFilesystem(object):
         self._counts = {}
         self._lock = threading.Lock()
 
+    # Documented to ride ``make_reader(..., filesystem=...)``, which the
+    # ProcessPool pickles into worker args — the lock (and the injection
+    # counts, which are per-process bookkeeping) must stay behind.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state['_lock']
+        # Counts consumed in the parent (e.g. the construction-time footer
+        # read) must not eat a worker's injection budget.
+        del state['_counts']
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._counts = {}
+        self._lock = threading.Lock()
+
     def open(self, path, *args, **kwargs):
         if _is_data_file(path):
             with self._lock:
@@ -43,6 +59,8 @@ class FlakyOpenFilesystem(object):
         return self._real.open(path, *args, **kwargs)
 
     def __getattr__(self, name):
+        if name == '_real':  # mid-unpickle: not yet restored
+            raise AttributeError(name)
         return getattr(self._real, name)
 
 

@@ -20,19 +20,27 @@ def exec_in_new_process(func, *args, **kwargs):
     (no lambdas/closures).
     """
     fd, payload_path = tempfile.mkstemp(suffix='.pkl', prefix='pstpu_spawn_')
-    with os.fdopen(fd, 'wb') as f:
-        pickle.dump((func, args, kwargs, sys.path), f, protocol=4)
-    program = (
-        'import pickle, sys\n'
-        'with open(sys.argv[1], "rb") as f:\n'
-        '    func, args, kwargs, parent_path = pickle.load(f)\n'
-        'import os; os.remove(sys.argv[1])\n'
-        'sys.path[:0] = [p for p in parent_path if p not in sys.path]\n'
-        'func(*args, **kwargs)\n'
-    )
-    env = dict(os.environ)
-    # Child processes are pure CPU decode workers: never let them grab the
-    # TPU client (single-client tunnel) or spin up XLA.
-    env['JAX_PLATFORMS'] = 'cpu'
-    env.pop('PALLAS_AXON_POOL_IPS', None)
-    return subprocess.Popen([sys.executable, '-c', program, payload_path], env=env)
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            pickle.dump((func, args, kwargs, sys.path), f, protocol=4)
+        program = (
+            'import pickle, sys\n'
+            'with open(sys.argv[1], "rb") as f:\n'
+            '    func, args, kwargs, parent_path = pickle.load(f)\n'
+            'import os; os.remove(sys.argv[1])\n'
+            'sys.path[:0] = [p for p in parent_path if p not in sys.path]\n'
+            'func(*args, **kwargs)\n'
+        )
+        env = dict(os.environ)
+        # Child processes are pure CPU decode workers: never let them grab
+        # the TPU client (single-client tunnel) or spin up XLA.
+        env['JAX_PLATFORMS'] = 'cpu'
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        return subprocess.Popen([sys.executable, '-c', program, payload_path],
+                                env=env)
+    except BaseException:
+        # The spawned child owns (and removes) the payload file; until the
+        # spawn succeeds it is still ours — a failed pickle.dump or Popen
+        # must not leak it (lint resource-lifecycle).
+        os.unlink(payload_path)
+        raise

@@ -23,6 +23,7 @@ workloads where the GIL does bind.
 
 import os
 import pickle
+import shutil
 import tempfile
 import uuid
 
@@ -33,7 +34,7 @@ from petastorm_tpu.workers_pool.exec_in_new_process import exec_in_new_process
 from petastorm_tpu.workers_pool.process_worker import worker_main
 
 
-class ProcessPool(object):
+class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-side shell; children get a pickled (worker_class, args) payload, never the pool
     def __init__(self, workers_count=10, results_queue_size=50, zmq_copy_buffers=True,
                  use_shm=None, shm_capacity_bytes=None):
         self.workers_count = workers_count
@@ -49,6 +50,7 @@ class ProcessPool(object):
         self._context = None
         self._work_socket = None
         self._sink_socket = None
+        self._endpoint_dir = None
         self._processes = []
         self._ventilator = None
         self._inflight = 0
@@ -71,7 +73,10 @@ class ProcessPool(object):
         self._arrow_ser = ArrowTableSerializer()
 
         self._context = zmq.Context()
-        endpoint_dir = tempfile.mkdtemp(prefix='pstpu_zmq_')
+        # Owned for the pool's lifetime; join() removes it (lint
+        # resource-lifecycle: the ipc socket files used to leak in /tmp
+        # on every pool).
+        endpoint_dir = self._endpoint_dir = tempfile.mkdtemp(prefix='pstpu_zmq_')
         work_addr = 'ipc://%s' % os.path.join(endpoint_dir, 'work_' + uuid.uuid4().hex[:8])
         sink_addr = 'ipc://%s' % os.path.join(endpoint_dir, 'sink_' + uuid.uuid4().hex[:8])
         self._work_socket = self._context.socket(zmq.PUSH)
@@ -85,9 +90,15 @@ class ProcessPool(object):
         capacity = (self._shm_capacity_bytes
                     or shm_plane.DEFAULT_CAPACITY_BYTES)
         try:
+            # os.getpid() rides in the payload because the CHILD cannot
+            # learn it reliably: sampling os.getppid() after its slow
+            # setup (imports + reader construction) races a parent that
+            # died during startup — the child would record the reaper's
+            # pid and never detect the orphaning.
             setup_payload = pickle.dumps(
                 (worker_class, worker_setup_args, work_addr, sink_addr,
-                 self._zmq_copy_buffers, use_shm, capacity), protocol=4)
+                 self._zmq_copy_buffers, use_shm, capacity, os.getpid()),
+                protocol=4)
         except Exception:
             # Unpicklable worker args (e.g. a closure transform): fail clean,
             # leaving no bound sockets behind.
@@ -95,6 +106,8 @@ class ProcessPool(object):
             self._sink_socket.close(0)
             self._context.term()
             self._work_socket = self._sink_socket = self._context = None
+            shutil.rmtree(endpoint_dir, ignore_errors=True)
+            self._endpoint_dir = None
             raise
         for worker_id in range(self.workers_count):
             self._processes.append(exec_in_new_process(worker_main, setup_payload, worker_id))
@@ -212,6 +225,11 @@ class ProcessPool(object):
             self._sink_socket.close(0)
         if self._context is not None:
             self._context.term()
+        if self._endpoint_dir is not None:
+            # The ipc endpoint files (and their directory) are this
+            # pool's to reclaim — nothing else ever unlinks them.
+            shutil.rmtree(self._endpoint_dir, ignore_errors=True)
+            self._endpoint_dir = None
 
     @property
     def diagnostics(self):

@@ -23,6 +23,7 @@ message to the matching byte tag — the parent speaks all four framings
 at all times.
 """
 
+import os
 import pickle
 import traceback
 
@@ -36,7 +37,7 @@ def worker_main(setup_payload, worker_id):
     from petastorm_tpu.workers_pool import shm_plane
 
     worker_class, worker_args, work_addr, sink_addr, copy_buffers, \
-        use_shm, shm_capacity = pickle.loads(setup_payload)
+        use_shm, shm_capacity, parent_pid = pickle.loads(setup_payload)
 
     context = zmq.Context()
     work_socket = context.socket(zmq.PULL)
@@ -76,8 +77,20 @@ def worker_main(setup_payload, worker_id):
     import time
 
     worker = worker_class(worker_id, publish, worker_args)
+    # A SIGKILLed parent can never send STOP: without a bounded wait the
+    # child parks in recv forever — an orphan pinning its /dev/shm arena
+    # and a CPU slot (lint unbounded-recv).  Poll with a timeout and exit
+    # when the parent is gone: getppid() stops matching the pool pid the
+    # parent embedded in the payload (reparenting to init/a reaper), a
+    # check that works even when the parent died before this point.
+    poller = zmq.Poller()
+    poller.register(work_socket, zmq.POLLIN)
     try:
         while True:
+            if not dict(poller.poll(2000)):
+                if os.getppid() != parent_pid:
+                    break  # orphaned: clean up as if STOP had arrived
+                continue
             frames = work_socket.recv_multipart()
             if frames[-1] == b'STOP':
                 break

@@ -67,6 +67,13 @@ import weakref
 
 import numpy as np
 
+# Shared with the cache plane: both planes cooperate on one /dev/shm
+# sweep protocol, so the liveness/alignment logic has a single home
+# (consolidated there after twin copies drifted review-visibly).
+from petastorm_tpu.utils.ipc import align as _align
+from petastorm_tpu.utils.ipc import flock_probe_unlink
+from petastorm_tpu.utils.ipc import pid_alive as _pid_alive
+
 logger = logging.getLogger(__name__)
 
 SHM_DIR = '/dev/shm'
@@ -75,7 +82,6 @@ DEFAULT_CAPACITY_BYTES = 256 << 20
 #: Payloads below this stay on the byte path: a descriptor round trip and
 #: a slab lease are pure overhead for results ZMQ moves in microseconds.
 MIN_SHM_BYTES = 32 << 10
-_ALIGN = 64
 #: Slab header: one little-endian uint64 — the highest released
 #: generation.  Payloads start at this offset (which also keeps them
 #: 64-byte aligned for the numpy views).
@@ -104,20 +110,6 @@ def _unregister_tracker(raw_name):
         resource_tracker.unregister(raw_name, 'shared_memory')
     except Exception:  # noqa: BLE001 — tracker variance must never cost us
         pass
-
-
-def _pid_alive(pid):
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True  # someone else's live process
-    return True
-
-
-def _align(offset):
-    return (offset + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
 # -- writer side --------------------------------------------------------------
@@ -564,22 +556,8 @@ def sweep_orphans():
             continue
         if _pid_alive(pid):
             continue
-        path = os.path.join(SHM_DIR, entry)
-        try:
-            fd = os.open(path, os.O_RDONLY)
-        except OSError:
-            continue
-        try:
-            try:
-                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-            except OSError:
-                continue  # lock held: the owner lives in another pid ns
-            os.unlink(path)
+        if flock_probe_unlink(os.path.join(SHM_DIR, entry)):
             removed.append(entry)
-        except OSError:
-            pass
-        finally:
-            os.close(fd)
     if removed:
         logger.info('shm sweep reclaimed %d orphaned segment(s)',
                     len(removed))

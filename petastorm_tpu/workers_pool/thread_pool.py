@@ -28,7 +28,7 @@ class _WorkerError(object):
         self.tb_str = tb_str
 
 
-class ThreadPool(object):
+class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process pool; nothing about it ever crosses a pickle boundary
     def __init__(self, workers_count=10, results_queue_size=50, profiler=None):
         #: Uniform public attribute across all pool classes (reader sizing).
         self.workers_count = workers_count

@@ -55,7 +55,7 @@ class Ventilator(object):
         pass
 
 
-class ConcurrentVentilator(Ventilator):
+class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs — drives its pool from the parent process only (resume tokens carry its cursor, not the object)
     """Feeds ``items`` to ``ventilate_fn`` across ``iterations`` epochs from a
     background thread, keeping at most ``max_ventilation_queue_size`` items
     un-acked in flight (acks arrive via :meth:`processed_item`).

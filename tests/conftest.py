@@ -10,7 +10,15 @@ import (but before first backend use) still wins, and is required — env vars
 alone are overridden by the hook.
 """
 
+import faulthandler
 import os
+
+# The suite has died natively before (PR 1: an mmap-backed ParquetFile
+# closed mid-read segfaulted teardown): faulthandler turns a native
+# crash into a stack dump.  (pytest's builtin faulthandler plugin
+# re-enables this onto a dup of the REAL stderr at configure time; this
+# call covers any pre-configure crash window and non-pytest imports.)
+faulthandler.enable()
 
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
@@ -25,11 +33,34 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.hookimpl(trylast=True)  # after builtin plugins have stashed fds
 def pytest_configure(config):
     # pytest-timeout is not installed in the TPU image; register the mark so
     # the suite stays warning-free (the marks document intent either way).
     config.addinivalue_line('markers', 'timeout(seconds): per-test time budget')
     config.addinivalue_line('markers', 'slow: long-running correctness test')
+    # Suite-level hang watchdog: the tier-1 run is killed at a hard 870s
+    # budget on some hosts, historically with NO python traceback.  The
+    # 800s repeating timer dumps every thread's stack just before that
+    # external kill (exit=False: diagnose, don't interfere).  It must
+    # write to the REAL stderr: pytest's fd-capture replaces fd 2 before
+    # conftest import, so a naive dump_traceback_later() lands in a
+    # per-test capture buffer that dies, unread, with the killed process
+    # (verified on this box) — reuse the original-stderr dup the builtin
+    # faulthandler plugin stashed at configure time.  The timeout knob
+    # exists so tests can pin the watchdog end-to-end without an 800s
+    # wait.  NOTE: do not also set the `faulthandler_timeout` ini option
+    # — its per-test timers share the single global faulthandler timer
+    # and would cancel this one at the first test.
+    timeout_s = float(os.environ.get('PETASTORM_TPU_FAULT_TIMEOUT', 800))
+    kwargs = {}
+    try:
+        from _pytest.faulthandler import fault_handler_stderr_fd_key
+        kwargs['file'] = config.stash[fault_handler_stderr_fd_key]
+    except Exception:  # plugin layout changed: an fd-2 dump beats none
+        pass
+    faulthandler.dump_traceback_later(timeout=timeout_s, repeat=True,
+                                      exit=False, **kwargs)
 
 
 @pytest.fixture(scope='session')

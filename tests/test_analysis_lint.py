@@ -1,0 +1,479 @@
+"""Fixture tests for ``petastorm_tpu.analysis`` — every lint rule gets a
+bad fixture proving it fires and a good fixture proving it stays quiet,
+plus framework-level coverage (suppressions, baseline, walker) and the
+gate test that the repo itself is clean modulo the checked-in baseline.
+"""
+
+import os
+import textwrap
+
+from petastorm_tpu.analysis import lint_paths, lint_text
+from petastorm_tpu.analysis.framework import (apply_baseline, load_baseline,
+                                              write_baseline)
+from petastorm_tpu.analysis.rules import ALL_RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ids(source, rule_id=None, path='fixture.py'):
+    findings = lint_text(textwrap.dedent(source), path=path)
+    ids = [f.rule_id for f in findings]
+    if rule_id is not None:
+        return [i for i in ids if i == rule_id]
+    return ids
+
+
+# -- resource-lifecycle -------------------------------------------------------
+
+def test_resource_lifecycle_fires_on_leaked_tempdir():
+    bad = '''
+    import tempfile, os
+
+    def start():
+        d = tempfile.mkdtemp(prefix='x')
+        return os.path.join(d, 'sock')  # path escapes, the dir leaks
+    '''
+    assert _ids(bad, 'resource-lifecycle')
+
+
+def test_resource_lifecycle_fires_on_unclosed_socket():
+    bad = '''
+    def serve(context, zmq):
+        sock = context.socket(zmq.REP)
+        sock.bind('tcp://127.0.0.1:1')
+    '''
+    assert _ids(bad, 'resource-lifecycle')
+
+
+def test_resource_lifecycle_quiet_on_teardown_ownership_or_with():
+    good = '''
+    import tempfile, os, shutil, weakref
+
+    def closed(context, zmq):
+        sock = context.socket(zmq.REP)
+        try:
+            sock.bind('tcp://127.0.0.1:1')
+        finally:
+            sock.close(0)
+
+    def transferred():
+        fd, path = tempfile.mkstemp()
+        os.fdopen(fd, 'wb').close()
+        os.unlink(path)
+
+    def owner_stored(self, context, zmq, cache):
+        s = context.socket(zmq.PUSH)
+        cache['s'] = s          # an owner holds it now
+
+    def returned(context, zmq):
+        s = context.socket(zmq.PULL)
+        return s                # ownership moves to the caller
+
+    def managed():
+        with tempfile.NamedTemporaryFile() as f:
+            return f.name
+    '''
+    assert not _ids(good, 'resource-lifecycle')
+
+
+# -- flock-discipline ---------------------------------------------------------
+
+def test_flock_discipline_fires_on_unbounded_lock_ex():
+    bad = '''
+    import fcntl
+
+    def grab(fd):
+        fcntl.flock(fd, fcntl.LOCK_EX)
+    '''
+    assert _ids(bad, 'flock-discipline')
+
+
+def test_flock_discipline_fires_on_rename_after_close():
+    bad = '''
+    import fcntl, os
+
+    def publish(tmp, dst):
+        fd = os.open(tmp, os.O_RDWR)
+        fcntl.flock(fd, fcntl.LOCK_SH | fcntl.LOCK_NB)
+        os.close(fd)          # the liveness lock dies here...
+        os.replace(tmp, dst)  # ...so a sweeper can reap tmp mid-publish
+    '''
+    assert _ids(bad, 'flock-discipline')
+
+
+def test_flock_discipline_quiet_on_nb_and_publish_before_close():
+    good = '''
+    import fcntl, os
+
+    def grab(fd):
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+
+    def publish(tmp, dst):
+        fd = os.open(tmp, os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_SH | fcntl.LOCK_NB)
+            os.replace(tmp, dst)  # lock still held through the rename
+        finally:
+            os.close(fd)
+    '''
+    assert not _ids(good, 'flock-discipline')
+
+
+# -- pickle-unsafe-attrs ------------------------------------------------------
+
+def test_pickle_unsafe_attrs_fires_without_getstate():
+    bad = '''
+    import threading
+
+    class Pool(object):
+        def __init__(self):
+            self._lock = threading.Lock()
+    '''
+    assert _ids(bad, 'pickle-unsafe-attrs')
+
+
+def test_pickle_unsafe_attrs_quiet_with_getstate_or_clean_attrs():
+    good = '''
+    import threading
+
+    class Tier(object):
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def __getstate__(self):
+            state = self.__dict__.copy()
+            del state['_lock']
+            return state
+
+    class Plain(object):
+        def __init__(self):
+            self.count = 0
+    '''
+    assert not _ids(good, 'pickle-unsafe-attrs')
+
+
+# -- swallowed-exception ------------------------------------------------------
+
+def test_swallowed_exception_fires_in_loop():
+    bad = '''
+    def worker_loop(queue):
+        while True:
+            try:
+                queue.step()
+            except Exception:
+                pass
+    '''
+    assert _ids(bad, 'swallowed-exception')
+
+
+def test_swallowed_exception_quiet_when_counted_logged_or_narrow():
+    good = '''
+    def counted(self, queue):
+        while True:
+            try:
+                queue.step()
+            except Exception:
+                self.errors += 1
+
+    def narrow(queue):
+        while True:
+            try:
+                queue.step()
+            except OSError:
+                pass
+
+    def outside_loop(queue):
+        try:
+            queue.step()
+        except Exception:
+            pass
+    '''
+    assert not _ids(good, 'swallowed-exception')
+
+
+# -- blocking-under-lock ------------------------------------------------------
+
+def test_blocking_under_lock_fires_on_sleep_and_bare_get():
+    bad = '''
+    import time
+
+    def fill(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def drain(self, q):
+        with self._lock:
+            item = q.get()
+    '''
+    assert len(_ids(bad, 'blocking-under-lock')) == 2
+
+
+def test_blocking_under_lock_quiet_for_deferred_callbacks():
+    good = '''
+    import time
+
+    def register(self):
+        with self._lock:
+            def cb():
+                time.sleep(1)   # defined under the lock, never RUN there
+            self.cb = cb
+            h = lambda: self.q.get()
+            self.h = h
+    '''
+    assert not _ids(good, 'blocking-under-lock')
+
+
+def test_blocking_under_lock_quiet_outside_lock_or_bounded():
+    good = '''
+    import time
+
+    def fill(self):
+        with self._lock:
+            self.n += 1
+        time.sleep(0.5)
+
+    def drain(self, q):
+        with self._lock:
+            item = q.get_nowait()
+            self.t.join(timeout=1)
+    '''
+    assert not _ids(good, 'blocking-under-lock')
+
+
+# -- unbounded-recv -----------------------------------------------------------
+
+def test_unbounded_recv_fires_in_pollerless_loop():
+    bad = '''
+    def worker_main(sock):
+        while True:
+            frames = sock.recv_multipart()
+    '''
+    assert _ids(bad, 'unbounded-recv')
+
+
+def test_unbounded_recv_quiet_with_poller_or_flags():
+    good = '''
+    def worker_main(sock, poller):
+        while True:
+            if not dict(poller.poll(1000)):
+                continue
+            frames = sock.recv_multipart()
+
+    def drain(sock, zmq):
+        while True:
+            frames = sock.recv_multipart(zmq.NOBLOCK)
+    '''
+    assert not _ids(good, 'unbounded-recv')
+
+
+# -- short-write --------------------------------------------------------------
+
+def test_short_write_fires_on_discarded_return():
+    bad = '''
+    import os
+
+    def store(fd, blob):
+        os.write(fd, blob)
+    '''
+    assert _ids(bad, 'short-write')
+
+
+def test_short_write_quiet_when_return_consumed():
+    good = '''
+    import os
+
+    def store(fd, blob):
+        view = memoryview(blob)
+        while len(view):
+            view = view[os.write(fd, view):]
+    '''
+    assert not _ids(good, 'short-write')
+
+
+# -- degrade-contract ---------------------------------------------------------
+
+def test_degrade_contract_fires_on_raise_in_never_raise_function():
+    bad = '''
+    def get_or_fill(key):
+        """Hit the tier or decode directly; never raises from cache
+        machinery."""
+        raise ValueError('full')
+    '''
+    assert _ids(bad, 'degrade-contract', path='cache_plane/plane.py')
+
+
+def test_degrade_contract_scoped_to_plane_modules_and_degrade_types():
+    quiet = '''
+    def get_or_fill(key):
+        """Never raises from cache machinery."""
+        raise ValueError('full')
+    '''
+    # Same source outside a plane module: the contract doesn't apply.
+    assert not _ids(quiet, 'degrade-contract', path='jax/loader.py')
+    good = '''
+    def read_payload(desc):
+        """Degrades per-chunk; lost slabs surface the degrade sentinel."""
+        raise SegmentVanishedError(2, 'gone')
+
+    def plain(key):
+        """No contract language here."""
+        raise ValueError('fine')
+    '''
+    assert not _ids(good, 'degrade-contract', path='shm_plane.py')
+
+
+# -- readonly-view-mutation ---------------------------------------------------
+
+def test_readonly_view_mutation_fires_on_lookup_result_write():
+    bad = '''
+    def warm(plane, key):
+        batch = plane.get_or_fill(key, fill)
+        batch['col'][0] = 1
+    '''
+    assert _ids(bad, 'readonly-view-mutation')
+
+
+def test_readonly_view_mutation_quiet_on_copy_or_other_values():
+    good = '''
+    import numpy as np
+
+    def warm(plane, key):
+        batch = dict(plane.get_or_fill(key, fill))
+        fresh = np.array(batch['col'])
+        fresh[0] = 1
+
+    def unrelated(chunk):
+        chunk['col'][0] = 1
+    '''
+    assert not _ids(good, 'readonly-view-mutation')
+
+
+def test_readonly_view_mutation_respects_statement_order():
+    # A write BEFORE the name is ever a view, and a write after the name
+    # is rebound to something else, both target non-view values.
+    good = '''
+    def before_and_after(plane, key):
+        batch = build()
+        batch['col'] = 1          # plain dict at this point
+        batch = plane.lookup(key)
+        use(batch)
+        batch = build()
+        batch['col'] = 2          # rebound away from the view
+    '''
+    assert not _ids(good, 'readonly-view-mutation')
+    bad = '''
+    def between(plane, key):
+        batch = build()
+        batch = plane.lookup(key)
+        batch['col'] = 1          # THIS one targets the view
+        batch = build()
+    '''
+    assert len(_ids(bad, 'readonly-view-mutation')) == 1
+
+
+# -- framework: suppressions, baseline, walker, syntax errors -----------------
+
+def test_inline_disable_suppresses_only_that_line_and_rule():
+    src = '''
+    import os
+
+    def a(fd, blob):
+        os.write(fd, blob)  # ptlint: disable=short-write — header stamp is 8 bytes, single-page write
+
+    def b(fd, blob):
+        os.write(fd, blob)
+    '''
+    findings = lint_text(textwrap.dedent(src), path='x.py')
+    assert [f.rule_id for f in findings] == ['short-write']
+    assert findings[0].line > 5  # only the un-suppressed call
+
+
+def test_file_level_disable_covers_whole_file():
+    src = '''
+    # ptlint: disable-file=short-write — fixture corpus, writes are fake
+    import os
+
+    def a(fd, blob):
+        os.write(fd, blob)
+    '''
+    assert not lint_text(textwrap.dedent(src), path='x.py')
+
+
+def test_baseline_roundtrip_and_budget(tmp_path):
+    src = textwrap.dedent('''
+    import os
+
+    def a(fd, blob):
+        os.write(fd, blob)
+        os.write(fd, blob)
+    ''')
+    findings = lint_text(src, path='mod.py')
+    assert len(findings) == 2
+    baseline_path = str(tmp_path / 'baseline.txt')
+    write_baseline(baseline_path, findings[:1])  # grandfather ONE of them
+    budget = load_baseline(baseline_path)
+    new, baselined = apply_baseline(findings, budget)
+    # Identical (path, rule, message) keys: the budget covers exactly one.
+    assert len(baselined) == 1 and len(new) == 1
+
+
+def test_write_baseline_merges_unscanned_files_and_refuses_select(
+        tmp_path, monkeypatch):
+    """A partial --write-baseline run must not wipe grandfathered entries
+    for files it did not scan, and a rule-scoped run must refuse to write
+    at all (it cannot see other rules' findings)."""
+    from petastorm_tpu.analysis import main
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir()
+    (pkg / 'a.py').write_text(
+        'import os\n\ndef f(fd, b):\n    os.write(fd, b)\n')
+    (pkg / 'b.py').write_text(
+        'import os\n\ndef g(fd, b):\n    os.write(fd, b)\n')
+    baseline = str(tmp_path / 'baseline.txt')
+    # Relative invocations (like CI's): file-root keys match dir-mode keys.
+    monkeypatch.chdir(tmp_path)
+    assert main(['pkg', '--baseline', baseline, '--write-baseline']) == 0
+    assert main(['pkg', '--baseline', baseline]) == 0  # green
+    # Partial re-write over only a.py: b.py's entry must survive.
+    assert main(['pkg/a.py', '--baseline', baseline,
+                 '--write-baseline']) == 0
+    entries = [l for l in open(baseline) if not l.startswith('#')]
+    assert len(entries) == 2, entries
+    assert main(['pkg', '--baseline', baseline]) == 0, \
+        'partial --write-baseline dropped entries for unscanned files'
+    # Rule-scoped write refused outright (usage error).
+    assert main(['pkg', '--baseline', baseline, '--select',
+                 'short-write', '--write-baseline']) == 2
+
+
+def test_lint_paths_walks_and_reports_root_relative(tmp_path):
+    pkg = tmp_path / 'somepkg' / 'sub'
+    pkg.mkdir(parents=True)
+    (pkg / 'mod.py').write_text(
+        'import os\n\ndef f(fd, b):\n    os.write(fd, b)\n')
+    (pkg / 'broken.py').write_text('def f(:\n')
+    findings = lint_paths([str(tmp_path / 'somepkg')])
+    keys = {(f.path, f.rule_id) for f in findings}
+    # Report paths start at the scanned root's basename — identical
+    # regardless of the invoking CWD, which is what keeps baseline keys
+    # stable between CI and local runs.
+    assert ('somepkg/sub/mod.py', 'short-write') in keys
+    assert ('somepkg/sub/broken.py', 'syntax-error') in keys
+
+
+def test_every_rule_has_id_and_motivation():
+    ids = [r.rule_id for r in ALL_RULES]
+    assert len(ids) == len(set(ids)) and all(ids)
+    assert all(r.motivation for r in ALL_RULES)
+    assert len(ids) >= 8  # the ISSUE 4 rule floor
+
+
+def test_repo_is_clean_modulo_baseline():
+    """THE gate invariant: the checked-in tree has zero non-baselined,
+    non-suppressed findings — exactly what the CI lint job enforces."""
+    findings = lint_paths([os.path.join(REPO, 'petastorm_tpu')])
+    budget = load_baseline(
+        os.path.join(REPO, 'petastorm_tpu', 'analysis', 'baseline.txt'))
+    new, _ = apply_baseline(findings, budget)
+    assert not new, 'un-baselined lint findings:\n%s' % '\n'.join(
+        str(f) for f in new)

@@ -74,6 +74,19 @@ def test_ci_run_commands_reference_real_paths():
             'ci.yml references missing path %r' % p
 
 
+def test_ci_lint_job_gates_on_ptlint_and_ruff():
+    """The lint job must run the repo-aware gate from the bare checkout
+    (stdlib-only: `python -m petastorm_tpu.analysis`) AND the generic
+    ruff subset — renaming either invocation must fail here, not on the
+    first real CI run (ISSUE 4)."""
+    job = _load_ci()['jobs']['lint']
+    run_text = '\n'.join(s['run'] for s in job['steps'] if 'run' in s)
+    assert 'python -m petastorm_tpu.analysis petastorm_tpu/' in run_text
+    assert 'ruff check' in run_text
+    # The gate stays JAX-free: no dependency install beyond ruff.
+    assert 'pip install -e' not in run_text
+
+
 def test_ci_tier1_names_its_slowest_tests():
     """The tier-1 suite runs against a hard time budget on some hosts;
     the pytest invocation must carry --durations so every run names its
@@ -137,7 +150,8 @@ def test_docs_conf_compiles_and_has_sphinx_settings():
     assert isinstance(ns.get('extensions'), list) and ns['extensions']
     # every doc page conf/index reference exists
     for page in ('index.md', 'api.md', 'architecture.md', 'performance.md',
-                 'migration.md', 'deployment.md', 'data_service.md'):
+                 'migration.md', 'deployment.md', 'data_service.md',
+                 'development.md'):
         assert os.path.exists(os.path.join(REPO, 'docs', page)), page
 
 
@@ -163,3 +177,118 @@ def test_console_script_entry_points_resolve():
 def test_docs_makefile_targets():
     mk = open(os.path.join(REPO, 'docs', 'Makefile')).read()
     assert 'html' in mk and 'sphinx' in mk.lower()
+
+
+# -- petastorm-tpu-lint CLI (ISSUE 4 satellite): exit codes, baseline
+# write mode, suppression parsing — pinned next to the other console
+# scripts so a CLI regression fails HERE, not in a CI run.
+
+def _lint_main(argv, capsys=None):
+    from petastorm_tpu.analysis import main
+    return main(argv)
+
+
+def test_lint_cli_exit_0_on_clean_tree(tmp_path):
+    (tmp_path / 'ok.py').write_text('x = 1\n')
+    assert _lint_main([str(tmp_path)]) == 0
+
+
+def test_lint_cli_exit_1_on_findings(tmp_path, capsys):
+    mod = tmp_path / 'leaky.py'
+    mod.write_text('import os\n\ndef f(fd, b):\n    os.write(fd, b)\n')
+    assert _lint_main([str(mod), '--no-baseline']) == 1
+    out = capsys.readouterr().out
+    # The documented finding format: path:line rule-id message.
+    assert 'leaky.py:4 short-write' in out
+
+
+def test_lint_cli_exit_2_on_usage_errors(tmp_path):
+    import pytest
+    assert _lint_main([str(tmp_path / 'nope')]) == 2
+    assert _lint_main(['--select', 'not-a-rule', str(tmp_path)]) == 2
+    with pytest.raises(SystemExit) as exc:  # argparse's own usage error
+        _lint_main(['--not-a-flag'])
+    assert exc.value.code == 2
+
+
+def test_lint_cli_write_baseline_then_green(tmp_path, capsys):
+    mod = tmp_path / 'leaky.py'
+    mod.write_text('import os\n\ndef f(fd, b):\n    os.write(fd, b)\n')
+    baseline = str(tmp_path / 'baseline.txt')
+    assert _lint_main([str(mod), '--baseline', baseline,
+                       '--write-baseline']) == 0
+    # Grandfathered: the same tree is now green against that baseline...
+    assert _lint_main([str(mod), '--baseline', baseline]) == 0
+    capsys.readouterr()
+    # ...but a NEW finding still fails, and only the new one prints.
+    mod.write_text('import os\n\ndef f(fd, b):\n    os.write(fd, b)\n'
+                   '\ndef g(fd, b):\n    os.write(fd, b)\n')
+    assert _lint_main([str(mod), '--baseline', baseline]) == 1
+    out = capsys.readouterr().out
+    assert out.count('short-write') == 1 and ':7 ' in out
+
+
+def test_lint_cli_inline_suppression_parsing(tmp_path):
+    mod = tmp_path / 'sup.py'
+    mod.write_text(
+        'import os\n\ndef f(fd, b):\n'
+        '    os.write(fd, b)'
+        '  # ptlint: disable=short-write — 8-byte stamp, single write\n')
+    assert _lint_main([str(mod), '--no-baseline']) == 0
+    # The suppression is rule-scoped: disabling another rule keeps the
+    # finding alive.
+    mod.write_text(
+        'import os\n\ndef f(fd, b):\n'
+        '    os.write(fd, b)  # ptlint: disable=flock-discipline\n')
+    assert _lint_main([str(mod), '--no-baseline']) == 1
+
+
+def test_conftest_arms_faulthandler():
+    """The tier-1 suite dies at a hard external timeout on some hosts and
+    has segfaulted natively before (PR 1) — conftest must arm
+    faulthandler with a pre-timeout dump so those runs end with
+    tracebacks instead of silence (ISSUE 4 satellite)."""
+    src = open(os.path.join(REPO, 'tests', 'conftest.py')).read()
+    assert 'faulthandler.enable()' in src
+    assert re.search(r'dump_traceback_later\(timeout=timeout_s,'
+                     r'\s*repeat=True,\s*\n\s*exit=False', src)
+    assert "'PETASTORM_TPU_FAULT_TIMEOUT', 800" in src
+
+
+def test_conftest_watchdog_dump_survives_pytest_capture(tmp_path):
+    """End-to-end: a hung suite must print thread stacks to the REAL
+    stderr before the external kill.  pytest's fd-capture swallows a
+    naively-armed dump (the bug the conftest works around), so this
+    spawns a pytest run with the watchdog at 2s over a 5s-sleeping test
+    and asserts the dump reached the process output."""
+    import shutil
+    import subprocess
+
+    # conftest discovery follows the TEST FILE's ancestors, so the real
+    # conftest is copied next to the hang test — this drives the very
+    # file the repo ships.
+    shutil.copy(os.path.join(REPO, 'tests', 'conftest.py'),
+                str(tmp_path / 'conftest.py'))
+    test = tmp_path / 'test_hang.py'
+    test.write_text('import time\n\ndef test_hangs():\n    time.sleep(5)\n')
+    env = dict(os.environ, PETASTORM_TPU_FAULT_TIMEOUT='2',
+               JAX_PLATFORMS='cpu')
+    out = subprocess.run(
+        [sys.executable, '-m', 'pytest', str(test), '-q',
+         '-p', 'no:cacheprovider', '-p', 'no:randomly'],
+        cwd=str(tmp_path),
+        env=env, capture_output=True, text=True, timeout=120)
+    merged = out.stdout + out.stderr
+    assert out.returncode == 0, merged
+    assert 'Timeout (0:00:02)' in merged, \
+        'watchdog dump did not reach the real stderr:\n%s' % merged[-2000:]
+    assert 'test_hangs' in merged.split('Timeout (0:00:02)', 1)[1]
+
+
+def test_pyproject_carries_ruff_config():
+    src = open(os.path.join(REPO, 'pyproject.toml')).read()
+    assert '[tool.ruff' in src
+    block = re.search(r'\[tool\.ruff\.lint\](.*?)\n\[', src, re.S)
+    assert block and re.search(r'select\s*=', block.group(1))
+    assert '[tool.ruff.lint.per-file-ignores]' in src
+    assert '"petastorm/**"' in src  # legacy alias package stays ignored

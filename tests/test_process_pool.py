@@ -11,6 +11,7 @@ import pytest
 
 from petastorm_tpu import make_batch_reader, make_reader
 from petastorm_tpu.transform import TransformSpec
+from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
 from test_common import assert_rows_equal, create_test_dataset, shm_residue
 
@@ -107,3 +108,51 @@ def test_process_pool_shm_round_trip_matches_pickle_path(
     assert_rows_equal(rows_by_path['bytes'], big_rowgroup_dataset.data)
     assert shm_residue() - before == set(), \
         'clean shutdown left /dev/shm residue'
+
+
+class _NoopWorker(WorkerBase):
+    """Module-level (picklable by reference) worker for pool-internal tests."""
+
+    def process(self, *args, **kwargs):
+        pass
+
+
+@pytest.mark.timeout(60)
+def test_process_pool_worker_exits_when_parent_vanishes(tmp_path):
+    """A worker whose pool parent died must self-exit from its poll loop
+    instead of parking in recv forever — the orphaned children used to
+    outlive a SIGKILLed parent indefinitely, pinning /dev/shm arenas
+    (lint rule unbounded-recv; the parent pid rides the setup payload
+    because sampling getppid() after slow child setup races a parent
+    that dies during startup)."""
+    import pickle
+    import time
+
+    zmq = pytest.importorskip('zmq')
+    from petastorm_tpu.workers_pool.exec_in_new_process import \
+        exec_in_new_process
+    from petastorm_tpu.workers_pool.process_worker import worker_main
+
+    context = zmq.Context()
+    work_addr = 'ipc://%s' % (tmp_path / 'work')
+    sink_addr = 'ipc://%s' % (tmp_path / 'sink')
+    work = context.socket(zmq.PUSH)
+    work.bind(work_addr)
+    sink = context.socket(zmq.PULL)
+    sink.bind(sink_addr)
+    try:
+        # A pid that cannot be alive: pid 2**22 is above this kernel's
+        # default pid_max and os.kill probes it as ProcessLookupError.
+        dead_parent = 2 ** 22 - 1
+        payload = pickle.dumps(
+            (_NoopWorker, None, work_addr, sink_addr, True, False, 0,
+             dead_parent), protocol=4)
+        child = exec_in_new_process(worker_main, payload, 0)
+        t0 = time.monotonic()
+        assert child.wait(timeout=30) == 0
+        # One or two 2s poll ticks after startup, not a hang.
+        assert time.monotonic() - t0 < 25
+    finally:
+        work.close(0)
+        sink.close(0)
+        context.term()
