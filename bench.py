@@ -991,6 +991,44 @@ def delivery_plane_service_leg(worker_counts=(1, 2, 4), shm_pairs=3):
         if churn:
             fields['delivery_plane_service_lease_churn_w%d'
                    % n_workers] = churn
+
+    # Stall attribution (ISSUE 5 satellite): one short instrumented pass
+    # — TraceRecorder on the client merges the workers' correlated spans
+    # (decode/serialize/shm publish) onto the consumer timeline, and the
+    # StallMonitor's data_wait windows decompose by component.  The top
+    # component rides the compact line; the full pct map is detail.
+    # Contained: a failure here may lose only these two fields, never
+    # the scaling measurements already sitting in `fields`.
+    try:
+        from petastorm_tpu.benchmark import StallMonitor, TraceRecorder
+        recorder = TraceRecorder()
+        config = ServiceConfig(
+            SVC_DATASET_URL, num_consumers=1, rowgroups_per_split=2,
+            lease_ttl_s=30.0,
+            reader_kwargs={'workers_count': max(2, WORKERS // 2)})
+        monitor = StallMonitor(warmup_steps=4, trace_recorder=recorder)
+        with Dispatcher(config) as dispatcher:
+            workers = [Worker(dispatcher.addr).start() for _ in range(2)]
+            try:
+                loader = ServiceDataLoader(dispatcher.addr,
+                                           batch_size=BATCH,
+                                           consumer=0, drop_last=False,
+                                           prefetch=2,
+                                           trace_recorder=recorder)
+                with loader:
+                    for _ in monitor.wrap(loader.iter_host_batches()):
+                        pass
+            finally:
+                for w in workers:
+                    w.stop()
+                for w in workers:
+                    w.join()
+        report = monitor.report()
+        if 'stall_breakdown' in report:
+            fields['stall_breakdown_service'] = report['stall_breakdown']
+            fields['stall_top_component'] = report['stall_top_component']
+    except Exception as e:  # noqa: BLE001 — diagnostic add-on only
+        fields['stall_breakdown_error'] = '%s: %s' % (type(e).__name__, e)
     return fields
 
 
@@ -1448,6 +1486,7 @@ _COMPACT_KEYS = (
     'epoch_cache_service_warm_images_per_sec',
     'epoch_cache_service_warm_over_cold',
     'stall_pct_epoch_cache_warm_scan',
+    'stall_top_component',
     'ipc_bytes_per_s', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',

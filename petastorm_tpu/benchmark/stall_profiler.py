@@ -54,10 +54,17 @@ class StallMonitor(object):
             wait_end = time.monotonic()
             yield batch
             step_end = time.monotonic()
+            warmup = self._skipped < self._warmup_steps
             if self._trace is not None:
-                self._trace.event('data_wait', wait_start, wait_end)
-                self._trace.event('step', wait_end, step_end)
-            if self._skipped < self._warmup_steps:
+                # Warmup pairs stay ON the timeline but under their own
+                # names: stall_breakdown attributes only 'data_wait'
+                # windows, so it covers exactly the population stall_pct
+                # counts — pipeline-fill/compile waits must not name the
+                # compact line's top component.
+                suffix = '_warmup' if warmup else ''
+                self._trace.event('data_wait' + suffix, wait_start, wait_end)
+                self._trace.event('step' + suffix, wait_end, step_end)
+            if warmup:
                 # First pulls pay pipeline fill + compile; not steady state.
                 self._skipped += 1
                 continue
@@ -70,10 +77,26 @@ class StallMonitor(object):
         total = self.wait_time + self.step_time
         return (self.wait_time / total) if total > 0 else 0.0
 
+    def stall_breakdown(self):
+        """Attribute the recorded ``data_wait`` time to pipeline
+        components (lease-wait / decode / IPC / cache-fill / H2D) from
+        the attached recorder's spans — including any worker spans merged
+        cross-process (ISSUE 5).  None without a recorder or waits."""
+        if self._trace is None:
+            return None
+        from petastorm_tpu.telemetry import attribute_stalls
+        return attribute_stalls(self._trace.events)
+
     def report(self):
-        return {
+        out = {
             'steps': self.steps,
             'data_wait_s': round(self.wait_time, 4),
             'step_s': round(self.step_time, 4),
             'stall_pct': round(100.0 * self.stall_fraction, 2),
         }
+        breakdown = self.stall_breakdown()
+        if breakdown:
+            out['stall_breakdown'] = breakdown['pct']
+            out['stall_top_component'] = '%s:%.0f%%' % (
+                breakdown['top'], breakdown['pct'][breakdown['top']])
+        return out

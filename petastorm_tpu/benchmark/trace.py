@@ -23,15 +23,26 @@ Spans recorded (one 'X' event each): ``host_batch`` (decode-plane wait),
 loader in the family, plus ``data_wait`` / ``step`` from
 ``StallMonitor.wrap``.  The reference has no equivalent (its
 observability is logging only); this is a build-obligation extension.
+
+Cross-process timelines (ISSUE 5): worker processes record spans into
+``telemetry.spans.SpanBuffer``s that ride the existing ZMQ frames back;
+the parent/client merges them here via
+``telemetry.spans.merge_into_recorder`` (which passes explicit ``pid=``
+so each process gets its own Perfetto track) after clock-offset
+alignment.  ``set_process_label`` names the tracks.
 """
 
 import json
 import os
 import threading
 import time
+import weakref
 from collections import deque
 
-__all__ = ['TraceRecorder']
+__all__ = ['TraceRecorder', 'all_recorder_events']
+
+#: Live recorders, for the crash-artifact dump (telemetry.dump_state).
+_LIVE = weakref.WeakSet()
 
 
 class TraceRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — recorder lives in the driving process; workers ship spans back over the wire, never the recorder
@@ -47,22 +58,35 @@ class TraceRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — recorder
         self._events = deque(maxlen=int(max_events))
         self._lock = threading.Lock()
         self._t0 = time.monotonic()  # trace origin: construction time
+        _LIVE.add(self)
 
-    def event(self, name, t_start_s, t_end_s, **args):
+    def event(self, name, t_start_s, t_end_s, pid=None, tid=None, **args):
         """Record one complete span; timestamps are ``time.monotonic()``
-        seconds (the clock every instrumented section already reads)."""
+        seconds (the clock every instrumented section already reads).
+        ``pid``/``tid`` override the recording process/thread — the merge
+        path for spans another process shipped over (each pid renders as
+        its own Perfetto track)."""
         ev = {
             'name': name,
             'ph': 'X',
             'ts': round(1e6 * (t_start_s - self._t0), 1),
             'dur': round(1e6 * max(0.0, t_end_s - t_start_s), 1),
-            'pid': os.getpid(),
-            'tid': threading.get_ident(),
+            'pid': os.getpid() if pid is None else pid,
+            'tid': threading.get_ident() if tid is None else tid,
         }
         if args:
             ev['args'] = args
         with self._lock:
             self._events.append(ev)
+
+    def set_process_label(self, pid, label):
+        """Name a pid's Perfetto track (metadata 'M' event) — e.g.
+        ``service worker w1`` — so the merged fleet timeline reads as
+        processes, not numbers."""
+        with self._lock:
+            self._events.append({'name': 'process_name', 'ph': 'M',
+                                 'pid': pid, 'tid': 0,
+                                 'args': {'name': str(label)}})
 
     def instant(self, name, **args):
         """Record a point-in-time marker (epoch boundary, checkpoint, ...)."""
@@ -95,3 +119,15 @@ class TraceRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — recorder
         with open(path, 'w') as f:
             json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
         return len(events)
+
+
+def all_recorder_events():
+    """Per-recorder event batches for crash dumps
+    (``telemetry.dump_state``).  Each batch carries the recorder's
+    monotonic origin: ``ts`` values are RELATIVE to the recorder's own
+    construction time, so a flat concatenation of two recorders created
+    minutes apart would show their spans as simultaneous —
+    ``origin_monotonic + ts/1e6`` puts every event back on the one
+    process clock."""
+    return [{'origin_monotonic': recorder._t0, 'events': recorder.events}
+            for recorder in list(_LIVE)]

@@ -536,11 +536,7 @@ class CachePlane(object):
                                'running disk-only', e)
         self.context = context
         self.fill_wait_s = float(fill_wait_s)
-        self.hits = 0
-        self.ram_hits = 0
-        self.misses = 0
-        self.single_flight_hits = 0
-        self.degraded = 0
+        self._init_metrics()
         self._promote_backoff_until = 0.0
         # Construction sweeps crash residue — but per-split reader churn
         # (the service builds one reader, hence one plane object, per
@@ -552,6 +548,43 @@ class CachePlane(object):
             if now - _LAST_SWEEP.get(tier.root, -1e9) >= 30.0:
                 _LAST_SWEEP[tier.root] = now
                 tier.sweep()
+
+    def _init_metrics(self):
+        """Source of truth for the plane's counters (ISSUE 5): ``stats``
+        (and through it reader/loader diagnostics and the service
+        heartbeats) is a view over this registry.  Fill spans land in
+        the plane's OWN buffer (not the process-global singleton): the
+        instance is per-reader, so whoever owns the reader drains
+        exactly its own fills — concurrent in-process workers can't
+        drop or mis-attribute each other's spans."""
+        from petastorm_tpu.telemetry import MetricsRegistry, SpanBuffer
+        self.metrics = MetricsRegistry('cache_plane')
+        self.spans = SpanBuffer(1024)
+        self._m_hits = self.metrics.counter('cache_hits')
+        self._m_ram_hits = self.metrics.counter('cache_ram_hits')
+        self._m_misses = self.metrics.counter('cache_misses')
+        self._m_sf_hits = self.metrics.counter('cache_single_flight_hits')
+        self._m_degraded = self.metrics.counter('cache_degraded')
+        self._m_fill = self.metrics.histogram('cache_fill')
+
+    # pickling (PlaneCache rides worker args across the ProcessPool
+    # boundary): instruments hold the registry's process-local lock, so
+    # ship the SNAPSHOT and rebuild live instruments in the child — the
+    # counts carry over, then the copies diverge exactly like the plain
+    # ints they replaced (parent-side merge channels reunite them).
+    def __getstate__(self):
+        state = {k: v for k, v in self.__dict__.items()
+                 if k not in ('metrics', 'spans')
+                 and not k.startswith('_m_')}
+        state['_metrics_snapshot'] = self.metrics.snapshot()
+        return state
+
+    def __setstate__(self, state):
+        snapshot = state.pop('_metrics_snapshot', None)
+        self.__dict__.update(state)
+        self._init_metrics()
+        if snapshot:
+            self.metrics.merge(snapshot)
 
     def _tiers(self):
         return [t for t in (self.ram, self.disk) if t is not None]
@@ -565,7 +598,7 @@ class CachePlane(object):
         if self.ram is not None:
             value = self.ram.lookup(digest)
             if value is not MISS:
-                self.ram_hits += 1
+                self._m_ram_hits.inc()
                 return value
         value = self.disk.lookup(digest)
         if value is not MISS and promote and self.ram is not None \
@@ -603,13 +636,15 @@ class CachePlane(object):
         decode — never block past ``fill_wait_s``, never raise from
         cache machinery into the decode path."""
         if self.disk is None:  # plane dir unavailable: decode-direct
-            self.degraded += 1
-            self.misses += 1
-            return fill()
+            self._m_degraded.inc()
+            self._m_misses.inc()
+            # digest, not the raw key: span cids must match the healthy
+            # paths' (and structured keys stringify arbitrarily long).
+            return self._timed_fill(self.digest(key), fill)
         digest = self.digest(key)
         value = self._lookup(digest)
         if value is not MISS:
-            self.hits += 1
+            self._m_hits.inc()
             return value
         lock_path = os.path.join(self.disk.root, digest + LOCK_SUFFIX)
         lock_fd = None
@@ -620,9 +655,9 @@ class CachePlane(object):
                 # Can't even CREATE the lock file (read-only mount, bad
                 # ownership): nobody is filling — waiting would stall
                 # every miss for fill_wait_s.  Decode directly.
-                self.degraded += 1
-                self.misses += 1
-                return fill()
+                self._m_degraded.inc()
+                self._m_misses.inc()
+                return self._timed_fill(digest, fill)
             try:
                 fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
             except OSError:
@@ -635,8 +670,8 @@ class CachePlane(object):
                 while time.monotonic() < deadline:
                     value = self._lookup(digest)
                     if value is not MISS:
-                        self.hits += 1
-                        self.single_flight_hits += 1
+                        self._m_hits.inc()
+                        self._m_sf_hits.inc()
                         return value
                     try:
                         lock_fd = os.open(lock_path,
@@ -654,27 +689,27 @@ class CachePlane(object):
                     # Still locked past the deadline (or the lock file
                     # vanished from under us): decode directly — a
                     # wedged peer must not block this epoch.
-                    self.degraded += 1
-                    self.misses += 1
-                    return fill()
+                    self._m_degraded.inc()
+                    self._m_misses.inc()
+                    return self._timed_fill(digest, fill)
             # Holding the key lock: re-check (the previous holder may
             # have published while we acquired), then fill + publish.
             value = self._lookup(digest)
             if value is not MISS:
-                self.hits += 1
-                self.single_flight_hits += 1
+                self._m_hits.inc()
+                self._m_sf_hits.inc()
                 return value
-            self.misses += 1
-            value = fill()
+            self._m_misses.inc()
+            value = self._timed_fill(digest, fill)
             try:
                 blob = encode_entry(value)
             except Exception as e:  # noqa: BLE001 — unencodable: degrade
                 logger.warning('cache plane: cannot encode entry for %r '
                                '(%s); serving uncached', key, e)
-                self.degraded += 1
+                self._m_degraded.inc()
                 return value
             if not self.disk.store(digest, blob):
-                self.degraded += 1
+                self._m_degraded.inc()
             # Same thrash gate as the disk->ram promotion in _lookup:
             # oversized entries never enter the hot tier, and a store
             # that itself evicts puts hot-tier writes on backoff.
@@ -690,6 +725,41 @@ class CachePlane(object):
             if lock_fd is not None:
                 os.close(lock_fd)  # closing drops the flock
 
+    def _timed_fill(self, cid, fill):
+        """Run the direct decode, timed into the ``cache_fill`` histogram
+        and the plane's span buffer (correlation id = the entry digest),
+        so a miss-heavy epoch shows up in stage p99s and on the merged
+        timeline.  ``fill`` raising is the decode path raising — cache
+        machinery adds no exception of its own here."""
+        t0 = time.monotonic()
+        try:
+            return fill()
+        finally:
+            t1 = time.monotonic()
+            self._m_fill.observe(t1 - t0)
+            self.spans.span('cache/fill', t0, t1, cid=cid)
+
+    # Registry views — the counter attributes older callers/tests read.
+    @property
+    def hits(self):
+        return self._m_hits.value
+
+    @property
+    def ram_hits(self):
+        return self._m_ram_hits.value
+
+    @property
+    def misses(self):
+        return self._m_misses.value
+
+    @property
+    def single_flight_hits(self):
+        return self._m_sf_hits.value
+
+    @property
+    def degraded(self):
+        return self._m_degraded.value
+
     @property
     def evictions(self):
         return sum(t.evictions for t in self._tiers())
@@ -697,7 +767,7 @@ class CachePlane(object):
     @property
     def stats(self):
         """The diagnostics counters surfaced by readers, the service
-        worker heartbeat, and the JAX loader."""
+        worker heartbeat, and the JAX loader — a view over ``metrics``."""
         out = {'cache_hits': self.hits, 'cache_misses': self.misses,
                'cache_evictions': self.evictions,
                'cache_ram_hits': self.ram_hits,
@@ -741,6 +811,16 @@ class PlaneCache(CacheBase):
     @property
     def stats(self):
         return self.plane.stats
+
+    @property
+    def metrics(self):
+        """The plane's registry — the service worker merges its
+        ``cache_fill`` histogram into the heartbeat snapshot."""
+        return self.plane.metrics
+
+    @property
+    def spans(self):
+        return self.plane.spans
 
     def cleanup(self):
         if self._cleanup_on_exit:

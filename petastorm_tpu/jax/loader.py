@@ -104,10 +104,43 @@ class DataLoader(object):
         #: async and overlaps).  Pair with StallMonitor for the consumer
         #: view and reader.diagnostics['decode_utilization'] for the
         #: worker-pool view (all three pools; the ZeroMQ pool ships child
-        #: busy time back on each ack).
-        self.stats = {'host_batch_s': 0.0, 'transform_s': 0.0,
-                      'device_put_s': 0.0, 'batches': 0}
+        #: busy time back on each ack).  The source of truth is the
+        #: telemetry registry (ISSUE 5): ``stats`` is a view over its
+        #: counters, and each stage additionally feeds a log2-bucket
+        #: latency histogram (``diagnostics`` reports the p50/p99s).
+        from petastorm_tpu.telemetry import MetricsRegistry
+        self.metrics = MetricsRegistry('loader')
+        self._m_batches = self.metrics.counter('batches')
+        self._m_stage = {
+            stage: (self.metrics.counter(stage + '_s'),
+                    self.metrics.histogram(stage))
+            for stage in ('host_batch', 'transform', 'device_put')}
         self._trace = trace_recorder
+        if trace_recorder is not None:
+            # ProcessPool children ship their spans (pool/process,
+            # pool/publish, cache/fill) on the ack channel; pointing the
+            # pool at this recorder is what lands them on THIS timeline
+            # — without it they sit in the pool's bounded remote_spans
+            # buffer that nothing reads.  Same-host children share
+            # CLOCK_MONOTONIC, so no offset is needed.
+            pool = getattr(reader, '_pool', None)
+            if pool is not None and hasattr(pool, 'trace_recorder'):
+                pool.trace_recorder = trace_recorder
+
+    def _observe(self, stage, t0, t1):
+        """One stage sample: wall-time counter + latency histogram."""
+        counter, hist = self._m_stage[stage]
+        counter.inc(t1 - t0)
+        hist.observe(t1 - t0)
+
+    @property
+    def stats(self):
+        """Aggregate per-stage seconds + batch count — the historical
+        dict surface, now a view over ``self.metrics``."""
+        return {'host_batch_s': self._m_stage['host_batch'][0].value,
+                'transform_s': self._m_stage['transform'][0].value,
+                'device_put_s': self._m_stage['device_put'][0].value,
+                'batches': int(self._m_batches.value)}
 
     # -- iteration -----------------------------------------------------------
 
@@ -142,12 +175,12 @@ class DataLoader(object):
             with TraceAnnotation('pt/device_put'):
                 pending.append(self._to_device(host_batch))
             t3 = time.monotonic()
-            self.stats['host_batch_s'] += t1 - t0
-            self.stats['transform_s'] += t2 - t1
-            self.stats['device_put_s'] += t3 - t2
-            self.stats['batches'] += 1
+            self._observe('host_batch', t0, t1)
+            self._observe('transform', t1, t2)
+            self._observe('device_put', t2, t3)
+            self._m_batches.inc()
             if self._trace is not None:
-                n = self.stats['batches']
+                n = int(self._m_batches.value)
                 self._trace.event('host_batch', t0, t1, batch=n)
                 if self._transform_fn is not None:
                     self._trace.event('transform', t1, t2, batch=n)
@@ -397,7 +430,7 @@ class DataLoader(object):
             restored = self._resume_state['pending']
             self._resume_state = dict(self._resume_state, pending=[])
             for host_batch in restored:
-                self.stats['batches'] += 1
+                self._m_batches.inc()
                 yield host_batch
         # Same per-stage accounting as __iter__ (minus device_put — there
         # is none here), so the bottleneck advisor and the doctor can
@@ -407,10 +440,10 @@ class DataLoader(object):
             if self._transform_fn is not None:
                 host_batch = self._transform_fn(host_batch)
                 t2 = time.monotonic()
-                self.stats['transform_s'] += t2 - t1
+                self._observe('transform', t1, t2)
                 if self._trace is not None:
                     self._trace.event('transform', t1, t2)
-            self.stats['batches'] += 1
+            self._m_batches.inc()
             yield host_batch
 
     def _timed_pulls(self, gen):
@@ -425,7 +458,7 @@ class DataLoader(object):
             except StopIteration:
                 return
             t1 = time.monotonic()
-            self.stats['host_batch_s'] += t1 - t0
+            self._observe('host_batch', t0, t1)
             if self._trace is not None:
                 self._trace.event('host_batch', t0, t1)
             yield host_batch
@@ -496,8 +529,8 @@ class DataLoader(object):
             else:
                 out = jax.device_put(numeric)
             t2 = time.monotonic()
-            self.stats['transform_s'] += t1 - t0
-            self.stats['device_put_s'] += t2 - t1
+            self._observe('transform', t0, t1)
+            self._observe('device_put', t1, t2)
             if self._trace is not None:
                 if self._transform_fn is not None and not transformed:
                     self._trace.event('transform', t0, t1, chunk=len(chunk))
@@ -517,7 +550,7 @@ class DataLoader(object):
             restored = self._resume_state['pending']
             self._resume_state = dict(self._resume_state, pending=[])
             for host_batch in restored:
-                self.stats['batches'] += 1
+                self._m_batches.inc()
                 carry, outs = fn(carry, put_stacked([host_batch],
                                                     transformed=True))
                 yield carry, outs
@@ -531,7 +564,7 @@ class DataLoader(object):
                 chunk = []
                 yield carry, outs
             chunk.append(host_batch)
-            self.stats['batches'] += 1
+            self._m_batches.inc()
             if len(chunk) == steps_per_call:
                 carry, outs = fn(carry, put_stacked(chunk))
                 chunk = []
@@ -610,12 +643,14 @@ class DataLoader(object):
 
     @property
     def diagnostics(self):
-        """The loader's per-stage ``stats`` merged with the reader's pool
-        diagnostics — including the epoch-cache plane counters
-        (``cache_hits`` / ``cache_misses`` / ``cache_evictions``) when
-        the underlying reader runs ``cache_type='plane'``, so one gauge
-        read says whether this epoch decoded or served warm."""
-        out = dict(self.stats)
+        """The loader's registry view (per-stage seconds + log2-histogram
+        p50/p99s) merged with the reader's pool diagnostics — including
+        the epoch-cache plane counters (``cache_hits`` / ``cache_misses``
+        / ``cache_evictions``) when the underlying reader runs
+        ``cache_type='plane'``, so one gauge read says whether this epoch
+        decoded or served warm."""
+        out = self.metrics.as_dict()
+        out['batches'] = int(out.get('batches', 0))
         if self.reader is not None:
             out.update(getattr(self.reader, 'diagnostics', None) or {})
         return out
@@ -1010,7 +1045,7 @@ class DeviceInMemDataLoader(InMemDataLoader):
                         idx = order[start:]
                         batch = jax.tree_util.tree_map(
                             lambda v: jnp.take(v, idx, axis=0), cache)
-                    self.stats['batches'] += 1
+                    self._m_batches.inc()
                     # Account BEFORE the yield: once the consumer holds the
                     # epoch's last batch, a state_dict() taken there must
                     # read as an epoch boundary (the generator stays
@@ -1194,7 +1229,7 @@ class DeviceInMemDataLoader(InMemDataLoader):
                     # (ADVICE r05 #2: the bare tail shape was a foot-gun
                     # for consumers indexing outs by epoch.)
                     outs = jax.tree_util.tree_map(lambda x: x[None], outs)
-                self.stats['batches'] += steps - start
+                self._m_batches.inc(steps - start)
                 self._epochs_done += 1
                 yield carry, outs
             else:
@@ -1210,7 +1245,7 @@ class DeviceInMemDataLoader(InMemDataLoader):
                 # requested — a trailing 1-epoch group must not silently
                 # drop the epochs axis consumers index by.
                 carry, outs = fn_many(carry, cache, jnp.stack(group))
-            self.stats['batches'] += steps * len(group)
+            self._m_batches.inc(steps * len(group))
             self._epochs_done += len(group)  # group yields ARE boundaries
             yield carry, outs
 

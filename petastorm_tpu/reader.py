@@ -694,7 +694,20 @@ class Reader(object):
         self._cache.cleanup()
 
     @property
+    def metrics(self):
+        """The pool's ``telemetry.MetricsRegistry`` — the source of truth
+        ``diagnostics`` (and the loader's merged view) is built from.
+        For a ProcessPool reader the parent-side registry is merged with
+        the child snapshots riding the ack channel
+        (``ProcessPool.worker_telemetry``)."""
+        return getattr(self._pool, 'metrics', None)
+
+    @property
     def diagnostics(self):
+        # A VIEW over the telemetry registries (ISSUE 5): the pool's
+        # parent-side registry (+ merged child snapshots for the
+        # ProcessPool) and the cache plane's — no counter lives in this
+        # dict; it is rebuilt from the registries on every read.
         d = dict(self._pool.diagnostics)
         # Epoch-cache plane counters (cache_type='plane'): hit/miss/evict
         # gauges of THIS process's view of the shared plane (thread-pool

@@ -8,6 +8,10 @@ Three-command quickstart (one dispatcher, N decode hosts, then point
         --num-consumers 4
     petastorm-tpu-data-service worker --dispatcher tcp://dispatch:7777
     petastorm-tpu-data-service status --dispatcher tcp://dispatch:7777
+
+``status`` is a one-shot JSON dump; for a live terminal view of the same
+``stats`` RPC (per-worker throughput, fleet stage p50/p99, cache/shm
+hit-and-degrade rates) use ``petastorm-tpu-top`` (ISSUE 5).
 """
 
 import argparse
@@ -48,6 +52,11 @@ def _build_parser():
                    help='hot /dev/shm tier cap (default 128 MiB)')
     d.add_argument('--cache-plane-disk-bytes', type=int, default=None,
                    help='disk tier cap (default 4 GiB)')
+    d.add_argument('--no-telemetry-spans', action='store_true',
+                   help='do not ship per-split correlated stage spans on '
+                        'the data-plane end headers (metrics registries '
+                        'and heartbeat stats stay on; see '
+                        'docs/observability.md)')
 
     w = sub.add_parser('worker', help='run one decode worker')
     w.add_argument('--dispatcher', required=True,
@@ -108,7 +117,8 @@ def main(argv=None):
             cache_plane=args.cache_plane_dir is not None,
             cache_plane_dir=args.cache_plane_dir,
             cache_plane_ram_bytes=args.cache_plane_ram_bytes,
-            cache_plane_disk_bytes=args.cache_plane_disk_bytes)
+            cache_plane_disk_bytes=args.cache_plane_disk_bytes,
+            telemetry_spans=not args.no_telemetry_spans)
         with Dispatcher(config, bind=args.bind) as dispatcher:
             print('dispatcher serving %s (%d splits, %d consumers)'
                   % (dispatcher.addr, dispatcher._job['num_splits'],

@@ -32,10 +32,12 @@ import logging
 import pickle
 import queue
 import threading
+import time
 
 from petastorm_tpu.errors import ServiceError
 from petastorm_tpu.jax.loader import DataLoader
 from petastorm_tpu.service.worker import _Rpc, deserialize_chunk
+from petastorm_tpu.telemetry import merge_into_recorder
 
 logger = logging.getLogger(__name__)
 
@@ -45,13 +47,22 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
 
     def __init__(self, dispatcher_addr, consumer=None, resume=None,
                  ordered=False, queue_splits=4, credits=None,
-                 rpc_timeout_s=20.0):
+                 rpc_timeout_s=20.0, trace_recorder=None):
         import zmq
 
         self._zmq = zmq
         self._dispatcher_addr = dispatcher_addr
         self._context = zmq.Context()
         self._rpc_timeout_s = rpc_timeout_s
+        #: optional ``benchmark.TraceRecorder``: worker spans riding the
+        #: ``end`` headers merge into it after clock-offset alignment —
+        #: one Perfetto timeline across client + every decode worker.
+        self._trace = trace_recorder
+        #: (client_clock - dispatcher_clock), refreshed from the 1 Hz
+        #: ``workers`` discovery poll's send/recv midpoint.
+        self._clock_offset = None
+        self._worker_offsets = {}   # data addr -> (worker - dispatcher)
+        self._labeled_pids = set()
         try:
             self._init(consumer, resume or {}, ordered, queue_splits,
                        credits)
@@ -130,7 +141,16 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
         """Next complete, not-yet-delivered split: ``(split_id, chunks)``;
         None at end of stream.  A receive-loop failure raises here — a
         dead receiver must not masquerade as a clean (rows-missing) end
-        of stream."""
+        of stream.  With a trace recorder attached the wait is recorded
+        as a ``service/split_wait`` span — the 'no split was ready'
+        component of a data stall (lease starvation, slow workers)."""
+        t_wait = time.monotonic()
+        item = self._next_split()
+        if self._trace is not None:
+            self._trace.event('service/split_wait', t_wait, time.monotonic())
+        return item
+
+    def _next_split(self):
         while True:
             if self._ended.is_set() and self._ready.empty():
                 if self._error is not None:
@@ -180,15 +200,27 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
         held = {}               # ordered mode: completed, awaiting turn
         order = [sid for sid in self._my_splits if sid not in received]
         next_refresh = 0.0
+        addr_of = {}            # DEALER -> worker data addr (span origin)
         try:
-            import time
             while remaining and not self._stop.is_set():
                 now = time.monotonic()
                 if now >= next_refresh:
                     next_refresh = now + 1.0
                     try:
+                        t_rpc0 = time.monotonic()
                         reply = rpc.call({'op': 'workers'})
+                        t_rpc1 = time.monotonic()
                         workers = reply['workers']
+                        if reply.get('t_mono') is not None:
+                            # The discovery poll doubles as the clock
+                            # handshake: (client - dispatcher) from the
+                            # send/recv midpoint (ISSUE 5).
+                            self._clock_offset = ((t_rpc0 + t_rpc1) / 2.0
+                                                  - float(reply['t_mono']))
+                        for worker in workers:
+                            if worker.get('clock_offset') is not None:
+                                self._worker_offsets[worker['addr']] = \
+                                    float(worker['clock_offset'])
                     except ServiceError:
                         workers, reply = [], {}
                     failed = set(reply.get('failed_splits') or ()) & remaining
@@ -218,6 +250,7 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                              'credits': self._credits,
                              'shm_probe': self._shm_probe}, protocol=4))
                         sockets[addr] = sock
+                        addr_of[sock] = addr
                         poller.register(sock, zmq.POLLIN)
                 for sock in dict(poller.poll(100)):
                     while True:
@@ -295,6 +328,8 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                             sock.send(pickle.dumps(
                                 {'type': 'ack', 'split': sid,
                                  'attempt': attempt}, protocol=4))
+                            self._merge_worker_spans(header,
+                                                     addr_of.get(sock))
                             chunks = [parts[i][1] if parts[i][0] == 'shm'
                                       else deserialize_chunk(*parts[i])
                                       for i in sorted(parts)]
@@ -329,6 +364,32 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
             # workers' segments are untouched.
             if self._shm_probe is not None:
                 shm_plane.sweep_orphans()
+
+    def _merge_worker_spans(self, header, addr):
+        """Land a split's worker spans on this process's timeline: shift
+        by the chained offsets (client-dispatcher from the discovery
+        poll, worker-dispatcher from the worker's registration handshake
+        — ``(C-D) - (W-D) = C-W``), label the worker's Perfetto track,
+        merge.  Missing offsets (worker pre-first-heartbeat) fall back to
+        0 — correct between same-host processes, where CLOCK_MONOTONIC is
+        shared."""
+        spans = header.get('spans')
+        if not spans or self._trace is None:
+            return
+        shift = 0.0
+        worker_offset = self._worker_offsets.get(addr)
+        if self._clock_offset is not None and worker_offset is not None:
+            shift = self._clock_offset - worker_offset
+        pid = spans[0].get('pid')
+        if pid is not None and pid not in self._labeled_pids:
+            self._labeled_pids.add(pid)
+            import os
+            if pid != os.getpid():
+                # In-process (thread) workers share our pid: labeling it
+                # would rename the CLIENT's own track.
+                self._trace.set_process_label(
+                    pid, 'service worker %s' % (addr or '?'))
+        merge_into_recorder(self._trace, spans, clock_offset_s=shift)
 
     def _put(self, item):
         while not self._stop.is_set():
@@ -457,7 +518,10 @@ class ServiceDataLoader(DataLoader):
         connection = _ServiceConnection(
             dispatcher_addr, consumer=consumer, resume=svc,
             ordered=ordered, queue_splits=queue_splits, credits=credits,
-            rpc_timeout_s=rpc_timeout_s)
+            rpc_timeout_s=rpc_timeout_s,
+            # The loader's recorder doubles as the merge target for the
+            # workers' spans: ONE timeline from rowgroup decode to H2D.
+            trace_recorder=kwargs.get('trace_recorder'))
         super(ServiceDataLoader, self).__init__(
             ServiceReader(connection), batch_size,
             resume_state=resume_state, **kwargs)

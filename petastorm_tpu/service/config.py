@@ -83,6 +83,13 @@ class ServiceConfig:
             at host-local paths — the plane is a same-host cache.
         cache_plane_ram_bytes / cache_plane_disk_bytes: per-tier byte
             caps (None = the plane's defaults: 128 MiB hot, 4 GiB disk).
+        telemetry_spans: ship each split's correlated stage spans
+            (decode / serialize / shm publish / cache fill) on its
+            ``end`` header so clients with a ``trace_recorder`` merge
+            them into one cross-process timeline (ISSUE 5).  Measured
+            overhead is <1% (a handful of small dicts per chunk); the
+            flag exists for byte-budgeted control planes, and turning it
+            off never affects the metrics registry or heartbeat stats.
     """
 
     dataset_url: str
@@ -102,6 +109,7 @@ class ServiceConfig:
     cache_plane_dir: str = None
     cache_plane_ram_bytes: int = None
     cache_plane_disk_bytes: int = None
+    telemetry_spans: bool = True
 
     def __post_init__(self):
         if self.num_consumers < 1:
@@ -154,5 +162,6 @@ class ServiceConfig:
             'cache_plane_dir': self.cache_plane_dir,
             'cache_plane_ram_bytes': self.cache_plane_ram_bytes,
             'cache_plane_disk_bytes': self.cache_plane_disk_bytes,
+            'telemetry_spans': bool(self.telemetry_spans),
             'fingerprint': self.fingerprint(num_splits),
         }

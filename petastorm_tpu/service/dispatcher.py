@@ -237,7 +237,16 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             }
         logger.info('registered worker %s at %s', worker_id,
                     request['data_addr'])
-        return {'worker_id': worker_id, 'job': self._job}
+        # t_mono: the registration doubles as the clock-offset handshake
+        # (ISSUE 5) — the worker records (its_clock - ours) against the
+        # send/recv midpoint and ships the offset on every heartbeat.
+        return {'worker_id': worker_id, 'job': self._job,
+                't_mono': time.monotonic()}
+
+    def _op_clock(self, request):
+        """Bare clock handshake for clients/tools that registered nothing
+        (``telemetry.measure_clock_offset`` against this endpoint)."""
+        return {'t_mono': time.monotonic()}
 
     def _op_heartbeat(self, request):
         worker_id = request['worker_id']
@@ -347,13 +356,23 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         with self._lock:
             workers = [
                 {'worker_id': wid, 'addr': w['addr'],
-                 'alive': (now - w['last_heartbeat']) < stale}
+                 'alive': (now - w['last_heartbeat']) < stale,
+                 # (worker_clock - dispatcher_clock), from the worker's
+                 # registration handshake via its heartbeats: clients
+                 # chain it with their own dispatcher offset to align
+                 # that worker's spans onto their timeline.
+                 'clock_offset': w['stats'].get('clock_offset'),
+                 'pid': w['stats'].get('pid')}
                 for wid, w in sorted(self._workers.items())]
             # Terminally-failed splits ride on the discovery poll so a
             # waiting client can raise instead of hanging forever.
             failed = sorted(s.split_id for s in self._splits
                             if s.state == _FAILED)
-        return {'workers': workers, 'failed_splits': failed}
+        # t_mono rides the discovery poll the client already makes every
+        # second: its send/recv midpoint IS the client<->dispatcher clock
+        # handshake — no extra RPC on the refresh path.
+        return {'workers': workers, 'failed_splits': failed,
+                't_mono': time.monotonic()}
 
     def _op_stats(self, request):
         with self._lock:
@@ -369,6 +388,30 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                  for key in ('cache_hits', 'cache_misses',
                              'cache_evictions', 'cache_ram_hits',
                              'cache_degraded')}
+        # shm result-plane rollup (ISSUE 5 satellite): the per-worker
+        # counters rode the heartbeats all along but never summed — a
+        # worker silently degraded to the byte path (arena full, /dev/shm
+        # unusable) was invisible without reading every worker's row.
+        shm = {key: sum(int(w.get(key, 0)) for w in workers.values())
+               for key in ('shm_chunks', 'shm_degraded')}
+        # True fleet-wide stage latencies: the heartbeat registry
+        # snapshots merge by histogram-bucket addition (the reason the
+        # buckets are fixed log2), then each stage reports p50/p99.
+        from petastorm_tpu.telemetry import hist_quantile, merge_snapshots
+        from petastorm_tpu.telemetry.registry import ms
+        merged = merge_snapshots([w.get('registry') for w in
+                                  workers.values()])
+        stages = {}
+        for name, hist in merged['histograms'].items():
+            stages[name] = {'count': hist['count'],
+                            'p50_ms': ms(hist_quantile(hist, 0.5)),
+                            'p99_ms': ms(hist_quantile(hist, 0.99))}
+        # The raw per-worker snapshots (44-int bucket arrays per
+        # histogram) served their purpose in `stages`; shipping them per
+        # worker per poll would grow the reply linearly with fleet size
+        # for data neither `top` nor the status CLI reads.
+        workers = {wid: {k: v for k, v in row.items() if k != 'registry'}
+                   for wid, row in workers.items()}
         return {
             'num_splits': len(self._splits),
             'pending': states[_PENDING],
@@ -377,6 +420,8 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             'failed': states[_FAILED],
             'lease_churn': self.lease_churn,
             'cache': cache,
+            'shm': shm,
+            'stages': stages,
             'workers': workers,
         }
 

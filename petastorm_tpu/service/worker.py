@@ -37,6 +37,7 @@ dedupe turns into exactly-once delivery.
 """
 
 import logging
+import os
 import pickle
 import queue
 import threading
@@ -46,8 +47,14 @@ import traceback
 import numpy as np
 
 from petastorm_tpu.errors import ServiceError, ServiceRpcTimeoutError
+from petastorm_tpu.telemetry import MetricsRegistry
 
 logger = logging.getLogger(__name__)
+
+#: Per-split span-list bound shipped on the ``end`` header: enough for
+#: every chunk of a sane split (serialize + shm publish + cache fills),
+#: small enough that a pathological split can't bloat the control frames.
+_MAX_SPANS_PER_SPLIT = 2048
 
 _DEFAULT_RPC_TIMEOUT_S = 20.0
 
@@ -159,13 +166,27 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         self._stop = threading.Event()
         self._thread = None
         self._reader_factory = None
-        self._rows_decoded = 0
-        self._splits_decoded = 0
         self._t_start = None
         self._decode_out = None
         self.worker_id = None
         self.data_addr = None
         self._ready = threading.Event()
+        #: Source of truth for the worker's counters (ISSUE 5):
+        #: ``diagnostics`` is a view, and the full snapshot (including
+        #: the stage latency histograms) rides every heartbeat so the
+        #: dispatcher's ``stats`` RPC can roll the fleet up by addition.
+        self.metrics = MetricsRegistry('service_worker')
+        self._m_rows = self.metrics.counter('rows_decoded')
+        self._m_splits = self.metrics.counter('splits_decoded')
+        self._m_shm_chunks = self.metrics.counter('shm_chunks')
+        self._m_decode_hist = self.metrics.histogram('decode_split')
+        self._m_serialize_hist = self.metrics.histogram('serialize')
+        self._m_shm_pub_hist = self.metrics.histogram('shm_publish')
+        #: (this_worker_monotonic - dispatcher_monotonic), measured at
+        #: registration (reply midpoint handshake) and shipped on every
+        #: heartbeat: the client chains it with ITS dispatcher offset to
+        #: land this worker's spans on its own timeline.
+        self.clock_offset = None
         #: shm result plane (None when the job or host disables it);
         #: written only by the decode thread, stopped after it joins.
         self._arena = None
@@ -173,13 +194,13 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         #: (read by the decode thread, written by the event loop — a plain
         #: dict is safe under the GIL for this flag traffic).
         self._shm_consumers = {}
-        self._shm_chunks = 0
         #: epoch-cache plane counters accumulated across per-split
-        #: readers (job['cache_plane']); shipped in every heartbeat so
-        #: the dispatcher's ``stats`` RPC can aggregate fleet-wide.
-        self._cache_stats = {'cache_hits': 0, 'cache_misses': 0,
-                             'cache_evictions': 0, 'cache_ram_hits': 0,
-                             'cache_degraded': 0}
+        #: readers (job['cache_plane']) into the registry; shipped in
+        #: every heartbeat (see ``diagnostics``).
+        self._m_cache = {key: self.metrics.counter(key)
+                         for key in ('cache_hits', 'cache_misses',
+                                     'cache_evictions', 'cache_ram_hits',
+                                     'cache_degraded')}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -235,16 +256,25 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         self._decode_out = decode_out
         decode_thread = None
         try:
+            t_reg0 = time.monotonic()
             reply = rpc.call({'op': 'register_worker',
                               'data_addr': self.data_addr})
+            t_reg1 = time.monotonic()
             self.worker_id = reply['worker_id']
             job = reply['job']
+            if reply.get('t_mono') is not None:
+                # Clock handshake (ISSUE 5): dispatcher monotonic against
+                # the local send/recv midpoint — wrong by at most rtt/2,
+                # which orders spans fine on any LAN.
+                self.clock_offset = round(
+                    (t_reg0 + t_reg1) / 2.0 - float(reply['t_mono']), 6)
             from petastorm_tpu.workers_pool import shm_plane
             if job.get('shm', True) and shm_plane.available():
                 self._arena = shm_plane.ShmArena(
                     capacity_bytes=job.get(
                         'shm_capacity_bytes',
-                        shm_plane.DEFAULT_CAPACITY_BYTES))
+                        shm_plane.DEFAULT_CAPACITY_BYTES),
+                    metrics=self.metrics)
             self._t_start = time.monotonic()
             self._ready.set()
             decode_thread = threading.Thread(
@@ -391,11 +421,16 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     sendq.setdefault(consumer, deque()).append(
                         (header, payload))
                 elif kind == 'end':
-                    _, _, nchunks, nrows = item
+                    _, _, nchunks, nrows, chunk_spans = item
                     decoding.discard(split['split_id'])
                     header = {'type': 'end', 'split': split['split_id'],
                               'attempt': split['attempt'],
-                              'chunks': nchunks, 'rows': nrows}
+                              'chunks': nchunks, 'rows': nrows,
+                              # Correlated spans of this split's decode
+                              # (ISSUE 5): the client aligns them onto its
+                              # clock via the chained dispatcher offsets
+                              # and merges them into its TraceRecorder.
+                              'spans': chunk_spans}
                     sendq.setdefault(consumer, deque()).append((header, None))
                     key = (split['split_id'], split['attempt'])
                     awaiting_ack[key] = split
@@ -442,7 +477,7 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             if now - last_heartbeat >= heartbeat_every:
                 try:
                     rpc.call({'op': 'heartbeat', 'worker_id': self.worker_id,
-                              'stats': self.diagnostics,
+                              'stats': self.heartbeat_stats(),
                               'held': list(inflight)})
                 except ServiceRpcTimeoutError:
                     logger.warning('heartbeat to %s timed out',
@@ -507,19 +542,33 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         except MetadataError:
             return make_batch_reader
 
-    def _serialize_split_chunk(self, split, chunk):
+    def _serialize_split_chunk(self, split, chunk, cid, spans):
         """(tag, payload) for one chunk: shm descriptors (tag ``b'S'``)
         for consumers that proved same-host residence, degrading per-chunk
         to the byte framing (arena full, chunk under the segment-worthy
-        floor, or a cross-host consumer)."""
+        floor, or a cross-host consumer).  Each chunk's serialize/publish
+        time feeds the stage histograms and, correlation-id'd by
+        ``split/seq``, the span list riding the split's ``end`` header."""
+        t0 = time.monotonic()
         if self._arena is not None \
                 and self._shm_consumers.get(split['consumer']):
             from petastorm_tpu.workers_pool import shm_plane
             desc = shm_plane.write_columns(self._arena, chunk)
             if desc is not None:
-                self._shm_chunks += 1
+                t1 = time.monotonic()
+                self._m_shm_chunks.inc()
+                self._m_shm_pub_hist.observe(t1 - t0)
+                spans.append({'name': 'service/shm_publish', 't0': t0,
+                              't1': t1, 'pid': os.getpid(),
+                              'tid': threading.get_ident(), 'cid': cid})
                 return b'S', pickle.dumps(desc, protocol=4)
-        return serialize_chunk(chunk)
+        tag, payload = serialize_chunk(chunk)
+        t1 = time.monotonic()
+        self._m_serialize_hist.observe(t1 - t0)
+        spans.append({'name': 'service/serialize', 't0': t0, 't1': t1,
+                      'pid': os.getpid(), 'tid': threading.get_ident(),
+                      'cid': cid})
+        return tag, payload
 
     def _reader_kwargs(self, job):
         """Per-split reader kwargs; with ``job['cache_plane']`` the reader
@@ -540,17 +589,31 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         return kwargs
 
     def _accumulate_cache_stats(self, reader):
-        stats = getattr(getattr(reader, '_cache', None), 'stats', None)
-        if stats:
-            for key in self._cache_stats:
-                self._cache_stats[key] += int(stats.get(key, 0))
+        """Fold one (per-split, hence fresh) plane instance's counters
+        and its ``cache_fill`` latency histogram into the worker
+        registry, so fill time reaches the fleet ``stages`` rollup like
+        every other stage.  Counters are accumulated explicitly (their
+        names collide with the heartbeat keys) — merge ONLY the
+        histograms from the plane snapshot."""
+        cache = getattr(reader, '_cache', None)
+        stats = getattr(cache, 'stats', None)
+        if not stats:
+            return
+        for key, counter in self._m_cache.items():
+            counter.inc(int(stats.get(key, 0)))
+        plane_metrics = getattr(cache, 'metrics', None)
+        if plane_metrics is not None:
+            self.metrics.merge(
+                {'histograms': plane_metrics.snapshot()['histograms']})
 
     def _decode_loop(self, job, decode_in, decode_out):
+        ship_spans = bool(job.get('telemetry_spans', True))
         while True:
             split = decode_in.get()
             if split is None:
                 return
             t0 = time.monotonic()
+            spans = []
             try:
                 if self._reader_factory is None:
                     self._reader_factory = self._resolve_factory(job)
@@ -564,18 +627,37 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     for item in reader:
                         chunk = (item._asdict() if hasattr(item, '_asdict')
                                  else dict(item))
-                        tag, payload = self._serialize_split_chunk(split,
-                                                                   chunk)
+                        cid = '%d/%d' % (split['split_id'], seq)
+                        tag, payload = self._serialize_split_chunk(
+                            split, chunk, cid, spans)
                         rows += len(next(iter(chunk.values())))
                         decode_out.put(('chunk', split, seq, tag, payload))
                         seq += 1
-                decode_out.put(('end', split, seq, rows))
+                t1 = time.monotonic()
+                self._m_decode_hist.observe(t1 - t0)
+                spans.append({'name': 'service/decode_split', 't0': t0,
+                              't1': t1, 'pid': os.getpid(),
+                              'tid': threading.get_ident(),
+                              'cid': str(split['split_id']),
+                              'args': {'rows': rows}})
+                # Cache-plane fills land in the PLANE's own span buffer,
+                # and the plane instance is per-split — draining it here
+                # claims exactly this split's fills, even with several
+                # in-process workers sharing the process (the global
+                # singleton would race them).
+                plane_spans = getattr(
+                    getattr(reader, '_cache', None), 'spans', None)
+                if plane_spans is not None:
+                    spans.extend(plane_spans.drain())
+                if not ship_spans:
+                    spans = []
+                decode_out.put(('end', split, seq, rows,
+                                spans[-_MAX_SPANS_PER_SPLIT:]))
                 self._accumulate_cache_stats(reader)
-                self._rows_decoded += rows
-                self._splits_decoded += 1
+                self._m_rows.inc(rows)
+                self._m_splits.inc()
                 if self._trace is not None:
-                    self._trace.event('service/decode_split', t0,
-                                      time.monotonic(),
+                    self._trace.event('service/decode_split', t0, t1,
                                       split=split['split_id'], rows=rows)
             except Exception:  # noqa: BLE001 — shipped to the event loop
                 decode_out.put(('error', split, traceback.format_exc()))
@@ -584,27 +666,41 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
 
     @property
     def diagnostics(self):
-        """Per-worker metrics, also shipped to the dispatcher on every
-        heartbeat (``stats`` RPC surfaces them fleet-wide)."""
+        """Per-worker metrics — a view over ``self.metrics`` (ISSUE 5),
+        also shipped to the dispatcher on every heartbeat (``stats`` RPC
+        surfaces them fleet-wide)."""
         elapsed = (time.monotonic() - self._t_start) if self._t_start else 0.0
+        rows = int(self._m_rows.value)
         return {
-            'rows_decoded': int(self._rows_decoded),
-            'splits_decoded': int(self._splits_decoded),
-            'rows_per_s': round(self._rows_decoded / elapsed, 1)
-                          if elapsed > 0 else 0.0,
+            'rows_decoded': rows,
+            'splits_decoded': int(self._m_splits.value),
+            'rows_per_s': round(rows / elapsed, 1) if elapsed > 0 else 0.0,
             'queue_depth': (self._decode_out.qsize()
                             if self._decode_out is not None else 0),
-            'shm_chunks': int(self._shm_chunks),
-            'shm_degraded': (self._arena.degraded
-                             if self._arena is not None else 0),
+            # shm result-plane traffic INCLUDING the degrades: a worker
+            # silently on the byte path (arena full, /dev/shm gone) must
+            # be visible fleet-wide, not only in its own process.  The
+            # arena shares this registry, so its refusals land here.
+            'shm_chunks': int(self._m_shm_chunks.value),
+            'shm_degraded': int(self.metrics.counter('shm_degraded').value),
             # Epoch-cache plane traffic of this worker's split readers
             # (all zero unless the job enables cache_plane).
             # cache_degraded matters most fleet-wide: it is the only
             # signal that a plane is silently OFF (unwritable dir, full
             # tiers) while hits/misses still look plausible.
-            'cache_hits': int(self._cache_stats['cache_hits']),
-            'cache_misses': int(self._cache_stats['cache_misses']),
-            'cache_evictions': int(self._cache_stats['cache_evictions']),
-            'cache_ram_hits': int(self._cache_stats['cache_ram_hits']),
-            'cache_degraded': int(self._cache_stats['cache_degraded']),
+            'cache_hits': int(self._m_cache['cache_hits'].value),
+            'cache_misses': int(self._m_cache['cache_misses'].value),
+            'cache_evictions': int(self._m_cache['cache_evictions'].value),
+            'cache_ram_hits': int(self._m_cache['cache_ram_hits'].value),
+            'cache_degraded': int(self._m_cache['cache_degraded'].value),
         }
+
+    def heartbeat_stats(self):
+        """The heartbeat payload: ``diagnostics`` plus the telemetry
+        piggyback — the full registry snapshot (stage histograms merge
+        fleet-wide by addition in the dispatcher), the clock offset for
+        span alignment, and this process's pid for timeline labels."""
+        return dict(self.diagnostics,
+                    registry=self.metrics.snapshot(),
+                    clock_offset=self.clock_offset,
+                    pid=os.getpid())

@@ -1,0 +1,46 @@
+"""Cross-process telemetry plane (ISSUE 5).
+
+Three pillars, one package:
+
+* **Metrics registry** (``registry.py``) — process-local counters /
+  gauges / histograms with fixed log2 buckets, so merging registries
+  from other processes is pure addition.  The ad-hoc diagnostics dicts
+  (``Reader.diagnostics``, ``DataLoader.diagnostics``, pool
+  ``shm_results``, cache-plane hits/misses, dispatcher ``stats``) are
+  VIEWS over these registries; worker-side registries snapshot into the
+  existing return channels (ProcessPool acks, service heartbeats) and
+  merge in the parent.
+* **Correlated spans** (``spans.py``) — bounded per-process span
+  buffers keyed by correlation id (ventilator item position / service
+  ``split/seq``), shipped over the existing ZMQ frames and merged into
+  ONE ``benchmark.TraceRecorder`` timeline with per-process
+  ``time.monotonic()`` clock-offset alignment.
+* **Live introspection** (``top.py``) — the ``petastorm-tpu-top``
+  console script polling the dispatcher ``stats`` RPC, plus
+  ``MetricsRegistry.render_prometheus()`` for any scraper.
+
+See ``docs/observability.md`` for the registry model, the span
+catalogue, and scrape examples.
+"""
+
+from petastorm_tpu.telemetry.registry import (  # noqa: F401
+    MetricsRegistry, hist_quantile, merge_snapshots, snapshot_all)
+from petastorm_tpu.telemetry.spans import (  # noqa: F401
+    SpanBuffer, attribute_stalls, current_buffer, measure_clock_offset,
+    merge_into_recorder)
+
+__all__ = ['MetricsRegistry', 'merge_snapshots', 'hist_quantile',
+           'snapshot_all', 'SpanBuffer', 'current_buffer',
+           'merge_into_recorder', 'measure_clock_offset',
+           'attribute_stalls', 'dump_state']
+
+
+def dump_state():
+    """One JSON-able dict of every live registry snapshot and every live
+    ``TraceRecorder``'s events in this process — the crash-artifact dump
+    the test-suite watchdog writes (``tests/conftest.py``), so the next
+    silent-death bug ships with a timeline attached."""
+    from petastorm_tpu.benchmark.trace import all_recorder_events
+    return {'registries': snapshot_all(),
+            'trace_events': all_recorder_events(),
+            'span_residue': current_buffer().peek()}

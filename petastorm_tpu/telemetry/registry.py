@@ -1,0 +1,280 @@
+"""Unified metrics registry — the source of truth the diagnostics dicts view.
+
+Design constraints, in order:
+
+* **Merging is addition.**  Histograms use FIXED log2 buckets (bucket
+  ``i`` counts observations in ``[2**i, 2**(i+1))`` microseconds), so a
+  fleet rollup — dispatcher summing worker heartbeats, a ProcessPool
+  parent summing child acks — is elementwise addition with no rebinning
+  and no per-process bucket negotiation.
+* **Snapshots are plain dicts.**  They ride the channels the data plane
+  already has (pickled ProcessPool acks, service heartbeat stats) and
+  survive ``json.dumps`` for the status CLI, so no process ever pickles
+  a registry object across a boundary — only its snapshot.
+* **Cheap enough to leave on.**  Instruments are created once and held;
+  the hot path is one lock + one int add.  Instrumented code observes
+  per *batch/item/split*, never per row.
+
+A registry is process-local state; pickling one (e.g. riding inside a
+``PlaneCache`` crossing the ProcessPool boundary) transfers the counts
+and rebuilds the lock in the child — from there the two copies diverge,
+exactly like the plane counters they replaced, and the parent-side merge
+channels are how the halves reunite.
+"""
+
+import bisect
+import math
+import threading
+import weakref
+
+__all__ = ['MetricsRegistry', 'Counter', 'Gauge', 'Histogram',
+           'merge_snapshots', 'hist_quantile', 'snapshot_all', 'ms']
+
+
+def ms(seconds):
+    """None-propagating seconds → milliseconds (3 dp): the ONE rounding
+    every diagnostics view applies to histogram quantiles."""
+    return None if seconds is None else round(seconds * 1e3, 3)
+
+#: log2 buckets over microseconds: 1 µs .. ~2.4 hours (2**43 µs); index 0
+#: absorbs sub-µs observations, the last bucket absorbs the tail.
+BUCKETS = 44
+
+#: Every live registry, so a crash dump (`telemetry.dump_state`) can
+#: report the whole process without the subsystems registering anywhere.
+_LIVE = weakref.WeakSet()
+
+
+class Counter(object):
+    """Monotonic accumulator (int or float)."""
+
+    __slots__ = ('_lock', 'value')
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+
+
+class Gauge(object):
+    """Last-write-wins sample (queue depth, offset, ...)."""
+
+    __slots__ = ('_lock', 'value')
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.value = 0
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+
+
+class Histogram(object):
+    """Fixed log2-bucket latency histogram; merge = bucket addition."""
+
+    __slots__ = ('_lock', 'counts', 'sum', 'count')
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.counts = [0] * BUCKETS
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, seconds):
+        us = seconds * 1e6
+        index = 0 if us < 1.0 else min(BUCKETS - 1, int(math.log2(us)))
+        with self._lock:
+            self.counts[index] += 1
+            self.sum += seconds
+            self.count += 1
+
+    def quantile(self, q):
+        """Bucket-upper-bound estimate of quantile ``q`` in SECONDS (None
+        when empty) — the resolution is the log2 bucket, which is what a
+        'which stage, which worker' question needs."""
+        return hist_quantile({'counts': self.counts, 'count': self.count}, q)
+
+
+class MetricsRegistry(object):
+    """Named instruments under one namespace + one lock.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create and return the
+    SAME instrument for the same name, so subsystems can share a registry
+    without coordinating construction order.
+    """
+
+    def __init__(self, namespace=''):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+        _LIVE.add(self)
+
+    # Registries cross the ProcessPool boundary inside PlaneCache-holding
+    # readers: ship the counts, rebuild the lock (process-local) in the
+    # child — the copies then diverge and reunite through the snapshot
+    # merge channels, like every other per-process counter.
+    def __getstate__(self):
+        return {'namespace': self.namespace, 'snapshot': self.snapshot()}
+
+    def __setstate__(self, state):
+        self.__init__(state['namespace'])
+        self.merge(state['snapshot'])
+
+    def _get(self, table, name, factory):
+        with self._lock:
+            instrument = table.get(name)
+            if instrument is None:
+                instrument = table[name] = factory(self._lock)
+            return instrument
+
+    def counter(self, name):
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name):
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name):
+        return self._get(self._histograms, name, Histogram)
+
+    # -- snapshot / merge ----------------------------------------------------
+
+    def snapshot(self):
+        """Plain-dict copy of every instrument — picklable, JSON-able,
+        and addition-mergeable (`merge_snapshots`)."""
+        with self._lock:
+            return {
+                'namespace': self.namespace,
+                'counters': {k: c.value for k, c in self._counters.items()},
+                'gauges': {k: g.value for k, g in self._gauges.items()},
+                'histograms': {
+                    k: {'counts': list(h.counts), 'sum': h.sum,
+                        'count': h.count}
+                    for k, h in self._histograms.items()},
+            }
+
+    def merge(self, snapshot):
+        """Add a snapshot's counts into this registry (counters and
+        histogram buckets add; gauges last-write-win)."""
+        if not snapshot:
+            return
+        for name, value in (snapshot.get('counters') or {}).items():
+            self.counter(name).inc(value)
+        for name, value in (snapshot.get('gauges') or {}).items():
+            self.gauge(name).set(value)
+        for name, hist in (snapshot.get('histograms') or {}).items():
+            mine = self.histogram(name)
+            with self._lock:
+                for i, n in enumerate(hist.get('counts', ())):
+                    if i < BUCKETS:
+                        mine.counts[i] += n
+                mine.sum += hist.get('sum', 0.0)
+                mine.count += hist.get('count', 0)
+
+    # -- views ---------------------------------------------------------------
+
+    def as_dict(self):
+        """Flat ``name -> value`` view (counters + gauges), plus
+        ``<hist>_p50_ms`` / ``<hist>_p99_ms`` / ``<hist>_count`` per
+        histogram — the shape the diagnostics dicts are built from."""
+        snap = self.snapshot()
+        out = dict(snap['counters'])
+        out.update(snap['gauges'])
+        for name, hist in snap['histograms'].items():
+            out[name + '_count'] = hist['count']
+            for label, q in (('p50', 0.5), ('p99', 0.99)):
+                out['%s_%s_ms' % (name, label)] = ms(hist_quantile(hist, q))
+        return out
+
+    def render_prometheus(self):
+        """Text exposition format (one scrape target per process); the
+        namespace becomes the metric prefix."""
+        snap = self.snapshot()
+        prefix = 'petastorm_tpu_'
+        if snap['namespace']:
+            prefix += _sanitize(snap['namespace']) + '_'
+        lines = []
+        for name, value in sorted(snap['counters'].items()):
+            metric = prefix + _sanitize(name)
+            lines += ['# TYPE %s counter' % metric,
+                      '%s %s' % (metric, _fmt(value))]
+        for name, value in sorted(snap['gauges'].items()):
+            metric = prefix + _sanitize(name)
+            lines += ['# TYPE %s gauge' % metric,
+                      '%s %s' % (metric, _fmt(value))]
+        for name, hist in sorted(snap['histograms'].items()):
+            metric = prefix + _sanitize(name) + '_seconds'
+            lines.append('# TYPE %s histogram' % metric)
+            cumulative = 0
+            for i, n in enumerate(hist['counts']):
+                cumulative += n
+                if n:
+                    lines.append('%s_bucket{le="%g"} %d'
+                                 % (metric, (2.0 ** (i + 1)) / 1e6,
+                                    cumulative))
+            lines.append('%s_bucket{le="+Inf"} %d' % (metric, hist['count']))
+            lines.append('%s_sum %s' % (metric, _fmt(hist['sum'])))
+            lines.append('%s_count %d' % (metric, hist['count']))
+        return '\n'.join(lines) + '\n'
+
+
+def _sanitize(name):
+    return ''.join(c if (c.isalnum() or c == '_') else '_' for c in name)
+
+
+def _fmt(value):
+    if isinstance(value, float):
+        return repr(round(value, 6))
+    return str(value)
+
+
+def merge_snapshots(snapshots):
+    """Pure fleet rollup: sum counters and histogram buckets across
+    snapshots (gauges: last wins).  Stateless on purpose — the dispatcher
+    re-merges the CURRENT heartbeat snapshots on every ``stats`` call, so
+    nothing double-counts across calls."""
+    merged = {'namespace': 'fleet', 'counters': {}, 'gauges': {},
+              'histograms': {}}
+    for snap in snapshots:
+        if not snap:
+            continue
+        for name, value in (snap.get('counters') or {}).items():
+            merged['counters'][name] = merged['counters'].get(name, 0) + value
+        for name, value in (snap.get('gauges') or {}).items():
+            merged['gauges'][name] = value
+        for name, hist in (snap.get('histograms') or {}).items():
+            mine = merged['histograms'].setdefault(
+                name, {'counts': [0] * BUCKETS, 'sum': 0.0, 'count': 0})
+            for i, n in enumerate(hist.get('counts', ())):
+                if i < BUCKETS:
+                    mine['counts'][i] += n
+            mine['sum'] += hist.get('sum', 0.0)
+            mine['count'] += hist.get('count', 0)
+    return merged
+
+
+def hist_quantile(hist, q):
+    """Quantile (seconds) of a histogram SNAPSHOT dict; None when empty.
+    Returns the matched bucket's upper bound — a deliberate over-estimate
+    that can never hide a slow stage under its bucket floor."""
+    count = hist.get('count', 0)
+    if not count:
+        return None
+    rank = max(1, int(math.ceil(q * count)))
+    cumulative = []
+    total = 0
+    for n in hist['counts']:
+        total += n
+        cumulative.append(total)
+    index = bisect.bisect_left(cumulative, rank)
+    return (2.0 ** (index + 1)) / 1e6
+
+
+def snapshot_all():
+    """Snapshots of every live registry in this process (crash dumps)."""
+    return [r.snapshot() for r in list(_LIVE)]

@@ -1,0 +1,135 @@
+"""``petastorm-tpu-top`` — live fleet introspection for the data service.
+
+Polls the dispatcher's ``stats`` RPC and renders, per refresh: split
+progress (pending/leased/done/failed + lease churn), the fleet cache and
+shm rollups (hit and degrade rates), fleet-merged stage latencies
+(p50/p99 per stage, from the workers' heartbeat registry snapshots), and
+one row per worker (rows/s, queue depth, shm/cache traffic, heartbeat
+age).  The same numbers any scraper can lift via
+``MetricsRegistry.render_prometheus()`` — this is the zero-setup
+terminal view::
+
+    petastorm-tpu-top --dispatcher tcp://dispatch:7777           # live
+    petastorm-tpu-top --dispatcher tcp://dispatch:7777 --once --json
+
+``--once`` prints a single snapshot and exits (scriptable); ``--json``
+emits the raw stats reply instead of the table.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+__all__ = ['render_stats', 'main']
+
+
+def _rate(hits, misses):
+    total = hits + misses
+    return '%5.1f%%' % (100.0 * hits / total) if total else '    -'
+
+
+def render_stats(stats, elapsed_s=None):
+    """One text frame from a dispatcher ``stats`` reply."""
+    lines = []
+    lines.append(
+        'splits  pending %-5d leased %-5d done %-5d failed %-5d '
+        'lease_churn %d'
+        % (stats.get('pending', 0), stats.get('leased', 0),
+           stats.get('done', 0), stats.get('failed', 0),
+           stats.get('lease_churn', 0)))
+    cache = stats.get('cache') or {}
+    shm = stats.get('shm') or {}
+    lines.append(
+        'cache   hit %s  ram_hit %-7d degraded %-7d evictions %d'
+        % (_rate(cache.get('cache_hits', 0), cache.get('cache_misses', 0)),
+           cache.get('cache_ram_hits', 0), cache.get('cache_degraded', 0),
+           cache.get('cache_evictions', 0)))
+    # shm_degraded counts ARENA refusals only (arena full / no /dev/shm);
+    # byte-path chunks for size or cross-host locality reasons increment
+    # neither counter, so no "% zero-copy" claim is honest here.
+    lines.append(
+        'shm     chunks %-7d arena_refusals %d'
+        % (shm.get('shm_chunks', 0), shm.get('shm_degraded', 0)))
+    stages = stats.get('stages') or {}
+    if stages:
+        lines.append('stage latencies (fleet-merged log2 histograms):')
+        lines.append('  %-14s %10s %10s %10s' % ('stage', 'count',
+                                                 'p50_ms', 'p99_ms'))
+        for name in sorted(stages):
+            stage = stages[name]
+            lines.append('  %-14s %10d %10s %10s'
+                         % (name, stage.get('count', 0),
+                            stage.get('p50_ms'), stage.get('p99_ms')))
+    workers = stats.get('workers') or {}
+    lines.append('workers (%d):' % len(workers))
+    lines.append('  %-6s %9s %8s %6s %9s %9s %8s %7s'
+                 % ('id', 'rows/s', 'rows', 'queue', 'shm_chunk',
+                    'shm_degr', 'cache_hit', 'age_s'))
+    for wid in sorted(workers):
+        w = workers[wid]
+        lines.append('  %-6s %9s %8s %6s %9s %9s %8s %7s'
+                     % (wid, w.get('rows_per_s', '-'),
+                        w.get('rows_decoded', '-'),
+                        w.get('queue_depth', '-'),
+                        w.get('shm_chunks', '-'),
+                        w.get('shm_degraded', '-'),
+                        w.get('cache_hits', '-'),
+                        w.get('age_s', '-')))
+    if elapsed_s is not None:
+        lines.append('(stats rpc took %.0f ms)' % (1e3 * elapsed_s))
+    return '\n'.join(lines)
+
+
+def _poll(addr, timeout_s):
+    import zmq
+
+    from petastorm_tpu.service.worker import _Rpc
+    context = zmq.Context()
+    rpc = _Rpc(context, addr, timeout_s=timeout_s)
+    try:
+        t0 = time.monotonic()
+        stats = rpc.call({'op': 'stats'})
+        return stats, time.monotonic() - t0
+    finally:
+        rpc.close()
+        context.term()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-top', description=__doc__.split('\n\n')[0])
+    parser.add_argument('--dispatcher', required=True,
+                        help='dispatcher endpoint (tcp://host:port)')
+    parser.add_argument('--interval', type=float, default=2.0,
+                        help='refresh period in seconds (live mode)')
+    parser.add_argument('--once', action='store_true',
+                        help='print one snapshot and exit')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the raw stats reply as JSON')
+    parser.add_argument('--rpc-timeout', type=float, default=10.0)
+    args = parser.parse_args(argv)
+
+    while True:
+        try:
+            stats, elapsed = _poll(args.dispatcher, args.rpc_timeout)
+        except Exception as e:  # noqa: BLE001 — report, exit nonzero
+            print('cannot reach dispatcher at %s: %s'
+                  % (args.dispatcher, e), file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(stats, sort_keys=True, default=str))
+        else:
+            if not args.once:
+                sys.stdout.write('\x1b[2J\x1b[H')  # clear, home
+            print('petastorm-tpu-top  %s  %s' % (args.dispatcher,
+                                                 time.strftime('%H:%M:%S')))
+            print(render_stats(stats, elapsed))
+        if args.once:
+            return 0
+        sys.stdout.flush()
+        time.sleep(max(0.2, args.interval))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

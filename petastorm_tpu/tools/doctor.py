@@ -27,6 +27,11 @@ Sections (each contained — a dead plane is reported, not fatal):
   directories writable (``--cache-plane-dir``), ``/dev/shm`` headroom
   for the hot tier and the shm result plane, and a crash-residue sweep
   report (orphaned result-plane slabs, dead writers' tmp files).
+* **telemetry** — the cross-process observability plane (ISSUE 5):
+  registry round-trip + Prometheus rendering, a real 2-process
+  ``time.monotonic()`` clock-offset handshake (span alignment sanity),
+  and a span-buffer residue report (spans recorded but not drained by
+  an ack/heartbeat channel).
 """
 
 import argparse
@@ -201,6 +206,56 @@ def _check_cache_plane(plane_dir):
     return out
 
 
+def _check_telemetry():
+    """Environment of the telemetry plane (``petastorm_tpu/telemetry``):
+    does a registry round-trip and render, is the cross-process clock
+    offset sane (same-host processes share CLOCK_MONOTONIC on Linux, so
+    anything past the handshake rtt means span alignment is broken on
+    this host), and how many spans sit undrained in the process buffer
+    (residue means a subsystem records spans no channel ships)."""
+    import subprocess
+
+    from petastorm_tpu import telemetry
+
+    out = {}
+    registry = telemetry.MetricsRegistry('doctor')
+    registry.counter('probe').inc()
+    registry.histogram('probe_hist').observe(0.002)
+    snapshot = telemetry.merge_snapshots([registry.snapshot()])
+    rendered = registry.render_prometheus()
+    out['registry_ok'] = bool(
+        snapshot['counters'].get('probe') == 1
+        and 'petastorm_tpu_doctor_probe 1' in rendered
+        and 'probe_hist_seconds_bucket' in rendered)
+
+    def child_clock():
+        probe = subprocess.run(
+            [sys.executable, '-c', 'import time; print(time.monotonic())'],
+            capture_output=True, text=True, timeout=60)
+        return float(probe.stdout.strip())
+
+    offset, rtt = telemetry.measure_clock_offset(child_clock)
+    out['clock_offset_s'] = round(offset, 4)
+    out['clock_handshake_rtt_s'] = round(rtt, 4)
+    # The child reads its clock at the END of its interpreter startup, so
+    # the midpoint estimate is biased by up to rtt/2 — the gate allows
+    # that plus scheduling slack.  Anything bigger means monotonic is NOT
+    # shared the way span alignment assumes on this host.
+    out['clock_offset_ok'] = bool(abs(offset) <= max(1.0, rtt))
+    # peek, never drain: run_doctor() is importable from a LIVE process,
+    # and consuming its pending spans would steal them from the real
+    # drain channel.  The buffer is bounded, so reporting is enough.
+    residue = telemetry.current_buffer().peek()
+    out['span_residue'] = len(residue)
+    if residue:
+        out['span_residue_note'] = (
+            'spans recorded but not yet drained by any ack/heartbeat '
+            'channel (first: %r) — persistent growth means an '
+            'instrumented subsystem runs without its return channel'
+            % (residue[0].get('name'),))
+    return out
+
+
 def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
                batch_size=64, h2d_mb=32, cache_plane_dir=None):
     """Run every applicable section; returns the report dict."""
@@ -209,6 +264,7 @@ def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
     _contained(report, 'native', _check_native)
     _contained(report, 'cache_plane',
                lambda: _check_cache_plane(cache_plane_dir))
+    _contained(report, 'telemetry', _check_telemetry)
     if dataset_url:
         advisor = {}
         _contained(report, 'host_plane',

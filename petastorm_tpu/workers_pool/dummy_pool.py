@@ -8,6 +8,7 @@ ventilation order.
 import time
 from collections import deque
 
+from petastorm_tpu.telemetry import MetricsRegistry
 from petastorm_tpu.workers_pool import EmptyResultError, VentilatedItem
 
 
@@ -20,8 +21,11 @@ class DummyPool(object):
         self._worker = None
         self._ventilator = None
         self._stopped = False
-        self.items_processed = 0
-        self.busy_time = 0.0
+        #: Uniform registry surface across pool classes (ISSUE 5).
+        self.metrics = MetricsRegistry('dummy_pool')
+        self._m_items = self.metrics.counter('items_processed')
+        self._m_busy = self.metrics.counter('decode_busy_s')
+        self._m_decode = self.metrics.histogram('decode')
         self._started_at = None
         self._stopped_at = None
 
@@ -47,8 +51,10 @@ class DummyPool(object):
                 sleep_before = getattr(self._worker, 'retry_sleep_s', 0.0)
                 self._worker.process(*args, **kwargs)
                 slept = getattr(self._worker, 'retry_sleep_s', 0.0) - sleep_before
-                self.busy_time += max(0.0, time.monotonic() - started - slept)
-                self.items_processed += 1
+                elapsed = max(0.0, time.monotonic() - started - slept)
+                self._m_busy.inc(elapsed)
+                self._m_decode.observe(elapsed)
+                self._m_items.inc()
                 if self._ventilator is not None:
                     self._ventilator.processed_item(position)
             elif self._ventilator is not None and not self._ventilator.completed():
@@ -78,6 +84,14 @@ class DummyPool(object):
     def join(self):
         if not self._stopped:
             raise RuntimeError('join() called before stop()')
+
+    @property
+    def items_processed(self):
+        return self._m_items.value
+
+    @property
+    def busy_time(self):
+        return self._m_busy.value
 
     @property
     def diagnostics(self):

@@ -26,7 +26,11 @@ import pickle
 import shutil
 import tempfile
 import uuid
+from collections import deque
 
+from petastorm_tpu.telemetry import (MetricsRegistry, hist_quantile,
+                                     merge_into_recorder, merge_snapshots)
+from petastorm_tpu.telemetry.registry import ms as _ms
 from petastorm_tpu.workers_pool import (DEFAULT_TIMEOUT_S, EmptyResultError,
                                         TimeoutWaitingForResultError, VentilatedItem)
 from petastorm_tpu.workers_pool import shm_plane
@@ -45,8 +49,23 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
         #: works per-reader.
         self._use_shm = use_shm
         self._shm_capacity_bytes = shm_capacity_bytes
-        #: results that arrived as shm descriptors (vs serialized bytes)
-        self.shm_results = 0
+        #: Source of truth for the pool's counters (ISSUE 5);
+        #: ``diagnostics`` is a view.  Child registries snapshot into the
+        #: ``b'K'`` ack payloads and merge here, so child-only telemetry
+        #: (arena degrades, per-item decode histograms) is visible in the
+        #: parent without a second channel.
+        self.metrics = MetricsRegistry('process_pool')
+        self._m_items = self.metrics.counter('items_processed')
+        self._m_busy = self.metrics.counter('decode_busy_s')
+        self._m_shm_results = self.metrics.counter('shm_results')
+        #: worker_id -> latest child registry snapshot (full-state, so
+        #: replacing — never adding — is the double-count-free merge).
+        self._worker_snapshots = {}
+        #: optional TraceRecorder: child spans (pool/process, pool/publish,
+        #: cache/fill) merge straight into it (same-host CLOCK_MONOTONIC:
+        #: offset 0); without one they buffer in remote_spans, bounded.
+        self.trace_recorder = None
+        self.remote_spans = deque(maxlen=4096)
         self._context = None
         self._work_socket = None
         self._sink_socket = None
@@ -54,11 +73,6 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
         self._processes = []
         self._ventilator = None
         self._inflight = 0
-        self.items_processed = 0
-        #: Summed child-side seconds inside worker.process (net of retry
-        #: sleeps), shipped back on each ack — diagnostics parity with the
-        #: in-process pools.
-        self.busy_time = 0.0
         self._started_at = None
         self._stopped_at = None
         self._stopped = False
@@ -159,13 +173,25 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
                             e.errno, 'shm result slab vanished before the '
                             'parent read it — worker process died '
                             'mid-stream? (%s)' % e)
-                    self.shm_results += 1
+                    self._m_shm_results.inc()
                     return result
                 if tag == b'K':
-                    position, busy_s = pickle.loads(payload)
+                    ack = pickle.loads(payload)
+                    position, busy_s = ack[0], ack[1]
+                    if len(ack) >= 5:
+                        # Telemetry piggyback (ISSUE 5): the child's full
+                        # registry snapshot replaces its slot (full-state,
+                        # so re-sending never double-counts), and its span
+                        # buffer drains into the parent timeline.
+                        worker_id, snapshot, spans = ack[2], ack[3], ack[4]
+                        self._worker_snapshots[worker_id] = snapshot
+                        if self.trace_recorder is not None:
+                            merge_into_recorder(self.trace_recorder, spans)
+                        else:
+                            self.remote_spans.extend(spans)
                     self._inflight -= 1
-                    self.items_processed += 1
-                    self.busy_time += busy_s
+                    self._m_items.inc()
+                    self._m_busy.inc(busy_s)
                     if self._ventilator is not None:
                         self._ventilator.processed_item(position)
                     continue
@@ -231,11 +257,38 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
             shutil.rmtree(self._endpoint_dir, ignore_errors=True)
             self._endpoint_dir = None
 
+    # Registry views — the attribute surface older callers read.
+    @property
+    def items_processed(self):
+        return self._m_items.value
+
+    @property
+    def busy_time(self):
+        return self._m_busy.value
+
+    @property
+    def shm_results(self):
+        return self._m_shm_results.value
+
+    def drain_remote_spans(self):
+        """Child spans buffered while no ``trace_recorder`` was attached
+        (raw span dicts — feed ``telemetry.merge_into_recorder``)."""
+        out = list(self.remote_spans)
+        self.remote_spans.clear()
+        return out
+
+    def worker_telemetry(self):
+        """Fleet-merged child registry snapshot (one
+        ``telemetry.merge_snapshots`` over the latest per-child acks)."""
+        return merge_snapshots(list(self._worker_snapshots.values()))
+
     @property
     def diagnostics(self):
         import time
         end = self._stopped_at if self._stopped_at is not None else time.monotonic()
         wall = (end - self._started_at) if self._started_at else 0.0
+        children = self.worker_telemetry()
+        decode_hist = children['histograms'].get('decode', {})
         return {
             'pool': 'process',
             'workers_count': self.workers_count,
@@ -243,10 +296,18 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
             'inflight': self._inflight,
             'workers_alive': sum(p.poll() is None for p in self._processes),
             'shm_results': self.shm_results,
+            # Child-side arena refusals (arena full -> byte path), summed
+            # from the ack-channel registry snapshots: before ISSUE 5 a
+            # silently-degraded child was invisible from the parent.
+            'shm_degraded': children['counters'].get('shm_degraded', 0),
             'decode_busy_s': round(self.busy_time, 4),
             # Child-side decode fraction of total worker-process wall time —
             # same interpretation as the thread pool's number (low values
             # additionally include child startup, which threads don't pay).
             'decode_utilization': round(
                 self.busy_time / (wall * self.workers_count), 4) if wall else 0.0,
+            # Per-item decode latency, merged across children (log2
+            # histogram addition — the reason the buckets are fixed).
+            'decode_p50_ms': _ms(hist_quantile(decode_hist, 0.5)),
+            'decode_p99_ms': _ms(hist_quantile(decode_hist, 0.99)),
         }

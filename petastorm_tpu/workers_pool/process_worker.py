@@ -12,9 +12,16 @@ can refill.  Message framing (multipart):
                 buffers live in a /dev/shm segment; see
                 ``workers_pool/shm_plane.py``)
       tag b'T'  shm descriptor for an arrow-IPC-written pyarrow.Table
-      tag b'K'  ack: pickle((position or None, busy_seconds)) — busy is the
-                worker.process wall time net of retry-backoff sleeps, feeding
-                the parent pool's decode_utilization
+      tag b'K'  ack: pickle((position or None, busy_seconds, worker_id,
+                registry_snapshot, spans)) — busy is the worker.process
+                wall time net of retry-backoff sleeps, feeding the parent
+                pool's decode_utilization; the trailing telemetry fields
+                (ISSUE 5) are the child's full MetricsRegistry snapshot
+                (parent REPLACES its per-child slot, so re-sends never
+                double-count; the cache plane's histograms are folded
+                in) and the drained spans (pool/process, pool/publish
+                from the child buffer; cache/fill from the plane's own
+                buffer), correlation-id'd by the ventilator position
       tag b'E'  error: pickle((exception, traceback_str))
 
 The shm tags are best-effort per message: a small result, a full arena
@@ -32,12 +39,21 @@ def worker_main(setup_payload, worker_id):
     import pyarrow as pa
     import zmq
 
+    from petastorm_tpu import telemetry
     from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
     from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
     from petastorm_tpu.workers_pool import shm_plane
 
     worker_class, worker_args, work_addr, sink_addr, copy_buffers, \
         use_shm, shm_capacity, parent_pid = pickle.loads(setup_payload)
+
+    # Child-side telemetry (ISSUE 5): one registry + the process-local
+    # span buffer (shared with the cache plane's fill spans); both ride
+    # every b'K' ack back to the parent pool.
+    metrics = telemetry.MetricsRegistry('pool_worker')
+    decode_hist = metrics.histogram('decode')
+    spans = telemetry.current_buffer()
+    current_position = [None]
 
     context = zmq.Context()
     work_socket = context.socket(zmq.PULL)
@@ -51,10 +67,18 @@ def worker_main(setup_payload, worker_id):
     # user-code pace (it may sit on queued descriptors for minutes); the
     # pool has no resend path, so retiring an unread slab would lose rows.
     arena = (shm_plane.ShmArena(capacity_bytes=shm_capacity,
-                                stale_after_s=None)
+                                stale_after_s=None, metrics=metrics)
              if use_shm and shm_plane.available() else None)
 
     def publish(result):
+        t_pub = time.monotonic()
+        try:
+            _publish(result)
+        finally:
+            spans.span('pool/publish', t_pub, time.monotonic(),
+                       cid=current_position[0])
+
+    def _publish(result):
         if isinstance(result, pa.Table):
             if arena is not None:
                 desc = shm_plane.write_table(arena, result, arrow_ser)
@@ -77,6 +101,25 @@ def worker_main(setup_payload, worker_id):
     import time
 
     worker = worker_class(worker_id, publish, worker_args)
+    # The reader workers carry their cache in the setup-args dataclass
+    # (`worker._a.cache`); when it is a PlaneCache, its fill telemetry
+    # lives on per-instance surfaces (plane registry + plane span
+    # buffer) that THIS channel must ship — nothing else ever drains
+    # them in a child process.  Duck-typed: NullCache/local-disk have
+    # neither attribute.
+    cache = getattr(getattr(worker, '_a', None), 'cache', None)
+    cache_metrics = getattr(cache, 'metrics', None)
+    cache_spans = getattr(cache, 'spans', None)
+
+    def ack_snapshot():
+        """Full-state composite snapshot: the child registry plus the
+        cache plane's histograms (both cumulative — the parent REPLACES
+        its per-child slot, so full state never double-counts)."""
+        snap = metrics.snapshot()
+        if cache_metrics is not None:
+            snap['histograms'].update(
+                cache_metrics.snapshot()['histograms'])
+        return snap
     # A SIGKILLed parent can never send STOP: without a bounded wait the
     # child parks in recv forever — an orphan pinning its /dev/shm arena
     # and a CPU slot (lint unbounded-recv).  Poll with a timeout and exit
@@ -95,6 +138,7 @@ def worker_main(setup_payload, worker_id):
             if frames[-1] == b'STOP':
                 break
             position, args, kwargs = pickle.loads(frames[0])
+            current_position[0] = position
             started = time.monotonic()
             sleep_before = getattr(worker, 'retry_sleep_s', 0.0)
             try:
@@ -105,10 +149,19 @@ def worker_main(setup_payload, worker_id):
             finally:
                 # Ack carries this item's decode time (minus retry-backoff
                 # sleeps) so the parent pool can report decode_utilization
-                # like the in-process pools do.
+                # like the in-process pools do — plus the telemetry
+                # piggyback: registry snapshot + drained spans (ISSUE 5).
                 slept = getattr(worker, 'retry_sleep_s', 0.0) - sleep_before
                 busy = max(0.0, time.monotonic() - started - slept)
-                sink_socket.send_multipart([b'K', pickle.dumps((position, busy))])
+                decode_hist.observe(busy)
+                spans.span('pool/process', started, time.monotonic(),
+                           cid=position)
+                item_spans = spans.drain()
+                if cache_spans is not None:
+                    item_spans.extend(cache_spans.drain())
+                sink_socket.send_multipart(
+                    [b'K', pickle.dumps((position, busy, worker_id,
+                                         ack_snapshot(), item_spans))])
     finally:
         worker.shutdown()
         if arena is not None:

@@ -141,9 +141,19 @@ class ShmArena(object):
     """
 
     def __init__(self, capacity_bytes=DEFAULT_CAPACITY_BYTES,
-                 min_bytes=MIN_SHM_BYTES, stale_after_s=300.0):
+                 min_bytes=MIN_SHM_BYTES, stale_after_s=300.0,
+                 metrics=None):
         self.capacity_bytes = int(capacity_bytes)
         self.min_bytes = int(min_bytes)
+        # The writer's telemetry registry (ISSUE 5): the degrade counter
+        # lives here so the owning process's snapshot channel (ProcessPool
+        # ack, service heartbeat) carries it fleet-wide without a second
+        # bookkeeping surface.  Callers without a registry get a private
+        # one — `.degraded` stays the uniform read surface either way.
+        from petastorm_tpu.telemetry import MetricsRegistry
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry('shm_arena')
+        self._m_degraded = self.metrics.counter('shm_degraded')
         #: A slab neither released nor unlinked for this long is retired
         #: (unlinked, budget returned): its descriptor went to a consumer
         #: that vanished (client restart, dropped ZMQ identity) and
@@ -164,8 +174,12 @@ class ShmArena(object):
         self._slabs = []
         self.segments_written = 0
         self.bytes_written = 0
-        self.degraded = 0  # allocate() refusals (arena full)
         self.retired = 0   # stale inflight slabs unlinked (lost consumers)
+
+    @property
+    def degraded(self):
+        """allocate() refusals (arena full) — a registry view."""
+        return self._m_degraded.value
 
     @property
     def outstanding_bytes(self):
@@ -267,7 +281,7 @@ class ShmArena(object):
         slab = min(free, key=lambda s: s.size) if free \
             else self._create_slab(nbytes)
         if slab is None:
-            self.degraded += 1
+            self._m_degraded.inc()
             return None
         slab.gen += 1
         slab.inflight = True

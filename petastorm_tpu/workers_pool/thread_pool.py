@@ -14,6 +14,8 @@ import sys
 import threading
 import time
 
+from petastorm_tpu.telemetry import MetricsRegistry
+from petastorm_tpu.telemetry.registry import ms as _ms
 from petastorm_tpu.workers_pool import (DEFAULT_TIMEOUT_S, EmptyResultError,
                                         TimeoutWaitingForResultError, VentilatedItem)
 
@@ -40,8 +42,13 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
         self._stop_event = threading.Event()
         self._inflight_lock = threading.Lock()
         self._inflight = 0  # ventilated but result-not-yet-consumed items
-        self.items_processed = 0
-        self.busy_time = 0.0  # summed seconds inside worker.process (all threads)
+        #: Source of truth for the pool's counters (ISSUE 5):
+        #: ``diagnostics`` — and through it ``Reader.diagnostics`` — is a
+        #: view over this registry.
+        self.metrics = MetricsRegistry('thread_pool')
+        self._m_items = self.metrics.counter('items_processed')
+        self._m_busy = self.metrics.counter('decode_busy_s')
+        self._m_decode = self.metrics.histogram('decode')
         self._started_at = None
         self._stopped_at = None
         self._profiler = profiler
@@ -105,8 +112,9 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
                     elapsed = max(0.0, time.monotonic() - started - slept)
                     with self._inflight_lock:
                         self._inflight -= 1
-                        self.items_processed += 1
-                        self.busy_time += elapsed
+                    self._m_items.inc()
+                    self._m_busy.inc(elapsed)
+                    self._m_decode.observe(elapsed)
                     if self._ventilator is not None:
                         self._ventilator.processed_item(position)
         finally:
@@ -168,6 +176,16 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
     def results_qsize(self):
         return self._results_queue.qsize()
 
+    # Registry views — the attribute surface older callers (and
+    # _clone_pool) read, now backed by the telemetry registry.
+    @property
+    def items_processed(self):
+        return self._m_items.value
+
+    @property
+    def busy_time(self):
+        return self._m_busy.value
+
     @property
     def diagnostics(self):
         # Wall clock ends at stop(): reading diagnostics long after teardown
@@ -187,4 +205,8 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
             # values mean workers starve on I/O or the consumer backpressures.
             'decode_utilization': round(
                 self.busy_time / (wall * self.workers_count), 4) if wall else 0.0,
+            # Per-item decode latency from the registry histogram (log2
+            # buckets): the shape behind decode_busy_s's average.
+            'decode_p50_ms': _ms(self._m_decode.quantile(0.5)),
+            'decode_p99_ms': _ms(self._m_decode.quantile(0.99)),
         }

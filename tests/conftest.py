@@ -11,7 +11,10 @@ alone are overridden by the hook.
 """
 
 import faulthandler
+import json
 import os
+import threading
+import time
 
 # The suite has died natively before (PR 1: an mmap-backed ParquetFile
 # closed mid-read segfaulted teardown): faulthandler turns a native
@@ -61,6 +64,82 @@ def pytest_configure(config):
         pass
     faulthandler.dump_traceback_later(timeout=timeout_s, repeat=True,
                                       exit=False, **kwargs)
+    # Telemetry crash artifact (ISSUE 5 satellite): when the watchdog
+    # window elapses (suite hung — the external kill follows shortly), a
+    # companion timer writes every live registry snapshot + trace-recorder
+    # timeline to the artifact path CI uploads on failure, so the next
+    # silent-death bug ships with a timeline attached, not just thread
+    # stacks.  faulthandler can only dump stacks (C-level timer); this
+    # python-level dump needs its own timer.  The telemetry module is
+    # imported HERE, on the main thread: a first import of native
+    # extension modules from the timer thread (concurrent with the
+    # faulthandler dump) has segfaulted the child on this host.
+    global _TELEMETRY, _TELEMETRY_TIMER
+    try:
+        from petastorm_tpu import telemetry as _TELEMETRY
+        # dump_state's own lazy imports (benchmark.trace and through it
+        # the petastorm_tpu package tree) must also happen NOW: the
+        # timer thread must never be the first importer of anything.
+        _TELEMETRY.dump_state()
+    except Exception:  # no telemetry -> no dump, never a broken suite
+        _TELEMETRY = None
+    if _TELEMETRY is not None:
+        _arm_telemetry_timer(timeout_s)
+
+
+_TELEMETRY = None
+_TELEMETRY_TIMER = None
+
+
+def _arm_telemetry_timer(delay_s):
+    """Self-re-arming dump timer: after the first (watchdog-window) fire
+    it re-dumps every 30s, overwriting the artifact — like faulthandler's
+    repeat=True, so a hang that BEGINS after the first window is still
+    captured by the last dump before the external kill (the single-shot
+    version shipped a healthy pre-hang snapshot)."""
+    global _TELEMETRY_TIMER
+
+    def fire():
+        _write_telemetry_dump('watchdog_timeout')
+        _arm_telemetry_timer(30.0)
+
+    _TELEMETRY_TIMER = threading.Timer(delay_s, fire)
+    _TELEMETRY_TIMER.daemon = True
+    _TELEMETRY_TIMER.start()
+
+
+def _telemetry_dump_path():
+    return os.environ.get(
+        'PETASTORM_TPU_TELEMETRY_ARTIFACT',
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), '..',
+                     'test-artifacts', 'telemetry_dump.json'))
+
+
+def _write_telemetry_dump(reason):
+    """Best-effort: a failing diagnostics write must never fail (or hang)
+    the suite it is diagnosing.  Import-free by design (see
+    pytest_configure) — this may run on a timer thread mid-crash."""
+    if _TELEMETRY is None:
+        return
+    try:
+        state = _TELEMETRY.dump_state()
+        state['reason'] = reason
+        state['unix_time'] = time.time()
+        path = _telemetry_dump_path()
+        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+        with open(path, 'w') as f:
+            json.dump(state, f, default=str)
+    except Exception as e:  # noqa: BLE001
+        print('telemetry dump failed: %s' % (e,))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _TELEMETRY_TIMER is not None:
+        _TELEMETRY_TIMER.cancel()
+    # 0 = green, 5 = no tests collected; anything else failed/errored —
+    # leave the registry+timeline state next to the junit output.
+    if exitstatus not in (0, 5):
+        _write_telemetry_dump('exitstatus_%s' % (exitstatus,))
 
 
 @pytest.fixture(scope='session')

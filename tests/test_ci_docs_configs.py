@@ -292,3 +292,25 @@ def test_pyproject_carries_ruff_config():
     assert block and re.search(r'select\s*=', block.group(1))
     assert '[tool.ruff.lint.per-file-ignores]' in src
     assert '"petastorm/**"' in src  # legacy alias package stays ignored
+
+
+def test_bench_compact_line_pins_telemetry_fields():
+    """The stall-attribution top component (ISSUE 5 satellite) must ride
+    the compact machine line next to the stall family it explains."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    assert "'stall_top_component'" in block.group(1)
+
+
+def test_ci_uploads_telemetry_dump_on_failure():
+    """A red/hung tier-1 run must ship the conftest telemetry dump as an
+    artifact (ISSUE 5 satellite) — the timeline IS the bug report for
+    the silent-death class."""
+    job = _load_ci()['jobs']['tests']
+    uploads = [s for s in job['steps']
+               if str(s.get('uses', '')).startswith('actions/upload-artifact')]
+    assert uploads, 'tests job lost its telemetry-dump upload step'
+    step = uploads[0]
+    assert step.get('if') == 'failure()'
+    assert 'test-artifacts' in step['with']['path']

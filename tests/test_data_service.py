@@ -497,3 +497,86 @@ def test_ordered_mode_delivers_in_split_order(dataset):
     # One consumer + ordered mode: splits release in split-id order and
     # chunks in seq order, so ids come back in dataset row order.
     assert ids == list(range(ROWS))
+
+
+# -- telemetry plane (ISSUE 5) ------------------------------------------------
+
+def test_dispatcher_stats_rolls_up_shm_counters_fleet_wide(raw_dataset):
+    """Regression (ISSUE 5 satellite): the per-worker shm counters always
+    rode the heartbeats, but the dispatcher ``stats`` rollup dropped them
+    — a worker silently degraded to the byte path was invisible without
+    reading every worker's row.  Drive a real shm delivery and assert the
+    fleet-wide rollup reports the chunks (the degrade twin of this path
+    is pinned against a synthetic heartbeat in test_telemetry)."""
+    from petastorm_tpu.workers_pool import shm_plane
+    if not shm_plane.available():
+        pytest.skip('no usable /dev/shm on this host')
+    config = ServiceConfig(raw_dataset.url, num_consumers=1,
+                           rowgroups_per_split=2, lease_ttl_s=2.0,
+                           reader_kwargs={'workers_count': 2})
+    with Dispatcher(config) as dispatcher:
+        with Worker(dispatcher.addr) as worker:
+            loader = ServiceDataLoader(dispatcher.addr, batch_size=BATCH,
+                                       consumer=0, drop_last=False)
+            ids = _collect_ids(loader)
+            assert worker.diagnostics['shm_chunks'] > 0
+            stats = lambda: dispatcher._op_stats({})  # noqa: E731
+            # rollup catches up on the next heartbeat (lease_ttl/3)
+            _wait_for(lambda: stats()['shm']['shm_chunks'] > 0, 30,
+                      'shm rollup to reflect the heartbeat counters')
+            snapshot = stats()
+    assert sorted(ids) == list(range(raw_dataset.rows))
+    assert set(snapshot['shm']) == {'shm_chunks', 'shm_degraded'}
+    assert snapshot['shm']['shm_chunks'] == \
+        sum(int(w.get('shm_chunks', 0))
+            for w in snapshot['workers'].values())
+    # the heartbeat registry snapshots merged into fleet stage latencies
+    assert snapshot['stages']['decode_split']['count'] > 0
+    assert snapshot['stages']['decode_split']['p99_ms'] is not None
+
+
+def test_service_run_merges_worker_spans_into_client_trace(dataset):
+    """Cross-process correlated spans (ISSUE 5 tentpole): a REAL worker
+    subprocess's decode/serialize spans ride the end headers, align via
+    the chained clock offsets, and land on the client's recorder as one
+    correlation-id-linked timeline next to its own split_wait spans."""
+    from petastorm_tpu.benchmark import TraceRecorder
+    config = _config(dataset, num_consumers=1)
+    recorder = TraceRecorder()
+    with Dispatcher(config) as dispatcher:
+        proc = _spawn_worker_process(dispatcher.addr)
+        try:
+            loader = ServiceDataLoader(dispatcher.addr, batch_size=BATCH,
+                                       consumer=0, drop_last=False,
+                                       trace_recorder=recorder)
+            ids = _collect_ids(loader)
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+    assert sorted(ids) == list(range(ROWS))
+    events = recorder.events
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev['name'], []).append(ev)
+    decodes = by_name.get('service/decode_split') or []
+    serializes = by_name.get('service/serialize') or []
+    assert decodes and serializes, 'worker spans never reached the client'
+    # spans come from the WORKER process, labeled on its own track
+    assert all(ev['pid'] == proc.pid for ev in decodes)
+    labels = [ev for ev in events if ev.get('ph') == 'M']
+    assert any(ev['pid'] == proc.pid and
+               ev['args']['name'].startswith('service worker')
+               for ev in labels)
+    # client-side waits share the timeline
+    assert by_name.get('service/split_wait'), 'client never recorded waits'
+    # correlation ids link each chunk's serialize span to its split's
+    # decode span, and the chunk span nests inside the split span
+    for serialize in serializes:
+        split_id, _, seq = serialize['args']['cid'].partition('/')
+        assert seq != ''
+        parents = [d for d in decodes if d['args']['cid'] == split_id]
+        assert parents, 'serialize span with no decode parent'
+        parent = parents[0]
+        assert parent['ts'] - 1000 <= serialize['ts'] \
+            <= parent['ts'] + parent['dur'] + 1000  # 1ms alignment slack

@@ -1,0 +1,478 @@
+"""Cross-process telemetry plane (ISSUE 5, ``petastorm_tpu/telemetry``).
+
+Covers the three pillars: the metrics registry (log2 histograms merge by
+addition; snapshots ride pickles and render as Prometheus text), the
+correlated spans (clock-offset alignment lands a spawned process's spans
+in order on the local timeline; stall attribution decomposes data_wait),
+and the views (golden-key tests pin the diagnostics dicts of every
+subsystem as STABLE views over the registries — key drift here silently
+breaks dashboards and the BENCH compact line downstream).
+"""
+
+import json
+import pickle
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader, telemetry
+from petastorm_tpu.benchmark import StallMonitor, TraceRecorder
+from petastorm_tpu.jax import DataLoader
+from petastorm_tpu.telemetry import (MetricsRegistry, attribute_stalls,
+                                     hist_quantile, measure_clock_offset,
+                                     merge_into_recorder, merge_snapshots)
+
+from test_common import create_test_dataset
+
+ROWS = 48
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('telemds')
+    return create_test_dataset('file://' + str(path), num_rows=ROWS,
+                               rows_per_rowgroup=8)
+
+
+# -- registry -----------------------------------------------------------------
+
+def test_histogram_log2_buckets_merge_by_addition():
+    a, b = MetricsRegistry('a'), MetricsRegistry('b')
+    for v in (0.001, 0.002, 0.004):
+        a.histogram('stage').observe(v)
+    for v in (0.004, 0.128):
+        b.histogram('stage').observe(v)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    hist = merged['histograms']['stage']
+    assert hist['count'] == 5
+    # merged bucket counts are the elementwise sums
+    assert sum(hist['counts']) == 5
+    one_each = a.snapshot()['histograms']['stage']['counts']
+    other = b.snapshot()['histograms']['stage']['counts']
+    assert hist['counts'] == [x + y for x, y in zip(one_each, other)]
+    # quantiles report the bucket UPPER bound (can't under-state a stage)
+    assert hist_quantile(hist, 0.5) >= 0.004
+    assert hist_quantile(hist, 0.99) >= 0.128
+    assert hist_quantile({'counts': [], 'count': 0}, 0.5) is None
+
+
+def test_registry_snapshot_rides_pickle_and_merges():
+    registry = MetricsRegistry('pool')
+    registry.counter('items').inc(3)
+    registry.gauge('depth').set(7)
+    registry.histogram('decode').observe(0.01)
+    snap = pickle.loads(pickle.dumps(registry.snapshot()))
+    other = MetricsRegistry('pool')
+    other.merge(snap)
+    other.counter('items').inc()
+    assert other.counter('items').value == 4
+    assert other.gauge('depth').value == 7
+    assert other.histogram('decode').count == 1
+    # registries themselves pickle BY SNAPSHOT (PlaneCache rides worker
+    # args across the ProcessPool boundary)
+    clone = pickle.loads(pickle.dumps(other))
+    assert clone.counter('items').value == 4
+
+
+def test_render_prometheus_exposition_format():
+    registry = MetricsRegistry('svc')
+    registry.counter('rows').inc(12)
+    registry.gauge('queue').set(3)
+    registry.histogram('decode').observe(0.002)
+    text = registry.render_prometheus()
+    assert '# TYPE petastorm_tpu_svc_rows counter' in text
+    assert 'petastorm_tpu_svc_rows 12' in text
+    assert '# TYPE petastorm_tpu_svc_queue gauge' in text
+    assert '# TYPE petastorm_tpu_svc_decode_seconds histogram' in text
+    assert 'petastorm_tpu_svc_decode_seconds_count 1' in text
+    # cumulative buckets end with +Inf carrying the total count
+    assert 'petastorm_tpu_svc_decode_seconds_bucket{le="+Inf"} 1' in text
+
+
+def test_as_dict_is_the_diagnostics_shape():
+    registry = MetricsRegistry('x')
+    registry.counter('n').inc(2)
+    registry.histogram('stage').observe(0.004)
+    view = registry.as_dict()
+    assert view['n'] == 2
+    assert view['stage_count'] == 1
+    assert view['stage_p50_ms'] == view['stage_p99_ms'] > 0
+
+
+# -- spans --------------------------------------------------------------------
+
+def test_attribute_stalls_decomposes_data_wait():
+    events = [
+        {'name': 'data_wait', 'ph': 'X', 'ts': 0, 'dur': 100},
+        # covers most of the wait by construction (client-side wrapper):
+        # only its stage-free remainder may count as lease starvation
+        {'name': 'service/split_wait', 'ph': 'X', 'ts': 0, 'dur': 90},
+        {'name': 'service/decode_split', 'ph': 'X', 'ts': 10, 'dur': 60},
+        {'name': 'service/serialize', 'ph': 'X', 'ts': 70, 'dur': 10},
+        {'name': 'device_put', 'ph': 'X', 'ts': 95, 'dur': 30},  # clipped
+        {'name': 'step', 'ph': 'X', 'ts': 100, 'dur': 50},
+    ]
+    breakdown = attribute_stalls(events)
+    assert breakdown['top'] == 'decode'
+    assert breakdown['pct']['decode'] == 60.0
+    assert breakdown['pct']['ipc'] == 10.0
+    assert breakdown['pct']['h2d'] == 5.0   # only the overlap counts
+    # split_wait spanned [0,90) but stages covered [10,80)+[95,100):
+    # starvation is the stage-free wrapper time [0,10)+[80,90) = 20 —
+    # NOT the raw 90 (which would crown lease_wait for every service
+    # stall) — and 'other' is what NOTHING accounts for ([90,95) = 5;
+    # starved time must not double into it, or other >= lease_wait
+    # always and starvation could never top the compact line).
+    assert breakdown['pct']['lease_wait'] == 20.0
+    assert breakdown['pct']['other'] == 5.0
+    assert attribute_stalls([]) is None
+
+
+def test_attribute_stalls_pure_starvation_tops():
+    """A wait covered ONLY by the split_wait wrapper is lease starvation
+    and must win top — the signal the satellite exists to surface."""
+    events = [
+        {'name': 'data_wait', 'ph': 'X', 'ts': 0, 'dur': 100},
+        {'name': 'service/split_wait', 'ph': 'X', 'ts': 0, 'dur': 95},
+        {'name': 'service/decode_split', 'ph': 'X', 'ts': 0, 'dur': 10},
+    ]
+    breakdown = attribute_stalls(events)
+    assert breakdown['pct']['lease_wait'] == 85.0
+    assert breakdown['pct']['other'] == 5.0
+    assert breakdown['top'] == 'lease_wait'
+
+
+def test_stall_monitor_report_carries_breakdown(dataset):
+    recorder = TraceRecorder()
+    monitor = StallMonitor(warmup_steps=0, trace_recorder=recorder)
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     num_epochs=1) as reader:
+        loader = DataLoader(reader, batch_size=8, trace_recorder=recorder)
+        for _ in monitor.wrap(loader.iter_host_batches()):
+            pass
+    report = monitor.report()
+    assert set(report['stall_breakdown']) == {
+        'lease_wait', 'decode', 'ipc', 'cache_fill', 'h2d', 'other'}
+    component, pct = report['stall_top_component'].split(':')
+    assert component in report['stall_breakdown']
+    assert pct.endswith('%')
+
+
+def test_two_process_clock_offset_alignment():
+    """Satellite: spans from a SPAWNED process — whose reported clock is
+    skewed by a constant the handshake must recover — land ordered and
+    inside the local wait window after the merge."""
+    skew = 5000.0  # seconds: simulated foreign monotonic origin
+    child = (
+        'import json, time\n'
+        't = time.monotonic() + %r\n'
+        'spans = [\n'
+        ' {"name": "service/decode_split", "t0": t - 0.008,'
+        ' "t1": t - 0.004, "pid": 4242, "cid": "7"},\n'
+        ' {"name": "service/serialize", "t0": t - 0.004,'
+        ' "t1": t - 0.002, "pid": 4242, "cid": "7/0"},\n'
+        ']\n'
+        'print(json.dumps({"t_mono": t, "spans": spans}))\n' % skew)
+    payload = {}
+
+    def call():
+        probe = subprocess.run([sys.executable, '-c', child],
+                               capture_output=True, text=True, timeout=120)
+        payload.update(json.loads(probe.stdout))
+        return payload['t_mono']
+
+    recorder = TraceRecorder()
+    t_wait0 = time.monotonic()
+    offset, rtt = measure_clock_offset(call)
+    t_wait1 = time.monotonic()
+    recorder.event('data_wait', t_wait0, t_wait1)
+    # the skew dominates the offset; the handshake recovers it to ~rtt
+    assert abs(offset + skew) <= rtt + 0.05
+    merged = merge_into_recorder(recorder, payload['spans'],
+                                 clock_offset_s=offset)
+    assert merged == 2
+    spans = {e['name']: e for e in recorder.events if e['ph'] == 'X'}
+    decode = spans['service/decode_split']
+    serialize = spans['service/serialize']
+    # ordered after alignment, and attributed to the foreign pid
+    assert decode['ts'] < serialize['ts']
+    assert decode['pid'] == serialize['pid'] == 4242
+    assert decode['args']['cid'] == '7'
+    # both land INSIDE the local wait window (the child ran within it)
+    wait = spans['data_wait']
+    assert wait['ts'] <= decode['ts'] <= serialize['ts'] \
+        <= wait['ts'] + wait['dur']
+    # ...so stall attribution sees them
+    breakdown = attribute_stalls(recorder.events)
+    assert breakdown['pct']['decode'] > 0
+
+
+# -- golden keys: every diagnostics dict is a STABLE view ---------------------
+
+THREAD_READER_KEYS = {
+    'pool', 'workers_count', 'items_processed', 'inflight', 'input_qsize',
+    'results_qsize', 'decode_busy_s', 'decode_utilization',
+    'decode_p50_ms', 'decode_p99_ms', 'ventilated_count',
+    'prologue_remaining', 'cursor', 'epoch', 'seed'}
+
+PROCESS_READER_KEYS = {
+    'pool', 'workers_count', 'items_processed', 'inflight', 'workers_alive',
+    'shm_results', 'shm_degraded', 'decode_busy_s', 'decode_utilization',
+    'decode_p50_ms', 'decode_p99_ms', 'ventilated_count',
+    'prologue_remaining', 'cursor', 'epoch', 'seed'}
+
+LOADER_ONLY_KEYS = {
+    'batches',
+    'host_batch_s', 'host_batch_count', 'host_batch_p50_ms',
+    'host_batch_p99_ms',
+    'transform_s', 'transform_count', 'transform_p50_ms', 'transform_p99_ms',
+    'device_put_s', 'device_put_count', 'device_put_p50_ms',
+    'device_put_p99_ms'}
+
+CACHE_PLANE_KEYS = {
+    'cache_hits', 'cache_misses', 'cache_evictions', 'cache_ram_hits',
+    'cache_single_flight_hits', 'cache_degraded'}
+
+WORKER_DIAG_KEYS = {
+    'rows_decoded', 'splits_decoded', 'rows_per_s', 'queue_depth',
+    'shm_chunks', 'shm_degraded', 'cache_hits', 'cache_misses',
+    'cache_evictions', 'cache_ram_hits', 'cache_degraded'}
+
+DISPATCHER_STATS_KEYS = {
+    'num_splits', 'pending', 'leased', 'done', 'failed', 'lease_churn',
+    'cache', 'shm', 'stages', 'workers'}
+
+
+def test_golden_keys_thread_reader_and_loader(dataset):
+    with make_reader(dataset.url, reader_pool_type='thread',
+                     workers_count=2, num_epochs=1) as reader:
+        loader = DataLoader(reader, batch_size=8)
+        for _ in loader.iter_host_batches():
+            pass
+        assert set(reader.diagnostics) == THREAD_READER_KEYS
+        assert set(loader.diagnostics) == \
+            THREAD_READER_KEYS | LOADER_ONLY_KEYS
+        assert set(loader.stats) == {'host_batch_s', 'transform_s',
+                                     'device_put_s', 'batches'}
+        assert loader.stats['batches'] == ROWS // 8
+        # the view is REBUILT from the registry on every read
+        assert reader.metrics is not None
+        assert reader.diagnostics['items_processed'] == \
+            reader.metrics.counter('items_processed').value
+
+
+def test_golden_keys_process_reader(dataset):
+    with make_reader(dataset.url, reader_pool_type='process',
+                     workers_count=2, num_epochs=1) as reader:
+        n = sum(1 for _ in reader)
+    assert n == ROWS
+    diag = reader.diagnostics
+    assert set(diag) == PROCESS_READER_KEYS
+    # acceptance: child registry snapshots round-trip through the b'K'
+    # ack channel — the merged per-item decode histogram reaches the
+    # parent (plain busy_time could never produce a quantile)
+    assert diag['decode_p50_ms'] is not None
+    assert diag['decode_p99_ms'] >= diag['decode_p50_ms']
+
+
+def test_golden_keys_cache_plane(tmp_path):
+    from petastorm_tpu.cache_plane.plane import CachePlane
+    plane = CachePlane(str(tmp_path / 'plane'))
+    assert plane.get_or_fill('k', lambda: 41) == 41
+    assert plane.get_or_fill('k', lambda: 42) == 41
+    assert set(plane.stats) == CACHE_PLANE_KEYS
+    assert plane.stats['cache_hits'] == 1 and plane.stats['cache_misses'] == 1
+    # counters live in the registry; the attrs/stats dict are views
+    assert plane.metrics.counter('cache_hits').value == plane.hits == 1
+    # ...and the fill was timed into the histogram + the plane's OWN
+    # span buffer (per-instance: concurrent in-process drainers must not
+    # race over the global singleton)
+    assert plane.metrics.histogram('cache_fill').count == 1
+    fills = plane.spans.drain()
+    assert any(s['name'] == 'cache/fill' for s in fills)
+
+
+def test_golden_keys_dispatcher_stats_and_fleet_rollup(tmp_path):
+    """Dispatcher ``stats`` keys + the heartbeat registry round-trip:
+    per-worker snapshots merge into fleet-wide stage histograms."""
+    import zmq
+
+    from petastorm_tpu.service import Dispatcher, ServiceConfig
+    from petastorm_tpu.service.worker import _Rpc
+    config = ServiceConfig('file:///unused', num_consumers=1)
+    with Dispatcher(config, num_pieces=4) as dispatcher:
+        context = zmq.Context()
+        rpc = _Rpc(context, dispatcher.addr)
+        try:
+            reply = rpc.call({'op': 'register_worker',
+                              'data_addr': 'tcp://127.0.0.1:1'})
+            assert reply['t_mono'] > 0  # clock handshake rides register
+            registry = MetricsRegistry('service_worker')
+            registry.histogram('decode_split').observe(0.05)
+            registry.histogram('decode_split').observe(0.1)
+            rpc.call({'op': 'heartbeat', 'worker_id': reply['worker_id'],
+                      'stats': {'rows_decoded': 7, 'shm_chunks': 3,
+                                'shm_degraded': 2, 'cache_hits': 1,
+                                'clock_offset': 0.25,
+                                'registry': registry.snapshot()}})
+            stats = rpc.call({'op': 'stats'})
+            workers = rpc.call({'op': 'workers'})
+        finally:
+            rpc.close()
+            context.term()
+    assert set(stats) == DISPATCHER_STATS_KEYS
+    # the raw snapshot is merged into `stages`, then STRIPPED from the
+    # per-worker reply rows (it would grow the poll linearly with fleet
+    # size for data nothing reads)
+    assert all('registry' not in row for row in stats['workers'].values())
+    assert stats['shm'] == {'shm_chunks': 3, 'shm_degraded': 2}
+    assert stats['cache']['cache_hits'] == 1
+    stage = stats['stages']['decode_split']
+    assert stage['count'] == 2 and stage['p99_ms'] >= stage['p50_ms'] > 0
+    # per-worker clock offsets surface on the discovery poll for span
+    # alignment, next to the dispatcher's own clock
+    assert workers['t_mono'] > 0
+    assert workers['workers'][0]['clock_offset'] == 0.25
+
+
+def test_golden_keys_service_worker_diagnostics():
+    from petastorm_tpu.service.worker import Worker
+    worker = Worker('tcp://127.0.0.1:1')
+    assert set(worker.diagnostics) == WORKER_DIAG_KEYS
+    beat = worker.heartbeat_stats()
+    assert set(beat) == WORKER_DIAG_KEYS | {'registry', 'clock_offset',
+                                            'pid'}
+    assert beat['registry']['namespace'] == 'service_worker'
+
+
+# -- live introspection -------------------------------------------------------
+
+def test_top_render_and_once_json(tmp_path, capsys):
+    from petastorm_tpu.service import Dispatcher, ServiceConfig
+    from petastorm_tpu.telemetry import top
+    config = ServiceConfig('file:///unused', num_consumers=1)
+    with Dispatcher(config, num_pieces=4) as dispatcher:
+        rc = top.main(['--dispatcher', dispatcher.addr, '--once'])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert 'splits' in text and 'pending 2' in text
+        assert 'workers (0):' in text
+        rc = top.main(['--dispatcher', dispatcher.addr, '--once', '--json'])
+        assert rc == 0
+        stats = json.loads(capsys.readouterr().out)
+        assert stats['pending'] == 2
+    # unreachable dispatcher: clean nonzero exit, not a hang
+    rc = top.main(['--dispatcher', 'tcp://127.0.0.1:1', '--once',
+                   '--rpc-timeout', '0.3'])
+    assert rc == 1
+
+
+def test_top_render_stats_handles_rich_reply():
+    from petastorm_tpu.telemetry.top import render_stats
+    text = render_stats({
+        'pending': 1, 'leased': 2, 'done': 3, 'failed': 0,
+        'lease_churn': 4,
+        'cache': {'cache_hits': 30, 'cache_misses': 10,
+                  'cache_ram_hits': 5, 'cache_degraded': 1,
+                  'cache_evictions': 0},
+        'shm': {'shm_chunks': 9, 'shm_degraded': 1},
+        'stages': {'decode_split': {'count': 12, 'p50_ms': 8.2,
+                                    'p99_ms': 131.0}},
+        'workers': {'w0': {'rows_per_s': 100.5, 'rows_decoded': 1000,
+                           'queue_depth': 2, 'shm_chunks': 9,
+                           'shm_degraded': 1, 'cache_hits': 30,
+                           'age_s': 0.5}},
+    })
+    assert '75.0%' in text            # cache hit rate
+    assert 'decode_split' in text and '131.0' in text
+    assert 'w0' in text and '100.5' in text
+
+
+def test_dump_state_collects_live_registries_and_recorders():
+    registry = MetricsRegistry('dumptest')
+    registry.counter('alive').inc()
+    recorder = TraceRecorder()
+    recorder.event('probe', 0.0, 0.001)
+    state = telemetry.dump_state()
+    assert any(s['namespace'] == 'dumptest'
+               and s['counters'].get('alive') == 1
+               for s in state['registries'])
+    # trace events come as per-recorder batches WITH their monotonic
+    # origin — each recorder's ts values are relative to its own
+    # construction time, so the origin is what makes two recorders'
+    # batches alignable in the artifact
+    assert any(batch['origin_monotonic'] > 0
+               and any(e['name'] == 'probe' for e in batch['events'])
+               for batch in state['trace_events'])
+    json.dumps(state)  # the conftest artifact write must not choke
+
+
+def test_pool_worker_spans_reach_parent_recorder(dataset):
+    """ProcessPool children's pool/process + pool/publish spans ride the
+    ack channel and merge into an attached recorder, correlation-id'd by
+    ventilator position — wired through the PUBLIC
+    ``DataLoader(trace_recorder=)`` surface, as documented."""
+    recorder = TraceRecorder()
+    with make_reader(dataset.url, reader_pool_type='process',
+                     workers_count=2, num_epochs=1) as reader:
+        loader = DataLoader(reader, batch_size=8, trace_recorder=recorder)
+        assert reader._pool.trace_recorder is recorder
+        del loader
+        n = sum(1 for _ in reader)
+    assert n == ROWS
+    spans = [e for e in recorder.events if e['name'] == 'pool/process']
+    assert spans, 'no child spans merged'
+    assert all('cid' in e['args'] for e in spans)
+    assert any(e['name'] == 'pool/publish' for e in recorder.events)
+    # child pids, not the parent's
+    import os
+    assert all(e['pid'] != os.getpid() for e in spans)
+
+
+def test_pool_child_cache_fill_telemetry_reaches_parent(dataset, tmp_path):
+    """Review regression guard: a PlaneCache inside a ProcessPool CHILD
+    records fills on per-instance surfaces (plane registry + plane span
+    buffer); the b'K' ack must ship both, or a miss-heavy cached epoch
+    is invisible from the parent."""
+    recorder = TraceRecorder()
+    with make_reader(dataset.url, reader_pool_type='process',
+                     workers_count=2, num_epochs=1, cache_type='plane',
+                     cache_location=str(tmp_path / 'plane')) as reader:
+        reader._pool.trace_recorder = recorder
+        n = sum(1 for _ in reader)
+        merged = reader._pool.worker_telemetry()
+    assert n == ROWS
+    assert merged['histograms']['cache_fill']['count'] > 0
+    fills = [e for e in recorder.events if e['name'] == 'cache/fill']
+    assert fills, 'child cache/fill spans never reached the parent'
+    import os
+    assert all(e['pid'] != os.getpid() for e in fills)
+
+
+def test_stall_breakdown_excludes_warmup_windows(dataset):
+    """Warmup pulls stay on the timeline (data_wait_warmup) but must not
+    be attributed: stall_breakdown covers exactly the population
+    stall_pct counts."""
+    recorder = TraceRecorder()
+    monitor = StallMonitor(warmup_steps=2, trace_recorder=recorder)
+    with make_reader(dataset.url, reader_pool_type='dummy',
+                     num_epochs=1) as reader:
+        loader = DataLoader(reader, batch_size=8, trace_recorder=recorder)
+        for _ in monitor.wrap(loader.iter_host_batches()):
+            pass
+    names = [e['name'] for e in recorder.events]
+    assert names.count('data_wait_warmup') == 2
+    assert names.count('data_wait') == monitor.steps
+    counted = [e for e in recorder.events if e['name'] == 'data_wait']
+    warm = [e for e in recorder.events if e['name'] == 'data_wait_warmup']
+    breakdown = attribute_stalls(recorder.events)
+    total_counted_us = sum(e['dur'] for e in counted)
+    # total_wait_s is rounded to 4 dp by attribute_stalls
+    assert abs(breakdown['total_wait_s'] - total_counted_us / 1e6) < 1e-4
+    # threads of remote spans keep their own ident for separate tracks
+    assert all('tid' in s for s in
+               [e for e in recorder.events if e.get('ph') == 'X'])
+    assert warm  # timeline still shows the warmup pulls
