@@ -1227,14 +1227,101 @@ def epoch_cache_plane_leg(pairs=3):
     return fields
 
 
-#: Host-only IPC-plane legs (the shm result plane's evidence set), wired
-#: identically into the cpu-fallback and on-chip paths of main() — one
-#: table so the two paths cannot drift apart.
+def transfer_plane_leg(pairs=3, reps=8):
+    """Host→device transfer plane (ISSUE 6): delivered-images/s of the
+    coalesced ring path and its wire-narrowed variant vs the inline
+    per-column ``device_put`` baseline, on a multi-column image batch
+    (96×96×3 uint8 image + 96 16-wide float32 feature columns + int64
+    label — the wide-table regime transfer coalescing targets, where the
+    per-put fixed dispatch cost dominates; that regime is also the one
+    that measures meaningfully on ANY backend, including the CPU
+    fallback where the link itself is a memcpy).  Variants run
+    interleaved round-robin ``pairs`` times with medians reported (the
+    BENCH_NOTES adjacent-runs discipline — single runs on this shared
+    host swing 2-3x).  Plane-off equivalence (the kill-switch/degrade
+    matrix) is asserted bit-identical here rather than timed; on-TPU
+    numbers record the tunnel condition via the transport leg's
+    ``h2d_bytes_per_s`` as usual."""
+    import jax
+
+    from petastorm_tpu.jax.transfer import TransferPlane
+
+    rng = np.random.default_rng(0)
+    batch = {'image': rng.integers(0, 256, (BATCH, 96, 96, 3))
+                         .astype(np.uint8)}
+    for i in range(96):
+        batch['feat_%02d' % i] = rng.standard_normal(
+            (BATCH, 16)).astype(np.float32)
+    batch['label'] = rng.integers(0, 1000, (BATCH,)).astype(np.int64)
+
+    def run_inline():
+        t0 = time.monotonic()
+        outs = [jax.device_put(batch) for _ in range(reps)]
+        jax.block_until_ready(outs)
+        return reps * BATCH / (time.monotonic() - t0)
+
+    planes = {'coalesced': TransferPlane(ring_slots=3),
+              'narrowed': TransferPlane(ring_slots=3, wire_dtypes='auto')}
+
+    def run_plane(plane):
+        t0 = time.monotonic()
+        outs = [plane.put(batch) for _ in range(reps)]
+        assert outs[0] is not None, 'plane degraded on the bench batch'
+        jax.block_until_ready(outs)
+        return reps * BATCH / (time.monotonic() - t0)
+
+    # Untimed warmup for every variant: device_put path, slab first-touch
+    # faults, and the unpack executables compile outside the window.
+    jax.block_until_ready(jax.device_put(batch))
+    for plane in planes.values():
+        jax.block_until_ready(plane.put(batch))
+    rates = {'inline': [], 'coalesced': [], 'narrowed': []}
+    for _ in range(max(1, int(pairs))):
+        rates['inline'].append(run_inline())
+        rates['coalesced'].append(run_plane(planes['coalesced']))
+        rates['narrowed'].append(run_plane(planes['narrowed']))
+    med = {k: float(np.median(v)) for k, v in rates.items()}
+    wire = planes['narrowed'].metrics.counter('h2d_bytes_wire').value
+    logical = planes['narrowed'].metrics.counter('h2d_bytes_logical').value
+    fields = {
+        'transfer_plane_images_per_sec_inline': round(med['inline'], 1),
+        'transfer_plane_images_per_sec_coalesced':
+            round(med['coalesced'], 1),
+        'transfer_plane_images_per_sec_narrowed': round(med['narrowed'], 1),
+        'transfer_plane_coalesced_over_inline':
+            round(med['coalesced'] / med['inline'], 2) if med['inline']
+            else None,
+        'transfer_plane_narrowed_over_inline':
+            round(med['narrowed'] / med['inline'], 2) if med['inline']
+            else None,
+        'transfer_plane_wire_bytes_ratio':
+            round(wire / logical, 3) if logical else None,
+    }
+    # Degrade-matrix equivalence, asserted on the same batch: the exact
+    # (no-narrowing) plane output must be bit-identical to the inline
+    # path — the contract that makes 'auto' safe to leave on.
+    exact = planes['coalesced'].put(batch)
+    ref = jax.device_put(batch)
+    identical = all(
+        np.asarray(exact[k]).dtype == np.asarray(ref[k]).dtype
+        and np.array_equal(np.asarray(exact[k]), np.asarray(ref[k]))
+        for k in batch)
+    fields['transfer_plane_bit_identical'] = bool(identical)
+    for plane in planes.values():
+        plane.close()
+    return fields
+
+
+#: Host-only IPC/transfer-plane legs (the shm result plane's and the
+#: transfer plane's evidence sets), wired identically into the
+#: cpu-fallback and on-chip paths of main() — one table so the two paths
+#: cannot drift apart.
 _IPC_PLANE_LEGS = (
     ('ipc', ipc_microbench),
     ('processpool_plane', processpool_host_plane_leg),
     ('delivery_plane_service', delivery_plane_service_leg),
     ('epoch_cache_plane', epoch_cache_plane_leg),
+    ('transfer_plane', transfer_plane_leg),
 )
 
 
@@ -1487,6 +1574,13 @@ _COMPACT_KEYS = (
     'epoch_cache_service_warm_over_cold',
     'stall_pct_epoch_cache_warm_scan',
     'stall_top_component',
+    'transfer_plane_images_per_sec_inline',
+    'transfer_plane_images_per_sec_coalesced',
+    'transfer_plane_images_per_sec_narrowed',
+    'transfer_plane_coalesced_over_inline',
+    'transfer_plane_narrowed_over_inline',
+    'transfer_plane_wire_bytes_ratio',
+    'transfer_plane_bit_identical',
     'ipc_bytes_per_s', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',

@@ -10,6 +10,13 @@ execution model instead of translated from them:
 * **Async dispatch double-buffering** — ``jax.device_put`` returns
   immediately while DMA proceeds; the loader keeps ``prefetch`` batches in
   flight so H2D transfer of batch N+1 overlaps the device step on batch N.
+* **Pipelined transfer plane** (``petastorm_tpu.jax.transfer``) — on
+  accelerator backends a background dispatch thread stages each batch
+  into a reused ring slab (one coalesced ``device_put`` per batch, not
+  one per column, opt-in bf16/uint8 wire narrowing, per-device parallel
+  dispatch under a ``sharding``) so host staging, the link, and the
+  step overlap as three pipeline stages; ``transfer=``/``wire_dtypes=``
+  /``ring_slots=`` control it, unsupported shapes degrade bit-identical.
 * **Multi-host global batches** — pass ``sharding`` (a ``NamedSharding``
   over a mesh) and each host contributes its local rows via
   ``jax.make_array_from_process_local_data``; the yielded pytree holds
@@ -24,6 +31,7 @@ execution model instead of translated from them:
 import logging
 import time
 from collections import deque
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -56,16 +64,39 @@ class DataLoader(object):
             section (host_batch / transform / device_put) is additionally
             recorded as a chrome-trace span (timeline view of the same
             time ``stats`` aggregates).
+        transfer: the host→device transfer plane
+            (``petastorm_tpu.jax.transfer``): ``'auto'`` (default) turns
+            it on when an accelerator backend is live, ``True`` forces it
+            on (CPU tests), ``False`` keeps the inline ``device_put``
+            path.  When on, a background dispatch thread stages each
+            batch into a reused ring slab (one coalesced ``device_put``
+            per batch instead of one per column) so the link runs as its
+            own overlapped pipeline stage; ``PETASTORM_TPU_NO_TRANSFER_
+            PLANE=1`` kills it globally, and unsupported batch
+            structures degrade per batch to the inline path with
+            bit-identical results.
+        wire_dtypes: opt-in wire narrowing for the transfer plane:
+            ``'auto'`` ships float32/float64 leaves as bfloat16 and
+            casts back on device (half/quarter the bytes on the link —
+            values round to bf16), or a ``{field: dtype}`` dict for
+            explicit control.  ``None`` (default) transfers every leaf
+            at full width, bit-identical to ``jax.device_put``.
+        ring_slots: device-buffer ring depth for the transfer plane
+            (default ``prefetch + 1``): up to ``ring_slots - 1``
+            transfers stay in flight while the step runs.
     """
 
     def __init__(self, reader, batch_size, shuffling_queue_capacity=0,
                  min_after_retrieve=None, transform_fn=None, drop_last=True,
                  prefetch=2, device=None, sharding=None, seed=None,
-                 resume_state=None, echo=1, trace_recorder=None):
+                 resume_state=None, echo=1, trace_recorder=None,
+                 transfer='auto', wire_dtypes=None, ring_slots=None):
         if batch_size <= 0:
             raise ValueError('batch_size must be positive')
         if echo < 1:
             raise ValueError('echo must be >= 1')
+        from petastorm_tpu.jax.transfer import validate_transfer
+        validate_transfer(transfer)   # fail at construction, not first iter
         self.reader = reader
         self.batch_size = int(batch_size)
         self._shuffle_capacity = shuffling_queue_capacity
@@ -101,7 +132,9 @@ class DataLoader(object):
         #: Per-stage wall time (SURVEY.md §5.1 obligation): 'host_batch_s'
         #: covers waiting on the decode plane + collate, 'transform_s' the
         #: user hook, 'device_put_s' the H2D *dispatch* (the DMA itself is
-        #: async and overlaps).  Pair with StallMonitor for the consumer
+        #: async and overlaps; on the transfer-plane path it covers the
+        #: whole staged put — pack + dispatch + any ring commit wait —
+        #: with the h2d_* histograms carrying the split).  Pair with StallMonitor for the consumer
         #: view and reader.diagnostics['decode_utilization'] for the
         #: worker-pool view (all three pools; the ZeroMQ pool ships child
         #: busy time back on each ack).  The source of truth is the
@@ -115,6 +148,18 @@ class DataLoader(object):
             stage: (self.metrics.counter(stage + '_s'),
                     self.metrics.histogram(stage))
             for stage in ('host_batch', 'transform', 'device_put')}
+        #: ``device_put`` above times only the async DISPATCH; this
+        #: histogram samples TRUE transfer completion (a periodic
+        #: ``block_until_ready``, plus every ring-slot reuse wait when
+        #: the transfer plane is on) so ``diagnostics`` reports both
+        #: dispatch and commit p50/p99.
+        self._m_commit = self.metrics.histogram('h2d_commit')
+        self._commit_probe = 0
+        self._transfer = transfer
+        self._wire_dtypes = wire_dtypes
+        self._ring_slots = ring_slots
+        self._plane = None
+        self._pump = None
         self._trace = trace_recorder
         if trace_recorder is not None:
             # ProcessPool children ship their spans (pool/process,
@@ -144,7 +189,142 @@ class DataLoader(object):
 
     # -- iteration -----------------------------------------------------------
 
+    def _transfer_plane(self):
+        """The loader's transfer plane, or None when disabled (kill
+        switch, ``transfer=False``, or ``'auto'`` on the CPU backend).
+        Built once; shares the loader's registry and trace recorder so
+        its ``h2d_*`` histograms and ``h2d/*`` spans land on the same
+        surfaces as every other stage."""
+        from petastorm_tpu.jax import transfer
+        if not transfer.plane_enabled(self._transfer):
+            return None
+        if self._plane is None:
+            ring = (self._ring_slots if self._ring_slots is not None
+                    else self._prefetch + 1)
+            self._plane = transfer.TransferPlane(
+                device=self._device, sharding=self._sharding,
+                wire_dtypes=self._wire_dtypes, ring_slots=ring,
+                metrics=self.metrics, trace_recorder=self._trace)
+        return self._plane
+
+    def _sample_commit(self, dev, every=32):
+        """Periodic true-completion sample for the INLINE path: 1-in-
+        ``every`` device_puts additionally waits for the transfer to
+        land, feeding the ``h2d_commit`` histogram (the plane path
+        observes commits on every ring-slot reuse instead)."""
+        self._commit_probe += 1
+        if (self._commit_probe - 1) % every:
+            return
+        t0 = time.monotonic()
+        jax.block_until_ready(dev)
+        t1 = time.monotonic()
+        self._m_commit.observe(t1 - t0)
+        if self._trace is not None:
+            self._trace.event('h2d/commit', t0, t1, kind='sample')
+
     def __iter__(self):
+        plane = self._transfer_plane()
+        if plane is not None:
+            if self._pump is not None and self._pump.alive:
+                # A previous iteration's dispatch thread is still winding
+                # down (a pull parked in the reader): never share a ring
+                # with it — a fresh plane gets fresh slabs.
+                self._plane = None
+                plane = self._transfer_plane()
+            return self._iter_pumped(plane)
+        return self._iter_inline()
+
+    def _iter_pumped(self, plane):
+        """Transfer-plane iteration: a background dispatch thread pulls
+        host batches, transforms, and ring-transfers them, so host
+        staging, the H2D link, and the device step overlap as three
+        pipeline stages.  Batch order, values, accounting surfaces and
+        the exact-resume contract are identical to the inline path."""
+        from jax.profiler import TraceAnnotation
+
+        from petastorm_tpu.jax.transfer import _DONE, DispatchPump
+
+        restored = []
+        if self._resume_state and self._resume_state.get('pending'):
+            restored = [self._to_device(b)
+                        for b in self._resume_state['pending']]
+            self._resume_state = dict(self._resume_state, pending=[])
+
+        def annotated_pulls(gen):
+            # Same pt/* jax.profiler spans as the inline path (SURVEY
+            # §5.1) — they land on the dispatch thread's track, which is
+            # exactly where this pipeline stage now runs.
+            while True:
+                with TraceAnnotation('pt/host_batch'):
+                    try:
+                        item = next(gen)
+                    except StopIteration:
+                        return
+                yield item
+
+        def ship(host_batch):
+            t1 = time.monotonic()
+            if self._transform_fn is not None:
+                with TraceAnnotation('pt/transform'):
+                    host_batch = self._transform_fn(host_batch)
+            t2 = time.monotonic()
+            with TraceAnnotation('pt/device_put'):
+                dev = plane.put(
+                    _filter_numeric(host_batch, self._warned_fields))
+                degraded = dev is None
+                if degraded:   # structure degrades: the existing path
+                    dev = self._to_device(host_batch)
+            t3 = time.monotonic()
+            self._observe('transform', t1, t2)
+            # Counter/histogram continuity: device_put_s covers the whole
+            # put (stage + dispatch + any ring commit wait) on this path.
+            self._observe('device_put', t2, t3)
+            self._m_batches.inc()
+            if self._trace is not None:
+                n = int(self._m_batches.value)
+                if self._transform_fn is not None:
+                    self._trace.event('transform', t1, t2, batch=n)
+                if degraded:
+                    # Only the inline fallback records the generic
+                    # 'device_put' SPAN: a plane-handled batch already
+                    # emitted h2d/stage + h2d/dispatch (+ h2d/commit)
+                    # inside this window, and a wrapper span here would
+                    # fold staging time into the 'h2d' link component —
+                    # h2d >= h2d_stage by construction — so stall
+                    # attribution could never name staging as top.
+                    self._trace.event('device_put', t2, t3, batch=n)
+            return dev
+
+        pump = DispatchPump(
+            annotated_pulls(self._timed_pulls(self._echoed_host_batches())),
+            ship, self._prefetch)
+        for dev in restored:
+            pump.pending.append(dev)
+        self._pending = pump.pending
+        self._pump = pump
+        pump.start()
+        try:
+            while True:
+                item = pump.get()
+                if item is _DONE:
+                    break
+                yield item
+        finally:
+            # Keep self._pump referencing this (now stopping) pump:
+            # __exit__'s plane-close guard must still see a thread that
+            # outlived the bounded join below, and a paused/`state_dict`
+            # call on a finished pump returns immediately.  The short
+            # join keeps early `break`s cheap — a thread parked in a
+            # slow reader pull is released by reader.stop() in __exit__.
+            pump.stop(join_timeout_s=0.2)
+            if not pump.alive:
+                # Draining the ring under a still-shipping thread
+                # (bounded join timed out on a slow/wedged backend)
+                # would race _wait_slot/put, and block_until_ready
+                # could hang this generator close.
+                plane.drain()
+
+    def _iter_inline(self):
         # TraceAnnotation spans make the data pipeline visible in
         # ``jax.profiler`` device traces (SURVEY.md §5.1): when a step
         # stalls, the trace shows whether the time went to the decode
@@ -185,6 +365,7 @@ class DataLoader(object):
                 if self._transform_fn is not None:
                     self._trace.event('transform', t1, t2, batch=n)
                 self._trace.event('device_put', t2, t3, batch=n)
+            self._sample_commit(pending[-1])
             if len(pending) > self._prefetch:
                 yield pending.popleft()
         while pending:
@@ -508,6 +689,11 @@ class DataLoader(object):
             raise ValueError('steps_per_call must be >= 1')
         fn = jax.jit(lambda c, xs: lax.scan(step_fn, c, xs),
                      donate_argnums=(0,) if donate_carry else ())
+        # The stacked chunk rides the transfer plane too (one coalesced
+        # ring transfer per k-step chunk); the sharded scan spec shards
+        # axis 1, not the leading axis, so it keeps the existing
+        # assembly path.
+        plane = self._transfer_plane() if self._sharding is None else None
 
         def put_stacked(chunk, transformed=False):
             # Same per-stage stats accounting as __iter__ (transform /
@@ -519,22 +705,35 @@ class DataLoader(object):
             t1 = time.monotonic()
             stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *chunk)
             numeric = _filter_numeric(stacked, self._warned_fields)
+            out = None
             if self._sharding is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
                 spec = PartitionSpec(None, *self._sharding.spec)
                 out = global_batch_from_local(
                     numeric, NamedSharding(self._sharding.mesh, spec))
-            elif self._device is not None:
-                out = jax.device_put(numeric, self._device)
-            else:
-                out = jax.device_put(numeric)
+            elif plane is not None:
+                out = plane.put(numeric)   # None: degrade to inline below
+            planed = out is not None and plane is not None \
+                and self._sharding is None
+            if out is None:
+                if self._device is not None:
+                    out = jax.device_put(numeric, self._device)
+                else:
+                    out = jax.device_put(numeric)
             t2 = time.monotonic()
             self._observe('transform', t0, t1)
             self._observe('device_put', t1, t2)
             if self._trace is not None:
                 if self._transform_fn is not None and not transformed:
                     self._trace.event('transform', t0, t1, chunk=len(chunk))
-                self._trace.event('device_put', t1, t2, chunk=len(chunk))
+                if not planed:
+                    # Plane-handled chunks already emitted h2d/* spans in
+                    # this window; a wrapper 'device_put' span would fold
+                    # staging into the link component (see ship()).
+                    self._trace.event('device_put', t1, t2,
+                                      chunk=len(chunk))
+            if not planed:
+                self._sample_commit(out, every=4)
             return out
 
         def rows_of(batch):
@@ -596,7 +795,34 @@ class DataLoader(object):
         checkpoint-then-keep-training works.  The state is picklable
         (plain dicts/numpy); pair it with the model state in orbax via
         ``ocp.args.Pickle`` or bytes.
+
+        With the transfer plane on, the background dispatch pump is
+        paused first (it otherwise advances the shuffle/chunk buffers
+        this snapshot reads) and every in-flight ring batch is already
+        in ``pending`` by the time the pump is quiescent — the snapshot
+        drains the ring by construction.
         """
+        with self._pump_paused():
+            return self._state_dict_quiesced()
+
+    @contextmanager
+    def _pump_paused(self):
+        """Freeze the dispatch pump (when one is live) around a state
+        snapshot.  EVERY ``state_dict`` in the loader family must read
+        loader buffers under this bracket — outside it the dispatch
+        thread races the shuffle/chunk/packer state being snapshotted.
+        Counting pause, so brackets nest (PackedDataLoader wraps the
+        base snapshot plus its packer residue in one outer bracket)."""
+        pump = self._pump
+        if pump is not None:
+            pump.pause()
+        try:
+            yield
+        finally:
+            if pump is not None:
+                pump.resume()
+
+    def _state_dict_quiesced(self):
         drained = self.reader.drain_in_flight()
         if not self._batched_input:
             drained = [_row_as_dict(r) for r in drained]
@@ -659,9 +885,23 @@ class DataLoader(object):
         return self
 
     def __exit__(self, exc_type, exc_value, tb):
+        pump = self._pump
+        if pump is not None:
+            # Ask the dispatch thread out first; a pull blocked inside
+            # the reader is released by reader.stop() below, after which
+            # the (daemonic) thread exits without shipping.
+            pump.stop(join_timeout_s=0.5)
         if self.reader is not None:   # DiskCachedDataLoader allows None
             self.reader.stop()
             self.reader.join()
+        if pump is not None:
+            pump.join()
+        if self._plane is not None and (pump is None or not pump.alive):
+            # Only reclaim the slabs once the dispatch thread is truly
+            # out — closing under a still-shipping thread (wedged
+            # backend) would race the ring; the slabs are plain numpy
+            # arrays and fall to the GC with the loader either way.
+            self._plane.close()
 
 
 def _row_as_dict(row):
@@ -884,6 +1124,10 @@ class InMemDataLoader(DataLoader):
         if self._im is None:
             raise ValueError('state_dict() is supported once iteration has '
                              'begun; call it between batches')
+        with self._pump_paused():
+            return self._inmem_state()
+
+    def _inmem_state(self):
         im = self._im
         return {
             'version': 1,
@@ -995,9 +1239,16 @@ class DeviceInMemDataLoader(InMemDataLoader):
             if self._build_cache() is None:
                 return None
             numeric = _filter_numeric(self._cache, self._warned_fields)
-            place = (lambda x: jax.device_put(x, self._device)) \
-                if self._device is not None else jax.device_put
-            self._dev_cache = jax.tree_util.tree_map(place, numeric)
+            # Transfer plane (one coalesced put for the whole cache, a
+            # transient staging slab); oversized/unsupported caches fall
+            # back to the per-leaf puts below.
+            plane = self._transfer_plane()
+            placed = plane.put_once(numeric) if plane is not None else None
+            if placed is None:
+                place = (lambda x: jax.device_put(x, self._device)) \
+                    if self._device is not None else jax.device_put
+                placed = jax.tree_util.tree_map(place, numeric)
+            self._dev_cache = placed
             # The host copy is never read again — release dataset-sized RAM.
             self._cache = None
         return self._dev_cache
@@ -1494,6 +1745,10 @@ class DiskCachedDataLoader(DataLoader):
                 'state_dict() is supported once the decoded cache is '
                 'complete (from epoch 1 on); during the epoch-0 build, '
                 'checkpoint at the epoch boundary instead')
+        with self._pump_paused():
+            return self._disk_cache_state()
+
+    def _disk_cache_state(self):
         dc = self._dc
         return {
             'version': 1,
@@ -1576,16 +1831,24 @@ class PackedDataLoader(DataLoader):
     def state_dict(self):
         """Exact packed snapshot: DataLoader state + the packer residue
         (open rows, closed rows, sticky dtype) + ready-but-unyielded
-        batches."""
-        state = super().state_dict()
-        rs = self._resume_state or {}
-        if self._packer is not None:   # iteration started
-            state['packer'] = self._packer.state_dict()
-            state['packed_ready'] = list(self._packed_ready)
-        else:                          # restored but not yet iterated
-            state['packer'] = rs.get('packer')
-            state['packed_ready'] = list(rs.get('packed_ready', []))
-        return state
+        batches.
+
+        The pump stays paused across BOTH reads (the base snapshot and
+        the packer residue): ``_pump_paused`` counts, so the nested
+        pause inside ``super().state_dict()`` composes — resuming
+        between the two would let the dispatch thread pack
+        just-snapshotted pushback rows into the packer and duplicate
+        them in the token."""
+        with self._pump_paused():
+            state = super().state_dict()
+            rs = self._resume_state or {}
+            if self._packer is not None:   # iteration started
+                state['packer'] = self._packer.state_dict()
+                state['packed_ready'] = list(self._packed_ready)
+            else:                          # restored but not yet iterated
+                state['packer'] = rs.get('packer')
+                state['packed_ready'] = list(rs.get('packed_ready', []))
+            return state
 
 
 def make_jax_loader(dataset_url, batch_size, batched=True, loader_kwargs=None, **reader_kwargs):

@@ -1,0 +1,689 @@
+"""Pipelined host→device transfer plane (ISSUE 6).
+
+BENCH_TPU_LAST showed the link, not the data plane, as the frontier:
+``stall_pct_streaming`` ≈ 96% while ``hbm_scan`` sits at 5.4% — once
+batches are in HBM the framework is nearly stall-free, so everything
+between host memory and HBM must be hidden, not paid inline.  This
+module makes the transfer a first-class pipeline stage:
+
+* **Ring-buffered staging** — a fixed ring of reused host staging slabs
+  (reuse matters: first-touch page faults cost ~20x the memcpy on the
+  virtualized bench kernel).  A slot is rewritten only after the batch
+  it last carried is committed on device (``jax.block_until_ready`` on
+  slot reuse), so with ``ring_slots`` slots up to ``ring_slots - 1``
+  transfers are in flight while the step runs — batch N+1's DMA
+  overlaps batch N's compute.  The device-side slab is donated into the
+  unpack executable (off the CPU backend, where donation is a no-op),
+  so steady-state transfer recycles buffers instead of allocating.
+* **Transfer coalescing** — the many small per-column arrays of a batch
+  are packed into ONE C-contiguous staging slab per step: one
+  ``device_put`` instead of one per column, then a jitted on-device
+  unpack slices/bitcasts the slab back into the pytree.  The win is the
+  per-dispatch fixed cost (python + transport round-trip per put), which
+  dominates for wide-table batches.
+* **Wire-dtype narrowing** — opt-in (``wire_dtypes='auto'`` or a
+  ``{field: dtype}`` map): float32/float64 leaves travel as bfloat16
+  and are cast back inside the jitted unpack, halving/quartering
+  bytes-on-wire.  uint8 images already travel at their natural width
+  and pass through bit-exact.  Without the opt-in every leaf travels at
+  its canonical width and the result is bit-identical to
+  ``jax.device_put``.
+* **Sharded parallel transfer** — with a ``sharding`` whose spec shards
+  only the leading (batch) axis, per-device slices of the staging batch
+  are dispatched concurrently (one ``device_put`` per device — the DMAs
+  overlap) and reassembled with
+  ``jax.make_array_from_single_device_arrays`` instead of funneling the
+  whole global batch through one host-thread call.
+
+**Degrade matrix** (the plane NEVER changes delivered values; every
+fallback is the existing inline path, bit-identical):
+
+=====================================  =====================================
+condition                              behaviour
+=====================================  =====================================
+``PETASTORM_TPU_NO_TRANSFER_PLANE=1``  plane off (inline ``device_put``)
+``transfer='auto'`` on the CPU         plane off — the "link" is a memcpy
+backend                                and the staging pass buys nothing
+unsupported leaf dtype (datetime64,    that batch structure degrades to the
+strings already filtered upstream)     inline path (``h2d_degraded`` counts)
+single already-full-width leaf         inline path (coalescing is a no-op
+                                       and the staging copy isn't free)
+staging slab over the cap              inline path (a slab is a second host
+(``PETASTORM_TPU_TRANSFER_MAX_        copy of the batch)
+STAGING_MB``, default 512)
+sharding not leading-axis /            ``global_batch_from_local`` as today
+multi-host
+=====================================  =====================================
+
+Telemetry (ISSUE 5 plane): every transfer records ``h2d/stage`` (host
+pack), ``h2d/dispatch`` (async put + unpack dispatch) and ``h2d/commit``
+(observed wait for true transfer completion: ring-slot reuse waits, plus
+a periodic 1-in-32 full sample) spans into the loader's
+``TraceRecorder``, and the same stages into ``h2d_stage`` /
+``h2d_dispatch`` / ``h2d_commit`` histograms on the loader's metrics
+registry — ``attribute_stalls`` can now split staging-copy time from
+link time (components ``h2d_stage`` vs ``h2d``).
+"""
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+__all__ = ['TransferPlane', 'DispatchPump', 'plane_enabled', 'KILL_SWITCH']
+
+#: Environment kill switch: set to any non-empty value to force every
+#: loader onto the inline ``device_put`` path regardless of ``transfer=``.
+KILL_SWITCH = 'PETASTORM_TPU_NO_TRANSFER_PLANE'
+
+#: Staging slabs above this bound degrade to the inline path — a slab is
+#: a second host-side copy of the batch, and a whole-dataset transfer
+#: (DeviceInMemDataLoader._materialize) must not double host RAM.
+MAX_STAGING_BYTES = int(os.environ.get(
+    'PETASTORM_TPU_TRANSFER_MAX_STAGING_MB', '512')) << 20
+
+#: Per-field slab alignment: keeps every wire-dtype view aligned and the
+#: per-device segments cache-line separated.
+_ALIGN = 64
+
+#: 1-in-N full commit sample (dispatch → device-ready wall time); ring
+#: reuse additionally observes the *residual* commit wait on every slot.
+_COMMIT_SAMPLE_EVERY = 32
+
+_BF16 = np.dtype(jnp.bfloat16)
+
+
+#: Accepted ``transfer=`` values — ONE place, validated both at loader
+#: construction (fail fast) and in :func:`plane_enabled` (direct users).
+_TRANSFER_MODES = (True, False, None, 'auto')
+
+
+def validate_transfer(transfer):
+    """Strict on purpose: 'off'/'false'/'disabled' from a config parse
+    are truthy and would silently ENABLE the plane under a
+    fall-through-to-auto reading."""
+    if transfer not in _TRANSFER_MODES:
+        raise ValueError("transfer must be True, False, None, or 'auto' "
+                         '(got %r)' % (transfer,))
+
+
+def plane_enabled(transfer):
+    """Resolve a loader's ``transfer=`` kwarg against the environment.
+
+    ``False``/``None`` → off; ``True`` → on (tests force the plane on the
+    CPU backend this way); ``'auto'`` → on only when an accelerator
+    backend is live — on the CPU fallback the "link" is a memcpy and the
+    extra staging pass buys nothing (measured: bench.py
+    ``transfer_plane`` leg).  The kill switch wins over everything.
+    """
+    validate_transfer(transfer)
+    if os.environ.get(KILL_SWITCH):
+        return False
+    if transfer is True:
+        return True
+    if not transfer:
+        return False
+    try:
+        return jax.default_backend() != 'cpu'
+    except Exception:  # noqa: BLE001 — no backend at all: nothing to feed
+        return False
+
+
+def _supported(dtype):
+    """Wire-packable dtypes: fixed-width bool/int/uint/float (bfloat16
+    included).  datetime64/timedelta64/object/str degrade."""
+    return dtype.kind in 'biuf' or dtype == _BF16
+
+
+def _leaf_name(path):
+    """Last path component name ('image' from "['image']") — the key the
+    ``wire_dtypes`` dict matches on."""
+    last = path[-1]
+    key = getattr(last, 'key', None)
+    if key is None:
+        key = getattr(last, 'name', None)
+    if key is None:
+        key = getattr(last, 'idx', None)
+    return str(key)
+
+
+def _resolve_wire(name, out_dtype, policy):
+    """Wire dtype for one leaf: the canonical dtype unchanged (exact), or
+    the policy's narrowed dtype.  ``'auto'`` narrows >=32-bit floats to
+    bfloat16; a dict names fields explicitly (absent fields stay exact).
+    """
+    if not policy:
+        return out_dtype
+    if policy == 'auto':
+        if out_dtype.kind == 'f' and out_dtype.itemsize >= 4:
+            return _BF16
+        return out_dtype
+    want = policy.get(name)
+    return np.dtype(want) if want is not None else out_dtype
+
+
+class _Unsupported(Exception):
+    """This batch structure cannot ride the plane; fall back inline."""
+
+
+class _Field(object):
+    __slots__ = ('offset', 'nbytes', 'wire', 'out', 'shape')
+
+    def __init__(self, offset, nbytes, wire, out, shape):
+        self.offset = offset
+        self.nbytes = nbytes
+        self.wire = wire
+        self.out = out
+        self.shape = shape
+
+
+def _align(n):
+    return -(-n // _ALIGN) * _ALIGN
+
+
+def _signature(tree):
+    """Cheap per-batch structure key: path + shape + source dtype per
+    leaf.  Layouts, unpack executables and shard plans cache under it."""
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return tuple((jax.tree_util.keystr(path), np.asarray(leaf).shape,
+                  np.asarray(leaf).dtype.str) for path, leaf in paths)
+
+
+class _Layout(object):
+    """Static packing plan for one batch structure: per-leaf slab offset,
+    wire dtype (narrowed or canonical) and on-device output dtype.  The
+    output dtype is ``jax.dtypes.canonicalize_dtype`` of the source —
+    exactly what ``jax.device_put`` itself would deliver (int64 → int32
+    under default x64-disabled JAX), so the no-narrowing plane output is
+    bit-identical to the inline path."""
+
+    def __init__(self, tree, policy):
+        paths, self.treedef = jax.tree_util.tree_flatten_with_path(tree)
+        if not paths:
+            raise _Unsupported('empty pytree')
+        self.fields = []
+        offset = 0
+        logical = 0
+        for path, leaf in paths:
+            arr = np.asarray(leaf)
+            if arr.size == 0:
+                raise _Unsupported('zero-size leaf %s'
+                                   % jax.tree_util.keystr(path))
+            if not _supported(arr.dtype):
+                raise _Unsupported('leaf %s dtype %s is not wire-packable'
+                                   % (jax.tree_util.keystr(path), arr.dtype))
+            out = np.dtype(jax.dtypes.canonicalize_dtype(arr.dtype))
+            wire = np.dtype(_resolve_wire(_leaf_name(path), out, policy))
+            if not _supported(wire):
+                raise _Unsupported('wire dtype %s for leaf %s is not '
+                                   'packable'
+                                   % (wire, jax.tree_util.keystr(path)))
+            offset = _align(offset)
+            nbytes = arr.size * wire.itemsize
+            self.fields.append(_Field(offset, nbytes, wire, out, arr.shape))
+            offset += nbytes
+            logical += arr.size * out.itemsize
+        self.slab_nbytes = offset
+        self.logical_nbytes = logical
+        if len(self.fields) == 1 and self.fields[0].wire == self.fields[0].out:
+            # One full-width leaf: coalescing is a no-op and the staging
+            # memcpy is pure cost — the inline put is already one dispatch.
+            raise _Unsupported('single full-width leaf')
+
+    def pack(self, tree, slab):
+        """One cast-or-copy pass per leaf into the staging slab (numpy
+        assignment casts unsafely — the same canonicalization/narrowing
+        semantics the unpack side expects)."""
+        for field, leaf in zip(self.fields, jax.tree_util.tree_leaves(tree)):
+            dst = slab[field.offset:field.offset + field.nbytes]
+            dst.view(field.wire)[...] = np.asarray(leaf).reshape(-1)
+
+    def build_unpack(self):
+        """The on-device inverse: slice each leaf's bytes out of the slab,
+        bitcast to the wire dtype, reshape, and cast back to the output
+        dtype when the wire was narrowed.  Jitted by the plane, so the
+        whole batch materializes in ONE executable."""
+        fields = list(self.fields)
+        treedef = self.treedef
+
+        def unpack(slab):
+            leaves = []
+            for f in fields:
+                seg = slab[f.offset:f.offset + f.nbytes]
+                if f.wire == np.uint8:
+                    arr = seg
+                elif f.wire.kind == 'b':
+                    arr = seg.astype(jnp.bool_)
+                elif f.wire.itemsize == 1:
+                    arr = jax.lax.bitcast_convert_type(seg, jnp.dtype(f.wire))
+                else:
+                    arr = jax.lax.bitcast_convert_type(
+                        seg.reshape(-1, f.wire.itemsize), jnp.dtype(f.wire))
+                arr = arr.reshape(f.shape)
+                if f.wire != f.out:
+                    arr = arr.astype(jnp.dtype(f.out))
+                leaves.append(arr)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        return unpack
+
+
+def _slab_bytes(prepared):
+    """Host staging bytes a prepared (layout, unpack, plan) needs."""
+    layout, _, plan = prepared
+    return layout.slab_nbytes if plan is None else plan.total_nbytes
+
+
+class _ShardPlan(object):
+    """Per-device split of one layout: unique leading-axis row ranges (a
+    replicated mesh axis maps several devices to one range), the
+    per-shard sub-layout, and the device order the reassembly uses."""
+
+    __slots__ = ('devices', 'ranges', 'uniq', 'seg_offsets', 'shard_layout',
+                 'total_nbytes')
+
+    def __init__(self, devices, ranges, uniq, seg_offsets, shard_layout,
+                 total_nbytes):
+        self.devices = devices
+        self.ranges = ranges
+        self.uniq = uniq
+        self.seg_offsets = seg_offsets
+        self.shard_layout = shard_layout
+        self.total_nbytes = total_nbytes
+
+
+class TransferPlane(object):
+    """Coalescing, narrowing, ring-buffered host→device transfer.
+
+    ``put`` returns the device pytree — or ``None`` when this batch
+    structure degrades, in which case the caller runs its existing
+    inline path (the plane never guesses; the fallback is the code that
+    was already correct).  One plane instance serves one loader: the
+    ring slabs, layout caches and unpack executables are all keyed by
+    batch structure and reused across steps.
+    """
+
+    def __init__(self, device=None, sharding=None, wire_dtypes=None,
+                 ring_slots=3, metrics=None, trace_recorder=None,
+                 max_staging_bytes=None):
+        if wire_dtypes not in (None, 'auto') \
+                and not isinstance(wire_dtypes, dict):
+            raise ValueError("wire_dtypes must be None, 'auto', or a "
+                             '{field: dtype} dict (got %r)' % (wire_dtypes,))
+        self._device = device
+        self._sharding = sharding
+        self._policy = wire_dtypes
+        nslots = max(2, int(ring_slots))
+        self._slabs = [None] * nslots
+        self._inflight = [None] * nslots
+        self._turn = 0
+        self._max_staging = (MAX_STAGING_BYTES if max_staging_bytes is None
+                             else int(max_staging_bytes))
+        self._prepared = {}   # signature -> (layout, unpack, plan) | None
+        self._trace = trace_recorder
+        if metrics is None:
+            from petastorm_tpu.telemetry import MetricsRegistry
+            metrics = MetricsRegistry('transfer')
+        self.metrics = metrics
+        self._m_batches = metrics.counter('h2d_batches')
+        self._m_degraded = metrics.counter('h2d_degraded')
+        self._m_wire = metrics.counter('h2d_bytes_wire')
+        self._m_logical = metrics.counter('h2d_bytes_logical')
+        self._h_stage = metrics.histogram('h2d_stage')
+        self._h_dispatch = metrics.histogram('h2d_dispatch')
+        self._h_commit = metrics.histogram('h2d_commit')
+        # Donation recycles the device-side slab buffer into the unpack
+        # outputs; on the CPU backend it is a no-op that only warns.
+        try:
+            self._donate = jax.default_backend() != 'cpu'
+        except Exception:  # noqa: BLE001 — resolved again at first put
+            self._donate = False
+
+    # -- public API ----------------------------------------------------------
+
+    def put(self, tree):
+        """Ring-buffered coalesced transfer of one batch pytree; returns
+        the device pytree, or None when the structure degrades."""
+        prepared = self._prepare(tree)
+        if prepared is None:
+            self._m_degraded.inc()
+            return None
+        slot = self._turn % len(self._slabs)
+        self._turn += 1
+        self._wait_slot(slot)
+        slab = self._slot_slab(slot, _slab_bytes(prepared))
+        batch = self._staged_put(prepared, tree, slab)
+        self._inflight[slot] = batch
+        return batch
+
+    def put_once(self, tree):
+        """One-shot coalesced transfer outside the ring (whole-dataset
+        placement: ``DeviceInMemDataLoader._materialize``).  The
+        transient slab is released immediately after the dispatch."""
+        prepared = self._prepare(tree)
+        if prepared is None:
+            self._m_degraded.inc()
+            return None
+        slab = np.empty(_slab_bytes(prepared), np.uint8)
+        return self._staged_put(prepared, tree, slab, sample_commit=False)
+
+    def _staged_put(self, prepared, tree, slab, sample_commit=True):
+        """Pack → dispatch → on-device unpack + accounting — the shared
+        core of ``put`` (ring slab) and ``put_once`` (transient slab)."""
+        layout, unpack, plan = prepared
+        t0 = time.monotonic()
+        if plan is None:
+            layout.pack(tree, slab)
+            t1 = time.monotonic()
+            dev_slab = (jax.device_put(slab, self._device)
+                        if self._device is not None else jax.device_put(slab))
+            batch = unpack(dev_slab)
+            wire = layout.slab_nbytes
+        else:
+            t1, batch = self._put_sharded(layout, unpack, plan, tree, slab)
+            # One device_put PER DEVICE: a replicated mesh axis ships the
+            # same segment to every replica, and those bytes are on the
+            # link too.
+            wire = plan.shard_layout.slab_nbytes * len(plan.devices)
+        t2 = time.monotonic()
+        self._account(layout, batch, wire, t0, t1, t2,
+                      sample_commit=sample_commit)
+        return batch
+
+    def drain(self):
+        """Block until every in-flight ring transfer is committed (the
+        checkpoint / teardown quiesce); host slabs stay for reuse."""
+        for i, batch in enumerate(self._inflight):
+            if batch is not None:
+                jax.block_until_ready(batch)
+                self._inflight[i] = None
+
+    def close(self):
+        """Drain the ring and release the staging slabs."""
+        self.drain()
+        self._slabs = [None] * len(self._slabs)
+
+    # -- ring ----------------------------------------------------------------
+
+    def _wait_slot(self, slot):
+        """Commit barrier for slab reuse: the batch this slot last staged
+        must be device-resident before the slab is rewritten (the H2D
+        copy reads the host slab asynchronously).  The observed wait is
+        the ring's view of true link time → ``h2d/commit``."""
+        batch = self._inflight[slot]
+        if batch is None:
+            return
+        t0 = time.monotonic()
+        jax.block_until_ready(batch)
+        t1 = time.monotonic()
+        self._inflight[slot] = None
+        self._h_commit.observe(t1 - t0)
+        if self._trace is not None:
+            self._trace.event('h2d/commit', t0, t1, kind='ring')
+
+    def _slot_slab(self, slot, nbytes):
+        slab = self._slabs[slot]
+        if slab is None or slab.nbytes < nbytes:
+            slab = self._slabs[slot] = np.empty(nbytes, np.uint8)
+        return slab[:nbytes]
+
+    def _account(self, layout, batch, wire_bytes, t0, t1, t2,
+                 sample_commit=True):
+        self._m_batches.inc()
+        self._m_wire.inc(wire_bytes)
+        self._m_logical.inc(layout.logical_nbytes)
+        self._h_stage.observe(t1 - t0)
+        self._h_dispatch.observe(t2 - t1)
+        if self._trace is not None:
+            self._trace.event('h2d/stage', t0, t1)
+            self._trace.event('h2d/dispatch', t1, t2)
+        if sample_commit \
+                and int(self._m_batches.value) % _COMMIT_SAMPLE_EVERY == 1:
+            # Periodic FULL commit sample: dispatch → device-ready wall
+            # time of the batch just put (the ring wait in _wait_slot
+            # only ever sees the residual after a full lap of overlap).
+            t3 = time.monotonic()
+            jax.block_until_ready(batch)
+            t4 = time.monotonic()
+            self._h_commit.observe(t4 - t3)
+            if self._trace is not None:
+                self._trace.event('h2d/commit', t3, t4, kind='sample')
+
+    # -- layout / plan cache -------------------------------------------------
+
+    def _prepare(self, tree):
+        sig = _signature(tree)
+        if sig in self._prepared:
+            return self._prepared[sig]
+        try:
+            layout = _Layout(tree, self._policy)
+            plan = None
+            if self._sharding is not None:
+                plan = self._plan_shards(tree)
+                total = plan.total_nbytes
+            else:
+                total = layout.slab_nbytes
+            if total > self._max_staging:
+                raise _Unsupported('staging slab %d B exceeds the %d B cap'
+                                   % (total, self._max_staging))
+            unpack = jax.jit((layout if plan is None
+                              else plan.shard_layout).build_unpack(),
+                             donate_argnums=(0,) if self._donate else ())
+            prepared = (layout, unpack, plan)
+        except _Unsupported as e:
+            logger.debug('transfer plane degrades for this batch '
+                         'structure: %s', e)
+            prepared = None
+        self._prepared[sig] = prepared
+        return prepared
+
+    # -- sharded parallel transfer -------------------------------------------
+
+    def _plan_shards(self, tree):
+        """Validate that the sharding splits only the leading axis of
+        every leaf (replication over other mesh axes allowed) and build
+        the per-device packing plan.  Anything else degrades to
+        ``global_batch_from_local``."""
+        sharding = self._sharding
+        if jax.process_count() != 1:
+            raise _Unsupported('multi-host sharding assembles via '
+                               'make_array_from_process_local_data')
+        leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+        ref_ranges = None
+        for arr in leaves:
+            if arr.ndim == 0:
+                raise _Unsupported('scalar leaf cannot shard a batch axis')
+            try:
+                index_map = sharding.addressable_devices_indices_map(
+                    arr.shape)
+            except Exception as e:  # noqa: BLE001 — e.g. indivisible dim
+                raise _Unsupported('sharding rejects leaf shape %s: %s'
+                                   % (arr.shape, e))
+            ranges = {}
+            for dev, idx in index_map.items():
+                idx = idx if isinstance(idx, tuple) else (idx,)
+                start, stop, step = (idx[0] if idx else slice(None)) \
+                    .indices(arr.shape[0])
+                if step != 1:
+                    raise _Unsupported('strided shard index')
+                for dim, sub in zip(arr.shape[1:], idx[1:]):
+                    lo, hi, st = sub.indices(dim)
+                    if (lo, hi, st) != (0, dim, 1):
+                        raise _Unsupported('sharding splits a non-leading '
+                                           'axis')
+                ranges[dev] = (start, stop)
+            if ref_ranges is None:
+                ref_ranges = ranges
+            elif ranges != ref_ranges:
+                raise _Unsupported('leaves shard to different row ranges')
+        uniq = sorted(set(ref_ranges.values()))
+        rows = {stop - start for start, stop in uniq}
+        if len(rows) != 1 or 0 in rows:
+            raise _Unsupported('unequal shard row counts')
+        rows = rows.pop()
+        devices = sorted(ref_ranges, key=lambda d: (ref_ranges[d][0], d.id))
+        shard_tree = jax.tree_util.tree_map(
+            lambda v: np.asarray(v)[:rows], tree)
+        shard_layout = _Layout(shard_tree, self._policy)
+        stride = _align(shard_layout.slab_nbytes)
+        seg_offsets = {rng: i * stride for i, rng in enumerate(uniq)}
+        return _ShardPlan(devices, ref_ranges, uniq, seg_offsets,
+                          shard_layout, stride * len(uniq))
+
+    def _put_sharded(self, layout, unpack, plan, tree, slab):
+        """Pack each unique row range once, dispatch every device's slice
+        concurrently (async ``device_put`` per device — the DMAs
+        overlap), unpack on-device per shard, and reassemble each leaf
+        as one global array."""
+        nbytes = plan.shard_layout.slab_nbytes
+        for start, stop in plan.uniq:
+            seg = slab[plan.seg_offsets[(start, stop)]:]
+            plan.shard_layout.pack(
+                jax.tree_util.tree_map(
+                    lambda v: np.asarray(v)[start:stop], tree),
+                seg[:nbytes])
+        t1 = time.monotonic()
+        shards = {}
+        for dev in plan.devices:   # all dispatches before any unpack
+            off = plan.seg_offsets[plan.ranges[dev]]
+            shards[dev] = jax.device_put(slab[off:off + nbytes], dev)
+        per_dev = [jax.tree_util.tree_leaves(unpack(shards[dev]))
+                   for dev in plan.devices]
+        out_leaves = []
+        for li, field in enumerate(layout.fields):
+            out_leaves.append(jax.make_array_from_single_device_arrays(
+                field.shape, self._sharding,
+                [per_dev[di][li] for di in range(len(plan.devices))]))
+        return t1, jax.tree_util.tree_unflatten(layout.treedef, out_leaves)
+
+
+_DONE = object()
+
+
+class DispatchPump(object):  # ptlint: disable=pickle-unsafe-attrs — the pump lives and dies inside one loader iteration in the consuming process; it is never pickled (resume tokens carry drained host batches, not the pump)
+    """Background H2D dispatch thread: pulls host batches from the
+    loader's (single-consumer) host-batch generator, ships each through
+    the transfer plane, and appends the resulting device batches to the
+    shared ``pending`` deque the loader yields from — so host staging,
+    the link, and the device step run as three overlapped pipeline
+    stages instead of one serial loop.
+
+    Checkpoint contract: ``pause()`` blocks until the thread is
+    quiescent (not touching the generator, the plane, or ``pending``) —
+    ``DataLoader.state_dict`` brackets its snapshot with
+    ``pause()``/``resume()`` so the exact-resume machinery (reader
+    drain, shuffle-buffer snapshot, pending drain) sees a frozen
+    pipeline.  ``stop()`` ends the thread; a pull blocked inside the
+    reader cannot be interrupted mid-call, so the thread is daemonic and
+    exits right after that pull returns (the loader's ``reader.stop()``
+    is what unblocks it during teardown).
+    """
+
+    def __init__(self, source, ship, prefetch):
+        self._source = source
+        self._ship = ship
+        self._cap = max(1, int(prefetch))
+        self.pending = deque()
+        self._cond = threading.Condition()
+        self._idle = False
+        self._pause = 0
+        self._stopped = False
+        self._done = False
+        self._error = None
+        self._thread = threading.Thread(target=self._run,
+                                        name='petastorm-tpu-h2d-dispatch',
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        try:
+            while True:
+                with self._cond:
+                    while (self._pause or len(self.pending) >= self._cap) \
+                            and not self._stopped:
+                        self._idle = True
+                        self._cond.notify_all()
+                        self._cond.wait()
+                    self._idle = False
+                    if self._stopped:
+                        return
+                item = next(self._source)   # outside the lock: may block
+                with self._cond:
+                    if self._stopped:
+                        return
+                dev = self._ship(item)
+                with self._cond:
+                    self.pending.append(dev)
+                    self._cond.notify_all()
+        except StopIteration:
+            pass
+        except BaseException as e:  # noqa: BLE001 — re-raised by get()
+            self._error = e
+        finally:
+            with self._cond:
+                self._done = True
+                self._idle = True
+                self._cond.notify_all()
+
+    def get(self):
+        """Next device batch in stream order; raises the pump's pending
+        error once the buffered batches are served; the module-level
+        ``_DONE`` sentinel ends the stream."""
+        with self._cond:
+            while not self.pending and not self._done:
+                self._cond.wait()
+            if self.pending:
+                item = self.pending.popleft()
+                self._cond.notify_all()
+                return item
+            if self._error is not None:
+                raise self._error
+            return _DONE
+
+    def pause(self):
+        """Checkpoint barrier: returns once the pump thread is parked
+        (or finished) and guaranteed not to advance the generator or
+        mutate ``pending`` until ``resume()``.  Counting, so brackets
+        nest (PackedDataLoader wraps the base snapshot).
+
+        A pull already in progress must complete first — an in-flight
+        ``next()`` cannot be snapshotted consistently — so on a starved
+        source a checkpoint waits out the current batch wait.  That is
+        the same wall-clock position the inline path puts the caller
+        in: without the pump, the consuming thread sits inside
+        ``next(loader)`` for that same stall and cannot call
+        ``state_dict`` at all until it returns."""
+        with self._cond:
+            self._pause += 1
+            self._cond.notify_all()
+            while not (self._idle or self._done):
+                self._cond.wait()
+
+    def resume(self):
+        with self._cond:
+            self._pause = max(0, self._pause - 1)
+            self._cond.notify_all()
+
+    def stop(self, join_timeout_s=2.0):
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(join_timeout_s)
+
+    def join(self, timeout_s=2.0):
+        self._thread.join(timeout_s)
+
+    @property
+    def alive(self):
+        return self._thread.is_alive()

@@ -151,7 +151,14 @@ STALL_COMPONENTS = {
     'decode': ('service/decode_split', 'pool/process'),
     'ipc': ('service/serialize', 'service/shm_publish', 'pool/publish'),
     'cache_fill': ('cache/fill',),
-    'h2d': ('device_put',),
+    # h2d splits into the LINK (async dispatch + observed commit waits —
+    # 'device_put' is the inline loader's dispatch span, 'h2d/dispatch'
+    # and 'h2d/commit' the transfer plane's) vs the host-side STAGING
+    # copy ('h2d/stage': packing columns into the wire slab) — a
+    # staging-bound stall wants fewer/narrower columns, a link-bound
+    # stall wants narrowing/overlap, so the breakdown keeps them apart.
+    'h2d': ('device_put', 'h2d/dispatch', 'h2d/commit'),
+    'h2d_stage': ('h2d/stage',),
 }
 
 #: Wait-wrapper spans: ``service/split_wait`` covers the WHOLE client

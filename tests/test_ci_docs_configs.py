@@ -131,6 +131,27 @@ def test_bench_compact_line_pins_epoch_cache_fields():
                      src), 'epoch_cache_plane_leg missing from the leg table'
 
 
+def test_bench_compact_line_pins_transfer_plane_fields():
+    """The transfer plane's evidence (ISSUE 6): coalesced/narrowed
+    delivered throughput vs the inline device_put baseline, the
+    bytes-on-wire ratio, and the bit-identity check must ride the
+    compact machine line, and the leg must sit in the shared host-leg
+    table so both main() paths run it."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    for field in ('transfer_plane_images_per_sec_inline',
+                  'transfer_plane_images_per_sec_coalesced',
+                  'transfer_plane_images_per_sec_narrowed',
+                  'transfer_plane_coalesced_over_inline',
+                  'transfer_plane_narrowed_over_inline',
+                  'transfer_plane_wire_bytes_ratio',
+                  'transfer_plane_bit_identical'):
+        assert "'%s'" % field in block.group(1), field
+    assert re.search(r"_IPC_PLANE_LEGS = \((?:.|\n)*?transfer_plane_leg",
+                     src), 'transfer_plane_leg missing from the leg table'
+
+
 def test_docs_conf_compiles_and_has_sphinx_settings():
     path = os.path.join(REPO, 'docs', 'conf.py')
     src = open(path).read()

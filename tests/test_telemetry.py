@@ -155,7 +155,8 @@ def test_stall_monitor_report_carries_breakdown(dataset):
             pass
     report = monitor.report()
     assert set(report['stall_breakdown']) == {
-        'lease_wait', 'decode', 'ipc', 'cache_fill', 'h2d', 'other'}
+        'lease_wait', 'decode', 'ipc', 'cache_fill', 'h2d', 'h2d_stage',
+        'other'}
     component, pct = report['stall_top_component'].split(':')
     assert component in report['stall_breakdown']
     assert pct.endswith('%')
@@ -230,7 +231,12 @@ LOADER_ONLY_KEYS = {
     'host_batch_p99_ms',
     'transform_s', 'transform_count', 'transform_p50_ms', 'transform_p99_ms',
     'device_put_s', 'device_put_count', 'device_put_p50_ms',
-    'device_put_p99_ms'}
+    'device_put_p99_ms',
+    # true-transfer-completion samples (ISSUE 6 satellite): device_put_*
+    # times only the async dispatch; h2d_commit is the periodic
+    # block_until_ready sample (and, with the transfer plane on, every
+    # ring-slot reuse wait)
+    'h2d_commit_count', 'h2d_commit_p50_ms', 'h2d_commit_p99_ms'}
 
 CACHE_PLANE_KEYS = {
     'cache_hits', 'cache_misses', 'cache_evictions', 'cache_ram_hits',
