@@ -1759,6 +1759,16 @@ def _emit(result):
         # and file above; the machine line carries only its evidence core.
         compact['last_tpu'] = _last_tpu_compact(compact['last_tpu'])
     print(json.dumps(compact), flush=True)
+    # Perf-trend store (ISSUE 7): every clean completed run appends its
+    # compact line to BENCH_HISTORY.jsonl so `trend.py --check` can gate
+    # future rounds against the recorded trajectory.  AFTER the machine
+    # line — the line is the artifact, the history is memory; degraded
+    # runs (error keys set) are skipped inside append_entry.
+    try:
+        from petastorm_tpu.benchmark import trend
+        trend.append_entry(compact)
+    except Exception:  # noqa: BLE001 — history must never cost the line
+        pass
 
 
 def _certify_into(result, backend_label, unhealthy=None):

@@ -1,0 +1,252 @@
+"""Perf-trend store + regression gate over ``BENCH_HISTORY.jsonl``.
+
+Every completed ``bench.py`` run appends its compact machine line (plus
+a timestamp and round number) to ``BENCH_HISTORY.jsonl`` at the repo
+root — an append-only trajectory of the repo's measured performance
+that, until this module existed, lived only in scattered ``BENCH_r{N}``
+driver captures nothing could gate on.
+
+``--check`` compares a run (by default the newest history entry) against
+the **median of the prior rounds** per tracked field, with a noise band
+sized from the measured run-to-run variance on the bench host
+(BENCH_NOTES: host A/B swings ±30% even at 9 interleaved repeats — a
+tighter band would alarm on weather, a looser one would sleep through a
+real regression).  Only host-plane throughput fields are tracked: they
+are backend-independent (comparable across tpu / cpu-fallback rounds)
+and are the stable perf statements the compact line exists for.
+
+The gate FLIPS ON at history depth: with fewer than
+``MIN_ROUNDS_TO_GATE`` prior rounds carrying a field, the check
+annotates and exits 0 (a 1-round "trend" is a coin flip); from then on
+a tracked field below ``median * (1 - band)`` exits 1.  Rounds that
+recorded an error (``error`` / ``throughput_error`` / ``legs_failed``)
+neither append cleanly nor count as history — a wedged run must not
+drag the median down and mask the next real regression.
+
+Deliberately **stdlib-only and runnable as a bare file**
+(``python petastorm_tpu/benchmark/trend.py --check``): the CI step runs
+it from the checkout before any install, like the lint gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+__all__ = ['append_entry', 'load_history', 'check', 'main',
+           'TRACKED_FIELDS', 'NOISE_BAND', 'MIN_ROUNDS_TO_GATE']
+
+#: Higher-is-better host-plane throughput fields from the compact line.
+#: Scalars only (ipc_bytes_per_s is a dict on the compact line and is
+#: represented here by its delivery-plane consumers instead).
+TRACKED_FIELDS = (
+    'value',
+    'delivery_plane_images_per_sec_host',
+    'delivery_plane_processpool_images_per_sec_host_shm',
+    'delivery_plane_service_images_per_sec_host_w1',
+    'epoch_cache_streaming_warm_images_per_sec',
+    'transfer_plane_images_per_sec_coalesced',
+    'dlrm_host_rows_per_s',
+)
+
+#: Fractional drop below the history median that counts as a regression.
+NOISE_BAND = 0.30
+
+#: Prior rounds a field needs before its check can gate (exit nonzero).
+MIN_ROUNDS_TO_GATE = 3
+
+#: Keys that mark a round as degraded — excluded from history medians.
+_ERROR_KEYS = ('error', 'throughput_error', 'legs_failed',
+               'device_unhealthy')
+
+_DEFAULT_HISTORY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), 'BENCH_HISTORY.jsonl')
+
+
+def history_path(path=None):
+    return path or os.environ.get('PETASTORM_TPU_BENCH_HISTORY',
+                                  _DEFAULT_HISTORY)
+
+
+def load_history(path=None):
+    """Every parseable entry, in file order.  Unparseable lines are
+    skipped (an interrupted append must not wedge every future check)."""
+    entries = []
+    try:
+        with open(history_path(path)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(entry, dict):
+                    entries.append(entry)
+    except OSError:
+        pass
+    return entries
+
+
+def append_entry(compact, path=None):
+    """Append one compact bench line to the history (best-effort: the
+    trend store must never cost the bench artifact).  Degraded rounds
+    (error keys set) are NOT appended — they would poison the medians.
+    Returns the entry on append, None otherwise."""
+    try:
+        if not isinstance(compact, dict) or compact.get('value') is None:
+            return None
+        if any(compact.get(k) for k in _ERROR_KEYS):
+            return None
+        path = history_path(path)
+        entry = dict(compact)
+        entry['ts'] = time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())
+        entry['round'] = len(load_history(path)) + 1
+        with open(path, 'a') as f:
+            f.write(json.dumps(entry, sort_keys=True, default=str) + '\n')
+        return entry
+    except Exception:  # noqa: BLE001 — history is memory, not the artifact
+        return None
+
+
+def _median(values):
+    ordered = sorted(values)
+    mid = len(ordered) // 2
+    if len(ordered) % 2:
+        return float(ordered[mid])
+    return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def check(current=None, history=None, path=None, band=NOISE_BAND,
+          min_rounds=MIN_ROUNDS_TO_GATE):
+    """Compare ``current`` (default: newest history entry) against the
+    median of the prior clean rounds per tracked field.
+
+    Returns a report dict::
+
+        {'rounds': <clean prior rounds>, 'gating': bool, 'band': band,
+         'fields': {name: {'current', 'median', 'floor', 'rounds',
+                           'gating', 'below_floor', 'ok'}},
+         'regressions': [field, ...], 'ok': bool}
+
+    Per-field ``ok`` is gate-aware (a below-floor value on a field whose
+    gate is still off is annotated via ``below_floor`` but stays ok —
+    the tool deliberately waved it through, and must say so
+    consistently in text and JSON).
+    """
+    entries = load_history(path) if history is None else list(history)
+    if current is None:
+        if not entries:
+            return {'rounds': 0, 'gating': False, 'band': band,
+                    'fields': {}, 'regressions': [], 'ok': True,
+                    'note': 'no history yet — run bench.py to record '
+                            'round 1'}
+        current = entries[-1]
+        entries = entries[:-1]
+    clean = [e for e in entries if not any(e.get(k) for k in _ERROR_KEYS)]
+    fields = {}
+    regressions = []
+    for name in TRACKED_FIELDS:
+        value = current.get(name)
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        prior = [e[name] for e in clean
+                 if isinstance(e.get(name), (int, float))
+                 and not isinstance(e.get(name), bool)]
+        if not prior:
+            fields[name] = {'current': value, 'median': None, 'floor': None,
+                            'rounds': 0, 'gating': False,
+                            'below_floor': False, 'ok': True}
+            continue
+        median = _median(prior)
+        floor = median * (1.0 - band)
+        gating = len(prior) >= min_rounds
+        below = value < floor
+        ok = (not gating) or not below
+        fields[name] = {'current': value, 'median': round(median, 3),
+                        'floor': round(floor, 3), 'rounds': len(prior),
+                        'gating': gating, 'below_floor': below, 'ok': ok}
+        if not ok:
+            regressions.append(name)
+    gating = any(f['gating'] for f in fields.values())
+    return {'rounds': len(clean), 'gating': gating, 'band': band,
+            'fields': fields, 'regressions': regressions,
+            'ok': not regressions}
+
+
+def _render(report):
+    lines = ['bench-trend: %d clean prior round(s); gate %s'
+             % (report['rounds'],
+                'ON' if report['gating'] else
+                'OFF (flips on at %d rounds per field)' % MIN_ROUNDS_TO_GATE)]
+    if report.get('note'):
+        lines.append('  ' + report['note'])
+    for name, field in sorted(report['fields'].items()):
+        if field['median'] is None:
+            lines.append('  %-55s %12s  (no prior rounds)'
+                         % (name, field['current']))
+            continue
+        if not field['below_floor']:
+            status = 'OK'
+        elif field['gating']:
+            status = 'REGRESSION'
+        else:
+            status = 'below floor (not gating yet)'
+        lines.append(
+            '  %-55s %12s  vs median %s (floor %s, %d rounds%s) %s'
+            % (name, field['current'], field['median'], field['floor'],
+               field['rounds'], '' if field['gating'] else ', not gating',
+               status))
+    if report['regressions']:
+        lines.append('REGRESSION in gating field(s): %s (below median '
+                     'minus the %.0f%% noise band)'
+                     % (', '.join(report['regressions']),
+                        100 * report.get('band', NOISE_BAND)))
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-bench-trend',
+        description=__doc__.split('\n\n')[0])
+    parser.add_argument('--check', action='store_true',
+                        help='compare the newest (or --current) round '
+                             'against the history medians')
+    parser.add_argument('--history', default=None,
+                        help='history file (default: repo '
+                             'BENCH_HISTORY.jsonl)')
+    parser.add_argument('--current', default=None,
+                        help='JSON file holding the compact line of the '
+                             'run to check (default: newest history '
+                             'entry)')
+    parser.add_argument('--band', type=float, default=NOISE_BAND,
+                        help='noise band as a fraction (default %.2f, '
+                             'the measured host A/B variance)'
+                             % NOISE_BAND)
+    parser.add_argument('--json', action='store_true',
+                        help='emit the report as JSON')
+    args = parser.parse_args(argv)
+    if not args.check:
+        parser.error('nothing to do: pass --check')
+    current = None
+    if args.current:
+        try:
+            with open(args.current) as f:
+                current = json.load(f)
+        except (OSError, ValueError) as e:
+            print('cannot read --current %s: %s' % (args.current, e),
+                  file=sys.stderr)
+            return 2
+    report = check(current=current, path=args.history, band=args.band)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(_render(report))
+    return 0 if report['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

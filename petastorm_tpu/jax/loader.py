@@ -141,7 +141,11 @@ class DataLoader(object):
         #: telemetry registry (ISSUE 5): ``stats`` is a view over its
         #: counters, and each stage additionally feeds a log2-bucket
         #: latency histogram (``diagnostics`` reports the p50/p99s).
-        from petastorm_tpu.telemetry import MetricsRegistry
+        from petastorm_tpu.telemetry import MetricsRegistry, flight
+        # Always-on flight recorder for the trainer process (ISSUE 7):
+        # the stage histograms below snapshot into its bounded ring so a
+        # postmortem sees the minutes before a hang, not final totals.
+        flight.enable(label='trainer')
         self.metrics = MetricsRegistry('loader')
         self._m_batches = self.metrics.counter('batches')
         self._m_stage = {

@@ -214,9 +214,17 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                         if reply.get('t_mono') is not None:
                             # The discovery poll doubles as the clock
                             # handshake: (client - dispatcher) from the
-                            # send/recv midpoint (ISSUE 5).
-                            self._clock_offset = ((t_rpc0 + t_rpc1) / 2.0
-                                                  - float(reply['t_mono']))
+                            # send/recv midpoint (ISSUE 5).  EWMA over
+                            # the 1 Hz polls (ISSUE 7): one rtt-skewed
+                            # poll must not yank the whole timeline, and
+                            # a long run tracks genuine drift instead of
+                            # freezing the first estimate.
+                            estimate = ((t_rpc0 + t_rpc1) / 2.0
+                                        - float(reply['t_mono']))
+                            self._clock_offset = (
+                                estimate if self._clock_offset is None
+                                else 0.8 * self._clock_offset
+                                + 0.2 * estimate)
                         for worker in workers:
                             if worker.get('clock_offset') is not None:
                                 self._worker_offsets[worker['addr']] = \

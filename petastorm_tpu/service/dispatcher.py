@@ -118,6 +118,22 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         self._thread = None
         self._started = threading.Event()
         self.addr = None
+        #: Fleet flight recorder (ISSUE 7): same bounded ring every
+        #: process keeps, but the frame source is the fleet — the merge
+        #: of every worker's heartbeat registry snapshot plus the
+        #: control-plane state.  Ticked from the serve loop (no extra
+        #: thread in the control plane); consecutive frames subtract
+        #: into the windowed deltas the ``stats`` health report reads.
+        from petastorm_tpu.telemetry import MetricsRegistry
+        from petastorm_tpu.telemetry.flight import (FlightRecorder,
+                                                    default_persist_path)
+        self.flight = FlightRecorder(source=self._fleet_snapshot,
+                                     label='dispatcher_fleet',
+                                     persist_path=default_persist_path(
+                                         'dispatcher'))
+        #: Health gauges land here so any Prometheus scrape of the
+        #: dispatcher process carries them (``render_prometheus``).
+        self.metrics = MetricsRegistry('dispatcher')
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -170,6 +186,9 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         try:
             while not self._stop.is_set():
                 self._expire_leases()
+                # One fleet flight frame per interval, from the loop the
+                # control plane already runs (contained inside tick()).
+                self.flight.maybe_tick()
                 if not dict(poller.poll(100)):
                     continue
                 request = pickle.loads(socket.recv())
@@ -183,8 +202,35 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 if request.get('op') == 'stop':
                     break
         finally:
+            # The ring is the postmortem: leave the last window on disk
+            # when a flight dir is configured (best-effort by contract).
+            self.flight.persist(reason='dispatcher_exit')
             socket.close(0)
             context.term()
+
+    def _fleet_snapshot(self):
+        """Fleet-merged registry snapshot + control-plane overlay — the
+        flight-recorder frame source.  Heartbeat snapshots merge by
+        bucket addition (fleet-cumulative, so consecutive frames
+        subtract cleanly); split states ride as gauges, lease churn as
+        a counter."""
+        from petastorm_tpu.telemetry import merge_snapshots
+        with self._lock:
+            snaps = [w['stats'].get('registry')
+                     for w in self._workers.values()]
+            states = collections.Counter(s.state for s in self._splits)
+            alive = len(self._workers)
+        merged = merge_snapshots(snaps)
+        merged['namespace'] = 'fleet'
+        merged['gauges'].update({
+            'splits_pending': states[_PENDING],
+            'splits_leased': states[_LEASED],
+            'splits_done': states[_DONE],
+            'splits_failed': states[_FAILED],
+            'workers_registered': alive,
+        })
+        merged['counters']['lease_churn'] = self.lease_churn
+        return merged
 
     # -- lease bookkeeping ---------------------------------------------------
 
@@ -269,7 +315,11 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 if split.state == _LEASED and split.worker_id == worker_id \
                         and (held is None or split.split_id in held):
                     split.lease_expires = now + self._config.lease_ttl_s
-        return {'ok': True}
+        # t_mono: every heartbeat doubles as a clock re-handshake (ISSUE
+        # 7 satellite) — long-lived workers drift off their one
+        # registration-time offset, so the worker EWMAs the midpoint
+        # estimate from each beat and ships `clock_drift_ms` back.
+        return {'ok': True, 't_mono': time.monotonic()}
 
     def _op_lease(self, request):
         worker_id = request['worker_id']
@@ -375,12 +425,19 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 't_mono': time.monotonic()}
 
     def _op_stats(self, request):
+        stale = 3.0 * self._config.lease_ttl_s
         with self._lock:
             states = collections.Counter(s.state for s in self._splits)
+            now = time.monotonic()
             workers = {wid: dict(w['stats'],
-                                 age_s=round(time.monotonic()
-                                             - w['last_heartbeat'], 3))
+                                 age_s=round(now - w['last_heartbeat'], 3))
                        for wid, w in self._workers.items()}
+            # Registered is not alive: the dispatcher never forgets a
+            # worker, so health must count heartbeats (same staleness
+            # rule as _op_workers) or a fully-crashed fleet could never
+            # classify lease-starved.
+            alive = sum(1 for w in self._workers.values()
+                        if (now - w['last_heartbeat']) < stale)
         # Fleet-wide epoch-cache plane counters (jobs with cache_plane):
         # summed from the per-worker heartbeat stats, so one `status`
         # call says whether this epoch is being decoded or served warm.
@@ -396,16 +453,37 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                for key in ('shm_chunks', 'shm_degraded')}
         # True fleet-wide stage latencies: the heartbeat registry
         # snapshots merge by histogram-bucket addition (the reason the
-        # buckets are fixed log2), then each stage reports p50/p99.
-        from petastorm_tpu.telemetry import hist_quantile, merge_snapshots
-        from petastorm_tpu.telemetry.registry import ms
+        # buckets are fixed log2), then each stage reports the ONE
+        # canonical summary (`summarize_hist`) that `top` and
+        # `petastorm-tpu-diagnose` also print — same snapshot, same
+        # numbers, everywhere.
+        from petastorm_tpu.telemetry import (health, merge_snapshots,
+                                             snapshot_delta, summarize_hist)
         merged = merge_snapshots([w.get('registry') for w in
                                   workers.values()])
-        stages = {}
-        for name, hist in merged['histograms'].items():
-            stages[name] = {'count': hist['count'],
-                            'p50_ms': ms(hist_quantile(hist, 0.5)),
-                            'p99_ms': ms(hist_quantile(hist, 0.99))}
+        stages = {name: summarize_hist(hist)
+                  for name, hist in merged['histograms'].items()}
+        # Derived fleet health (ISSUE 7): the CURRENT fleet snapshot
+        # delta'd against the flight-ring frame nearest the window edge
+        # (~60 s back, `flight.window_frames` — the one windowing rule;
+        # the serve loop ticks the ring).  Deltaing live state — not
+        # frame-vs-frame — keeps the report current even on a dispatcher
+        # younger than one tick interval, so with a single frame that
+        # frame IS the baseline.
+        from petastorm_tpu.telemetry.flight import window_frames
+        self.flight.maybe_tick()
+        frames = self.flight.frames()
+        baseline = window_frames(frames, 60.0)[0] or (
+            frames[-1] if frames else None)
+        delta = snapshot_delta(self._fleet_snapshot(),
+                               baseline['snapshot'] if baseline else None)
+        meta = {'pending': states[_PENDING], 'leased': states[_LEASED],
+                'failed': states[_FAILED], 'workers_alive': alive}
+        fleet_health = health.health_report(
+            delta, meta=meta,
+            window_s=(time.monotonic() - baseline['t_mono'])
+            if baseline else None)
+        health.export_gauges(self.metrics, fleet_health)
         # The raw per-worker snapshots (44-int bucket arrays per
         # histogram) served their purpose in `stages`; shipping them per
         # worker per poll would grow the reply linearly with fleet size
@@ -422,6 +500,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             'cache': cache,
             'shm': shm,
             'stages': stages,
+            'health': fleet_health,
             'workers': workers,
         }
 

@@ -183,10 +183,19 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         self._m_serialize_hist = self.metrics.histogram('serialize')
         self._m_shm_pub_hist = self.metrics.histogram('shm_publish')
         #: (this_worker_monotonic - dispatcher_monotonic), measured at
-        #: registration (reply midpoint handshake) and shipped on every
-        #: heartbeat: the client chains it with ITS dispatcher offset to
+        #: registration (reply midpoint handshake), then RE-measured on
+        #: every heartbeat and EWMA-smoothed (ISSUE 7 satellite: a
+        #: long-lived worker drifts off its one registration-time
+        #: estimate and skews every merged timeline).  Shipped on every
+        #: heartbeat; the client chains it with ITS dispatcher offset to
         #: land this worker's spans on its own timeline.
         self.clock_offset = None
+        #: EWMA offset minus the registration-time offset, in ms — the
+        #: drift signal `stats`/doctor surface (a same-host fleet should
+        #: sit at ~0; growth means monotonic clocks diverging or rtt
+        #: asymmetry corrupting the midpoint estimate).
+        self.clock_drift_ms = 0.0
+        self._clock_offset_initial = None
         #: shm result plane (None when the job or host disables it);
         #: written only by the decode thread, stopped after it joins.
         self._arena = None
@@ -262,12 +271,15 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             t_reg1 = time.monotonic()
             self.worker_id = reply['worker_id']
             job = reply['job']
-            if reply.get('t_mono') is not None:
-                # Clock handshake (ISSUE 5): dispatcher monotonic against
-                # the local send/recv midpoint — wrong by at most rtt/2,
-                # which orders spans fine on any LAN.
-                self.clock_offset = round(
-                    (t_reg0 + t_reg1) / 2.0 - float(reply['t_mono']), 6)
+            # Clock handshake (ISSUE 5): dispatcher monotonic against
+            # the local send/recv midpoint — wrong by at most rtt/2,
+            # which orders spans fine on any LAN.  Heartbeats repeat it
+            # (ISSUE 7: drift EWMA).
+            self._update_clock(reply.get('t_mono'), t_reg0, t_reg1)
+            from petastorm_tpu.telemetry import flight
+            # Always-on flight recorder for this process: the minutes
+            # before a worker death persist when a flight dir is set.
+            flight.enable(label='service_worker')
             from petastorm_tpu.workers_pool import shm_plane
             if job.get('shm', True) and shm_plane.available():
                 self._arena = shm_plane.ShmArena(
@@ -300,6 +312,27 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             rpc.close()
             data.close(0)
             context.term()
+
+    #: EWMA weight of each new midpoint estimate: heavy enough to track
+    #: genuine drift within ~10 beats, light enough that one rtt-skewed
+    #: beat cannot yank every span's alignment.
+    _CLOCK_EWMA_ALPHA = 0.2
+
+    def _update_clock(self, t_mono, t0, t1):
+        """Fold one (reply ``t_mono``, local send/recv window) clock
+        handshake into the EWMA offset + drift estimate."""
+        if t_mono is None:
+            return
+        estimate = (t0 + t1) / 2.0 - float(t_mono)
+        if self.clock_offset is None:
+            self._clock_offset_initial = estimate
+            self.clock_offset = round(estimate, 6)
+            return
+        alpha = self._CLOCK_EWMA_ALPHA
+        ewma = (1.0 - alpha) * self.clock_offset + alpha * estimate
+        self.clock_offset = round(ewma, 6)
+        self.clock_drift_ms = round(
+            1e3 * (ewma - self._clock_offset_initial), 3)
 
     def _advertised(self, addr):
         """The address published to the dispatcher: clients on OTHER
@@ -476,9 +509,17 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             # 4. heartbeat (renews the leases this worker still claims)
             if now - last_heartbeat >= heartbeat_every:
                 try:
-                    rpc.call({'op': 'heartbeat', 'worker_id': self.worker_id,
-                              'stats': self.heartbeat_stats(),
-                              'held': list(inflight)})
+                    t_hb0 = time.monotonic()
+                    reply = rpc.call({'op': 'heartbeat',
+                                      'worker_id': self.worker_id,
+                                      'stats': self.heartbeat_stats(),
+                                      'held': list(inflight)})
+                    # Opportunistic clock re-handshake (ISSUE 7): the
+                    # beat's send/recv midpoint EWMAs into clock_offset
+                    # so a long-lived worker tracks drift instead of
+                    # freezing its registration-time estimate.
+                    self._update_clock(reply.get('t_mono'), t_hb0,
+                                       time.monotonic())
                 except ServiceRpcTimeoutError:
                     logger.warning('heartbeat to %s timed out',
                                    self._dispatcher_addr)
@@ -698,9 +739,11 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
     def heartbeat_stats(self):
         """The heartbeat payload: ``diagnostics`` plus the telemetry
         piggyback — the full registry snapshot (stage histograms merge
-        fleet-wide by addition in the dispatcher), the clock offset for
-        span alignment, and this process's pid for timeline labels."""
+        fleet-wide by addition in the dispatcher), the EWMA clock offset
+        for span alignment with its drift-vs-registration estimate, and
+        this process's pid for timeline labels."""
         return dict(self.diagnostics,
                     registry=self.metrics.snapshot(),
                     clock_offset=self.clock_offset,
+                    clock_drift_ms=self.clock_drift_ms,
                     pid=os.getpid())

@@ -1,0 +1,313 @@
+"""Always-on flight recorder: the minutes BEFORE a failure, per process.
+
+The telemetry plane (ISSUE 5) made the fleet measurable, but every
+surface is *current state*: a hang investigated after the watchdog fires
+ships the final registry totals and whatever spans were still buffered —
+the trajectory that led there is gone.  This module keeps it: a bounded
+ring of periodic **frames**, each one merged-registry snapshot (every
+live registry in the process, merged by the same bucket-addition
+machinery the fleet rollups use) plus the spans that completed since the
+last frame, stamped with monotonic AND wall-clock time.  Consecutive
+frames subtract into windowed deltas (``registry.snapshot_delta``) — the
+input the health engine (``telemetry/health.py``) and
+``petastorm-tpu-diagnose`` classify regimes from.
+
+Cheap enough to leave on: a frame is one ``snapshot_all()`` merge + a
+bounded span peek every ``interval_s`` (default 2 s) on a daemon thread
+— nothing rides any data-plane hot path, so the ProcessPool ack path
+pays zero per-item cost (measured: the host-plane leg is within run
+noise with the recorder on; see ``docs/observability.md``).
+
+Crash-safety is WRITE-AHEAD, not at-exit: with a ``persist_path`` the
+ring overwrites one JSON file every ``persist_every`` frames (atomic
+tmp+rename), so a SIGKILL/segfault leaves the last periodic write on
+disk — a postmortem artifact nobody had to remember to request.
+``persist()`` additionally writes on demand (watchdog fire, clean exit).
+
+Span capture PEEKS with a time watermark, never drains: a process's span
+buffer belongs to its real return channel (ack payloads, end headers) —
+the doctor learned this the hard way — so the recorder copies spans
+newer than its last frame and leaves the buffer intact.
+
+Process wiring: :func:`enable` is a pid-keyed singleton (like
+``spans.current_buffer``) armed by the long-lived processes — service
+workers, ProcessPool children, ``DataLoader`` trainers, the test suite —
+and killed globally by ``PETASTORM_TPU_NO_FLIGHT=1``.  The dispatcher
+instead owns a dedicated instance whose ``source`` merges the fleet's
+heartbeat snapshots (see ``service/dispatcher.py``): same ring, fleet
+scope.
+"""
+
+import json
+import os
+import threading
+import time
+
+from petastorm_tpu.telemetry.registry import merge_snapshots, snapshot_all
+from petastorm_tpu.telemetry.spans import current_buffer
+
+__all__ = ['FlightRecorder', 'window_frames', 'enable', 'get', 'disable',
+           'dump_current', 'default_persist_path']
+
+
+def window_frames(frames, seconds=None):
+    """THE frame-windowing rule, shared by every consumer (recorder,
+    health engine, dispatcher stats, diagnose): ``(baseline, newest)``
+    pair for delta computation over a frame list.  ``newest`` is the
+    last frame; ``baseline`` is the newest frame at or behind the
+    ``seconds`` horizon (the oldest frame when the ring is younger than
+    the window), or None when fewer than two frames exist.
+    ``seconds=None`` spans the whole list.  Returns ``(None, None)``
+    for an empty list."""
+    if not frames:
+        return None, None
+    newest = frames[-1]
+    if len(frames) == 1:
+        return None, newest
+    if seconds is None:
+        return frames[0], newest
+    horizon = newest['t_mono'] - float(seconds)
+    baseline = frames[0]
+    for frame in frames[:-1]:
+        if frame['t_mono'] <= horizon:
+            baseline = frame
+        else:
+            break
+    return baseline, newest
+
+#: ~8 minutes of history at the default cadence — "the minutes before
+#: the failure", bounded.
+DEFAULT_INTERVAL_S = 2.0
+DEFAULT_MAX_FRAMES = 240
+
+#: Span bound per frame: a pathological burst must not bloat the ring.
+_MAX_SPANS_PER_FRAME = 256
+
+
+class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-process diagnostic state; dumps (plain dicts) are what cross boundaries
+    """Bounded ring of periodic telemetry frames.
+
+    Args:
+        interval_s: target seconds between frames.
+        max_frames: ring bound (oldest frames drop first).
+        source: zero-arg callable returning a merged registry snapshot;
+            defaults to merging every live registry in this process.
+            The dispatcher passes its fleet-heartbeat merge here.
+        label: human tag carried in dumps ('service_worker', 'trainer').
+        persist_path: when set, the ring overwrites this file every
+            ``persist_every`` frames and on :meth:`persist` — the
+            crash-survivable artifact.
+        persist_every: frames between periodic persists.
+
+    Drive it either with :meth:`start` (daemon thread) or by calling
+    :meth:`maybe_tick` from a loop the process already runs (the
+    dispatcher's serve loop does this — no extra thread in the control
+    plane).
+    """
+
+    def __init__(self, interval_s=None, max_frames=None, source=None,
+                 label=None, persist_path=None, persist_every=8):
+        self.interval_s = float(interval_s if interval_s is not None
+                                else DEFAULT_INTERVAL_S)
+        self.max_frames = int(max_frames if max_frames is not None
+                              else DEFAULT_MAX_FRAMES)
+        self.label = label
+        self.persist_path = persist_path
+        self.persist_every = max(1, int(persist_every))
+        self._source = source
+        self._frames = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._last_tick = 0.0
+        self._span_watermark = 0.0
+        self._ticks = 0
+        self._started_monotonic = time.monotonic()
+        self._started_unix = time.time()
+
+    # -- recording -----------------------------------------------------------
+
+    def tick(self):
+        """Record one frame.  Contained: a diagnostic must never take the
+        process it is diagnosing down with it."""
+        try:
+            frame = self._build_frame()
+        except Exception:  # noqa: BLE001 — diagnostics are best-effort
+            return None
+        with self._lock:
+            self._frames.append(frame)
+            del self._frames[:-self.max_frames]
+            self._ticks += 1
+            ticks = self._ticks
+        self._last_tick = time.monotonic()
+        if self.persist_path and ticks % self.persist_every == 0:
+            self.persist(reason='periodic')
+        return frame
+
+    def maybe_tick(self):
+        """Tick iff ``interval_s`` elapsed since the last frame — for
+        host loops that already wake frequently (dispatcher serve loop)."""
+        if time.monotonic() - self._last_tick >= self.interval_s:
+            return self.tick()
+        return None
+
+    def _build_frame(self):
+        snapshot = (self._source() if self._source is not None
+                    else merge_snapshots(snapshot_all()))
+        # Peek-with-watermark: copy spans that COMPLETED since the last
+        # frame, leave the buffer for its real drain channel.
+        pending = current_buffer().peek()
+        fresh = [s for s in pending if s.get('t1', 0.0) > self._span_watermark]
+        if fresh:
+            self._span_watermark = max(s['t1'] for s in fresh)
+        return {
+            't_mono': time.monotonic(),
+            'unix_time': time.time(),
+            'snapshot': snapshot,
+            'spans': fresh[-_MAX_SPANS_PER_FRAME:],
+            'span_residue': len(pending),
+        }
+
+    # -- thread lifecycle ----------------------------------------------------
+
+    def start(self):
+        """Arm the daemon tick thread (idempotent).  The thread is
+        import-free by construction — everything it touches is imported
+        at module load on the arming thread (the timer-thread
+        first-import segfault class, see tests/conftest.py)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name='telemetry-flight', daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self.tick()
+
+    def stop(self):
+        self._stop.set()
+
+    # -- reading -------------------------------------------------------------
+
+    def frames(self):
+        with self._lock:
+            return list(self._frames)
+
+    def window(self, seconds=None):
+        """:func:`window_frames` over this ring's current frames."""
+        return window_frames(self.frames(), seconds)
+
+    def dump(self):
+        """JSON-able dump of the whole ring + identity/provenance."""
+        return {
+            'kind': 'flight_recorder',
+            'pid': os.getpid(),
+            'label': self.label,
+            'interval_s': self.interval_s,
+            'started_monotonic': self._started_monotonic,
+            'started_unix': self._started_unix,
+            'frames': self.frames(),
+        }
+
+    def persist(self, path=None, reason=None):
+        """Atomic write of :meth:`dump` (tmp + ``os.replace``).  Returns
+        the path on success, None on any failure — persistence is
+        best-effort by contract."""
+        path = path or self.persist_path
+        if not path:
+            return None
+        try:
+            state = self.dump()
+            if reason is not None:
+                state['reason'] = reason
+            directory = os.path.dirname(os.path.abspath(path))
+            os.makedirs(directory, exist_ok=True)
+            tmp = '%s.%d.tmp' % (path, os.getpid())
+            with open(tmp, 'w') as f:
+                json.dump(state, f, default=str)
+            os.replace(tmp, path)
+            return path
+        except Exception:  # noqa: BLE001 — a failed artifact beats a dead process
+            return None
+
+
+# -- process singleton --------------------------------------------------------
+
+_RECORDER = None
+_RECORDER_PID = None
+_SINGLETON_LOCK = threading.Lock()
+
+
+def _disabled_by_env():
+    return os.environ.get('PETASTORM_TPU_NO_FLIGHT', '') not in ('', '0')
+
+
+def default_persist_path(label=None):
+    """Where this process's crash artifact lands when
+    ``PETASTORM_TPU_FLIGHT_DIR`` is set (None otherwise): one file per
+    (label, pid) so concurrent processes never clobber each other."""
+    directory = os.environ.get('PETASTORM_TPU_FLIGHT_DIR')
+    if not directory:
+        return None
+    name = 'flight_%s_%d.json' % (label or 'proc', os.getpid())
+    return os.path.join(directory, name)
+
+
+def enable(label=None, interval_s=None, persist_path=None, source=None):
+    """Arm (or return) the process-local always-on recorder.
+
+    Pid-keyed like ``spans.current_buffer`` — a fork gets a fresh ring,
+    never its parent's frames.  The FIRST enabler's label/interval win;
+    later calls return the live recorder unchanged.  Returns None when
+    ``PETASTORM_TPU_NO_FLIGHT=1`` (the kill switch for hosts where even
+    a 2 s tick thread is unwelcome).
+    """
+    global _RECORDER, _RECORDER_PID
+    if _disabled_by_env():
+        return None
+    pid = os.getpid()
+    with _SINGLETON_LOCK:
+        if _RECORDER is None or _RECORDER_PID != pid:
+            env_interval = os.environ.get('PETASTORM_TPU_FLIGHT_INTERVAL_S')
+            if interval_s is None and env_interval:
+                try:
+                    interval_s = float(env_interval)
+                except ValueError:
+                    interval_s = None
+            if persist_path is None:
+                persist_path = default_persist_path(label)
+            _RECORDER = FlightRecorder(interval_s=interval_s, label=label,
+                                       persist_path=persist_path,
+                                       source=source)
+            _RECORDER_PID = pid
+            _RECORDER.start()
+        return _RECORDER
+
+
+def get():
+    """The live process recorder, or None (disabled / never enabled /
+    different process after fork)."""
+    with _SINGLETON_LOCK:
+        if _RECORDER is not None and _RECORDER_PID == os.getpid():
+            return _RECORDER
+        return None
+
+
+def disable():
+    """Stop and forget the process recorder (tests; explicit opt-out)."""
+    global _RECORDER, _RECORDER_PID
+    with _SINGLETON_LOCK:
+        if _RECORDER is not None:
+            _RECORDER.stop()
+        _RECORDER = None
+        _RECORDER_PID = None
+
+
+def dump_current():
+    """The process recorder's dump, or None — the hook
+    ``telemetry.dump_state`` includes in every crash artifact."""
+    recorder = get()
+    return recorder.dump() if recorder is not None else None

@@ -1,0 +1,308 @@
+"""Derived health signals: raw telemetry in, regimes and scores out.
+
+The registry/span plane answers "what happened"; this module answers
+"what is WRONG and which knob fixes it" — the interpretation layer the
+ROADMAP's autoscaling item needs (scale decisions read regimes, not p99
+tables) and the layer ``petastorm-tpu-diagnose`` builds verdicts from.
+Per the tf.data-service / latency-hiding framing (PAPERS.md): the
+*attribution* of stage overlap locates the bottleneck, not the raw
+timings.
+
+Inputs are **windowed snapshot deltas** (``registry.snapshot_delta``
+over flight-recorder frames, or a cumulative snapshot when no history
+exists), optionally joined with a span-level stall attribution
+(``spans.attribute_stalls``'s ``pct`` map) and control-plane metadata
+(split states, live workers).  Every threshold is a named constant and
+every classification carries its evidence string — the rules are the
+contract the synthetic-regime tests pin.
+
+Regime catalogue (``classify_regime``):
+
+* ``decode-bound``   — stall time (or stage busy time) dominated by
+  rowgroup decode / cache fill.  Knobs: ``workers_count``, more service
+  workers, the epoch-cache plane.
+* ``link-bound``     — dominated by host->device transfer (``h2d``) or
+  its host-side staging copy (``h2d_stage``; the evidence names which).
+  Knobs: transfer plane, wire narrowing, deeper ring / prefetch.
+* ``lease-starved``  — the client waited while NO pipeline stage was
+  active (true starvation), or no live worker can lease pending splits.
+  Knobs: add workers, check the dispatcher, smaller splits.
+* ``cache-degraded`` — the epoch-cache plane is refusing work (full /
+  unwritable / unencodable): hits may still look plausible while every
+  miss re-decodes.  Knobs: plane dir, tier caps, /dev/shm headroom.
+* ``shm-degraded``   — the zero-copy result plane is falling back to
+  the byte path (arena full, /dev/shm unusable).  Knobs: arena
+  capacity, /dev/shm size, consumer drain rate.
+* ``healthy`` / ``idle`` — nothing above threshold / no traffic at all.
+"""
+
+from petastorm_tpu.telemetry.registry import summarize_hist
+
+__all__ = ['classify_regime', 'health_report', 'report_from_frames',
+           'export_gauges', 'busy_seconds', 'degrade_ratios', 'REGIMES']
+
+REGIMES = ('decode-bound', 'link-bound', 'lease-starved', 'cache-degraded',
+           'shm-degraded', 'healthy', 'idle')
+
+#: Histogram name -> pipeline component.  Names from every registry the
+#: fleet merges: service workers (decode_split/serialize/shm_publish),
+#: ProcessPool children (decode), the cache plane (cache_fill), loaders
+#: (host_batch/device_put) and the transfer plane (h2d_*).
+STAGE_COMPONENTS = {
+    'decode_split': 'decode', 'decode': 'decode', 'cache_fill': 'decode',
+    'host_batch': 'decode',
+    'serialize': 'delivery', 'shm_publish': 'delivery',
+    'device_put': 'link', 'h2d_dispatch': 'link', 'h2d_commit': 'link',
+    'h2d_stage': 'link_stage',
+}
+
+#: attribute_stalls component -> regime it evidences.
+_STALL_REGIMES = {
+    'decode': 'decode-bound', 'cache_fill': 'decode-bound',
+    'h2d': 'link-bound', 'h2d_stage': 'link-bound',
+    'lease_wait': 'lease-starved',
+}
+
+#: A stall component below this share of the wait does not name a regime.
+STALL_PCT_FLOOR = 25.0
+#: Degrade counters below this share of their plane's traffic stay quiet.
+DEGRADE_RATIO_FLOOR = 0.02
+#: Busy-share classification (counters-only fallback) needs at least
+#: this much measured stage time in the window to say anything.
+MIN_BUSY_S = 0.25
+#: ...and the dominant component must hold at least this share.
+BUSY_SHARE_FLOOR = 0.6
+
+
+def busy_seconds(delta):
+    """Seconds each pipeline component was measurably busy in the window
+    (histogram ``sum`` fields, grouped by :data:`STAGE_COMPONENTS`)."""
+    out = {}
+    for name, hist in (delta.get('histograms') or {}).items():
+        component = STAGE_COMPONENTS.get(name)
+        if component is not None:
+            out[component] = out.get(component, 0.0) + float(
+                hist.get('sum', 0.0))
+    return out
+
+
+def degrade_ratios(delta):
+    """Degrade share per degradable plane, or None where the plane saw
+    no traffic at all (no evidence either way)."""
+    counters = delta.get('counters') or {}
+
+    def ratio(degraded_key, traffic_keys):
+        degraded = int(counters.get(degraded_key, 0))
+        traffic = degraded + sum(int(counters.get(k, 0))
+                                 for k in traffic_keys)
+        return (degraded / traffic) if traffic else None
+
+    return {
+        'cache': ratio('cache_degraded', ('cache_hits', 'cache_misses')),
+        'shm': ratio('shm_degraded',
+                     ('shm_chunks', 'shm_results')),
+        'link': ratio('h2d_degraded', ('h2d_batches',)),
+    }
+
+
+def classify_regime(delta, stall_pct=None, meta=None):
+    """Ranked ``[(severity 0..1, regime, evidence), ...]`` (best first;
+    empty when nothing clears its floor).  Span-level stall attribution
+    is the strongest evidence; degrade counters and control-plane state
+    rank by their measured share; busy shares are the counters-only
+    fallback (weaker: stages overlap the step, so share != stall)."""
+    candidates = []
+    counters = (delta.get('counters') or {}) if delta else {}
+
+    # 1. degrade counters: a silently-OFF plane outranks a slow stage at
+    # the same share — it is invisible to every latency number.  A
+    # degrading transfer plane (h2d falling back to inline puts) is a
+    # link problem, so it claims the link-bound regime directly.
+    ratios = degrade_ratios(delta or {})
+    for plane, counter_name, regime in (
+            ('cache', 'cache_degraded', 'cache-degraded'),
+            ('shm', 'shm_degraded', 'shm-degraded'),
+            ('link', 'h2d_degraded', 'link-bound')):
+        ratio = ratios.get(plane)
+        if ratio is not None and ratio >= DEGRADE_RATIO_FLOOR:
+            degraded = counters.get(counter_name, 0)
+            candidates.append((
+                min(1.0, 0.4 + ratio),
+                regime,
+                '%s %d = %.0f%% of %s-plane traffic this window'
+                % (counter_name, degraded, 100.0 * ratio, plane)))
+
+    # 2. span-level stall attribution (the strongest stage evidence).
+    if stall_pct:
+        by_regime = {}
+        for component, regime in _STALL_REGIMES.items():
+            pct = float(stall_pct.get(component, 0.0) or 0.0)
+            if pct > by_regime.get(regime, (0.0, None))[0]:
+                by_regime[regime] = (pct, component)
+        for regime, (pct, component) in by_regime.items():
+            if pct >= STALL_PCT_FLOOR:
+                candidates.append((
+                    min(1.0, pct / 100.0), regime,
+                    '%s active for %.0f%% of the stalled time (span '
+                    'attribution)' % (component, pct)))
+
+    # 3. counters-only fallback: stage busy shares from histogram sums.
+    elif delta:
+        busy = busy_seconds(delta)
+        total = sum(busy.values())
+        if total >= MIN_BUSY_S:
+            component, seconds = max(busy.items(), key=lambda kv: kv[1])
+            share = seconds / total
+            regime = {'decode': 'decode-bound', 'link': 'link-bound',
+                      'link_stage': 'link-bound'}.get(component)
+            if regime is not None and share >= BUSY_SHARE_FLOOR:
+                candidates.append((
+                    0.8 * share, regime,
+                    '%s holds %.0f%% of %.1fs measured stage time '
+                    '(busy-share fallback; no span attribution in '
+                    'this window)' % (component, 100.0 * share, total)))
+
+    # 4. control-plane starvation: pending work no live worker can take.
+    if meta:
+        pending = int(meta.get('pending', 0) or 0)
+        alive = meta.get('workers_alive')
+        if pending > 0 and alive == 0:
+            candidates.append((
+                0.95, 'lease-starved',
+                '%d split(s) pending with 0 live workers' % pending))
+
+    candidates.sort(key=lambda c: c[0], reverse=True)
+    return candidates
+
+
+def health_report(delta, stall_pct=None, meta=None, window_s=None):
+    """One health verdict over a windowed delta.
+
+    Returns::
+
+        {'window_s': ..., 'regime': 'decode-bound',
+         'regime_severity': 0.92, 'regime_evidence': '...',
+         'candidates': [{'regime', 'severity', 'evidence'}, ...],
+         'components': {'cache': {'score': 100.0, 'evidence': ...}, ...}}
+
+    Component scores are 0 (dead) .. 100 (healthy); a component with no
+    traffic and no evidence is omitted rather than scored.  ``regime``
+    is ``healthy`` when no candidate clears its floor, ``idle`` when the
+    window additionally shows no stage activity at all.
+    """
+    delta = delta or {}
+    counters = delta.get('counters') or {}
+    candidates = classify_regime(delta, stall_pct=stall_pct, meta=meta)
+    components = {}
+
+    if stall_pct:
+        for component, keys in (('decode', ('decode', 'cache_fill')),
+                                ('link', ('h2d', 'h2d_stage')),
+                                ('control', ('lease_wait',))):
+            pct = max(float(stall_pct.get(k, 0.0) or 0.0) for k in keys)
+            components[component] = {
+                'score': round(max(0.0, 100.0 - pct), 1),
+                'evidence': 'active/starved for %.0f%% of stalled time'
+                            % pct,
+            }
+    ratios = degrade_ratios(delta)
+    for plane in ('cache', 'shm', 'link'):
+        ratio = ratios.get(plane)
+        if ratio is None:
+            continue
+        entry = {
+            'score': round(100.0 * (1.0 - min(1.0, 2.0 * ratio)), 1),
+            'evidence': '%.1f%% of traffic degraded' % (100.0 * ratio),
+        }
+        current = components.get(plane)
+        if current is None:
+            components[plane] = entry
+        elif entry['score'] < current['score']:
+            # e.g. 'link': a degrading transfer plane can be sicker than
+            # its stall share says — keep the worst score, both stories.
+            current['score'] = entry['score']
+            current['evidence'] = '%s; %s' % (entry['evidence'],
+                                              current['evidence'])
+    if meta:
+        failed = int(meta.get('failed', 0) or 0)
+        if failed:
+            entry = components.setdefault(
+                'control', {'score': 100.0, 'evidence': ''})
+            entry['score'] = min(entry['score'], 10.0)
+            entry['evidence'] = ('%d split(s) terminally failed; %s'
+                                 % (failed, entry['evidence'])).rstrip('; ')
+
+    busy = busy_seconds(delta)
+    if candidates:
+        severity, regime, evidence = candidates[0]
+    elif not busy and not sum(counters.values()):
+        severity, regime, evidence = 0.0, 'idle', 'no activity in window'
+    else:
+        severity, regime, evidence = 0.0, 'healthy', (
+            'no degrade ratio or stall component above threshold')
+    return {
+        'window_s': round(window_s, 1) if window_s is not None else None,
+        'regime': regime,
+        'regime_severity': round(severity, 2),
+        'regime_evidence': evidence,
+        'candidates': [{'regime': r, 'severity': round(s, 2), 'evidence': e}
+                       for s, r, e in candidates],
+        'components': components,
+    }
+
+
+def report_from_frames(frames, window_s=60.0, stall_pct=None, meta=None):
+    """Health over the last ``window_s`` of flight-recorder frames
+    (``flight.window_frames`` picks the baseline — the ONE windowing
+    rule).  One frame reads as a delta-from-start; zero frames returns
+    None."""
+    if not frames:
+        return None
+    from petastorm_tpu.telemetry.flight import window_frames
+    from petastorm_tpu.telemetry.registry import snapshot_delta
+    old, newest = window_frames(frames, window_s)
+    delta = snapshot_delta(newest.get('snapshot'),
+                           old.get('snapshot') if old else None)
+    measured = (newest['t_mono'] - old['t_mono']) if old else None
+    return health_report(delta, stall_pct=stall_pct, meta=meta,
+                         window_s=measured if measured else window_s)
+
+
+def export_gauges(registry, report):
+    """Write a report's scores into ``health_<component>`` gauges (plus
+    ``health_regime_severity``) so any existing
+    ``MetricsRegistry.render_prometheus()`` scrape carries them."""
+    if report is None:
+        return
+    for component, entry in report.get('components', {}).items():
+        registry.gauge('health_%s' % component).set(entry['score'])
+    registry.gauge('health_regime_severity').set(
+        report.get('regime_severity', 0.0))
+
+
+def summarize_stages(histograms):
+    """Canonical per-stage summary table for a snapshot's histograms —
+    the dispatcher ``stats`` / ``top`` / ``diagnose`` shared shape
+    (one :func:`registry.summarize_hist` per stage)."""
+    return {name: summarize_hist(hist)
+            for name, hist in (histograms or {}).items()}
+
+
+def format_health_line(report):
+    """One-line rendering for ``top`` and the status CLI."""
+    if not report:
+        return 'health  (no data)'
+    parts = ['health  %s' % report['regime']]
+    if report['regime'] not in ('healthy', 'idle'):
+        parts.append('(sev %.2f: %s)' % (report['regime_severity'],
+                                         report['regime_evidence']))
+    scores = '  '.join('%s %s' % (c, _fmt_score(e['score']))
+                       for c, e in sorted(
+                           report.get('components', {}).items()))
+    if scores:
+        parts.append('| ' + scores)
+    return ' '.join(parts)
+
+
+def _fmt_score(score):
+    return '%d' % round(score) if score is not None else '-'

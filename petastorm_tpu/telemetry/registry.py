@@ -28,7 +28,8 @@ import threading
 import weakref
 
 __all__ = ['MetricsRegistry', 'Counter', 'Gauge', 'Histogram',
-           'merge_snapshots', 'hist_quantile', 'snapshot_all', 'ms']
+           'merge_snapshots', 'hist_quantile', 'snapshot_all', 'ms',
+           'summarize_hist', 'snapshot_delta']
 
 
 def ms(seconds):
@@ -273,6 +274,58 @@ def hist_quantile(hist, q):
         cumulative.append(total)
     index = bisect.bisect_left(cumulative, rank)
     return (2.0 ** (index + 1)) / 1e6
+
+
+def summarize_hist(hist):
+    """The ONE canonical summary of a histogram snapshot dict:
+    ``{'count', 'p50_ms', 'p99_ms', 'max_ms'}`` with the standard
+    :func:`ms` rounding.  ``top``, ``petastorm-tpu-diagnose``, and the
+    dispatcher ``stats`` rollup all print THESE numbers, so the same
+    snapshot can never summarize three different ways downstream
+    (quantiles are bucket upper bounds, like :func:`hist_quantile`;
+    ``max_ms`` is the highest non-empty bucket's upper bound)."""
+    count = int(hist.get('count', 0) or 0)
+    out = {'count': count,
+           'p50_ms': ms(hist_quantile(hist, 0.5)),
+           'p99_ms': ms(hist_quantile(hist, 0.99)),
+           'max_ms': None}
+    counts = hist.get('counts') or ()
+    for i in range(len(counts) - 1, -1, -1):
+        if counts[i]:
+            out['max_ms'] = ms((2.0 ** (i + 1)) / 1e6)
+            break
+    return out
+
+
+def snapshot_delta(new, old):
+    """``new - old`` for two snapshots of the SAME (cumulative) source:
+    counters and histogram buckets subtract, gauges take ``new``'s value
+    (they are instantaneous).  Negative deltas clamp to zero per
+    instrument — a restarted worker resets its counters mid-window, and
+    a clamped zero ("no progress seen") is the honest reading where a
+    negative count would poison every ratio downstream.  ``old=None``
+    returns ``new`` unchanged (delta from process start)."""
+    if not new:
+        return merge_snapshots([])
+    if not old:
+        return merge_snapshots([new])
+    out = {'namespace': new.get('namespace', ''), 'counters': {},
+           'gauges': dict(new.get('gauges') or {}), 'histograms': {}}
+    old_counters = old.get('counters') or {}
+    for name, value in (new.get('counters') or {}).items():
+        out['counters'][name] = max(0, value - old_counters.get(name, 0))
+    old_hists = old.get('histograms') or {}
+    for name, hist in (new.get('histograms') or {}).items():
+        prev = old_hists.get(name) or {}
+        prev_counts = prev.get('counts') or ()
+        counts = [max(0, n - (prev_counts[i] if i < len(prev_counts) else 0))
+                  for i, n in enumerate(hist.get('counts') or ())]
+        out['histograms'][name] = {
+            'counts': counts,
+            'sum': max(0.0, hist.get('sum', 0.0) - prev.get('sum', 0.0)),
+            'count': max(0, hist.get('count', 0) - prev.get('count', 0)),
+        }
+    return out
 
 
 def snapshot_all():

@@ -31,6 +31,7 @@ def _rate(hits, misses):
 
 def render_stats(stats, elapsed_s=None):
     """One text frame from a dispatcher ``stats`` reply."""
+    from petastorm_tpu.telemetry.health import format_health_line
     lines = []
     lines.append(
         'splits  pending %-5d leased %-5d done %-5d failed %-5d '
@@ -38,6 +39,11 @@ def render_stats(stats, elapsed_s=None):
         % (stats.get('pending', 0), stats.get('leased', 0),
            stats.get('done', 0), stats.get('failed', 0),
            stats.get('lease_churn', 0)))
+    # Derived fleet health (ISSUE 7): regime + per-component scores from
+    # the dispatcher's flight-ring window — the interpreted line above
+    # the raw numbers.  `petastorm-tpu-diagnose` expands it to verdicts.
+    if stats.get('health') is not None:
+        lines.append(format_health_line(stats['health']))
     cache = stats.get('cache') or {}
     shm = stats.get('shm') or {}
     lines.append(
@@ -53,14 +59,18 @@ def render_stats(stats, elapsed_s=None):
         % (shm.get('shm_chunks', 0), shm.get('shm_degraded', 0)))
     stages = stats.get('stages') or {}
     if stages:
+        # The dispatcher built these with telemetry.summarize_hist — the
+        # same canonical summary `diagnose` prints, so the two tools can
+        # never show different numbers for the same snapshot.
         lines.append('stage latencies (fleet-merged log2 histograms):')
-        lines.append('  %-14s %10s %10s %10s' % ('stage', 'count',
-                                                 'p50_ms', 'p99_ms'))
+        lines.append('  %-14s %10s %10s %10s %10s'
+                     % ('stage', 'count', 'p50_ms', 'p99_ms', 'max_ms'))
         for name in sorted(stages):
             stage = stages[name]
-            lines.append('  %-14s %10d %10s %10s'
+            lines.append('  %-14s %10d %10s %10s %10s'
                          % (name, stage.get('count', 0),
-                            stage.get('p50_ms'), stage.get('p99_ms')))
+                            stage.get('p50_ms'), stage.get('p99_ms'),
+                            stage.get('max_ms')))
     workers = stats.get('workers') or {}
     lines.append('workers (%d):' % len(workers))
     lines.append('  %-6s %9s %8s %6s %9s %9s %8s %7s'

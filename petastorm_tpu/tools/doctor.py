@@ -36,6 +36,7 @@ Sections (each contained — a dead plane is reported, not fatal):
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -312,6 +313,22 @@ def _check_telemetry():
     # that plus scheduling slack.  Anything bigger means monotonic is NOT
     # shared the way span alignment assumes on this host.
     out['clock_offset_ok'] = bool(abs(offset) <= max(1.0, rtt))
+    # Drift probe (ISSUE 7 satellite): a SECOND handshake — two midpoint
+    # estimates of the same same-host clock pair should agree to within
+    # their rtts; disagreement is the per-worker `clock_drift_ms` signal
+    # the dispatcher `stats` rows track for long-lived fleets.
+    offset2, rtt2 = telemetry.measure_clock_offset(child_clock)
+    out['clock_drift_ms'] = round(1e3 * (offset2 - offset), 3)
+    out['clock_drift_ok'] = bool(
+        abs(offset2 - offset) <= max(1.0, rtt + rtt2))
+    # Flight recorder (ISSUE 7): armed state + ring depth of THIS
+    # process, and the kill-switch/persist env that governs it.
+    recorder = telemetry.flight.get()
+    out['flight_enabled'] = recorder is not None
+    if recorder is not None:
+        out['flight_frames'] = len(recorder.frames())
+        out['flight_persist_path'] = recorder.persist_path
+    out['flight_dir_env'] = os.environ.get('PETASTORM_TPU_FLIGHT_DIR')
     # peek, never drain: run_doctor() is importable from a LIVE process,
     # and consuming its pending spans would steal them from the real
     # drain channel.  The buffer is bounded, so reporting is enough.

@@ -53,6 +53,10 @@ def worker_main(setup_payload, worker_id):
     metrics = telemetry.MetricsRegistry('pool_worker')
     decode_hist = metrics.histogram('decode')
     spans = telemetry.current_buffer()
+    # Always-on flight recorder (ISSUE 7): a child killed mid-epoch
+    # leaves its last periodic frame dump behind when a flight dir is
+    # configured; costs nothing on the ack path (2 s daemon tick).
+    telemetry.flight.enable(label='pool_worker')
     current_position = [None]
 
     context = zmq.Context()

@@ -81,6 +81,12 @@ def pytest_configure(config):
         # the petastorm_tpu package tree) must also happen NOW: the
         # timer thread must never be the first importer of anything.
         _TELEMETRY.dump_state()
+        # Always-on flight recorder (ISSUE 7): the suite process keeps a
+        # bounded ring of periodic registry frames, so the watchdog
+        # artifact carries the minutes BEFORE a hang, not just the final
+        # counter totals.  Armed here on the main thread (the tick
+        # thread is import-free by construction).
+        _TELEMETRY.flight.enable(label='pytest')
     except Exception:  # no telemetry -> no dump, never a broken suite
         _TELEMETRY = None
     if _TELEMETRY is not None:
@@ -129,6 +135,15 @@ def _write_telemetry_dump(reason):
         os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
         with open(path, 'w') as f:
             json.dump(state, f, default=str)
+        # The flight ring also lands as its own artifact next to the
+        # dump (ISSUE 7): `petastorm-tpu-diagnose --flight` reads it
+        # directly, and CI's failure upload ships the whole directory.
+        recorder = _TELEMETRY.flight.get()
+        if recorder is not None:
+            recorder.persist(
+                path=os.path.join(os.path.dirname(path),
+                                  'flight_recorder.json'),
+                reason=reason)
     except Exception as e:  # noqa: BLE001
         print('telemetry dump failed: %s' % (e,))
 

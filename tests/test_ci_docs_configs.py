@@ -189,6 +189,9 @@ def test_console_script_entry_points_resolve():
     assert len(lines) >= 8, lines  # reference-parity CLIs + data service
     names = [l.split('=', 1)[0].strip() for l in lines]
     assert 'petastorm-tpu-data-service' in names, names
+    # ISSUE 7: the diagnosis + perf-trend CLIs must stay registered
+    assert 'petastorm-tpu-diagnose' in names, names
+    assert 'petastorm-tpu-bench-trend' in names, names
     for line in lines:
         _, target = [s.strip().strip('"') for s in line.split('=', 1)]
         mod, fn = target.split(':')
@@ -335,3 +338,21 @@ def test_ci_uploads_telemetry_dump_on_failure():
     step = uploads[0]
     assert step.get('if') == 'failure()'
     assert 'test-artifacts' in step['with']['path']
+
+
+def test_ci_bench_trend_step_runs_bare_file():
+    """The bench-trend check (ISSUE 7) must run trend.py as a BARE FILE
+    from the checkout (stdlib-only, no package import) so it lives in
+    the no-install lint job — renaming the invocation must fail here."""
+    job = _load_ci()['jobs']['lint']
+    run_text = '\n'.join(s['run'] for s in job['steps'] if 'run' in s)
+    assert 'python petastorm_tpu/benchmark/trend.py --check' in run_text
+
+
+def test_conftest_arms_flight_recorder_and_writes_its_artifact():
+    """The suite process must keep the always-on flight ring and land it
+    as flight_recorder.json next to the telemetry dump (ISSUE 7) — the
+    file CI uploads and `petastorm-tpu-diagnose --flight` reads."""
+    src = open(os.path.join(REPO, 'tests', 'conftest.py')).read()
+    assert "flight.enable(label='pytest')" in src
+    assert "'flight_recorder.json'" in src

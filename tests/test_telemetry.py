@@ -249,7 +249,7 @@ WORKER_DIAG_KEYS = {
 
 DISPATCHER_STATS_KEYS = {
     'num_splits', 'pending', 'leased', 'done', 'failed', 'lease_churn',
-    'cache', 'shm', 'stages', 'workers'}
+    'cache', 'shm', 'stages', 'health', 'workers'}
 
 
 def test_golden_keys_thread_reader_and_loader(dataset):
@@ -336,8 +336,18 @@ def test_golden_keys_dispatcher_stats_and_fleet_rollup(tmp_path):
     assert all('registry' not in row for row in stats['workers'].values())
     assert stats['shm'] == {'shm_chunks': 3, 'shm_degraded': 2}
     assert stats['cache']['cache_hits'] == 1
+    # stages carry the CANONICAL summarize_hist shape (ISSUE 7
+    # satellite): count/p50/p99/max — the same numbers top and diagnose
+    # print for this snapshot
     stage = stats['stages']['decode_split']
+    assert set(stage) == {'count', 'p50_ms', 'p99_ms', 'max_ms'}
     assert stage['count'] == 2 and stage['p99_ms'] >= stage['p50_ms'] > 0
+    assert stage['max_ms'] >= stage['p99_ms']
+    # derived fleet health rides the same reply (ISSUE 7)
+    assert stats['health']['regime'] in (
+        'healthy', 'idle', 'decode-bound', 'link-bound', 'lease-starved',
+        'cache-degraded', 'shm-degraded')
+    assert 'components' in stats['health']
     # per-worker clock offsets surface on the discovery poll for span
     # alignment, next to the dispatcher's own clock
     assert workers['t_mono'] > 0
@@ -350,7 +360,7 @@ def test_golden_keys_service_worker_diagnostics():
     assert set(worker.diagnostics) == WORKER_DIAG_KEYS
     beat = worker.heartbeat_stats()
     assert set(beat) == WORKER_DIAG_KEYS | {'registry', 'clock_offset',
-                                            'pid'}
+                                            'clock_drift_ms', 'pid'}
     assert beat['registry']['namespace'] == 'service_worker'
 
 
