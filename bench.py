@@ -1312,6 +1312,195 @@ def transfer_plane_leg(pairs=3, reps=8):
     return fields
 
 
+SKEW_DATASET_URL = 'file://' + BENCH_DIR + '/skew_mixed_jpeg_v2'
+SKEW_UNIFORM_URL = 'file://' + BENCH_DIR + '/skew_uniform_jpeg_v2'
+#: Emulated cold storage for the scheduling leg: plenty of streaming
+#: bandwidth (fast ~100 KB groups fetch in ~2.5 ms), but each multi-MB
+#: straggler FILE pays a cold-object first-read latency (a cold-tier
+#: GET/recall) — a pure GIL-released wait, so a straggler's wall time is
+#: comparable to the whole fast epoch while consuming almost no CPU.
+#: That is the regime the scheduler targets: FIFO pays the straggler
+#: wherever the shuffle lands it (an idle-pool epoch tail when late),
+#: adaptive launches it at t=0 and hides it under the fast stream.
+SKEW_COLD_BPS = 40e6
+#: Sized so the straggler wall (~1.25 s with the open + decode) stays
+#: comparable to, but safely under, the fast-epoch duration across
+#: host-speed swings: a straggler much shorter than the epoch
+#: compresses the measured win toward 1; one LONGER than the fast
+#: stream's in-flight horizon stalls adaptive too.
+SKEW_COLD_LATENCY_S = 1.2
+#: 200 fast groups + 2 stragglers: the epoch must be LONG relative to
+#: FIFO's own in-flight lookahead (2x workers), or FIFO accidentally
+#: launches stragglers early too and the comparison measures nothing.
+_SKEW_GROUPS, _SKEW_SLOW_EVERY = 202, 101
+_SKEW_ROWS_PER_GROUP, _SKEW_SLOW_HW, _SKEW_FAST_HW = 8, 512, 224
+#: Straggler rows additionally carry an incompressible pad column that
+#: the leg never reads: it inflates the straggler FILE past the cold
+#: gate (and past every fast file for the byte-size cost prior) without
+#: adding decode work — the straggler is latency-dominated, like a real
+#: cold-tier object, not CPU-heavy (early-launching CPU-heavy pieces
+#: would just move their decode into contention with the fast stream).
+_SKEW_PAD_BYTES, _SKEW_FAST_PAD_BYTES = 1 << 18, 8
+
+
+def _ensure_skew_dataset(url, groups, slow_every):
+    """Mixed-resolution JPEG dataset for the scheduling leg: fast groups
+    are 224² low-entropy JPEGs (~100 KB/group), slow groups are 512²
+    per-pixel-noise JPEGs padded to multi-MB cold-tier objects by an
+    unread, incompressible ``pad`` column.  One row group per FILE
+    (``rows_per_file``): the cold filesystem's size gate must see each
+    straggler as its own multi-MB object.  ``slow_every=None`` builds
+    the uniform twin (no stragglers — the noise-band control)."""
+    from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    fs, path = get_filesystem_and_path_or_paths(url)
+    if fs.exists(path + '/_common_metadata'):
+        return
+    schema = Unischema('SkewBench', [
+        UnischemaField('noun_id', np.int64, (), None, False),
+        UnischemaField('image', np.uint8, (None, None, 3),
+                       CompressedImageCodec('jpeg', quality=85), False),
+        UnischemaField('pad', np.uint8, (None,), NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(0)
+
+    def img(hw, noisy):
+        base = np.linspace(0, 200, hw * hw * 3,
+                           dtype=np.float32).reshape(hw, hw, 3)
+        if noisy:  # per-pixel noise: many JPEG bytes per pixel
+            tex = rng.integers(0, 160, (hw, hw, 3))
+        else:      # 4x4-blocked jitter: natural-ish, compact
+            tex = rng.integers(0, 56, (hw // 4, hw // 4, 3)) \
+                     .repeat(4, 0).repeat(4, 1)
+        return np.clip(base + tex, 0, 255).astype(np.uint8)
+
+    def rows():
+        i = 0
+        for g in range(groups):
+            slow = slow_every is not None and g % slow_every == 0
+            hw = _SKEW_SLOW_HW if slow else _SKEW_FAST_HW
+            pad_n = _SKEW_PAD_BYTES if slow else _SKEW_FAST_PAD_BYTES
+            for _ in range(_SKEW_ROWS_PER_GROUP):
+                pad = rng.integers(0, 255, pad_n).astype(np.uint8)
+                yield {'noun_id': np.int64(i), 'image': img(hw, slow),
+                       'pad': pad}
+                i += 1
+
+    with DatasetWriter(url, schema,
+                       rows_per_rowgroup=_SKEW_ROWS_PER_GROUP,
+                       rows_per_file=_SKEW_ROWS_PER_GROUP) as w:
+        w.write_many(rows())
+
+
+def adaptive_sched_leg(pairs=4, seeds_per=3):
+    """Adaptive out-of-order scheduler (ISSUE 9): epoch images/s of
+    ``scheduling='adaptive'`` vs ``'fifo'`` on the skew-heavy
+    mixed-resolution JPEG dataset behind an emulated cold filesystem
+    (``BandwidthLimitedFilesystem`` — bandwidth + cold-object first-read
+    latency, both GIL-released waits that parallelize across the pool
+    like real remote storage), plus the uniform-twin control where
+    adaptive must measure within the host's ±30% noise band.
+
+    Protocol: interleaved fifo/adaptive pairs over a FIXED seed set
+    (per-seed straggler placement is part of what FIFO pays for, so the
+    seed set must be identical across variants and pairs — otherwise
+    placement variance swamps the policy effect), one epoch per reader
+    (epoch throughput: FIFO's cost IS the epoch tail), medians
+    reported.  Timing covers ITERATION only — reader setup is per-job,
+    not per-epoch, and the adaptive footer scan pays the emulated
+    cold-object latency at setup.  Delivery-order bit-identity is
+    asserted in-leg against the serialized dummy-pool reference
+    (multi-worker FIFO delivers in COMPLETION order — epoch-order
+    delivery is the adaptive reorder stage's contract, not the legacy
+    pool's)."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.benchmark.hostplane import BandwidthLimitedFilesystem
+    from petastorm_tpu.transform import ResizeImages
+
+    stragglers = (_SKEW_GROUPS + _SKEW_SLOW_EVERY - 1) // _SKEW_SLOW_EVERY
+    _ensure_skew_dataset(SKEW_DATASET_URL, _SKEW_GROUPS, _SKEW_SLOW_EVERY)
+    _ensure_skew_dataset(SKEW_UNIFORM_URL, _SKEW_GROUPS - stragglers, None)
+    import fsspec
+    cold_fs = BandwidthLimitedFilesystem(fsspec.filesystem('file'),
+                                         SKEW_COLD_BPS,
+                                         cold_latency=SKEW_COLD_LATENCY_S)
+    seeds = list(range(seeds_per))
+    sched_workers = 8  # straggler fetches must parallelize across the pool
+
+    def epoch_sweep(url, scheduling, collect_ids=False, **overrides):
+        ids = [] if collect_ids else None
+        n = 0
+        elapsed = 0.0
+        kwargs = dict(filesystem=cold_fs, workers_count=sched_workers,
+                      columnar_decode=True,
+                      transform_spec=ResizeImages({'image': (224, 224)}),
+                      shuffle_row_groups=True, num_epochs=1,
+                      scheduling=scheduling)
+        kwargs.update(overrides)
+        for seed in seeds:
+            with make_reader(url, seed=seed, **kwargs) as r:
+                t0 = time.monotonic()
+                for batch in r:
+                    n += len(batch.noun_id)
+                    if ids is not None:
+                        ids.extend(int(x) for x in batch.noun_id)
+                elapsed += time.monotonic() - t0
+        return n / elapsed, ids
+
+    epoch_sweep(SKEW_DATASET_URL, 'fifo')  # warmup: page cache, pools
+    rates = {'fifo': [], 'adaptive': []}
+    adaptive_ids = None
+    for i in range(max(1, int(pairs))):
+        rates['fifo'].append(
+            epoch_sweep(SKEW_DATASET_URL, 'fifo')[0])
+        rate, adaptive_ids_i = epoch_sweep(SKEW_DATASET_URL, 'adaptive',
+                                           collect_ids=(i == 0))
+        rates['adaptive'].append(rate)
+        if i == 0:
+            adaptive_ids = adaptive_ids_i
+    med = {k: float(np.median(v)) for k, v in rates.items()}
+    # Delivery-order contract, end to end on the real bench dataset:
+    # adaptive delivery must be bit-identical to the serialized epoch
+    # order (dummy pool = the deterministic reference; multi-worker FIFO
+    # delivers in completion order, so it is not the reference).
+    ref_ids = epoch_sweep(SKEW_DATASET_URL, 'fifo', collect_ids=True,
+                          reader_pool_type='dummy', workers_count=1)[1]
+    if ref_ids != adaptive_ids:
+        # in-leg assertion, like the transfer leg's bit-identity check:
+        # the compact-line boolean alone gates nothing (trend tracks the
+        # throughput fields), so an ordering regression must fail the
+        # leg loudly, not ship as a quietly-false field
+        raise AssertionError(
+            'adaptive delivery order diverged from the serialized epoch '
+            'order (%d vs %d rows)' % (len(adaptive_ids or ()),
+                                       len(ref_ids or ())))
+    # Uniform control: adaptive on equal-cost groups must be a wash.
+    uniform = {'fifo': [], 'adaptive': []}
+    for _ in range(2):
+        uniform['fifo'].append(
+            epoch_sweep(SKEW_UNIFORM_URL, 'fifo')[0])
+        uniform['adaptive'].append(
+            epoch_sweep(SKEW_UNIFORM_URL, 'adaptive')[0])
+    uniform_ratio = (float(np.median(uniform['adaptive']))
+                     / float(np.median(uniform['fifo']))
+                     if np.median(uniform['fifo']) else None)
+    return {
+        'adaptive_sched_images_per_sec_fifo': round(med['fifo'], 1),
+        'adaptive_sched_images_per_sec_adaptive':
+            round(med['adaptive'], 1),
+        'adaptive_sched_adaptive_over_fifo':
+            round(med['adaptive'] / med['fifo'], 2) if med['fifo']
+            else None,
+        'adaptive_sched_uniform_over_fifo':
+            round(uniform_ratio, 2) if uniform_ratio else None,
+        # processing order moves, delivery order must not
+        'adaptive_sched_delivery_identical': ref_ids == adaptive_ids,
+    }
+
+
 #: Host-only IPC/transfer-plane legs (the shm result plane's and the
 #: transfer plane's evidence sets), wired identically into the
 #: cpu-fallback and on-chip paths of main() — one table so the two paths
@@ -1322,6 +1511,7 @@ _IPC_PLANE_LEGS = (
     ('delivery_plane_service', delivery_plane_service_leg),
     ('epoch_cache_plane', epoch_cache_plane_leg),
     ('transfer_plane', transfer_plane_leg),
+    ('adaptive_sched', adaptive_sched_leg),
 )
 
 
@@ -1581,6 +1771,11 @@ _COMPACT_KEYS = (
     'transfer_plane_narrowed_over_inline',
     'transfer_plane_wire_bytes_ratio',
     'transfer_plane_bit_identical',
+    'adaptive_sched_images_per_sec_fifo',
+    'adaptive_sched_images_per_sec_adaptive',
+    'adaptive_sched_adaptive_over_fifo',
+    'adaptive_sched_uniform_over_fifo',
+    'adaptive_sched_delivery_identical',
     'ipc_bytes_per_s', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',

@@ -47,6 +47,7 @@ TRACKED_FIELDS = (
     'delivery_plane_service_images_per_sec_host_w1',
     'epoch_cache_streaming_warm_images_per_sec',
     'transfer_plane_images_per_sec_coalesced',
+    'adaptive_sched_images_per_sec_adaptive',
     'dlrm_host_rows_per_s',
 )
 

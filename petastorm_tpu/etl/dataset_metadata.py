@@ -477,6 +477,31 @@ def read_row_group_num_rows(fs, file_row_groups):
         return sum(pool.map(scan, file_row_groups.items()))
 
 
+def read_row_group_byte_sizes(fs, paths):
+    """``{(path, row_group_index): total_byte_size}`` for every row group
+    of the given files, via a threaded footer scan (one open per file).
+
+    The adaptive scheduler's epoch-0 cost prior (ISSUE 9): compressed
+    byte size is the one cheaply-knowable signal that separates a
+    mixed-resolution JPEG row group from its neighbors before a single
+    piece has been timed.
+    """
+
+    def scan(path):
+        with fs.open(path, 'rb') as handle:
+            md = pq.ParquetFile(handle).metadata
+            return [(path, i, md.row_group(i).total_byte_size)
+                    for i in range(md.num_row_groups)]
+
+    paths = sorted(set(paths))
+    if not paths:
+        return {}
+    with ThreadPoolExecutor(max_workers=min(16, len(paths))) as pool:
+        return {(path, rg): size
+                for found in pool.map(scan, paths)
+                for path, rg, size in found}
+
+
 def _write_common_metadata(fs, path, schema):
     """Write ``_common_metadata`` carrying the pickled Unischema and the
     per-file row-group count map (reference-compatible footer keys), plus the

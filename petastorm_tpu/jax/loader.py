@@ -84,13 +84,25 @@ class DataLoader(object):
         ring_slots: device-buffer ring depth for the transfer plane
             (default ``prefetch + 1``): up to ``ring_slots - 1``
             transfers stay in flight while the step runs.
+        autotune: stage autotuning (ISSUE 9).  ``'auto'`` (default)
+            activates when the underlying reader runs the adaptive
+            scheduler: a rate-limited, clamped tuner adjusts the
+            ventilation lookahead window, the ventilator in-flight
+            bound, and this loader's ``prefetch`` from measured stage
+            p50/p99s (decode skew, host_batch vs device_put) and — when
+            a ``StallMonitor`` is attached via
+            :meth:`attach_stall_monitor` — the consumer's measured wait
+            fraction.  Decisions export as ``sched_*`` gauges on
+            ``self.metrics``.  ``True`` forces it on (FIFO readers tune
+            prefetch only), ``False`` keeps every knob where you set it.
     """
 
     def __init__(self, reader, batch_size, shuffling_queue_capacity=0,
                  min_after_retrieve=None, transform_fn=None, drop_last=True,
                  prefetch=2, device=None, sharding=None, seed=None,
                  resume_state=None, echo=1, trace_recorder=None,
-                 transfer='auto', wire_dtypes=None, ring_slots=None):
+                 transfer='auto', wire_dtypes=None, ring_slots=None,
+                 autotune='auto'):
         if batch_size <= 0:
             raise ValueError('batch_size must be positive')
         if echo < 1:
@@ -164,6 +176,14 @@ class DataLoader(object):
         self._ring_slots = ring_slots
         self._plane = None
         self._pump = None
+        if autotune not in ('auto', True, False):
+            raise ValueError("autotune must be 'auto', True or False; got %r"
+                             % (autotune,))
+        self._autotune = autotune
+        self._tuner = None
+        self._tuner_ventilator = None
+        self._knobs = None
+        self._stall_monitor = None
         self._trace = trace_recorder
         if trace_recorder is not None:
             # ProcessPool children ship their spans (pool/process,
@@ -376,9 +396,98 @@ class DataLoader(object):
             yield pending.popleft()
 
     def _host_batches(self):
-        if self._batched_input:
-            return self._columnar_batches()
-        return self._row_batches()
+        gen = (self._columnar_batches() if self._batched_input
+               else self._row_batches())
+        return self._autotuned(gen)
+
+    # -- stage autotuning (ISSUE 9) ------------------------------------------
+
+    def attach_stall_monitor(self, monitor):
+        """Give the autotuner the consumer's ``StallMonitor``: its
+        measured wait fraction over each tuning window is the strongest
+        prefetch signal (the consumer actually starving vs merely skewed
+        stage quantiles)."""
+        self._stall_monitor = monitor
+        if self._tuner is not None:
+            self._tuner.attach_stall_monitor(monitor)
+
+    def _set_prefetch(self, depth):
+        # Read per batch by the inline path; the pumped path picks the
+        # new depth up at its next iteration (the pump's bound is fixed
+        # per run).
+        self._prefetch = max(1, int(depth))
+
+    def _build_autotuner(self):
+        """The loader-side autotuner, or None (autotune off, or 'auto'
+        with a FIFO reader).  Binds live setters for the three knobs it
+        owns: adaptive window, ventilator in-flight bound, prefetch."""
+        if self._autotune is False:
+            return None
+        from petastorm_tpu.workers_pool import scheduling as sched
+        ventilator = getattr(self.reader, '_ventilator', None)
+        # cache keyed on the ventilator INSTANCE: reader.reset() builds a
+        # new pool/ventilator/policy/cost model, and a tuner bound to the
+        # old ones would freeze (the fresh-samples gate reads the dead
+        # cost model) while writing knobs into stopped objects
+        if self._tuner is not None and ventilator is self._tuner_ventilator:
+            return self._tuner
+        self._tuner = None
+        policy = getattr(ventilator, '_policy', None)
+        adaptive = bool(getattr(policy, 'adaptive', False))
+        if self._autotune == 'auto' and not adaptive:
+            return None
+        knobs = sched.SchedulerKnobs(
+            window=getattr(policy, 'window', sched.MIN_WINDOW),
+            max_inflight=getattr(ventilator, 'max_inflight',
+                                 sched.MIN_INFLIGHT),
+            prefetch=self._prefetch)
+        if adaptive:
+            knobs.bind('window',
+                       lambda v, p=policy: setattr(p, 'window', v))
+            # the in-flight bound doubles as the reorder-depth knob, so
+            # it is only the tuner's to move on adaptive readers — on a
+            # FIFO reader (autotune=True) shrinking it would just
+            # throttle the pipeline below the pool size ("FIFO readers
+            # tune prefetch only", the documented contract)
+            if ventilator is not None \
+                    and hasattr(ventilator, 'set_max_inflight'):
+                knobs.bind('max_inflight', ventilator.set_max_inflight)
+        knobs.bind('prefetch', self._set_prefetch)
+        self._knobs = knobs
+        # the no-skew shrink floor scales with the pool: the in-flight
+        # bound counts undelivered positions (ack-on-delivery), so
+        # dropping it below 2x workers would idle workers FIFO's own
+        # default bound keeps busy
+        workers = getattr(getattr(self.reader, '_pool', None),
+                          'workers_count', 0) or 0
+        self._tuner = sched.Autotuner(
+            registry=self.metrics,
+            cost_model=getattr(self.reader, 'cost_model', None),
+            stall_monitor=self._stall_monitor,
+            min_inflight=max(sched.MIN_INFLIGHT, 2 * workers))
+        self._tuner_ventilator = ventilator
+        # publish the starting point so the gauges tell the whole story
+        self.metrics.gauge('sched_window').set(knobs.window)
+        self.metrics.gauge('sched_max_inflight').set(knobs.max_inflight)
+        self.metrics.gauge('sched_prefetch').set(knobs.prefetch)
+        return self._tuner
+
+    def _autotuned(self, gen):
+        tuner = self._build_autotuner()
+        if tuner is None:
+            return gen
+        reader_metrics = getattr(self.reader, 'metrics', None)
+        decode_hist = (reader_metrics.histogram('decode')
+                       if reader_metrics is not None else None)
+        host_hist = self._m_stage['host_batch'][1]
+        put_hist = self._m_stage['device_put'][1]
+
+        def ticked():
+            for batch in gen:
+                yield batch
+                tuner.maybe_tune(self._knobs, decode=decode_hist,
+                                 host_batch=host_hist, device_put=put_hist)
+        return ticked()
 
     def _echoed_host_batches(self):
         """Host batches with data echoing: each decoded batch repeats

@@ -148,7 +148,7 @@ def make_reader(dataset_url,
                 storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                 seed=None, resume_state=None, zmq_copy_buffers=True,
                 columnar_decode=False, read_retries=2, retry_backoff_s=0.1,
-                piece_indices=None):
+                piece_indices=None, scheduling='auto'):
     """Reader over a petastorm-format dataset (codec-decoded rows).
 
     Parity: ``petastorm/reader.py :: make_reader`` (argument names kept,
@@ -167,6 +167,17 @@ def make_reader(dataset_url,
     a reader.  Mutually exclusive with ``cur_shard``/``shard_count`` and
     with ``rowgroup_selector``/``filters`` (both renumber or prune the
     global piece list the indices refer to).
+
+    ``scheduling`` (extension, ISSUE 9): dispatch-order policy of the
+    decode plane.  ``'fifo'`` processes row groups in the epoch
+    permutation order; ``'adaptive'`` launches predicted-slow row groups
+    early within a bounded lookahead window (an online cost model fed by
+    per-item decode timings, seeded from row-group sizes) while a
+    bounded reorder stage keeps DELIVERY in exact epoch order — shuffle
+    determinism and resume tokens are bit-unchanged.  ``'auto'``
+    (default) picks ``'adaptive'`` when there is anything to gain
+    (multi-worker pool, enough row groups) and ``'fifo'`` otherwise;
+    ``PETASTORM_TPU_NO_ADAPTIVE_SCHED=1`` forces ``'fifo'`` everywhere.
     """
     fs, path = get_filesystem_and_path_or_paths(
         dataset_url, storage_options=storage_options, filesystem=filesystem,
@@ -188,7 +199,8 @@ def make_reader(dataset_url,
         transform_spec=transform_spec, filters=filters, seed=seed,
         resume_state=resume_state, zmq_copy_buffers=zmq_copy_buffers,
         columnar_decode=columnar_decode, read_retries=read_retries,
-        retry_backoff_s=retry_backoff_s, piece_indices=piece_indices)
+        retry_backoff_s=retry_backoff_s, piece_indices=piece_indices,
+        scheduling=scheduling)
 
 
 def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
@@ -200,7 +212,7 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
                         cache_row_size_estimate, cache_extra_settings,
                         transform_spec, filters, seed, resume_state, zmq_copy_buffers,
                         columnar_decode=False, read_retries=2, retry_backoff_s=0.1,
-                        piece_indices=None):
+                        piece_indices=None, scheduling='auto'):
     from petastorm_tpu.ngram import NGram
     from petastorm_tpu.py_dict_reader_worker import PyDictReaderWorker, RowWorkerArgs
 
@@ -276,7 +288,8 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
                   items=items, schema=result_schema, ngram=ngram,
                   shuffle_items=shuffle_row_groups, num_epochs=num_epochs,
                   seed=seed, resume_state=resume_state, cache=cache,
-                  result_converter=converter, topology=topology)
+                  result_converter=converter, topology=topology,
+                  scheduling=scheduling)
 
 
 class _ColumnarDictConverter(object):
@@ -324,7 +337,8 @@ def make_batch_reader(dataset_url_or_urls,
                       transform_spec=None, filters=None,
                       storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                       seed=None, resume_state=None, zmq_copy_buffers=True,
-                      read_retries=2, retry_backoff_s=0.1, piece_indices=None):
+                      read_retries=2, retry_backoff_s=0.1, piece_indices=None,
+                      scheduling='auto'):
     """Columnar reader over *any* Parquet store (no petastorm metadata needed).
 
     Parity: ``petastorm/reader.py :: make_batch_reader``.  Yields namedtuples
@@ -332,6 +346,8 @@ def make_batch_reader(dataset_url_or_urls,
 
     ``piece_indices`` (extension): read exactly these global row-group
     indices instead of sharding — see :func:`make_reader`.
+    ``scheduling`` (extension): dispatch-order policy — see
+    :func:`make_reader`.
     """
     from petastorm_tpu.arrow_reader_worker import (ArrowReaderWorker,
                                                    BatchWorkerArgs,
@@ -396,7 +412,7 @@ def make_batch_reader(dataset_url_or_urls,
                   shuffle_items=shuffle_row_groups, num_epochs=num_epochs,
                   seed=seed, resume_state=resume_state, cache=cache,
                   result_converter=ArrowResultConverter(result_schema),
-                  topology=topology)
+                  topology=topology, scheduling=scheduling)
 
 
 class Reader(object):
@@ -409,7 +425,18 @@ class Reader(object):
 
     def __init__(self, *, pool, worker_class, worker_args, items, schema, ngram,
                  shuffle_items, num_epochs, seed, resume_state, cache,
-                 result_converter=None, topology=None):
+                 result_converter=None, topology=None, scheduling='auto'):
+        from petastorm_tpu.workers_pool import scheduling as _sched
+        #: requested mode; the EFFECTIVE mode (after 'auto' resolution and
+        #: the kill switch) is the public ``scheduling`` attribute, set in
+        #: _start.  Resolved per start so reset() re-evaluates the env.
+        self._scheduling_requested = scheduling
+        # validate eagerly — a typo must fail before threads spin up
+        _sched.resolve_scheduling(scheduling, len(items),
+                                  pool.workers_count)
+        self.scheduling = None
+        self.cost_model = None
+        self._reorder = None
         self.schema = schema
         self.ngram = ngram
         #: True for the columnar (make_batch_reader) path: __next__ yields
@@ -490,9 +517,56 @@ class Reader(object):
                 'data.' % ', '.join(mismatches))
 
     def _start(self, start_epoch=0, start_cursor=0, prologue=()):
+        from petastorm_tpu.workers_pool import scheduling as _sched
         # Small in-flight window: keeps resume tokens tight and bounds memory;
         # large enough to never starve the workers.
         window = max(2 * self._pool.workers_count, 4)
+        self.scheduling = _sched.resolve_scheduling(
+            self._scheduling_requested, len(self._items),
+            self._pool.workers_count)
+        policy = None
+        self._reorder = None
+        self.cost_model = None
+        if self.scheduling == 'adaptive':
+            # Online cost model: seeded from row-group byte sizes so
+            # epoch 0 already ranks pieces; every pool ack refines it.
+            # The lookahead window scales with the pool (more workers =
+            # more reordering headroom) inside the autotuner's clamps.
+            self.cost_model = _sched.PieceCostModel()
+            self.cost_model.seed(self._scheduling_weights())
+            # Lookahead spans the whole epoch (clamped): the window is
+            # only an ORDER-selection horizon — memory/latency are
+            # bounded by the in-flight window, because ack-on-delivery
+            # counts undelivered positions against it.  Deeper in-flight
+            # than FIFO's 2x-workers: slow pieces launched early hold
+            # their slot until their delivery turn.
+            # early_limit: keep at least half the pool on the in-order
+            # fast stream — front-loading every worker with slow pieces
+            # would stall delivery until the first one lands.
+            policy = _sched.AdaptiveDispatchPolicy(
+                self.cost_model,
+                window=min(_sched.MAX_WINDOW,
+                           max(_sched.MIN_WINDOW, len(self._items))),
+                early_limit=max(1, self._pool.workers_count // 2))
+            # The in-flight bound counts UNDELIVERED positions, so it
+            # must cover a straggler's worth of fast completions piling
+            # up behind it — too shallow and the fast stream freezes
+            # that many positions past a blocked early-permutation
+            # straggler, idling the pool for the rest of its fetch (the
+            # exact worker-idle stall the scheduler exists to kill).
+            # 16x the pool (8x FIFO's 2x-workers window), capped at the
+            # autotuner clamp ceiling: worst-case reorder memory is the
+            # bound in completed row groups, so it must SCALE with the
+            # decode resources the user already sized, not sit at a
+            # flat 128 — bare make_reader consumers have no autotuner
+            # to shrink it (a DataLoader's tuner moves it both ways
+            # from measured skew).
+            window = min(16 * self._pool.workers_count,
+                         max(len(self._items), 1), _sched.MAX_INFLIGHT)
+            n = max(len(self._items), 1)
+            self._reorder = _sched.ReorderBuffer(
+                start_position=start_epoch * n + start_cursor,
+                prologue_count=len(prologue))
         self._ventilator = ConcurrentVentilator(
             ventilate_fn=self._pool.ventilate,
             items=self._items,
@@ -502,8 +576,51 @@ class Reader(object):
             max_ventilation_queue_size=max(
                 1, min(len(self._items) + len(prologue), window)),
             start_epoch=start_epoch, start_cursor=start_cursor,
-            prologue_items=prologue)
-        self._pool.start(self._worker_class, self._worker_args, ventilator=self._ventilator)
+            prologue_items=prologue, dispatch_policy=policy)
+        self._pool.start(self._worker_class, self._worker_args,
+                         ventilator=self._ventilator, reorder=self._reorder)
+
+    def _scheduling_weights(self):
+        """Epoch-0 cost priors for the adaptive scheduler, cached across
+        reset(): per-piece compressed byte sizes from a one-time threaded
+        footer scan (the one cheap signal that separates a heavy
+        mixed-resolution row group from its neighbors before anything is
+        timed), falling back to row counts — then uniform — when the
+        footers are unreachable."""
+        if getattr(self, '_sched_weights', None) is not None:
+            return self._sched_weights
+        from petastorm_tpu.workers_pool import scheduling as _sched
+        pieces = getattr(self._worker_args, 'pieces', ())
+        weights = _sched.piece_weights(self._items, pieces)
+        try:
+            from petastorm_tpu.etl.dataset_metadata import \
+                read_row_group_byte_sizes
+            local = sorted({i for i, _ in self._items
+                            if isinstance(i, int) and 0 <= i < len(pieces)})
+            paths = {pieces[i].path for i in local}
+            if len(paths) > _sched.MAX_PRIOR_SCAN_FILES:
+                # one footer open per file: past the cap the scan itself
+                # dominates reader startup (remote stores pay a GET per
+                # file) — row-count priors + first-ack timings instead
+                logger.debug(
+                    'scheduling prior: %d files exceeds the footer-scan '
+                    'cap (%d); using row-count priors', len(paths),
+                    _sched.MAX_PRIOR_SCAN_FILES)
+                self._sched_weights = weights
+                return weights
+            sizes = read_row_group_byte_sizes(
+                self._worker_args.filesystem, paths)
+            byte_weights = {
+                i: sizes[(pieces[i].path, pieces[i].row_group)]
+                for i in local
+                if (pieces[i].path, pieces[i].row_group) in sizes}
+            if byte_weights:
+                weights = byte_weights
+        except Exception:  # noqa: BLE001 — priors are best-effort
+            logger.debug('row-group byte-size scan failed; cost priors '
+                         'fall back to row counts', exc_info=True)
+        self._sched_weights = weights
+        return weights
 
     # -- resume --------------------------------------------------------------
 
@@ -636,7 +753,10 @@ class Reader(object):
         if self._result_converter is None and self._row_buffer:
             drained.extend(self._convert_row(r) for r in self._row_buffer)
             self._row_buffer = []
-        while self._ventilator.has_outstanding():
+        # Deliverable only: under out-of-order dispatch, positions held
+        # past an undispatched gap can never release while paused — the
+        # token replays them, so waiting on them would spin forever.
+        while self._ventilator.has_deliverable_outstanding():
             try:
                 results = self._pool.get_results(timeout=0.2)
             except TimeoutWaitingForResultError:
@@ -718,6 +838,11 @@ class Reader(object):
         if cache_stats:
             d.update(cache_stats)
         d['ventilated_count'] = self._ventilator.ventilated_count
+        d['scheduling'] = self.scheduling
+        # results staged behind an earlier incomplete position (adaptive
+        # only; 0 when idle/fifo) — the reorder stage's live depth
+        d['reorder_pending'] = (self._reorder.pending_results
+                                if self._reorder is not None else 0)
         token = self._ventilator.state_dict()
         # the prologue item list is data, not a gauge — report its length
         d['prologue_remaining'] = len(token.pop('prologue', ()))

@@ -83,6 +83,15 @@ class ServiceConfig:
             at host-local paths — the plane is a same-host cache.
         cache_plane_ram_bytes / cache_plane_disk_bytes: per-tier byte
             caps (None = the plane's defaults: 128 MiB hot, 4 GiB disk).
+        scheduling: dispatch-order policy every per-split reader runs
+            with (``'auto'`` / ``'fifo'`` / ``'adaptive'`` — see
+            ``make_reader(scheduling=)``).  Splits are small by design
+            (``rowgroups_per_split``), so ``'auto'`` usually resolves to
+            FIFO per split; the field exists so a skew-heavy job can
+            force ``'adaptive'`` fleet-wide from one place, and so the
+            ``PETASTORM_TPU_NO_ADAPTIVE_SCHED=1`` kill switch has a
+            config-level mirror.  An explicit ``scheduling`` in
+            ``reader_kwargs`` wins.
         telemetry_spans: ship each split's correlated stage spans
             (decode / serialize / shm publish / cache fill) on its
             ``end`` header so clients with a ``trace_recorder`` merge
@@ -109,6 +118,7 @@ class ServiceConfig:
     cache_plane_dir: str = None
     cache_plane_ram_bytes: int = None
     cache_plane_disk_bytes: int = None
+    scheduling: str = 'auto'
     telemetry_spans: bool = True
 
     def __post_init__(self):
@@ -129,6 +139,9 @@ class ServiceConfig:
             raise ValueError('shm_capacity_bytes must be positive')
         if self.cache_plane and not self.cache_plane_dir:
             raise ValueError('cache_plane=True requires cache_plane_dir')
+        if self.scheduling not in ('auto', 'fifo', 'adaptive'):
+            raise ValueError("scheduling must be 'auto', 'fifo' or "
+                             "'adaptive', got %r" % (self.scheduling,))
         if self.heartbeat_interval_s is None:
             self.heartbeat_interval_s = self.lease_ttl_s / 3.0
 
@@ -162,6 +175,7 @@ class ServiceConfig:
             'cache_plane_dir': self.cache_plane_dir,
             'cache_plane_ram_bytes': self.cache_plane_ram_bytes,
             'cache_plane_disk_bytes': self.cache_plane_disk_bytes,
+            'scheduling': self.scheduling,
             'telemetry_spans': bool(self.telemetry_spans),
             'fingerprint': self.fingerprint(num_splits),
         }

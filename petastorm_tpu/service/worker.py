@@ -619,6 +619,10 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         worker per epoch, and any worker can SERVE it warm afterwards).
         Explicit cache settings in ``reader_kwargs`` win."""
         kwargs = dict(job['reader_kwargs'])
+        # Per-split readers inherit the job's dispatch policy (ISSUE 9);
+        # an explicit reader_kwargs['scheduling'] wins, and 'auto' still
+        # degrades to fifo on splits too small to reorder.
+        kwargs.setdefault('scheduling', job.get('scheduling', 'auto'))
         if job.get('cache_plane') and 'cache_type' not in kwargs:
             kwargs['cache_type'] = 'plane'
             kwargs.setdefault('cache_location', job['cache_plane_dir'])

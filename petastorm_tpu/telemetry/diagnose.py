@@ -67,6 +67,12 @@ _REGIME_ACTIONS = {
     'shm-degraded': (
         'raise the shm arena capacity or /dev/shm size; a slow consumer '
         'pinning slabs also fills the arena — check client drain rate'),
+    'skew-bound': (
+        "enable the adaptive out-of-order scheduler (scheduling="
+        "'adaptive' on make_reader / ServiceConfig): slow pieces launch "
+        'early and fast pieces backfill the stall window — adding '
+        'workers would idle just the same; '
+        'PETASTORM_TPU_NO_ADAPTIVE_SCHED=1 is the kill switch'),
 }
 
 #: |clock_drift_ms| above this breaks cross-process span ordering at
@@ -219,6 +225,15 @@ def _regime_verdicts(evidence):
                 evidence_bits.append(
                     'h2d (link) p99 %s ms vs h2d_stage (host copy) '
                     'p99 %s ms' % (link, stage))
+        elif regime == 'skew-bound':
+            for name in ('decode', 'decode_split'):
+                stage = stages.get(name)
+                if stage and stage.get('p99_ms') is not None:
+                    evidence_bits.append(
+                        '%s p50 %s ms vs p99 %s ms over %d items'
+                        % (name, stage.get('p50_ms'), stage.get('p99_ms'),
+                           stage.get('count', 0)))
+                    break
         elif regime == 'cache-degraded':
             worker = _worst_worker(evidence, 'cache_degraded')
             if worker:

@@ -33,16 +33,26 @@ Regime catalogue (``classify_regime``):
 * ``shm-degraded``   — the zero-copy result plane is falling back to
   the byte path (arena full, /dev/shm unusable).  Knobs: arena
   capacity, /dev/shm size, consumer drain rate.
+* ``skew-bound``     — per-item decode latency is heavily skewed
+  (p99/p50 over :data:`SKEW_RATIO_FLOOR`) while workers show idle gaps
+  (``meta['decode_utilization']`` under :data:`SKEW_UTILIZATION_CEIL`,
+  or the consumer stalls on decode): a few slow pieces head-of-line
+  block the epoch while the rest of the pool idles.  Knob:
+  ``scheduling='adaptive'`` (the ISSUE 9 out-of-order scheduler) —
+  more workers would idle just the same.
 * ``healthy`` / ``idle`` — nothing above threshold / no traffic at all.
 """
 
-from petastorm_tpu.telemetry.registry import summarize_hist
+import math
+
+from petastorm_tpu.telemetry.registry import hist_quantile, summarize_hist
+from petastorm_tpu.workers_pool.scheduling import SKEW_RATIO_FLOOR
 
 __all__ = ['classify_regime', 'health_report', 'report_from_frames',
            'export_gauges', 'busy_seconds', 'degrade_ratios', 'REGIMES']
 
 REGIMES = ('decode-bound', 'link-bound', 'lease-starved', 'cache-degraded',
-           'shm-degraded', 'healthy', 'idle')
+           'shm-degraded', 'skew-bound', 'healthy', 'idle')
 
 #: Histogram name -> pipeline component.  Names from every registry the
 #: fleet merges: service workers (decode_split/serialize/shm_publish),
@@ -72,6 +82,15 @@ DEGRADE_RATIO_FLOOR = 0.02
 MIN_BUSY_S = 0.25
 #: ...and the dominant component must hold at least this share.
 BUSY_SHARE_FLOOR = 0.6
+#: Per-item decode p99/p50 at or above SKEW_RATIO_FLOOR (imported from
+#: the scheduler — ONE threshold: what diagnose calls skew-bound must be
+#: exactly what the autotuner treats as skew) reads as cost skew.
+#: ...but skew only names the regime when workers also show idle gaps:
+#: pool decode_utilization at or below this (all-busy skew is just
+#: decode-bound — add workers; idle skew needs reordering).
+SKEW_UTILIZATION_CEIL = 0.6
+#: ...and enough samples that the quantile ratio means something.
+SKEW_MIN_COUNT = 16
 
 
 def busy_seconds(delta):
@@ -132,6 +151,29 @@ def classify_regime(delta, stall_pct=None, meta=None):
                 '%s %d = %.0f%% of %s-plane traffic this window'
                 % (counter_name, degraded, 100.0 * ratio, plane)))
 
+    # 1b. decode-latency skew with idle workers (ISSUE 9): a handful of
+    # slow pieces serializing the epoch is a SCHEDULING problem — it
+    # must outrank the decode-bound busy-share reading at heavy skew,
+    # because the decode-bound knob (more workers) cannot fix it.
+    skew = _decode_skew(delta)
+    if skew is not None:
+        ratio, hist_name = skew
+        utilization = (meta or {}).get('decode_utilization')
+        idle_evidence = None
+        if utilization is not None and utilization <= SKEW_UTILIZATION_CEIL:
+            idle_evidence = ('pool decode_utilization %.2f'
+                             % float(utilization))
+        elif stall_pct and float(stall_pct.get('decode', 0.0) or 0.0) \
+                >= STALL_PCT_FLOOR:
+            idle_evidence = ('consumer stalled on decode %.0f%% of waits'
+                            % float(stall_pct['decode']))
+        if ratio >= SKEW_RATIO_FLOOR and idle_evidence is not None:
+            candidates.append((
+                min(1.0, 0.6 + math.log2(ratio) / 16.0),
+                'skew-bound',
+                '%s p99/p50 = %.0fx with %s'
+                % (hist_name, ratio, idle_evidence)))
+
     # 2. span-level stall attribution (the strongest stage evidence).
     if stall_pct:
         by_regime = {}
@@ -173,6 +215,24 @@ def classify_regime(delta, stall_pct=None, meta=None):
 
     candidates.sort(key=lambda c: c[0], reverse=True)
     return candidates
+
+
+def _decode_skew(delta):
+    """(p99/p50 ratio, histogram name) of the busiest per-item decode
+    histogram in the window, or None without enough signal."""
+    best = None
+    for name in ('decode', 'decode_split'):
+        hist = (delta or {}).get('histograms', {}).get(name)
+        if not hist or int(hist.get('count', 0)) < SKEW_MIN_COUNT:
+            continue
+        p50 = hist_quantile(hist, 0.5)
+        p99 = hist_quantile(hist, 0.99)
+        if not p50 or p99 is None:
+            continue
+        ratio = p99 / p50
+        if best is None or ratio > best[0]:
+            best = (ratio, name)
+    return best
 
 
 def health_report(delta, stall_pct=None, meta=None, window_s=None):

@@ -115,6 +115,13 @@ def _check_host_plane(dataset_url, seconds, batch_size, advisor_out=None):
         # spin-up + first row-group read excluded from both).
         rows, dt = pump_host_batches(loader, seconds, warmup_batches=1)
         stats = dict(loader.stats)
+        # Scheduling surface (ISSUE 9): the effective dispatch policy
+        # after 'auto' resolution, plus the measured per-item decode
+        # skew — p99/p50 >= 8x with idle workers is the skew-bound
+        # regime scheduling='adaptive' exists for (see diagnose).
+        diag = dict(getattr(reader, 'diagnostics', None) or {})
+        sched = diag.get('scheduling')
+        p50, p99 = diag.get('decode_p50_ms'), diag.get('decode_p99_ms')
         if advisor_out is not None:
             verdict = diagnose(loader)
             advisor_out.update({
@@ -126,6 +133,9 @@ def _check_host_plane(dataset_url, seconds, batch_size, advisor_out=None):
                         'examples/imagenet',
             })
     out = {'reader': kind, 'rows_per_s': round(rows / dt, 1), 'rows': rows,
+           'scheduling': sched,
+           'decode_skew_p99_over_p50': (round(p99 / p50, 1)
+                                        if p50 and p99 else None),
            'stage_seconds': {k: round(v, 3) for k, v in stats.items()
                              if k.endswith('_s')},
            # rows_per_s is measured AFTER the one-batch warmup;

@@ -20,6 +20,8 @@ class DummyPool(object):
         self._results = deque()
         self._worker = None
         self._ventilator = None
+        self._reorder = None
+        self._position = None
         self._stopped = False
         #: Uniform registry surface across pool classes (ISSUE 5).
         self.metrics = MetricsRegistry('dummy_pool')
@@ -29,12 +31,23 @@ class DummyPool(object):
         self._started_at = None
         self._stopped_at = None
 
-    def start(self, worker_class, worker_setup_args=None, ventilator=None):
-        self._worker = worker_class(0, self._results.append, worker_setup_args)
+    def start(self, worker_class, worker_setup_args=None, ventilator=None,
+              reorder=None):
+        self._worker = worker_class(0, self._publish, worker_setup_args)
         self._ventilator = ventilator
+        self._reorder = reorder
+        self._position = None
         self._started_at = time.monotonic()
         if ventilator is not None:
             ventilator.start()
+
+    def _publish(self, result):
+        # Single-threaded pool, but an out-of-order dispatch policy still
+        # needs the reorder stage to restore epoch-order delivery.
+        if self._reorder is not None and self._position is not None:
+            self._reorder.add(self._position, result)
+            return
+        self._results.append(result)
 
     def ventilate(self, *args, **kwargs):
         self._pending.append((args, kwargs))
@@ -47,16 +60,26 @@ class DummyPool(object):
                 position = None
                 if len(args) == 1 and isinstance(args[0], VentilatedItem):
                     position, args = args[0].position, tuple(args[0].args)
+                self._position = position
                 started = time.monotonic()
                 sleep_before = getattr(self._worker, 'retry_sleep_s', 0.0)
-                self._worker.process(*args, **kwargs)
+                try:
+                    self._worker.process(*args, **kwargs)
+                finally:
+                    self._position = None
                 slept = getattr(self._worker, 'retry_sleep_s', 0.0) - sleep_before
                 elapsed = max(0.0, time.monotonic() - started - slept)
                 self._m_busy.inc(elapsed)
                 self._m_decode.observe(elapsed)
                 self._m_items.inc()
-                if self._ventilator is not None:
-                    self._ventilator.processed_item(position)
+                if self._reorder is not None and position is not None:
+                    # ack-on-delivery: ReorderBuffer.release holds the
+                    # publish-then-ack drain invariant
+                    self._reorder.release(position, elapsed,
+                                          self._results.append,
+                                          self._ventilator)
+                elif self._ventilator is not None:
+                    self._ventilator.processed_item(position, elapsed)
             elif self._ventilator is not None and not self._ventilator.completed():
                 # Ventilator thread may still be filling us; spin briefly —
                 # but honor the timeout (a PAUSED ventilator never completes,

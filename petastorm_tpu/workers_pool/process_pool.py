@@ -72,12 +72,18 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
         self._endpoint_dir = None
         self._processes = []
         self._ventilator = None
+        #: Optional scheduling.ReorderBuffer (ISSUE 9): children append a
+        #: position frame to every result message; the parent buffers per
+        #: position and serves ``_ready`` in exact epoch order.
+        self._reorder = None
+        self._ready = deque()
         self._inflight = 0
         self._started_at = None
         self._stopped_at = None
         self._stopped = False
 
-    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+    def start(self, worker_class, worker_setup_args=None, ventilator=None,
+              reorder=None):
         import zmq
 
         from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
@@ -85,6 +91,7 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
 
         self._pickle_ser = PickleSerializer()
         self._arrow_ser = ArrowTableSerializer()
+        self._reorder = reorder
 
         self._context = zmq.Context()
         # Owned for the pool's lifetime; join() removes it (lint
@@ -111,7 +118,8 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
             # pid and never detect the orphaning.
             setup_payload = pickle.dumps(
                 (worker_class, worker_setup_args, work_addr, sink_addr,
-                 self._zmq_copy_buffers, use_shm, capacity, os.getpid()),
+                 self._zmq_copy_buffers, use_shm, capacity, os.getpid(),
+                 reorder is not None),
                 protocol=4)
         except Exception:
             # Unpicklable worker args (e.g. a closure transform): fail clean,
@@ -146,13 +154,23 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
         poller.register(self._sink_socket, zmq.POLLIN)
         waited = 0
         while True:
+            if self._ready:
+                # reorder stage: results released in epoch order by acks
+                return self._ready.popleft()
             events = dict(poller.poll(50))
             if self._sink_socket in events:
-                tag, payload = self._sink_socket.recv_multipart()
+                frames = self._sink_socket.recv_multipart()
+                tag, payload = frames[0], frames[1]
                 if tag == b'R':
-                    return self._pickle_ser.deserialize(payload)
+                    result = self._pickle_ser.deserialize(payload)
+                    if self._stage_result(frames, result):
+                        continue
+                    return result
                 if tag == b'A':
-                    return self._arrow_ser.deserialize(payload)
+                    result = self._arrow_ser.deserialize(payload)
+                    if self._stage_result(frames, result):
+                        continue
+                    return result
                 if tag in (b'P', b'T'):
                     # shm plane: payload is a descriptor; the worker's
                     # slab maps zero-copy and returns to the worker when
@@ -174,6 +192,8 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
                             'parent read it — worker process died '
                             'mid-stream? (%s)' % e)
                     self._m_shm_results.inc()
+                    if self._stage_result(frames, result):
+                        continue
                     return result
                 if tag == b'K':
                     ack = pickle.loads(payload)
@@ -192,8 +212,16 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
                     self._inflight -= 1
                     self._m_items.inc()
                     self._m_busy.inc(busy_s)
-                    if self._ventilator is not None:
-                        self._ventilator.processed_item(position)
+                    if self._reorder is not None and position is not None:
+                        # ack-on-delivery: ReorderBuffer.release holds
+                        # the publish-then-ack drain invariant
+                        self._reorder.release(position, busy_s,
+                                              self._ready.append,
+                                              self._ventilator)
+                    elif self._ventilator is not None:
+                        # busy_s is the ack-timing plumb: the child's wall
+                        # time for this item feeds the cost model
+                        self._ventilator.processed_item(position, busy_s)
                     continue
                 if tag == b'E':
                     exc, tb_str = pickle.loads(payload)
@@ -216,10 +244,23 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
                        sum(p.poll() is None for p in self._processes),
                        len(self._processes)))
 
+    def _stage_result(self, frames, result):
+        """Route a positioned result into the reorder buffer (frame 3 is
+        the pickled position, present only when the child was started
+        with reordering on).  Returns True when staged."""
+        if self._reorder is None or len(frames) < 3:
+            return False
+        position = pickle.loads(frames[2])
+        if position is None:
+            return False
+        self._reorder.add(position, result)
+        return True
+
     def _all_done(self):
         if self._ventilator is not None and not self._ventilator.completed():
             return False
-        return self._inflight == 0
+        return self._inflight == 0 and not self._ready \
+            and (self._reorder is None or self._reorder.empty())
 
     def stop(self):
         if self._stopped:

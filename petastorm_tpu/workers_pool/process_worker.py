@@ -44,8 +44,14 @@ def worker_main(setup_payload, worker_id):
     from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
     from petastorm_tpu.workers_pool import shm_plane
 
+    payload = pickle.loads(setup_payload)
     worker_class, worker_args, work_addr, sink_addr, copy_buffers, \
-        use_shm, shm_capacity, parent_pid = pickle.loads(setup_payload)
+        use_shm, shm_capacity, parent_pid = payload[:8]
+    # Positioned result framing (ISSUE 9 reorder stage): every result
+    # message grows a trailing pickled-position frame so the parent can
+    # restore epoch-order delivery.  Old-style 8-tuple payloads (none in
+    # tree, but the framing is feature-flagged either way) default off.
+    reorder = payload[8] if len(payload) > 8 else False
 
     # Child-side telemetry (ISSUE 5): one registry + the process-local
     # span buffer (shared with the cache plane's fill spans); both ride
@@ -82,25 +88,26 @@ def worker_main(setup_payload, worker_id):
             spans.span('pool/publish', t_pub, time.monotonic(),
                        cid=current_position[0])
 
+    def _send(frames, **kwargs):
+        if reorder:
+            frames = frames + [pickle.dumps(current_position[0], protocol=4)]
+        sink_socket.send_multipart(frames, **kwargs)
+
     def _publish(result):
         if isinstance(result, pa.Table):
             if arena is not None:
                 desc = shm_plane.write_table(arena, result, arrow_ser)
                 if desc is not None:
-                    sink_socket.send_multipart(
-                        [b'T', pickle.dumps(desc, protocol=4)])
+                    _send([b'T', pickle.dumps(desc, protocol=4)])
                     return
-            sink_socket.send_multipart([b'A', arrow_ser.serialize(result)],
-                                       copy=copy_buffers)
+            _send([b'A', arrow_ser.serialize(result)], copy=copy_buffers)
         else:
             if arena is not None:
                 desc = shm_plane.write_pickled(arena, result, pickle_ser)
                 if desc is not None:
-                    sink_socket.send_multipart(
-                        [b'P', pickle.dumps(desc, protocol=4)])
+                    _send([b'P', pickle.dumps(desc, protocol=4)])
                     return
-            sink_socket.send_multipart([b'R', pickle_ser.serialize(result)],
-                                       copy=copy_buffers)
+            _send([b'R', pickle_ser.serialize(result)], copy=copy_buffers)
 
     import time
 

@@ -39,6 +39,15 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
         self._threads = []
         self._workers = []
         self._ventilator = None
+        #: Optional scheduling.ReorderBuffer (ISSUE 9): results buffer per
+        #: position and publish in exact epoch order; None = completion
+        #: order (the legacy behavior, and the FIFO default).
+        self._reorder = None
+        #: serializes reorder release batches: complete() is atomic, but
+        #: two workers publishing their released runs concurrently could
+        #: interleave them on the results queue.
+        self._flush_lock = threading.Lock()
+        self._tls = threading.local()  # per-worker-thread current position
         self._stop_event = threading.Event()
         self._inflight_lock = threading.Lock()
         self._inflight = 0  # ventilated but result-not-yet-consumed items
@@ -53,8 +62,10 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
         self._stopped_at = None
         self._profiler = profiler
 
-    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+    def start(self, worker_class, worker_setup_args=None, ventilator=None,
+              reorder=None):
         self._ventilator = ventilator
+        self._reorder = reorder
         self._started_at = time.monotonic()
         for worker_id in range(self.workers_count):
             worker = worker_class(worker_id, self._publish, worker_setup_args)
@@ -72,6 +83,18 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
         self._input_queue.put((args, kwargs))
 
     def _publish(self, result):
+        # With a reorder buffer, positioned results stage per position and
+        # only reach the queue once every earlier position completed (the
+        # worker's finally flushes).  Worker errors never pass through
+        # here — the processing loop's except path puts _WorkerError on
+        # the queue directly, preempting delivery as on the legacy path.
+        position = getattr(self._tls, 'position', None)
+        if self._reorder is not None and position is not None:
+            self._reorder.add(position, result)
+            return
+        self._put_result(result)
+
+    def _put_result(self, result):
         # Bounded put that stays responsive to stop(): a worker blocked on a
         # full results queue must not deadlock teardown.
         while not self._stop_event.is_set():
@@ -94,6 +117,7 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
                 position = None
                 if len(args) == 1 and isinstance(args[0], VentilatedItem):
                     position, args = args[0].position, tuple(args[0].args)
+                self._tls.position = position
                 started = time.monotonic()
                 sleep_before = getattr(worker, 'retry_sleep_s', 0.0)
                 try:
@@ -103,20 +127,31 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
                     # Same stop-responsive put as results: a bare put on the
                     # bounded queue could block forever during teardown and
                     # keep this thread (and its worker's files) alive.
-                    self._publish(_WorkerError(e, traceback.format_exc()))
+                    self._put_result(_WorkerError(e, traceback.format_exc()))
                 finally:
                     # Retry-backoff sleeps are waiting, not decoding —
                     # excluding them keeps decode_utilization an honest
                     # decode-work measure.
                     slept = getattr(worker, 'retry_sleep_s', 0.0) - sleep_before
                     elapsed = max(0.0, time.monotonic() - started - slept)
+                    self._tls.position = None
                     with self._inflight_lock:
                         self._inflight -= 1
                     self._m_items.inc()
                     self._m_busy.inc(elapsed)
                     self._m_decode.observe(elapsed)
-                    if self._ventilator is not None:
-                        self._ventilator.processed_item(position)
+                    if self._reorder is not None and position is not None:
+                        # Ack-on-delivery: ReorderBuffer.release holds
+                        # the publish-then-ack drain invariant.  One
+                        # release batch publishes atomically; the flush
+                        # lock keeps two workers' batches from
+                        # interleaving.
+                        with self._flush_lock:
+                            self._reorder.release(position, elapsed,
+                                                  self._put_result,
+                                                  self._ventilator)
+                    elif self._ventilator is not None:
+                        self._ventilator.processed_item(position, elapsed)
         finally:
             # The owning thread closes its own worker's files: shutdown from
             # any other thread (stop() used to do it) can close an
@@ -153,7 +188,9 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
             return False
         with self._inflight_lock:
             inflight = self._inflight
-        return inflight == 0 and self._input_queue.empty() and self._results_queue.empty()
+        return inflight == 0 and self._input_queue.empty() \
+            and self._results_queue.empty() \
+            and (self._reorder is None or self._reorder.empty())
 
     def stop(self):
         if self._stopped_at is None:

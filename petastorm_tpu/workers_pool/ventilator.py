@@ -9,17 +9,29 @@ resume (SURVEY.md Â§5.4 gap).  The per-epoch permutation is a pure function of
 ``(seed, epoch)``, so restoring a token reproduces the exact remaining work
 order.  Tokens snapshot at row-group granularity: items already handed to
 workers but not yet consumed downstream are re-read on resume.
+
+Dispatch ORDER is pluggable (ISSUE 9): the default
+:class:`~petastorm_tpu.workers_pool.scheduling.FifoDispatchPolicy` walks
+the epoch permutation front to back; the adaptive policy launches
+predicted-slow pieces early within a bounded window.  Either way the
+token stays the OLDEST position not fully processed â€” out-of-order
+dispatch only ever moves the token earlier, never past unfinished work.
 """
 
 import logging
 import threading
-import time
 
 import numpy as np
 
 from petastorm_tpu.workers_pool import VentilatedItem
+from petastorm_tpu.workers_pool.scheduling import FifoDispatchPolicy
 
 logger = logging.getLogger(__name__)
+
+#: epoch-exhausted marker from the dispatch picker (distinct from
+#: "stopped", which is None)
+_EPOCH_DONE = object()
+
 
 def epoch_order(items, shuffle, seed, epoch):
     """Canonical per-epoch work-item order â€” THE one implementation.
@@ -62,12 +74,17 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
 
     ``iterations=None`` repeats forever.  ``randomize_item_order`` reshuffles
     deterministically every epoch from ``(random_seed, epoch)``.
+
+    Backpressure, pause, and stop all block on ONE condition variable
+    (no timed polling: gVisor timed-waits burn measurable CPU at 50 Hz,
+    and the cv wakes the drain path the instant an ack/unpause lands).
     """
 
     def __init__(self, ventilate_fn, items, iterations=1,
                  randomize_item_order=False, random_seed=0,
                  max_ventilation_queue_size=None,
-                 start_epoch=0, start_cursor=0, prologue_items=None):
+                 start_epoch=0, start_cursor=0, prologue_items=None,
+                 dispatch_policy=None):
         super(ConcurrentVentilator, self).__init__(ventilate_fn)
         if iterations is not None and iterations <= 0:
             raise ValueError('iterations must be positive or None, got %r' % (iterations,))
@@ -76,6 +93,9 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
         self._randomize = randomize_item_order
         self._seed = random_seed if random_seed is not None else 0
         self._max_inflight = max_ventilation_queue_size or max(2 * len(self._items), 1)
+        #: dispatch-order strategy (ISSUE 9); FIFO reproduces the legacy
+        #: behavior bit for bit.
+        self._policy = dispatch_policy or FifoDispatchPolicy()
 
         #: One-shot work dispatched BEFORE the regular epochs, in list order
         #: and un-shuffled â€” the elastic-reshard handoff (epoch tails
@@ -86,16 +106,19 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
         self._prologue = list(prologue_items or [])
         self._prologue_cursor = 0
         self._epoch = start_epoch
-        self._cursor = start_cursor  # index into the current epoch's permutation
+        self._cursor = start_cursor  # oldest UNDISPATCHED index in the epoch
         self._start_epoch = start_epoch      # resume target while prologue runs
         self._start_cursor = start_cursor
-        self._inflight = threading.Semaphore(self._max_inflight)
+        self._inflight_count = 0
         self._completed = threading.Event()
         self._paused = threading.Event()
         self._stop_requested = threading.Event()
         self._thread = None
         self._lock = threading.Lock()
-        self._outstanding = set()  # global positions ventilated but not acked
+        self._cond = threading.Condition(self._lock)
+        #: position -> work item, ventilated but not acked (the item is
+        #: kept so acks can feed the cost model by piece index)
+        self._outstanding = {}
         self.ventilated_count = 0
 
     # -- resume token --------------------------------------------------------
@@ -114,10 +137,7 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
         n = max(len(self._items), 1)
         P = len(self._prologue)
         with self._lock:
-            if self._prologue_cursor < P:
-                current = self._prologue_cursor - P
-            else:
-                current = self._epoch * n + self._cursor
+            current = self._oldest_undispatched_position()
             oldest = min(self._outstanding) if self._outstanding else current
             oldest = min(oldest, current)
             if oldest < 0:
@@ -136,28 +156,88 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
         self._thread = threading.Thread(target=self._run, name='ventilator', daemon=True)
         self._thread.start()
 
+    def _next_dispatch(self, picker):
+        """Block until dispatch is allowed (un-paused, in-flight below the
+        bound), then run ``picker`` under the lock.  Returns None when
+        stopped.  The combined wait-and-pick under one lock is what makes
+        pause() exact: after pause() returns, every item is either visible
+        in the outstanding map or will not be dispatched.
+
+        A saturated bound is only honored while the delivery frontier is
+        DISPATCHED (an ack can still arrive): when out-of-order dispatch
+        left the frontier undispatched and the bound then shrank under
+        the in-flight count (``set_max_inflight`` racing the autotuner),
+        waiting would deadlock under ack-on-delivery â€” nothing releases
+        until the frontier runs â€” so the bound is overdrafted by exactly
+        one dispatch, which ``_pick_epoch``'s force-oldest rule sends to
+        the frontier."""
+        with self._cond:
+            while not self._stop_requested.is_set() and \
+                    (self._paused.is_set()
+                     or (self._inflight_count >= self._max_inflight
+                         and self._frontier_dispatched())):
+                self._cond.wait()
+            if self._stop_requested.is_set():
+                return None
+            return picker()
+
+    def _oldest_undispatched_position(self):
+        """Caller holds the lock: the oldest GLOBAL position not yet
+        handed to a worker â€” prologue positions are negative; in the
+        epoch run ``_cursor`` tracks the oldest undispatched epoch index
+        (== the classic cursor under FIFO; under adaptive dispatch it
+        lags the frontier until the gap fills).  THE one copy of the
+        position math the resume token, the backpressure predicate, and
+        the drain predicate all share."""
+        P = len(self._prologue)
+        if self._prologue_cursor < P:
+            return self._prologue_cursor - P
+        return self._epoch * max(len(self._items), 1) + self._cursor
+
+    def _frontier_dispatched(self):
+        """Caller holds the lock.  True while the oldest position not yet
+        fully processed is in the outstanding map (delivery can make
+        progress without new dispatch)."""
+        if not self._outstanding:
+            # saturated bound with nothing outstanding: the count and the
+            # map disagree (legacy position-less acks) â€” never wait on it
+            return False
+        return min(self._outstanding) < self._oldest_undispatched_position()
+
+    def _pick_prologue(self):
+        P = len(self._prologue)
+        j = self._prologue_cursor
+        item = self._prologue[j]
+        self._prologue_cursor = j + 1
+        self._outstanding[j - P] = item
+        self._inflight_count += 1
+        self.ventilated_count += 1
+        return VentilatedItem(j - P, item)
+
+    def _pick_epoch(self):
+        # Last free slot -> the delivery frontier.  Under ack-on-delivery
+        # (reorder mode) a saturated in-flight window MUST contain the
+        # position delivery is waiting on, or no ack can ever free a
+        # slot; under completion acks it is merely a harmless preference.
+        force_oldest = self._inflight_count >= self._max_inflight - 1
+        nxt = self._policy.next(force_oldest=force_oldest)
+        if nxt is None:
+            return _EPOCH_DONE
+        position, item = nxt
+        self._cursor = self._policy.oldest_undispatched_idx()
+        self._outstanding[position] = item
+        self._inflight_count += 1
+        self.ventilated_count += 1
+        return VentilatedItem(position, item)
+
     def _run(self):
         # Prologue first: inherited work from an elastic reshard, dispatched
         # in list order under the same pause/backpressure gates as epochs.
-        P = len(self._prologue)
-        while self._prologue_cursor < P:
-            if self._stop_requested.is_set():
+        while self._prologue_cursor < len(self._prologue):
+            out = self._next_dispatch(self._pick_prologue)
+            if out is None:
                 return
-            if self._paused.is_set():
-                time.sleep(0.02)
-                continue
-            if not self._inflight.acquire(timeout=0.1):
-                continue
-            with self._lock:
-                if self._paused.is_set():
-                    self._inflight.release()
-                    continue
-                j = self._prologue_cursor
-                item = self._prologue[j]
-                self._prologue_cursor = j + 1
-                self._outstanding.add(j - P)
-                self.ventilated_count += 1
-            self._ventilate_fn(VentilatedItem(j - P, item))
+            self._ventilate_fn(out)
         if not self._items:
             # Prologue-only ventilator (elastic reshard onto more shards
             # than row groups): nothing to iterate â€” spinning the epoch
@@ -170,41 +250,29 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
                     break
                 epoch, cursor = self._epoch, self._cursor
             order = self._epoch_order(epoch)
-            n = len(order)
-            while cursor < n:
-                if self._stop_requested.is_set():
+            self._policy.begin_epoch(order, epoch * len(order), cursor)
+            while True:
+                out = self._next_dispatch(self._pick_epoch)
+                if out is None:
                     return
-                if self._paused.is_set():
-                    time.sleep(0.02)
-                    continue
-                # Bounded in-flight: block until a worker acks something.
-                if not self._inflight.acquire(timeout=0.1):
-                    continue
-                with self._lock:
-                    # Re-check under the lock: pause() also takes it, so
-                    # after pause() returns, either this item is already in
-                    # _outstanding (drain will consume it) or it will not be
-                    # dispatched â€” no window where it is in neither state.
-                    if self._paused.is_set():
-                        self._inflight.release()
-                        continue
-                    item = order[cursor]
-                    position = epoch * n + cursor
-                    cursor += 1
-                    self._cursor = cursor
-                    self._outstanding.add(position)
-                    self.ventilated_count += 1
-                self._ventilate_fn(VentilatedItem(position, item))
+                if out is _EPOCH_DONE:
+                    break
+                self._ventilate_fn(out)
             with self._lock:
                 self._epoch += 1
                 self._cursor = 0
         self._completed.set()
 
-    def processed_item(self, position=None):
-        if position is not None:
-            with self._lock:
-                self._outstanding.discard(position)
-        self._inflight.release()
+    def processed_item(self, position=None, elapsed=None):
+        item = None
+        with self._cond:
+            if position is not None:
+                item = self._outstanding.pop(position, None)
+            self._inflight_count = max(0, self._inflight_count - 1)
+            self._cond.notify()
+        if item is not None and elapsed is not None:
+            # outside the dispatch lock: the cost model has its own
+            self._policy.observe(item, elapsed)
 
     # -- pause/drain (exact checkpointing) -----------------------------------
 
@@ -219,17 +287,44 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
             self._paused.set()
 
     def unpause(self):
-        self._paused.clear()
+        with self._cond:
+            self._paused.clear()
+            self._cond.notify_all()
+
+    def set_max_inflight(self, bound):
+        """Live in-flight bound (the autotuner's reorder-depth knob)."""
+        with self._cond:
+            self._max_inflight = max(1, int(bound))
+            self._cond.notify_all()
+
+    @property
+    def max_inflight(self):
+        return self._max_inflight
 
     def has_outstanding(self):
         with self._lock:
             return bool(self._outstanding)
+
+    def has_deliverable_outstanding(self):
+        """True while an outstanding position sits BEFORE the dispatch
+        frontier â€” i.e. it can still complete/deliver without new
+        dispatch.  The drain loop's condition: under out-of-order
+        dispatch, positions past the frontier are held behind an
+        undispatched gap and (with dispatch paused) will never release â€”
+        waiting on them would spin forever; the resume token replays
+        them instead."""
+        with self._lock:
+            if not self._outstanding:
+                return False
+            return min(self._outstanding) < self._oldest_undispatched_position()
 
     def completed(self):
         """True once every item of every iteration has been ventilated."""
         return self._completed.is_set()
 
     def stop(self):
-        self._stop_requested.set()
+        with self._cond:
+            self._stop_requested.set()
+            self._cond.notify_all()
         if self._thread is not None:
             self._thread.join()

@@ -152,6 +152,27 @@ def test_bench_compact_line_pins_transfer_plane_fields():
                      src), 'transfer_plane_leg missing from the leg table'
 
 
+def test_bench_compact_line_pins_adaptive_sched_fields():
+    """The adaptive scheduler's evidence (ISSUE 9): fifo vs adaptive
+    epoch throughput on the skew-heavy dataset, the uniform-twin noise
+    control, and the delivery-order bit-identity check must ride the
+    compact machine line; the leg must sit in the shared host-leg table;
+    and the adaptive throughput must be trend-gated."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    for field in ('adaptive_sched_images_per_sec_fifo',
+                  'adaptive_sched_images_per_sec_adaptive',
+                  'adaptive_sched_adaptive_over_fifo',
+                  'adaptive_sched_uniform_over_fifo',
+                  'adaptive_sched_delivery_identical'):
+        assert "'%s'" % field in block.group(1), field
+    assert re.search(r"_IPC_PLANE_LEGS = \((?:.|\n)*?adaptive_sched_leg",
+                     src), 'adaptive_sched_leg missing from the leg table'
+    from petastorm_tpu.benchmark import trend
+    assert 'adaptive_sched_images_per_sec_adaptive' in trend.TRACKED_FIELDS
+
+
 def test_docs_conf_compiles_and_has_sphinx_settings():
     path = os.path.join(REPO, 'docs', 'conf.py')
     src = open(path).read()

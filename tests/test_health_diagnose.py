@@ -139,14 +139,22 @@ def test_flight_span_peek_never_drains():
 
 # -- health engine: the regime fixtures ARE the rule contract -----------------
 
-def _fixture_delta(counters=None, hist_sums=None):
+def _fixture_delta(counters=None, hist_sums=None, hist_counts=None):
     """Synthetic windowed delta: counters + histograms with given
-    busy-time sums (counts/buckets don't matter for busy shares)."""
+    busy-time sums (counts/buckets don't matter for busy shares) and,
+    via ``hist_counts``, explicit bucket populations (the skew rule
+    reads quantile RATIOS, so the shape matters there)."""
     histograms = {}
     for name, busy_s in (hist_sums or {}).items():
         counts = [0] * BUCKETS
         counts[20] = 10
         histograms[name] = {'counts': counts, 'sum': busy_s, 'count': 10}
+    for name, bucket_population in (hist_counts or {}).items():
+        counts = [0] * BUCKETS
+        for bucket, n in bucket_population.items():
+            counts[bucket] = n
+        histograms[name] = {'counts': counts, 'sum': 1.0,
+                            'count': sum(counts)}
     return {'namespace': 'fix', 'counters': dict(counters or {}),
             'gauges': {}, 'histograms': histograms}
 
@@ -174,6 +182,15 @@ REGIME_FIXTURES = {
         delta=_fixture_delta(counters={'shm_degraded': 400,
                                        'shm_chunks': 600}),
         stall_pct=None),
+    # ISSUE 9: bimodal per-item decode latency (90 fast items 10 buckets
+    # below 10 slow ones: p99/p50 = 2^10) while the pool reports idle
+    # gaps — must name skew-bound OVER the decode-bound busy-share
+    # fallback, because the decode-bound knob (more workers) cannot fix
+    # a head-of-line straggler.
+    'skew-bound': dict(
+        delta=_fixture_delta(hist_counts={'decode': {10: 90, 20: 10}}),
+        stall_pct=None,
+        meta={'decode_utilization': 0.35}),
 }
 
 
@@ -181,10 +198,36 @@ REGIME_FIXTURES = {
 def test_health_classifies_every_regime(regime):
     fixture = REGIME_FIXTURES[regime]
     report = health.health_report(fixture['delta'],
-                                  stall_pct=fixture['stall_pct'])
+                                  stall_pct=fixture['stall_pct'],
+                                  meta=fixture.get('meta'))
     assert report['regime'] == regime, report
     assert report['regime_severity'] > 0
     assert report['regime_evidence']
+
+
+def test_skew_without_idle_gaps_stays_decode_bound():
+    """The same bimodal latency with a SATURATED pool is not a
+    scheduling problem — all-busy skew is plain decode-bound (add
+    workers), so the skew rule must not fire."""
+    delta = _fixture_delta(hist_counts={'decode': {10: 90, 20: 10}})
+    report = health.health_report(delta,
+                                  meta={'decode_utilization': 0.97})
+    assert report['regime'] != 'skew-bound'
+
+
+def test_skew_bound_verdict_points_at_adaptive_scheduling():
+    fixture = REGIME_FIXTURES['skew-bound']
+    report = health.health_report(fixture['delta'],
+                                  meta=fixture['meta'])
+    evidence = {'source': 'fixture', 'health': report,
+                'stages': health.summarize_stages(
+                    fixture['delta']['histograms']),
+                'counters': {}, 'meta': fixture['meta'], 'workers': {},
+                'span_residue': 0, 'reason': None}
+    verdicts = diagnose.run_rules(evidence)
+    assert verdicts[0]['id'] == 'skew-bound'
+    assert "scheduling='adaptive'" in verdicts[0]['action']
+    assert 'p99/p50' in verdicts[0]['evidence']
 
 
 def test_health_busy_share_fallback_without_spans():
@@ -266,13 +309,15 @@ def test_health_report_from_frames_windows_the_ring():
 def test_diagnose_top_verdict_per_regime(regime):
     fixture = REGIME_FIXTURES[regime]
     report = health.health_report(fixture['delta'],
-                                  stall_pct=fixture['stall_pct'])
+                                  stall_pct=fixture['stall_pct'],
+                                  meta=fixture.get('meta'))
     evidence = {
         'source': 'fixture', 'health': report,
         'stages': health.summarize_stages(
             fixture['delta']['histograms']),
         'counters': fixture['delta']['counters'],
-        'meta': {}, 'workers': {}, 'span_residue': 0, 'reason': None,
+        'meta': fixture.get('meta') or {}, 'workers': {},
+        'span_residue': 0, 'reason': None,
     }
     verdicts = diagnose.run_rules(evidence)
     assert verdicts[0]['id'] == regime, verdicts

@@ -1,0 +1,689 @@
+"""Adaptive out-of-order preprocessing scheduler (ISSUE 9).
+
+Covers the regression pin that precedes the tentpole (resume-token
+oldest-outstanding math under heavily out-of-order acks — the invariant
+the scheduler leans on), the scheduling primitives (cost model, adaptive
+dispatch policy, reorder buffer), the reader wire-through (bit-identical
+delivery order + resume round-trip under ``scheduling='adaptive'``), and
+the autotuner's clamp/rate-limit contract.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.workers_pool import VentilatedItem
+from petastorm_tpu.workers_pool import scheduling as sched
+from petastorm_tpu.workers_pool.ventilator import (ConcurrentVentilator,
+                                                   epoch_order)
+
+
+class Sink:
+    """Collects ventilated items; acks on demand, in any order."""
+
+    def __init__(self, vent=None):
+        self.items = []
+        self._lock = threading.Lock()
+        self.vent = vent
+
+    def __call__(self, item):
+        assert isinstance(item, VentilatedItem)
+        with self._lock:
+            self.items.append(item)
+
+    def take(self):
+        with self._lock:
+            pending, self.items = self.items, []
+        return pending
+
+    def ack(self, pending):
+        for item in pending:
+            self.vent.processed_item(item.position)
+        return [i.args for i in pending]
+
+
+def _make(items, **kwargs):
+    sink = Sink()
+    vent = ConcurrentVentilator(ventilate_fn=sink, items=items, **kwargs)
+    sink.vent = vent
+    return vent, sink
+
+
+def _drain(vent, sink, timeout=5.0):
+    out = []
+    deadline = time.monotonic() + timeout
+    while not vent.completed():
+        out.extend(sink.ack(sink.take()))
+        if time.monotonic() > deadline:
+            raise AssertionError('ventilator did not complete; got %d items'
+                                 % len(out))
+        time.sleep(0.001)
+    out.extend(sink.ack(sink.take()))
+    return out
+
+
+def _wait_items(sink, n, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with sink._lock:
+            if len(sink.items) >= n:
+                return
+        time.sleep(0.002)
+    raise AssertionError('never saw %d ventilated items' % n)
+
+
+# -- regression pin (BEFORE the tentpole): oldest-outstanding resume math
+# under heavily out-of-order acks ---------------------------------------------
+
+def test_state_dict_oldest_outstanding_under_out_of_order_acks():
+    """Acks arriving in ANY order must keep the token at the oldest
+    position not fully processed — the invariant adaptive (out-of-order)
+    scheduling leans on."""
+    vent, sink = _make(list(range(10)), iterations=1,
+                       max_ventilation_queue_size=6)
+    vent.start()
+    _wait_items(sink, 6)
+    pending = sink.take()                       # positions 0..5 in flight
+    by_pos = {i.position: i for i in pending}
+    # Ack newest-first, skipping position 1: the token must pin at 1,
+    # not at the count of acks.
+    for pos in (5, 4, 3, 2, 0):
+        vent.processed_item(pos)
+    time.sleep(0.1)
+    token = vent.state_dict()
+    assert token['epoch'] == 0 and token['cursor'] == 1
+    vent.processed_item(by_pos[1].position)
+    time.sleep(0.1)
+    # With 1 acked, the oldest outstanding moves to the dispatch frontier.
+    token2 = vent.state_dict()
+    assert token2['cursor'] >= 6
+    vent.stop()
+
+
+def test_post_resume_delivery_exact_after_out_of_order_acks():
+    """Resume from an out-of-order-ack token: the new ventilator must
+    dispatch exactly the suffix from the token position — re-reads of
+    acked-but-newer positions are expected (at-least-once), losses are
+    not."""
+    items = list(range(12))
+    vent, sink = _make(items, iterations=1, randomize_item_order=True,
+                       random_seed=3, max_ventilation_queue_size=5)
+    vent.start()
+    _wait_items(sink, 5)
+    pending = sink.take()
+    # ack everything EXCEPT the oldest position
+    oldest = min(i.position for i in pending)
+    for item in pending:
+        if item.position != oldest:
+            vent.processed_item(item.position)
+    token = vent.state_dict()
+    vent.stop()
+    assert token['cursor'] == oldest
+
+    vent2, sink2 = _make(items, iterations=1, randomize_item_order=True,
+                         random_seed=token['seed'],
+                         start_epoch=token['epoch'],
+                         start_cursor=token['cursor'])
+    vent2.start()
+    resumed = _drain(vent2, sink2)
+    vent2.stop()
+    full = epoch_order(items, True, 3, 0)
+    assert resumed == full[oldest:]
+
+
+# -- cost model ---------------------------------------------------------------
+
+def test_cost_model_seeding_orders_before_observations():
+    model = sched.PieceCostModel()
+    model.seed({0: 10, 1: 1000, 2: 50})
+    assert model.predict(1) > model.predict(2) > model.predict(0)
+    # unknown piece ranks neutral, not extreme
+    assert model.predict(99) >= 0.0
+
+
+def test_cost_model_ewma_overrides_seed():
+    model = sched.PieceCostModel(alpha=0.5)
+    model.seed({0: 1000, 1: 1})
+    for _ in range(6):
+        model.observe(0, 0.001)   # "big" piece turns out cheap
+        model.observe(1, 0.5)     # "small" piece turns out expensive
+    assert model.predict(1) > model.predict(0)
+    assert model.observations == 12
+    # EWMA tracks the recent value, not the first
+    before = model.predict(1)
+    for _ in range(8):
+        model.observe(1, 0.1)
+    assert model.predict(1) < before
+
+
+# -- adaptive dispatch policy -------------------------------------------------
+
+def _dispatch_all(policy, order, base=0, start=0):
+    policy.begin_epoch(order, base, start)
+    seq = []
+    while True:
+        nxt = policy.next()
+        if nxt is None:
+            break
+        seq.append(nxt)
+    return seq
+
+
+def test_adaptive_policy_dispatches_each_position_exactly_once():
+    model = sched.PieceCostModel()
+    model.seed({i: i for i in range(20)})
+    policy = sched.AdaptiveDispatchPolicy(model, window=6)
+    seq = _dispatch_all(policy, [(i, 0) for i in range(20)])
+    assert sorted(p for p, _ in seq) == list(range(20))
+
+
+def test_adaptive_policy_launches_predicted_slow_first_within_window():
+    model = sched.PieceCostModel()
+    # piece 5 is predicted 100x every other piece in the first window
+    model.seed({i: (1000 if i == 5 else 10) for i in range(12)})
+    policy = sched.AdaptiveDispatchPolicy(model, window=8, reserve_frac=0.25)
+    seq = _dispatch_all(policy, [(i, 0) for i in range(12)])
+    # slow piece 5 dispatches first even though FIFO rank is 5
+    assert seq[0][1][0] == 5
+
+
+def test_adaptive_policy_lag_bound_forces_oldest():
+    """A cheap piece cannot be overtaken by more than ``window`` later
+    dispatches — the bound that keeps the reorder buffer finite."""
+    model = sched.PieceCostModel()
+    model.seed({i: (1 if i == 0 else 100 + i) for i in range(40)})
+    window = 6
+    policy = sched.AdaptiveDispatchPolicy(model, window=window)
+    seq = _dispatch_all(policy, [(i, 0) for i in range(40)])
+    rank_of = {pos: rank for rank, (pos, _) in enumerate(seq)}
+    for pos in range(40):
+        assert rank_of[pos] - pos <= 2 * window, (pos, rank_of[pos])
+
+
+def test_adaptive_policy_predicts_once_per_piece_per_epoch():
+    """``next()`` runs under the ventilator dispatch lock: predictions
+    snapshot at ADMISSION (one ``predict`` per piece per epoch), never
+    once per pending piece per dispatch — window-many locked cost-model
+    reads on every dispatch would contend with the ack path."""
+    class Counting(sched.PieceCostModel):
+        calls = 0
+
+        def predict(self, piece):
+            Counting.calls += 1
+            return super().predict(piece)
+
+    model = Counting()
+    model.seed({i: (1000 if i % 7 == 0 else 10) for i in range(30)})
+    policy = sched.AdaptiveDispatchPolicy(model, window=8)
+    seq = _dispatch_all(policy, [(i, 0) for i in range(30)])
+    assert sorted(p for p, _ in seq) == list(range(30))
+    assert Counting.calls == 30
+
+
+def test_adaptive_policy_oldest_undispatched_tracks_gap():
+    model = sched.PieceCostModel()
+    model.seed({i: (1 if i == 0 else 50) for i in range(10)})
+    policy = sched.AdaptiveDispatchPolicy(model, window=4)
+    policy.begin_epoch([(i, 0) for i in range(10)], 0, 0)
+    first = policy.next()
+    assert first is not None
+    if first[0] != 0:
+        # position 0 (predicted cheap) is still pending: the resume
+        # frontier must stay at 0
+        assert policy.oldest_undispatched_idx() == 0
+
+
+def test_adaptive_policy_resume_start_cursor():
+    model = sched.PieceCostModel()
+    policy = sched.AdaptiveDispatchPolicy(model, window=4)
+    seq = _dispatch_all(policy, [(i, 0) for i in range(10)], base=10, start=7)
+    assert sorted(p for p, _ in seq) == [17, 18, 19]
+
+
+# -- reorder buffer -----------------------------------------------------------
+
+def _flat(released):
+    return [r for _, _, results in released for r in results]
+
+
+def test_reorder_buffer_restores_ascending_delivery():
+    buf = sched.ReorderBuffer(start_position=0)
+    buf.add(2, 'c')
+    assert buf.complete(2, 0.2) == []     # 0, 1 still missing
+    buf.add(0, 'a')
+    buf.add(1, 'b')
+    assert buf.complete(1, 0.1) == []
+    released = buf.complete(0, 0.05)
+    assert _flat(released) == ['a', 'b', 'c']
+    # each released run carries its position + decode elapsed (the
+    # ack-on-delivery payload pools forward to the ventilator)
+    assert [(p, e) for p, e, _ in released] == [(0, 0.05), (1, 0.1),
+                                                (2, 0.2)]
+    assert buf.pending_positions == 0
+
+
+def test_reorder_buffer_multi_result_and_empty_positions():
+    buf = sched.ReorderBuffer(start_position=4)
+    buf.add(5, 'x1')
+    buf.add(5, 'x2')
+    assert buf.complete(5) == []
+    # position 4 published nothing (predicate dropped the group)
+    assert _flat(buf.complete(4)) == ['x1', 'x2']
+
+
+def test_reorder_buffer_prologue_runs_before_epoch_positions():
+    buf = sched.ReorderBuffer(start_position=10, prologue_count=2)
+    buf.add(10, 'epoch')
+    assert buf.complete(10) == []
+    buf.add(-1, 'p1')
+    buf.add(-2, 'p0')
+    assert buf.complete(-1) == []
+    assert _flat(buf.complete(-2)) == ['p0', 'p1', 'epoch']
+
+
+# -- reader wire-through ------------------------------------------------------
+
+ROWS = 96
+
+
+@pytest.fixture(scope='module')
+def skewed_dataset(tmp_path_factory):
+    """Small dataset whose row groups have strongly skewed decode cost
+    (via row width): 12 row groups x 8 rows."""
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    url = 'file://' + str(tmp_path_factory.mktemp('sched') / 'ds')
+    schema = Unischema('Sched', [
+        UnischemaField('idx', np.int64, (), None, False),
+        UnischemaField('vec', np.float32, (None,), NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(0)
+
+    def rows():
+        for i in range(ROWS):
+            group = i // 8
+            width = 20000 if group % 4 == 0 else 64
+            yield {'idx': np.int64(i),
+                   'vec': rng.standard_normal(width).astype(np.float32)}
+
+    with DatasetWriter(url, schema, rows_per_rowgroup=8) as w:
+        w.write_many(rows())
+    return url
+
+
+def _read_ids(url, **kwargs):
+    from petastorm_tpu import make_reader
+    with make_reader(url, schema_fields=['idx'], **kwargs) as reader:
+        return [int(row.idx) for row in reader]
+
+
+def test_adaptive_delivery_order_bit_identical(skewed_dataset):
+    """Delivery order under scheduling='adaptive' (4 workers, shuffled)
+    must be bit-identical to the serialized FIFO order — processing
+    moves, delivery does not."""
+    common = dict(shuffle_row_groups=True, seed=11, num_epochs=2)
+    fifo = _read_ids(skewed_dataset, reader_pool_type='dummy',
+                     scheduling='fifo', **common)
+    adaptive = _read_ids(skewed_dataset, workers_count=4,
+                         scheduling='adaptive', **common)
+    assert adaptive == fifo
+
+
+def test_adaptive_resume_token_round_trip(skewed_dataset):
+    """state_dict mid-stream under adaptive scheduling resumes without
+    losing a row; the delivered suffix is exactly the FIFO suffix."""
+    from petastorm_tpu import make_reader
+    common = dict(schema_fields=['idx'], shuffle_row_groups=True, seed=7,
+                  num_epochs=1, workers_count=4, scheduling='adaptive')
+    with make_reader(skewed_dataset, **common) as reader:
+        assert reader.scheduling == 'adaptive'
+        got = []
+        for i, row in enumerate(reader):
+            got.append(int(row.idx))
+            if i == 29:
+                break
+        drained = reader.drain_in_flight()
+        got.extend(int(r.idx) for r in drained)
+        token = reader.state_dict()
+    with make_reader(skewed_dataset, resume_state=token, **common) as r2:
+        resumed = [int(row.idx) for row in r2]
+    serialized = _read_ids(skewed_dataset, reader_pool_type='dummy',
+                           scheduling='fifo', shuffle_row_groups=True,
+                           seed=7, num_epochs=1)
+    # exact: after a drain, delivered + resumed is the full epoch with no
+    # loss and no duplicates (delivery is in epoch order end to end)
+    assert got + resumed == serialized
+
+
+def test_auto_resolves_and_kill_switch(skewed_dataset, monkeypatch):
+    from petastorm_tpu import make_reader
+    with make_reader(skewed_dataset, schema_fields=['idx'],
+                     workers_count=4, scheduling='auto') as reader:
+        assert reader.scheduling == 'adaptive'
+    monkeypatch.setenv('PETASTORM_TPU_NO_ADAPTIVE_SCHED', '1')
+    with make_reader(skewed_dataset, schema_fields=['idx'],
+                     workers_count=4, scheduling='auto') as reader:
+        assert reader.scheduling == 'fifo'
+    monkeypatch.delenv('PETASTORM_TPU_NO_ADAPTIVE_SCHED')
+    # tiny work lists degrade to fifo under 'auto'...
+    with make_reader(skewed_dataset, schema_fields=['idx'],
+                     workers_count=4, scheduling='auto',
+                     piece_indices=[0, 1]) as reader:
+        assert reader.scheduling == 'fifo'
+    # ...and single-worker pools have nothing to reorder
+    with make_reader(skewed_dataset, schema_fields=['idx'],
+                     workers_count=1, scheduling='auto') as reader:
+        assert reader.scheduling == 'fifo'
+    with pytest.raises(ValueError):
+        make_reader(skewed_dataset, scheduling='sometimes')
+
+
+def test_adaptive_processpool_delivery_and_multiset(skewed_dataset):
+    """The ProcessPool speaks the positioned result framing: adaptive
+    delivery through real child processes stays in epoch order."""
+    fifo = _read_ids(skewed_dataset, reader_pool_type='dummy',
+                     scheduling='fifo', shuffle_row_groups=False,
+                     num_epochs=1)
+    adaptive = _read_ids(skewed_dataset, reader_pool_type='process',
+                         workers_count=2, scheduling='adaptive',
+                         shuffle_row_groups=False, num_epochs=1)
+    assert adaptive == fifo
+
+
+def test_adaptive_diagnostics_surface(skewed_dataset):
+    from petastorm_tpu import make_reader
+    with make_reader(skewed_dataset, schema_fields=['idx'],
+                     workers_count=4, scheduling='adaptive') as reader:
+        list(reader)
+        d = reader.diagnostics
+        assert d['scheduling'] == 'adaptive'
+        assert d['reorder_pending'] == 0
+    with make_reader(skewed_dataset, schema_fields=['idx'],
+                     workers_count=2, scheduling='fifo') as reader:
+        assert reader.diagnostics['scheduling'] == 'fifo'
+
+
+# -- autotuner ----------------------------------------------------------------
+
+class _FakeHist:
+    def __init__(self, p50, p99, count=100):
+        self._q = {0.5: p50, 0.99: p99}
+        self.count = count
+
+    def quantile(self, q):
+        return self._q[q]
+
+
+def test_autotuner_widens_on_skew_and_clamps():
+    from petastorm_tpu.telemetry import MetricsRegistry
+    registry = MetricsRegistry('tune')
+    tuner = sched.Autotuner(registry=registry, interval_s=0.0,
+                            min_observations=0)
+    knobs = sched.SchedulerKnobs(window=32, max_inflight=8, prefetch=2)
+    # heavy skew + decode-dominant stall: widen, deepen
+    for _ in range(20):
+        tuner.tune(knobs, decode=_FakeHist(0.001, 0.5),
+                   host_batch=_FakeHist(0.01, 0.5),
+                   device_put=_FakeHist(0.001, 0.002))
+    assert knobs.window == sched.MAX_WINDOW          # clamped, not runaway
+    assert knobs.max_inflight <= sched.MAX_INFLIGHT
+    assert knobs.prefetch <= sched.MAX_PREFETCH
+    assert registry.gauge('sched_window').value == knobs.window
+    assert registry.counter('sched_adjust_total').value > 0
+
+
+def test_autotuner_shrinks_toward_defaults_without_skew():
+    tuner = sched.Autotuner(interval_s=0.0, min_observations=0)
+    knobs = sched.SchedulerKnobs(window=sched.MAX_WINDOW,
+                                 max_inflight=sched.MAX_INFLIGHT,
+                                 prefetch=sched.MAX_PREFETCH)
+    for _ in range(40):
+        tuner.tune(knobs, decode=_FakeHist(0.01, 0.012),
+                   host_batch=_FakeHist(0.001, 0.002),
+                   device_put=_FakeHist(0.001, 0.002))
+    assert knobs.window < sched.MAX_WINDOW
+    assert knobs.prefetch == sched.MIN_PREFETCH
+
+
+def test_autotuner_rate_limited():
+    tuner = sched.Autotuner(interval_s=3600.0, min_observations=0)
+    knobs = sched.SchedulerKnobs(window=32, max_inflight=8, prefetch=2)
+    tuner.tune(knobs, decode=_FakeHist(0.001, 0.5))
+    first = (knobs.window, knobs.max_inflight, knobs.prefetch)
+    tuner.tune(knobs, decode=_FakeHist(0.001, 0.5))   # inside the window
+    assert (knobs.window, knobs.max_inflight, knobs.prefetch) == first
+
+
+def test_loader_autotune_wires_gauges(skewed_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import DataLoader
+    with make_reader(skewed_dataset, workers_count=4,
+                     scheduling='adaptive', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=8, transfer=False)
+        for _ in loader.iter_host_batches():
+            pass
+        snap = loader.metrics.snapshot()
+        assert 'sched_window' in snap['gauges']
+        assert 'sched_prefetch' in snap['gauges']
+
+
+def test_adaptive_inflight_bound_scales_with_pool(tmp_path):
+    """The adaptive in-flight bound (== worst-case reorder depth in
+    COMPLETED undelivered row groups) defaults to 16x the pool, not the
+    flat MAX_INFLIGHT ceiling: bare make_reader consumers have no
+    autotuner to shrink it, so the memory exposure must scale with the
+    decode resources the user already sized."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    url = 'file://' + str(tmp_path / 'many')
+    schema = Unischema('Many', [
+        UnischemaField('idx', np.int64, (), None, False)])
+    with DatasetWriter(url, schema, rows_per_rowgroup=1) as w:
+        w.write_many({'idx': np.int64(i)} for i in range(80))
+    with make_reader(url, workers_count=2, scheduling='adaptive',
+                     num_epochs=1) as reader:
+        assert reader._ventilator.max_inflight == 32   # 16 x 2 workers
+        assert sorted(int(r.idx) for r in reader) == list(range(80))
+
+
+def test_loader_autotuner_rebinds_after_reader_reset(skewed_dataset):
+    """reader.reset() builds a new pool/ventilator/policy/cost model;
+    the loader's autotuner must rebind to the fresh instances — a tuner
+    bound to the dead ones freezes (its fresh-samples gate reads the
+    old cost model's frozen counter) while writing knobs into stopped
+    objects."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import DataLoader
+    with make_reader(skewed_dataset, workers_count=4,
+                     scheduling='adaptive', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=8, transfer=False)
+        for _ in loader.iter_host_batches():
+            pass
+        first = loader._tuner
+        assert first is not None
+        reader.reset()
+        for _ in loader.iter_host_batches():
+            pass
+        assert loader._tuner is not first, 'tuner kept the dead ventilator'
+        assert loader._tuner._cost_model is reader.cost_model
+
+
+# -- ventilator condition-variable waits (satellite) --------------------------
+
+def test_ventilator_pause_unpause_without_polling_burn():
+    """pause/unpause and backpressure block on a condition variable now;
+    the observable contract (bounded in-flight, prompt unpause) holds."""
+    vent, sink = _make(list(range(30)), iterations=1,
+                       max_ventilation_queue_size=4)
+    vent.start()
+    _wait_items(sink, 4)
+    vent.pause()
+    sink.ack(sink.take())
+    time.sleep(0.1)
+    assert sink.take() == []       # paused: acks must not refill
+    vent.unpause()
+    _wait_items(sink, 4)           # resumes promptly on the cv signal
+    got = _drain(vent, sink)
+    assert len(got) == 26
+    vent.stop()
+
+
+def test_set_max_inflight_shrink_keeps_frontier_liveness():
+    """Shrinking the in-flight bound below the outstanding count while
+    the delivery frontier is UNDISPATCHED (early slow pieces hold every
+    slot) must overdraft one dispatch to the frontier instead of
+    deadlocking — under ack-on-delivery nothing can release until the
+    frontier runs, so honoring the shrunk bound would wait forever."""
+    model = sched.PieceCostModel()
+    model.seed({i: (100.0 if i in (4, 5, 6) else 1.0) for i in range(8)})
+    policy = sched.AdaptiveDispatchPolicy(model, window=12,
+                                          early_limit=None)
+    sink = Sink()
+    vent = ConcurrentVentilator(ventilate_fn=sink,
+                                items=[(i, 0) for i in range(8)],
+                                iterations=1, max_ventilation_queue_size=4,
+                                dispatch_policy=policy)
+    sink.vent = vent
+    vent.start()
+    _wait_items(sink, 4)
+    got = {i.position for i in sink.take()}
+    # three predicted-slow pieces early-dispatch, force-oldest fills the
+    # last slot with the frontier
+    assert got == {0, 4, 5, 6}
+    vent.processed_item(0)       # the frontier delivers...
+    vent.set_max_inflight(2)     # ...then the autotuner shrinks the bound
+    # ack-on-delivery: 4/5/6 cannot ack until 1..3 deliver.  Drive
+    # delivery order and require every position to arrive.
+    expect = 1
+    deadline = time.monotonic() + 5.0
+    while expect < 8 and time.monotonic() < deadline:
+        got.update(i.position for i in sink.take())
+        if expect in got:
+            vent.processed_item(expect)
+            expect += 1
+        else:
+            time.sleep(0.002)
+    vent.stop()
+    assert expect == 8, 'dispatch deadlocked at position %d' % expect
+
+
+def test_autotuner_cost_model_fallback_and_no_signal_hold():
+    """Without a decode histogram (the process-pool parent never
+    observes one) the tuner falls back to the cost model's ack-fed skew;
+    with NO signal at all it must hold the ordering knobs, not shrink
+    them toward the minimums."""
+    model = sched.PieceCostModel()
+    tuner = sched.Autotuner(interval_s=0.0, min_observations=0,
+                            cost_model=model)
+    knobs = sched.SchedulerKnobs(window=32, max_inflight=16, prefetch=2)
+    tuner.tune(knobs)    # no histogram, no observations: hold
+    assert (knobs.window, knobs.max_inflight) == (32, 16)
+    for piece in range(16):
+        model.observe(piece, 50.0 if piece == 0 else 1.0)
+    for _ in range(3):
+        tuner.tune(knobs)  # ack-fed skew alone must widen
+    assert knobs.window > 32
+    assert knobs.max_inflight > 16
+
+
+def test_autotuner_inflight_shrink_floor_scales_with_pool():
+    """Measured non-skew shrinks the in-flight bound only down to the
+    caller's floor (the loader passes 2x the pool), never the global
+    MIN_INFLIGHT: under ack-on-delivery the bound counts undelivered
+    positions, so a constant floor of 4 would permanently idle all but
+    4 workers of a bigger pool on uniform-cost data."""
+    tuner = sched.Autotuner(interval_s=0.0, min_observations=0,
+                            min_inflight=20)
+    knobs = sched.SchedulerKnobs(window=64,
+                                 max_inflight=sched.MAX_INFLIGHT,
+                                 prefetch=2)
+    for _ in range(40):
+        tuner.tune(knobs, decode=_FakeHist(0.01, 0.012))
+    assert knobs.max_inflight == 20
+
+
+def test_autotuner_prefetch_holds_without_delivery_signal():
+    """The prefetch knob obeys the same no-evidence rule as the
+    ordering knobs: with no StallMonitor attached and no device_put
+    histogram (pure host-side consumption), a user-set prefetch must
+    hold — halving it there would claw back pipeline overlap on zero
+    measurements."""
+    tuner = sched.Autotuner(interval_s=0.0, min_observations=0)
+    knobs = sched.SchedulerKnobs(window=32, max_inflight=16,
+                                 prefetch=sched.MAX_PREFETCH)
+    for _ in range(5):
+        tuner.tune(knobs, decode=_FakeHist(0.01, 0.012),
+                   host_batch=_FakeHist(0.01, 0.02), device_put=None)
+    assert knobs.prefetch == sched.MAX_PREFETCH
+
+
+def test_prior_footer_scan_capped_by_file_count(skewed_dataset,
+                                                monkeypatch):
+    """Past MAX_PRIOR_SCAN_FILES data files in the shard, the epoch-0
+    prior must skip the per-file footer scan (one GET per file on an
+    object store — it would dominate time-to-first-batch) and fall back
+    to row-count weights.  A spy, not a raising sentinel: the weights
+    path is best-effort (``except Exception``), so a raise would be
+    swallowed and the test would pass vacuously."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl import dataset_metadata as dm
+
+    calls = []
+
+    def spy(fs, paths):
+        calls.append(sorted(paths))
+        return {}
+
+    monkeypatch.setattr(sched, 'MAX_PRIOR_SCAN_FILES', 0)
+    monkeypatch.setattr(dm, 'read_row_group_byte_sizes', spy)
+    with make_reader(skewed_dataset, workers_count=4,
+                     scheduling='adaptive', num_epochs=1,
+                     schema_fields=['idx']) as reader:
+        ids = sorted(int(row.idx) for row in reader)
+    assert not calls, 'footer scan ran past the file-count cap'
+    assert ids == list(range(ROWS))
+
+
+def test_loader_autotune_true_on_fifo_tunes_prefetch_only(skewed_dataset):
+    """autotune=True on a FIFO reader owns prefetch and nothing else:
+    binding the in-flight bound (the reorder-depth knob) would let the
+    not-skewed branch throttle a FIFO pipeline below the pool size."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import DataLoader
+    with make_reader(skewed_dataset, workers_count=4, scheduling='fifo',
+                     num_epochs=1, shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=8, transfer=False,
+                            autotune=True)
+        for _ in loader.iter_host_batches():
+            pass
+        assert loader._tuner is not None
+        assert set(loader._knobs._setters) == {'prefetch'}
+
+
+def test_ventilator_ack_elapsed_feeds_cost_model():
+    model = sched.PieceCostModel()
+    policy = sched.AdaptiveDispatchPolicy(model, window=4)
+    sink = Sink()
+    vent = ConcurrentVentilator(ventilate_fn=sink,
+                                items=[(i, 0) for i in range(8)],
+                                iterations=1, dispatch_policy=policy)
+    sink.vent = vent
+    vent.start()
+    deadline = time.monotonic() + 5.0
+    acked = 0
+    while acked < 8 and time.monotonic() < deadline:
+        for item in sink.take():
+            vent.processed_item(item.position, elapsed=0.05)
+            acked += 1
+        time.sleep(0.001)
+    vent.stop()
+    assert model.observations == 8
+    assert model.predict(3) == pytest.approx(0.05)

@@ -217,13 +217,16 @@ THREAD_READER_KEYS = {
     'pool', 'workers_count', 'items_processed', 'inflight', 'input_qsize',
     'results_qsize', 'decode_busy_s', 'decode_utilization',
     'decode_p50_ms', 'decode_p99_ms', 'ventilated_count',
-    'prologue_remaining', 'cursor', 'epoch', 'seed'}
+    'prologue_remaining', 'cursor', 'epoch', 'seed',
+    # ISSUE 9: effective dispatch policy + live reorder-stage depth
+    'scheduling', 'reorder_pending'}
 
 PROCESS_READER_KEYS = {
     'pool', 'workers_count', 'items_processed', 'inflight', 'workers_alive',
     'shm_results', 'shm_degraded', 'decode_busy_s', 'decode_utilization',
     'decode_p50_ms', 'decode_p99_ms', 'ventilated_count',
-    'prologue_remaining', 'cursor', 'epoch', 'seed'}
+    'prologue_remaining', 'cursor', 'epoch', 'seed',
+    'scheduling', 'reorder_pending'}
 
 LOADER_ONLY_KEYS = {
     'batches',

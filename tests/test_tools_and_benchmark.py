@@ -228,6 +228,11 @@ def test_doctor_report_over_petastorm_dataset(dataset, capsys):
     assert host['reader'].startswith('make_reader')
     assert host['rows'] > 0 and host['rows_per_s'] > 0
     assert 'host_batch_s' in host['stage_seconds']
+    # ISSUE 9: the effective dispatch policy + measured decode skew ride
+    # the host-plane section (skew >= 8x with idle workers is what
+    # scheduling='adaptive' exists for)
+    assert host['scheduling'] in ('fifo', 'adaptive')
+    assert 'decode_skew_p99_over_p50' in host
     assert 'regime' in report['advisor']
     # the doctor itself gates h2d on the live probe — when present it ran
     if 'h2d' in report:
