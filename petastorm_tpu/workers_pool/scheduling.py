@@ -15,9 +15,9 @@ measurement-driven tuning (PAPERS.md):
   pool ack.
 * :class:`AdaptiveDispatchPolicy` — cost-aware out-of-order
   ventilation: within a bounded lookahead window of the deterministic
-  epoch permutation, predicted-slow pieces launch earliest while a
-  reserve of predicted-fast pieces is held back to backfill worker
-  slots near the window boundary (the stall window).  A lag bound
+  epoch permutation, predicted-slow pieces launch earliest while the
+  predicted-fast pieces keep flowing in exact epoch order, backfilling
+  worker slots whenever no slow piece is pending.  A lag bound
   guarantees no position is overtaken by more than ``window`` later
   dispatches, which is what keeps the reorder buffer finite.
   :class:`FifoDispatchPolicy` is the exact legacy order.
@@ -111,6 +111,12 @@ class PieceCostModel(object):  # ptlint: disable=pickle-unsafe-attrs — lives o
         self._alpha = float(alpha)
         self._lock = threading.Lock()
         self._ewma = {}    # piece -> observed EWMA seconds
+        #: running sum of ``_ewma`` values, maintained by observe() so
+        #: predict() gets the observed mean in O(1) — summing the dict
+        #: per call made epoch-0 admission O(n^2) under the lock every
+        #: ack contends on.  Float drift is irrelevant: predictions
+        #: only rank pieces against each other.
+        self._ewma_sum = 0.0
         self._prior = {}   # piece -> relative size weight
         self._prior_mean = 0.0
         self.observations = 0
@@ -126,8 +132,10 @@ class PieceCostModel(object):  # ptlint: disable=pickle-unsafe-attrs — lives o
     def observe(self, piece, seconds):
         with self._lock:
             prev = self._ewma.get(piece)
-            self._ewma[piece] = (seconds if prev is None
-                                 else prev + self._alpha * (seconds - prev))
+            value = (seconds if prev is None
+                     else prev + self._alpha * (seconds - prev))
+            self._ewma[piece] = value
+            self._ewma_sum += value - (prev or 0.0)
             self.observations += 1
 
     def skew_ratio(self, min_pieces=8):
@@ -154,15 +162,15 @@ class PieceCostModel(object):  # ptlint: disable=pickle-unsafe-attrs — lives o
             observed = self._ewma.get(piece)
             if observed is not None:
                 return observed
+            observed_mean = (self._ewma_sum / len(self._ewma)
+                             if self._ewma else None)
             prior = self._prior.get(piece)
             if prior is None:
                 # unknown piece: rank at the observed mean (neutral)
-                return (sum(self._ewma.values()) / len(self._ewma)
-                        if self._ewma else self._prior_mean)
-            if self._ewma and self._prior_mean:
-                scale = ((sum(self._ewma.values()) / len(self._ewma))
-                         / self._prior_mean)
-                return prior * scale
+                return (observed_mean if observed_mean is not None
+                        else self._prior_mean)
+            if observed_mean is not None and self._prior_mean:
+                return prior * (observed_mean / self._prior_mean)
             return prior
 
 
@@ -222,14 +230,9 @@ class AdaptiveDispatchPolicy(object):
 
     adaptive = True
 
-    def __init__(self, cost_model, window=64, reserve_frac=0.25,
-                 early_limit=None):
+    def __init__(self, cost_model, window=64, early_limit=None):
         self.cost_model = cost_model
         self.window = max(2, int(window))
-        #: at least this fraction of the pending window is always held
-        #: as fast backfill — a degenerate cost model (everything looks
-        #: slow) must not devolve into full reverse-order dispatch
-        self._reserve_frac = min(0.9, max(0.0, float(reserve_frac)))
         #: at most this many slow pieces may run AHEAD of the dispatch
         #: frontier at once (None = unlimited).  Front-loading every
         #: worker with slow pieces would stall delivery (and the
@@ -288,8 +291,11 @@ class AdaptiveDispatchPolicy(object):
             costs = self._costs
             ranked = sorted(self._pending, key=lambda i: (costs[i], -i))
             median = costs[ranked[len(ranked) // 2]]
-            reserve = int(self._reserve_frac * len(ranked))
-            slow = [i for i in (ranked[reserve:] if reserve else ranked)
+            # SLOW_FACTOR is also the degenerate-cost-model guard: when
+            # everything looks equally expensive nothing clears 4x the
+            # median, so dispatch stays exact epoch order instead of
+            # devolving into reverse-cost order
+            slow = [i for i in ranked
                     if median > 0 and costs[i] >= SLOW_FACTOR * median]
             if slow and (self.early_limit is None
                          or len(self._early) < self.early_limit):
@@ -464,6 +470,8 @@ class Autotuner(object):
         self._last_tune = 0.0
         self._last_observations = 0
         self._last_wait = self._last_step = 0.0
+        if stall_monitor is not None:
+            self._baseline_stall_monitor(stall_monitor)
         if registry is not None:
             self._g_window = registry.gauge('sched_window')
             self._g_inflight = registry.gauge('sched_max_inflight')
@@ -472,6 +480,16 @@ class Autotuner(object):
 
     def attach_stall_monitor(self, monitor):
         self._stall_monitor = monitor
+        if monitor is not None:
+            self._baseline_stall_monitor(monitor)
+
+    def _baseline_stall_monitor(self, monitor):
+        """Snapshot the monitor's counters so the first window is a
+        DELTA — an attached monitor may carry lifetime totals (warmup
+        stalls long resolved) that would otherwise drive the first
+        prefetch decision."""
+        self._last_wait = monitor.wait_time
+        self._last_step = monitor.step_time
 
     def _window_wait_fraction(self):
         """StallMonitor delta since the last tune (None when absent or

@@ -183,10 +183,23 @@ def test_adaptive_policy_launches_predicted_slow_first_within_window():
     model = sched.PieceCostModel()
     # piece 5 is predicted 100x every other piece in the first window
     model.seed({i: (1000 if i == 5 else 10) for i in range(12)})
-    policy = sched.AdaptiveDispatchPolicy(model, window=8, reserve_frac=0.25)
+    policy = sched.AdaptiveDispatchPolicy(model, window=8)
     seq = _dispatch_all(policy, [(i, 0) for i in range(12)])
     # slow piece 5 dispatches first even though FIFO rank is 5
     assert seq[0][1][0] == 5
+
+
+def test_adaptive_policy_uniform_costs_dispatch_in_epoch_order():
+    """The degenerate-cost-model guard: when every pending piece
+    predicts (near-)equal cost, nothing clears ``SLOW_FACTOR`` times
+    the pending median, so dispatch stays exact epoch order — it must
+    not devolve into reverse-cost order, which would pin every
+    in-flight slot until its delivery turn and idle the pool."""
+    model = sched.PieceCostModel()
+    model.seed({i: 10.0 + 0.01 * (i % 3) for i in range(24)})
+    policy = sched.AdaptiveDispatchPolicy(model, window=8)
+    seq = _dispatch_all(policy, [(i, 0) for i in range(24)])
+    assert [p for p, _ in seq] == list(range(24))
 
 
 def test_adaptive_policy_lag_bound_forces_oldest():
@@ -456,6 +469,34 @@ def test_autotuner_rate_limited():
     first = (knobs.window, knobs.max_inflight, knobs.prefetch)
     tuner.tune(knobs, decode=_FakeHist(0.001, 0.5))   # inside the window
     assert (knobs.window, knobs.max_inflight, knobs.prefetch) == first
+
+
+class _FakeStallMonitor:
+    def __init__(self, wait_time=0.0, step_time=0.0):
+        self.wait_time = wait_time
+        self.step_time = step_time
+
+
+@pytest.mark.parametrize('attach_via', ['ctor', 'attach'])
+def test_autotuner_baselines_attached_stall_monitor(attach_via):
+    """A monitor attached mid-life carries lifetime totals (e.g. warmup
+    stalls long resolved).  The first tuning window must be a DELTA
+    from the attach point — stale history must not drive a prefetch
+    doubling; a genuinely starved window after attach must."""
+    monitor = _FakeStallMonitor(wait_time=100.0, step_time=1.0)
+    if attach_via == 'ctor':
+        tuner = sched.Autotuner(interval_s=0.0, min_observations=0,
+                                stall_monitor=monitor)
+    else:
+        tuner = sched.Autotuner(interval_s=0.0, min_observations=0)
+        tuner.attach_stall_monitor(monitor)
+    knobs = sched.SchedulerKnobs(window=32, max_inflight=8, prefetch=2)
+    tuner.tune(knobs)
+    assert knobs.prefetch == 2      # no wait since attach: hold
+    monitor.wait_time += 10.0       # consumer starved THIS window
+    monitor.step_time += 1.0
+    tuner.tune(knobs)
+    assert knobs.prefetch == 4
 
 
 def test_loader_autotune_wires_gauges(skewed_dataset):
