@@ -1227,6 +1227,159 @@ def epoch_cache_plane_leg(pairs=3):
     return fields
 
 
+def _cluster_fleet_pass(shared_plane, worker_planes, collect_digest=False,
+                        wait_digests=0):
+    """One ordered client pass over the JPEG dataset against a fresh
+    dispatcher with one worker per plane dir (distinct dirs = a
+    simulated multi-host fleet; the per-worker ``cache_plane_dir``
+    override exists for exactly this).  Returns ``(rate, digest,
+    worker_diags)`` — the digest hashes every delivered row's id + image
+    bytes in delivery order (``ordered=True`` + ``workers_count=1``
+    split readers make the sequence deterministic regardless of which
+    worker serves), so two passes are bit-identical iff digests match."""
+    import hashlib
+
+    from petastorm_tpu.service import (Dispatcher, ServiceConfig,
+                                       ServiceDataLoader, Worker)
+    from petastorm_tpu.service.worker import _Rpc
+
+    config = ServiceConfig(
+        DATASET_URL, num_consumers=1, rowgroups_per_split=2,
+        lease_ttl_s=30.0, reader_kwargs={'workers_count': 1},
+        cache_plane=True, cache_plane_dir=shared_plane)
+    with Dispatcher(config) as dispatcher:
+        workers = [Worker(dispatcher.addr, cache_plane_dir=p).start()
+                   for p in worker_planes]
+        try:
+            if wait_digests:
+                # The warm worker's digest advertisement + the piece map
+                # ride heartbeats; let them land before granting leases
+                # so the measured pass is the WARM path, not a race.
+                import zmq
+                context = zmq.Context()
+                rpc = _Rpc(context, dispatcher.addr)
+                try:
+                    deadline = time.monotonic() + 30
+                    while time.monotonic() < deadline:
+                        rollup = rpc.call({'op': 'stats'})['cluster_cache']
+                        if rollup['piece_map'] \
+                                and rollup['directory_digests'] \
+                                >= wait_digests:
+                            break
+                        time.sleep(0.2)
+                finally:
+                    rpc.close()
+                    context.term()
+            loader = ServiceDataLoader(dispatcher.addr, batch_size=BATCH,
+                                       consumer=0, drop_last=False,
+                                       prefetch=2, ordered=True)
+            h = hashlib.blake2b(digest_size=16) if collect_digest else None
+            n_host, t0, t_end = 0, None, None
+            with loader:
+                for i, batch in enumerate(loader.iter_host_batches()):
+                    if i == 0:
+                        t0 = time.monotonic()
+                    else:
+                        n_host += len(batch['noun_id'])
+                        t_end = time.monotonic()
+                    if h is not None:
+                        h.update(np.ascontiguousarray(
+                            batch['noun_id']).tobytes())
+                        h.update(np.ascontiguousarray(
+                            batch['image']).tobytes())
+            diags = [w.diagnostics for w in workers]
+        finally:
+            for w in workers:
+                w.stop()
+            for w in workers:
+                w.join()
+    rate = (n_host / (t_end - t0)
+            if n_host and t_end is not None and t_end > t0 else 0.0)
+    return rate, (h.hexdigest() if h is not None else None), diags
+
+
+def cluster_cache_leg(pairs=3):
+    """Cluster cache tier (ISSUE 10): three interleaved fleet passes
+    over the JPEG (decode-bound) dataset, medians reported —
+
+    * ``cold_join``: ONE worker, cold plane — what a lone host achieves
+      by decoding everything itself ("its own cold-decode throughput",
+      the acceptance denominator);
+    * ``cold_fleet``: TWO workers, both planes cold — the fair
+      same-topology control for the warm fleet;
+    * ``warm``: TWO workers, one plane decoded ELSEWHERE (a prior run's
+      plane; the other worker cold — the "worker joining a fleet that
+      already decoded the dataset" scenario): splits stream as remote
+      HITs out of the plane (no reader constructed), peer fill covering
+      any lease the cold joiner wins.
+
+    ``warm_over_cold_join`` is the acceptance ratio (a joining host
+    sustains this multiple of what it could decode alone);
+    ``warm_over_cold_fleet`` is the topology-controlled fleet ratio
+    (ceilinged by the single consumer's delivery bandwidth, so it
+    compresses on fast-decode hosts).  Warm delivery is asserted
+    bit-identical to the single-worker direct-decode reference in-leg —
+    an ordering or content regression fails the leg loudly rather than
+    shipping a quietly-wrong ratio."""
+    base = os.path.join(BENCH_DIR, 'cluster_cache_v1')
+    prep = os.path.join(base, 'plane_prep')
+    pieces = -(-NUM_IMAGES // 64)
+    _wipe_plane(prep)
+    _cluster_fleet_pass(prep, [prep])      # untimed: decode once into prep
+    rates = {'cold_join': [], 'cold_fleet': [], 'warm': []}
+    ref_digest = warm_digest = None
+    totals = {'cache_remote_hits': 0, 'cache_peer_fills': 0,
+              'cache_peer_degraded': 0}
+    for pair in range(max(1, int(pairs))):
+        cold_a = os.path.join(base, 'cold_a')
+        cold_b = os.path.join(base, 'cold_b')
+        _wipe_plane(cold_a)
+        _wipe_plane(cold_b)
+        rate, digest, _ = _cluster_fleet_pass(
+            cold_a, [cold_a], collect_digest=(pair == 0))
+        rates['cold_join'].append(rate)
+        if pair == 0:
+            ref_digest = digest
+        _wipe_plane(cold_a)
+        rate, _, _ = _cluster_fleet_pass(cold_a, [cold_a, cold_b])
+        rates['cold_fleet'].append(rate)
+        warm_b = os.path.join(base, 'warm_b')
+        _wipe_plane(warm_b)
+        rate, digest, diags = _cluster_fleet_pass(
+            prep, [prep, warm_b], collect_digest=(pair == 0),
+            wait_digests=pieces)
+        rates['warm'].append(rate)
+        if pair == 0:
+            warm_digest = digest
+        for diag in diags:
+            for key in totals:
+                totals[key] += diag[key]
+    if ref_digest != warm_digest:
+        # In-leg assertion (transfer/adaptive-leg discipline): the
+        # compact-line boolean gates nothing by itself.
+        raise AssertionError(
+            'cluster-cache warm delivery diverged from the direct-decode '
+            'reference (%s vs %s)' % (warm_digest, ref_digest))
+    med = {k: float(np.median(v)) for k, v in rates.items()}
+    return {
+        'cluster_cache_images_per_sec_cold_join':
+            round(med['cold_join'], 1),
+        'cluster_cache_images_per_sec_cold_fleet':
+            round(med['cold_fleet'], 1),
+        'cluster_cache_images_per_sec_warm': round(med['warm'], 1),
+        'cluster_cache_warm_over_cold_join':
+            round(med['warm'] / med['cold_join'], 2)
+            if med['cold_join'] else None,
+        'cluster_cache_warm_over_cold_fleet':
+            round(med['warm'] / med['cold_fleet'], 2)
+            if med['cold_fleet'] else None,
+        'cluster_cache_remote_hits': totals['cache_remote_hits'],
+        'cluster_cache_peer_fills': totals['cache_peer_fills'],
+        'cluster_cache_peer_degraded': totals['cache_peer_degraded'],
+        'cluster_cache_bit_identical': True,
+    }
+
+
 def transfer_plane_leg(pairs=3, reps=8):
     """Host→device transfer plane (ISSUE 6): delivered-images/s of the
     coalesced ring path and its wire-narrowed variant vs the inline
@@ -1510,6 +1663,7 @@ _IPC_PLANE_LEGS = (
     ('processpool_plane', processpool_host_plane_leg),
     ('delivery_plane_service', delivery_plane_service_leg),
     ('epoch_cache_plane', epoch_cache_plane_leg),
+    ('cluster_cache', cluster_cache_leg),
     ('transfer_plane', transfer_plane_leg),
     ('adaptive_sched', adaptive_sched_leg),
 )
@@ -1763,6 +1917,15 @@ _COMPACT_KEYS = (
     'epoch_cache_service_warm_images_per_sec',
     'epoch_cache_service_warm_over_cold',
     'stall_pct_epoch_cache_warm_scan',
+    'cluster_cache_images_per_sec_cold_join',
+    'cluster_cache_images_per_sec_cold_fleet',
+    'cluster_cache_images_per_sec_warm',
+    'cluster_cache_warm_over_cold_join',
+    'cluster_cache_warm_over_cold_fleet',
+    'cluster_cache_remote_hits',
+    'cluster_cache_peer_fills',
+    'cluster_cache_peer_degraded',
+    'cluster_cache_bit_identical',
     'stall_top_component',
     'transfer_plane_images_per_sec_inline',
     'transfer_plane_images_per_sec_coalesced',

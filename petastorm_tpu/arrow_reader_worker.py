@@ -31,6 +31,21 @@ class BatchWorkerArgs:
     retry_backoff_s: float = 0.1
 
 
+def piece_cache_key(piece, schema_view, transform_spec):
+    """Result-cache key of one batch-path row group.  ``_apply_transform``
+    runs before the cache store: the payload is post-transform, so the
+    key carries the transform identity.  Module-level for the same
+    reason as ``py_dict_reader_worker.piece_cache_key`` — the cluster
+    cache tier must reproduce it without constructing a reader."""
+    cache_key = '%s:%d:batch:%s' % (piece.path, piece.row_group,
+                                    ','.join(sorted(schema_view.fields)))
+    token = getattr(transform_spec, 'cache_token', None) \
+        if transform_spec is not None else None
+    if token:
+        cache_key += ':t{%s}' % token
+    return cache_key
+
+
 class ArrowReaderWorker(ParquetWorkerBase):
 
     #: TransformSpec.func runs at DataFrame level here and may drop rows —
@@ -40,14 +55,8 @@ class ArrowReaderWorker(ParquetWorkerBase):
 
     def process(self, piece_index, _row_drop_partition=0):
         piece = self._a.pieces[piece_index]
-        cache_key = '%s:%d:batch:%s' % (piece.path, piece.row_group,
-                                        ','.join(sorted(self._a.schema_view.fields)))
-        # _apply_transform runs before the cache store: the payload is
-        # post-transform, so the key carries the transform identity.
-        token = getattr(self._a.transform_spec, 'cache_token', None) \
-            if self._a.transform_spec is not None else None
-        if token:
-            cache_key += ':t{%s}' % token
+        cache_key = piece_cache_key(piece, self._a.schema_view,
+                                    self._a.transform_spec)
         # The retry/poison classifier wraps only the I/O stage: an ArrowInvalid
         # out of a user transform (e.g. from_pandas on a mixed-type column)
         # must surface as the transform's own error, not as a corrupt file.

@@ -34,8 +34,9 @@ import os
 import sys
 import time
 
-__all__ = ['append_entry', 'load_history', 'check', 'main',
-           'TRACKED_FIELDS', 'NOISE_BAND', 'MIN_ROUNDS_TO_GATE']
+__all__ = ['append_entry', 'load_history', 'check', 'check_integrity',
+           'main', 'TRACKED_FIELDS', 'NOISE_BAND', 'MIN_ROUNDS_TO_GATE',
+           'BACKEND_VOCABULARY']
 
 #: Higher-is-better host-plane throughput fields from the compact line.
 #: Scalars only (ipc_bytes_per_s is a dict on the compact line and is
@@ -48,8 +49,21 @@ TRACKED_FIELDS = (
     'epoch_cache_streaming_warm_images_per_sec',
     'transfer_plane_images_per_sec_coalesced',
     'adaptive_sched_images_per_sec_adaptive',
+    'cluster_cache_images_per_sec_warm',
     'dlrm_host_rows_per_s',
 )
+
+#: The ONLY backend labels ``bench.py`` ever emits: ``jax.default_backend()``
+#: values, or (verbatim, in full) the cpu-fallback label from its
+#: ``main()``.  Hand-edited history rounds have twice shipped truncated
+#: variants of that label ("cpu-fallback (...)") — a label outside this
+#: vocabulary is proof the round did not come from ``append_entry`` at
+#: the end of a real run, and the check rejects it.
+BACKEND_VOCABULARY = frozenset((
+    'cpu', 'gpu', 'tpu',
+    'cpu-fallback (TPU tunnel wedged at bench time; host decode/collate '
+    'pipeline vs reference strategy is backend-independent)',
+))
 
 #: Fractional drop below the history median that counts as a regression.
 NOISE_BAND = 0.30
@@ -104,13 +118,56 @@ def append_entry(compact, path=None):
             return None
         path = history_path(path)
         entry = dict(compact)
-        entry['ts'] = time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())
+        # Microsecond resolution: the integrity rule treats an EXACT
+        # duplicate ts as proof of a hand-copied round, so honest
+        # appends (including rapid test appends) must never collide.
+        now = time.time()
+        entry['ts'] = (time.strftime('%Y-%m-%dT%H:%M:%S', time.gmtime(now))
+                       + '.%06dZ' % int(round((now % 1.0) * 1e6) % 1000000))
         entry['round'] = len(load_history(path)) + 1
         with open(path, 'a') as f:
             f.write(json.dumps(entry, sort_keys=True, default=str) + '\n')
         return entry
     except Exception:  # noqa: BLE001 — history is memory, not the artifact
         return None
+
+
+def check_integrity(entries):
+    """Violation strings for rounds that cannot have grown through
+    ``append_entry`` at the end of a real ``bench.py`` run.
+
+    Two rules, each matching a pattern of the fabricated rounds this
+    repo's history has actually carried (and purged) twice:
+
+    * **duplicate timestamps** — ``append_entry`` stamps wall-clock
+      seconds at append time and a bench run takes minutes, so two
+      rounds sharing a ``ts`` means one was hand-copied;
+    * **backend label outside the emitter vocabulary** — ``bench.py``
+      emits ``jax.default_backend()`` or the full cpu-fallback label;
+      truncated/invented labels mean hand-written rounds.
+
+    The check gates on these unconditionally (no minimum-rounds grace):
+    an untrustworthy history makes every median it produces meaningless.
+    """
+    violations = []
+    seen_ts = {}
+    for entry in entries:
+        label = 'round %s' % entry.get('round', '?')
+        ts = entry.get('ts')
+        if ts is not None:
+            if ts in seen_ts:
+                violations.append(
+                    '%s: duplicate ts %s (also on round %s) — history '
+                    'may only grow through append_entry at the end of a '
+                    'real bench.py run' % (label, ts, seen_ts[ts]))
+            else:
+                seen_ts[ts] = entry.get('round', '?')
+        backend = entry.get('backend')
+        if backend is not None and backend not in BACKEND_VOCABULARY:
+            violations.append(
+                '%s: backend label %r is not one bench.py emits '
+                '(truncated/hand-written round)' % (label, backend))
+    return violations
 
 
 def _median(values):
@@ -131,22 +188,27 @@ def check(current=None, history=None, path=None, band=NOISE_BAND,
         {'rounds': <clean prior rounds>, 'gating': bool, 'band': band,
          'fields': {name: {'current', 'median', 'floor', 'rounds',
                            'gating', 'below_floor', 'ok'}},
-         'regressions': [field, ...], 'ok': bool}
+         'regressions': [field, ...], 'integrity': [violation, ...],
+         'ok': bool}
 
     Per-field ``ok`` is gate-aware (a below-floor value on a field whose
     gate is still off is annotated via ``below_floor`` but stays ok —
     the tool deliberately waved it through, and must say so
-    consistently in text and JSON).
+    consistently in text and JSON).  ``integrity`` violations
+    (:func:`check_integrity` over the whole store, current included)
+    fail the check regardless of the per-field gates.
     """
     entries = load_history(path) if history is None else list(history)
     if current is None:
         if not entries:
             return {'rounds': 0, 'gating': False, 'band': band,
-                    'fields': {}, 'regressions': [], 'ok': True,
+                    'fields': {}, 'regressions': [], 'integrity': [],
+                    'ok': True,
                     'note': 'no history yet — run bench.py to record '
                             'round 1'}
         current = entries[-1]
         entries = entries[:-1]
+    integrity = check_integrity(entries + [current])
     clean = [e for e in entries if not any(e.get(k) for k in _ERROR_KEYS)]
     fields = {}
     regressions = []
@@ -175,7 +237,8 @@ def check(current=None, history=None, path=None, band=NOISE_BAND,
     gating = any(f['gating'] for f in fields.values())
     return {'rounds': len(clean), 'gating': gating, 'band': band,
             'fields': fields, 'regressions': regressions,
-            'ok': not regressions}
+            'integrity': integrity,
+            'ok': not regressions and not integrity}
 
 
 def _render(report):
@@ -206,6 +269,8 @@ def _render(report):
                      'minus the %.0f%% noise band)'
                      % (', '.join(report['regressions']),
                         100 * report.get('band', NOISE_BAND)))
+    for violation in report.get('integrity', ()):
+        lines.append('INTEGRITY: ' + violation)
     return '\n'.join(lines)
 
 

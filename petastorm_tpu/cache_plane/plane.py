@@ -594,6 +594,22 @@ class CachePlane(object):
             ('%s|%s' % (self.context, key)).encode('utf-8', 'replace'),
             digest_size=16).hexdigest()
 
+    def _ram_store_gated(self, digest, blob):
+        """Hot-tier store behind THE thrash gates (one copy of the
+        rule, shared by disk-hit promotion, the fill path, and peer
+        fill): entries bigger than 1/8 of the hot tier never enter
+        (they'd evict the whole working set), and a store that itself
+        triggered an eviction means the hot tier is at capacity churn —
+        back off 30 s instead of cycling multi-MB copies through
+        /dev/shm."""
+        if self.ram is None or len(blob) * 8 > self.ram.capacity_bytes \
+                or time.monotonic() < self._promote_backoff_until:
+            return
+        before = self.ram.evictions
+        self.ram.store(digest, blob)
+        if self.ram.evictions > before:
+            self._promote_backoff_until = time.monotonic() + 30.0
+
     def _lookup(self, digest, promote=True):
         if self.ram is not None:
             value = self.ram.lookup(digest)
@@ -604,12 +620,9 @@ class CachePlane(object):
         if value is not MISS and promote and self.ram is not None \
                 and time.monotonic() >= self._promote_backoff_until:
             # Promote via the disk mapping's bytes; a failed store (hot
-            # tier full) simply leaves the entry disk-only.  Gated
-            # against thrash: entries bigger than 1/8 of the hot tier
-            # never promote (they'd evict the whole working set), and a
-            # promotion that itself triggered an eviction means the hot
-            # tier is at capacity churn — back off instead of cycling
-            # multi-MB copies through /dev/shm on every disk hit.
+            # tier full) simply leaves the entry disk-only.  The size
+            # gate runs BEFORE the copy (no point materializing bytes
+            # the gate would refuse); _ram_store_gated re-applies it.
             # The copy happens under the tier lock (a concurrent
             # _mapping_for remap closes superseded mmaps under the same
             # lock; a closed mmap raises ValueError, which must stay
@@ -621,11 +634,7 @@ class CachePlane(object):
                             if len(mapping) * 8 <= self.ram.capacity_bytes
                             else None)
                 if blob is not None:
-                    before = self.ram.evictions
-                    self.ram.store(digest, blob)
-                    if self.ram.evictions > before:
-                        self._promote_backoff_until = \
-                            time.monotonic() + 30.0
+                    self._ram_store_gated(digest, blob)
             except (KeyError, ValueError, OSError):
                 pass
         return value
@@ -710,16 +719,8 @@ class CachePlane(object):
                 return value
             if not self.disk.store(digest, blob):
                 self._m_degraded.inc()
-            # Same thrash gate as the disk->ram promotion in _lookup:
-            # oversized entries never enter the hot tier, and a store
-            # that itself evicts puts hot-tier writes on backoff.
-            if self.ram is not None \
-                    and len(blob) * 8 <= self.ram.capacity_bytes \
-                    and time.monotonic() >= self._promote_backoff_until:
-                before = self.ram.evictions
-                self.ram.store(digest, blob)
-                if self.ram.evictions > before:
-                    self._promote_backoff_until = time.monotonic() + 30.0
+            # Same thrash gate as every other hot-tier write.
+            self._ram_store_gated(digest, blob)
             return value
         finally:
             if lock_fd is not None:
@@ -738,6 +739,69 @@ class CachePlane(object):
             t1 = time.monotonic()
             self._m_fill.observe(t1 - t0)
             self.spans.span('cache/fill', t0, t1, cid=cid)
+
+    # -- digest-level surface (the cluster cache tier, ISSUE 10) -------------
+    # Entry files are named by digest, and digests already mix in the
+    # content-fingerprint context — so a digest is a location-independent,
+    # staleness-proof name any process (or host) can exchange.
+
+    def has_digest(self, digest):
+        """A published entry for ``digest`` exists in either tier."""
+        return any(os.path.exists(tier.entry_path(digest))
+                   for tier in self._tiers())
+
+    def lookup_digest(self, digest, promote=False):
+        """Decoded value by digest (``MISS`` when absent) — the remote-HIT
+        serve path's read: no key, no fill, no single-flight."""
+        if self.disk is None:
+            return MISS
+        return self._lookup(digest, promote=promote)
+
+    def entry_blob(self, digest):
+        """Raw published bytes of an entry, or None.  This is what the
+        peer-fetch RPC ships: the receiving plane republishes the bytes
+        verbatim, so a peer-filled entry is bit-identical to the
+        original by construction."""
+        for tier in self._tiers():
+            path = tier.entry_path(digest)
+            try:
+                mapping = tier._mapping_for(path, digest)
+                return bytes(memoryview(mapping))
+            except (OSError, ValueError):
+                continue
+        return None
+
+    def publish_blob(self, digest, blob):
+        """Atomically publish an already-encoded entry blob under
+        ``digest`` (peer fill) through the same crash-safe tmp+rename
+        store — and the same hot-tier thrash gates — the fill path uses.
+        False degrades (full tier / ENOSPC); never raises."""
+        if self.disk is None:
+            return False
+        try:
+            if not self.disk.store(digest, blob):
+                return False
+            self._ram_store_gated(digest, blob)
+            return True
+        except Exception:  # noqa: BLE001 — cache machinery never raises
+            logger.warning('cache plane: publish_blob(%s) failed',
+                           digest, exc_info=True)
+            return False
+
+    def held_digests(self):
+        """Digests of every published entry in either tier — what a
+        service worker advertises to the dispatcher's cache directory.
+        Digests mix in the fingerprint context, so the listing needs no
+        per-context filtering to be exchangeable."""
+        out = set()
+        for tier in self._tiers():
+            try:
+                names = os.listdir(tier.root)
+            except OSError:
+                continue
+            out.update(name[:-len(ENTRY_SUFFIX)] for name in names
+                       if name.endswith(ENTRY_SUFFIX))
+        return out
 
     # Registry views — the counter attributes older callers/tests read.
     @property

@@ -43,29 +43,54 @@ class RowWorkerArgs:
     retry_backoff_s: float = 0.1
 
 
+def piece_cache_key(piece, schema_view, transform_spec, row_drop_partition=0):
+    """Result-cache key of one (piece, row-drop-partition) work item.
+
+    Cached payloads are POST-transform on EVERY branch of ``process``
+    (the fused-columnar resize, the per-row func path, and the
+    opaque-func columnar fallback alike), so the key carries the
+    transform's identity — different resize targets / funcs must not
+    share entries (cache_type='local-disk' would otherwise serve stale
+    rows at the old resolution across runs).
+
+    Module-level because the service's cluster cache tier
+    (``service/cluster.py``) must reproduce the exact key a reader would
+    use for a piece WITHOUT constructing the reader — this function is
+    the single source of truth for the format.
+    """
+    cache_key = '%s:%d:%d:%s' % (piece.path, piece.row_group,
+                                 row_drop_partition,
+                                 ','.join(sorted(schema_view.fields)))
+    token = getattr(transform_spec, 'cache_token', None) \
+        if transform_spec is not None else None
+    if token:
+        cache_key += ':t{%s}' % token
+    return cache_key
+
+
+def columnar_fast_path(transform_spec):
+    """True when the columnar worker takes the stacked-columns path
+    (cache key suffix ``:c``, cached value = the published columns
+    dict); False routes through the per-row path (cached value = the
+    post-transform rows list).  A declared-resize spec (ResizeImages)
+    fuses into the columnar decode instead of forcing the per-row path
+    an opaque func does."""
+    ts = transform_spec
+    return ts is None or ts.func is None \
+        or bool(getattr(ts, 'columnar_fusable', False))
+
+
 class PyDictReaderWorker(ParquetWorkerBase):
 
     # -- work item -----------------------------------------------------------
 
     def process(self, piece_index, row_drop_partition=0):
         piece = self._a.pieces[piece_index]
-        cache_key = '%s:%d:%d:%s' % (piece.path, piece.row_group, row_drop_partition,
-                                     ','.join(sorted(self._a.schema_view.fields)))
-        ts = self._a.transform_spec
-        # Cached payloads are POST-transform on EVERY branch below (the
-        # fused-columnar resize, the per-row func path, and the opaque-func
-        # columnar fallback alike), so every key carries the transform's
-        # identity — different resize targets / funcs must not share
-        # entries (cache_type='local-disk' would otherwise serve stale
-        # rows at the old resolution across runs).
-        token = getattr(ts, 'cache_token', None) if ts is not None else None
-        if token:
-            cache_key += ':t{%s}' % token
+        cache_key = piece_cache_key(piece, self._a.schema_view,
+                                    self._a.transform_spec,
+                                    row_drop_partition)
         if self._a.columnar_output and self._a.ngram is None:
-            # A declared-resize spec (ResizeImages) fuses into the columnar
-            # decode instead of forcing the per-row path an opaque func does.
-            fusable = ts is not None and getattr(ts, 'columnar_fusable', False)
-            if ts is None or ts.func is None or fusable:
+            if columnar_fast_path(self._a.transform_spec):
                 # True columnar decode: no intermediate row dicts at all.
                 columns = self._a.cache.get(
                     cache_key + ':c',

@@ -18,6 +18,11 @@ machinery:
   plane: a drop-in ``petastorm_tpu.jax.DataLoader`` peer with the same
   sharding default (``jax.process_index()``) and resume-token contract,
   committing whole splits exactly once.
+* ``petastorm_tpu.service.cluster`` — the cluster cache tier (ISSUE
+  10): cache-affinity lease routing, remote HIT serving, and peer fill
+  over the epoch-cache plane's content-fingerprint digests (on by
+  default with ``cache_plane=True``; kill switch
+  ``PETASTORM_TPU_NO_CLUSTER_CACHE=1``).
 
 Console entry point: ``petastorm-tpu-data-service`` (see
 ``petastorm_tpu/service/cli.py``).

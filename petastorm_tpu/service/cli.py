@@ -52,6 +52,13 @@ def _build_parser():
                    help='hot /dev/shm tier cap (default 128 MiB)')
     d.add_argument('--cache-plane-disk-bytes', type=int, default=None,
                    help='disk tier cap (default 4 GiB)')
+    d.add_argument('--no-cluster-cache', action='store_true',
+                   help='disable the cluster cache tier (on by default '
+                        'whenever the cache plane is enabled): no '
+                        'cache-affinity lease routing, no remote HIT '
+                        'serving, no peer fill — '
+                        'PETASTORM_TPU_NO_CLUSTER_CACHE=1 is the '
+                        'equivalent kill switch')
     d.add_argument('--no-telemetry-spans', action='store_true',
                    help='do not ship per-split correlated stage spans on '
                         'the data-plane end headers (metrics registries '
@@ -73,6 +80,10 @@ def _build_parser():
                         'binds')
     w.add_argument('--max-inflight-splits', type=int, default=3)
     w.add_argument('--max-buffered-chunks', type=int, default=32)
+    w.add_argument('--cache-plane-dir', default=None,
+                   help="override the job's cache_plane_dir on THIS "
+                        'worker (host-local plane layouts; see '
+                        'Worker(cache_plane_dir=))')
 
     s = sub.add_parser('status', help='print dispatcher stats as JSON')
     s.add_argument('--dispatcher', required=True)
@@ -118,6 +129,7 @@ def main(argv=None):
             cache_plane_dir=args.cache_plane_dir,
             cache_plane_ram_bytes=args.cache_plane_ram_bytes,
             cache_plane_disk_bytes=args.cache_plane_disk_bytes,
+            cluster_cache=(False if args.no_cluster_cache else None),
             telemetry_spans=not args.no_telemetry_spans)
         with Dispatcher(config, bind=args.bind) as dispatcher:
             print('dispatcher serving %s (%d splits, %d consumers)'
@@ -135,7 +147,8 @@ def main(argv=None):
         worker = Worker(args.dispatcher, data_bind=args.data_bind,
                         advertise_host=args.advertise_host,
                         max_inflight_splits=args.max_inflight_splits,
-                        max_buffered_chunks=args.max_buffered_chunks)
+                        max_buffered_chunks=args.max_buffered_chunks,
+                        cache_plane_dir=args.cache_plane_dir)
         try:
             worker.run()  # blocks until stop()/SIGTERM
         except KeyboardInterrupt:

@@ -1,0 +1,528 @@
+"""Cluster-wide cache tier: the epoch-cache plane as a fleet asset.
+
+The plane (``petastorm_tpu/cache_plane/``) stops at the host boundary:
+N hosts training on one dataset each pay full Parquet read + decode.
+This module is the service-side glue that makes decoded entries flow
+between hosts — three cooperating mechanisms, all strictly best-effort
+(degrade-everywhere: the data plane NEVER blocks on cache machinery):
+
+* **cache-affinity lease routing** — workers advertise the digests their
+  plane holds (compact prefixes riding heartbeats); the dispatcher keeps
+  a cache directory and prefers leasing a split to a worker that already
+  holds its entries decoded (``dispatcher._op_lease``).
+* **remote HIT serving** — a worker whose leased split fully HITs its
+  local plane streams the decoded entries over the existing chunk
+  protocol without constructing a reader at all (no Parquet open, no
+  decode, no per-split pool spin-up): :meth:`ClusterCacheIdentity
+  .serve_chunks`.
+* **peer fill** — on a local MISS for a digest the directory says a peer
+  holds, the worker fetches the *encoded entry bytes* from that peer
+  over a bounded fetch RPC (:class:`PeerFetcher` / :func:`fetch_reply`,
+  reusing the data-socket chunk framing and the shm byte-path fallback
+  matrix) and republishes them verbatim through the plane's crash-safe
+  atomic publish — bit-identical by construction, and local for every
+  later epoch.
+
+What makes any of this safe is the plane's content-fingerprint keying:
+a digest names (dataset file identity x decode identity x piece), so an
+entry is valid on any host or none — there is no staleness protocol to
+get wrong, per the reproducibility framing of "Optimizing
+High-Throughput Distributed Data Pipelines" (PAPERS.md).
+
+The crux is computing a split's digests WITHOUT constructing a reader:
+:class:`ClusterCacheIdentity` resolves the same (schema view, pieces,
+transform, predicate, plane context) a per-split reader would, and the
+per-piece key formats are imported from the reader workers themselves
+(``py_dict_reader_worker.piece_cache_key`` /
+``arrow_reader_worker.piece_cache_key`` — single source of truth;
+``tests/test_cluster_cache.py`` pins the equivalence against a real
+reader's plane).
+
+Kill switch: ``PETASTORM_TPU_NO_CLUSTER_CACHE=1`` (env, beats
+everything) or ``ServiceConfig(cluster_cache=False)``; either leaves
+the service bit-identical to the pre-cluster behavior.
+"""
+
+import logging
+import os
+import pickle
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+KILL_ENV = 'PETASTORM_TPU_NO_CLUSTER_CACHE'
+
+#: Control-plane digests are truncated to this many hex chars (48 bits):
+#: the directory is advisory (affinity, holder hints) and the data plane
+#: validates by full digest, so collisions cost one wasted fetch at
+#: worst, while heartbeats stay small.
+CDIGEST_LEN = 12
+
+#: One peer fetch waits at most this long before degrading to direct
+#: decode (a dead/slow/partitioned peer must cost bounded time, and the
+#: lease TTL keeps renewing meanwhile only via heartbeats).
+FETCH_TIMEOUT_S = 8.0
+
+#: A fetch reply (or a serve of one) larger than this degrades — a
+#: bound on both sides of the RPC so one pathological entry cannot wedge
+#: a worker's event loop or a fetcher's memory.
+FETCH_MAX_BYTES = 256 << 20
+
+
+def killed():
+    return bool(os.environ.get(KILL_ENV))
+
+
+def enabled(job):
+    """Cluster tier active for this job on this process?"""
+    return bool(job.get('cluster_cache')) and bool(job.get('cache_plane')) \
+        and not killed()
+
+
+def cdigest(digest):
+    """Full entry digest -> compact control-plane digest."""
+    return digest[:CDIGEST_LEN]
+
+
+class ClusterCacheIdentity(object):  # ptlint: disable=pickle-unsafe-attrs — built and used inside one worker process, never shipped
+    """Per-(worker, job) decode identity: piece list, plane context, and
+    the exact per-piece cache digests a per-split reader would use.
+
+    Built once per worker via :meth:`build` (a footer scan, no decode,
+    no pool); ``None`` when the job's reader kwargs fall outside the
+    supported surface — the caller then simply runs without the cluster
+    tier (the local plane still works exactly as before).
+    """
+
+    def __init__(self, plane, pieces, item_digests, converter, kind,
+                 drop_partitions):
+        #: The worker's own CachePlane over the job's plane dir (same
+        #: dirs the per-split readers publish into — shared by path).
+        self.plane = plane
+        self._pieces = pieces
+        #: piece index -> [full digest per row-drop partition].
+        self._item_digests = item_digests
+        self._converter = converter
+        self._kind = kind  # 'columns' (codec reader) | 'batch' (arrow)
+        self._drop_partitions = drop_partitions
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def build(cls, job):
+        """Resolve the job's decode identity, or None (unsupported
+        kwargs / metadata errors — logged once, never raised: the
+        cluster tier is an optimization)."""
+        try:
+            return cls._build(job)
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            logger.warning('cluster cache: identity unavailable for %r '
+                           '(%s: %s); running without the cluster tier',
+                           job.get('dataset_url'), type(e).__name__, e)
+            return None
+
+    @classmethod
+    def _build(cls, job):
+        from petastorm_tpu.cache_plane import PlaneCache
+        from petastorm_tpu.errors import MetadataError
+        from petastorm_tpu.etl.dataset_metadata import (
+            get_schema, infer_or_load_unischema, load_row_groups)
+        from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+        from petastorm_tpu.reader import _plane_context
+        from petastorm_tpu.transform import transform_schema
+        from petastorm_tpu.unischema import match_unischema_fields
+
+        kwargs = dict(job.get('reader_kwargs') or {})
+        if not _supported_kwargs(kwargs):
+            logger.info('cluster cache: reader_kwargs %s outside the '
+                        'supported surface; cluster tier off',
+                        sorted(kwargs))
+            return None
+        schema_fields = kwargs.get('schema_fields')
+        predicate = kwargs.get('predicate')
+        transform_spec = kwargs.get('transform_spec')
+        drop_partitions = max(
+            1, int(kwargs.get('shuffle_row_drop_partitions') or 1))
+
+        fs, path_or_paths = get_filesystem_and_path_or_paths(
+            job['dataset_url'],
+            storage_options=kwargs.get('storage_options'),
+            filesystem=kwargs.get('filesystem'))
+        paths = (path_or_paths if isinstance(path_or_paths, list)
+                 else [path_or_paths])
+
+        # The same auto-detection _resolve_factory performs, minus the
+        # probe reader: petastorm metadata -> codec reader (columnar
+        # output), plain Parquet -> batch reader.
+        factory = job.get('reader_factory', 'auto')
+        stored_schema = None
+        if factory in ('auto', 'reader'):
+            try:
+                stored_schema = get_schema(fs, paths[0])
+                kind = 'columns'
+            except MetadataError:
+                if factory == 'reader':
+                    raise
+                kind = 'batch'
+        else:
+            kind = 'batch'
+        if kind == 'batch':
+            if schema_fields is not None and not all(
+                    isinstance(f, str) for f in schema_fields):
+                return None
+            stored_schema = infer_or_load_unischema(fs, paths[0])
+            if schema_fields is not None:
+                matched = match_unischema_fields(stored_schema,
+                                                 schema_fields)
+                schema_view = (stored_schema.create_schema_view(matched)
+                               if matched else stored_schema)
+            else:
+                schema_view = stored_schema
+            if drop_partitions != 1:
+                return None  # the batch reader has no row-drop axis
+        else:
+            if schema_fields is not None and not all(
+                    isinstance(f, str) for f in schema_fields):
+                return None  # NGram (or exotic) selections: no cluster tier
+            schema_view = (stored_schema.create_schema_view(schema_fields)
+                           if schema_fields is not None else stored_schema)
+            if not _columnar_cacheable(transform_spec):
+                # Opaque per-row funcs cache the rows list, not the
+                # published columns — servable, but the ':c'/rows split
+                # doubles the matrix; keep the supported surface at the
+                # fast path the service actually runs.
+                return None
+
+        pieces = []
+        for p in paths:
+            pieces.extend(load_row_groups(fs, p))
+        if not pieces:
+            return None
+        context = _plane_context('plane', fs, pieces, schema_view,
+                                 predicate, transform_spec)
+        plane_cache = PlaneCache(
+            job['cache_plane_dir'],
+            size_limit_bytes=job.get('cache_plane_disk_bytes'),
+            ram_bytes=job.get('cache_plane_ram_bytes'),
+            context=context)
+        plane = plane_cache.plane
+        if plane.disk is None:
+            return None  # plane dir unusable: nothing to share
+
+        item_digests = []
+        if kind == 'columns':
+            from petastorm_tpu.py_dict_reader_worker import piece_cache_key
+            for piece in pieces:
+                item_digests.append([
+                    plane.digest(piece_cache_key(piece, schema_view,
+                                                 transform_spec, part)
+                                 + ':c')
+                    for part in range(drop_partitions)])
+        else:
+            from petastorm_tpu.arrow_reader_worker import piece_cache_key
+            for piece in pieces:
+                item_digests.append(
+                    [plane.digest(piece_cache_key(piece, schema_view,
+                                                  transform_spec))])
+
+        result_schema = (transform_schema(schema_view, transform_spec)
+                         if transform_spec is not None else schema_view)
+        if kind == 'columns':
+            from petastorm_tpu.reader import _ColumnarDictConverter
+            converter = _ColumnarDictConverter(result_schema)
+        else:
+            from petastorm_tpu.arrow_reader_worker import \
+                ArrowResultConverter
+            converter = ArrowResultConverter(result_schema)
+        return cls(plane, pieces, item_digests, converter, kind,
+                   drop_partitions)
+
+    # -- digest surface ------------------------------------------------------
+
+    @property
+    def num_pieces(self):
+        return len(self._pieces)
+
+    def piece_cdigests(self):
+        """Compact digest per global piece index — the once-per-job
+        advertisement a worker ships so the dispatcher can map any
+        split's indices to directory entries.  One cdigest per piece:
+        multi-partition pieces advertise their first partition's digest
+        (affinity is advisory; serve/fetch use the full per-item set)."""
+        return [cdigest(parts[0]) for parts in self._item_digests]
+
+    def split_digests(self, indices):
+        """Full digests of a split's work items, in delivery order."""
+        out = []
+        for i in indices:
+            out.extend(self._item_digests[int(i)])
+        return out
+
+    def missing_digests(self, indices):
+        """The subset of a split's digests with no local published
+        entry — the peer-fill shopping list."""
+        return [d for d in self.split_digests(indices)
+                if not self.plane.has_digest(d)]
+
+    # -- remote-HIT serving --------------------------------------------------
+
+    def serve_chunks(self, indices):
+        """The split's chunk dicts straight from the local plane, or
+        None when ANY item misses (caller falls back to the reader path
+        with nothing emitted — all lookups happen before the first chunk
+        is returned, so a concurrent eviction can't tear a split).
+
+        Produces exactly what the per-split reader would publish: the
+        cached values are post-transform (the plane key carries the
+        transform identity) and run through the same result converter
+        (namedtuple ``_asdict``), so delivery is bit-identical to the
+        decode path.
+        """
+        from petastorm_tpu.cache_plane.plane import MISS
+        values = []
+        for i in indices:
+            for digest in self._item_digests[int(i)]:
+                value = self.plane.lookup_digest(digest)
+                if value is MISS:
+                    return None
+                values.append(value)
+        chunks = []
+        for value in values:
+            if value is None:
+                continue  # cached predicate-empty piece: publishes nothing
+            if self._kind == 'columns':
+                if not len(next(iter(value.values()), ())):
+                    continue
+                chunks.append(self._converter.convert(value)._asdict())
+            else:
+                if value.num_rows == 0:
+                    continue
+                chunks.append(self._converter.convert(value)._asdict())
+        return chunks
+
+
+def _supported_kwargs(kwargs):
+    """Reader kwargs the identity computation understands.  Anything
+    that renumbers the piece list or changes what a piece caches to —
+    and anything we have simply not audited — turns the cluster tier
+    off for the job rather than risking a wrong digest."""
+    if kwargs.get('rowgroup_selector') is not None \
+            or kwargs.get('filters') is not None:
+        return False
+    cache_type = kwargs.get('cache_type', 'plane')
+    if cache_type != 'plane':
+        return False  # an explicit non-plane cache wins (documented)
+    return True
+
+
+def _columnar_cacheable(transform_spec):
+    from petastorm_tpu.py_dict_reader_worker import columnar_fast_path
+    return columnar_fast_path(transform_spec)
+
+
+# -- peer fetch (data plane) --------------------------------------------------
+
+def fetch_reply(identity_frame, request, plane, arena=None):
+    """Build the reply frames for one ``fetch`` request — shared by the
+    worker event loop and the doctor's synthetic round-trip probe.
+
+    Returns ``[identity, header_bytes, payload]``.  The payload is the
+    raw entry blob (byte path) or a shm descriptor (``tag 'S'``) when
+    the requester proved same-host residence via its probe file — the
+    same fallback matrix as chunk delivery.  Absent/oversized entries
+    reply ``ok=False`` with an empty payload (the fetcher degrades).
+    """
+    digest = str(request.get('digest', ''))
+    blob = plane.entry_blob(digest) if plane is not None and digest else None
+    if blob is None or len(blob) > FETCH_MAX_BYTES:
+        header = {'type': 'fetched', 'digest': digest, 'ok': False}
+        return [identity_frame, pickle.dumps(header, protocol=4), b'']
+    tag = b'B'
+    payload = blob
+    if arena is not None:
+        from petastorm_tpu.workers_pool import shm_plane
+        import numpy as np
+        if shm_plane.probe_exists(request.get('shm_probe')):
+            desc = shm_plane.write_columns(
+                arena, {'blob': np.frombuffer(blob, np.uint8)})
+            if desc is not None:
+                tag = b'S'
+                payload = pickle.dumps(desc, protocol=4)
+    header = {'type': 'fetched', 'digest': digest, 'ok': True, 'tag': tag,
+              'nbytes': len(blob)}
+    return [identity_frame, pickle.dumps(header, protocol=4), payload]
+
+
+class PeerFetcher(object):  # ptlint: disable=pickle-unsafe-attrs — owned by one decode thread; sockets never cross threads or processes
+    """Bounded fetch client over peers' data sockets (one DEALER per
+    peer, cached; owned by a single thread).
+
+    ``fetch`` returns the entry blob bytes or None (timeout, peer dead,
+    not found, oversized) — callers count ``cache_peer_degraded`` and
+    fall through to direct decode.  A timed-out socket is closed and
+    rebuilt on the next fetch to that peer (a DEALER with a stale
+    in-flight request would misalign replies).
+    """
+
+    def __init__(self, context, timeout_s=None):
+        import zmq
+        self._zmq = zmq
+        self._context = context
+        # Resolved per-instance at construction (not at def time) so the
+        # module constant stays the one tunable.
+        self._timeout_s = float(FETCH_TIMEOUT_S if timeout_s is None
+                                else timeout_s)
+        self._sockets = {}
+        # Same-host proof for the shm path of the fetch reply: workers
+        # are shm consumers here, exactly like clients are for chunks.
+        from petastorm_tpu.workers_pool import shm_plane
+        self._probe = None
+        if shm_plane.available():
+            try:
+                self._probe = shm_plane.make_probe()
+            except OSError:
+                pass  # byte path only — the matrix's documented fallback
+
+    def _socket(self, addr):
+        sock = self._sockets.get(addr)
+        if sock is None:
+            sock = self._context.socket(self._zmq.DEALER)
+            sock.setsockopt(self._zmq.LINGER, 0)
+            sock.connect(addr)
+            self._sockets[addr] = sock
+        return sock
+
+    def _drop(self, addr):
+        sock = self._sockets.pop(addr, None)
+        if sock is not None:
+            sock.close(0)
+
+    def fetch(self, addr, digest):
+        """Entry blob bytes from the peer at ``addr``, or None."""
+        from petastorm_tpu.workers_pool import shm_plane
+        try:
+            sock = self._socket(addr)
+            sock.send(pickle.dumps(
+                {'type': 'fetch', 'digest': digest,
+                 'shm_probe': self._probe}, protocol=4))
+            deadline = time.monotonic() + self._timeout_s
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not sock.poll(
+                        max(1, int(remaining * 1000))):
+                    self._drop(addr)
+                    # The peer may have died with our reply's shm slab
+                    # in flight: reclaim dead owners' segments so a
+                    # degraded fetch leaves zero /dev/shm residue.
+                    if self._probe is not None:
+                        shm_plane.sweep_orphans()
+                    return None
+                frames = sock.recv_multipart()
+                header = pickle.loads(frames[0])
+                if header.get('type') != 'fetched' \
+                        or header.get('digest') != digest:
+                    continue  # stale reply from a recycled exchange
+                if not header.get('ok'):
+                    return None
+                if header.get('tag') == b'S':
+                    try:
+                        payload = shm_plane.read_payload(
+                            pickle.loads(frames[1]))
+                    except shm_plane.SegmentVanishedError:
+                        return None
+                    blob = payload['blob'].tobytes()
+                else:
+                    blob = bytes(frames[1])
+                if len(blob) > FETCH_MAX_BYTES:
+                    return None
+                return blob
+        except Exception:  # noqa: BLE001 — a fetch failure is a degrade
+            self._drop(addr)
+            return None
+
+    def close(self):
+        for addr in list(self._sockets):
+            self._drop(addr)
+        from petastorm_tpu.workers_pool import shm_plane
+        shm_plane.remove_probe(self._probe)
+        self._probe = None
+
+
+class ClusterWorkerState(object):  # ptlint: disable=pickle-unsafe-attrs — per-worker-process state, never pickled
+    """Everything a service worker keeps for the cluster tier: the lazily
+    built identity (background thread — the footer scan must not delay
+    registration), the advertised-digest refresh, and the peer fetcher.
+    """
+
+    #: Re-listdir the plane's tiers for the heartbeat advertisement at
+    #: most this often; locally published digests are folded in live.
+    DIGEST_REFRESH_S = 5.0
+
+    def __init__(self, job):
+        self.identity = None
+        self._job = job
+        #: Guarded: the decode thread folds freshly published digests in
+        #: (note_published) while the event-loop thread snapshots the
+        #: set for heartbeats — an unguarded frozenset() over a set
+        #: being update()d raises mid-iteration and would kill the
+        #: event loop.
+        self._known_lock = threading.Lock()
+        self._known = set()
+        self._known_at = 0.0
+        self._advertised = None   # last frozenset shipped on a heartbeat
+        self.advertised_pieces = False
+        self._thread = threading.Thread(target=self._build, daemon=True,
+                                        name='cluster-cache-identity')
+        self._thread.start()
+
+    def _build(self):
+        identity = ClusterCacheIdentity.build(self._job)
+        # Publish the fully built object in one reference store (GIL):
+        # readers see None or a complete identity, never a partial.
+        self.identity = identity
+
+    def ready(self):
+        return self.identity is not None
+
+    def heartbeat_fields(self):
+        """The cluster fields to ride THIS heartbeat: the compact digest
+        set when it changed since last shipped, and the once-per-job
+        piece-digest map until the dispatcher has it."""
+        fields = {}
+        identity = self.identity
+        if identity is None:
+            return fields
+        now = time.monotonic()
+        if now - self._known_at >= self.DIGEST_REFRESH_S:
+            self._known_at = now
+            try:
+                listed = {cdigest(d)
+                          for d in identity.plane.held_digests()}
+                with self._known_lock:
+                    self._known = listed
+            except Exception:  # noqa: BLE001 — advertisement is advisory
+                pass
+        with self._known_lock:
+            current = frozenset(self._known)
+        if current != self._advertised:
+            self._advertised = current
+            fields['cache_digests'] = sorted(current)
+        if not self.advertised_pieces:
+            fields['piece_digests'] = identity.piece_cdigests()
+        return fields
+
+    def note_published(self, digests):
+        """Fold just-published (decoded or peer-filled) digests into the
+        advertised set without waiting for the next listdir refresh.
+        Called from the decode thread; the lock serializes against the
+        event loop's heartbeat snapshot."""
+        fresh = [cdigest(d) for d in digests]
+        with self._known_lock:
+            self._known.update(fresh)
+
+    def reset_advertisement(self):
+        """Forget what the dispatcher knows (it restarted): the next
+        heartbeat re-ships both the digest set and the piece map."""
+        self._advertised = None
+        self.advertised_pieces = False

@@ -83,6 +83,20 @@ class ServiceConfig:
             at host-local paths — the plane is a same-host cache.
         cache_plane_ram_bytes / cache_plane_disk_bytes: per-tier byte
             caps (None = the plane's defaults: 128 MiB hot, 4 GiB disk).
+        cluster_cache: opt the job into the CLUSTER cache tier
+            (``service/cluster.py``): workers advertise the digests
+            their plane holds, the dispatcher routes leases with cache
+            affinity, a worker whose leased split fully HITs its local
+            plane streams it without constructing a reader
+            (``cache_remote_hits``), and local misses a peer holds are
+            fetched from that peer instead of re-decoded
+            (``cache_peer_fills``; failures degrade to direct decode,
+            ``cache_peer_degraded``).  Defaults to ``cache_plane`` —
+            the tier is pure best-effort on top of the plane, so any
+            plane-enabled job gets it unless explicitly disabled.
+            ``PETASTORM_TPU_NO_CLUSTER_CACHE=1`` is the kill switch
+            (beats the config everywhere; either path is bit-identical
+            to the pre-cluster behavior).
         scheduling: dispatch-order policy every per-split reader runs
             with (``'auto'`` / ``'fifo'`` / ``'adaptive'`` — see
             ``make_reader(scheduling=)``).  Splits are small by design
@@ -118,6 +132,7 @@ class ServiceConfig:
     cache_plane_dir: str = None
     cache_plane_ram_bytes: int = None
     cache_plane_disk_bytes: int = None
+    cluster_cache: bool = None
     scheduling: str = 'auto'
     telemetry_spans: bool = True
 
@@ -139,6 +154,11 @@ class ServiceConfig:
             raise ValueError('shm_capacity_bytes must be positive')
         if self.cache_plane and not self.cache_plane_dir:
             raise ValueError('cache_plane=True requires cache_plane_dir')
+        if self.cluster_cache is None:
+            self.cluster_cache = bool(self.cache_plane)
+        if self.cluster_cache and not self.cache_plane:
+            raise ValueError('cluster_cache=True requires cache_plane=True '
+                             '(the cluster tier shares the plane entries)')
         if self.scheduling not in ('auto', 'fifo', 'adaptive'):
             raise ValueError("scheduling must be 'auto', 'fifo' or "
                              "'adaptive', got %r" % (self.scheduling,))
@@ -175,6 +195,7 @@ class ServiceConfig:
             'cache_plane_dir': self.cache_plane_dir,
             'cache_plane_ram_bytes': self.cache_plane_ram_bytes,
             'cache_plane_disk_bytes': self.cache_plane_disk_bytes,
+            'cluster_cache': bool(self.cluster_cache),
             'scheduling': self.scheduling,
             'telemetry_spans': bool(self.telemetry_spans),
             'fingerprint': self.fingerprint(num_splits),

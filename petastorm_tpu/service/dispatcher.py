@@ -44,12 +44,27 @@ logger = logging.getLogger(__name__)
 
 _PENDING, _LEASED, _DONE, _FAILED = 'pending', 'leased', 'done', 'failed'
 
+#: Cache-affinity lease routing (ISSUE 10) — all three knobs bound the
+#: preference strictly: affinity may REORDER pending work, never delay
+#: it unboundedly.
+#: How many pending splits one lease call may look at when choosing.
+_AFFINITY_SCAN = 64
+#: A worker "holds" a split when it advertises at least this fraction of
+#: the split's digests (peer fill covers the remainder).
+_AFFINITY_MIN_COVERAGE = 0.5
+#: A split held by another live worker is kept back from a cold
+#: requester for at most this long (further bounded by lease_ttl/5)
+#: before first-come-first-served resumes.  Splits requeued by lease
+#: expiry (attempt > 0) are NEVER deferred — reassignment latency is the
+#: failure-recovery bound and affinity must not touch it.
+_AFFINITY_DEFER_S = 2.0
+
 
 class Split(object):
     """One leasable unit of decode work: consecutive row-group indices."""
 
     __slots__ = ('split_id', 'indices', 'consumer', 'attempt', 'state',
-                 'worker_id', 'lease_expires')
+                 'worker_id', 'lease_expires', 'affinity_defer_until')
 
     def __init__(self, split_id, indices, consumer):
         self.split_id = split_id
@@ -59,6 +74,10 @@ class Split(object):
         self.state = _PENDING
         self.worker_id = None
         self.lease_expires = 0.0
+        #: Monotonic deadline of this split's affinity preference window
+        #: (set on the first deferral, cleared on grant): past it, any
+        #: requester gets the split.
+        self.affinity_defer_until = None
 
     def describe(self):
         return {'split_id': self.split_id, 'indices': list(self.indices),
@@ -106,6 +125,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         if num_pieces < 1:
             raise ValueError('dataset %r has no row groups'
                              % (config.dataset_url,))
+        self._num_pieces = int(num_pieces)
         self._splits = build_splits(num_pieces, config.rowgroups_per_split,
                                     config.num_consumers)
         self._job = config.job_info(len(self._splits))
@@ -113,6 +133,30 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         self._workers = {}   # worker_id -> {'addr', 'last_heartbeat', 'stats'}
         self._next_worker_id = 0
         self.lease_churn = 0
+        # -- cluster cache directory (ISSUE 10) ------------------------------
+        # Advisory state only: a wrong/stale entry costs one deferred or
+        # misrouted lease, never correctness (workers validate by full
+        # digest; the plane validates by content fingerprint).
+        from petastorm_tpu.service import cluster as _cluster
+        #: worker_id -> set of compact digests its plane holds (replaced
+        #: wholesale whenever a heartbeat ships the field).
+        self._worker_digests = {}
+        #: global piece index -> compact digest, advertised once per job
+        #: by the first cluster-enabled worker whose identity resolves.
+        self._piece_digests = None
+        #: worker_ids whose advertised map was rejected (wrong length =
+        #: a different view of the dataset): asked once, declined
+        #: permanently — re-asking every beat would warn-spam forever
+        #: and re-ship a large invalid list with no path to acceptance.
+        self._piece_digests_declined = set()
+        self._cluster_on = (bool(self._job.get('cluster_cache'))
+                            and not _cluster.killed())
+        #: Leases granted to a worker that already held the split
+        #: (coverage >= _AFFINITY_MIN_COVERAGE).
+        self.affinity_routed = 0
+        #: Lease calls answered 'wait' because every scannable split was
+        #: inside another worker's preference window.
+        self.affinity_deferrals = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
@@ -230,6 +274,10 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             'workers_registered': alive,
         })
         merged['counters']['lease_churn'] = self.lease_churn
+        # Control-plane cluster counters: the worker-side ones
+        # (cache_remote_hits / peer_fills / peer_degraded) already ride
+        # the merged heartbeat registries above.
+        merged['counters']['cache_affinity_routed'] = self.affinity_routed
         return merged
 
     # -- lease bookkeeping ---------------------------------------------------
@@ -311,6 +359,28 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             worker['last_heartbeat'] = now
             if request.get('stats'):
                 worker['stats'] = dict(request['stats'])
+            # Cluster cache directory (ISSUE 10): the advertised digest
+            # set replaces wholesale (workers only ship it on change);
+            # the piece-digest map is per-job, first valid one wins.
+            if request.get('cache_digests') is not None:
+                self._worker_digests[worker_id] = {
+                    str(d) for d in request['cache_digests']}
+            pieces = request.get('piece_digests')
+            if self._cluster_on and pieces and self._piece_digests is None:
+                pieces = [str(d) for d in pieces]
+                if len(pieces) == self._num_pieces:
+                    self._piece_digests = pieces
+                elif worker_id not in self._piece_digests_declined:
+                    self._piece_digests_declined.add(worker_id)
+                    logger.warning(
+                        'worker %s advertised %d piece digests for a '
+                        '%d-piece job (differing dataset view); '
+                        'declining its map permanently', worker_id,
+                        len(pieces), self._num_pieces)
+            need_pieces = (self._cluster_on
+                           and self._piece_digests is None
+                           and worker_id not in
+                           self._piece_digests_declined)
             for split in self._splits:
                 if split.state == _LEASED and split.worker_id == worker_id \
                         and (held is None or split.split_id in held):
@@ -319,7 +389,116 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         # 7 satellite) — long-lived workers drift off their one
         # registration-time offset, so the worker EWMAs the midpoint
         # estimate from each beat and ships `clock_drift_ms` back.
-        return {'ok': True, 't_mono': time.monotonic()}
+        return {'ok': True, 't_mono': time.monotonic(),
+                'need_piece_digests': need_pieces}
+
+    # -- cache-affinity helpers (ISSUE 10; callers hold self._lock) ----------
+
+    def _split_cdigests(self, split):
+        """Compact digests of a split's pieces, or None before any
+        worker advertised the piece map."""
+        if self._piece_digests is None:
+            return None
+        return [self._piece_digests[i] for i in split.indices]
+
+    def _coverage(self, split, worker_id):
+        """Fraction of the split's digests the worker advertises, or
+        None without directory evidence."""
+        held = self._worker_digests.get(worker_id)
+        digests = self._split_cdigests(split)
+        if not held or not digests:
+            return None
+        return sum(1 for d in digests if d in held) / float(len(digests))
+
+    def _alive_holder(self, split, exclude_worker):
+        """Another live worker that holds this split (the deferral
+        predicate — and the holder set must be workers that can actually
+        be leased to, hence the heartbeat-staleness gate)."""
+        digests = self._split_cdigests(split)
+        if not digests:
+            return None
+        stale = 3.0 * self._config.lease_ttl_s
+        now = time.monotonic()
+        for wid, held in self._worker_digests.items():
+            if wid == exclude_worker or wid not in self._workers:
+                continue
+            if now - self._workers[wid]['last_heartbeat'] >= stale:
+                continue
+            if sum(1 for d in digests if d in held) \
+                    >= _AFFINITY_MIN_COVERAGE * len(digests):
+                return wid
+        return None
+
+    def _split_holders(self, split, exclude_worker):
+        """cdigest -> [data addr, ...] of live peers holding it — the
+        lease reply's peer-fill hints."""
+        digests = self._split_cdigests(split)
+        if not digests:
+            return None
+        stale = 3.0 * self._config.lease_ttl_s
+        now = time.monotonic()
+        holders = {}
+        for wid, held in self._worker_digests.items():
+            worker = self._workers.get(wid)
+            if wid == exclude_worker or worker is None:
+                continue
+            if now - worker['last_heartbeat'] >= stale:
+                continue
+            for digest in digests:
+                if digest in held:
+                    holders.setdefault(digest, []).append(worker['addr'])
+        return holders or None
+
+    def _choose_pending(self, worker_id, consumers):
+        """Pop the split to lease to ``worker_id`` (None = nothing
+        assignable now).  FIFO, except that with directory evidence the
+        call prefers (within a bounded scan window) a split the
+        requester already holds, and keeps a split another live worker
+        holds back from a cold requester for a bounded window.  Splits
+        requeued by lease expiry (attempt > 0) are never kept back."""
+        affinity = (self._cluster_on and self._piece_digests is not None
+                    and bool(self._worker_digests))
+        window, skipped = [], []
+        limit = _AFFINITY_SCAN if affinity else 1
+        while self._pending and len(window) < limit:
+            split = self._pending.popleft()
+            if split.state != _PENDING:
+                continue  # completed via mark_consumed while queued
+            if consumers is not None and split.consumer not in consumers:
+                skipped.append(split)
+                continue
+            window.append(split)
+        chosen = None
+        routed = False
+        if affinity and window:
+            for split in window:
+                coverage = self._coverage(split, worker_id)
+                if coverage is not None \
+                        and coverage >= _AFFINITY_MIN_COVERAGE:
+                    chosen, routed = split, True
+                    break
+        if chosen is None:
+            now = time.monotonic()
+            defer_s = min(_AFFINITY_DEFER_S,
+                          self._config.lease_ttl_s / 5.0)
+            for split in window:
+                if affinity and split.attempt == 0 \
+                        and self._alive_holder(split, worker_id):
+                    if split.affinity_defer_until is None:
+                        split.affinity_defer_until = now + defer_s
+                    if now < split.affinity_defer_until:
+                        continue  # inside its holder's preference window
+                chosen = split
+                break
+            if chosen is None and window:
+                self.affinity_deferrals += 1
+        # Unchosen window members go back to the FRONT in order (the
+        # scan must not rotate the FIFO); consumer-mismatched splits
+        # rejoin at the back exactly as before.
+        for split in reversed([s for s in window if s is not chosen]):
+            self._pending.appendleft(split)
+        self._pending.extend(skipped)
+        return chosen, routed
 
     def _op_lease(self, request):
         worker_id = request['worker_id']
@@ -335,29 +514,27 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             if worker_id not in self._workers:
                 return {'error': 'unknown worker %r' % worker_id}
             self._workers[worker_id]['last_heartbeat'] = time.monotonic()
-            chosen, skipped = None, []
-            while self._pending:
-                split = self._pending.popleft()
-                if split.state != _PENDING:
-                    continue  # completed via mark_consumed while queued
-                if consumers is not None and split.consumer not in consumers:
-                    skipped.append(split)
-                    continue
-                chosen = split
-                break
-            self._pending.extend(skipped)
+            chosen, routed = self._choose_pending(worker_id, consumers)
             if chosen is not None:
                 chosen.state = _LEASED
                 chosen.worker_id = worker_id
                 chosen.lease_expires = (time.monotonic()
                                         + self._config.lease_ttl_s)
+                chosen.affinity_defer_until = None
+                if routed:
+                    self.affinity_routed += 1
+                holders = (self._split_holders(chosen, worker_id)
+                           if self._cluster_on else None)
                 if self._trace is not None:
                     self._trace.instant('service/lease_grant',
                                         split=chosen.split_id,
                                         worker=worker_id,
                                         attempt=chosen.attempt)
-                return {'split': chosen.describe(),
-                        'ttl': self._config.lease_ttl_s}
+                reply = {'split': chosen.describe(),
+                         'ttl': self._config.lease_ttl_s}
+                if holders:
+                    reply['holders'] = holders
+                return reply
             if all(s.state in (_DONE, _FAILED) for s in self._splits):
                 return {'done': True}
             return {'wait': True}
@@ -451,6 +628,23 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         # unusable) was invisible without reading every worker's row.
         shm = {key: sum(int(w.get(key, 0)) for w in workers.values())
                for key in ('shm_chunks', 'shm_degraded')}
+        # Cluster cache tier rollup (ISSUE 10): worker counters summed
+        # fleet-wide plus the dispatcher's own routing counters and the
+        # directory's footprint — one `status`/`top` call says whether
+        # the fleet is sharing decoded entries or re-paying decode.
+        cluster = {key: sum(int(w.get(key, 0)) for w in workers.values())
+                   for key in ('cache_remote_hits', 'cache_peer_fills',
+                               'cache_peer_degraded')}
+        with self._lock:
+            cluster.update({
+                'cache_affinity_routed': self.affinity_routed,
+                'affinity_deferrals': self.affinity_deferrals,
+                'directory_workers': len(self._worker_digests),
+                'directory_digests': len(set().union(
+                    *self._worker_digests.values()))
+                if self._worker_digests else 0,
+                'piece_map': self._piece_digests is not None,
+            })
         # True fleet-wide stage latencies: the heartbeat registry
         # snapshots merge by histogram-bucket addition (the reason the
         # buckets are fixed log2), then each stage reports the ONE
@@ -499,6 +693,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             'lease_churn': self.lease_churn,
             'cache': cache,
             'shm': shm,
+            'cluster_cache': cluster,
             'stages': stages,
             'health': fleet_health,
             'workers': workers,

@@ -152,11 +152,18 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         max_inflight_splits / max_buffered_chunks: see ``ServiceConfig``.
         trace_recorder: optional ``benchmark.TraceRecorder`` — each
             decoded split is recorded as a ``service/decode_split`` span.
+        cache_plane_dir: override the job's ``cache_plane_dir`` for THIS
+            worker.  The plane is a host-local asset: workers on
+            different machines naturally resolve the job's path on their
+            own filesystems, but co-hosted workers that must NOT share a
+            plane (tests, benches simulating a multi-host fleet, tiered
+            storage layouts) point each at its own directory here.
     """
 
     def __init__(self, dispatcher_addr, data_bind='tcp://127.0.0.1:*',
                  advertise_host=None, max_inflight_splits=3,
-                 max_buffered_chunks=32, trace_recorder=None):
+                 max_buffered_chunks=32, trace_recorder=None,
+                 cache_plane_dir=None):
         self._dispatcher_addr = dispatcher_addr
         self._data_bind = data_bind
         self._advertise_host = advertise_host
@@ -210,6 +217,20 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                          for key in ('cache_hits', 'cache_misses',
                                      'cache_evictions', 'cache_ram_hits',
                                      'cache_degraded')}
+        #: Cluster cache tier (ISSUE 10): remote_hits counts pieces of a
+        #: leased split streamed straight from the local plane (no
+        #: reader constructed); peer_fills counts entries fetched from a
+        #: peer's plane instead of re-decoded; peer_degraded counts
+        #: fetches that failed (dead/slow/absent peer -> direct decode).
+        self._m_cluster = {key: self.metrics.counter(key)
+                           for key in ('cache_remote_hits',
+                                       'cache_peer_fills',
+                                       'cache_peer_degraded')}
+        self._m_serve_hist = self.metrics.histogram('serve_cached_split')
+        #: ClusterWorkerState when the job opts in (None otherwise /
+        #: killed); owned by run(), read by the event + decode threads.
+        self._cluster = None
+        self._cache_plane_dir = cache_plane_dir
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -271,11 +292,21 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             t_reg1 = time.monotonic()
             self.worker_id = reply['worker_id']
             job = reply['job']
+            if self._cache_plane_dir is not None:
+                # Host-local override applied in ONE place: every
+                # downstream consumer (per-split readers, the cluster
+                # identity) sees the same resolved path.
+                job = dict(job, cache_plane_dir=self._cache_plane_dir)
             # Clock handshake (ISSUE 5): dispatcher monotonic against
             # the local send/recv midpoint — wrong by at most rtt/2,
             # which orders spans fine on any LAN.  Heartbeats repeat it
             # (ISSUE 7: drift EWMA).
             self._update_clock(reply.get('t_mono'), t_reg0, t_reg1)
+            from petastorm_tpu.service import cluster
+            if cluster.enabled(job):
+                # Identity build is a footer scan — background it so a
+                # big dataset cannot delay registration/first lease.
+                self._cluster = cluster.ClusterWorkerState(job)
             from petastorm_tpu.telemetry import flight
             # Always-on flight recorder for this process: the minutes
             # before a worker death persist when a flight dir is set.
@@ -288,6 +319,10 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                         shm_plane.DEFAULT_CAPACITY_BYTES),
                     metrics=self.metrics)
             self._t_start = time.monotonic()
+            #: shared zmq context for the decode thread's peer fetcher
+            #: (contexts are thread-safe; the fetcher's sockets live and
+            #: die on the decode thread alone).
+            self._zmq_context = context
             self._ready.set()
             decode_thread = threading.Thread(
                 target=self._decode_loop, args=(job, decode_in, decode_out),
@@ -415,6 +450,19 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     elif kind == 'credit':
                         if identity in credits:
                             credits[identity] += int(msg.get('n', 1))
+                    elif kind == 'fetch':
+                        # Cluster cache tier (ISSUE 10): a peer worker
+                        # asks for one encoded plane entry by digest.
+                        # Request/reply on the spot — fetches are not
+                        # credit-gated chunks, and the entry read is a
+                        # bounded mmap copy, not a decode.
+                        from petastorm_tpu.service import cluster
+                        state = self._cluster
+                        plane = (state.identity.plane
+                                 if state is not None and state.ready()
+                                 else None)
+                        data.send_multipart(cluster.fetch_reply(
+                            identity, msg, plane, arena=self._arena))
                     elif kind == 'ack':
                         key = (int(msg['split']), int(msg['attempt']))
                         split = awaiting_ack.pop(key, None)
@@ -510,10 +558,25 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             if now - last_heartbeat >= heartbeat_every:
                 try:
                     t_hb0 = time.monotonic()
-                    reply = rpc.call({'op': 'heartbeat',
-                                      'worker_id': self.worker_id,
-                                      'stats': self.heartbeat_stats(),
-                                      'held': list(inflight)})
+                    request = {'op': 'heartbeat',
+                               'worker_id': self.worker_id,
+                               'stats': self.heartbeat_stats(),
+                               'held': list(inflight)}
+                    # Cluster cache advertisement rides the heartbeat
+                    # (ISSUE 10): the compact held-digest set when it
+                    # changed, and the once-per-job piece-digest map
+                    # until the dispatcher confirms it has one.
+                    sent_pieces = False
+                    if self._cluster is not None:
+                        fields = self._cluster.heartbeat_fields()
+                        sent_pieces = 'piece_digests' in fields
+                        request.update(fields)
+                    reply = rpc.call(request)
+                    if self._cluster is not None:
+                        if sent_pieces and reply.get('ok'):
+                            self._cluster.advertised_pieces = True
+                        if reply.get('need_piece_digests'):
+                            self._cluster.advertised_pieces = False
                     # Opportunistic clock re-handshake (ISSUE 7): the
                     # beat's send/recv midpoint EWMAs into clock_offset
                     # so a long-lived worker tracks drift instead of
@@ -533,6 +596,10 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                                        self._dispatcher_addr,
                                        reply['worker_id'], self.worker_id)
                         self.worker_id = reply['worker_id']
+                        if self._cluster is not None:
+                            # A restarted dispatcher lost the directory:
+                            # re-advertise everything on the next beat.
+                            self._cluster.reset_advertisement()
                     except ServiceError:  # incl. timeout; retry next beat
                         pass
                 last_heartbeat = now  # retry next interval, don't spin
@@ -549,6 +616,12 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     reply = {'wait': True}
                 if reply.get('split'):
                     split = reply['split']
+                    # Cluster tier: the dispatcher's directory hints at
+                    # which peers hold this split's entries (cdigest ->
+                    # [data addr]); the decode thread uses them for peer
+                    # fill.  Advisory: absent/stale hints just decode.
+                    if reply.get('holders'):
+                        split['holders'] = reply['holders']
                     inflight[split['split_id']] = split
                     decoding.add(split['split_id'])
                     decode_in.put(split)
@@ -651,8 +724,103 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             self.metrics.merge(
                 {'histograms': plane_metrics.snapshot()['histograms']})
 
+    def _cluster_chunks(self, split, fetcher):
+        """Try the cluster cache tier for a leased split: peer-fill any
+        local misses the lease's holder hints cover, then look the whole
+        split up in the local plane.  Returns ``(chunks, fetcher)`` —
+        ``chunks`` is None when the split (still) cannot be served
+        cache-only, in which case NOTHING has been emitted and the
+        caller falls through to the reader path (which itself benefits
+        from whatever peer fill just published).  Never raises: every
+        failure here is a degrade back to decode."""
+        from petastorm_tpu.service import cluster
+        state = self._cluster
+        if state is None or not state.ready():
+            return None, fetcher
+        identity = state.identity
+        try:
+            indices = split['indices']
+            missing = identity.missing_digests(indices)
+            holders = split.get('holders') or {}
+            filled = []
+            for digest in missing:
+                addrs = holders.get(cluster.cdigest(digest)) or ()
+                if not addrs:
+                    continue  # nobody holds it: plain cold decode, no
+                    # counter — degrade counts FAILED fetches only
+                if fetcher is None:
+                    fetcher = cluster.PeerFetcher(self._zmq_context)
+                blob = None
+                for addr in addrs:
+                    blob = fetcher.fetch(addr, digest)
+                    if blob is not None:
+                        break
+                if blob is not None \
+                        and identity.plane.publish_blob(digest, blob):
+                    self._m_cluster['cache_peer_fills'].inc()
+                    filled.append(digest)
+                else:
+                    self._m_cluster['cache_peer_degraded'].inc()
+            if filled:
+                state.note_published(filled)
+            chunks = identity.serve_chunks(indices)
+            if chunks is not None:
+                self._m_cluster['cache_remote_hits'].inc(
+                    len(identity.split_digests(indices)))
+            return chunks, fetcher
+        except Exception:  # noqa: BLE001 — cluster tier degrades, never blocks
+            logger.warning('cluster cache: serving split %s degraded to '
+                           'direct decode', split.get('split_id'),
+                           exc_info=True)
+            return None, fetcher
+
     def _decode_loop(self, job, decode_in, decode_out):
         ship_spans = bool(job.get('telemetry_spans', True))
+        try:
+            self._decode_loop_inner(job, decode_in, decode_out, ship_spans)
+        finally:
+            # Peer-fetch sockets die with their owning thread, BEFORE
+            # run()'s context.term() (which would otherwise block on
+            # them forever).
+            fetcher, self._fetcher = self._fetcher, None
+            if fetcher is not None:
+                fetcher.close()
+
+    _fetcher = None
+
+    def _serve_cached_split(self, split, chunks, decode_out, ship_spans,
+                            t0):
+        """Stream an entirely-cached split through the normal chunk
+        protocol (same serialization, shm fallback matrix, credits, end
+        marker, ack/complete flow — only the decode is gone)."""
+        seq = 0
+        rows = 0
+        spans = []
+        for chunk in chunks:
+            cid = '%d/%d' % (split['split_id'], seq)
+            tag, payload = self._serialize_split_chunk(split, chunk, cid,
+                                                       spans)
+            rows += len(next(iter(chunk.values())))
+            decode_out.put(('chunk', split, seq, tag, payload))
+            seq += 1
+        t1 = time.monotonic()
+        self._m_serve_hist.observe(t1 - t0)
+        spans.append({'name': 'service/serve_cached_split', 't0': t0,
+                      't1': t1, 'pid': os.getpid(),
+                      'tid': threading.get_ident(),
+                      'cid': str(split['split_id']),
+                      'args': {'rows': rows}})
+        if not ship_spans:
+            spans = []
+        decode_out.put(('end', split, seq, rows,
+                        spans[-_MAX_SPANS_PER_SPLIT:]))
+        self._m_rows.inc(rows)
+        self._m_splits.inc()
+        if self._trace is not None:
+            self._trace.event('service/serve_cached_split', t0, t1,
+                              split=split['split_id'], rows=rows)
+
+    def _decode_loop_inner(self, job, decode_in, decode_out, ship_spans):
         while True:
             split = decode_in.get()
             if split is None:
@@ -660,6 +828,16 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             t0 = time.monotonic()
             spans = []
             try:
+                # Cluster cache tier (ISSUE 10): a split the local plane
+                # fully holds (natively or after peer fill) streams
+                # without constructing a reader — no Parquet open, no
+                # decode, no per-split pool spin-up.
+                chunks, self._fetcher = self._cluster_chunks(split,
+                                                             self._fetcher)
+                if chunks is not None:
+                    self._serve_cached_split(split, chunks, decode_out,
+                                             ship_spans, t0)
+                    continue
                 if self._reader_factory is None:
                     self._reader_factory = self._resolve_factory(job)
                 reader = self._reader_factory(
@@ -699,6 +877,13 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 decode_out.put(('end', split, seq, rows,
                                 spans[-_MAX_SPANS_PER_SPLIT:]))
                 self._accumulate_cache_stats(reader)
+                if self._cluster is not None and self._cluster.ready():
+                    # The per-split reader's plane just published this
+                    # split's entries: advertise them on the next beat
+                    # without waiting for the listdir refresh.
+                    self._cluster.note_published(
+                        self._cluster.identity.split_digests(
+                            split['indices']))
                 self._m_rows.inc(rows)
                 self._m_splits.inc()
                 if self._trace is not None:
@@ -738,6 +923,16 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             'cache_evictions': int(self._m_cache['cache_evictions'].value),
             'cache_ram_hits': int(self._m_cache['cache_ram_hits'].value),
             'cache_degraded': int(self._m_cache['cache_degraded'].value),
+            # Cluster cache tier (ISSUE 10): served-from-plane pieces,
+            # peer fetches that replaced a decode, and peer fetches that
+            # failed back to direct decode.  peer_degraded is the fleet
+            # signal that entries exist somewhere but cannot flow.
+            'cache_remote_hits':
+                int(self._m_cluster['cache_remote_hits'].value),
+            'cache_peer_fills':
+                int(self._m_cluster['cache_peer_fills'].value),
+            'cache_peer_degraded':
+                int(self._m_cluster['cache_peer_degraded'].value),
         }
 
     def heartbeat_stats(self):

@@ -64,6 +64,14 @@ _REGIME_ACTIONS = {
         '(cache_plane_ram_bytes / cache_plane_disk_bytes) and /dev/shm '
         'headroom — the plane is refusing work, every refused piece '
         're-decodes at full cost'),
+    'cluster-cache-degraded': (
+        'the fleet is re-decoding a dataset a peer already holds '
+        'decoded: peer fetches are failing back to direct decode — '
+        'check worker data-endpoint reachability between hosts '
+        '(advertise_host / firewalls), that '
+        'PETASTORM_TPU_NO_CLUSTER_CACHE is not set on part of the '
+        'fleet, and plane tier caps (a full plane cannot accept '
+        'peer-filled entries)'),
     'shm-degraded': (
         'raise the shm arena capacity or /dev/shm size; a slow consumer '
         'pinning slabs also fills the arena — check client drain rate'),
@@ -99,6 +107,11 @@ def evidence_from_stats(stats, source='live fleet'):
     counters = {}
     counters.update(stats.get('cache') or {})
     counters.update(stats.get('shm') or {})
+    # Cluster tier rollup: only the COUNTER fields (the rollup also
+    # carries directory metadata booleans no health rule reads).
+    counters.update({k: v for k, v in
+                     (stats.get('cluster_cache') or {}).items()
+                     if isinstance(v, int)})
     report = stats.get('health')
     if report is None:
         report = _health.health_report(
@@ -234,6 +247,13 @@ def _regime_verdicts(evidence):
                         % (name, stage.get('p50_ms'), stage.get('p99_ms'),
                            stage.get('count', 0)))
                     break
+        elif regime == 'cluster-cache-degraded':
+            worker = _worst_worker(evidence, 'cache_peer_degraded')
+            if worker:
+                evidence_bits.append(
+                    'worst worker %s: cache_peer_degraded %d — its '
+                    'misses name entries a live peer advertises but '
+                    'cannot deliver' % (worker[0], worker[1]))
         elif regime == 'cache-degraded':
             worker = _worst_worker(evidence, 'cache_degraded')
             if worker:

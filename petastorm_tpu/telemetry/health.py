@@ -30,6 +30,11 @@ Regime catalogue (``classify_regime``):
 * ``cache-degraded`` — the epoch-cache plane is refusing work (full /
   unwritable / unencodable): hits may still look plausible while every
   miss re-decodes.  Knobs: plane dir, tier caps, /dev/shm headroom.
+* ``cluster-cache-degraded`` — the CLUSTER cache tier's peer fetches
+  are failing (``cache_peer_degraded`` vs ``cache_peer_fills`` +
+  ``cache_remote_hits``): the fleet is re-decoding a dataset a peer
+  already holds decoded.  Knobs: peer data-endpoint reachability, the
+  ``PETASTORM_TPU_NO_CLUSTER_CACHE`` kill switch, plane tier caps.
 * ``shm-degraded``   — the zero-copy result plane is falling back to
   the byte path (arena full, /dev/shm unusable).  Knobs: arena
   capacity, /dev/shm size, consumer drain rate.
@@ -52,7 +57,8 @@ __all__ = ['classify_regime', 'health_report', 'report_from_frames',
            'export_gauges', 'busy_seconds', 'degrade_ratios', 'REGIMES']
 
 REGIMES = ('decode-bound', 'link-bound', 'lease-starved', 'cache-degraded',
-           'shm-degraded', 'skew-bound', 'healthy', 'idle')
+           'cluster-cache-degraded', 'shm-degraded', 'skew-bound',
+           'healthy', 'idle')
 
 #: Histogram name -> pipeline component.  Names from every registry the
 #: fleet merges: service workers (decode_split/serialize/shm_publish),
@@ -121,6 +127,11 @@ def degrade_ratios(delta):
         'shm': ratio('shm_degraded',
                      ('shm_chunks', 'shm_results')),
         'link': ratio('h2d_degraded', ('h2d_batches',)),
+        # Cluster tier (ISSUE 10): traffic = what flowed between planes
+        # (remote hits + peer fills); degraded = fetches that fell back
+        # to a full re-decode of entries a live peer holds.
+        'cluster': ratio('cache_peer_degraded',
+                         ('cache_peer_fills', 'cache_remote_hits')),
     }
 
 
@@ -140,6 +151,7 @@ def classify_regime(delta, stall_pct=None, meta=None):
     ratios = degrade_ratios(delta or {})
     for plane, counter_name, regime in (
             ('cache', 'cache_degraded', 'cache-degraded'),
+            ('cluster', 'cache_peer_degraded', 'cluster-cache-degraded'),
             ('shm', 'shm_degraded', 'shm-degraded'),
             ('link', 'h2d_degraded', 'link-bound')):
         ratio = ratios.get(plane)
@@ -266,7 +278,7 @@ def health_report(delta, stall_pct=None, meta=None, window_s=None):
                             % pct,
             }
     ratios = degrade_ratios(delta)
-    for plane in ('cache', 'shm', 'link'):
+    for plane in ('cache', 'cluster', 'shm', 'link'):
         ratio = ratios.get(plane)
         if ratio is None:
             continue

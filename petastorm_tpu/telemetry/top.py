@@ -57,6 +57,18 @@ def render_stats(stats, elapsed_s=None):
     lines.append(
         'shm     chunks %-7d arena_refusals %d'
         % (shm.get('shm_chunks', 0), shm.get('shm_degraded', 0)))
+    cluster = stats.get('cluster_cache')
+    if cluster:
+        # Cluster cache tier (ISSUE 10): pieces served straight from a
+        # plane (no reader), entries fetched from peers instead of
+        # re-decoded, fetches that degraded, and warm lease routes.
+        lines.append(
+            'cluster remote_hits %-7d peer_fills %-5d peer_degraded %-5d '
+            'affinity_routed %d'
+            % (cluster.get('cache_remote_hits', 0),
+               cluster.get('cache_peer_fills', 0),
+               cluster.get('cache_peer_degraded', 0),
+               cluster.get('cache_affinity_routed', 0)))
     stages = stats.get('stages') or {}
     if stages:
         # The dispatcher built these with telemetry.summarize_hist — the

@@ -27,6 +27,11 @@ Sections (each contained — a dead plane is reported, not fatal):
   directories writable (``--cache-plane-dir``), ``/dev/shm`` headroom
   for the hot tier and the shm result plane, and a crash-residue sweep
   report (orphaned result-plane slabs, dead writers' tmp files).
+* **cluster_cache** — the cluster cache tier's environment (ISSUE 10):
+  kill-switch state, a real loopback peer-fetch round-trip on a
+  synthetic entry (same ``fetch_reply``/``PeerFetcher`` pair the
+  workers run, byte equality asserted), and — with ``--dispatcher`` —
+  the live fleet's cache-directory footprint from one ``stats`` RPC.
 * **telemetry** — the cross-process observability plane (ISSUE 5):
   registry round-trip + Prometheus rendering, a real 2-process
   ``time.monotonic()`` clock-offset handshake (span alignment sanity),
@@ -287,6 +292,101 @@ def _check_cache_plane(plane_dir):
     return out
 
 
+def _check_cluster_cache(plane_dir, dispatcher_addr=None):
+    """Environment of the CLUSTER cache tier (``service/cluster.py``):
+    kill-switch state, a real peer-fetch round-trip over a loopback
+    ROUTER socket (a synthetic entry published into a throwaway plane,
+    served by the same ``fetch_reply`` the worker event loop calls,
+    fetched by the same ``PeerFetcher`` workers use — byte equality
+    asserted), and — when ``--dispatcher`` names a live fleet — the
+    directory's reachability and footprint from its ``stats`` RPC."""
+    import os
+    import pickle
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import zmq
+
+    from petastorm_tpu.cache_plane import CachePlane
+    from petastorm_tpu.cache_plane.plane import encode_entry
+    from petastorm_tpu.service import cluster
+
+    out = {'kill_switch': cluster.killed()}
+    if out['kill_switch']:
+        out['note'] = ('PETASTORM_TPU_NO_CLUSTER_CACHE=1: no affinity '
+                       'routing, remote HIT serving, or peer fill on '
+                       'this host')
+
+    # Peer-fetch round trip on a synthetic entry (loopback).
+    root = plane_dir or tempfile.mkdtemp(prefix='pstpu-doctor-cluster-')
+    # The throwaway plane dir is OURS to delete; never derive the
+    # cleanup path from the plane object (an init-degraded plane has
+    # disk=None, and the fallback must not point at the USER'S dir).
+    doctor_dir = os.path.join(root, '.doctor-cluster')
+    plane = CachePlane(doctor_dir, ram_capacity_bytes=0)
+    try:
+        blob = bytes(encode_entry({'probe': np.arange(64)}))
+        digest = plane.digest('doctor-cluster-probe')
+        if not plane.publish_blob(digest, blob):
+            out['peer_fetch_ok'] = False
+            out['peer_fetch_error'] = 'publish_blob degraded (full/ro dir)'
+            return out
+        stop = threading.Event()
+        context = zmq.Context()
+        sock = context.socket(zmq.ROUTER)
+        sock.setsockopt(zmq.LINGER, 0)
+        port = sock.bind_to_random_port('tcp://127.0.0.1')
+
+        def serve():
+            while not stop.is_set():
+                if not sock.poll(50):
+                    continue
+                identity, raw = sock.recv_multipart()
+                sock.send_multipart(cluster.fetch_reply(
+                    identity, pickle.loads(raw), plane))
+
+        peer = threading.Thread(target=serve, daemon=True)
+        peer.start()
+        fetcher = cluster.PeerFetcher(context, timeout_s=5.0)
+        try:
+            fetched = fetcher.fetch('tcp://127.0.0.1:%d' % port, digest)
+            out['peer_fetch_ok'] = fetched == blob
+            out['peer_fetch_bytes'] = len(blob)
+        finally:
+            fetcher.close()
+            stop.set()
+            peer.join(5)
+            sock.close(0)
+            context.term()
+    finally:
+        shutil.rmtree(doctor_dir, ignore_errors=True)
+        if plane_dir is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # Live directory reachability (optional).
+    if dispatcher_addr:
+        from petastorm_tpu.service.worker import _Rpc
+        context = zmq.Context()
+        rpc = _Rpc(context, dispatcher_addr, timeout_s=10.0)
+        try:
+            rollup = rpc.call({'op': 'stats'}).get('cluster_cache') or {}
+            out['directory_reachable'] = True
+            for key in ('directory_workers', 'directory_digests',
+                        'piece_map', 'cache_affinity_routed',
+                        'cache_remote_hits', 'cache_peer_fills',
+                        'cache_peer_degraded'):
+                out[key] = rollup.get(key)
+        except Exception as e:  # noqa: BLE001 — reported, not raised
+            out['directory_reachable'] = False
+            out['directory_error'] = '%s: %s' % (type(e).__name__, e)
+        finally:
+            rpc.close()
+            context.term()
+    return out
+
+
 def _check_telemetry():
     """Environment of the telemetry plane (``petastorm_tpu/telemetry``):
     does a registry round-trip and render, is the cross-process clock
@@ -354,13 +454,17 @@ def _check_telemetry():
 
 
 def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
-               batch_size=64, h2d_mb=32, cache_plane_dir=None):
+               batch_size=64, h2d_mb=32, cache_plane_dir=None,
+               dispatcher_addr=None):
     """Run every applicable section; returns the report dict."""
     report = {}
     _contained(report, 'backend', lambda: _check_backend(probe_timeout_s))
     _contained(report, 'native', _check_native)
     _contained(report, 'cache_plane',
                lambda: _check_cache_plane(cache_plane_dir))
+    _contained(report, 'cluster_cache',
+               lambda: _check_cluster_cache(cache_plane_dir,
+                                            dispatcher_addr))
     _contained(report, 'telemetry', _check_telemetry)
     if dataset_url:
         advisor = {}
@@ -415,6 +519,10 @@ def main(argv=None):
                              '(tier writability + entry count); the '
                              '/dev/shm headroom and orphan-sweep report '
                              'run either way')
+    parser.add_argument('--dispatcher', default=None,
+                        help='live data-service dispatcher '
+                             '(tcp://host:port) to check the cluster '
+                             'cache directory against (one stats RPC)')
     parser.add_argument('--autotune', action='store_true',
                         help='also sweep reader configurations '
                              '(workers_count grid) on this host and '
@@ -427,7 +535,8 @@ def main(argv=None):
                         probe_timeout_s=args.probe_timeout,
                         sample_seconds=args.seconds,
                         batch_size=args.batch_size,
-                        cache_plane_dir=args.cache_plane_dir)
+                        cache_plane_dir=args.cache_plane_dir,
+                        dispatcher_addr=args.dispatcher)
     if args.autotune:
         _contained(report, 'autotune',
                    lambda: _check_autotune(args.dataset_url,

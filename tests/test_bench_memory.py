@@ -22,9 +22,18 @@ import bench
 
 @pytest.fixture
 def mem(tmp_path, monkeypatch):
-    """Redirect the artifact memory + detail file into a tmpdir."""
+    """Redirect EVERY file ``_emit`` touches into a tmpdir — including
+    the trend history.  The missing history redirect was the actual
+    origin of the repo's "fabricated" BENCH_HISTORY rounds (2-7, 10-15):
+    each tier-1 run's ``_emit`` tests appended their synthetic trios
+    (value-3500 'tpu' rounds, truncated ``cpu-fallback (...)`` labels,
+    same-second timestamps) to the REAL store, which
+    ``trend.check_integrity`` now rejects and
+    ``test_repo_bench_history_is_integrity_clean`` pins against."""
     monkeypatch.setattr(bench, '_TPU_LAST_PATH', str(tmp_path / 'last.json'))
     monkeypatch.setattr(bench, '_DETAIL_PATH', str(tmp_path / 'detail.json'))
+    monkeypatch.setenv('PETASTORM_TPU_BENCH_HISTORY',
+                       str(tmp_path / 'hist.jsonl'))
     return tmp_path
 
 

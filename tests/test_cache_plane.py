@@ -318,10 +318,14 @@ def test_unencodable_value_serves_uncached(tmp_path):
 
 # -- service integration ------------------------------------------------------
 
-def test_service_warm_epoch_serves_cache_hits(tmp_path, dataset):
+def test_service_warm_epoch_serves_cache_hits(tmp_path, dataset,
+                                              monkeypatch):
     """Two service runs over one plane dir: run 1 decodes every piece
     exactly once (the lease is the ownership grant), run 2 serves the
-    whole epoch from the plane — fleet stats say so."""
+    whole epoch from the plane — via the cluster tier's remote-HIT path
+    (no reader constructed, ``cache_remote_hits``).  A third run under
+    the cluster kill switch pins the legacy behavior bit-for-bit: the
+    per-split readers run and the plane answers as ``cache_hits``."""
     from petastorm_tpu.service import (Dispatcher, ServiceConfig,
                                       ServiceDataLoader, Worker)
     plane_dir = str(tmp_path / 'svcplane')
@@ -350,7 +354,18 @@ def test_service_warm_epoch_serves_cache_hits(tmp_path, dataset):
     ids2, warm = run_epoch()
     assert ids1 == ids2 == list(range(30))
     assert cold['cache_misses'] == 6 and cold['cache_hits'] == 0
-    assert warm['cache_hits'] == 6 and warm['cache_misses'] == 0
+    assert cold['cache_remote_hits'] == 0
+    # Warm epoch, cluster tier ON (the cache_plane default): every piece
+    # streams straight from the plane without constructing a reader.
+    assert warm['cache_remote_hits'] == 6
+    assert warm['cache_hits'] == 0 and warm['cache_misses'] == 0
+    # Kill switch: the pre-cluster path — per-split readers run and the
+    # plane serves them as ordinary hits.
+    monkeypatch.setenv('PETASTORM_TPU_NO_CLUSTER_CACHE', '1')
+    ids3, legacy = run_epoch()
+    assert ids3 == ids1
+    assert legacy['cache_hits'] == 6 and legacy['cache_misses'] == 0
+    assert legacy['cache_remote_hits'] == 0
 
 
 def test_plane_cache_pickles_across_pool_boundary(tmp_path):

@@ -182,6 +182,14 @@ REGIME_FIXTURES = {
         delta=_fixture_delta(counters={'shm_degraded': 400,
                                        'shm_chunks': 600}),
         stall_pct=None),
+    # ISSUE 10: peer fetches failing back to direct decode while the
+    # cluster tier IS moving entries — the fleet is re-decoding a
+    # dataset a peer already holds.
+    'cluster-cache-degraded': dict(
+        delta=_fixture_delta(counters={'cache_peer_degraded': 80,
+                                       'cache_peer_fills': 15,
+                                       'cache_remote_hits': 25}),
+        stall_pct=None),
     # ISSUE 9: bimodal per-item decode latency (90 fast items 10 buckets
     # below 10 slow ones: p99/p50 = 2^10) while the pool reports idle
     # gaps — must name skew-bound OVER the decode-bound busy-share
@@ -213,6 +221,25 @@ def test_skew_without_idle_gaps_stays_decode_bound():
     report = health.health_report(delta,
                                   meta={'decode_utilization': 0.97})
     assert report['regime'] != 'skew-bound'
+
+
+def test_cluster_cache_degraded_verdict_names_redecode():
+    """ISSUE 10: the verdict reads 'fleet re-decoding a dataset a peer
+    already holds' and points at peer reachability + the kill switch."""
+    fixture = REGIME_FIXTURES['cluster-cache-degraded']
+    report = health.health_report(fixture['delta'])
+    evidence = {'source': 'fixture', 'health': report,
+                'stages': {}, 'counters': fixture['delta']['counters'],
+                'meta': {},
+                'workers': {'w0': {'cache_peer_degraded': 80,
+                                   'cache_hits': 0}},
+                'span_residue': 0, 'reason': None}
+    verdicts = diagnose.run_rules(evidence)
+    assert verdicts[0]['id'] == 'cluster-cache-degraded'
+    assert 're-decoding a dataset a peer already holds' \
+        in verdicts[0]['action']
+    assert 'PETASTORM_TPU_NO_CLUSTER_CACHE' in verdicts[0]['action']
+    assert 'worst worker w0' in verdicts[0]['evidence']
 
 
 def test_skew_bound_verdict_points_at_adaptive_scheduling():
@@ -555,6 +582,52 @@ def test_trend_gate_flips_on_at_three_rounds(tmp_path):
     assert not report['ok'] and report['regressions'] == ['value']
     # within the ±30% noise band: fine
     assert trend.check(current=_entry(71.0), path=path)['ok']
+
+
+def test_trend_integrity_rejects_fabricated_rounds(tmp_path, capsys):
+    """ISSUE 10 satellite: history may only grow through append_entry
+    at the end of a real bench.py run.  The two fabrication patterns
+    this repo's history actually carried — duplicate timestamps within
+    hand-copied trios, and truncated backend labels the emitter never
+    produces — must fail --check with exit 1, unconditionally (no
+    minimum-rounds grace)."""
+    import json
+
+    from petastorm_tpu.benchmark import trend
+    path = str(tmp_path / 'hist.jsonl')
+    trend.append_entry(_entry(100.0), path=path)
+    # A legitimate follow-up round appended the only legitimate way
+    # keeps the check green (ts stamps at microsecond resolution, so
+    # honest back-to-back appends never collide).
+    trend.append_entry(_entry(102.0), path=path)
+    assert trend.check(path=path)['integrity'] == []
+    # Hand-copy a round: same ts, truncated backend label.
+    rows = trend.load_history(path)
+    fake = dict(rows[-1], round=3, backend='cpu-fallback (...)')
+    with open(path, 'a') as f:
+        f.write(json.dumps(fake) + '\n')
+    report = trend.check(path=path)
+    assert not report['ok']
+    assert len(report['integrity']) == 2     # dup ts + bad label
+    assert any('duplicate ts' in v for v in report['integrity'])
+    assert any('not one bench.py emits' in v for v in report['integrity'])
+    assert trend.main(['--check', '--history', path]) == 1
+    assert 'INTEGRITY' in capsys.readouterr().out
+    # The real emitter vocabulary passes: every label bench.py produces.
+    for label in trend.BACKEND_VOCABULARY:
+        assert trend.check_integrity([
+            {'round': 1, 'ts': '2026-01-01T00:00:00Z',
+             'backend': label}]) == []
+
+
+def test_repo_bench_history_is_integrity_clean():
+    """The checked-in store itself must pass the rules it now enforces
+    (the fabricated rounds 2-7 and 10-15 are purged; 1/8/9 are real)."""
+    from petastorm_tpu.benchmark import trend
+    entries = trend.load_history(os.path.join(REPO,
+                                              'BENCH_HISTORY.jsonl'))
+    assert entries, 'repo BENCH_HISTORY.jsonl missing or empty'
+    assert trend.check_integrity(entries) == []
 
 
 def test_trend_cli_exit_codes_and_default_tail_mode(tmp_path, capsys):

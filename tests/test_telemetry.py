@@ -248,11 +248,13 @@ CACHE_PLANE_KEYS = {
 WORKER_DIAG_KEYS = {
     'rows_decoded', 'splits_decoded', 'rows_per_s', 'queue_depth',
     'shm_chunks', 'shm_degraded', 'cache_hits', 'cache_misses',
-    'cache_evictions', 'cache_ram_hits', 'cache_degraded'}
+    'cache_evictions', 'cache_ram_hits', 'cache_degraded',
+    # cluster cache tier (ISSUE 10)
+    'cache_remote_hits', 'cache_peer_fills', 'cache_peer_degraded'}
 
 DISPATCHER_STATS_KEYS = {
     'num_splits', 'pending', 'leased', 'done', 'failed', 'lease_churn',
-    'cache', 'shm', 'stages', 'health', 'workers'}
+    'cache', 'shm', 'cluster_cache', 'stages', 'health', 'workers'}
 
 
 def test_golden_keys_thread_reader_and_loader(dataset):
