@@ -13,8 +13,14 @@ Architecture:
 * a **rule** is a class with a ``rule_id``, a ``motivation`` (the review
   finding it encodes), and a ``check(module)`` generator yielding
   :class:`Finding` objects — see ``analysis/rules/``;
+* a **repo rule** (``repo_scope = True``) additionally sees EVERY
+  parsed module at once through ``check_repo(modules)`` — the hook the
+  deadlock analysis plane (ISSUE 11) uses for cross-file passes like
+  the lock-order graph and wire-protocol conformance, where the
+  invariant spans functions and files;
 * the **walker** parses each ``.py`` file once into a :class:`Module`
-  (AST + source lines) and runs every registered rule over it;
+  (AST + source lines), runs every per-module rule over it, then hands
+  the full module list to the repo rules;
 * findings print as ``path:line rule-id message`` and exit the CLI
   with 1;
 * ``# ptlint: disable=rule-id`` on the offending line suppresses a
@@ -41,7 +47,8 @@ import os
 import re
 import sys
 
-__all__ = ['Finding', 'Module', 'lint_paths', 'lint_text', 'main']
+__all__ = ['Finding', 'Module', 'lint_paths', 'lint_text', 'main',
+           'parse_modules']
 
 #: Inline suppression: ``# ptlint: disable=rule-a,rule-b — justification``.
 _DISABLE_RE = re.compile(r'#\s*ptlint:\s*disable=([\w\-,]+)')
@@ -156,6 +163,10 @@ def _parse(path, report_path, source=None):
     return Module(report_path, source, tree), None
 
 
+def _is_repo_rule(rule):
+    return bool(getattr(rule, 'repo_scope', False))
+
+
 def _run_rules(module, rules):
     file_disabled = module.file_disables()
     for rule in rules:
@@ -167,28 +178,73 @@ def _run_rules(module, rules):
             yield finding
 
 
+def _run_repo_rules(modules, rules):
+    """Cross-file rules see the whole parsed module set; their findings
+    are still suppressible at the module/line they land on."""
+    if not rules:
+        return
+    by_path = {m.path: m for m in modules}
+    file_disabled = {m.path: m.file_disables() for m in modules}
+    try:
+        for rule in rules:
+            for finding in rule.check_repo(modules):
+                module = by_path.get(finding.path)
+                if module is not None:
+                    if finding.rule_id in file_disabled[finding.path]:
+                        continue
+                    if finding.rule_id in module.line_disables(finding.line):
+                        continue
+                yield finding
+    finally:
+        # The lockdep rules memoize their shared whole-repo analysis,
+        # which pins every parsed module; one lint invocation is the
+        # memo's whole useful life.
+        from petastorm_tpu.analysis.lockdep.static import \
+            clear_analysis_cache
+        clear_analysis_cache()
+
+
 def lint_text(source, rules=None, path='<text>'):
-    """Lint a source string (the fixture-test entry point)."""
+    """Lint a source string (the fixture-test entry point).  Repo rules
+    run over the one-module "repo", so cross-file rules keep their
+    intra-file behavior testable from a single fixture."""
     rules = _resolve_rules(rules)
     module, finding = _parse(path, path, source=source)
     if finding is not None:
         return [finding]
-    return sorted(_run_rules(module, rules),
-                  key=lambda f: (f.path, f.line, f.rule_id))
+    findings = list(_run_rules(
+        module, [r for r in rules if not _is_repo_rule(r)]))
+    findings.extend(_run_repo_rules(
+        [module], [r for r in rules if _is_repo_rule(r)]))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule_id))
+
+
+def parse_modules(paths):
+    """Walk ``paths`` and parse every ``.py`` file: ``(module-or-None,
+    syntax-Finding-or-None)`` pairs.  THE one walk both the lint gate
+    and the ``petastorm-tpu-lockdep`` CLI share — a skip rule or
+    report-path change lands in both or the two gates silently disagree
+    on scope."""
+    out = []
+    for root in paths:
+        for file_path in _iter_py_files(root):
+            out.append(_parse(file_path, _report_path(file_path, root)))
+    return out
 
 
 def lint_paths(paths, rules=None):
     """Lint files/directories; returns findings sorted by location."""
     rules = _resolve_rules(rules)
-    findings = []
-    for root in paths:
-        for file_path in _iter_py_files(root):
-            report = _report_path(file_path, root)
-            module, finding = _parse(file_path, report)
-            if finding is not None:
-                findings.append(finding)
-                continue
-            findings.extend(_run_rules(module, rules))
+    local_rules = [r for r in rules if not _is_repo_rule(r)]
+    repo_rules = [r for r in rules if _is_repo_rule(r)]
+    findings, modules = [], []
+    for module, finding in parse_modules(paths):
+        if finding is not None:
+            findings.append(finding)
+            continue
+        modules.append(module)
+        findings.extend(_run_rules(module, local_rules))
+    findings.extend(_run_repo_rules(modules, repo_rules))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule_id))
 
 

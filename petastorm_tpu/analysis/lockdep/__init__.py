@@ -1,0 +1,25 @@
+"""``petastorm_tpu.analysis.lockdep`` — the deadlock analysis plane.
+
+Two halves over one lock-order-graph model
+(:mod:`~petastorm_tpu.analysis.lockdep.model`):
+
+* **static** (:mod:`~petastorm_tpu.analysis.lockdep.static`): a
+  whole-repo AST pass that derives lock identities from binding sites,
+  follows acquisition nesting through direct calls across files, and
+  turns cycles into ``lock-order-cycle`` findings — surfaced by the
+  ``petastorm-tpu-lockdep`` CLI and as ptlint rules on the existing
+  baseline/suppression/CI machinery;
+* **runtime** (:mod:`~petastorm_tpu.analysis.lockdep.runtime`): the
+  opt-in ``PETASTORM_TPU_LOCKDEP=1`` sanitizer behind the
+  :mod:`petastorm_tpu.utils.locks` factory — per-thread acquisition
+  stacks, order-inversion detection at acquire time, dumps through the
+  conftest watchdog/telemetry artifact path.
+
+Stdlib-only: the CI lint job runs ``python -m
+petastorm_tpu.analysis.lockdep --check`` from a bare checkout.
+"""
+
+from petastorm_tpu.analysis.lockdep.model import LockOrderGraph
+from petastorm_tpu.analysis.lockdep.static import Analysis, analyze
+
+__all__ = ['LockOrderGraph', 'Analysis', 'analyze']

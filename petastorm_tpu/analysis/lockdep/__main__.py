@@ -1,0 +1,10 @@
+"""``python -m petastorm_tpu.analysis.lockdep`` — the no-install entry
+point the CI lint job uses (the console script ``petastorm-tpu-lockdep``
+is the installed twin)."""
+
+import sys
+
+from petastorm_tpu.analysis.lockdep.cli import main
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""``petastorm-tpu-lockdep`` — the deadlock analysis plane's CLI.
+
+Modes:
+
+* default: print the statically-derived lock-order graph (nodes, edges,
+  one witness site per edge) — how a reviewer reads the plane;
+* ``--dot``: the same graph as Graphviz DOT (cycle members in red);
+* ``--check``: run the lockdep-derived lint rules
+  (``lock-order-cycle`` and the transitive ``blocking-under-lock``
+  upgrade) through the shared baseline/suppression machinery and exit
+  1 on any new finding — the CI gate invocation.
+
+Exit codes mirror ``petastorm-tpu-lint``: 0 clean, 1 findings, 2 usage
+error.  Stdlib-only (runs from a bare checkout).
+"""
+
+import argparse
+import collections
+import os
+import sys
+
+from petastorm_tpu.analysis.framework import (DEFAULT_BASELINE,
+                                              apply_baseline, lint_paths,
+                                              load_baseline, parse_modules)
+from petastorm_tpu.analysis.lockdep.static import analyze
+
+#: The rules `--check` gates on — the lockdep-derived subset of the
+#: ptlint registry (the full gate is `petastorm-tpu-lint`).
+CHECK_RULES = ('lock-order-cycle', 'blocking-under-lock')
+
+
+def _build_parser():
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-lockdep',
+        description='Deadlock analysis plane: cross-file lock-order graph '
+                    '(petastorm_tpu.analysis.lockdep).  Exit codes: 0 '
+                    'clean, 1 findings, 2 usage error.')
+    parser.add_argument('paths', nargs='*', default=['petastorm_tpu'],
+                        help='files/directories to analyze '
+                             '(default: petastorm_tpu)')
+    parser.add_argument('--dot', action='store_true',
+                        help='emit the lock-order graph as Graphviz DOT')
+    parser.add_argument('--check', action='store_true',
+                        help='gate mode: run the lockdep lint rules '
+                             '(%s) against the baseline and exit 1 on '
+                             'new findings' % ', '.join(CHECK_RULES))
+    parser.add_argument('--baseline', default=DEFAULT_BASELINE,
+                        help='baseline file of grandfathered findings '
+                             '(default: the checked-in '
+                             'analysis/baseline.txt)')
+    parser.add_argument('--no-baseline', action='store_true',
+                        help='ignore the baseline: report every finding')
+    return parser
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print('petastorm-tpu-lockdep: no such path: %s'
+              % ', '.join(missing), file=sys.stderr)
+        return 2
+
+    if args.check:
+        findings = lint_paths(args.paths, rules=list(CHECK_RULES))
+        budget = (collections.Counter() if args.no_baseline
+                  else load_baseline(args.baseline))
+        new, baselined = apply_baseline(findings, budget)
+        for finding in new:
+            print(finding)
+        print('%d finding(s), %d baselined' % (len(new), len(baselined)))
+        return 1 if new else 0
+
+    parsed = parse_modules(args.paths)
+    for _module, finding in parsed:
+        if finding is not None:
+            print('petastorm-tpu-lockdep: skipping unparseable %s (%s)'
+                  % (finding.path, finding.message), file=sys.stderr)
+    analysis = analyze([m for m, _finding in parsed if m is not None])
+    graph = analysis.graph
+    if args.dot:
+        print(graph.to_dot())
+        return 0
+    cycles = graph.cycles()
+    print('lock-order graph: %d node(s), %d edge(s), %d cycle(s)'
+          % (len(graph.nodes()), len(graph.edges()), len(cycles)))
+    for src, dst, witnesses in graph.edges():
+        site = witnesses[0].get('site', '?') if witnesses else '?'
+        via = witnesses[0].get('via', '') if witnesses else ''
+        print('  %s -> %s  [%s%s]' % (src, dst, site,
+                                      '  ' + via if via else ''))
+    for cycle in cycles:
+        print('  CYCLE: %s' % ' -> '.join(cycle))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

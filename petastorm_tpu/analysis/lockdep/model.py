@@ -1,0 +1,181 @@
+"""The lock-order-graph model shared by both lockdep halves.
+
+One vocabulary: a **node** is a lock identity — the dotted binding-site
+name (``workers_pool.ventilator.ConcurrentVentilator._lock``,
+``workers_pool.shm_plane._MAPPINGS_LOCK``, ``cache_plane.plane.Tier.
+_mapping_for.flock``) that the static pass derives from the assignment
+site and the runtime shim receives through the
+:mod:`petastorm_tpu.utils.locks` factory.  An **edge** ``A -> B`` means
+"B was (or can be) acquired while A is held", with witnesses (source
+location + call chain for the static half, acquisition stacks for the
+runtime half).  A **cycle** is a deadlock candidate.
+
+Stdlib-only (the CI lint job imports this from a bare checkout).
+"""
+
+__all__ = ['LockOrderGraph']
+
+
+class LockOrderGraph(object):
+    """Directed graph of lock-order edges with bounded witnesses."""
+
+    MAX_WITNESSES = 4
+
+    def __init__(self):
+        self._edges = {}   # (src, dst) -> [witness dict, ...]
+
+    # -- building -------------------------------------------------------------
+
+    def add_edge(self, src, dst, witness=None):
+        if src == dst:
+            return  # re-entry on a shared-identity condition, not an order
+        witnesses = self._edges.setdefault((src, dst), [])
+        if witness is not None and len(witnesses) < self.MAX_WITNESSES:
+            witnesses.append(dict(witness))
+
+    # -- reading --------------------------------------------------------------
+
+    def nodes(self):
+        out = set()
+        for src, dst in self._edges:
+            out.add(src)
+            out.add(dst)
+        return sorted(out)
+
+    def edges(self):
+        """[(src, dst, [witness, ...])] sorted for stable output."""
+        return [(src, dst, list(w))
+                for (src, dst), w in sorted(self._edges.items())]
+
+    def successors(self, node):
+        return sorted(dst for (src, dst) in self._edges if src == node)
+
+    def witnesses(self, src, dst):
+        return list(self._edges.get((src, dst), ()))
+
+    def has_path(self, src, dst):
+        if src == dst:
+            return True
+        adjacency = self._adjacency()
+        seen, stack = set(), [src]
+        while stack:
+            node = stack.pop()
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in adjacency.get(node, ()):
+                if nxt == dst:
+                    return True
+                stack.append(nxt)
+        return False
+
+    def _adjacency(self):
+        adjacency = {}
+        for src, dst in self._edges:
+            adjacency.setdefault(src, set()).add(dst)
+        return adjacency
+
+    def cycles(self):
+        """One representative cycle per strongly-connected component,
+        as a node path ``[a, b, ..., a]`` — deterministic, so findings
+        built from cycles have stable messages."""
+        adjacency = {n: self.successors(n) for n in self.nodes()}
+        sccs = _tarjan(adjacency)
+        out = []
+        for scc in sccs:
+            members = sorted(scc)
+            if len(members) == 1:
+                continue  # self-edges are filtered at add_edge
+            start = members[0]
+            path = _path_within(adjacency, start, start, set(scc))
+            if path:
+                out.append(path)
+        return out
+
+    # -- rendering ------------------------------------------------------------
+
+    def to_dict(self):
+        return {'nodes': self.nodes(),
+                'edges': [{'src': s, 'dst': d, 'witnesses': w}
+                          for s, d, w in self.edges()]}
+
+    def to_dot(self, title='lock-order'):
+        cyclic = set()
+        for cycle in self.cycles():
+            cyclic.update(cycle)
+        lines = ['digraph "%s" {' % title, '  rankdir=LR;',
+                 '  node [shape=box, fontsize=10];']
+        for node in self.nodes():
+            style = ', color=red, penwidth=2' if node in cyclic else ''
+            lines.append('  "%s" [label="%s"%s];' % (node, node, style))
+        for src, dst, witnesses in self.edges():
+            label = ''
+            if witnesses:
+                site = witnesses[0]
+                where = site.get('site') or ''
+                label = ' [label="%s", fontsize=8]' % where
+            lines.append('  "%s" -> "%s"%s;' % (src, dst, label))
+        lines.append('}')
+        return '\n'.join(lines)
+
+
+def _tarjan(adjacency):
+    """Iterative Tarjan SCC over ``{node: [succ, ...]}``."""
+    index_of, lowlink, on_stack = {}, {}, set()
+    stack, sccs = [], []
+    counter = [0]
+
+    for root in sorted(adjacency):
+        if root in index_of:
+            continue
+        work = [(root, iter(adjacency.get(root, ())))]
+        index_of[root] = lowlink[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, successors = work[-1]
+            advanced = False
+            for succ in successors:
+                if succ not in index_of:
+                    index_of[succ] = lowlink[succ] = counter[0]
+                    counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append((succ, iter(adjacency.get(succ, ()))))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    lowlink[node] = min(lowlink[node], index_of[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == index_of[node]:
+                scc = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    scc.append(member)
+                    if member == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def _path_within(adjacency, start, goal, members):
+    """A cycle path start -> ... -> goal (== start) of length >= 2
+    staying inside ``members``; DFS, deterministic order."""
+    stack = [(start, [start])]
+    while stack:
+        node, path = stack.pop()
+        for succ in adjacency.get(node, ()):
+            if succ not in members:
+                continue
+            if succ == goal and len(path) >= 2:
+                return path + [succ]
+            if succ != goal and succ not in path:
+                stack.append((succ, path + [succ]))
+    return None

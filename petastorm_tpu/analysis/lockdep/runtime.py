@@ -1,0 +1,421 @@
+"""Runtime lockdep — the sanitizer half of the deadlock analysis plane.
+
+Armed via ``PETASTORM_TPU_LOCKDEP=1`` (see
+:mod:`petastorm_tpu.utils.locks`), this module wraps lock primitives so
+every acquisition feeds a process-wide observed lock-order graph:
+
+* each thread keeps the ordered list of locks it currently holds;
+* acquiring ``B`` while holding ``A`` records the edge ``A -> B`` with
+  the acquisition stacks of both ends (the witness a human needs);
+* if the observed graph already contains a path ``B -> ... -> A``, the
+  acquire is an **order inversion** — the classic ABBA deadlock shape —
+  and a violation is recorded *at acquire time* with both stacks, then
+  logged once per lock pair.  Detection never blocks or raises: a
+  tier-1 run under the shim must finish, red or green, and the
+  violations ride the conftest watchdog/telemetry artifact.
+
+Deliberately NO timer threads and NO waiting: gVisor timed waits burn
+measurable CPU (see ``tests/conftest.py`` history), so everything is
+recorded synchronously on acquire/release only.  Stacks are captured
+lazily — only when at least one lock is already held (the only case
+that can create an edge) — so the uncontended single-lock hot path
+pays a list append/pop and nothing else.
+
+All tables are bounded: ``MAX_EDGES`` distinct edges, ``MAX_VIOLATIONS``
+violations, ``STACK_DEPTH`` frames per stack.  Stdlib-only.
+"""
+
+import logging
+import sys
+import threading
+
+logger = logging.getLogger(__name__)
+
+MAX_EDGES = 4096
+MAX_VIOLATIONS = 64
+STACK_DEPTH = 12
+
+#: Guards the process-wide tables below.  A bare primitive on purpose:
+#: the bookkeeping lock must never be tracked by the bookkeeping.
+_TABLE_LOCK = threading.Lock()
+_EDGES = {}       # (src, dst) -> {'count', 'src_stack', 'dst_stack'}
+_ADJ = {}         # src -> set of dst (mirror of _EDGES for reachability)
+_VIOLATIONS = []
+_WARNED = set()   # (acquiring, holding) pairs already logged
+_DROPPED_EDGES = 0
+#: wrapper id -> thread id of the current holder.  A mutex has at most
+#: ONE holder, so plain GIL-atomic dict store/pop (no table lock) is
+#: race-free for the attribution this exists for: telling a
+#: cross-thread release WHICH thread's held entry went stale.
+_OWNERS = {}
+#: (wrapper id, owner thread id) -> outstanding cross-thread releases.
+#: A handoff (acquire in thread A, release in thread B — legal for
+#: threading.Lock) cannot reach A's thread-local held list from B; the
+#: count makes A purge its stale entry lazily at its next acquire.
+#: Keyed by instance AND owner thread — an instance-only key let any
+#: live holder of the same instance consume the purge against its own
+#: live entry and then re-register it on release, permanently blinding
+#: the lock (review finding).  Guarded by _TABLE_LOCK.
+_HANDOFF = {}
+
+_tls = threading.local()
+
+
+def _held():
+    held = getattr(_tls, 'held', None)
+    if held is None:
+        held = _tls.held = []  # ordered [(name, stack-or-None), ...]
+    return held
+
+
+def _rdepth():
+    depth = getattr(_tls, 'rdepth', None)
+    if depth is None:
+        depth = _tls.rdepth = {}
+    return depth
+
+
+def _capture_stack():
+    """[(file:line func), ...] innermost-first, skipping shim frames.
+
+    Manual frame walk (no ``traceback`` module): this runs on the lock
+    acquire path and must not touch linecache or allocate FrameSummary
+    objects.
+    """
+    out = []
+    frame = sys._getframe(1)
+    while frame is not None and len(out) < STACK_DEPTH:
+        code = frame.f_code
+        filename = code.co_filename.replace('\\', '/')
+        if not filename.endswith(('lockdep/runtime.py', 'utils/locks.py')):
+            out.append('%s:%d %s'
+                       % (filename, frame.f_lineno, code.co_name))
+        frame = frame.f_back
+    return out
+
+
+def _path_exists(src, dst):
+    """Caller holds ``_TABLE_LOCK``: is there a path src -> ... -> dst in
+    the observed graph?  Graphs are bounded-small; iterative DFS."""
+    if src == dst:
+        return True
+    seen = set()
+    stack = [src]
+    while stack:
+        node = stack.pop()
+        if node in seen:
+            continue
+        seen.add(node)
+        for nxt in _ADJ.get(node, ()):
+            if nxt == dst:
+                return True
+            if nxt not in seen:
+                stack.append(nxt)
+    return False
+
+
+def _cycle_path(src, dst):
+    """Caller holds ``_TABLE_LOCK``: one witness path src -> ... -> dst
+    (names), or ``[src, dst]`` if the search races an eviction."""
+    stack = [(src, [src])]
+    seen = set()
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        if node in seen:
+            continue
+        seen.add(node)
+        for nxt in sorted(_ADJ.get(node, ())):
+            stack.append((nxt, path + [nxt]))
+    return [src, dst]
+
+
+def note_acquire_attempt(name):
+    """Record edges held -> ``name`` and detect inversions.  Returns the
+    captured stack (reused for the held-table entry) or None when no
+    edge was newly observed.
+
+    Stack capture AND the reachability check happen only when an edge
+    is first inserted: a cycle can only be newly closed by a new edge
+    (it fires at the insertion of its last edge), so steady-state
+    nested acquires — the hot case once the suite has warmed the graph
+    — pay one dict hit and an int increment under the table lock."""
+    held = _held()
+    if not held:
+        return None
+    stack = None
+    global _DROPPED_EDGES
+    with _TABLE_LOCK:
+        for held_name, held_stack, _wid in held:
+            if held_name == name:
+                continue  # re-entry through a shared-identity condition
+            key = (held_name, name)
+            edge = _EDGES.get(key)
+            if edge is not None:
+                edge['count'] += 1
+                continue
+            if len(_EDGES) >= MAX_EDGES:
+                _DROPPED_EDGES += 1
+                continue
+            if stack is None:
+                stack = _capture_stack()
+            _EDGES[key] = {'count': 1, 'src_stack': held_stack,
+                           'dst_stack': stack}
+            _ADJ.setdefault(held_name, set()).add(name)
+            # Inversion: acquiring `name` while holding `held_name` is an
+            # edge held->name; a pre-existing path name ->* held_name
+            # closes a cycle.  Checked at acquire time, BEFORE blocking.
+            if _path_exists(name, held_name):
+                _note_violation(held_name, held_stack, name, stack)
+    return stack
+
+
+def _note_violation(holding, held_stack, acquiring, stack):
+    """Caller holds ``_TABLE_LOCK``."""
+    pair = (acquiring, holding)
+    if pair in _WARNED:
+        return
+    _WARNED.add(pair)
+    cycle = _cycle_path(acquiring, holding) + [acquiring]
+    reverse = _EDGES.get((acquiring, cycle[1] if len(cycle) > 1
+                          else holding)) or {}
+    violation = {
+        'acquiring': acquiring,
+        'holding': holding,
+        'cycle': cycle,
+        'acquire_stack': list(stack),
+        'held_stack': list(held_stack or ()),
+        'reverse_witness_stack': list(reverse.get('dst_stack') or ()),
+        'thread': threading.current_thread().name,
+    }
+    if len(_VIOLATIONS) < MAX_VIOLATIONS:
+        _VIOLATIONS.append(violation)
+    logger.warning(
+        'lock-order inversion: acquiring %r while holding %r closes the '
+        'cycle %s — see the lockdep dump in the telemetry artifact for '
+        'both stacks', acquiring, holding, ' -> '.join(cycle))
+
+
+def _purge_handoffs(held):
+    """Drop THIS thread's held entries whose lock instance was
+    handed-off-released while this thread was the recorded owner;
+    caller checked ``_HANDOFF`` is non-empty."""
+    tid = threading.get_ident()
+    with _TABLE_LOCK:
+        for i in range(len(held) - 1, -1, -1):
+            key = (held[i][2], tid)
+            count = _HANDOFF.get(key)
+            if count:
+                del held[i]
+                if count == 1:
+                    del _HANDOFF[key]
+                else:
+                    _HANDOFF[key] = count - 1
+            if not _HANDOFF:
+                break
+
+
+def push_held(name, stack, wid):
+    _held().append((name, stack, wid))
+
+
+def pop_own(wid, name=None):
+    """Drop the most recent held entry for wrapper ``wid`` (falling
+    back to ``name`` for the lock-acquired/condition-waited split);
+    returns it so condition waits can re-push the same witness, or
+    None when this thread never held it."""
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][2] == wid:
+            return held.pop(i)
+    if name is not None:
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == name:
+                return held.pop(i)
+    return None
+
+
+def state_dict():
+    """Bounded snapshot for the watchdog/telemetry artifact."""
+    with _TABLE_LOCK:
+        edges = [{'src': src, 'dst': dst, 'count': rec['count'],
+                  'src_stack': rec['src_stack'], 'dst_stack': rec['dst_stack']}
+                 for (src, dst), rec in sorted(_EDGES.items())]
+        return {'edges': edges,
+                'violations': [dict(v) for v in _VIOLATIONS],
+                'dropped_edges': _DROPPED_EDGES}
+
+
+def violations():
+    with _TABLE_LOCK:
+        return [dict(v) for v in _VIOLATIONS]
+
+
+def reset():
+    """Test hook: clear the process-wide tables (held lists are
+    per-thread and drain naturally as locks release)."""
+    global _DROPPED_EDGES
+    with _TABLE_LOCK:
+        _EDGES.clear()
+        _ADJ.clear()
+        del _VIOLATIONS[:]
+        _WARNED.clear()
+        _HANDOFF.clear()
+        _OWNERS.clear()
+        _DROPPED_EDGES = 0
+
+
+class _TrackedAcquirable(object):
+    """Shared acquire/release/context-manager protocol for the tracked
+    wrappers (one copy — the review-found cross-thread-release bug had
+    to be fixed in every duplicate).
+
+    The no-other-lock-held fast path (the overwhelmingly common case:
+    one uncontended lock guarding a counter or a deque) is inlined —
+    one thread-local read, one list append/pop, no stack capture, no
+    table lock — so arming the shim for a whole tier-1 run stays cheap.
+    """
+
+    __slots__ = ('_inner', 'name')
+
+    def __init__(self, inner, name):
+        self._inner = inner
+        self.name = name
+
+    def acquire(self, *args, **kwargs):
+        try:
+            held = _tls.held
+        except AttributeError:
+            held = _tls.held = []
+        if _HANDOFF and held:
+            _purge_handoffs(held)
+        # Non-blocking attempts record nothing: trylock-with-fallback is
+        # the deadlock-FREE escape pattern — treating its reverse-order
+        # probe as an inversion would poison the artifact with false
+        # ABBA reports (review finding).
+        blocking = args[0] if args else kwargs.get('blocking', True)
+        stack = note_acquire_attempt(self.name) \
+            if (held and blocking) else None
+        ok = self._inner.acquire(*args, **kwargs)
+        if ok:
+            held.append((self.name, stack, id(self)))
+            _OWNERS[id(self)] = threading.get_ident()
+        return ok
+
+    def release(self):
+        # Owner bookkeeping BEFORE the inner release: until the inner
+        # lock is freed no other thread can acquire it, so the pop
+        # cannot race (popping after let a woken waiter's fresh
+        # ownership record be erased — review finding).
+        owner = _OWNERS.pop(id(self), None)
+        self._inner.release()
+        # _held(), not _tls.held: a legal cross-thread Lock handoff
+        # releases on a thread that never acquired — that thread may
+        # have no held list at all, and holds no entry to pop.
+        held = _held()
+        if held and held[-1][2] == id(self):
+            held.pop()
+        elif pop_own(id(self)) is None and owner is not None \
+                and owner != threading.get_ident():
+            # Released on a thread that never acquired THIS instance:
+            # the recorded owner's stale entry is purged lazily via
+            # _HANDOFF (its held list is unreachable from here).
+            with _TABLE_LOCK:
+                key = (id(self), owner)
+                _HANDOFF[key] = _HANDOFF.get(key, 0) + 1
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+
+    def __repr__(self):
+        return '<%s %s %r>' % (type(self).__name__, self.name, self._inner)
+
+
+class TrackedLock(_TrackedAcquirable):
+    """Order-tracking wrapper over a bare ``threading.Lock``."""
+
+    __slots__ = ()
+
+    def locked(self):
+        return self._inner.locked()
+
+
+class TrackedRLock(_TrackedAcquirable):
+    """Order-tracking wrapper over ``threading.RLock`` — only the
+    outermost acquire/release of a thread records (re-entrant acquires
+    cannot create new edges)."""
+
+    __slots__ = ()
+
+    def acquire(self, blocking=True, timeout=-1):
+        # Depth keys on the INSTANCE: two same-named RLocks held by one
+        # thread are distinct re-entry scopes (review finding).
+        depth = _rdepth()
+        first = not depth.get(id(self))
+        stack = note_acquire_attempt(self.name) \
+            if (first and blocking) else None
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            depth[id(self)] = depth.get(id(self), 0) + 1
+            if first:
+                push_held(self.name, stack, id(self))
+                _OWNERS[id(self)] = threading.get_ident()
+        return ok
+
+    def release(self):
+        depth = _rdepth()
+        depth[id(self)] = max(0, depth.get(id(self), 1) - 1)
+        if not depth[id(self)]:
+            _OWNERS.pop(id(self), None)
+            del depth[id(self)]  # ids recycle; a dead key must not
+            #                      seed a future instance's depth
+        self._inner.release()
+        if id(self) not in depth:
+            pop_own(id(self), self.name)
+
+
+class TrackedCondition(_TrackedAcquirable):
+    """Order-tracking wrapper over ``threading.Condition``.
+
+    The identity is the *underlying lock's* — a condition built over a
+    factory lock records as the same graph node, because acquiring the
+    condition IS acquiring that lock.  ``wait``/``wait_for`` drop the
+    held entry for the wait's duration (the lock really is released)
+    and re-push on wake.
+    """
+
+    __slots__ = ()
+
+    def wait(self, timeout=None):
+        entry = pop_own(id(self), self.name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            if entry is not None:  # un-held misuse: inner raised above
+                push_held(*entry)
+
+    def wait_for(self, predicate, timeout=None):
+        entry = pop_own(id(self), self.name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            if entry is not None:
+                push_held(*entry)
+
+    def notify(self, n=1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()
+
+
+def make_tracked_condition(name, lock=None):
+    """Condition sharing primitive AND identity with a factory lock."""
+    if isinstance(lock, (TrackedLock, TrackedRLock)):
+        return TrackedCondition(threading.Condition(lock._inner), lock.name)
+    return TrackedCondition(threading.Condition(lock), name)

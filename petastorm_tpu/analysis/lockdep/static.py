@@ -1,0 +1,629 @@
+"""Static lockdep — the whole-repo lock-order pass (ISSUE 11 tentpole).
+
+Where the per-function ptlint rules stop, this pass begins: it walks the
+shared per-file ASTs and models *orderings across functions and files*,
+which is where deadlocks actually live.
+
+* **Lock identities** come from binding sites: ``self._lock =
+  threading.Lock()`` / ``make_lock('...')`` attrs, module-level lock
+  globals, and ``fcntl.flock`` call sites (the cache/shm planes' file
+  locks).  A ``make_lock('name')`` string IS the identity, so the
+  static graph and the runtime shim's observed graph share node names.
+* **Nesting** is tracked through ``with`` blocks and bare
+  ``acquire()``/``release()`` pairs, and — the cross-file part —
+  *through direct calls*: a callee's acquired locks are inherited at
+  every call site (bare names and imported functions resolve across
+  modules; ``self.method()`` resolves one level within the class).
+* The result is a :class:`~petastorm_tpu.analysis.lockdep.model.
+  LockOrderGraph`; a cycle in it is a ``lock-order-cycle`` finding, and
+  the same call-reachability upgrades ``blocking-under-lock``: a call
+  that *transitively* blocks while a lock is held now flags.
+
+Heuristic and deliberately under-approximate (attribute-of-attribute
+receivers and callables passed as values don't resolve) — like every
+ptlint rule, silence proves nothing but every finding is worth a
+reviewer's time.  Stdlib-only.
+"""
+
+import ast
+
+from petastorm_tpu.analysis.framework import Finding
+from petastorm_tpu.analysis.lockdep.model import LockOrderGraph
+from petastorm_tpu.analysis.rules.base import (call_name, dotted_name,
+                                               is_flock_call, last_component)
+
+__all__ = ['analyze', 'Analysis', 'is_blocking_call', 'BLOCKING_LAST']
+
+#: Lock-constructor call names (stdlib primitives and the
+#: ``petastorm_tpu.utils.locks`` factory they migrate to).
+_LOCK_CTORS = frozenset(('Lock', 'RLock', 'make_lock', 'make_rlock'))
+_COND_CTORS = frozenset(('Condition', 'make_condition'))
+
+#: Calls that park the holder (mirrors rules/locking.py: the wedged-peer
+#: class — sleep always, the rest only in their unbounded no-arg form).
+BLOCKING_LAST = frozenset(('sleep', 'join', 'recv', 'recv_multipart',
+                           'recv_pyobj', 'get', 'acquire'))
+
+
+def is_blocking_call(call):
+    last = last_component(call_name(call))
+    if last not in BLOCKING_LAST:
+        return False
+    if last == 'sleep':
+        return True
+    return not call.args and not call.keywords
+
+
+def _module_dotted(path):
+    dotted = path[:-3] if path.endswith('.py') else path
+    dotted = dotted.replace('/', '.')
+    if dotted.endswith('.__init__'):
+        dotted = dotted[:-len('.__init__')]
+    if dotted.startswith('petastorm_tpu.'):
+        dotted = dotted[len('petastorm_tpu.'):]
+    return dotted
+
+
+def _str_arg(call, index=0):
+    if len(call.args) > index:
+        arg = call.args[index]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return None
+
+
+class _HeldEntry(object):
+    __slots__ = ('lock_id', 'display', 'fd_name')
+
+    def __init__(self, lock_id, display, fd_name=None):
+        self.lock_id = lock_id
+        self.display = display
+        self.fd_name = fd_name
+
+
+class _FunctionInfo(object):
+    def __init__(self, module_info, qualname, node, class_name,
+                 local_locks=None):
+        self.module = module_info
+        self.qualname = qualname
+        self.node = node
+        self.class_name = class_name
+        #: function-scoped lock bindings (``lock = make_lock('…')``),
+        #: SHARED with nested defs — the closure-held fn-local lock
+        #: pattern (tf_utils' queue pullers).
+        self.local_locks = {} if local_locks is None else local_locks
+        #: [(lock_id, display, line, [held entries before])]
+        self.acquires = []
+        #: [(callee_key, display, line, [held entries at call])]
+        self.calls = []
+        #: summaries (fixpoint): lock_id -> chain tuple of displays
+        self.eff_acquires = {}
+        #: None, or chain tuple ending at the blocking call's name
+        self.blocks = None
+
+    @property
+    def key(self):
+        return (self.module.dotted, self.qualname)
+
+
+class _ModuleInfo(object):
+    def __init__(self, module):
+        self.module = module
+        self.dotted = _module_dotted(module.path)
+        self.import_aliases = {}   # local name -> dotted module
+        self.imported_funcs = {}   # local name -> (dotted module, func name)
+        self.global_locks = {}     # global name -> lock id
+        self.class_locks = {}      # class -> {attr -> lock id}
+        self.class_methods = {}    # class -> set of method names
+        self.functions = {}        # qualname -> _FunctionInfo
+
+
+class Analysis(object):
+    """Result bundle: the index, the graph, and the findings."""
+
+    def __init__(self):
+        self.modules = {}        # report path -> _ModuleInfo
+        self.functions = {}      # (dotted, qualname) -> _FunctionInfo
+        self.graph = LockOrderGraph()
+        self.cycle_findings = []
+        self.transitive_blocking_findings = []
+
+
+# -- pass 1: imports, bindings, function table --------------------------------
+
+def _collect_imports(info, tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split('.')[0]
+                target = alias.name if alias.asname else alias.name.split('.')[0]
+                info.import_aliases[name] = _strip_pkg(target)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            base = _strip_pkg(node.module)
+            for alias in node.names:
+                local = alias.asname or alias.name
+                # `from pkg import mod` (module) vs `from mod import f`
+                # (function) is resolved against the scanned-module table
+                # at use time; record both readings.
+                info.import_aliases.setdefault(
+                    local, '%s.%s' % (base, alias.name))
+                info.imported_funcs[local] = (base, alias.name)
+
+
+def _strip_pkg(dotted):
+    return dotted[len('petastorm_tpu.'):] \
+        if dotted.startswith('petastorm_tpu.') else dotted
+
+
+def _lock_ctor_kind(value):
+    if not isinstance(value, ast.Call):
+        return None
+    last = last_component(call_name(value))
+    if last in _LOCK_CTORS:
+        return 'lock'
+    if last in _COND_CTORS:
+        return 'cond'
+    return None
+
+
+def _collect_bindings(info):
+    tree = info.module.tree
+    # Module-level lock globals.
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            kind = _lock_ctor_kind(node.value)
+            if kind:
+                name = node.targets[0].id
+                info.global_locks[name] = (
+                    _str_arg(node.value)
+                    or '%s.%s' % (info.dotted, name))
+    # Class attrs: two passes so a Condition over self._lock can join
+    # its lock's identity.
+    for cls in tree.body:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs = info.class_locks.setdefault(cls.name, {})
+        info.class_methods[cls.name] = {
+            n.name for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        conds = []
+        for sub in ast.walk(cls):
+            if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+                continue
+            target = sub.targets[0]
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == 'self'):
+                continue
+            kind = _lock_ctor_kind(sub.value)
+            if kind == 'lock':
+                attrs[target.attr] = (
+                    _str_arg(sub.value)
+                    or '%s.%s.%s' % (info.dotted, cls.name, target.attr))
+            elif kind == 'cond':
+                conds.append((target.attr, sub.value))
+        for attr, value in conds:
+            underlying = None
+            # threading.Condition(self._lock) / make_condition(name, lock)
+            for arg in value.args:
+                if isinstance(arg, ast.Attribute) \
+                        and isinstance(arg.value, ast.Name) \
+                        and arg.value.id == 'self' and arg.attr in attrs:
+                    underlying = attrs[arg.attr]
+            attrs[attr] = (underlying or _str_arg(value)
+                           or '%s.%s.%s' % (info.dotted, cls.name, attr))
+
+
+def _collect_functions(info):
+    def register(node, qualname, class_name):
+        outer = _FunctionInfo(info, qualname, node, class_name)
+        info.functions[qualname] = outer
+        # Closure support: nested defs register AFTER their outer (the
+        # outer's walk fills local_locks first) and SHARE its local
+        # lock bindings — without this, fn-local factory locks were
+        # invisible to the graph (review finding).
+        for sub in ast.walk(node):
+            if sub is not node and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub_qualname = '%s.%s' % (qualname, sub.name)
+                info.functions.setdefault(sub_qualname, _FunctionInfo(
+                    info, sub_qualname, sub, class_name,
+                    outer.local_locks))
+
+    tree = info.module.tree
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            register(node, node.name, None)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    register(sub, '%s.%s' % (node.name, sub.name),
+                             node.name)
+
+
+# -- pass 2: per-function event extraction ------------------------------------
+
+def _lockish_display(expr):
+    """Heuristic held-lock display for unresolvable-but-obviously-lock
+    expressions (rules/locking.py's lock/mutex heuristic, widened with
+    condition-variable names — a held condition IS its lock)."""
+    dotted = dotted_name(expr)
+    lowered = dotted.lower()
+    if 'lock' in lowered or 'mutex' in lowered or 'cond' in lowered:
+        return dotted
+    return None
+
+
+def _resolve_lock_expr(expr, func):
+    """(lock_id or None, display or None) for a with-context/receiver."""
+    info = func.module
+    if isinstance(expr, ast.Name):
+        if expr.id in func.local_locks:
+            return func.local_locks[expr.id], expr.id
+        if expr.id in info.global_locks:
+            return info.global_locks[expr.id], expr.id
+    elif isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) and expr.value.id == 'self' \
+            and func.class_name:
+        attrs = info.class_locks.get(func.class_name, {})
+        if expr.attr in attrs:
+            return attrs[expr.attr], 'self.%s' % expr.attr
+    display = _lockish_display(expr)
+    if display:
+        return None, display
+    return None, None
+
+
+def _resolve_callee(call, func):
+    """('dotted module', 'qualname') candidate or None — validated
+    against the global function table by the propagation pass."""
+    info = func.module
+    node = call.func
+    if isinstance(node, ast.Name):
+        nested = '%s.%s' % (func.qualname, node.id)
+        if nested in info.functions:
+            return (info.dotted, nested)
+        if node.id in info.functions:
+            return (info.dotted, node.id)
+        if node.id in info.imported_funcs:
+            return info.imported_funcs[node.id]
+        return None
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        owner, attr = node.value.id, node.attr
+        if owner == 'self' and func.class_name:
+            if attr in info.class_methods.get(func.class_name, ()):
+                return (info.dotted, '%s.%s' % (func.class_name, attr))
+            return None
+        if owner in info.import_aliases:
+            return (info.import_aliases[owner], attr)
+    return None
+
+
+def _is_nonblocking_acquire(call):
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return True
+    return any(kw.arg == 'blocking' and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False for kw in call.keywords)
+
+
+def _flock_fd_name(call):
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def _iter_own_calls(node):
+    """Call nodes in ``node``'s own scope, roughly source-ordered;
+    nested def/lambda bodies are a different scope."""
+    out = []
+    stack = [node]
+    while stack:
+        current = stack.pop(0)
+        if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+            continue
+        if isinstance(current, ast.Call):
+            out.append(current)
+        stack[0:0] = list(ast.iter_child_nodes(current))
+    return out
+
+
+def _process_expr(node, held, func):
+    """Record acquire/call/blocking/flock events for every call in an
+    expression or simple statement, mutating ``held`` for lock and
+    flock state that persists across subsequent statements."""
+    for call in _iter_own_calls(node):
+        dotted = call_name(call)
+        last = last_component(dotted)
+        if is_flock_call(call):
+            flags = ast.dump(call.args[1]) if len(call.args) > 1 else ''
+            fd_name = _flock_fd_name(call)
+            if 'LOCK_UN' in flags:
+                _pop_fd(held, fd_name)
+                continue
+            # Class-scoped identity (module-scoped outside classes): a
+            # per-FUNCTION node could never close a cycle with a
+            # threading lock acquired in the opposite order in a
+            # sibling method — exactly the flock-plane inversion class
+            # this pass exists for (review finding).  The coarsening
+            # can merge genuinely distinct file locks within one class;
+            # that is the usual under/over-approximation trade, resolved
+            # by an inline disable where a merge is provably safe.
+            if func.class_name:
+                lock_id = '%s.%s.flock' % (func.module.dotted,
+                                           func.class_name)
+            else:
+                lock_id = '%s.flock' % func.module.dotted
+            display = 'flock(%s)' % (fd_name or '...')
+            func.acquires.append((lock_id, display, call.lineno, list(held)))
+            held.append(_HeldEntry(lock_id, display, fd_name))
+            continue
+        if dotted == 'os.close':
+            _pop_fd(held, _flock_fd_name(call))
+            continue
+        if last == 'acquire':
+            lock_id, display = _resolve_lock_expr(
+                call.func.value if isinstance(call.func, ast.Attribute)
+                else call.func, func)
+            if lock_id is not None:
+                # A non-blocking acquire holds on success (locks nested
+                # under it are real edges) but is itself never an
+                # ordering hazard — trylock-with-fallback is the
+                # deadlock-free escape pattern, mirrored in the runtime
+                # shim.
+                if not _is_nonblocking_acquire(call):
+                    func.acquires.append(
+                        (lock_id, display, call.lineno, list(held)))
+                held.append(_HeldEntry(lock_id, display))
+                continue
+        if last == 'release' and isinstance(call.func, ast.Attribute):
+            lock_id, _ = _resolve_lock_expr(call.func.value, func)
+            if lock_id is not None:
+                _pop_lock(held, lock_id)
+                continue
+        if is_blocking_call(call):
+            if func.blocks is None:
+                func.blocks = ('%s' % dotted,)
+            continue
+        callee = _resolve_callee(call, func)
+        if callee is not None:
+            func.calls.append((callee, dotted, call.lineno, list(held)))
+
+
+def _pop_fd(held, fd_name):
+    if fd_name is None:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i].fd_name == fd_name:
+            del held[i]
+            return
+
+
+def _pop_lock(held, lock_id):
+    for i in range(len(held) - 1, -1, -1):
+        if held[i].lock_id == lock_id:
+            del held[i]
+            return
+
+
+def _walk_block(stmts, held, func):
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # defined here, not run here
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = []
+            for item in stmt.items:
+                _process_expr(item.context_expr, held, func)
+                lock_id, display = _resolve_lock_expr(item.context_expr,
+                                                      func)
+                if lock_id is not None or display is not None:
+                    func.acquires.append((lock_id, display,
+                                          stmt.lineno, list(held)))
+                    entry = _HeldEntry(lock_id, display)
+                    held.append(entry)
+                    pushed.append(entry)
+            _walk_block(stmt.body, held, func)
+            # Remove exactly the entries THIS with pushed: the body may
+            # have bare-acquire()d further locks that outlive the with,
+            # and a blind pop() would drop those instead (review
+            # finding: `with A: B.acquire()` then `with C:` recorded a
+            # false A->C edge and missed the true B->C).
+            for entry in pushed:
+                if entry in held:
+                    held.remove(entry)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            # An acquisition in the test (`if lock.acquire(False):`) is
+            # held on the success path — the BODY — and must neither
+            # leak to the statements after the if nor into the else
+            # branch (review finding: a test-expr trylock stayed
+            # "held" for the rest of the function).
+            test_held = list(held)
+            _process_expr(stmt.test, test_held, func)
+            _walk_block(stmt.body, test_held, func)
+            _walk_block(stmt.orelse, list(held), func)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            iter_held = list(held)
+            _process_expr(stmt.iter, iter_held, func)
+            _walk_block(stmt.body, iter_held, func)
+            _walk_block(stmt.orelse, list(held), func)
+        elif isinstance(stmt, ast.Try):
+            # Body/orelse/finalbody run on the fall-through path, so
+            # their acquire/release mutations must hit the REAL held
+            # list — a `finally: lock.release()` that only mutated a
+            # copy would leave the lock "held" for the rest of the
+            # function and fabricate cycle/blocking findings (the
+            # acquire-then-try/finally idiom).  Handlers are the
+            # exceptional path and see their own copies.
+            _walk_block(stmt.body, held, func)
+            for handler in stmt.handlers:
+                _walk_block(handler.body, list(held), func)
+            _walk_block(stmt.orelse, held, func)
+            _walk_block(stmt.finalbody, held, func)
+        else:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and _lock_ctor_kind(stmt.value):
+                # Function-local lock binding: visible to the rest of
+                # this function AND to nested defs (shared map).
+                name = stmt.targets[0].id
+                func.local_locks[name] = (
+                    _str_arg(stmt.value)
+                    or '%s.%s.%s' % (func.module.dotted, func.qualname,
+                                     name))
+            _process_expr(stmt, held, func)
+
+
+# -- pass 3: fixpoint propagation over direct calls ---------------------------
+
+def _propagate(analysis):
+    table = analysis.functions
+    # Seed direct acquires.
+    for func in table.values():
+        for lock_id, display, _line, _held in func.acquires:
+            if lock_id is not None and lock_id not in func.eff_acquires:
+                func.eff_acquires[lock_id] = ('with %s' % (display
+                                                           or lock_id),)
+    changed, guard = True, 0
+    while changed and guard < 100:
+        changed, guard = False, guard + 1
+        for func in table.values():
+            for callee_key, display, _line, _held in func.calls:
+                callee = table.get(callee_key)
+                if callee is None:
+                    continue
+                for lock_id, chain in callee.eff_acquires.items():
+                    if lock_id not in func.eff_acquires:
+                        func.eff_acquires[lock_id] = \
+                            ('%s()' % display,) + chain
+                        changed = True
+                if callee.blocks is not None and func.blocks is None:
+                    func.blocks = ('%s()' % display,) + callee.blocks
+                    changed = True
+
+
+# -- pass 4: graph + findings -------------------------------------------------
+
+def _build_graph(analysis):
+    graph = analysis.graph
+    for func in analysis.functions.values():
+        path = func.module.module.path
+        for lock_id, display, line, held in func.acquires:
+            if lock_id is None:
+                continue
+            for entry in held:
+                if entry.lock_id is not None:
+                    graph.add_edge(entry.lock_id, lock_id,
+                                   {'site': '%s:%d' % (path, line),
+                                    'via': 'with %s' % (display or lock_id),
+                                    'path': path, 'line': line})
+        for callee_key, display, line, held in func.calls:
+            callee = analysis.functions.get(callee_key)
+            if callee is None:
+                continue
+            for lock_id, chain in callee.eff_acquires.items():
+                for entry in held:
+                    if entry.lock_id is not None:
+                        graph.add_edge(
+                            entry.lock_id, lock_id,
+                            {'site': '%s:%d' % (path, line),
+                             'via': '%s() -> %s' % (display,
+                                                    ' -> '.join(chain)),
+                             'path': path, 'line': line})
+
+
+def _cycle_findings(analysis):
+    graph = analysis.graph
+    for cycle in graph.cycles():
+        first = graph.witnesses(cycle[0], cycle[1])
+        where = first[0] if first else {'path': '<unknown>', 'line': 1}
+        vias = []
+        for i in range(len(cycle) - 1):
+            witnesses = graph.witnesses(cycle[i], cycle[i + 1])
+            if witnesses:
+                vias.append('%s before %s via %s'
+                            % (cycle[i], cycle[i + 1],
+                               witnesses[0].get('via', '?')))
+        analysis.cycle_findings.append(Finding(
+            where.get('path', '<unknown>'), where.get('line', 1),
+            'lock-order-cycle',
+            'lock-order cycle: %s — these locks are acquired in both '
+            'orders (%s); a thread per order deadlocks the plane: pick '
+            'ONE global order or drop a nesting'
+            % (' -> '.join(cycle), '; '.join(vias))))
+
+
+def _transitive_blocking_findings(analysis):
+    for func in analysis.functions.values():
+        path = func.module.module.path
+        seen = set()
+        for callee_key, display, line, held in func.calls:
+            if not held:
+                continue
+            callee = analysis.functions.get(callee_key)
+            if callee is None or callee.blocks is None:
+                continue
+            key = (line, callee_key)
+            if key in seen:
+                continue
+            seen.add(key)
+            holder = held[-1]
+            chain = ('%s()' % display,) + callee.blocks
+            analysis.transitive_blocking_findings.append(Finding(
+                path, line, 'blocking-under-lock',
+                'call `%s` while `%s` is held transitively blocks '
+                '(%s) — a parked holder wedges every waiter; move the '
+                'blocking step outside the lock'
+                % (display, holder.display or holder.lock_id,
+                   ' -> '.join(chain))))
+
+
+#: One-slot memo for :func:`analyze_cached` — both lockdep-derived lint
+#: rules run over the SAME module list within one lint invocation, and
+#: the fixpoint pass over the repo costs ~0.5s; paying it twice per
+#: gate run (and per lint test) is pure waste.
+_LAST_ANALYSIS = None
+
+
+def analyze_cached(modules):
+    """:func:`analyze`, memoized on the identity of the module set."""
+    global _LAST_ANALYSIS
+    key = tuple((id(m), m.path) for m in modules)
+    if _LAST_ANALYSIS is not None and _LAST_ANALYSIS[0] == key:
+        return _LAST_ANALYSIS[1]
+    analysis = analyze(modules)
+    _LAST_ANALYSIS = (key, analysis)
+    return analysis
+
+
+def clear_analysis_cache():
+    """Release the memo (the framework calls this at the end of each
+    lint invocation): the cached Analysis pins every parsed module —
+    sources, ASTs, per-call held snapshots — and a suite process that
+    linted the whole repo once must not carry tens of MB for the rest
+    of its run."""
+    global _LAST_ANALYSIS
+    _LAST_ANALYSIS = None
+
+
+def analyze(modules):
+    """Run the whole-repo pass over parsed ``framework.Module`` objects."""
+    analysis = Analysis()
+    for module in modules:
+        info = _ModuleInfo(module)
+        analysis.modules[module.path] = info
+        _collect_imports(info, module.tree)
+        _collect_bindings(info)
+        _collect_functions(info)
+        for func in info.functions.values():
+            analysis.functions[func.key] = func
+    for info in analysis.modules.values():
+        for func in info.functions.values():
+            _walk_block(func.node.body, [], func)
+    _propagate(analysis)
+    _build_graph(analysis)
+    _cycle_findings(analysis)
+    _transitive_blocking_findings(analysis)
+    return analysis

@@ -12,10 +12,14 @@ from petastorm_tpu.analysis.rules.contracts import (DegradeContractRule,
 from petastorm_tpu.analysis.rules.lifecycle import (ResourceLifecycleRule,
                                                     ShortWriteRule)
 from petastorm_tpu.analysis.rules.locking import (BlockingUnderLockRule,
+                                                  CvWaitNoPredicateRule,
                                                   FlockDisciplineRule,
+                                                  LockOrderCycleRule,
                                                   UnboundedRecvRule)
 from petastorm_tpu.analysis.rules.process_safety import (
     PickleUnsafeAttrsRule, SwallowedExceptionRule)
+from petastorm_tpu.analysis.rules.wire_protocol import \
+    WireProtocolConformanceRule
 
 ALL_RULES = (
     ResourceLifecycleRule(),
@@ -23,6 +27,9 @@ ALL_RULES = (
     PickleUnsafeAttrsRule(),
     SwallowedExceptionRule(),
     BlockingUnderLockRule(),
+    LockOrderCycleRule(),
+    CvWaitNoPredicateRule(),
+    WireProtocolConformanceRule(),
     UnboundedRecvRule(),
     ShortWriteRule(),
     DegradeContractRule(),

@@ -31,6 +31,21 @@ class Rule(object):
                        self.rule_id, message)
 
 
+class RepoRule(Rule):
+    """A cross-file rule: ``check_repo(modules)`` sees every parsed
+    module at once (the deadlock analysis plane's hook, ISSUE 11).
+    ``check(module)`` delegates to the one-module "repo" so fixture
+    tests and ``--select`` work unchanged."""
+
+    repo_scope = True
+
+    def check_repo(self, modules):
+        raise NotImplementedError
+
+    def check(self, module):
+        return self.check_repo([module])
+
+
 def call_name(node):
     """Dotted name of a Call's callee: ``os.write``, ``self._sock.close``
     -> ``self._sock.close``; '' when the callee is not a name chain."""
@@ -52,6 +67,33 @@ def call_name(node):
 
 def last_component(dotted):
     return dotted.rsplit('.', 1)[-1] if dotted else ''
+
+
+def dotted_name(expr):
+    """Dotted name of an attribute chain (``self._lock``,
+    ``mod.LOCK``); Call nodes read through to their callee.  THE one
+    name-chain walk the locking rules and the lockdep static pass
+    share — two copies drifted once already (ISSUE 11 review)."""
+    node = expr
+    if isinstance(node, ast.Call):
+        node = node.func
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append('<expr>')
+    else:
+        return ''
+    return '.'.join(reversed(parts))
+
+
+def is_flock_call(call):
+    """A ``fcntl.flock`` call site (shared by flock-discipline and the
+    lockdep static pass)."""
+    return last_component(call_name(call)) == 'flock'
 
 
 def names_in(node):

@@ -9,12 +9,10 @@ liveness flock died with the fd before ``os.replace`` ran.
 
 import ast
 
-from petastorm_tpu.analysis.rules.base import (Rule, call_name, functions,
-                                               iter_calls, last_component)
-
-
-def _is_flock(call):
-    return last_component(call_name(call)) == 'flock'
+from petastorm_tpu.analysis.rules.base import (RepoRule, Rule, call_name,
+                                               dotted_name, functions,
+                                               is_flock_call, iter_calls,
+                                               last_component)
 
 
 def _flock_flags_src(call):
@@ -39,7 +37,7 @@ class FlockDisciplineRule(Rule):
             closes, renames, flocked = {}, [], {}
             for call in iter_calls(func):
                 dotted = call_name(call)
-                if _is_flock(call):
+                if is_flock_call(call):
                     flags = _flock_flags_src(call)
                     if 'LOCK_EX' in flags and 'LOCK_NB' not in flags:
                         yield self.finding(
@@ -91,29 +89,33 @@ def _is_blocking_call(call):
 def _lockish_name(expr):
     """The held-lock display name when ``expr`` reads like a lock
     acquisition (``self._lock``, ``_MAPPINGS_LOCK``, ``lock.acquire()``)."""
-    node = expr
-    if isinstance(node, ast.Call):
-        node = node.func
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-    dotted = '.'.join(reversed(parts))
+    dotted = dotted_name(expr)
     lowered = dotted.lower()
     if 'lock' in lowered or 'mutex' in lowered:
         return dotted
     return None
 
 
-class BlockingUnderLockRule(Rule):
+class BlockingUnderLockRule(RepoRule):
     rule_id = 'blocking-under-lock'
     motivation = ('sleep/unbounded join/blocking recv while holding a '
-                  'threading.Lock or flock — one stalled holder wedges '
-                  'every other thread/process on the plane')
+                  'threading.Lock or flock — directly OR through a call '
+                  'chain (the lockdep reachability upgrade, ISSUE 11): '
+                  'one stalled holder wedges every other thread/process '
+                  'on the plane')
 
-    def check(self, module):
+    def check_repo(self, modules):
+        """Lexical check per module, plus the cross-file upgrade: a call
+        under a held lock whose callee *transitively* blocks (resolved
+        through the lockdep call graph) flags at the call site."""
+        for module in modules:
+            for finding in self._check_lexical(module):
+                yield finding
+        from petastorm_tpu.analysis.lockdep.static import analyze_cached
+        for finding in analyze_cached(modules).transitive_blocking_findings:
+            yield finding
+
+    def _check_lexical(self, module):
         for node in ast.walk(module.tree):
             if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
@@ -147,6 +149,59 @@ def _own_nodes(func):
             continue
         yield node
         stack.extend(ast.iter_child_nodes(node))
+
+
+def _condish_name(expr):
+    """Display name when ``expr`` reads like a condition variable."""
+    dotted = dotted_name(expr)
+    lowered = dotted.lower()
+    if 'cond' in lowered or lowered.endswith('cv'):
+        return dotted
+    return None
+
+
+class CvWaitNoPredicateRule(Rule):
+    rule_id = 'cv-wait-no-predicate'
+    motivation = ('Condition.wait() outside a while-predicate loop — a '
+                  'spurious or stolen wakeup silently proceeds on a '
+                  'false predicate (the PR 9 polling->CV conversion '
+                  'review class); wait_for embeds its predicate and is '
+                  'the sanctioned loop-free form')
+
+    def check(self, module):
+        for func in functions(module.tree):
+            own = list(_own_nodes(func))
+            in_while = set()
+            for node in own:
+                if isinstance(node, ast.While):
+                    for sub in _own_nodes(node):
+                        in_while.add(id(sub))
+            for node in own:
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == 'wait'):
+                    continue
+                receiver = _condish_name(node.func.value)
+                if receiver and id(node) not in in_while:
+                    yield self.finding(
+                        module, node,
+                        '`%s.wait()` outside a while-predicate loop — '
+                        'condition waits can wake spuriously or after '
+                        'the predicate was re-falsified; loop '
+                        '`while not pred: cv.wait()` or use '
+                        '`cv.wait_for(pred)`' % receiver)
+
+
+class LockOrderCycleRule(RepoRule):
+    rule_id = 'lock-order-cycle'
+    motivation = ('two locks acquired in both orders across functions '
+                  'or files (the ABBA deadlock shape) — invisible to '
+                  'any single-function pass; derived from the lockdep '
+                  'cross-file lock-order graph (ISSUE 11)')
+
+    def check_repo(self, modules):
+        from petastorm_tpu.analysis.lockdep.static import analyze_cached
+        return analyze_cached(modules).cycle_findings
 
 
 class UnboundedRecvRule(Rule):

@@ -16,7 +16,10 @@ from petastorm_tpu.analysis.rules.base import (Rule, call_name,
 #: ``self.x = <these>(...)`` makes the instance unpicklable (or worse:
 #: quietly pickles per-process state into the child).
 _UNPICKLABLE_LAST = frozenset((
-    'Lock', 'RLock', 'Condition', 'Event', 'Semaphore', 'BoundedSemaphore'))
+    'Lock', 'RLock', 'Condition', 'Event', 'Semaphore', 'BoundedSemaphore',
+    # The utils.locks lockdep factory (ISSUE 11): factory-made locks are
+    # exactly as per-process as the bare primitives they wrap.
+    'make_lock', 'make_rlock', 'make_condition'))
 _UNPICKLABLE_DOTTED = frozenset(('mmap.mmap', 'zmq.Context'))
 
 

@@ -1,0 +1,105 @@
+"""Wire-protocol conformance: one-letter frame tags must balance
+across a protocol's peer modules.
+
+Motivating history (ISSUE 11): the pool and service planes frame
+multipart messages with one-letter tags (``b'K'`` acks, ``b'S'`` shm
+descriptors, ``b'P'``/``b'T'`` shm results, ...).  A tag *sent* by one
+side but never *dispatched* by its peer is a frame the receiver
+mis-routes or silently drops; a tag *dispatched* but never sent is a
+dead protocol arm that rots unnoticed.  Both have cost review rounds
+(recv-without-poll and ack frontier math rode exactly these paths) and
+neither is visible to a single-file pass — the sender and the handler
+live in different modules by construction.
+
+The rule catalogues every length-1 uppercase ``bytes`` literal per
+peer-group module: literals inside a comparison (``tag == b'R'``,
+``tag in (b'P', b'T')``, ``header['tag'] == b'S'``) count as
+*handled*; every other occurrence (send_multipart frame lists, framing
+assignments, ``return b'A', payload``) counts as *sent*.  Per group,
+``sent - handled`` and ``handled - sent`` are findings.
+"""
+
+import ast
+
+from petastorm_tpu.analysis.framework import Finding
+from petastorm_tpu.analysis.rules.base import RepoRule
+
+#: Peer groups: modules that speak one wire protocol to each other.
+#: Matched by path suffix so fixture trees exercise the same pairing.
+PEER_GROUPS = (
+    ('process-pool', ('workers_pool/process_pool.py',
+                      'workers_pool/process_worker.py')),
+    ('data-service', ('service/worker.py', 'service/client.py',
+                      'service/dispatcher.py', 'service/cluster.py')),
+)
+
+
+def _matches(path, member):
+    return path == member or path.endswith('/' + member)
+
+
+def _is_frame_tag(value):
+    return isinstance(value, bytes) and len(value) == 1 \
+        and 65 <= value[0] <= 90  # one uppercase letter
+
+
+def collect_tags(module):
+    """(sent, handled): tag -> first line, per the compare-context
+    classification in the module docstring."""
+    compare_members = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Compare):
+            for sub in ast.walk(node):
+                compare_members.add(id(sub))
+    sent, handled = {}, {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Constant) and _is_frame_tag(node.value):
+            bucket = handled if id(node) in compare_members else sent
+            bucket.setdefault(node.value, node.lineno)
+    return sent, handled
+
+
+class WireProtocolConformanceRule(RepoRule):
+    rule_id = 'wire-protocol-conformance'
+    motivation = ('a one-letter frame tag sent by one peer module but '
+                  'never dispatched by the other (or dispatched but '
+                  'never sent) — the receiver mis-routes or drops the '
+                  'frame, and dead protocol arms rot unnoticed; '
+                  'sender and handler live in different files, so only '
+                  'a cross-file pass can see the imbalance')
+
+    def check_repo(self, modules):
+        for group_name, members in PEER_GROUPS:
+            present = []   # (member, module)
+            for module in modules:
+                for member in members:
+                    if _matches(module.path, member):
+                        present.append((member, module))
+            if len({member for member, _ in present}) < 2:
+                continue  # a protocol needs two sides on the table
+            sent, handled = {}, {}   # tag -> (module, line) of first use
+            for _member, module in present:
+                mod_sent, mod_handled = collect_tags(module)
+                for tag, line in mod_sent.items():
+                    sent.setdefault(tag, (module, line))
+                for tag, line in mod_handled.items():
+                    handled.setdefault(tag, (module, line))
+            for tag in sorted(set(sent) - set(handled)):
+                module, line = sent[tag]
+                yield self.finding_at(
+                    module, line,
+                    'frame tag %r is sent on the %s wire but no peer '
+                    'module ever compares/dispatches it — the receiver '
+                    'mis-routes or silently drops the frame; add the '
+                    'dispatch arm or retire the tag' % (tag, group_name))
+            for tag in sorted(set(handled) - set(sent)):
+                module, line = handled[tag]
+                yield self.finding_at(
+                    module, line,
+                    'frame tag %r is dispatched on the %s wire but no '
+                    'peer module ever sends it — a dead protocol arm '
+                    '(or its sender was renamed away); wire the sender '
+                    'or retire the arm' % (tag, group_name))
+
+    def finding_at(self, module, line, message):
+        return Finding(module.path, line, self.rule_id, message)

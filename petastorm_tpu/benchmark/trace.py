@@ -35,6 +35,7 @@ alignment.  ``set_process_label`` names the tracks.
 import json
 import os
 import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 import weakref
 from collections import deque
@@ -56,7 +57,7 @@ class TraceRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — recorder
 
     def __init__(self, max_events=100_000):
         self._events = deque(maxlen=int(max_events))
-        self._lock = threading.Lock()
+        self._lock = make_lock('benchmark.trace.TraceRecorder._lock')
         self._t0 = time.monotonic()  # trace origin: construction time
         _LIVE.add(self)
 

@@ -39,7 +39,7 @@ import mmap
 import os
 import pickle
 import struct
-import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 import uuid
 
@@ -225,7 +225,7 @@ class Tier(object):
         #: digest -> (mmap, ino, size): persistent read mappings (see
         #: module docstring).  Guarded for the multi-threaded pools.
         self._mappings = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('cache_plane.plane.Tier._lock')
 
     # pickling: a Tier crosses the ProcessPool boundary inside worker
     # args; mappings and locks are per-process state.
@@ -237,7 +237,7 @@ class Tier(object):
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._lock = threading.Lock()
+        self._lock = make_lock('cache_plane.plane.Tier._lock')
 
     def entry_path(self, digest):
         return os.path.join(self.root, digest + ENTRY_SUFFIX)

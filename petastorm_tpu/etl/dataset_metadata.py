@@ -18,7 +18,7 @@ import json
 import logging
 import pickle
 import posixpath
-import threading
+from petastorm_tpu.utils.locks import make_lock
 from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager, suppress
 from dataclasses import dataclass
@@ -618,7 +618,7 @@ def load_row_groups(fs, path, fast_from_metadata=True):
                 for i in range(int(n)))
         return pieces
 
-    lock = threading.Lock()
+    lock = make_lock('etl.dataset_metadata.load_row_groups.lock')
 
     def scan(f):
         with fs.open(f, 'rb') as handle:

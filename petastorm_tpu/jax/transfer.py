@@ -68,6 +68,7 @@ link time (components ``h2d_stage`` vs ``h2d``).
 import logging
 import os
 import threading
+from petastorm_tpu.utils.locks import make_condition
 import time
 from collections import deque
 
@@ -592,7 +593,7 @@ class DispatchPump(object):  # ptlint: disable=pickle-unsafe-attrs — the pump 
         self._ship = ship
         self._cap = max(1, int(prefetch))
         self.pending = deque()
-        self._cond = threading.Condition()
+        self._cond = make_condition('jax.transfer.DispatchPump._cond')
         self._idle = False
         self._pause = 0
         self._stopped = False

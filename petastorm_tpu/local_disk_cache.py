@@ -14,7 +14,7 @@ best-effort behavior (atomic renames; eviction may race benignly).
 import hashlib
 import os
 import pickle
-import threading
+from petastorm_tpu.utils.locks import make_lock
 
 from petastorm_tpu.cache import CacheBase
 
@@ -29,7 +29,7 @@ class LocalDiskCache(CacheBase):
         self._path = path
         self._size_limit = size_limit_bytes or (1 << 30)
         self._cleanup_on_exit = cleanup
-        self._lock = threading.Lock()
+        self._lock = make_lock('local_disk_cache.LocalDiskCache._lock')
         os.makedirs(path, exist_ok=True)
 
     def __getstate__(self):
@@ -41,7 +41,7 @@ class LocalDiskCache(CacheBase):
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._lock = threading.Lock()
+        self._lock = make_lock('local_disk_cache.LocalDiskCache._lock')
 
     def _key_path(self, key):
         digest = hashlib.sha1(str(key).encode('utf-8')).hexdigest()

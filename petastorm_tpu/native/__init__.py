@@ -14,7 +14,7 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
+from petastorm_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -22,7 +22,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, 'pt_decode.cc')
 _SO = os.path.join(_HERE, 'libpt_decode.so')
 
-_lock = threading.Lock()
+_lock = make_lock('native._lock')
 _lib = None
 _tried = False
 _force_disabled = False

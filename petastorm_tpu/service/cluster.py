@@ -47,6 +47,7 @@ import logging
 import os
 import pickle
 import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 
 logger = logging.getLogger(__name__)
@@ -432,8 +433,12 @@ class PeerFetcher(object):  # ptlint: disable=pickle-unsafe-attrs — owned by o
                     except shm_plane.SegmentVanishedError:
                         return None
                     blob = payload['blob'].tobytes()
-                else:
+                elif header.get('tag') == b'B':
                     blob = bytes(frames[1])
+                else:
+                    # Explicit dispatch (wire-protocol-conformance): a tag
+                    # this side doesn't speak is a degrade, not a guess.
+                    return None
                 if len(blob) > FETCH_MAX_BYTES:
                     return None
                 return blob
@@ -467,7 +472,7 @@ class ClusterWorkerState(object):  # ptlint: disable=pickle-unsafe-attrs — per
         #: set for heartbeats — an unguarded frozenset() over a set
         #: being update()d raises mid-iteration and would kill the
         #: event loop.
-        self._known_lock = threading.Lock()
+        self._known_lock = make_lock('service.cluster.ClusterWorkerState._known_lock')
         self._known = set()
         self._known_at = 0.0
         self._advertised = None   # last frozenset shipped on a heartbeat

@@ -38,6 +38,7 @@ import collections
 import logging
 import pickle
 import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 
 logger = logging.getLogger(__name__)
@@ -157,7 +158,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         #: Lease calls answered 'wait' because every scannable split was
         #: inside another worker's preference window.
         self.affinity_deferrals = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock('service.dispatcher.Dispatcher._lock')
         self._stop = threading.Event()
         self._thread = None
         self._started = threading.Event()

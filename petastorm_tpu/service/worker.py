@@ -133,7 +133,12 @@ def deserialize_chunk(tag, payload):
         table = ArrowTableSerializer().deserialize(payload)
         return {name: table.column(name).to_numpy(zero_copy_only=False)
                 for name in table.column_names}
-    return pickle.loads(payload)
+    if tag == b'R':
+        return pickle.loads(payload)
+    # Explicit dispatch (wire-protocol-conformance): an unknown tag is a
+    # framing bug, not a pickle payload — naming it beats unpickling
+    # garbage.
+    raise ValueError('unknown chunk frame tag %r' % (tag,))
 
 
 class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a process/thread; jobs reach it via the dispatcher RPC, never by pickling the object

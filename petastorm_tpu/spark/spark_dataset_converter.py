@@ -20,7 +20,7 @@ Design notes for the TPU build:
 import atexit
 import hashlib
 import logging
-import threading
+from petastorm_tpu.utils.locks import make_lock
 import uuid
 
 from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
@@ -28,7 +28,7 @@ from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
 logger = logging.getLogger(__name__)
 
 _CACHED_CONVERTERS = {}
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = make_lock('spark.spark_dataset_converter._CACHE_LOCK')
 
 
 class CachedDataFrameMeta(object):

@@ -41,6 +41,7 @@ scope.
 import json
 import os
 import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 
 from petastorm_tpu.telemetry.registry import merge_snapshots, snapshot_all
@@ -116,7 +117,7 @@ class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-pro
         self.persist_every = max(1, int(persist_every))
         self._source = source
         self._frames = []
-        self._lock = threading.Lock()
+        self._lock = make_lock('telemetry.flight.FlightRecorder._lock')
         self._stop = threading.Event()
         self._thread = None
         self._last_tick = 0.0
@@ -238,7 +239,7 @@ class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-pro
 
 _RECORDER = None
 _RECORDER_PID = None
-_SINGLETON_LOCK = threading.Lock()
+_SINGLETON_LOCK = make_lock('telemetry.flight._SINGLETON_LOCK')
 
 
 def _disabled_by_env():

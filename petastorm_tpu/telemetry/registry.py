@@ -24,7 +24,7 @@ channels are how the halves reunite.
 
 import bisect
 import math
-import threading
+from petastorm_tpu.utils.locks import make_lock
 import weakref
 
 __all__ = ['MetricsRegistry', 'Counter', 'Gauge', 'Histogram',
@@ -110,7 +110,7 @@ class MetricsRegistry(object):
 
     def __init__(self, namespace=''):
         self.namespace = namespace
-        self._lock = threading.Lock()
+        self._lock = make_lock('telemetry.registry.MetricsRegistry._lock')
         self._counters = {}
         self._gauges = {}
         self._histograms = {}

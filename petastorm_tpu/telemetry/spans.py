@@ -29,6 +29,7 @@ worker directly.
 
 import os
 import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 from collections import deque
 
@@ -47,7 +48,7 @@ class SpanBuffer(object):
 
     def __init__(self, max_spans=4096):
         self._spans = deque(maxlen=int(max_spans))
-        self._lock = threading.Lock()
+        self._lock = make_lock('telemetry.spans.SpanBuffer._lock')
 
     # Buffers are per-process by contract (current_buffer re-keys on pid);
     # shipping one across a boundary ships the pending spans only.
@@ -84,7 +85,7 @@ class SpanBuffer(object):
 
 _BUFFER = None
 _BUFFER_PID = None
-_BUFFER_LOCK = threading.Lock()
+_BUFFER_LOCK = make_lock('telemetry.spans._BUFFER_LOCK')
 
 
 def current_buffer():

@@ -11,7 +11,7 @@ footer/metadata reads happen at reader construction, which deliberately has
 no retry layer.
 """
 
-import threading
+from petastorm_tpu.utils.locks import make_lock
 
 
 def is_data_file(path):
@@ -31,7 +31,7 @@ class FlakyOpenFilesystem(object):
         self._real = real_fs
         self._fail_times = fail_times
         self._counts = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('test_util.fault_injection.FlakyOpenFilesystem._lock')
 
     # Documented to ride ``make_reader(..., filesystem=...)``, which the
     # ProcessPool pickles into worker args — the lock (and the injection
@@ -47,7 +47,7 @@ class FlakyOpenFilesystem(object):
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._counts = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('test_util.fault_injection.FlakyOpenFilesystem._lock')
 
     def open(self, path, *args, **kwargs):
         if _is_data_file(path):

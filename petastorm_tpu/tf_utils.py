@@ -8,7 +8,7 @@ is CPU-only glue for migration; the TPU path is ``petastorm_tpu.jax``.
 
 import datetime
 import decimal
-import threading
+from petastorm_tpu.utils.locks import make_lock
 
 import numpy as np
 
@@ -154,7 +154,7 @@ def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
     names, dtypes = _schema_to_tf_dtypes(schema)
     # QueueRunner threads call the pull concurrently; Reader.__next__ keeps a
     # row buffer, so serialize (decode parallelism lives in the reader's pool).
-    lock = threading.Lock()
+    lock = make_lock('tf_utils.tf_tensors.lock')
 
     def pull():
         with lock:
@@ -179,7 +179,7 @@ def _tf_tensors_ngram(tf, reader, shuffling_queue_capacity, min_after_dequeue):
     names_at = {o: sorted(ngram.get_field_names_at_timestep(o)) for o in offsets}
     flat_fields = [(o, n) for o in offsets for n in names_at[o]]
     dtypes = [_tf_dtype_for(schema.fields[n].numpy_dtype) for _, n in flat_fields]
-    lock = threading.Lock()
+    lock = make_lock('tf_utils._tf_tensors_ngram.lock')
 
     def pull():
         with lock:

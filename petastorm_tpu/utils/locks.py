@@ -1,0 +1,67 @@
+"""Lock construction factory — the runtime-lockdep seam (ISSUE 11).
+
+Every lock-holding module constructs its ``threading.Lock``/``RLock``/
+``Condition`` through this factory instead of calling ``threading``
+directly.  With ``PETASTORM_TPU_LOCKDEP`` unset the factory is a pure
+pass-through: it returns the BARE stdlib primitive (identity pinned by
+``tests/test_lockdep.py``), so production hot paths pay nothing.  With
+``PETASTORM_TPU_LOCKDEP=1`` it returns instrumented wrappers from
+:mod:`petastorm_tpu.analysis.lockdep.runtime` that record per-thread
+acquisition stacks and detect lock-order inversions at acquire time —
+the runtime half of the deadlock analysis plane (the static half is
+``petastorm-tpu-lockdep``).
+
+The ``name`` argument is the lock's *binding-site identity* — the same
+dotted name the static lock-order graph derives from the assignment
+site (``workers_pool.ventilator.ConcurrentVentilator._lock``) — so the
+statically-predicted graph and the runtime-observed graph join on the
+same node names.
+
+Stdlib-only by design (this module and the runtime shim it defers to):
+the conftest arms the shim for the tier-1 run, and modules that import
+it from a bare checkout must not pull numpy/jax.
+"""
+
+import os
+import threading
+
+__all__ = ['lockdep_enabled', 'make_lock', 'make_rlock', 'make_condition']
+
+
+def lockdep_enabled():
+    """True when the runtime lockdep shim is armed for this process."""
+    return os.environ.get('PETASTORM_TPU_LOCKDEP', '') not in ('', '0')
+
+
+def make_lock(name):
+    """A ``threading.Lock`` (bare, unless lockdep is armed).
+
+    ``name`` is the binding-site identity recorded in the lock-order
+    graph; callers pass the dotted path of the assignment site.
+    """
+    if not lockdep_enabled():
+        return threading.Lock()
+    from petastorm_tpu.analysis.lockdep import runtime
+    return runtime.TrackedLock(threading.Lock(), name)
+
+
+def make_rlock(name):
+    """A ``threading.RLock`` (bare, unless lockdep is armed)."""
+    if not lockdep_enabled():
+        return threading.RLock()
+    from petastorm_tpu.analysis.lockdep import runtime
+    return runtime.TrackedRLock(threading.RLock(), name)
+
+
+def make_condition(name, lock=None):
+    """A ``threading.Condition`` (bare, unless lockdep is armed).
+
+    When ``lock`` is a factory-made lock the condition shares BOTH the
+    underlying primitive and the lock-order identity with it, so
+    ``with self._lock:`` and ``with self._cond:`` record as the same
+    graph node — which they are.
+    """
+    if not lockdep_enabled():
+        return threading.Condition(lock)
+    from petastorm_tpu.analysis.lockdep import runtime
+    return runtime.make_tracked_condition(name, lock)

@@ -37,7 +37,7 @@ for tiny datasets, single-worker pools, or when
 """
 
 import os
-import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 
 __all__ = ['PieceCostModel', 'FifoDispatchPolicy', 'AdaptiveDispatchPolicy',
@@ -109,7 +109,7 @@ class PieceCostModel(object):  # ptlint: disable=pickle-unsafe-attrs — lives o
 
     def __init__(self, alpha=0.3):
         self._alpha = float(alpha)
-        self._lock = threading.Lock()
+        self._lock = make_lock('workers_pool.scheduling.PieceCostModel._lock')
         self._ewma = {}    # piece -> observed EWMA seconds
         #: running sum of ``_ewma`` values, maintained by observe() so
         #: predict() gets the observed mean in O(1) — summing the dict
@@ -345,7 +345,7 @@ class ReorderBuffer(object):  # ptlint: disable=pickle-unsafe-attrs — parent-s
     """
 
     def __init__(self, start_position=0, prologue_count=0):
-        self._lock = threading.Lock()
+        self._lock = make_lock('workers_pool.scheduling.ReorderBuffer._lock')
         self._start = int(start_position)
         self._expected = (-int(prologue_count) if prologue_count
                           else self._start)

@@ -60,7 +60,7 @@ import mmap
 import os
 import pickle
 import struct
-import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 import uuid
 import weakref
@@ -419,7 +419,7 @@ class SegmentVanishedError(OSError):
 #: stays the size of the writers' working sets.  _cache_gc() drops
 #: mappings whose slab files are gone once the cache grows past a bound.
 _MAPPINGS = {}
-_MAPPINGS_LOCK = threading.Lock()
+_MAPPINGS_LOCK = make_lock('workers_pool.shm_plane._MAPPINGS_LOCK')
 _MAPPINGS_GC_AT = 128
 
 

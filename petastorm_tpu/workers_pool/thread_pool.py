@@ -12,6 +12,7 @@ this is the recommended pool on TPU-VM hosts (see SURVEY.md §7 stage 9).
 import queue
 import sys
 import threading
+from petastorm_tpu.utils.locks import make_lock
 import time
 
 from petastorm_tpu.telemetry import MetricsRegistry
@@ -46,10 +47,10 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
         #: serializes reorder release batches: complete() is atomic, but
         #: two workers publishing their released runs concurrently could
         #: interleave them on the results queue.
-        self._flush_lock = threading.Lock()
+        self._flush_lock = make_lock('workers_pool.thread_pool.ThreadPool._flush_lock')
         self._tls = threading.local()  # per-worker-thread current position
         self._stop_event = threading.Event()
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = make_lock('workers_pool.thread_pool.ThreadPool._inflight_lock')
         self._inflight = 0  # ventilated but result-not-yet-consumed items
         #: Source of truth for the pool's counters (ISSUE 5):
         #: ``diagnostics`` — and through it ``Reader.diagnostics`` — is a

@@ -20,6 +20,7 @@ dispatch only ever moves the token earlier, never past unfinished work.
 
 import logging
 import threading
+from petastorm_tpu.utils.locks import make_condition, make_lock
 
 import numpy as np
 
@@ -114,8 +115,9 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
         self._paused = threading.Event()
         self._stop_requested = threading.Event()
         self._thread = None
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = make_lock('workers_pool.ventilator.ConcurrentVentilator._lock')
+        self._cond = make_condition('workers_pool.ventilator.ConcurrentVentilator._lock',
+                                    self._lock)
         #: position -> work item, ventilated but not acked (the item is
         #: kept so acks can feed the cost model by piece index)
         self._outstanding = {}

@@ -28,6 +28,14 @@ if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
 os.environ['JAX_PLATFORMS'] = 'cpu'
 
+# Runtime lockdep (ISSUE 11): armed for the whole suite, so every tier-1
+# run doubles as a deadlock-detection run — the utils.locks factory
+# returns order-tracking wrappers and any lock-order inversion lands in
+# the watchdog/telemetry artifact below.  Must be set BEFORE any
+# petastorm_tpu module import (module-level locks are constructed at
+# import time).  setdefault: an explicit =0 disarms locally.
+os.environ.setdefault('PETASTORM_TPU_LOCKDEP', '1')
+
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
@@ -74,7 +82,15 @@ def pytest_configure(config):
     # imported HERE, on the main thread: a first import of native
     # extension modules from the timer thread (concurrent with the
     # faulthandler dump) has segfaulted the child on this host.
-    global _TELEMETRY, _TELEMETRY_TIMER
+    global _TELEMETRY, _TELEMETRY_TIMER, _LOCKDEP
+    try:
+        # Lockdep runtime pre-import (ISSUE 11): the dump below runs on
+        # a timer thread, which must NEVER be the first importer of
+        # anything (see the telemetry import note) — bind the module
+        # here on the main thread.
+        from petastorm_tpu.analysis.lockdep import runtime as _LOCKDEP
+    except Exception:
+        _LOCKDEP = None
     try:
         from petastorm_tpu import telemetry as _TELEMETRY
         # dump_state's own lazy imports (benchmark.trace and through it
@@ -95,6 +111,7 @@ def pytest_configure(config):
 
 _TELEMETRY = None
 _TELEMETRY_TIMER = None
+_LOCKDEP = None
 
 
 def _arm_telemetry_timer(delay_s):
@@ -131,6 +148,12 @@ def _write_telemetry_dump(reason):
         state = _TELEMETRY.dump_state()
         state['reason'] = reason
         state['unix_time'] = time.time()
+        if _LOCKDEP is not None:
+            # Lockdep dump (ISSUE 11): the observed lock-order graph,
+            # acquisition-stack witnesses, and any order inversions ride
+            # the same artifact — a hung suite ships its deadlock
+            # evidence, not just thread stacks.
+            state['lockdep'] = _LOCKDEP.state_dict()
         path = _telemetry_dump_path()
         os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
         with open(path, 'w') as f:

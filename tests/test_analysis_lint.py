@@ -371,6 +371,126 @@ def test_readonly_view_mutation_respects_statement_order():
     assert len(_ids(bad, 'readonly-view-mutation')) == 1
 
 
+# -- cv-wait-no-predicate (ISSUE 11 satellite) --------------------------------
+
+def test_cv_wait_fires_outside_while_loop():
+    bad = '''
+    def drain(self):
+        with self._cond:
+            self._cond.wait()
+
+    def drain_timed(self):
+        with self._cond:
+            if not self.ready:
+                self._cond.wait(1.0)
+    '''
+    assert len(_ids(bad, 'cv-wait-no-predicate')) == 2
+
+
+def test_cv_wait_quiet_in_predicate_loop_wait_for_and_events():
+    good = '''
+    def drain(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait()
+
+    def drain_for(self):
+        with self._cond:
+            self._cond.wait_for(lambda: self.ready)
+
+    def event_wait(self):
+        self._completed.wait()   # Event.wait: no predicate protocol
+    '''
+    assert not _ids(good, 'cv-wait-no-predicate')
+
+
+# -- wire-protocol-conformance (ISSUE 11 satellite) ---------------------------
+
+def _write_wire_pair(tmp_path, worker_src, pool_src):
+    pkg = tmp_path / 'pkg' / 'workers_pool'
+    pkg.mkdir(parents=True)
+    (pkg / 'process_worker.py').write_text(textwrap.dedent(worker_src))
+    (pkg / 'process_pool.py').write_text(textwrap.dedent(pool_src))
+    return str(tmp_path / 'pkg')
+
+
+def test_wire_conformance_fires_both_directions(tmp_path):
+    root = _write_wire_pair(
+        tmp_path,
+        '''
+        def send(sock, payload):
+            sock.send_multipart([b'R', payload])
+            sock.send_multipart([b'Q', payload])   # no dispatch arm
+        ''',
+        '''
+        def recv(tag, payload):
+            if tag == b'R':
+                return payload
+            if tag == b'Z':                        # no sender
+                return None
+        ''')
+    findings = [f for f in lint_paths([root])
+                if f.rule_id == 'wire-protocol-conformance']
+    messages = ' | '.join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "b'Q'" in messages and 'ever compares/dispatches' in messages
+    assert "b'Z'" in messages and 'ever sends' in messages
+
+
+def test_wire_conformance_quiet_on_balanced_protocol(tmp_path):
+    root = _write_wire_pair(
+        tmp_path,
+        '''
+        def send(sock, payload):
+            sock.send_multipart([b'R', payload])
+            sock.send_multipart([b'E', payload])
+        ''',
+        '''
+        def recv(tag, payload):
+            if tag in (b'R', b'E'):
+                return payload
+        ''')
+    assert not [f for f in lint_paths([root])
+                if f.rule_id == 'wire-protocol-conformance']
+
+
+def test_wire_conformance_needs_a_peer_pair(tmp_path):
+    """One side alone is not a protocol: the sender module without its
+    peer on the scan must stay quiet (partial scans, fixtures)."""
+    pkg = tmp_path / 'pkg' / 'workers_pool'
+    pkg.mkdir(parents=True)
+    (pkg / 'process_worker.py').write_text(
+        "def send(sock, p):\n    sock.send_multipart([b'Q', p])\n")
+    assert not [f for f in lint_paths([str(tmp_path / 'pkg')])
+                if f.rule_id == 'wire-protocol-conformance']
+
+
+def test_wire_catalogue_pinned_on_real_tree():
+    """THE tag catalogue: every one-letter frame tag each wire module
+    sends/handles today.  A new tag (or a dropped dispatch arm) must
+    update this table consciously — that is the review the rule
+    encodes."""
+    from petastorm_tpu.analysis.framework import _parse
+    from petastorm_tpu.analysis.rules.wire_protocol import collect_tags
+    expected = {
+        'workers_pool/process_pool.py':
+            (set(), {b'A', b'E', b'K', b'P', b'R', b'T'}),
+        'workers_pool/process_worker.py':
+            ({b'A', b'E', b'K', b'P', b'R', b'T'}, set()),
+        'service/worker.py': ({b'A', b'R', b'S'}, {b'A', b'R'}),
+        'service/client.py': (set(), {b'S'}),
+        'service/dispatcher.py': (set(), set()),
+        'service/cluster.py': ({b'B', b'S'}, {b'B', b'S'}),
+    }
+    for member, (want_sent, want_handled) in expected.items():
+        full = os.path.join(REPO, 'petastorm_tpu', member)
+        module, finding = _parse(full, member)
+        assert finding is None, finding
+        sent, handled = collect_tags(module)
+        assert set(sent) == want_sent, (member, sorted(sent))
+        assert set(handled) == want_handled, (member, sorted(handled))
+
+
 # -- framework: suppressions, baseline, walker, syntax errors -----------------
 
 def test_inline_disable_suppresses_only_that_line_and_rule():
@@ -466,6 +586,9 @@ def test_every_rule_has_id_and_motivation():
     assert len(ids) == len(set(ids)) and all(ids)
     assert all(r.motivation for r in ALL_RULES)
     assert len(ids) >= 8  # the ISSUE 4 rule floor
+    # ISSUE 11: the deadlock-analysis rules ride the same registry.
+    assert {'lock-order-cycle', 'cv-wait-no-predicate',
+            'wire-protocol-conformance'} <= set(ids)
 
 
 def test_repo_is_clean_modulo_baseline():

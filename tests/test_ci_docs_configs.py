@@ -82,6 +82,10 @@ def test_ci_lint_job_gates_on_ptlint_and_ruff():
     job = _load_ci()['jobs']['lint']
     run_text = '\n'.join(s['run'] for s in job['steps'] if 'run' in s)
     assert 'python -m petastorm_tpu.analysis petastorm_tpu/' in run_text
+    # ISSUE 11: the deadlock-analysis gate runs from the same bare
+    # checkout, right next to the lint gate.
+    assert 'python -m petastorm_tpu.analysis.lockdep --check ' \
+           'petastorm_tpu/' in run_text
     assert 'ruff check' in run_text
     # The gate stays JAX-free: no dependency install beyond ruff.
     assert 'pip install -e' not in run_text
@@ -281,6 +285,8 @@ def test_console_script_entry_points_resolve():
     # ISSUE 7: the diagnosis + perf-trend CLIs must stay registered
     assert 'petastorm-tpu-diagnose' in names, names
     assert 'petastorm-tpu-bench-trend' in names, names
+    # ISSUE 11: the deadlock-analysis CLI
+    assert 'petastorm-tpu-lockdep' in names, names
     for line in lines:
         _, target = [s.strip().strip('"') for s in line.split('=', 1)]
         mod, fn = target.split(':')
@@ -436,6 +442,21 @@ def test_ci_bench_trend_step_runs_bare_file():
     job = _load_ci()['jobs']['lint']
     run_text = '\n'.join(s['run'] for s in job['steps'] if 'run' in s)
     assert 'python petastorm_tpu/benchmark/trend.py --check' in run_text
+
+
+def test_docs_carry_lockdep_rule_catalogue_and_dump_rows():
+    """ISSUE 11 docs: development.md must catalogue the new rules and
+    explain the lockdep plane (graph reading, --dot, when to suppress);
+    observability.md must document the watchdog artifact's lockdep
+    section."""
+    dev = open(os.path.join(REPO, 'docs', 'development.md')).read()
+    for rule_id in ('lock-order-cycle', 'cv-wait-no-predicate',
+                    'wire-protocol-conformance'):
+        assert '`%s`' % rule_id in dev, rule_id
+    assert 'petastorm-tpu-lockdep' in dev
+    assert '--dot' in dev and 'PETASTORM_TPU_LOCKDEP' in dev
+    obs = open(os.path.join(REPO, 'docs', 'observability.md')).read()
+    assert 'lockdep' in obs and 'violations' in obs
 
 
 def test_conftest_arms_flight_recorder_and_writes_its_artifact():

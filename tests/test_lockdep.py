@@ -1,0 +1,795 @@
+"""Deadlock analysis plane (ISSUE 11): static lock-order graph, runtime
+lockdep shim, and the `petastorm-tpu-lockdep` CLI.
+
+Fixture conventions follow ``test_analysis_lint.py``: every behavior
+gets a bad fixture proving it fires and a good fixture proving it stays
+quiet; the runtime half constructs a REAL two-thread ABBA inversion and
+asserts the shim reports the cycle with both stacks.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from petastorm_tpu.analysis import lint_text
+from petastorm_tpu.analysis.lockdep import analyze
+from petastorm_tpu.analysis.lockdep.cli import main as lockdep_main
+from petastorm_tpu.analysis.framework import _parse
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ids(source, rule_id=None, path='fixture.py'):
+    findings = lint_text(textwrap.dedent(source), path=path)
+    ids = [f.rule_id for f in findings]
+    if rule_id is not None:
+        return [i for i in ids if i == rule_id]
+    return ids
+
+
+def _analyze_sources(sources):
+    """sources: {report path: source} -> Analysis over parsed modules."""
+    modules = []
+    for path, source in sorted(sources.items()):
+        module, finding = _parse(path, path,
+                                 source=textwrap.dedent(source))
+        assert finding is None, finding
+        modules.append(module)
+    return analyze(modules)
+
+
+# -- static: lock-order-cycle -------------------------------------------------
+
+def test_cycle_fires_on_same_file_abba():
+    bad = '''
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with B:
+            with A:
+                pass
+    '''
+    findings = [f for f in lint_text(textwrap.dedent(bad), path='m.py')
+                if f.rule_id == 'lock-order-cycle']
+    assert len(findings) == 1
+    # The finding names BOTH binding sites.
+    assert 'm.A' in findings[0].message and 'm.B' in findings[0].message
+
+
+def test_cycle_quiet_on_consistent_order():
+    good = '''
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with A:
+            with B:
+                pass
+    '''
+    assert not _ids(good, 'lock-order-cycle')
+
+
+def test_cycle_fires_across_files_through_direct_calls():
+    """The cross-file half: each file's nesting is consistent locally;
+    the cycle only exists through the imported-call edges."""
+    analysis = _analyze_sources({
+        'pkg/m1.py': '''
+            import threading
+            from pkg import m2
+            A = threading.Lock()
+
+            def locked_call():
+                with A:
+                    m2.take_b()
+
+            def take_a():
+                with A:
+                    pass
+        ''',
+        'pkg/m2.py': '''
+            import threading
+            from pkg import m1
+            B = threading.Lock()
+
+            def take_b():
+                with B:
+                    pass
+
+            def reverse():
+                with B:
+                    m1.take_a()
+        ''',
+    })
+    assert len(analysis.cycle_findings) == 1
+    message = analysis.cycle_findings[0].message
+    assert 'pkg.m1.A' in message and 'pkg.m2.B' in message
+
+
+def test_cycle_fires_through_self_method_resolution():
+    bad = '''
+    import threading
+    OTHER = threading.Lock()
+
+    class Plane(object):
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def __getstate__(self):
+            return {}
+
+        def outer(self):
+            with self._lock:
+                self.inner()
+
+        def inner(self):
+            with OTHER:
+                pass
+
+        def reversed_order(self):
+            with OTHER:
+                with self._lock:
+                    pass
+    '''
+    findings = [f for f in lint_text(textwrap.dedent(bad), path='p.py')
+                if f.rule_id == 'lock-order-cycle']
+    assert len(findings) == 1
+    assert 'p.Plane._lock' in findings[0].message
+    assert 'p.OTHER' in findings[0].message
+
+
+def test_factory_binding_sites_use_the_given_name():
+    src = '''
+    from petastorm_tpu.utils.locks import make_condition, make_lock
+
+    class V(object):
+        def __init__(self):
+            self._lock = make_lock('pool.V._lock')
+            self._cond = make_condition('pool.V._lock', self._lock)
+
+        def __getstate__(self):
+            return {}
+
+        def run(self):
+            with self._cond:
+                pass
+    '''
+    analysis = _analyze_sources({'v.py': src})
+    info = analysis.modules['v.py']
+    # Condition and lock share ONE identity — the factory name.
+    assert info.class_locks['V'] == {'_lock': 'pool.V._lock',
+                                     '_cond': 'pool.V._lock'}
+
+
+def test_flock_participates_in_the_graph():
+    src = '''
+    import fcntl
+    import threading
+    L = threading.Lock()
+
+    def publish(fd):
+        with L:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    '''
+    analysis = _analyze_sources({'pl.py': src})
+    edges = [(s, d) for s, d, _ in analysis.graph.edges()]
+    assert ('pl.L', 'pl.flock') in edges
+
+
+def test_flock_lock_inversion_across_methods_is_a_cycle():
+    """The flock-plane ABBA the issue motivation names: a file lock and
+    a threading lock nested in opposite orders in two methods of one
+    class must close a cycle (per-function flock identities could
+    never — review finding)."""
+    bad = '''
+    import fcntl
+    import threading
+
+    class Tier(object):
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def __getstate__(self):
+            return {}
+
+        def store(self, fd):
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            with self._lock:
+                pass
+
+        def evict(self, fd):
+            with self._lock:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    '''
+    findings = [f for f in lint_text(textwrap.dedent(bad), path='t.py')
+                if f.rule_id == 'lock-order-cycle']
+    assert len(findings) == 1
+    assert 't.Tier.flock' in findings[0].message
+    assert 't.Tier._lock' in findings[0].message
+
+
+def test_graph_dump_and_dot_render():
+    src = '''
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+    '''
+    graph = _analyze_sources({'g.py': src}).graph
+    assert graph.nodes() == ['g.A', 'g.B']
+    assert graph.has_path('g.A', 'g.B') and not graph.has_path('g.B', 'g.A')
+    dump = graph.to_dict()
+    assert dump['edges'][0]['src'] == 'g.A'
+    assert dump['edges'][0]['witnesses'][0]['site'].startswith('g.py:')
+    dot = graph.to_dot()
+    assert dot.startswith('digraph') and '"g.A" -> "g.B"' in dot
+
+
+def test_cycle_quiet_when_release_happens_in_finally():
+    """The acquire/try/finally/release idiom must actually RELEASE in
+    the walker: a finally-block release seen only on a copied held list
+    fabricated a cycle against a legitimate `with B: with A:` elsewhere
+    (review finding on this PR)."""
+    good = '''
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def careful():
+        A.acquire()
+        try:
+            work()
+        finally:
+            A.release()
+        with B:
+            pass
+
+    def nested():
+        with B:
+            with A:
+                pass
+    '''
+    findings = lint_text(textwrap.dedent(good), path='fin.py')
+    assert not [f for f in findings if f.rule_id == 'lock-order-cycle']
+
+
+def test_with_exit_releases_its_own_lock_not_a_bare_acquire():
+    """A bare acquire() inside a with-body outlives the with: the exit
+    must release the with's OWN entry, not the newest one (review
+    finding: `with A: B.acquire()` then `with C:` recorded a false
+    A->C edge and missed the true B->C)."""
+    src = '''
+    import threading
+    _A = threading.Lock()
+    _B = threading.Lock()
+    _C = threading.Lock()
+
+    def f():
+        with _A:
+            _B.acquire()
+        with _C:
+            pass
+        _B.release()
+    '''
+    graph = _analyze_sources({'we.py': src}).graph
+    edges = {(s, d) for s, d, _ in graph.edges()}
+    assert ('we._B', 'we._C') in edges
+    assert ('we._A', 'we._C') not in edges
+
+
+# -- static: transitive blocking-under-lock -----------------------------------
+
+def test_transitive_blocking_fires_through_call_chain():
+    bad = '''
+    import time
+
+    def backoff():
+        time.sleep(0.5)
+
+    def retry():
+        backoff()
+
+    def fill(self):
+        with self._lock:
+            retry()
+    '''
+    findings = [f for f in lint_text(textwrap.dedent(bad), path='t.py')
+                if f.rule_id == 'blocking-under-lock']
+    assert len(findings) == 1
+    assert 'transitively blocks' in findings[0].message
+    assert 'retry' in findings[0].message
+    assert 'time.sleep' in findings[0].message
+
+
+def test_transitive_blocking_does_not_double_report_direct_case():
+    bad = '''
+    import time
+
+    def fill(self):
+        with self._lock:
+            time.sleep(0.5)
+    '''
+    # Only the lexical finding: time.sleep is not a repo-local callee.
+    assert len(_ids(bad, 'blocking-under-lock')) == 1
+
+
+def test_transitive_blocking_quiet_when_callee_is_prompt():
+    good = '''
+    def bump(self):
+        self.n += 1
+
+    def fill(self):
+        with self._lock:
+            bump(self)
+    '''
+    assert not _ids(good, 'blocking-under-lock')
+
+
+def test_transitive_blocking_quiet_outside_lock():
+    good = '''
+    import time
+
+    def backoff():
+        time.sleep(0.5)
+
+    def fill(self):
+        with self._lock:
+            self.n += 1
+        backoff()
+    '''
+    assert not _ids(good, 'blocking-under-lock')
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def _write_abba(tmp_path):
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir(exist_ok=True)
+    (pkg / 'mod.py').write_text(textwrap.dedent('''
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    '''))
+    return str(pkg)
+
+
+def test_lockdep_cli_check_exits_1_on_planted_abba(tmp_path, capsys):
+    pkg = _write_abba(tmp_path)
+    assert lockdep_main(['--check', '--no-baseline', pkg]) == 1
+    out = capsys.readouterr().out
+    assert 'lock-order-cycle' in out
+    # Both binding sites named in the cycle report.
+    assert 'pkg.mod.A' in out and 'pkg.mod.B' in out
+
+
+def test_lockdep_cli_check_exits_0_on_clean_tree(tmp_path):
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir()
+    (pkg / 'ok.py').write_text(
+        'import threading\nL = threading.Lock()\n\n'
+        'def f():\n    with L:\n        pass\n')
+    assert lockdep_main(['--check', '--no-baseline', str(pkg)]) == 0
+
+
+def test_lockdep_cli_graph_and_dot_modes(tmp_path, capsys):
+    pkg = _write_abba(tmp_path)
+    assert lockdep_main([pkg]) == 0
+    out = capsys.readouterr().out
+    assert 'lock-order graph:' in out and 'CYCLE:' in out
+    assert lockdep_main(['--dot', pkg]) == 0
+    assert capsys.readouterr().out.startswith('digraph')
+
+
+def test_lockdep_cli_exit_2_on_missing_path(tmp_path):
+    assert lockdep_main([str(tmp_path / 'nope')]) == 2
+
+
+def test_lockdep_cli_check_respects_inline_suppression(tmp_path):
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir()
+    (pkg / 'mod.py').write_text(textwrap.dedent('''
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:  # ptlint: disable=lock-order-cycle — test fixture: both orders guarded by an external barrier
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    '''))
+    assert lockdep_main(['--check', '--no-baseline', str(pkg)]) == 0
+
+
+def test_repo_lockdep_gate_is_green():
+    """Acceptance: `petastorm-tpu-lockdep --check petastorm_tpu/` exits
+    0 on the final tree with an EMPTY baseline."""
+    baseline = os.path.join(REPO, 'petastorm_tpu', 'analysis',
+                            'baseline.txt')
+    entries = [line for line in open(baseline)
+               if line.strip() and not line.lstrip().startswith('#')]
+    assert not entries, 'baseline must stay empty: %r' % entries
+    assert lockdep_main(['--check',
+                         os.path.join(REPO, 'petastorm_tpu')]) == 0
+
+
+def test_lockdep_cli_is_stdlib_only():
+    """CI runs the gate from a bare checkout before any install: prove
+    the whole lockdep package imports with the heavy deps blocked."""
+    probe = (
+        'import sys\n'
+        'class Block:\n'
+        '    def find_module(self, name, path=None):\n'
+        '        if name.split(".")[0] in ("numpy", "pyarrow", "jax",\n'
+        '                                  "zmq", "fsspec"):\n'
+        '            raise ImportError("blocked: " + name)\n'
+        'sys.meta_path.insert(0, Block())\n'
+        'from petastorm_tpu.analysis.lockdep.cli import main\n'
+        'from petastorm_tpu.utils.locks import make_lock\n'
+        'sys.exit(main(["--check", "--no-baseline",\n'
+        '               "petastorm_tpu/analysis/lockdep"]))\n')
+    out = subprocess.run([sys.executable, '-c', probe], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+
+
+# -- runtime shim -------------------------------------------------------------
+
+def test_factory_is_pass_through_when_disarmed(monkeypatch):
+    """Acceptance: with PETASTORM_TPU_LOCKDEP unset the factory returns
+    the BARE stdlib primitives — zero wrapper overhead, identity-checked."""
+    monkeypatch.delenv('PETASTORM_TPU_LOCKDEP', raising=False)
+    from petastorm_tpu.utils import locks
+    assert type(locks.make_lock('x')) is type(threading.Lock())
+    assert type(locks.make_rlock('x')) is type(threading.RLock())
+    assert type(locks.make_condition('x')) is threading.Condition
+    inner = threading.Lock()
+    cond = locks.make_condition('x', inner)
+    assert type(cond) is threading.Condition and cond._lock is inner
+
+
+def test_runtime_shim_reports_real_two_thread_abba(monkeypatch):
+    """Acceptance: a REAL ABBA inversion across two threads is detected
+    at acquire time — no timer threads — with both stacks recorded."""
+    monkeypatch.setenv('PETASTORM_TPU_LOCKDEP', '1')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.utils import locks
+
+    lock_a = locks.make_lock('abba_test.A')
+    lock_b = locks.make_lock('abba_test.B')
+    assert isinstance(lock_a, runtime.TrackedLock)
+    first_order_done = threading.Event()
+    threads_before = threading.active_count()
+
+    def ab_order():
+        with lock_a:
+            with lock_b:
+                pass
+        first_order_done.set()
+
+    def ba_order():
+        first_order_done.wait(10)
+        with lock_b:
+            with lock_a:
+                pass
+
+    t1 = threading.Thread(target=ab_order)
+    t2 = threading.Thread(target=ba_order)
+    t1.start()
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+
+    mine = [v for v in runtime.violations()
+            if v['acquiring'] == 'abba_test.A'
+            and v['holding'] == 'abba_test.B']
+    assert len(mine) == 1, runtime.violations()
+    violation = mine[0]
+    assert violation['cycle'] == ['abba_test.A', 'abba_test.B',
+                                  'abba_test.A']
+    # Both stacks: the inverting acquire (thread 2) and the witness of
+    # the original order (thread 1's acquire of B under A).
+    assert any('ba_order' in frame
+               for frame in violation['acquire_stack'])
+    assert any('ab_order' in frame
+               for frame in violation['reverse_witness_stack'])
+    # record-on-acquire only: the shim spawned no helper threads.
+    assert threading.active_count() <= threads_before
+    # ...and the observed graph carries both edges for the dump.
+    edges = {(e['src'], e['dst'])
+             for e in runtime.state_dict()['edges']}
+    assert ('abba_test.A', 'abba_test.B') in edges
+    assert ('abba_test.B', 'abba_test.A') in edges
+
+
+def test_runtime_consistent_order_records_no_violation(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_LOCKDEP', '1')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.utils import locks
+    lock_a = locks.make_lock('order_test.A')
+    lock_b = locks.make_lock('order_test.B')
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert not [v for v in runtime.violations()
+                if 'order_test' in v['acquiring']]
+
+
+def test_runtime_condition_shares_lock_identity_and_survives_wait(
+        monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_LOCKDEP', '1')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.utils import locks
+    lock = locks.make_lock('cv_test.L')
+    cond = locks.make_condition('ignored-name', lock)
+    assert cond.name == 'cv_test.L'
+    results = []
+
+    def waiter():
+        with cond:
+            while not results:
+                cond.wait(5)
+            results.append('woke')
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+    import time
+    time.sleep(0.05)
+    with cond:
+        results.append('set')
+        cond.notify_all()
+    thread.join(10)
+    assert results == ['set', 'woke']
+    assert not [v for v in runtime.violations()
+                if 'cv_test' in v['acquiring']]
+
+
+def test_runtime_cross_thread_release_is_tolerated(monkeypatch):
+    """threading.Lock legally allows acquire-in-A / release-in-B (a
+    handoff); the shim must not crash on the releasing thread (review
+    finding: an unguarded thread-local read raised AttributeError)."""
+    monkeypatch.setenv('PETASTORM_TPU_LOCKDEP', '1')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.utils import locks
+    lock = locks.make_lock('handoff_test.L')
+    lock.acquire()
+    errors = []
+
+    def releaser():
+        try:
+            lock.release()
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            errors.append(e)
+
+    thread = threading.Thread(target=releaser)
+    thread.start()
+    thread.join(5)
+    assert not errors, errors
+    assert not lock.locked()
+    # ...and the acquirer's stale held entry must not fabricate edges:
+    # the next acquire on this thread purges it (lazy handoff purge).
+    other = locks.make_lock('handoff_test.other')
+    with other:
+        pass
+    edges = {(e['src'], e['dst']) for e in runtime.state_dict()['edges']}
+    assert ('handoff_test.L', 'handoff_test.other') not in edges
+
+
+def test_runtime_handoff_does_not_blind_live_holders(monkeypatch):
+    """The handoff purge is attributed to the OWNING thread: after one
+    handoff of L, a different thread's live `with L: with M:` must
+    still record the L->M edge and a genuine inversion must still be
+    detected (review finding: an instance-keyed purge let any holder
+    consume it against its live entry and re-register it forever)."""
+    monkeypatch.setenv('PETASTORM_TPU_LOCKDEP', '1')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.utils import locks
+    lock_l = locks.make_lock('blind_test.L')
+    lock_m = locks.make_lock('blind_test.M')
+    # One legal handoff: acquire here, release on another thread.
+    lock_l.acquire()
+    releaser = threading.Thread(target=lock_l.release)
+    releaser.start()
+    releaser.join(5)
+
+    def nest_forward():
+        with lock_l:
+            with lock_m:
+                pass
+
+    def nest_reverse():
+        with lock_m:
+            with lock_l:
+                pass
+
+    worker = threading.Thread(target=nest_forward)
+    worker.start()
+    worker.join(5)
+    edges = {(e['src'], e['dst']) for e in runtime.state_dict()['edges']}
+    assert ('blind_test.L', 'blind_test.M') in edges
+    worker = threading.Thread(target=nest_reverse)
+    worker.start()
+    worker.join(5)
+    assert [v for v in runtime.violations()
+            if v['acquiring'] == 'blind_test.L'
+            and v['holding'] == 'blind_test.M']
+
+
+def test_runtime_nonblocking_acquire_records_no_violation(monkeypatch):
+    """Trylock-with-fallback is the deadlock-FREE escape pattern: a
+    reverse-order acquire(blocking=False) probe must not be reported
+    as an ABBA inversion (review finding)."""
+    monkeypatch.setenv('PETASTORM_TPU_LOCKDEP', '1')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.utils import locks
+    lock_a = locks.make_lock('try_test.A')
+    lock_b = locks.make_lock('try_test.B')
+    with lock_a:
+        assert lock_b.acquire(blocking=False)
+        lock_b.release()
+    with lock_b:
+        assert lock_a.acquire(blocking=False)  # reverse probe: legal
+        lock_a.release()
+    assert not [v for v in runtime.violations()
+                if 'try_test' in v['acquiring']]
+
+
+def test_static_trylock_in_if_test_does_not_leak_held_state():
+    """An acquisition in an if-test is held in the success BODY only —
+    it must not stay 'held' for the rest of the function (review
+    finding: the test expr mutated the real held list while the body
+    released only a copy)."""
+    src = '''
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f(self):
+        if A.acquire(blocking=False):
+            self.n += 1
+            A.release()
+        with B:
+            pass
+    '''
+    graph = _analyze_sources({'ift.py': src}).graph
+    assert ('ift.A', 'ift.B') not in {(s, d) for s, d, _ in graph.edges()}
+
+
+def test_static_nested_function_locks_are_visible():
+    """Fn-local factory locks used inside closures (the tf_utils queue
+    puller shape) must appear in the graph (review finding: nested
+    defs were never walked)."""
+    src = '''
+    from petastorm_tpu.utils.locks import make_lock
+    import threading
+    OTHER = threading.Lock()
+
+    def tf_tensors(reader):
+        lock = make_lock('tf_utils.tf_tensors.lock')
+
+        def pull():
+            with lock:
+                with OTHER:
+                    return next(reader)
+        return pull
+    '''
+    graph = _analyze_sources({'tfu.py': src}).graph
+    assert ('tf_utils.tf_tensors.lock', 'tfu.OTHER') in \
+        {(s, d) for s, d, _ in graph.edges()}
+
+
+def test_runtime_rlock_instances_do_not_conflate(monkeypatch):
+    """Re-entry depth is per-INSTANCE: two same-named RLocks held by
+    one thread are distinct scopes (review finding: a name-keyed depth
+    skipped the second instance's hold entirely)."""
+    monkeypatch.setenv('PETASTORM_TPU_LOCKDEP', '1')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.utils import locks
+    rlock_1 = locks.make_rlock('rconf_test.R')
+    rlock_2 = locks.make_rlock('rconf_test.R')
+    other = locks.make_lock('rconf_test.M')
+    rlock_1.acquire()
+    rlock_2.acquire()
+    rlock_1.release()
+    with other:   # acquired while instance 2 is STILL held
+        pass
+    rlock_2.release()
+    edges = {(e['src'], e['dst']) for e in runtime.state_dict()['edges']}
+    assert ('rconf_test.R', 'rconf_test.M') in edges
+
+
+def test_static_nonblocking_acquire_forms_no_cycle():
+    good = '''
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def forward():
+        with A:
+            with B:
+                pass
+
+    def probe():
+        with B:
+            if A.acquire(blocking=False):
+                A.release()
+    '''
+    assert not _ids(good, 'lock-order-cycle')
+
+
+def test_runtime_rlock_reentry_records_single_hold(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_LOCKDEP', '1')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.utils import locks
+    rlock = locks.make_rlock('rlock_test.R')
+    other = locks.make_lock('rlock_test.L')
+    with rlock:
+        with rlock:   # re-entrant: must not self-edge or double-push
+            with other:
+                pass
+    edges = {(e['src'], e['dst'])
+             for e in runtime.state_dict()['edges']}
+    assert ('rlock_test.R', 'rlock_test.L') in edges
+    assert ('rlock_test.R', 'rlock_test.R') not in edges
+    assert not [v for v in runtime.violations()
+                if 'rlock_test' in v['acquiring']]
+
+
+# -- suite wiring -------------------------------------------------------------
+
+def test_conftest_arms_lockdep_and_ships_its_dump():
+    """The tier-1 suite IS a deadlock-detection run: conftest arms the
+    shim before any petastorm_tpu import and the watchdog artifact
+    carries the lockdep section."""
+    src = open(os.path.join(REPO, 'tests', 'conftest.py')).read()
+    assert "os.environ.setdefault('PETASTORM_TPU_LOCKDEP', '1')" in src
+    assert src.index('PETASTORM_TPU_LOCKDEP') < src.index('import jax')
+    assert "state['lockdep'] = _LOCKDEP.state_dict()" in src
+
+
+def test_suite_process_is_running_with_tracked_locks():
+    """Meta-check that the arming actually took: module-level locks in
+    the lock-holding modules are TrackedLock instances in this process
+    (constructed at import time, after conftest set the env)."""
+    if os.environ.get('PETASTORM_TPU_LOCKDEP', '') in ('', '0'):
+        pytest.skip('lockdep disarmed explicitly')
+    from petastorm_tpu.analysis.lockdep import runtime
+    from petastorm_tpu.workers_pool import shm_plane
+    assert isinstance(shm_plane._MAPPINGS_LOCK, runtime.TrackedLock)
+    assert shm_plane._MAPPINGS_LOCK.name == \
+        'workers_pool.shm_plane._MAPPINGS_LOCK'
