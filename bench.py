@@ -1654,6 +1654,56 @@ def adaptive_sched_leg(pairs=4, seeds_per=3):
     }
 
 
+def provenance_overhead_leg(pairs=3, seconds=3.0):
+    """Per-batch provenance plane (ISSUE 13): enabled-path cost on the
+    ProcessPool host-plane leg — the path that pays the most (a record
+    built + pickled per result message, a journal seal per batch).
+
+    Protocol: interleaved on/off pairs (``PETASTORM_TPU_NO_PROVENANCE``
+    toggled per variant, operator env restored), medians, same
+    pre-decoded dataset and pool shape as the shm host-plane leg.
+    ``provenance_overhead_pct`` = (off − on) / off × 100: positive means
+    the enabled path is slower; the acceptance bar is ≤1%.  The field
+    rides the compact line into BENCH_HISTORY like every other leg."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.benchmark.hostplane import pump_host_batches
+    from petastorm_tpu.jax import DataLoader
+
+    ensure_raw_dataset()
+    rates = {'on': [], 'off': []}
+    for _ in range(max(1, int(pairs))):
+        for label, forced in (('on', None), ('off', '1')):
+            prev = os.environ.get('PETASTORM_TPU_NO_PROVENANCE')
+            if forced is None:
+                os.environ.pop('PETASTORM_TPU_NO_PROVENANCE', None)
+            else:
+                os.environ['PETASTORM_TPU_NO_PROVENANCE'] = forced
+            try:
+                with make_reader(RAW_DATASET_URL, num_epochs=None,
+                                 reader_pool_type='process',
+                                 workers_count=min(4, WORKERS),
+                                 shuffle_row_groups=False,
+                                 columnar_decode=True) as reader:
+                    loader = DataLoader(reader, batch_size=BATCH,
+                                        prefetch=2)
+                    rows, dt = pump_host_batches(loader, seconds,
+                                                 warmup_batches=2)
+                rates[label].append(rows / dt)
+            finally:
+                if prev is not None:
+                    os.environ['PETASTORM_TPU_NO_PROVENANCE'] = prev
+                else:
+                    os.environ.pop('PETASTORM_TPU_NO_PROVENANCE', None)
+    on = float(np.median(rates['on']))
+    off = float(np.median(rates['off']))
+    return {
+        'provenance_images_per_sec_on': round(on, 1),
+        'provenance_images_per_sec_off': round(off, 1),
+        'provenance_overhead_pct':
+            round(100.0 * (off - on) / off, 2) if off else None,
+    }
+
+
 #: Host-only IPC/transfer-plane legs (the shm result plane's and the
 #: transfer plane's evidence sets), wired identically into the
 #: cpu-fallback and on-chip paths of main() — one table so the two paths
@@ -1666,6 +1716,7 @@ _IPC_PLANE_LEGS = (
     ('cluster_cache', cluster_cache_leg),
     ('transfer_plane', transfer_plane_leg),
     ('adaptive_sched', adaptive_sched_leg),
+    ('provenance_overhead', provenance_overhead_leg),
 )
 
 
@@ -1939,6 +1990,9 @@ _COMPACT_KEYS = (
     'adaptive_sched_adaptive_over_fifo',
     'adaptive_sched_uniform_over_fifo',
     'adaptive_sched_delivery_identical',
+    'provenance_images_per_sec_on',
+    'provenance_images_per_sec_off',
+    'provenance_overhead_pct',
     'ipc_bytes_per_s', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',

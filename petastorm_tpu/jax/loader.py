@@ -29,6 +29,7 @@ execution model instead of translated from them:
 """
 
 import logging
+import os
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -95,6 +96,14 @@ class DataLoader(object):
             fraction.  Decisions export as ``sched_*`` gauges on
             ``self.metrics``.  ``True`` forces it on (FIFO readers tune
             prefetch only), ``False`` keeps every knob where you set it.
+        batch_slo_ms: per-batch latency SLO (ISSUE 13).  When set (or
+            via ``PETASTORM_TPU_BATCH_SLO_MS``), a sealed provenance
+            record whose end-to-end wall exceeds the budget counts a
+            ``slo_violations`` metric and auto-dumps the FULL journal
+            (the whole causal chain) under ``PETASTORM_TPU_FLIGHT_DIR``
+            for ``petastorm-tpu-explain``.  The journal itself
+            (``self.provenance``) is on whenever provenance is
+            (``PETASTORM_TPU_NO_PROVENANCE=1`` kills both).
     """
 
     def __init__(self, reader, batch_size, shuffling_queue_capacity=0,
@@ -102,7 +111,7 @@ class DataLoader(object):
                  prefetch=2, device=None, sharding=None, seed=None,
                  resume_state=None, echo=1, trace_recorder=None,
                  transfer='auto', wire_dtypes=None, ring_slots=None,
-                 autotune='auto'):
+                 autotune='auto', batch_slo_ms=None):
         if batch_size <= 0:
             raise ValueError('batch_size must be positive')
         if echo < 1:
@@ -171,6 +180,31 @@ class DataLoader(object):
         #: dispatch and commit p50/p99.
         self._m_commit = self.metrics.histogram('h2d_commit')
         self._commit_probe = 0
+        # Per-batch provenance plane (ISSUE 13): every delivered batch
+        # seals ONE record — the merge of its chunks' producer records
+        # (pieces, worker pid/host, scheduling, cache, transport) with
+        # this consumer's stage windows and the transfer-path outcome —
+        # into a bounded journal; the stage histograms keep tail
+        # exemplars ({'step': N}) pointing back into it, so any p99
+        # resolves to the actual file/rowgroup/worker.
+        from petastorm_tpu.telemetry import provenance as _provenance
+        self._provenance_mod = _provenance
+        self.provenance = None
+        self._slo = None
+        self._last_pull_window = None
+        if _provenance.enabled():
+            self.provenance = _provenance.ProvenanceJournal(label='loader')
+            if batch_slo_ms is None:
+                env_slo = os.environ.get('PETASTORM_TPU_BATCH_SLO_MS')
+                if env_slo:
+                    try:
+                        batch_slo_ms = float(env_slo)
+                    except ValueError:
+                        batch_slo_ms = None
+            if batch_slo_ms:
+                self._slo = _provenance.SloWatchdog(
+                    self.provenance, float(batch_slo_ms) / 1e3,
+                    label='loader', metrics=self.metrics)
         self._transfer = transfer
         self._wire_dtypes = wire_dtypes
         self._ring_slots = ring_slots
@@ -197,10 +231,59 @@ class DataLoader(object):
                 pool.trace_recorder = trace_recorder
 
     def _observe(self, stage, t0, t1):
-        """One stage sample: wall-time counter + latency histogram."""
+        """One stage sample: wall-time counter + latency histogram (the
+        tail-exemplar refs attach at provenance-seal time, see
+        :meth:`_seal_provenance`)."""
         counter, hist = self._m_stage[stage]
         counter.inc(t1 - t0)
         hist.observe(t1 - t0)
+
+    def _seal_provenance(self, stages, transfer=None):
+        """Merge the reader records drained since the last batch with
+        this batch's consumer-side stage windows, seal into the journal,
+        and run the SLO watchdog.  Returns the journal step, or None
+        when provenance is off."""
+        journal = self.provenance
+        if journal is None:
+            return None
+        prov = self._provenance_mod
+        records = []
+        take = getattr(self.reader, 'take_provenance', None)
+        if take is not None:
+            try:
+                records = take() or []
+            except Exception:  # noqa: BLE001 — provenance is never load-bearing
+                records = []
+        record = prov.merge_records(records)
+        for name, window in stages.items():
+            if window is not None and window[1] > window[0]:
+                record['stages'][name] = list(window)
+        if transfer is not None:
+            record['transfer'] = transfer
+        record = journal.seal(record)
+        # Back-annotate tail exemplars: the stage histograms observed
+        # these windows before the step existed, so the refs attach
+        # without re-counting — uniform across __iter__,
+        # iter_host_batches and scan_batches consumption.
+        ref = {'step': record['step']}
+        for stage_name, hist_key in (('host_batch', 'host_batch'),
+                                     ('transform', 'transform'),
+                                     ('h2d_dispatch', 'device_put')):
+            window = record['stages'].get(stage_name)
+            if window is not None:
+                self._m_stage[hist_key][1].note_exemplar(
+                    window[1] - window[0], ref)
+        if self._slo is not None:
+            self._slo.check(record)
+        return record['step']
+
+    def dump_provenance(self, path):
+        """Persist the provenance journal (atomic JSON) — the file
+        ``petastorm-tpu-explain --journal`` reads.  Returns the path, or
+        None when provenance is off or the write failed."""
+        if self.provenance is None:
+            return None
+        return self.provenance.persist(path)
 
     @property
     def stats(self):
@@ -299,6 +382,19 @@ class DataLoader(object):
                 if degraded:   # structure degrades: the existing path
                     dev = self._to_device(host_batch)
             t3 = time.monotonic()
+            if self.provenance is not None:
+                last = (plane.last_put if not degraded else None) or {}
+                stages = dict(last.get('stages') or {})
+                stages['transform'] = [t1, t2]
+                if degraded:
+                    stages['h2d_dispatch'] = [t2, t3]
+                if self._last_pull_window is not None:
+                    # _timed_pulls runs on this same (pump) thread right
+                    # before ship(), so the stash is this batch's pull.
+                    stages['host_batch'] = list(self._last_pull_window)
+                self._seal_provenance(
+                    stages, transfer=('degraded' if degraded
+                                      else last.get('outcome')))
             self._observe('transform', t1, t2)
             # Counter/histogram continuity: device_put_s covers the whole
             # put (stage + dispatch + any ring commit wait) on this path.
@@ -379,6 +475,10 @@ class DataLoader(object):
             with TraceAnnotation('pt/device_put'):
                 pending.append(self._to_device(host_batch))
             t3 = time.monotonic()
+            if self.provenance is not None:
+                self._seal_provenance(
+                    {'host_batch': [t0, t1], 'transform': [t1, t2],
+                     'h2d_dispatch': [t2, t3]}, transfer='inline')
             self._observe('host_batch', t0, t1)
             self._observe('transform', t1, t2)
             self._observe('device_put', t2, t3)
@@ -731,12 +831,20 @@ class DataLoader(object):
         # diagnose a host-boundary consumer too.
         for host_batch in self._timed_pulls(self._echoed_host_batches()):
             t1 = time.monotonic()
+            t2 = None
             if self._transform_fn is not None:
                 host_batch = self._transform_fn(host_batch)
                 t2 = time.monotonic()
                 self._observe('transform', t1, t2)
                 if self._trace is not None:
                     self._trace.event('transform', t1, t2)
+            if self.provenance is not None:
+                stages = {}
+                if self._last_pull_window is not None:
+                    stages['host_batch'] = list(self._last_pull_window)
+                if t2 is not None:
+                    stages['transform'] = [t1, t2]
+                self._seal_provenance(stages)
             self._m_batches.inc()
             yield host_batch
 
@@ -752,6 +860,10 @@ class DataLoader(object):
             except StopIteration:
                 return
             t1 = time.monotonic()
+            # Provenance: the pull window of the batch about to be
+            # consumed (read by ship() / the host-boundary consumers on
+            # the same thread).
+            self._last_pull_window = (t0, t1)
             self._observe('host_batch', t0, t1)
             if self._trace is not None:
                 self._trace.event('host_batch', t0, t1)
@@ -876,6 +988,10 @@ class DataLoader(object):
                 chunk = []
                 yield carry, outs
             chunk.append(host_batch)
+            if self.provenance is not None:
+                self._seal_provenance(
+                    {'host_batch': list(self._last_pull_window)}
+                    if self._last_pull_window is not None else {})
             self._m_batches.inc()
             if len(chunk) == steps_per_call:
                 carry, outs = fn(carry, put_stacked(chunk))

@@ -234,6 +234,10 @@ class _Layout(object):
             logical += arr.size * out.itemsize
         self.slab_nbytes = offset
         self.logical_nbytes = logical
+        #: True when the wire policy narrows at least one leaf — the
+        #: provenance 'transfer' outcome distinguishes narrowed from
+        #: plain coalesced batches (ISSUE 13).
+        self.narrowed = any(f.wire != f.out for f in self.fields)
         if len(self.fields) == 1 and self.fields[0].wire == self.fields[0].out:
             # One full-width leaf: coalescing is a no-op and the staging
             # memcpy is pure cost — the inline put is already one dispatch.
@@ -347,6 +351,12 @@ class TransferPlane(object):
             self._donate = jax.default_backend() != 'cpu'
         except Exception:  # noqa: BLE001 — resolved again at first put
             self._donate = False
+        #: Per-batch provenance (ISSUE 13): outcome + stage windows of
+        #: the most recent put — ``{'outcome': 'coalesced'|'narrowed'|
+        #: 'degraded', 'stages': {'h2d_stage'/'h2d_dispatch'/
+        #: 'h2d_commit': [t0, t1]}}`` — read by the loader right after
+        #: ``put`` returns (the plane is single-consumer by contract).
+        self.last_put = None
 
     # -- public API ----------------------------------------------------------
 
@@ -356,12 +366,18 @@ class TransferPlane(object):
         prepared = self._prepare(tree)
         if prepared is None:
             self._m_degraded.inc()
+            self.last_put = {'outcome': 'degraded'}
             return None
         slot = self._turn % len(self._slabs)
         self._turn += 1
-        self._wait_slot(slot)
+        commit_window = self._wait_slot(slot)
         slab = self._slot_slab(slot, _slab_bytes(prepared))
         batch = self._staged_put(prepared, tree, slab)
+        if commit_window is not None and self.last_put is not None:
+            # The ring-slot reuse barrier is observed link time of this
+            # put's wall — part of its causal chain.
+            self.last_put.setdefault('stages', {})['h2d_commit'] = \
+                list(commit_window)
         self._inflight[slot] = batch
         return batch
 
@@ -372,6 +388,7 @@ class TransferPlane(object):
         prepared = self._prepare(tree)
         if prepared is None:
             self._m_degraded.inc()
+            self.last_put = {'outcome': 'degraded'}
             return None
         slab = np.empty(_slab_bytes(prepared), np.uint8)
         return self._staged_put(prepared, tree, slab, sample_commit=False)
@@ -418,10 +435,11 @@ class TransferPlane(object):
         """Commit barrier for slab reuse: the batch this slot last staged
         must be device-resident before the slab is rewritten (the H2D
         copy reads the host slab asynchronously).  The observed wait is
-        the ring's view of true link time → ``h2d/commit``."""
+        the ring's view of true link time → ``h2d/commit``.  Returns the
+        wait window (or None when the slot was free)."""
         batch = self._inflight[slot]
         if batch is None:
-            return
+            return None
         t0 = time.monotonic()
         jax.block_until_ready(batch)
         t1 = time.monotonic()
@@ -429,6 +447,7 @@ class TransferPlane(object):
         self._h_commit.observe(t1 - t0)
         if self._trace is not None:
             self._trace.event('h2d/commit', t0, t1, kind='ring')
+        return (t0, t1)
 
     def _slot_slab(self, slot, nbytes):
         slab = self._slabs[slot]
@@ -443,6 +462,9 @@ class TransferPlane(object):
         self._m_logical.inc(layout.logical_nbytes)
         self._h_stage.observe(t1 - t0)
         self._h_dispatch.observe(t2 - t1)
+        self.last_put = {
+            'outcome': 'narrowed' if layout.narrowed else 'coalesced',
+            'stages': {'h2d_stage': [t0, t1], 'h2d_dispatch': [t1, t2]}}
         if self._trace is not None:
             self._trace.event('h2d/stage', t0, t1)
             self._trace.event('h2d/dispatch', t1, t2)

@@ -787,6 +787,19 @@ class Reader(object):
         """Resume ventilation after :meth:`drain_in_flight`."""
         self._ventilator.unpause()
 
+    # -- per-batch provenance (ISSUE 13) --------------------------------------
+
+    def take_provenance(self):
+        """Provenance records of the results delivered since the last
+        call (delivery order): pieces (file + rowgroup), producing
+        worker pid/host, scheduling decision, cache outcome, transport
+        path, and decode/ipc stage windows.  The JAX loader drains this
+        per host batch into its :class:`~petastorm_tpu.telemetry.
+        provenance.ProvenanceJournal`; empty under
+        ``PETASTORM_TPU_NO_PROVENANCE=1``."""
+        take = getattr(self._pool, 'take_provenance', None)
+        return take() if take is not None else []
+
     # -- lifecycle -----------------------------------------------------------
 
     def reset(self):

@@ -37,7 +37,7 @@ import time
 from petastorm_tpu.errors import ServiceError
 from petastorm_tpu.jax.loader import DataLoader
 from petastorm_tpu.service.worker import _Rpc, deserialize_chunk
-from petastorm_tpu.telemetry import merge_into_recorder
+from petastorm_tpu.telemetry import merge_into_recorder, provenance
 
 logger = logging.getLogger(__name__)
 
@@ -338,6 +338,8 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                                  'attempt': attempt}, protocol=4))
                             self._merge_worker_spans(header,
                                                      addr_of.get(sock))
+                            record = self._align_provenance(
+                                header, addr_of.get(sock))
                             chunks = [parts[i][1] if parts[i][0] == 'shm'
                                       else deserialize_chunk(*parts[i])
                                       for i in sorted(parts)]
@@ -346,12 +348,13 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                             for key in [k for k in buffers if k[0] == sid]:
                                 del buffers[key]
                             if self._ordered:
-                                held[sid] = chunks
+                                held[sid] = (chunks, record)
                                 while order and order[0] in held:
                                     nxt = order.pop(0)
-                                    self._put((nxt, held.pop(nxt)))
+                                    nxt_chunks, nxt_record = held.pop(nxt)
+                                    self._put((nxt, nxt_chunks, nxt_record))
                             else:
-                                self._put((sid, chunks))
+                                self._put((sid, chunks, record))
         except Exception as e:  # noqa: BLE001 — re-raised in next_split
             # Without this, a crashed receiver would look exactly like a
             # clean (rows-missing!) end of stream to the consumer.
@@ -399,6 +402,38 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                     pid, 'service worker %s' % (addr or '?'))
         merge_into_recorder(self._trace, spans, clock_offset_s=shift)
 
+    def _align_provenance(self, header, addr):
+        """The split's provenance record (ISSUE 13) with its stage
+        windows shifted onto THIS process's monotonic clock — the same
+        chained-offset math :meth:`_merge_worker_spans` applies — plus a
+        receive timestamp so the consumer can account buffer-wait.
+
+        Unlike the span path (which only renders timelines), provenance
+        COMPUTES cross-clock differences (``latency_ms`` feeds the
+        worst-K and the SLO watchdog), so an unalignable record is
+        dropped rather than shifted by 0: a cross-host worker whose
+        offset has not arrived yet (pre-first-heartbeat) would otherwise
+        journal a latency equal to the inter-host boot skew, permanently
+        poisoning the rolling worst-K.  Same-host workers (shared
+        CLOCK_MONOTONIC) pass the sanity gate unshifted."""
+        record = header.get('provenance')
+        if record is None or not provenance.enabled():
+            return None
+        now = time.monotonic()
+        worker_offset = self._worker_offsets.get(addr)
+        if self._clock_offset is not None and worker_offset is not None:
+            record = provenance.shift_stages(
+                record, self._clock_offset - worker_offset)
+        stages = record.get('stages') or {}
+        latest = max((w[1] for w in stages.values()), default=now)
+        if abs(now - latest) > 60.0:
+            # Unaligned (or mis-aligned) clocks: the stage windows are
+            # nowhere near this client's present — journaling them would
+            # fabricate an hours-long batch.
+            return None
+        record['_received_t'] = now
+        return record
+
     def _put(self, item):
         while not self._stop.is_set():
             try:
@@ -439,6 +474,10 @@ class ServiceReader(object):
     def __init__(self, connection):
         self._conn = connection
         self._current = []
+        #: Per-batch provenance (ISSUE 13): clock-aligned split records
+        #: adopted as their chunks enter the loader, drained per host
+        #: batch by ``DataLoader`` via :meth:`take_provenance`.
+        self._pending_provenance = []
         self.last_row_consumed = False
 
     @property
@@ -458,18 +497,40 @@ class ServiceReader(object):
             if item is None:
                 self.last_row_consumed = True
                 raise StopIteration
-            split_id, chunks = item
+            split_id, chunks, record = item
             self._conn.commit(split_id)
             self._current = list(chunks)
+            self._adopt_provenance(record)
         return self._current.pop(0)
+
+    def _adopt_provenance(self, record):
+        if record is None:
+            return
+        received = record.pop('_received_t', None)
+        now = time.monotonic()
+        if received is not None and now > received:
+            # Time the complete split sat in the client buffer before
+            # the consumer took it — part of the causal chain.
+            record.setdefault('stages', {})['client_buffer'] = [received,
+                                                                now]
+        self._pending_provenance.append(record)
+        del self._pending_provenance[:-64]
+
+    def take_provenance(self):
+        """Provenance records of the splits adopted since the last call
+        (the loader-facing surface `Reader.take_provenance` also has)."""
+        out = list(self._pending_provenance)
+        self._pending_provenance = []
+        return out
 
     # -- exact-checkpoint support -------------------------------------------
 
     def drain_in_flight(self):
         drained = list(self._current)
         self._current = []
-        for split_id, chunks in self._conn.drain_ready():
+        for split_id, chunks, record in self._conn.drain_ready():
             self._conn.commit(split_id)
+            self._adopt_provenance(record)
             drained.extend(chunks)
         return drained
 

@@ -47,7 +47,7 @@ import traceback
 import numpy as np
 
 from petastorm_tpu.errors import ServiceError, ServiceRpcTimeoutError
-from petastorm_tpu.telemetry import MetricsRegistry
+from petastorm_tpu.telemetry import MetricsRegistry, provenance
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +57,11 @@ logger = logging.getLogger(__name__)
 _MAX_SPANS_PER_SPLIT = 2048
 
 _DEFAULT_RPC_TIMEOUT_S = 20.0
+
+#: Zero baseline for per-split cache-outcome classification: a per-split
+#: plane instance's lifetime totals ARE the split's delta.
+_ZERO_CACHE = {'cache_hits': 0, 'cache_ram_hits': 0, 'cache_misses': 0,
+               'cache_degraded': 0}
 
 
 class _Rpc(object):  # ptlint: disable=pickle-unsafe-attrs — one per owning thread; sockets are rebuilt, never shipped
@@ -507,7 +512,7 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     sendq.setdefault(consumer, deque()).append(
                         (header, payload))
                 elif kind == 'end':
-                    _, _, nchunks, nrows, chunk_spans = item
+                    _, _, nchunks, nrows, chunk_spans = item[:5]
                     decoding.discard(split['split_id'])
                     header = {'type': 'end', 'split': split['split_id'],
                               'attempt': split['attempt'],
@@ -517,6 +522,11 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                               # clock via the chained dispatcher offsets
                               # and merges them into its TraceRecorder.
                               'spans': chunk_spans}
+                    if len(item) > 5 and item[5] is not None:
+                        # Per-split provenance record (ISSUE 13): rides
+                        # the end header like the spans; the client
+                        # aligns its stage windows onto its own clock.
+                        header['provenance'] = item[5]
                     sendq.setdefault(consumer, deque()).append((header, None))
                     key = (split['split_id'], split['attempt'])
                     awaiting_ack[key] = split
@@ -689,6 +699,45 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                       'cid': cid})
         return tag, payload
 
+    def _split_record(self, split, stages, serialize_spans, tags, cache,
+                      worker_args=None, sched=None):
+        """Per-split provenance record (ISSUE 13), shipped on the split's
+        ``end`` header next to the spans.  Stage windows are THIS
+        worker's monotonic clock; the client re-aligns them via the
+        chained clock offsets before journaling."""
+        stages = dict(stages)
+        busy_ms = {}
+        for stage, names in (('serialize', ('service/serialize',
+                                            'service/shm_publish')),
+                             ('cache_fill', ('cache/fill',))):
+            windows = [s for s in serialize_spans if s.get('name') in names]
+            if windows:
+                stages[stage] = [min(s['t0'] for s in windows),
+                                 max(s['t1'] for s in windows)]
+                # Per-chunk spans interleave with decode, so the window
+                # is an ENVELOPE spanning most of the split: ship the
+                # summed busy time too, which is what explain's dur_ms /
+                # %-of-wall columns report (the envelope alone would
+                # misattribute the whole split wall to serialization).
+                busy_ms[stage] = round(
+                    1e3 * sum(s['t1'] - s['t0'] for s in windows), 3)
+        transport = None
+        if tags:
+            if tags <= {b'S'}:
+                transport = 'shm'
+            elif b'S' in tags:
+                transport = 'mixed'
+            else:
+                transport = 'bytes'
+        return provenance.make_record(
+            'service', worker_pid=os.getpid(),
+            worker_host=provenance.host(),
+            pieces=provenance.pieces_for_indices(
+                worker_args, split.get('indices') or ()),
+            cache=cache, transport=transport, sched=sched, stages=stages,
+            stage_busy_ms=busy_ms or None,
+            split=int(split['split_id']), attempt=int(split['attempt']))
+
     def _reader_kwargs(self, job):
         """Per-split reader kwargs; with ``job['cache_plane']`` the reader
         consults the shared epoch-cache plane before hitting Parquet —
@@ -794,22 +843,28 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
     _fetcher = None
 
     def _serve_cached_split(self, split, chunks, decode_out, ship_spans,
-                            t0):
+                            t0, cache_outcome='remote_hit'):
         """Stream an entirely-cached split through the normal chunk
         protocol (same serialization, shm fallback matrix, credits, end
         marker, ack/complete flow — only the decode is gone)."""
         seq = 0
         rows = 0
         spans = []
+        tags = set()
         for chunk in chunks:
             cid = '%d/%d' % (split['split_id'], seq)
             tag, payload = self._serialize_split_chunk(split, chunk, cid,
                                                        spans)
+            tags.add(tag)
             rows += len(next(iter(chunk.values())))
             decode_out.put(('chunk', split, seq, tag, payload))
             seq += 1
         t1 = time.monotonic()
         self._m_serve_hist.observe(t1 - t0)
+        record = None
+        if provenance.enabled():
+            record = self._split_record(split, {'serve_cached': [t0, t1]},
+                                        spans, tags, cache_outcome)
         spans.append({'name': 'service/serve_cached_split', 't0': t0,
                       't1': t1, 'pid': os.getpid(),
                       'tid': threading.get_ident(),
@@ -818,7 +873,7 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         if not ship_spans:
             spans = []
         decode_out.put(('end', split, seq, rows,
-                        spans[-_MAX_SPANS_PER_SPLIT:]))
+                        spans[-_MAX_SPANS_PER_SPLIT:], record))
         self._m_rows.inc(rows)
         self._m_splits.inc()
         if self._trace is not None:
@@ -833,6 +888,10 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             t0 = time.monotonic()
             spans = []
             try:
+                prov_on = provenance.enabled()
+                peer_fills_before = (
+                    int(self._m_cluster['cache_peer_fills'].value)
+                    if prov_on else 0)
                 # Cluster cache tier (ISSUE 10): a split the local plane
                 # fully holds (natively or after peer fill) streams
                 # without constructing a reader — no Parquet open, no
@@ -840,8 +899,12 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 chunks, self._fetcher = self._cluster_chunks(split,
                                                              self._fetcher)
                 if chunks is not None:
+                    outcome = 'remote_hit'
+                    if prov_on and int(self._m_cluster[
+                            'cache_peer_fills'].value) > peer_fills_before:
+                        outcome = 'peer_fill'
                     self._serve_cached_split(split, chunks, decode_out,
-                                             ship_spans, t0)
+                                             ship_spans, t0, outcome)
                     continue
                 if self._reader_factory is None:
                     self._reader_factory = self._resolve_factory(job)
@@ -851,6 +914,7 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     **self._reader_kwargs(job))
                 seq = 0
                 rows = 0
+                tags = set()
                 with reader:
                     for item in reader:
                         chunk = (item._asdict() if hasattr(item, '_asdict')
@@ -858,6 +922,7 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                         cid = '%d/%d' % (split['split_id'], seq)
                         tag, payload = self._serialize_split_chunk(
                             split, chunk, cid, spans)
+                        tags.add(tag)
                         rows += len(next(iter(chunk.values())))
                         decode_out.put(('chunk', split, seq, tag, payload))
                         seq += 1
@@ -877,10 +942,22 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     getattr(reader, '_cache', None), 'spans', None)
                 if plane_spans is not None:
                     spans.extend(plane_spans.drain())
+                record = None
+                if prov_on:
+                    # The plane instance is per-split, so its lifetime
+                    # totals ARE this split's cache outcome.
+                    cache_stats = getattr(
+                        getattr(reader, '_cache', None), 'stats', None)
+                    record = self._split_record(
+                        split, {'decode': [t0, t1]}, spans, tags,
+                        provenance.cache_outcome(_ZERO_CACHE, cache_stats),
+                        worker_args=getattr(reader, '_worker_args', None),
+                        sched={'policy': getattr(reader, 'scheduling',
+                                                 None)})
                 if not ship_spans:
                     spans = []
                 decode_out.put(('end', split, seq, rows,
-                                spans[-_MAX_SPANS_PER_SPLIT:]))
+                                spans[-_MAX_SPANS_PER_SPLIT:], record))
                 self._accumulate_cache_stats(reader)
                 if self._cluster is not None and self._cluster.ready():
                     # The per-split reader's plane just published this

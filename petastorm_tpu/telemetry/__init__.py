@@ -36,6 +36,7 @@ catalogue, the verdict catalogue, and scrape examples.
 
 from petastorm_tpu.telemetry import flight  # noqa: F401
 from petastorm_tpu.telemetry import health  # noqa: F401
+from petastorm_tpu.telemetry import provenance  # noqa: F401
 from petastorm_tpu.telemetry.registry import (  # noqa: F401
     MetricsRegistry, hist_quantile, merge_snapshots, snapshot_all,
     snapshot_delta, summarize_hist)
@@ -47,7 +48,7 @@ __all__ = ['MetricsRegistry', 'merge_snapshots', 'hist_quantile',
            'snapshot_all', 'snapshot_delta', 'summarize_hist',
            'SpanBuffer', 'current_buffer', 'merge_into_recorder',
            'measure_clock_offset', 'attribute_stalls', 'dump_state',
-           'flight', 'health']
+           'flight', 'health', 'provenance']
 
 
 def dump_state():
@@ -61,4 +62,7 @@ def dump_state():
     return {'registries': snapshot_all(),
             'trace_events': all_recorder_events(),
             'span_residue': current_buffer().peek(),
-            'flight': flight.dump_current()}
+            'flight': flight.dump_current(),
+            # Per-batch provenance journals (ISSUE 13): the causal
+            # chains `petastorm-tpu-explain --artifact` reconstructs.
+            'provenance': provenance.dump_journals()}

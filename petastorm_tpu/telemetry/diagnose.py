@@ -154,6 +154,9 @@ def evidence_from_flight(dump, window_s=None, stall_pct=None):
                                         window_s=measured),
         'span_residue': newest.get('span_residue'),
         'reason': dump.get('reason'),
+        # Per-batch provenance (ISSUE 13): the newest frame's rolling
+        # worst-K summaries — the refs the slow-batch rule cites.
+        'provenance_worst': newest.get('provenance_worst'),
     }
 
 
@@ -183,6 +186,17 @@ def evidence_from_artifact(artifact, window_s=None):
     evidence['reason'] = artifact.get('reason')
     if evidence.get('span_residue') is None:
         evidence['span_residue'] = len(artifact.get('span_residue') or ())
+    if not evidence.get('provenance_worst'):
+        # Artifact-level journals (telemetry.dump_state ships them in
+        # full): summarize their worst records with the SAME canonical
+        # shape flight frames carry (provenance.summarize_record), so
+        # both ingestion paths cite a slow batch identically.
+        from petastorm_tpu.telemetry.provenance import summarize_record
+        worst = [summarize_record(record)
+                 for journal in artifact.get('provenance') or ()
+                 for record in (journal.get('worst') or ())[:3]]
+        worst.sort(key=lambda row: -(row.get('latency_ms') or 0.0))
+        evidence['provenance_worst'] = worst[:4] or None
     return evidence
 
 
@@ -230,6 +244,18 @@ def _regime_verdicts(evidence):
                 evidence_bits.append(
                     'fleet decode p99 %s ms vs delivery p99 %s ms'
                     % (decode, delivery if delivery is not None else '-'))
+            exemplar = _stage_exemplar(stages, ('decode_split', 'decode',
+                                                'host_batch'))
+            if exemplar is not None:
+                # Tail exemplar (ISSUE 13): the p99 is not anonymous —
+                # it names a journaled batch petastorm-tpu-explain can
+                # reconstruct.
+                evidence_bits.append(
+                    'p99 exemplar: journal step %s (%s ms) — '
+                    'petastorm-tpu-explain --step %s names its '
+                    'file/rowgroup/worker'
+                    % (exemplar['ref'].get('step'), exemplar.get('ms'),
+                       exemplar['ref'].get('step')))
         elif regime == 'link-bound':
             link = _stage_p99(stages, ('h2d_commit', 'h2d_dispatch',
                                        'device_put'))
@@ -279,6 +305,17 @@ def _regime_verdicts(evidence):
             'action': action,
         })
     return verdicts
+
+
+def _stage_exemplar(stages, names):
+    """The first tail exemplar carried by one of the named stage
+    summaries (``summarize_hist`` attaches them when the source
+    histogram recorded any), with a usable ``ref``."""
+    for name in names:
+        exemplar = (stages.get(name) or {}).get('exemplar')
+        if exemplar and isinstance(exemplar.get('ref'), dict):
+            return exemplar
+    return None
 
 
 def _worst_worker(evidence, key):
@@ -359,8 +396,35 @@ def rule_watchdog_reason(evidence):
     }
 
 
+def rule_slow_batches(evidence):
+    """Per-batch provenance (ISSUE 13): when the input carries a
+    journal's rolling worst-K, name the slowest batch and point at
+    ``petastorm-tpu-explain`` — the per-batch causal chain is stronger
+    evidence than any aggregate."""
+    worst = evidence.get('provenance_worst')
+    if not worst:
+        return None
+    head = worst[0]
+    detail = ', '.join(
+        '%s=%s' % (key, head[key])
+        for key in ('worker_pid', 'piece', 'cache', 'transport')
+        if head.get(key) is not None)
+    return {
+        'id': 'slow-batch-provenance', 'severity': 'info',
+        'score': min(1.0, (head.get('latency_ms') or 0.0) / 10000.0),
+        'summary': 'slowest journaled batch: step %s at %s ms'
+                   % (head.get('step'), head.get('latency_ms')),
+        'evidence': 'rolling worst-K from the provenance journal: %s'
+                    % (detail or 'no identity fields recorded'),
+        'action': 'petastorm-tpu-explain --step %s against this '
+                  'artifact reconstructs the full causal chain (stages, '
+                  'worker, file + rowgroup, scheduling decision)'
+                  % head.get('step'),
+    }
+
+
 _RULES = (rule_failed_splits, rule_watchdog_reason, rule_clock_drift,
-          rule_span_residue)
+          rule_span_residue, rule_slow_batches)
 
 
 def run_rules(evidence):

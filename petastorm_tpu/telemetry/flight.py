@@ -38,17 +38,20 @@ heartbeat snapshots (see ``service/dispatcher.py``): same ring, fleet
 scope.
 """
 
-import json
+import fcntl
 import os
+import re
 import threading
 from petastorm_tpu.utils.locks import make_lock
 import time
 
+from petastorm_tpu.telemetry import provenance
 from petastorm_tpu.telemetry.registry import merge_snapshots, snapshot_all
 from petastorm_tpu.telemetry.spans import current_buffer
+from petastorm_tpu.utils import ipc
 
 __all__ = ['FlightRecorder', 'window_frames', 'enable', 'get', 'disable',
-           'dump_current', 'default_persist_path']
+           'dump_current', 'default_persist_path', 'sweep_dumps']
 
 
 def window_frames(frames, seconds=None):
@@ -161,13 +164,21 @@ class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-pro
         fresh = [s for s in pending if s.get('t1', 0.0) > self._span_watermark]
         if fresh:
             self._span_watermark = max(s['t1'] for s in fresh)
-        return {
+        frame = {
             't_mono': time.monotonic(),
             'unix_time': time.time(),
             'snapshot': snapshot,
             'spans': fresh[-_MAX_SPANS_PER_FRAME:],
             'span_residue': len(pending),
         }
+        # Per-batch provenance (ISSUE 13): the rolling worst-K batch
+        # summaries of every live journal — compact refs (step/latency/
+        # worker/piece), never full records, so the bounded ring stays
+        # bounded; the full journals ride `dump()`.
+        worst = provenance.worst_summaries()
+        if worst:
+            frame['provenance_worst'] = worst
+        return frame
 
     # -- thread lifecycle ----------------------------------------------------
 
@@ -190,6 +201,27 @@ class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-pro
 
     def stop(self):
         self._stop.set()
+        # Release the sidecar lock + fd: a stopped recorder must not pin
+        # one fd (and hold LOCK_SH) per enable/persist/disable cycle for
+        # the rest of the process.  The sidecar FILE goes too — an
+        # unlocked .owner left on disk would read as "owner provably
+        # gone" at the next sweep and take the dump of this still-alive
+        # process with it (the sweep only falls back to pid_alive when
+        # no sidecar exists).
+        with self._lock:
+            # Same lock _hold_owner takes: after this block no racing
+            # persist can re-create the sidecar (it sees _stop set).
+            fd, self._owner_fd = self._owner_fd, None
+        if fd is not None:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            if self.persist_path:
+                try:
+                    os.unlink(self.persist_path + '.owner')
+                except OSError:
+                    pass
 
     # -- reading -------------------------------------------------------------
 
@@ -211,7 +243,50 @@ class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-pro
             'started_monotonic': self._started_monotonic,
             'started_unix': self._started_unix,
             'frames': self.frames(),
+            # Full per-batch provenance journals (ISSUE 13): the dump is
+            # unbounded-once (not a ring frame), so the complete causal
+            # chains ship with the crash artifact.
+            'provenance': provenance.dump_journals(),
         }
+
+    _owner_fd = None
+
+    def _hold_owner(self, path):
+        """Lifetime shared flock on ``<path>.owner`` — the liveness
+        signal :func:`sweep_dumps` probes (the ``utils/ipc.py`` idiom:
+        a kernel-released lock is the only signal that survives pid
+        namespaces; the dump itself gets a fresh inode on every atomic
+        replace, so the lock must live on a stable sidecar)."""
+        with self._lock:
+            # Under the lock, re-checking _stop: a stop() racing an
+            # in-flight periodic persist must not let the tick thread
+            # re-create the sidecar (and leak a locked fd) right after
+            # stop() cleaned both up.
+            if self._owner_fd is not None or self._stop.is_set():
+                return
+        fd = None
+        try:
+            fd = os.open(path + '.owner', os.O_CREAT | os.O_RDWR, 0o644)
+            fcntl.flock(fd, fcntl.LOCK_SH | fcntl.LOCK_NB)
+            with self._lock:
+                if self._stop.is_set():
+                    raise OSError('recorder stopped during owner setup')
+                # Held (as an attribute) for the recorder's lifetime;
+                # the kernel releases it on ANY death, SIGKILL included.
+                self._owner_fd = fd
+        except OSError:
+            # Close the fd (no leak) AND remove the unlocked sidecar: an
+            # .owner file with a free flock would later read as "owner
+            # provably gone" and get the LIVE dump swept — the exact
+            # inversion of its purpose.  The name is pid-scoped, so this
+            # never unlinks another process's sidecar.
+            if fd is not None:
+                os.close(fd)
+                try:
+                    os.unlink(path + '.owner')
+                except OSError:
+                    pass
+            self._owner_fd = None
 
     def persist(self, path=None, reason=None):
         """Atomic write of :meth:`dump` (tmp + ``os.replace``).  Returns
@@ -226,13 +301,91 @@ class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-pro
                 state['reason'] = reason
             directory = os.path.dirname(os.path.abspath(path))
             os.makedirs(directory, exist_ok=True)
-            tmp = '%s.%d.tmp' % (path, os.getpid())
-            with open(tmp, 'w') as f:
-                json.dump(state, f, default=str)
-            os.replace(tmp, path)
-            return path
+            self._hold_owner(path)
         except Exception:  # noqa: BLE001 — a failed artifact beats a dead process
             return None
+        # THE one artifact-write idiom (tmp + replace + tmp cleanup).
+        return provenance.atomic_json_dump(path, state)
+
+
+# -- dump-directory hygiene (ISSUE 13 satellite) ------------------------------
+
+#: One dump file per (label, pid): ``flight_<label>_<pid>.json`` plus the
+#: SLO watchdog's ``provenance_slo_<label>_<pid>.json`` twins.
+_DUMP_NAME = re.compile(
+    r'^(?:flight|provenance_slo)_.+_(\d+)\.json(?P<owner>\.owner)?$')
+
+#: tmp residue from `atomic_json_dump` writers killed mid-persist —
+#: scoped to OUR naming scheme, exactly like `_DUMP_NAME`: the sweep
+#: runs automatically (doctor, first enable()) and must never reclaim
+#: third-party ``*.tmp`` files in a shared dump directory.
+_TMP_NAME = re.compile(
+    r'^(?:flight|provenance_slo)_.+\.json\.\d+\.tmp$')
+
+#: Age gate: residue younger than this is never touched — a dump is a
+#: postmortem artifact, and "the process died a minute ago" is exactly
+#: when someone wants to read it.
+DEFAULT_SWEEP_MIN_AGE_S = 24 * 3600.0
+
+
+def sweep_dumps(directory=None, min_age_s=DEFAULT_SWEEP_MIN_AGE_S):
+    """Dead-pid, age-gated sweep of accumulated flight/provenance dumps
+    under ``directory`` (default ``PETASTORM_TPU_FLIGHT_DIR``).
+
+    ``flight_<label>_<pid>.json`` files accumulate forever otherwise
+    (one per process, per run, for the life of the directory).  A dump
+    is reclaimed only when it is older than ``min_age_s`` AND its owner
+    is provably gone: the ``.owner`` sidecar's lifetime flock is free
+    (``utils/ipc.flock_probe_unlink`` — crosses pid namespaces), or,
+    for pre-sidecar dumps, the embedded pid is dead.  Stale ``.tmp``
+    residue from writers killed mid-persist sweeps under the same age
+    gate.  Returns ``{'swept', 'kept', 'tmp_swept'}``; never raises.
+    """
+    directory = directory or os.environ.get('PETASTORM_TPU_FLIGHT_DIR')
+    result = {'swept': 0, 'kept': 0, 'tmp_swept': 0}
+    if not directory or not os.path.isdir(directory):
+        return result
+    now = time.time()
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return result
+    for name in names:
+        path = os.path.join(directory, name)
+        try:
+            age = now - os.stat(path).st_mtime
+        except OSError:
+            continue  # vanished under us (concurrent sweep)
+        if age < min_age_s:
+            if _DUMP_NAME.match(name):
+                result['kept'] += 1
+            continue
+        if name.endswith('.tmp'):
+            if _TMP_NAME.match(name) and ipc.flock_probe_unlink(path):
+                result['tmp_swept'] += 1
+            continue
+        match = _DUMP_NAME.match(name)
+        if not match:
+            continue
+        if match.group('owner'):
+            # Orphaned sidecar (its dump already swept): same probe.
+            if ipc.flock_probe_unlink(path):
+                result['swept'] += 1
+            continue
+        owner = path + '.owner'
+        if os.path.exists(owner):
+            if not ipc.flock_probe_unlink(owner):
+                result['kept'] += 1  # owner lives (maybe another pid ns)
+                continue
+        elif ipc.pid_alive(int(match.group(1))):
+            result['kept'] += 1
+            continue
+        try:
+            os.unlink(path)
+            result['swept'] += 1
+        except OSError:
+            result['kept'] += 1
+    return result
 
 
 # -- process singleton --------------------------------------------------------
@@ -280,6 +433,14 @@ def enable(label=None, interval_s=None, persist_path=None, source=None):
                     interval_s = None
             if persist_path is None:
                 persist_path = default_persist_path(label)
+            if persist_path is not None:
+                # Opportunistic hygiene (ISSUE 13 satellite): the first
+                # recorder of a process reclaims ancient dead-owner
+                # residue so the dump dir stops growing forever.
+                try:
+                    sweep_dumps(os.path.dirname(persist_path))
+                except Exception:  # noqa: BLE001 — hygiene is best-effort
+                    pass
             _RECORDER = FlightRecorder(interval_s=interval_s, label=label,
                                        persist_path=persist_path,
                                        source=source)

@@ -1,0 +1,524 @@
+"""Per-batch provenance plane: end-to-end causal records for delivered
+batches (ISSUE 13).
+
+The telemetry plane answers *aggregate* questions ("decode p99 is
+high"); this module answers the one an operator actually asks at p99:
+**where did THIS batch come from and where did its latency go?**  Every
+delivered batch carries a compact, JSON-able **provenance record** —
+which rowgroups fed it (file + rowgroup + piece index), which worker
+process decoded them (pid + host), what the scheduler decided (FIFO vs
+early-launched, predicted vs actual cost), how the cache answered
+(ram/disk hit, remote hit, peer fill, decode, degraded), which
+transport carried it (shm descriptor vs byte fallback), which transfer
+path shipped it to the device (coalesced / narrowed / inline /
+degraded), and per-stage ``time.monotonic()`` windows (ventilate →
+decode → serialize → IPC → release → h2d stage/dispatch/commit) aligned
+onto the consumer's clock via the existing clock-offset machinery.
+
+Records ride the frames the data plane already has — ProcessPool result
+messages grow a trailing record frame next to the reorder-position
+frame, service split ``end`` headers gain a ``provenance`` field, the
+in-process pools pair records with results at publish time — into a
+bounded per-consumer :class:`ProvenanceJournal` owned by the
+``DataLoader``.  Registry histograms gain **tail exemplars**
+(``registry.Histogram.note_exemplar`` — the loader back-annotates at
+seal time, after the step exists; ``observe(..., exemplar=)`` is the
+one-call variant): top-of-distribution observations keep bounded
+``{'step': N}`` refs into the journal, so a p99 in any diagnostics
+view resolves to the actual file, rowgroup and worker that caused it.
+
+Kill switch: ``PETASTORM_TPU_NO_PROVENANCE=1`` disables every producer
+(no records are built or shipped) and delivery is bit-identical to the
+enabled path — records ride NEXT TO the data (extra frames / header
+fields), never inside it, and no producer ever blocks on provenance
+(the PR 5 piggyback idiom: amortize onto existing frames).
+
+``petastorm-tpu-explain`` (``telemetry/explain.py``) renders the causal
+chain of any journaled batch; :class:`SloWatchdog` auto-dumps the full
+journal when a batch exceeds a per-batch latency budget.
+"""
+
+import json
+import os
+import time
+import weakref
+from collections import deque
+
+from petastorm_tpu.utils.locks import make_lock
+
+__all__ = ['enabled', 'host', 'make_record', 'merge_records',
+           'shift_stages', 'piece_info', 'pieces_for_indices',
+           'cache_stats', 'cache_outcome', 'finalize_delivery',
+           'record_wall', 'atomic_json_dump',
+           'stage_coverage', 'Provenanced', 'ProvenanceJournal',
+           'SloWatchdog', 'journals', 'dump_journals',
+           'worst_summaries', 'summarize_record']
+
+#: Bounded sizes: a record is a piggyback on data-plane frames, so every
+#: list in it has a hard cap.
+MAX_PIECES_PER_RECORD = 32
+MAX_WORKERS_PER_RECORD = 8
+
+#: Every live journal in this process, so flight frames and crash dumps
+#: can carry the rolling worst-K without the loaders registering
+#: anywhere (same pattern as ``registry._LIVE``).
+_LIVE = weakref.WeakSet()
+
+
+def enabled():
+    """The kill switch, read per call so the env toggle works per
+    reader/pool start (matches ``PETASTORM_TPU_NO_SHM`` semantics)."""
+    return os.environ.get('PETASTORM_TPU_NO_PROVENANCE', '') in ('', '0')
+
+
+def atomic_json_dump(path, state):
+    """THE one crash-artifact write (journal persists, SLO dumps, flight
+    persists): tmp + ``os.replace``, tmp unlinked on failure, every
+    error swallowed — an artifact is best-effort by contract, and a
+    failed dump must not leave ``.tmp`` residue for the sweep's 24 h age
+    gate to babysit.  Returns the path, or None."""
+    tmp = None
+    try:
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        tmp = '%s.%d.tmp' % (path, os.getpid())
+        with open(tmp, 'w') as f:
+            json.dump(state, f, default=str)
+        os.replace(tmp, path)
+        return path
+    except Exception:  # noqa: BLE001 — a failed artifact beats a dead process
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return None
+
+
+_HOST = None
+
+
+def host():
+    """This process's hostname, memoized (records carry it per item)."""
+    global _HOST
+    if _HOST is None:
+        import socket
+        try:
+            _HOST = socket.gethostname()
+        except OSError:
+            _HOST = 'unknown'
+    return _HOST
+
+
+def make_record(source, position=None, worker_pid=None, worker_host=None,
+                pieces=None, sched=None, cache=None, transport=None,
+                transfer=None, stages=None, **extra):
+    """One compact provenance record (a plain dict; None fields pruned).
+
+    ``stages`` maps stage name -> ``[t0, t1]`` in the PRODUCER's
+    ``time.monotonic()`` seconds; cross-host consumers re-align them
+    with :func:`shift_stages` using the chained clock offsets the span
+    machinery already computes."""
+    record = {'v': 1, 'source': source}
+    for key, value in (('position', position), ('worker_pid', worker_pid),
+                       ('worker_host', worker_host), ('pieces', pieces),
+                       ('sched', sched), ('cache', cache),
+                       ('transport', transport), ('transfer', transfer)):
+        if value is not None:
+            record[key] = value
+    record['stages'] = dict(stages or {})
+    record.update({k: v for k, v in extra.items() if v is not None})
+    return record
+
+
+def piece_info(worker_args, item_args, limit=MAX_PIECES_PER_RECORD):
+    """``[{'index', 'path', 'row_group'}]`` for a reader work item —
+    best-effort and duck-typed (non-reader workers have no ``pieces``
+    list; their records simply carry no piece names)."""
+    pieces = getattr(worker_args, 'pieces', None)
+    if pieces is None or not item_args:
+        return None
+    try:
+        index = int(item_args[0])
+        piece = pieces[index]
+    except (TypeError, ValueError, IndexError, KeyError):
+        return None
+    return [{'index': index,
+             'path': getattr(piece, 'path', None),
+             'row_group': getattr(piece, 'row_group', None)}][:limit]
+
+
+def pieces_for_indices(worker_args, indices, limit=MAX_PIECES_PER_RECORD):
+    """Piece infos for a list of global piece indices (the service
+    split shape); falls back to index-only entries when the piece list
+    is unavailable (e.g. the readerless cached-serve path)."""
+    out = []
+    pieces = getattr(worker_args, 'pieces', None) or ()
+    for index in list(indices)[:limit]:
+        entry = {'index': int(index)}
+        try:
+            piece = pieces[int(index)]
+            entry['path'] = getattr(piece, 'path', None)
+            entry['row_group'] = getattr(piece, 'row_group', None)
+        except (TypeError, ValueError, IndexError, KeyError):
+            pass
+        out.append(entry)
+    return out or None
+
+
+def cache_stats(worker_args):
+    """Snapshot of the worker cache's counters (the ``CachePlane.stats``
+    shape) for :func:`cache_outcome` deltas — THE one copy all three
+    pools use; None for cache-less workers (NullCache has no stats)."""
+    stats = getattr(getattr(worker_args, 'cache', None), 'stats', None)
+    return dict(stats) if stats else None
+
+
+def cache_outcome(before, after):
+    """Classify one work item's cache interaction from a stats-dict
+    delta (``CachePlane.stats`` shape).  Returns None when no cache was
+    in play (NullCache readers)."""
+    if not before or not after:
+        return None
+    delta = {key: int(after.get(key, 0)) - int(before.get(key, 0))
+             for key in ('cache_hits', 'cache_ram_hits', 'cache_misses',
+                         'cache_degraded')}
+    if delta['cache_degraded'] > 0:
+        return 'degraded'
+    if delta['cache_ram_hits'] > 0:
+        return 'ram_hit'
+    if delta['cache_hits'] > 0:
+        return 'disk_hit'
+    if delta['cache_misses'] > 0:
+        return 'decode'
+    return None
+
+
+def shift_stages(record, offset_s):
+    """Return a copy of ``record`` with every stage window shifted by
+    ``offset_s`` (producer clock -> consumer clock; same sign convention
+    as ``spans.merge_into_recorder``)."""
+    if not offset_s:
+        return record
+    out = dict(record)
+    out['stages'] = {name: [t0 + offset_s, t1 + offset_s]
+                     for name, (t0, t1) in (record.get('stages') or {}).items()}
+    return out
+
+
+def merge_records(records):
+    """Merge the upstream records of one delivered batch (a batch may be
+    fed by several chunks/rowgroups) into ONE record: pieces concatenate
+    (bounded), stage windows union per name (min t0 / max t1), the
+    categorical outcomes keep their value when unanimous and become
+    ``'mixed'`` otherwise."""
+    records = [r for r in records if r]
+    if not records:
+        return make_record('local')
+    merged = make_record(records[0].get('source', 'local'))
+    pieces = []
+    worker_pids = []
+    scheds = []
+    for record in records:
+        for piece in record.get('pieces') or ():
+            if len(pieces) < MAX_PIECES_PER_RECORD:
+                pieces.append(piece)
+        pid = record.get('worker_pid')
+        if pid is not None and pid not in worker_pids \
+                and len(worker_pids) < MAX_WORKERS_PER_RECORD:
+            worker_pids.append(pid)
+        if isinstance(record.get('sched'), dict):
+            scheds.append(record['sched'])
+        for name, busy in (record.get('stage_busy_ms') or {}).items():
+            mine = merged.setdefault('stage_busy_ms', {})
+            mine[name] = round(mine.get(name, 0.0) + busy, 3)
+        for name, window in (record.get('stages') or {}).items():
+            mine = merged['stages'].get(name)
+            merged['stages'][name] = (list(window) if mine is None else
+                                      [min(mine[0], window[0]),
+                                       max(mine[1], window[1])])
+        for key in ('cache', 'transport', 'transfer', 'worker_host'):
+            value = record.get(key)
+            if value is None:
+                continue
+            current = merged.get(key)
+            if current is None:
+                merged[key] = value
+            elif current != value:
+                merged[key] = 'mixed'
+    if scheds:
+        # sched is a DICT, so unanimous-or-'mixed' would change its type
+        # (and crash every dict-shaped consumer): merge field-wise
+        # instead — policy unanimity, any early launch, and the batch's
+        # DOMINANT (max) costs.
+        policies = {s.get('policy') for s in scheds}
+        merged['sched'] = {'policy': (policies.pop() if len(policies) == 1
+                                      else 'mixed')}
+        if any('early' in s for s in scheds):
+            merged['sched']['early'] = any(s.get('early') for s in scheds)
+        for key in ('predicted_cost', 'actual_s'):
+            values = [s[key] for s in scheds if s.get(key) is not None]
+            if values:
+                merged['sched'][key] = max(values)
+    if worker_pids:
+        merged['worker_pid'] = worker_pids[0]
+        if len(worker_pids) > 1:
+            merged['worker_pids'] = worker_pids
+    if pieces:
+        merged['pieces'] = pieces
+    return merged
+
+
+def finalize_delivery(record, ventilator=None):
+    """Parent-side delivery stamp, shared by all three pools: close the
+    ``release`` stage (publish/stage time -> now: queue + reorder wait)
+    and fold in the ventilator's dispatch decision (policy, early-launch,
+    predicted cost, and the ``ventilate`` stage = dispatch -> decode
+    start)."""
+    now = time.monotonic()
+    staged = record.pop('_staged_t', None)
+    stages = record.setdefault('stages', {})
+    if staged is not None and now > staged:
+        stages['release'] = [staged, now]
+    position = record.get('position')
+    take = getattr(ventilator, 'take_dispatch_meta', None)
+    meta = take(position) if (take is not None and position is not None) \
+        else None
+    if meta:
+        t_dispatch = meta.pop('t_dispatch', None)
+        if t_dispatch is not None:
+            decode = stages.get('decode')
+            end = decode[0] if decode else now
+            if end > t_dispatch:
+                stages['ventilate'] = [t_dispatch, end]
+        decode = stages.get('decode')
+        if decode is not None:
+            meta.setdefault('actual_s', round(decode[1] - decode[0], 6))
+        record['sched'] = meta
+    return record
+
+
+def record_wall(record):
+    """Delivery wall of a record in seconds: earliest stage start to
+    latest stage end (0.0 when no stages were recorded)."""
+    stages = record.get('stages') or {}
+    if not stages:
+        return 0.0
+    t0 = min(w[0] for w in stages.values())
+    t1 = max(w[1] for w in stages.values())
+    return max(0.0, t1 - t0)
+
+
+def stage_coverage(record):
+    """Fraction of the record's wall time inside at least one recorded
+    stage (union of the stage intervals / wall) — the acceptance
+    measure for 'the causal chain explains this batch'."""
+    stages = record.get('stages') or {}
+    wall = record_wall(record)
+    if not wall:
+        return 0.0
+    union = []
+    for start, end in sorted(stages.values()):
+        if union and start <= union[-1][1]:
+            union[-1] = (union[-1][0], max(union[-1][1], end))
+        else:
+            union.append((start, end))
+    covered = sum(end - start for start, end in union)
+    return min(1.0, covered / wall)
+
+
+class Provenanced(object):
+    """In-process (result, record) pairing: the thread/dummy pools wrap
+    published results so delivery in ``get_results`` pairs each result
+    with exactly its record — no position bookkeeping, no race between
+    publish and ack."""
+
+    __slots__ = ('result', 'record')
+
+    def __init__(self, result, record):
+        self.result = result
+        self.record = record
+
+
+class ProvenanceJournal(object):
+    """Bounded per-consumer journal of sealed provenance records.
+
+    ``seal`` stamps a monotonically increasing ``step`` (the delivered-
+    batch index) and ``latency_ms`` (:func:`record_wall`), appends to a
+    bounded ring, and maintains a rolling worst-K by latency that
+    SURVIVES ring eviction — the slowest batch of the run stays
+    explainable even hours later.  Thread-safe: the dispatch pump seals
+    from its own thread while flight frames peek from the tick thread.
+    """
+
+    def __init__(self, capacity=512, worst_k=8, label=None):
+        self._records = deque(maxlen=int(capacity))
+        self._worst = []          # [(latency_ms, record)], ascending
+        self._worst_k = int(worst_k)
+        self._step = 0
+        self.label = label
+        self._lock = make_lock(
+            'telemetry.provenance.ProvenanceJournal._lock')
+        _LIVE.add(self)
+
+    # Journals are per-consumer state; shipping one ships its records.
+    def __getstate__(self):
+        return {'capacity': self._records.maxlen, 'worst_k': self._worst_k,
+                'label': self.label, 'records': self.records(),
+                'worst': self.worst()}
+
+    def __setstate__(self, state):
+        self.__init__(state['capacity'], state['worst_k'], state['label'])
+        self._records.extend(state['records'])
+        self._worst = sorted(
+            ((r.get('latency_ms', 0.0), r) for r in state['worst']),
+            key=lambda pair: pair[0])
+        self._step = max((r.get('step', -1)
+                          for r in state['records']), default=-1) + 1
+
+    def seal(self, record):
+        """Stamp + journal one delivered batch's record; returns it."""
+        with self._lock:
+            record['step'] = self._step
+            self._step += 1
+            record['latency_ms'] = round(1e3 * record_wall(record), 3)
+            record['sealed_unix'] = round(time.time(), 3)
+            self._records.append(record)
+            self._worst.append((record['latency_ms'], record))
+            self._worst.sort(key=lambda pair: pair[0])
+            del self._worst[:-self._worst_k]
+        return record
+
+    def get(self, step):
+        """The record of delivered batch ``step``, or None when it aged
+        out of both the ring and the worst-K."""
+        with self._lock:
+            for record in self._records:
+                if record.get('step') == step:
+                    return record
+            for _, record in self._worst:
+                if record.get('step') == step:
+                    return record
+        return None
+
+    def records(self):
+        with self._lock:
+            return list(self._records)
+
+    def worst(self, k=None):
+        """The rolling worst-K records, most expensive first."""
+        with self._lock:
+            worst = [record for _, record in reversed(self._worst)]
+        return worst if k is None else worst[:int(k)]
+
+    def worst_summary(self, k=3):
+        """Compact JSON-able worst-K lines for flight frames (full
+        records would bloat the bounded ring)."""
+        return [summarize_record(record) for record in self.worst(k)]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._records)
+
+    def dump(self):
+        """JSON-able dump — the shape ``petastorm-tpu-explain --journal``
+        reads (and the SLO watchdog / ``telemetry.dump_state`` write)."""
+        return {'kind': 'provenance_journal', 'pid': os.getpid(),
+                'label': self.label, 'steps': self._step,
+                'records': self.records(), 'worst': self.worst()}
+
+    def persist(self, path):
+        """Atomic best-effort write of :meth:`dump`."""
+        return atomic_json_dump(path, self.dump())
+
+
+def summarize_record(record):
+    """THE compact one-line summary of a record — flight frames, the
+    diagnose slow-batch rule, and any other worst-K surface all use
+    this shape, so the same slow batch can never be cited two different
+    ways downstream."""
+    piece = (record.get('pieces') or [{}])[0]
+    return {
+        'step': record.get('step'),
+        'latency_ms': record.get('latency_ms'),
+        'worker_pid': record.get('worker_pid'),
+        'piece': ('%s:rg%s' % (piece.get('path'), piece.get('row_group'))
+                  if piece.get('path') is not None else
+                  piece.get('index')),
+        'cache': record.get('cache'),
+        'transport': record.get('transport'),
+    }
+
+
+def journals():
+    """Every live journal in this process."""
+    return list(_LIVE)
+
+
+def dump_journals():
+    """Dumps of every live journal (crash artifacts, flight persists)."""
+    return [journal.dump() for journal in journals()]
+
+
+def worst_summaries(k=4):
+    """Rolling worst-K summaries across every live journal — the compact
+    payload flight frames carry."""
+    out = []
+    for journal in journals():
+        out.extend(journal.worst_summary(k))
+    out.sort(key=lambda row: -(row.get('latency_ms') or 0.0))
+    return out[:int(k)]
+
+
+class SloWatchdog(object):
+    """Per-batch latency SLO: when a sealed record exceeds the budget,
+    dump the FULL journal (the whole causal chain, not just the
+    violation) to a crash-artifact file ``petastorm-tpu-explain`` reads.
+
+    Dumps are rate-limited (one per ``min_interval_s``) so a
+    persistently over-budget pipeline produces a rolling artifact, not
+    an fsync storm; every violation still counts in ``metrics``
+    (``slo_violations``)."""
+
+    def __init__(self, journal, budget_s, label=None, dump_dir=None,
+                 min_interval_s=30.0, metrics=None):
+        self.journal = journal
+        self.budget_s = float(budget_s)
+        self.label = label or 'loader'
+        self._dump_dir = dump_dir
+        self._min_interval_s = float(min_interval_s)
+        self._last_dump = 0.0
+        self.violations = 0
+        self._m_violations = (metrics.counter('slo_violations')
+                              if metrics is not None else None)
+
+    def _dump_path(self):
+        directory = (self._dump_dir
+                     or os.environ.get('PETASTORM_TPU_FLIGHT_DIR'))
+        if not directory:
+            return None
+        return os.path.join(directory, 'provenance_slo_%s_%d.json'
+                            % (self.label, os.getpid()))
+
+    def check(self, record):
+        """Called per sealed record; returns the artifact path when a
+        violation was dumped, else None.  Never raises, never blocks the
+        delivery path on I/O beyond the rate-limited dump."""
+        latency_ms = record.get('latency_ms') or 0.0
+        if latency_ms <= 1e3 * self.budget_s:
+            return None
+        self.violations += 1
+        if self._m_violations is not None:
+            self._m_violations.inc()
+        now = time.monotonic()
+        if now - self._last_dump < self._min_interval_s:
+            return None
+        self._last_dump = now
+        path = self._dump_path()
+        if path is None:
+            return None
+        state = self.journal.dump()
+        state['violation_step'] = record.get('step')
+        state['budget_ms'] = round(1e3 * self.budget_s, 3)
+        state['reason'] = 'slo_violation'
+        return atomic_json_dump(path, state)

@@ -41,6 +41,11 @@ def ms(seconds):
 #: absorbs sub-µs observations, the last bucket absorbs the tail.
 BUCKETS = 44
 
+#: Tail exemplars kept per histogram (ISSUE 13): the worst observations
+#: carry bounded refs (e.g. ``{'step': N}`` into a provenance journal),
+#: so a p99 read anywhere resolves to the batch that caused it.
+EXEMPLARS_KEPT = 4
+
 #: Every live registry, so a crash dump (`telemetry.dump_state`) can
 #: report the whole process without the subsystems registering anywhere.
 _LIVE = weakref.WeakSet()
@@ -75,23 +80,51 @@ class Gauge(object):
 
 
 class Histogram(object):
-    """Fixed log2-bucket latency histogram; merge = bucket addition."""
+    """Fixed log2-bucket latency histogram; merge = bucket addition.
 
-    __slots__ = ('_lock', 'counts', 'sum', 'count')
+    ``observe(..., exemplar=ref)`` additionally maintains **tail
+    exemplars** (ISSUE 13): the :data:`EXEMPLARS_KEPT` slowest observed
+    samples keep their ref (a small JSON-able dict, e.g. ``{'step': N}``
+    pointing into a provenance journal) so the top bucket is never
+    anonymous.  Exemplars ride snapshots and re-rank on merge; they are
+    evidence refs, not counts, so merging keeps the worst K rather than
+    adding."""
+
+    __slots__ = ('_lock', 'counts', 'sum', 'count', 'exemplars')
 
     def __init__(self, lock):
         self._lock = lock
         self.counts = [0] * BUCKETS
         self.sum = 0.0
         self.count = 0
+        self.exemplars = []
 
-    def observe(self, seconds):
+    def observe(self, seconds, exemplar=None):
         us = seconds * 1e6
         index = 0 if us < 1.0 else min(BUCKETS - 1, int(math.log2(us)))
         with self._lock:
             self.counts[index] += 1
             self.sum += seconds
             self.count += 1
+            if exemplar is not None:
+                self._note_exemplar_locked(index, seconds, exemplar)
+
+    def note_exemplar(self, seconds, ref):
+        """Attach a tail-exemplar ref WITHOUT counting an observation —
+        for surfaces whose sample was observed earlier, before its
+        journal step existed (the loader observes per stage, then seals
+        the batch record and back-annotates)."""
+        us = seconds * 1e6
+        index = 0 if us < 1.0 else min(BUCKETS - 1, int(math.log2(us)))
+        with self._lock:
+            self._note_exemplar_locked(index, seconds, ref)
+
+    def _note_exemplar_locked(self, index, seconds, ref):
+        self.exemplars.append({'bucket': index,
+                               'seconds': round(seconds, 6),
+                               'ref': ref})
+        self.exemplars.sort(key=lambda e: e['seconds'])
+        del self.exemplars[:-EXEMPLARS_KEPT]
 
     def quantile(self, q):
         """Bucket-upper-bound estimate of quantile ``q`` in SECONDS (None
@@ -154,9 +187,7 @@ class MetricsRegistry(object):
                 'counters': {k: c.value for k, c in self._counters.items()},
                 'gauges': {k: g.value for k, g in self._gauges.items()},
                 'histograms': {
-                    k: {'counts': list(h.counts), 'sum': h.sum,
-                        'count': h.count}
-                    for k, h in self._histograms.items()},
+                    k: _hist_dict(h) for k, h in self._histograms.items()},
             }
 
     def merge(self, snapshot):
@@ -176,6 +207,10 @@ class MetricsRegistry(object):
                         mine.counts[i] += n
                 mine.sum += hist.get('sum', 0.0)
                 mine.count += hist.get('count', 0)
+                incoming = hist.get('exemplars')
+                if incoming:
+                    mine.exemplars = _merge_exemplars(
+                        [mine.exemplars, incoming])
 
     # -- views ---------------------------------------------------------------
 
@@ -224,6 +259,25 @@ class MetricsRegistry(object):
         return '\n'.join(lines) + '\n'
 
 
+def _hist_dict(hist):
+    """Plain-dict snapshot of one Histogram; 'exemplars' rides only when
+    present so pre-ISSUE-13 snapshot shapes stay unchanged."""
+    out = {'counts': list(hist.counts), 'sum': hist.sum,
+           'count': hist.count}
+    if hist.exemplars:
+        out['exemplars'] = list(hist.exemplars)
+    return out
+
+
+def _merge_exemplars(exemplar_lists):
+    """Worst-:data:`EXEMPLARS_KEPT` across exemplar lists, ascending by
+    seconds (the Histogram-internal order) — exemplars are evidence
+    refs, so merging re-ranks instead of adding."""
+    merged = [e for exemplars in exemplar_lists for e in exemplars or ()]
+    merged.sort(key=lambda e: e.get('seconds', 0.0))
+    return merged[-EXEMPLARS_KEPT:]
+
+
 def _sanitize(name):
     return ''.join(c if (c.isalnum() or c == '_') else '_' for c in name)
 
@@ -256,6 +310,9 @@ def merge_snapshots(snapshots):
                     mine['counts'][i] += n
             mine['sum'] += hist.get('sum', 0.0)
             mine['count'] += hist.get('count', 0)
+            if hist.get('exemplars'):
+                mine['exemplars'] = _merge_exemplars(
+                    [mine.get('exemplars'), hist['exemplars']])
     return merged
 
 
@@ -294,6 +351,14 @@ def summarize_hist(hist):
         if counts[i]:
             out['max_ms'] = ms((2.0 ** (i + 1)) / 1e6)
             break
+    exemplars = hist.get('exemplars')
+    if exemplars:
+        # The worst observation's evidence ref (ISSUE 13) — present only
+        # when the source histogram recorded exemplars, so pre-existing
+        # summary consumers see the exact historical shape.
+        worst = exemplars[-1]
+        out['exemplar'] = {'ref': worst.get('ref'),
+                           'ms': ms(worst.get('seconds'))}
     return out
 
 
@@ -325,6 +390,14 @@ def snapshot_delta(new, old):
             'sum': max(0.0, hist.get('sum', 0.0) - prev.get('sum', 0.0)),
             'count': max(0, hist.get('count', 0) - prev.get('count', 0)),
         }
+        fresh = [e for e in hist.get('exemplars') or ()
+                 if e not in (prev.get('exemplars') or ())]
+        if fresh:
+            # Exemplars are refs, not counts: a delta keeps only the
+            # refs that APPEARED in this window — the cumulative worst-K
+            # would cite an hours-stale batch as the window's p99
+            # evidence.
+            out['histograms'][name]['exemplars'] = fresh
     return out
 
 

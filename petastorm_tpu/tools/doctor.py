@@ -439,6 +439,13 @@ def _check_telemetry():
         out['flight_frames'] = len(recorder.frames())
         out['flight_persist_path'] = recorder.persist_path
     out['flight_dir_env'] = os.environ.get('PETASTORM_TPU_FLIGHT_DIR')
+    if out['flight_dir_env']:
+        # Flight-dump hygiene (ISSUE 13 satellite): dead-pid, age-gated
+        # sweep of accumulated flight_*/provenance_slo_* dumps — the
+        # doctor both reclaims and REPORTS the residue, so an operator
+        # sees how much a long-lived dump dir had rotted.
+        out['flight_residue'] = telemetry.flight.sweep_dumps(
+            out['flight_dir_env'])
     # peek, never drain: run_doctor() is importable from a LIVE process,
     # and consuming its pending spans would steal them from the real
     # drain channel.  The buffer is bounded, so reporting is enough.

@@ -5,10 +5,12 @@ work items execute lazily inside ``get_results``, one at a time, in
 ventilation order.
 """
 
+import os
 import time
 from collections import deque
 
-from petastorm_tpu.telemetry import MetricsRegistry
+from petastorm_tpu.telemetry import MetricsRegistry, provenance
+from petastorm_tpu.telemetry.provenance import Provenanced
 from petastorm_tpu.workers_pool import EmptyResultError, VentilatedItem
 
 
@@ -30,6 +32,11 @@ class DummyPool(object):
         self._m_decode = self.metrics.histogram('decode')
         self._started_at = None
         self._stopped_at = None
+        #: Per-batch provenance plane (ISSUE 13).
+        self.provenance_out = deque(maxlen=256)
+        self._prov_on = False
+        self._worker_setup_args = None
+        self._prov_ctx = None   # (started, item_args, cache_before)
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None,
               reorder=None):
@@ -37,6 +44,8 @@ class DummyPool(object):
         self._ventilator = ventilator
         self._reorder = reorder
         self._position = None
+        self._prov_on = provenance.enabled()
+        self._worker_setup_args = worker_setup_args
         self._started_at = time.monotonic()
         if ventilator is not None:
             ventilator.start()
@@ -44,6 +53,21 @@ class DummyPool(object):
     def _publish(self, result):
         # Single-threaded pool, but an out-of-order dispatch policy still
         # needs the reorder stage to restore epoch-order delivery.
+        if self._prov_on and self._prov_ctx is not None:
+            started, item_args, cache_before = self._prov_ctx
+            now = time.monotonic()
+            record = provenance.make_record(
+                'pool', position=self._position, worker_pid=os.getpid(),
+                worker_host=provenance.host(),
+                pieces=provenance.piece_info(self._worker_setup_args,
+                                             item_args),
+                cache=provenance.cache_outcome(
+                    cache_before,
+                    provenance.cache_stats(self._worker_setup_args)),
+                transport='inline',
+                stages={'decode': [started, now]})
+            record['_staged_t'] = now
+            result = Provenanced(result, record)
         if self._reorder is not None and self._position is not None:
             self._reorder.add(self._position, result)
             return
@@ -62,11 +86,15 @@ class DummyPool(object):
                     position, args = args[0].position, tuple(args[0].args)
                 self._position = position
                 started = time.monotonic()
+                if self._prov_on:
+                    self._prov_ctx = (started, args, provenance.cache_stats(
+                        self._worker_setup_args))
                 sleep_before = getattr(self._worker, 'retry_sleep_s', 0.0)
                 try:
                     self._worker.process(*args, **kwargs)
                 finally:
                     self._position = None
+                    self._prov_ctx = None
                 slept = getattr(self._worker, 'retry_sleep_s', 0.0) - sleep_before
                 elapsed = max(0.0, time.monotonic() - started - slept)
                 self._m_busy.inc(elapsed)
@@ -93,7 +121,19 @@ class DummyPool(object):
                 time.sleep(0.001)
             else:
                 raise EmptyResultError()
-        return self._results.popleft()
+        result = self._results.popleft()
+        if isinstance(result, Provenanced):
+            self.provenance_out.append(provenance.finalize_delivery(
+                result.record, self._ventilator))
+            result = result.result
+        return result
+
+    def take_provenance(self):
+        """Provenance records of results delivered since the last call
+        (delivery order; empty under the kill switch)."""
+        out = list(self.provenance_out)
+        self.provenance_out.clear()
+        return out
 
     def stop(self):
         self._stopped = True

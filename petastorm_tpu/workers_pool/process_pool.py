@@ -29,7 +29,9 @@ import uuid
 from collections import deque
 
 from petastorm_tpu.telemetry import (MetricsRegistry, hist_quantile,
-                                     merge_into_recorder, merge_snapshots)
+                                     merge_into_recorder, merge_snapshots,
+                                     provenance)
+from petastorm_tpu.telemetry.provenance import Provenanced
 from petastorm_tpu.telemetry.registry import ms as _ms
 from petastorm_tpu.workers_pool import (DEFAULT_TIMEOUT_S, EmptyResultError,
                                         TimeoutWaitingForResultError, VentilatedItem)
@@ -77,6 +79,9 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
         #: position and serves ``_ready`` in exact epoch order.
         self._reorder = None
         self._ready = deque()
+        #: Per-batch provenance (ISSUE 13): child records ride a trailing
+        #: result frame; delivery order here matches result delivery.
+        self.provenance_out = deque(maxlen=256)
         self._inflight = 0
         self._started_at = None
         self._stopped_at = None
@@ -156,7 +161,7 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
         while True:
             if self._ready:
                 # reorder stage: results released in epoch order by acks
-                return self._ready.popleft()
+                return self._deliver(self._ready.popleft())
             events = dict(poller.poll(50))
             if self._sink_socket in events:
                 frames = self._sink_socket.recv_multipart()
@@ -165,12 +170,12 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
                     result = self._pickle_ser.deserialize(payload)
                     if self._stage_result(frames, result):
                         continue
-                    return result
+                    return self._deliver(self._wrap_prov(frames, result))
                 if tag == b'A':
                     result = self._arrow_ser.deserialize(payload)
                     if self._stage_result(frames, result):
                         continue
-                    return result
+                    return self._deliver(self._wrap_prov(frames, result))
                 if tag in (b'P', b'T'):
                     # shm plane: payload is a descriptor; the worker's
                     # slab maps zero-copy and returns to the worker when
@@ -194,7 +199,7 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
                     self._m_shm_results.inc()
                     if self._stage_result(frames, result):
                         continue
-                    return result
+                    return self._deliver(self._wrap_prov(frames, result))
                 if tag == b'K':
                     ack = pickle.loads(payload)
                     position, busy_s = ack[0], ack[1]
@@ -244,16 +249,46 @@ class ProcessPool(object):  # ptlint: disable=pickle-unsafe-attrs — parent-sid
                        sum(p.poll() is None for p in self._processes),
                        len(self._processes)))
 
+    def _wrap_prov(self, frames, result):
+        """Pair a result with its provenance record (the trailing frame
+        the child appends when provenance is on; see process_worker's
+        framing note) — delivered results unwrap in :meth:`_deliver`."""
+        if len(frames) < 4:
+            return result
+        try:
+            record = pickle.loads(frames[3])
+        except Exception:  # noqa: BLE001 — provenance is never load-bearing
+            return result
+        return Provenanced(result, record) if record else result
+
+    def _deliver(self, result):
+        """Unwrap a provenance-paired result at delivery, stamping the
+        release stage + dispatch decision and queuing the record for
+        ``take_provenance``."""
+        if isinstance(result, Provenanced):
+            self.provenance_out.append(provenance.finalize_delivery(
+                result.record, self._ventilator))
+            result = result.result
+        return result
+
+    def take_provenance(self):
+        """Provenance records of results delivered since the last call
+        (delivery order; empty under the kill switch)."""
+        out = list(self.provenance_out)
+        self.provenance_out.clear()
+        return out
+
     def _stage_result(self, frames, result):
         """Route a positioned result into the reorder buffer (frame 3 is
-        the pickled position, present only when the child was started
-        with reordering on).  Returns True when staged."""
+        the pickled position, appended by children in reorder mode — and
+        whenever provenance is on, which _wrap_prov/_deliver consume).
+        Returns True when staged."""
         if self._reorder is None or len(frames) < 3:
             return False
         position = pickle.loads(frames[2])
         if position is None:
             return False
-        self._reorder.add(position, result)
+        self._reorder.add(position, self._wrap_prov(frames, result))
         return True
 
     def _all_done(self):

@@ -28,6 +28,14 @@ The shm tags are best-effort per message: a small result, a full arena
 (parent consuming slowly), or an unavailable ``/dev/shm`` degrade that
 message to the matching byte tag — the parent speaks all four framings
 at all times.
+
+Result messages optionally grow trailing frames: a pickled POSITION
+frame (reorder delivery, ISSUE 9 — also present whenever provenance is
+on, so the parent can pair records with results) and a pickled
+PROVENANCE RECORD frame (ISSUE 13: pieces, worker pid/host, cache
+outcome, transport, decode/ipc stage windows).  Both are killed by
+``PETASTORM_TPU_NO_PROVENANCE=1`` / reorder-off respectively; payload
+frames are byte-identical either way.
 """
 
 import os
@@ -64,6 +72,15 @@ def worker_main(setup_payload, worker_id):
     # configured; costs nothing on the ack path (2 s daemon tick).
     telemetry.flight.enable(label='pool_worker')
     current_position = [None]
+    # Per-batch provenance (ISSUE 13): each result message grows a
+    # position frame + a compact record frame when enabled — the kill
+    # switch (PETASTORM_TPU_NO_PROVENANCE=1) keeps the legacy framing
+    # and delivery bit-identical.
+    prov_on = telemetry.provenance.enabled()
+    current_started = [None]
+    current_args = [None]
+    current_publish_t = [None]
+    cache_before = [None]
 
     context = zmq.Context()
     work_socket = context.socket(zmq.PULL)
@@ -82,15 +99,39 @@ def worker_main(setup_payload, worker_id):
 
     def publish(result):
         t_pub = time.monotonic()
+        current_publish_t[0] = t_pub
         try:
             _publish(result)
         finally:
             spans.span('pool/publish', t_pub, time.monotonic(),
                        cid=current_position[0])
 
-    def _send(frames, **kwargs):
-        if reorder:
+    def _cache_stats():
+        # the reader workers hang their WorkerArgs dataclass on `_a`
+        return telemetry.provenance.cache_stats(getattr(worker, '_a', None))
+
+    def _send(frames, transport=None, **kwargs):
+        # Positioned framing: reorder mode needs the position to restore
+        # epoch order; provenance (ISSUE 13) needs it to pair the record
+        # with its result at the parent — either one appends the frame.
+        if reorder or prov_on:
             frames = frames + [pickle.dumps(current_position[0], protocol=4)]
+        if prov_on:
+            prov = telemetry.provenance
+            now = time.monotonic()
+            t_pub = current_publish_t[0] or now
+            stages = {'ipc': [t_pub, now]}
+            if current_started[0] is not None:
+                stages['decode'] = [current_started[0], t_pub]
+            record = prov.make_record(
+                'pool', position=current_position[0],
+                worker_pid=os.getpid(), worker_host=prov.host(),
+                pieces=prov.piece_info(getattr(worker, '_a', None),
+                                       current_args[0]),
+                cache=prov.cache_outcome(cache_before[0], _cache_stats()),
+                transport=transport, stages=stages)
+            record['_staged_t'] = now
+            frames = frames + [pickle.dumps(record, protocol=4)]
         sink_socket.send_multipart(frames, **kwargs)
 
     def _publish(result):
@@ -98,16 +139,20 @@ def worker_main(setup_payload, worker_id):
             if arena is not None:
                 desc = shm_plane.write_table(arena, result, arrow_ser)
                 if desc is not None:
-                    _send([b'T', pickle.dumps(desc, protocol=4)])
+                    _send([b'T', pickle.dumps(desc, protocol=4)],
+                          transport='shm')
                     return
-            _send([b'A', arrow_ser.serialize(result)], copy=copy_buffers)
+            _send([b'A', arrow_ser.serialize(result)], transport='bytes',
+                  copy=copy_buffers)
         else:
             if arena is not None:
                 desc = shm_plane.write_pickled(arena, result, pickle_ser)
                 if desc is not None:
-                    _send([b'P', pickle.dumps(desc, protocol=4)])
+                    _send([b'P', pickle.dumps(desc, protocol=4)],
+                          transport='shm')
                     return
-            _send([b'R', pickle_ser.serialize(result)], copy=copy_buffers)
+            _send([b'R', pickle_ser.serialize(result)], transport='bytes',
+                  copy=copy_buffers)
 
     import time
 
@@ -151,6 +196,11 @@ def worker_main(setup_payload, worker_id):
             position, args, kwargs = pickle.loads(frames[0])
             current_position[0] = position
             started = time.monotonic()
+            if prov_on:
+                current_started[0] = started
+                current_args[0] = args
+                current_publish_t[0] = None
+                cache_before[0] = _cache_stats()
             sleep_before = getattr(worker, 'retry_sleep_s', 0.0)
             try:
                 worker.process(*args, **kwargs)

@@ -178,6 +178,9 @@ class FifoDispatchPolicy(object):
     """The legacy order: epoch permutation, front to back."""
 
     adaptive = False
+    #: Per-batch provenance (ISSUE 13): the ventilator snapshots this
+    #: after every next(); FIFO has no decision to record.
+    last_dispatch_meta = None
 
     def begin_epoch(self, order, base_position, start_cursor):
         self._order = order
@@ -239,6 +242,10 @@ class AdaptiveDispatchPolicy(object):
         #: consumer overlap) until the first one lands — some of the
         #: pool must keep serving the in-order fast stream.
         self.early_limit = early_limit
+        #: Last dispatch's decision, snapshotted by the ventilator into
+        #: the position's provenance record (ISSUE 13).  Read under the
+        #: same dispatch lock next() runs under.
+        self.last_dispatch_meta = None
 
     @staticmethod
     def _piece_key(item):
@@ -278,6 +285,7 @@ class AdaptiveDispatchPolicy(object):
         if not self._pending:
             return None
         oldest = min(self._pending)
+        early_pick = False
         # early slow pieces stop counting once the in-order stream has
         # caught up to them (their delivery turn is imminent)
         self._early = {s for s in self._early if s > oldest}
@@ -303,13 +311,22 @@ class AdaptiveDispatchPolicy(object):
                 idx = slow[-1]
                 if idx != oldest:
                     self._early.add(idx)
+                    early_pick = True
             else:
                 # exact epoch order — the fast-backfill stream
                 idx = oldest
         item = self._pending.pop(idx)
         self._entered.pop(idx, None)
-        self._costs.pop(idx, None)
+        predicted = self._costs.pop(idx, None)
         self._seq += 1
+        self.last_dispatch_meta = {'policy': 'adaptive',
+                                   'early': early_pick,
+                                   # relative cost (seconds once observed,
+                                   # size-prior units before): predictions
+                                   # only RANK pieces, see PieceCostModel
+                                   'predicted_cost': (round(predicted, 6)
+                                                      if predicted is not None
+                                                      else None)}
         return self._base + idx, item
 
     def oldest_undispatched_idx(self):

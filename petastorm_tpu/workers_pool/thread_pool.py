@@ -9,13 +9,16 @@ thread pool saturates host cores without ProcessPool serialization overhead —
 this is the recommended pool on TPU-VM hosts (see SURVEY.md §7 stage 9).
 """
 
+import os
 import queue
 import sys
 import threading
 from petastorm_tpu.utils.locks import make_lock
 import time
+from collections import deque
 
-from petastorm_tpu.telemetry import MetricsRegistry
+from petastorm_tpu.telemetry import MetricsRegistry, provenance
+from petastorm_tpu.telemetry.provenance import Provenanced
 from petastorm_tpu.telemetry.registry import ms as _ms
 from petastorm_tpu.workers_pool import (DEFAULT_TIMEOUT_S, EmptyResultError,
                                         TimeoutWaitingForResultError, VentilatedItem)
@@ -62,11 +65,20 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
         self._started_at = None
         self._stopped_at = None
         self._profiler = profiler
+        #: Per-batch provenance plane (ISSUE 13): records of delivered
+        #: results in delivery order, drained by Reader.take_provenance.
+        self.provenance_out = deque(maxlen=256)
+        self._prov_on = False
+        self._worker_setup_args = None
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None,
               reorder=None):
         self._ventilator = ventilator
         self._reorder = reorder
+        # Resolved per start() (like the shm toggle) so the env kill
+        # switch works per reader.
+        self._prov_on = provenance.enabled()
+        self._worker_setup_args = worker_setup_args
         self._started_at = time.monotonic()
         for worker_id in range(self.workers_count):
             worker = worker_class(worker_id, self._publish, worker_setup_args)
@@ -90,10 +102,36 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
         # here — the processing loop's except path puts _WorkerError on
         # the queue directly, preempting delivery as on the legacy path.
         position = getattr(self._tls, 'position', None)
+        record = self._make_record(position)
+        if record is not None:
+            result = Provenanced(result, record)
         if self._reorder is not None and position is not None:
             self._reorder.add(position, result)
             return
         self._put_result(result)
+
+    def _make_record(self, position):
+        """Provenance record of the result being published, built AT
+        publish time (all decode work for this publish is done; only the
+        ack bookkeeping remains) so delivery pairing is exact."""
+        if not self._prov_on:
+            return None
+        now = time.monotonic()
+        started = getattr(self._tls, 'prov_started', None)
+        record = provenance.make_record(
+            'pool', position=position, worker_pid=os.getpid(),
+            worker_host=provenance.host(),
+            pieces=provenance.piece_info(self._worker_setup_args,
+                                         getattr(self._tls, 'item_args',
+                                                 None)),
+            cache=provenance.cache_outcome(
+                getattr(self._tls, 'cache_before', None),
+                provenance.cache_stats(self._worker_setup_args)),
+            transport='inline',
+            stages=({'decode': [started, now]} if started is not None
+                    else {}))
+        record['_staged_t'] = now
+        return record
 
     def _put_result(self, result):
         # Bounded put that stays responsive to stop(): a worker blocked on a
@@ -120,6 +158,15 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
                     position, args = args[0].position, tuple(args[0].args)
                 self._tls.position = position
                 started = time.monotonic()
+                if self._prov_on:
+                    # Per-item provenance context: decode start, the work
+                    # item (for piece identity) and the cache counters
+                    # before the item (best-effort under a shared cache:
+                    # concurrent threads' traffic can blur the delta).
+                    self._tls.prov_started = started
+                    self._tls.item_args = args
+                    self._tls.cache_before = provenance.cache_stats(
+                        self._worker_setup_args)
                 sleep_before = getattr(worker, 'retry_sleep_s', 0.0)
                 try:
                     worker.process(*args, **kwargs)
@@ -182,7 +229,18 @@ class ThreadPool(object):  # ptlint: disable=pickle-unsafe-attrs — in-process 
             if isinstance(result, _WorkerError):
                 sys.stderr.write(result.tb_str)
                 raise result.exc
+            if isinstance(result, Provenanced):
+                self.provenance_out.append(provenance.finalize_delivery(
+                    result.record, self._ventilator))
+                result = result.result
             return result
+
+    def take_provenance(self):
+        """Provenance records of results delivered since the last call
+        (delivery order; empty under the kill switch)."""
+        out = list(self.provenance_out)
+        self.provenance_out.clear()
+        return out
 
     def _all_done(self):
         if self._ventilator is not None and not self._ventilator.completed():

@@ -22,8 +22,11 @@ import logging
 import threading
 from petastorm_tpu.utils.locks import make_condition, make_lock
 
+import time
+
 import numpy as np
 
+from petastorm_tpu.telemetry import provenance
 from petastorm_tpu.workers_pool import VentilatedItem
 from petastorm_tpu.workers_pool.scheduling import FifoDispatchPolicy
 
@@ -122,6 +125,13 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
         #: kept so acks can feed the cost model by piece index)
         self._outstanding = {}
         self.ventilated_count = 0
+        #: Per-batch provenance (ISSUE 13): position -> dispatch decision
+        #: (policy, early-launch, predicted cost, dispatch timestamp),
+        #: popped by the pools at delivery (``take_dispatch_meta``).
+        #: Bounded: an unconsumed map (no provenance-aware pool) drops
+        #: its oldest entries.
+        self._dispatch_meta = {}
+        self._prov = provenance.enabled()
 
     # -- resume token --------------------------------------------------------
 
@@ -230,6 +240,15 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
         self._outstanding[position] = item
         self._inflight_count += 1
         self.ventilated_count += 1
+        if self._prov:
+            # Snapshot the dispatch decision for the provenance record
+            # this position's result will carry (caller holds the lock).
+            meta = dict(getattr(self._policy, 'last_dispatch_meta', None)
+                        or {'policy': 'fifo'})
+            meta['t_dispatch'] = time.monotonic()
+            self._dispatch_meta[position] = meta
+            while len(self._dispatch_meta) > 4096:
+                self._dispatch_meta.pop(next(iter(self._dispatch_meta)))
         return VentilatedItem(position, item)
 
     def _run(self):
@@ -264,6 +283,12 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
                 self._epoch += 1
                 self._cursor = 0
         self._completed.set()
+
+    def take_dispatch_meta(self, position):
+        """Pop the dispatch decision recorded for ``position`` (None when
+        provenance is off or the entry aged out of the bounded map)."""
+        with self._lock:
+            return self._dispatch_meta.pop(position, None)
 
     def processed_item(self, position=None, elapsed=None):
         item = None

@@ -477,7 +477,10 @@ def test_wire_catalogue_pinned_on_real_tree():
             (set(), {b'A', b'E', b'K', b'P', b'R', b'T'}),
         'workers_pool/process_worker.py':
             ({b'A', b'E', b'K', b'P', b'R', b'T'}, set()),
-        'service/worker.py': ({b'A', b'R', b'S'}, {b'A', b'R'}),
+        # worker handles b'S' since ISSUE 13: the provenance transport
+        # classification compares chunk tags against it (not a dispatch
+        # arm — but compare-context is how this rule defines 'handled').
+        'service/worker.py': ({b'A', b'R', b'S'}, {b'A', b'R', b'S'}),
         'service/client.py': (set(), {b'S'}),
         'service/dispatcher.py': (set(), set()),
         'service/cluster.py': ({b'B', b'S'}, {b'B', b'S'}),

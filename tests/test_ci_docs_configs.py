@@ -203,6 +203,76 @@ def test_bench_compact_line_pins_cluster_cache_fields():
     assert 'cluster_cache_images_per_sec_warm' in trend.TRACKED_FIELDS
 
 
+def test_bench_compact_line_pins_provenance_fields():
+    """The provenance plane's overhead evidence (ISSUE 13): the
+    interleaved on/off rates and the derived overhead percentage must
+    ride the compact machine line (and through it the BENCH_HISTORY
+    trend store), and the leg must sit in the shared host-leg table."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    for field in ('provenance_images_per_sec_on',
+                  'provenance_images_per_sec_off',
+                  'provenance_overhead_pct'):
+        assert "'%s'" % field in block.group(1), field
+    assert re.search(
+        r"_IPC_PLANE_LEGS = \((?:.|\n)*?provenance_overhead_leg", src), \
+        'provenance_overhead_leg missing from the leg table'
+
+
+def test_docs_carry_provenance_plane_rows():
+    """ISSUE 13 docs: observability.md must document the provenance
+    record model, the explain CLI, the kill switch, the SLO watchdog,
+    tail exemplars, the top --json contract sample, and the flight-dump
+    hygiene sweep."""
+    obs = open(os.path.join(REPO, 'docs', 'observability.md')).read()
+    for needle in ('petastorm-tpu-explain', 'PETASTORM_TPU_NO_PROVENANCE',
+                   'provenance_overhead_pct', 'batch_slo_ms',
+                   'sweep_dumps', 'provenance_slo_',
+                   'test_top_json_golden_schema', 'dump_provenance'):
+        assert needle in obs, needle
+
+
+def test_docs_span_catalogue_synced_with_code():
+    """ISSUE 13 satellite: the docs span-catalogue and stall-component
+    tables drifted across PRs 6-9 — pin them to the LIVE names.  Every
+    STALL_COMPONENTS component and every span name it reads must appear
+    in docs/observability.md, as must every span name the tree actually
+    records (the literal catalogue below is the shipping set; extending
+    the code means extending the docs AND this list)."""
+    from petastorm_tpu.telemetry.spans import STALL_COMPONENTS
+    obs = open(os.path.join(REPO, 'docs', 'observability.md')).read()
+    for component, names in STALL_COMPONENTS.items():
+        assert '`%s`' % component in obs, component
+        for name in names:
+            assert name in obs, name
+    live_spans = (
+        'data_wait', 'step', 'data_wait_warmup', 'step_warmup',
+        'host_batch', 'transform', 'device_put',
+        'service/split_wait', 'service/decode_split',
+        'service/serve_cached_split', 'service/serialize',
+        'service/shm_publish', 'pool/process', 'pool/publish',
+        'h2d/stage', 'h2d/dispatch', 'h2d/commit', 'cache/fill')
+    for name in live_spans:
+        assert name in obs, 'span %r missing from the docs catalogue' % name
+    # ...and the literal list above must itself stay live: each name is
+    # recorded somewhere in the source tree.
+    tree = []
+    for root, _, files in os.walk(os.path.join(REPO, 'petastorm_tpu')):
+        for name in files:
+            if name.endswith('.py'):
+                tree.append(open(os.path.join(root, name)).read())
+    source = '\n'.join(tree)
+    for name in live_spans:
+        if name.endswith('_warmup'):
+            # built as '<base>' + '_warmup' in StallMonitor.wrap
+            assert "'_warmup'" in source and \
+                "'%s'" % name[:-len('_warmup')] in source, name
+            continue
+        assert "'%s'" % name in source, \
+            'span %r pinned here but no longer recorded in the tree' % name
+
+
 def test_cluster_cache_config_and_cli_surfaces():
     """ISSUE 10 entry-point-free surfaces: the ServiceConfig kwarg (and
     its job_info field), the dispatcher/worker CLI flags, the per-worker
@@ -287,6 +357,8 @@ def test_console_script_entry_points_resolve():
     assert 'petastorm-tpu-bench-trend' in names, names
     # ISSUE 11: the deadlock-analysis CLI
     assert 'petastorm-tpu-lockdep' in names, names
+    # ISSUE 13: the per-batch provenance explainer
+    assert 'petastorm-tpu-explain' in names, names
     for line in lines:
         _, target = [s.strip().strip('"') for s in line.split('=', 1)]
         mod, fn = target.split(':')

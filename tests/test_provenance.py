@@ -1,0 +1,549 @@
+"""Per-batch provenance plane (ISSUE 13): end-to-end causal records,
+tail exemplars, the explain CLI, the SLO watchdog, and the kill switch.
+
+The correctness bar: a delivered batch's record must name the REAL
+pieces (file + rowgroup), the REAL producing process (pid/host — across
+the ProcessPool and service-worker process boundaries), and stage
+windows on the consumer's clock covering its wall time; and
+``PETASTORM_TPU_NO_PROVENANCE=1`` must deliver bit-identical batches
+with zero provenance machinery engaged.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.jax.loader import DataLoader
+from petastorm_tpu.telemetry import MetricsRegistry, provenance
+from petastorm_tpu.telemetry import explain, flight
+from petastorm_tpu.telemetry.registry import EXEMPLARS_KEPT, merge_snapshots
+
+from test_common import create_test_dataset
+
+ROWS = 40
+ROWS_PER_GROUP = 5
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('provds')
+    return create_test_dataset('file://' + str(path), num_rows=ROWS,
+                               rows_per_rowgroup=ROWS_PER_GROUP)
+
+
+@pytest.fixture
+def no_kill_switch(monkeypatch):
+    monkeypatch.delenv('PETASTORM_TPU_NO_PROVENANCE', raising=False)
+
+
+def _iterate(dataset, pool='thread', **loader_kwargs):
+    loader_kwargs.setdefault('transfer', False)
+    with make_reader(dataset.url, reader_pool_type=pool, workers_count=2,
+                     shuffle_row_groups=False,
+                     columnar_decode=True) as reader:
+        loader = DataLoader(reader, batch_size=ROWS_PER_GROUP,
+                            drop_last=False, **loader_kwargs)
+        batches = []
+        with loader:
+            for batch in loader:
+                batches.append({k: np.asarray(v) for k, v in batch.items()})
+        return batches, loader
+
+
+# -- unit: record model -------------------------------------------------------
+
+def test_merge_records_unions_stages_and_pieces():
+    a = provenance.make_record(
+        'pool', worker_pid=11, worker_host='h', cache='decode',
+        pieces=[{'index': 0, 'path': 'p', 'row_group': 0}],
+        stages={'decode': [1.0, 2.0]})
+    b = provenance.make_record(
+        'pool', worker_pid=12, worker_host='h', cache='ram_hit',
+        pieces=[{'index': 1, 'path': 'p', 'row_group': 1}],
+        stages={'decode': [1.5, 3.0], 'ipc': [3.0, 3.1]})
+    merged = provenance.merge_records([a, b])
+    assert merged['stages']['decode'] == [1.0, 3.0]
+    assert merged['stages']['ipc'] == [3.0, 3.1]
+    assert [p['index'] for p in merged['pieces']] == [0, 1]
+    assert merged['worker_pid'] == 11 and merged['worker_pids'] == [11, 12]
+    assert merged['cache'] == 'mixed'   # disagreeing outcomes are honest
+    assert provenance.record_wall(merged) == pytest.approx(2.1)
+    # shift: all windows move together
+    shifted = provenance.shift_stages(merged, 10.0)
+    assert shifted['stages']['decode'] == [11.0, 13.0]
+
+
+def test_merge_records_keeps_sched_a_dict(capsys):
+    """Review regression: per-result sched dicts differ on actual_s for
+    every multi-chunk batch — the merge must stay a DICT (field-wise:
+    policy unanimity, any early launch, dominant costs), never the
+    string 'mixed' that crashed the explain renderer."""
+    a = provenance.make_record(
+        'pool', sched={'policy': 'fifo', 'actual_s': 0.1},
+        stages={'decode': [1.0, 2.0]})
+    b = provenance.make_record(
+        'pool', sched={'policy': 'fifo', 'actual_s': 0.3, 'early': True},
+        stages={'decode': [2.0, 3.0]})
+    merged = provenance.merge_records([a, b])
+    assert merged['sched'] == {'policy': 'fifo', 'early': True,
+                               'actual_s': 0.3}
+    c = provenance.make_record('pool', sched={'policy': 'adaptive'},
+                               stages={'decode': [3.0, 4.0]})
+    mixed = provenance.merge_records([a, c])
+    assert mixed['sched']['policy'] == 'mixed'
+    # ...and the renderer survives both shapes
+    assert 'scheduling' in explain.format_chain(
+        provenance.ProvenanceJournal().seal(mixed))
+
+
+def test_explain_reports_busy_time_not_envelope():
+    """Review regression: per-chunk serialize spans interleave with
+    decode, so the stage WINDOW is an envelope spanning most of the
+    split — explain's duration/% columns must report the summed busy
+    time instead of claiming serialization ate the wall."""
+    record = provenance.make_record(
+        'service',
+        stages={'decode': [0.0, 1.0], 'serialize': [0.05, 0.95]},
+        stage_busy_ms={'serialize': 12.0})
+    info = explain.explain_record(
+        provenance.ProvenanceJournal().seal(record))
+    row = {r['stage']: r for r in info['stages']}
+    assert row['serialize']['dur_ms'] == 12.0
+    assert row['serialize']['pct_of_wall'] == pytest.approx(1.2)
+    assert row['serialize']['envelope_ms'] == 900.0
+    assert row['decode']['dur_ms'] == 1000.0
+    # merge SUMS busy across upstream records
+    merged = provenance.merge_records([
+        provenance.make_record('service', stage_busy_ms={'serialize': 5.0},
+                               stages={'decode': [0.0, 1.0]}),
+        provenance.make_record('service', stage_busy_ms={'serialize': 7.0},
+                               stages={'decode': [1.0, 2.0]})])
+    assert merged['stage_busy_ms'] == {'serialize': 12.0}
+
+
+def test_summarize_record_is_the_one_worst_shape():
+    """Review regression: diagnose's artifact path had a hand-rolled,
+    drifted copy of the worst-K summary — both paths must cite a slow
+    batch through provenance.summarize_record."""
+    from petastorm_tpu.telemetry import diagnose
+    journal = provenance.ProvenanceJournal()
+    record = journal.seal(provenance.make_record(
+        'service', worker_pid=7, cache='decode', transport='shm',
+        pieces=[{'index': 3, 'path': '/d/p.parquet', 'row_group': 7}],
+        stages={'decode': [0.0, 2.0]}))
+    summary = provenance.summarize_record(record)
+    assert summary['piece'] == '/d/p.parquet:rg7'
+    evidence = diagnose.evidence_from_artifact(
+        {'registries': [], 'trace_events': [],
+         'provenance': [journal.dump()]})
+    assert evidence['provenance_worst'][0] == summary
+    # index-only pieces (readerless cached serve) summarize by index
+    bare = journal.seal(provenance.make_record(
+        'service', pieces=[{'index': 5}], stages={'decode': [0.0, 9.0]}))
+    assert provenance.summarize_record(bare)['piece'] == 5
+
+
+def test_journal_seal_worst_and_ring_eviction():
+    journal = provenance.ProvenanceJournal(capacity=4, worst_k=2)
+    for i in range(10):
+        # step 3 is the pathological batch: a 50 s decode window
+        dur = 50.0 if i == 3 else 0.001 * (i + 1)
+        journal.seal(provenance.make_record(
+            'local', stages={'decode': [100.0, 100.0 + dur]}))
+    records = journal.records()
+    assert len(records) == 4                       # bounded ring
+    assert [r['step'] for r in records] == [6, 7, 8, 9]
+    # the worst batch survived ring eviction and stays explainable
+    worst = journal.worst()
+    assert worst[0]['step'] == 3
+    assert journal.get(3)['latency_ms'] == pytest.approx(50000.0)
+    assert journal.get(6) is not None
+    assert journal.get(0) is None                  # aged out everywhere
+    summary = journal.worst_summary(1)[0]
+    assert summary['step'] == 3 and summary['latency_ms'] > 1000
+
+
+def test_cache_outcome_classification():
+    zero = {'cache_hits': 0, 'cache_ram_hits': 0, 'cache_misses': 0,
+            'cache_degraded': 0}
+    assert provenance.cache_outcome(zero, dict(zero, cache_hits=1,
+                                               cache_ram_hits=1)) == 'ram_hit'
+    assert provenance.cache_outcome(zero, dict(zero, cache_hits=1)) \
+        == 'disk_hit'
+    assert provenance.cache_outcome(zero, dict(zero, cache_misses=1)) \
+        == 'decode'
+    assert provenance.cache_outcome(zero, dict(zero, cache_degraded=1,
+                                               cache_misses=1)) == 'degraded'
+    assert provenance.cache_outcome(None, zero) is None
+
+
+# -- registry tail exemplars --------------------------------------------------
+
+def test_histogram_exemplars_rank_snapshot_and_merge():
+    registry = MetricsRegistry('t')
+    hist = registry.histogram('stage')
+    for i in range(20):
+        hist.observe(0.001 * (i + 1), exemplar={'step': i})
+    hist.observe(5.0, exemplar={'step': 99})       # the tail
+    hist.observe(0.0001)                           # no ref: not an exemplar
+    snap = registry.snapshot()
+    exemplars = snap['histograms']['stage']['exemplars']
+    assert len(exemplars) == EXEMPLARS_KEPT
+    assert exemplars[-1]['ref'] == {'step': 99}    # worst last
+    # fleet merge re-ranks instead of adding
+    other = MetricsRegistry('t2')
+    other.histogram('stage').observe(9.0, exemplar={'step': 7})
+    merged = merge_snapshots([snap, other.snapshot()])
+    kept = merged['histograms']['stage']['exemplars']
+    assert len(kept) == EXEMPLARS_KEPT
+    assert kept[-1]['ref'] == {'step': 7}
+    assert kept[-2]['ref'] == {'step': 99}
+    # histograms with no exemplars keep the historical snapshot shape
+    registry.histogram('plain').observe(0.1)
+    assert 'exemplars' not in registry.snapshot()['histograms']['plain']
+
+
+# -- through the delivery paths ----------------------------------------------
+
+def test_thread_pool_loader_journal(dataset, no_kill_switch):
+    batches, loader = _iterate(dataset, pool='thread')
+    journal = loader.provenance
+    assert journal is not None and len(journal) == len(batches)
+    record = journal.records()[0]
+    assert record['worker_pid'] == os.getpid()
+    assert record['worker_host']
+    piece = record['pieces'][0]
+    assert piece['path'].endswith('.parquet') and piece['row_group'] == 0
+    assert record['sched']['policy'] in ('fifo', 'adaptive')
+    for stage in ('decode', 'host_batch'):
+        assert stage in record['stages']
+    assert record['transfer'] == 'inline'
+    # ≥90% of the batch's wall is inside recorded stages (acceptance)
+    assert provenance.stage_coverage(record) >= 0.9
+    # the loader's p99 exemplar resolves to a journal record naming
+    # file + rowgroup + worker (acceptance)
+    exemplars = loader.metrics.snapshot()['histograms']['host_batch'][
+        'exemplars']
+    step = exemplars[-1]['ref']['step']
+    resolved = journal.get(step)
+    assert resolved is not None
+    assert resolved['pieces'][0]['path'].endswith('.parquet')
+    assert resolved['worker_pid'] == os.getpid()
+
+
+def test_process_pool_record_survives_ack_piggyback(dataset,
+                                                    no_kill_switch):
+    """Cross-process satellite: the record built in a REAL ProcessPool
+    child rides the result frames and lands in the parent journal with
+    the child's pid/host and piece identity intact."""
+    batches, loader = _iterate(dataset, pool='process')
+    journal = loader.provenance
+    assert len(journal) == len(batches)
+    record = journal.records()[0]
+    assert record['source'] == 'pool'
+    assert record['worker_pid'] != os.getpid()     # the CHILD decoded it
+    assert record['worker_host'] == provenance.host()
+    piece = record['pieces'][0]
+    assert piece['path'].endswith('.parquet')
+    assert record['transport'] in ('shm', 'bytes')
+    # decode/ipc windows came from the child clock; same-host monotonic
+    # is shared, so they must sit inside the consumer's wall
+    assert 'decode' in record['stages'] and 'ipc' in record['stages']
+    assert provenance.stage_coverage(record) >= 0.9
+    # release (queue+reorder wait) is stamped parent-side at delivery
+    assert 'release' in record['stages']
+
+
+def test_kill_switch_is_bit_identical_and_inert(dataset, monkeypatch):
+    monkeypatch.delenv('PETASTORM_TPU_NO_PROVENANCE', raising=False)
+    on_batches, on_loader = _iterate(dataset, pool='process')
+    monkeypatch.setenv('PETASTORM_TPU_NO_PROVENANCE', '1')
+    off_batches, off_loader = _iterate(dataset, pool='process')
+    assert len(on_batches) == len(off_batches)
+    for a, b in zip(on_batches, off_batches):
+        assert sorted(a) == sorted(b)
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
+    # inert: no journal, no records anywhere on the disabled path
+    assert off_loader.provenance is None
+    assert off_loader.reader.take_provenance() == []
+
+
+def test_sched_meta_reaches_records(dataset, no_kill_switch):
+    with make_reader(dataset.url, reader_pool_type='thread',
+                     workers_count=2, shuffle_row_groups=False,
+                     columnar_decode=True, scheduling='adaptive') as reader:
+        loader = DataLoader(reader, batch_size=ROWS_PER_GROUP,
+                            drop_last=False, transfer=False,
+                            autotune=False)
+        with loader:
+            list(loader)
+    records = loader.provenance.records()
+    scheds = [r.get('sched') for r in records if r.get('sched')]
+    assert scheds, 'no dispatch decisions reached the journal'
+    assert all(s['policy'] == 'adaptive' for s in scheds)
+    assert all('early' in s for s in scheds)
+    assert any(s.get('actual_s') is not None for s in scheds)
+
+
+# -- SLO watchdog + persistence ----------------------------------------------
+
+def test_slo_watchdog_dumps_full_chain(dataset, no_kill_switch,
+                                       monkeypatch, tmp_path):
+    monkeypatch.setenv('PETASTORM_TPU_FLIGHT_DIR', str(tmp_path))
+    _, loader = _iterate(dataset, pool='dummy', batch_slo_ms=0.0001)
+    assert loader._slo is not None and loader._slo.violations > 0
+    assert int(loader.metrics.counter('slo_violations').value) \
+        == loader._slo.violations
+    artifacts = [n for n in os.listdir(str(tmp_path))
+                 if n.startswith('provenance_slo_loader_')]
+    assert len(artifacts) == 1
+    state = json.load(open(str(tmp_path / artifacts[0])))
+    assert state['reason'] == 'slo_violation'
+    assert state['violation_step'] == 0            # rate-limited: first dump
+    records, meta = explain.load_records(state)
+    assert meta['violation_step'] == 0
+    assert records[0][0]['pieces'][0]['path'].endswith('.parquet')
+
+
+def test_explain_cli_journal_step_worst_json(dataset, no_kill_switch,
+                                             tmp_path, capsys):
+    _, loader = _iterate(dataset, pool='thread')
+    path = str(tmp_path / 'journal.json')
+    assert loader.dump_provenance(path) == path
+    worst_step = loader.provenance.worst(1)[0]['step']
+
+    assert explain.main(['--journal', path, '--worst', '2']) == 0
+    out = capsys.readouterr().out
+    assert '.parquet:rg' in out and 'coverage:' in out
+    assert 'worker pid %d' % os.getpid() in out
+
+    assert explain.main(['--journal', path, '--step', str(worst_step)]) == 0
+    out = capsys.readouterr().out
+    assert 'step %d' % worst_step in out
+
+    assert explain.main(['--journal', path, '--json']) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report['records'][0]['coverage_pct'] >= 90.0
+    assert {row['stage'] for row in report['records'][0]['stages']} \
+        >= {'decode', 'host_batch'}
+
+    # unknown step / unreadable input exit 1 (not a traceback)
+    assert explain.main(['--journal', path, '--step', '99999']) == 1
+    assert explain.main(['--journal', str(tmp_path / 'nope.json')]) == 1
+
+
+def test_flight_frames_carry_worst_k_and_dump_carries_journals(
+        dataset, no_kill_switch):
+    _, loader = _iterate(dataset, pool='thread')
+    recorder = flight.FlightRecorder(label='test')
+    frame = recorder.tick()
+    worst = frame.get('provenance_worst')
+    assert worst, 'flight frame lost the rolling worst-K'
+    assert worst[0]['latency_ms'] >= (worst[-1]['latency_ms'] or 0)
+    # the full journals ride the DUMP (explain --flight reads them)
+    dump = recorder.dump()
+    steps = {r['step'] for j in dump['provenance'] for r in j['records']}
+    assert loader.provenance.records()[0]['step'] in steps
+    records, _ = explain.load_records(dump)
+    assert records
+
+
+def test_explain_step_collisions_across_journals(capsys):
+    """Review regression: an artifact can carry several independently-
+    numbered journals (dump_state ships every live one) — `--step N`
+    must surface EVERY matching record labeled with its journal, never
+    silently overwrite one with the other."""
+    a = provenance.ProvenanceJournal(label='loader_a')
+    b = provenance.ProvenanceJournal(label='loader_b')
+    a.seal(provenance.make_record(
+        'pool', worker_pid=1,
+        pieces=[{'index': 0, 'path': '/a.parquet', 'row_group': 0}],
+        stages={'decode': [0.0, 1.0]}))
+    b.seal(provenance.make_record(
+        'pool', worker_pid=2,
+        pieces=[{'index': 9, 'path': '/b.parquet', 'row_group': 9}],
+        stages={'decode': [0.0, 2.0]}))
+    state = {'registries': [], 'provenance': [a.dump(), b.dump()]}
+    records, _ = explain.load_records(state)
+    assert len(records[0]) == 2
+    import json as _json
+    import tempfile
+    path = tempfile.mktemp(suffix='.json')
+    with open(path, 'w') as f:
+        _json.dump(state, f)
+    assert explain.main(['--artifact', path, '--step', '0']) == 0
+    captured = capsys.readouterr()
+    assert '/a.parquet' in captured.out and '/b.parquet' in captured.out
+    assert 'loader_a' in captured.out and 'loader_b' in captured.out
+    assert '2 journals' in captured.err
+
+
+def test_unalignable_service_record_is_dropped(dataset, no_kill_switch):
+    """Review regression: a cross-host record whose clock offsets never
+    arrived must be DROPPED, not journaled with a boot-skew latency that
+    poisons the worst-K (and fires the SLO watchdog forever)."""
+    from petastorm_tpu.service.client import _ServiceConnection
+    conn = _ServiceConnection.__new__(_ServiceConnection)
+    conn._clock_offset = None
+    conn._worker_offsets = {}
+    skewed = provenance.make_record(
+        'service', stages={'decode': [time.monotonic() + 7200.0,
+                                      time.monotonic() + 7201.0]})
+    assert conn._align_provenance({'provenance': skewed}, 'addr') is None
+    # a same-host record (shared monotonic clock) still passes unshifted
+    near = provenance.make_record(
+        'service', stages={'decode': [time.monotonic() - 1.0,
+                                      time.monotonic()]})
+    kept = conn._align_provenance({'provenance': near}, 'addr')
+    assert kept is not None and '_received_t' in kept
+
+
+# -- flight-dump hygiene satellite -------------------------------------------
+
+def test_sweep_dumps_dead_pid_age_gated(tmp_path):
+    old = time.time() - 2 * 24 * 3600
+    # ancient dump of a dead pid: swept
+    stale = tmp_path / 'flight_worker_999999.json'
+    stale.write_text('{}')
+    os.utime(str(stale), (old, old))
+    # ancient dump of a LIVE pid (no owner sidecar): kept
+    live = tmp_path / ('flight_worker_%d.json' % os.getpid())
+    live.write_text('{}')
+    os.utime(str(live), (old, old))
+    # young dump of a dead pid: kept (age gate)
+    young = tmp_path / 'flight_worker_999998.json'
+    young.write_text('{}')
+    # ancient tmp residue from a killed writer: swept
+    tmp_residue = tmp_path / 'flight_worker_999997.json.999997.tmp'
+    tmp_residue.write_text('partial')
+    os.utime(str(tmp_residue), (old, old))
+    result = flight.sweep_dumps(str(tmp_path))
+    assert result['swept'] == 1 and result['tmp_swept'] == 1
+    assert not stale.exists() and not tmp_residue.exists()
+    assert live.exists() and young.exists()
+    # unrelated files are never touched
+    other = tmp_path / 'notes.txt'
+    other.write_text('keep me')
+    os.utime(str(other), (old, old))
+    flight.sweep_dumps(str(tmp_path))
+    assert other.exists()
+
+
+def test_sweep_respects_owner_flock(tmp_path):
+    """A dump whose .owner sidecar is still flocked belongs to a LIVE
+    recorder (possibly in another pid namespace where the pid looks
+    dead) — the sweep must keep it."""
+    import fcntl
+    old = time.time() - 2 * 24 * 3600
+    dump = tmp_path / 'flight_worker_999996.json'
+    dump.write_text('{}')
+    os.utime(str(dump), (old, old))
+    owner = str(dump) + '.owner'
+    fd = os.open(owner, os.O_CREAT | os.O_RDWR, 0o644)
+    os.utime(owner, (old, old))
+    try:
+        fcntl.flock(fd, fcntl.LOCK_SH | fcntl.LOCK_NB)
+        flight.sweep_dumps(str(tmp_path))
+        assert dump.exists(), 'swept a dump whose owner holds the flock'
+    finally:
+        os.close(fd)
+    # owner gone (lock released): the next sweep reclaims both
+    result = flight.sweep_dumps(str(tmp_path))
+    assert result['swept'] >= 1
+    assert not dump.exists() and not os.path.exists(owner)
+
+
+def test_persist_holds_owner_flock(tmp_path):
+    recorder = flight.FlightRecorder(
+        label='t', persist_path=str(tmp_path / 'flight_t_1.json'))
+    recorder.tick()
+    assert recorder.persist(reason='test')
+    assert os.path.exists(str(tmp_path / 'flight_t_1.json.owner'))
+    assert recorder._owner_fd is not None
+    # while the recorder lives, a sweep (age-gated off) must keep it
+    old = time.time() - 2 * 24 * 3600
+    for name in os.listdir(str(tmp_path)):
+        os.utime(str(tmp_path / name), (old, old))
+    result = flight.sweep_dumps(str(tmp_path))
+    assert result['swept'] == 0
+    assert os.path.exists(str(tmp_path / 'flight_t_1.json'))
+    # Review regression: stop() must remove the sidecar along with the
+    # lock — an UNLOCKED .owner left behind would read as "owner
+    # provably gone" and get this live process's dump swept (the sweep
+    # only falls back to pid_alive when no sidecar exists).
+    recorder.stop()
+    assert recorder._owner_fd is None
+    assert not os.path.exists(str(tmp_path / 'flight_t_1.json.owner'))
+    os.utime(str(tmp_path / 'flight_t_1.json'), (old, old))
+    flight.sweep_dumps(str(tmp_path))
+    assert os.path.exists(str(tmp_path / 'flight_t_1.json')), \
+        'live-pid dump swept after a clean recorder stop'
+
+
+# -- service path (real subprocess) ------------------------------------------
+
+_WORKER_CHILD = r"""
+import os, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+sys.path.insert(0, sys.argv[2])
+from petastorm_tpu.service.worker import Worker
+Worker(sys.argv[1]).run()
+"""
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_service_subprocess_record_aligned_to_client_clock(
+        dataset, no_kill_switch):
+    """Cross-process satellite: a REAL service-worker subprocess's
+    per-split record rides the end header, survives with the worker's
+    pid/host intact, and its stage windows land on the CLIENT's
+    monotonic clock."""
+    import subprocess
+
+    from petastorm_tpu.service import Dispatcher, ServiceConfig, \
+        ServiceDataLoader
+
+    config = ServiceConfig(dataset.url, num_consumers=1,
+                           rowgroups_per_split=2, lease_ttl_s=2.0,
+                           reader_kwargs={'workers_count': 2})
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PYTHONPATH', None)
+    with Dispatcher(config) as dispatcher:
+        proc = subprocess.Popen(
+            [sys.executable, '-c', _WORKER_CHILD, dispatcher.addr, REPO],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            t_before = time.monotonic()
+            loader = ServiceDataLoader(dispatcher.addr, batch_size=8,
+                                       consumer=0, drop_last=False)
+            ids = []
+            with loader:
+                for batch in loader.iter_host_batches():
+                    ids.extend(np.asarray(batch['id']).tolist())
+            t_after = time.monotonic()
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+    assert sorted(ids) == list(range(ROWS))
+    records = [r for r in loader.provenance.records()
+               if r.get('source') == 'service']
+    assert records, 'no service records reached the journal'
+    for record in records:
+        assert record['worker_pid'] == proc.pid
+        assert record['worker_host']
+        assert record['pieces'][0]['path'].endswith('.parquet')
+        # clock alignment: the subprocess's decode window, shifted onto
+        # the client clock, must fall inside the run's wall window
+        decode = record['stages']['decode']
+        assert t_before - 1.0 <= decode[0] <= decode[1] <= t_after + 1.0
+        assert provenance.stage_coverage(record) >= 0.9
+        assert record['transport'] in ('shm', 'bytes', 'mixed')

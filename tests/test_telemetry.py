@@ -391,6 +391,57 @@ def test_top_render_and_once_json(tmp_path, capsys):
     assert rc == 1
 
 
+def test_top_json_golden_schema(capsys):
+    """`petastorm-tpu-top --json` is a CONTRACT for scriptable consumers
+    (ISSUE 13 satellite): pin the full nested key schema of one real
+    reply — top-level, the three rollups, a stage summary, and a worker
+    row — so a rename fails here, not in someone's parsing script.  The
+    documented sample lives in docs/observability.md."""
+    import zmq
+
+    from petastorm_tpu.service import Dispatcher, ServiceConfig
+    from petastorm_tpu.service.worker import _Rpc
+    from petastorm_tpu.telemetry import top
+
+    config = ServiceConfig('file:///unused', num_consumers=1)
+    with Dispatcher(config, num_pieces=4) as dispatcher:
+        context = zmq.Context()
+        rpc = _Rpc(context, dispatcher.addr)
+        try:
+            wid = rpc.call({'op': 'register_worker',
+                            'data_addr': 'tcp://127.0.0.1:1'})['worker_id']
+            registry = MetricsRegistry('service_worker')
+            registry.histogram('decode_split').observe(0.05)
+            rpc.call({'op': 'heartbeat', 'worker_id': wid,
+                      'stats': {'rows_decoded': 7, 'shm_chunks': 3,
+                                'registry': registry.snapshot()}})
+        finally:
+            rpc.close()
+            context.term()
+        rc = top.main(['--dispatcher', dispatcher.addr, '--once', '--json'])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert set(stats) == DISPATCHER_STATS_KEYS
+    assert set(stats['cache']) == {
+        'cache_hits', 'cache_misses', 'cache_evictions', 'cache_ram_hits',
+        'cache_degraded'}
+    assert set(stats['shm']) == {'shm_chunks', 'shm_degraded'}
+    assert set(stats['cluster_cache']) == {
+        'cache_remote_hits', 'cache_peer_fills', 'cache_peer_degraded',
+        'cache_affinity_routed', 'affinity_deferrals', 'directory_workers',
+        'directory_digests', 'piece_map'}
+    # stage summaries keep the canonical summarize_hist shape ('exemplar'
+    # may additionally appear when the source histogram recorded tail
+    # exemplars — an additive key, never a replacement)
+    stage = stats['stages']['decode_split']
+    assert set(stage) - {'exemplar'} == {'count', 'p50_ms', 'p99_ms',
+                                         'max_ms'}
+    row = stats['workers'][str(wid)] if str(wid) in stats['workers'] \
+        else stats['workers'][wid]
+    assert {'rows_decoded', 'shm_chunks', 'age_s'} <= set(row)
+    assert 'registry' not in row
+
+
 def test_top_render_stats_handles_rich_reply():
     from petastorm_tpu.telemetry.top import render_stats
     text = render_stats({
