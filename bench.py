@@ -1591,7 +1591,11 @@ def adaptive_sched_leg(pairs=4, seeds_per=3):
                       columnar_decode=True,
                       transform_spec=ResizeImages({'image': (224, 224)}),
                       shuffle_row_groups=True, num_epochs=1,
-                      scheduling=scheduling)
+                      scheduling=scheduling,
+                      # this leg measures the SCHEDULER: the ingest plane
+                      # would hide the very cold-fetch skew it reorders
+                      # around (the object_store_ingest leg measures that)
+                      ingest='off')
         kwargs.update(overrides)
         for seed in seeds:
             with make_reader(url, seed=seed, **kwargs) as r:
@@ -1651,6 +1655,97 @@ def adaptive_sched_leg(pairs=4, seeds_per=3):
             round(uniform_ratio, 2) if uniform_ratio else None,
         # processing order moves, delivery order must not
         'adaptive_sched_delivery_identical': ref_ids == adaptive_ids,
+    }
+
+
+INGEST_DATASET_URL = 'file://' + BENCH_DIR + '/ingest_cold_jpeg_v1'
+#: Every group is its own multi-MB cold-tier file (slow_every=1): the
+#: object-store shape where EVERY first read pays the cold GET.
+_INGEST_GROUPS = 16
+_INGEST_WORKERS = 4
+
+
+def object_store_ingest_leg(pairs=2):
+    """Latency-hiding ingest plane (ISSUE 14): cold-epoch images/s of
+    ``ingest='plane'`` vs the synchronous path on an all-cold dataset
+    (every row group its own >1 MiB file) behind
+    ``BandwidthLimitedFilesystem(cold_latency=1.2)`` — the emulated
+    object store where every first read pays a cold GET.
+
+    The synchronous path parallelizes cold latency only as wide as the
+    decode pool (workers block in the GET); the plane parallelizes it
+    across its fetch threads and overlaps it with decode, which is the
+    whole latency-hiding claim — measured here, not asserted.
+
+    Protocol: interleaved sync/plane pairs, one epoch each, medians;
+    both variants run ``scheduling='adaptive'`` (epoch-order delivery,
+    so the content digest below is order-exact) with a fixed seed and
+    the same 4-worker pool.  Delivery is digest-asserted IN-LEG: sha1
+    over every delivered row's id + decoded image bytes, sync vs plane
+    — an ordering or content divergence fails the leg loudly rather
+    than shipping as a quietly-false field."""
+    import hashlib
+
+    import fsspec
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.test_util import BandwidthLimitedFilesystem
+    from petastorm_tpu.transform import ResizeImages
+
+    _ensure_skew_dataset(INGEST_DATASET_URL, _INGEST_GROUPS, 1)
+    cold_fs = BandwidthLimitedFilesystem(fsspec.filesystem('file'),
+                                         SKEW_COLD_BPS,
+                                         cold_latency=SKEW_COLD_LATENCY_S)
+
+    def epoch(ingest_mode, digest=False):
+        sha = hashlib.sha1() if digest else None
+        n = 0
+        with make_reader(INGEST_DATASET_URL, filesystem=cold_fs,
+                         schema_fields=['noun_id', 'image'],
+                         workers_count=_INGEST_WORKERS, columnar_decode=True,
+                         transform_spec=ResizeImages({'image': (224, 224)}),
+                         shuffle_row_groups=True, seed=5, num_epochs=1,
+                         scheduling='adaptive', ingest=ingest_mode,
+                         ingest_window=_INGEST_GROUPS) as reader:
+            t0 = time.monotonic()
+            for batch in reader:
+                n += len(batch.noun_id)
+                if sha is not None:
+                    sha.update(np.ascontiguousarray(batch.noun_id).tobytes())
+                    sha.update(np.ascontiguousarray(batch.image).tobytes())
+            elapsed = time.monotonic() - t0
+            diag = reader.diagnostics
+        return (n / elapsed, sha.hexdigest() if sha else None,
+                int(diag.get('ingest_degraded', 0) or 0))
+
+    epoch('off')  # warmup: page cache, pool spin-up
+    rates = {'off': [], 'plane': []}
+    digests = {}
+    degraded = 0
+    for i in range(max(1, int(pairs))):
+        for mode in ('off', 'plane'):
+            rate, digest, deg = epoch(mode, digest=(i == 0))
+            rates[mode].append(rate)
+            degraded += deg
+            if i == 0:
+                digests[mode] = digest
+    if digests['off'] != digests['plane']:
+        # in-leg assertion, like the transfer/adaptive legs: delivery
+        # through the plane must be bit-identical (same epoch order,
+        # same decoded bytes) to the synchronous path
+        raise AssertionError(
+            'ingest-plane delivery diverged from the synchronous path '
+            '(%s vs %s)' % (digests['plane'], digests['off']))
+    sync = float(np.median(rates['off']))
+    plane = float(np.median(rates['plane']))
+    return {
+        'object_store_ingest_images_per_sec_sync': round(sync, 1),
+        'object_store_ingest_images_per_sec_plane': round(plane, 1),
+        'object_store_ingest_plane_over_sync':
+            round(plane / sync, 2) if sync else None,
+        'object_store_ingest_delivery_identical':
+            digests['off'] == digests['plane'],
+        'object_store_ingest_degraded': degraded,
     }
 
 
@@ -1716,6 +1811,7 @@ _IPC_PLANE_LEGS = (
     ('cluster_cache', cluster_cache_leg),
     ('transfer_plane', transfer_plane_leg),
     ('adaptive_sched', adaptive_sched_leg),
+    ('object_store_ingest', object_store_ingest_leg),
     ('provenance_overhead', provenance_overhead_leg),
 )
 
@@ -1990,6 +2086,11 @@ _COMPACT_KEYS = (
     'adaptive_sched_adaptive_over_fifo',
     'adaptive_sched_uniform_over_fifo',
     'adaptive_sched_delivery_identical',
+    'object_store_ingest_images_per_sec_sync',
+    'object_store_ingest_images_per_sec_plane',
+    'object_store_ingest_plane_over_sync',
+    'object_store_ingest_delivery_identical',
+    'object_store_ingest_degraded',
     'provenance_images_per_sec_on',
     'provenance_images_per_sec_off',
     'provenance_overhead_pct',

@@ -29,6 +29,9 @@ class BatchWorkerArgs:
     #: (SURVEY.md §5.3 build obligation; no reference equivalent).
     read_retries: int = 2
     retry_backoff_s: float = 0.1
+    #: Ingest plane (ISSUE 14): the parent reader's IngestPlane, or None
+    #: (synchronous reads).  Set by Reader._start after mode resolution.
+    ingest: object = None
 
 
 def piece_cache_key(piece, schema_view, transform_spec):
@@ -60,15 +63,18 @@ class ArrowReaderWorker(ParquetWorkerBase):
         # The retry/poison classifier wraps only the I/O stage: an ArrowInvalid
         # out of a user transform (e.g. from_pandas on a mixed-type column)
         # must surface as the transform's own error, not as a corrupt file.
-        table = self._a.cache.get(
-            cache_key,
-            lambda: self._apply_transform(
-                self._read_with_retry(piece, lambda: self._load_table(piece))))
+        # _ingest_scope releases the plane's prefetched entry on a
+        # result-cache HIT (the lambda below never runs then).
+        with self._ingest_scope(piece):
+            table = self._a.cache.get(
+                cache_key,
+                lambda: self._apply_transform(
+                    self._read_with_retry(piece, lambda: self._read_piece(
+                        piece, lambda pf: self._load_table(pf, piece)))))
         if table is not None and table.num_rows > 0:
             self.publish_func(table)
 
-    def _load_table(self, piece):
-        pf = self._parquet_file(piece.path)
+    def _load_table(self, pf, piece):
         physical = set(pf.schema_arrow.names)
         wanted = [n for n in self._a.schema_view.fields if n in physical]
         predicate = self._a.predicate

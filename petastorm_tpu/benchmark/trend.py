@@ -49,6 +49,7 @@ TRACKED_FIELDS = (
     'epoch_cache_streaming_warm_images_per_sec',
     'transfer_plane_images_per_sec_coalesced',
     'adaptive_sched_images_per_sec_adaptive',
+    'object_store_ingest_images_per_sec_plane',
     'cluster_cache_images_per_sec_warm',
     'dlrm_host_rows_per_s',
 )

@@ -1,0 +1,15 @@
+"""Latency-hiding object-store ingest plane (ISSUE 14).
+
+Coalesced async byte-range prefetch under the decode workers:
+``planner`` turns footer metadata + selected columns into bounded,
+coalesced byte ranges; ``plane`` is the dispatch-ordered fetch pump
+(readahead window, request hedging, per-piece degrade) the readers
+mount via ``make_reader(ingest=...)``.
+"""
+
+from petastorm_tpu.ingest.plane import (INGEST_MODES, KILL_SWITCH,  # noqa: F401
+                                        IngestPlane, resolve_ingest)
+from petastorm_tpu.ingest.planner import (IngestMissError,  # noqa: F401
+                                          IngestPlanError, SparseFile,
+                                          coalesce, column_chunk_ranges,
+                                          read_footer)

@@ -498,7 +498,22 @@ class DataLoader(object):
     def _host_batches(self):
         gen = (self._columnar_batches() if self._batched_input
                else self._row_batches())
-        return self._autotuned(gen)
+        gen = self._autotuned(gen)
+        if self._trace is not None:
+            gen = self._ingest_spans_drained(gen)
+        return gen
+
+    def _ingest_spans_drained(self, gen):
+        """Merge the ingest plane's ``ingest/fetch`` / ``ingest/hedge``
+        spans (ISSUE 14) onto this recorder's timeline, once per host
+        batch.  Same process, same CLOCK_MONOTONIC — offset 0; so stall
+        attribution can name ``ingest_fetch`` as a component."""
+        from petastorm_tpu.telemetry.spans import merge_into_recorder
+        for batch in gen:
+            plane = getattr(self.reader, 'ingest_plane', None)
+            if plane is not None:
+                merge_into_recorder(self._trace, plane.spans.drain())
+            yield batch
 
     # -- stage autotuning (ISSUE 9) ------------------------------------------
 
@@ -553,6 +568,13 @@ class DataLoader(object):
                     and hasattr(ventilator, 'set_max_inflight'):
                 knobs.bind('max_inflight', ventilator.set_max_inflight)
         knobs.bind('prefetch', self._set_prefetch)
+        # Ingest plane (ISSUE 14): the readahead window is the fourth
+        # knob — grown when decode measurably blocks on fetches, shrunk
+        # gently when a window of fetches completed with zero waits.
+        ingest_plane = getattr(self.reader, 'ingest_plane', None)
+        if ingest_plane is not None:
+            knobs.ingest_window = ingest_plane.window
+            knobs.bind('ingest_window', ingest_plane.set_window)
         self._knobs = knobs
         # the no-skew shrink floor scales with the pool: the in-flight
         # bound counts undelivered positions (ack-on-delivery), so
@@ -565,6 +587,9 @@ class DataLoader(object):
             cost_model=getattr(self.reader, 'cost_model', None),
             stall_monitor=self._stall_monitor,
             min_inflight=max(sched.MIN_INFLIGHT, 2 * workers))
+        if ingest_plane is not None:
+            self._tuner.attach_ingest(ingest_plane)
+            self.metrics.gauge('sched_ingest_window').set(knobs.ingest_window)
         self._tuner_ventilator = ventilator
         # publish the starting point so the gauges tell the whole story
         self.metrics.gauge('sched_window').set(knobs.window)

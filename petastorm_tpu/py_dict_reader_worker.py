@@ -41,6 +41,11 @@ class RowWorkerArgs:
     #: (SURVEY.md §5.3 build obligation; no reference equivalent).
     read_retries: int = 2
     retry_backoff_s: float = 0.1
+    #: Ingest plane (ISSUE 14): the parent reader's IngestPlane, or None
+    #: (synchronous reads).  Set by Reader._start after mode resolution;
+    #: always None for ProcessPool readers (the plane cannot cross the
+    #: worker pickle boundary).
+    ingest: object = None
 
 
 def piece_cache_key(piece, schema_view, transform_spec, row_drop_partition=0):
@@ -89,44 +94,55 @@ class PyDictReaderWorker(ParquetWorkerBase):
         cache_key = piece_cache_key(piece, self._a.schema_view,
                                     self._a.transform_spec,
                                     row_drop_partition)
-        if self._a.columnar_output and self._a.ngram is None:
-            if columnar_fast_path(self._a.transform_spec):
-                # True columnar decode: no intermediate row dicts at all.
-                columns = self._a.cache.get(
-                    cache_key + ':c',
-                    lambda: self._read_with_retry(
-                        piece, lambda: self._load_columns(piece, row_drop_partition)))
-                if columns is not None and len(next(iter(columns.values()), ())) > 0:
-                    self.publish_func(columns)
+        # Reads route through _read_piece: the ingest plane's prefetched
+        # in-memory bytes when available, the cached handle otherwise.
+        # _ingest_scope releases the plane's prefetched entry when a
+        # result-cache HIT means no branch below ever reads Parquet.
+        def read_columns():
+            return self._read_piece(piece, lambda pf: self._load_columns(
+                pf, piece, row_drop_partition))
+
+        def read_rows():
+            return self._read_piece(piece, lambda pf: self._load_rows(
+                pf, piece, row_drop_partition))
+
+        with self._ingest_scope(piece):
+            if self._a.columnar_output and self._a.ngram is None:
+                if columnar_fast_path(self._a.transform_spec):
+                    # True columnar decode: no intermediate row dicts.
+                    columns = self._a.cache.get(
+                        cache_key + ':c',
+                        lambda: self._read_with_retry(piece, read_columns))
+                    if columns is not None \
+                            and len(next(iter(columns.values()), ())) > 0:
+                        self.publish_func(columns)
+                    return
+                rows = self._a.cache.get(
+                    cache_key,
+                    lambda: self._read_with_retry(piece, read_rows))
+                if rows:
+                    self.publish_func(_stack_columnar(rows))
                 return
             rows = self._a.cache.get(
                 cache_key,
-                lambda: self._read_with_retry(
-                    piece, lambda: self._load_rows(piece, row_drop_partition)))
+                lambda: self._read_with_retry(piece, read_rows))
+            if self._a.ngram is not None:
+                rows = self._a.ngram.form_sequences(rows, self._a.schema_view)
             if rows:
-                self.publish_func(_stack_columnar(rows))
-            return
-        rows = self._a.cache.get(
-            cache_key,
-            lambda: self._read_with_retry(
-                piece, lambda: self._load_rows(piece, row_drop_partition)))
-        if self._a.ngram is not None:
-            rows = self._a.ngram.form_sequences(rows, self._a.schema_view)
-        if rows:
-            self.publish_func(rows)
+                self.publish_func(rows)
 
     # -- columnar fast path ---------------------------------------------------
 
-    def _load_columns(self, piece, row_drop_partition):
+    def _load_columns(self, pf, piece, row_drop_partition):
         """Decode a row group column-wise into stacked arrays.
 
         Scalar codec-less columns come out of arrow as native numpy with no
         python loop; codec cells decode per value and stack once.  This is
         the decode-plane half of the loader's zero-per-row contract.
+        ``pf`` comes from the caller (ingest buffer or cached handle).
         """
         wanted = set(self._a.schema_view.fields)
         predicate = self._a.predicate
-        pf = self._parquet_file(piece.path)
         mask = None
         out = {}
 
@@ -260,10 +276,9 @@ class PyDictReaderWorker(ParquetWorkerBase):
                 out[name] = _resize_cells(batch, target)
         return out
 
-    def _load_rows(self, piece, row_drop_partition):
+    def _load_rows(self, pf, piece, row_drop_partition):
         wanted = set(self._a.schema_view.fields)
         predicate = self._a.predicate
-        pf = self._parquet_file(piece.path)
 
         if predicate is not None:
             predicate_fields = set(predicate.get_fields())

@@ -148,7 +148,8 @@ def make_reader(dataset_url,
                 storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                 seed=None, resume_state=None, zmq_copy_buffers=True,
                 columnar_decode=False, read_retries=2, retry_backoff_s=0.1,
-                piece_indices=None, scheduling='auto'):
+                piece_indices=None, scheduling='auto', ingest='auto',
+                ingest_window=None):
     """Reader over a petastorm-format dataset (codec-decoded rows).
 
     Parity: ``petastorm/reader.py :: make_reader`` (argument names kept,
@@ -178,6 +179,22 @@ def make_reader(dataset_url,
     (default) picks ``'adaptive'`` when there is anything to gain
     (multi-worker pool, enough row groups) and ``'fifo'`` otherwise;
     ``PETASTORM_TPU_NO_ADAPTIVE_SCHED=1`` forces ``'fifo'`` everywhere.
+
+    ``ingest`` (extension, ISSUE 14): the async byte-range ingest plane
+    for object-store-class storage.  ``'plane'`` prefetches each
+    dispatched row group's column-chunk byte ranges (selected columns
+    only, coalesced into bounded GETs) on background fetch threads, in
+    the ventilator's actual dispatch order, handing pyarrow an in-memory
+    buffer — cold first-byte latency moves off the decode workers'
+    clock.  ``'off'`` reads synchronously; ``'auto'`` (default) enables
+    the plane only on filesystems that pay real first-byte latency
+    (non-local fsspec protocols) and always stays off for ProcessPool
+    readers.  ``PETASTORM_TPU_NO_INGEST_PLANE=1`` kills it everywhere;
+    any fetch failure degrades per piece to the synchronous path.
+    Delivery is bit-identical in every mode.  ``ingest_window`` bounds
+    how many pieces may be prefetched ahead (default 8; the
+    ``DataLoader`` autotuner moves it live from measured
+    fetch-vs-decode overlap).
     """
     fs, path = get_filesystem_and_path_or_paths(
         dataset_url, storage_options=storage_options, filesystem=filesystem,
@@ -200,7 +217,7 @@ def make_reader(dataset_url,
         resume_state=resume_state, zmq_copy_buffers=zmq_copy_buffers,
         columnar_decode=columnar_decode, read_retries=read_retries,
         retry_backoff_s=retry_backoff_s, piece_indices=piece_indices,
-        scheduling=scheduling)
+        scheduling=scheduling, ingest=ingest, ingest_window=ingest_window)
 
 
 def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
@@ -212,7 +229,8 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
                         cache_row_size_estimate, cache_extra_settings,
                         transform_spec, filters, seed, resume_state, zmq_copy_buffers,
                         columnar_decode=False, read_retries=2, retry_backoff_s=0.1,
-                        piece_indices=None, scheduling='auto'):
+                        piece_indices=None, scheduling='auto', ingest='auto',
+                        ingest_window=None):
     from petastorm_tpu.ngram import NGram
     from petastorm_tpu.py_dict_reader_worker import PyDictReaderWorker, RowWorkerArgs
 
@@ -289,7 +307,8 @@ def _make_reader_common(fs, path, stored_schema, dataset_url, *, schema_fields,
                   shuffle_items=shuffle_row_groups, num_epochs=num_epochs,
                   seed=seed, resume_state=resume_state, cache=cache,
                   result_converter=converter, topology=topology,
-                  scheduling=scheduling)
+                  scheduling=scheduling, ingest=ingest,
+                  ingest_window=ingest_window)
 
 
 class _ColumnarDictConverter(object):
@@ -338,7 +357,7 @@ def make_batch_reader(dataset_url_or_urls,
                       storage_options=None, filesystem=None, hdfs_driver='libhdfs',
                       seed=None, resume_state=None, zmq_copy_buffers=True,
                       read_retries=2, retry_backoff_s=0.1, piece_indices=None,
-                      scheduling='auto'):
+                      scheduling='auto', ingest='auto', ingest_window=None):
     """Columnar reader over *any* Parquet store (no petastorm metadata needed).
 
     Parity: ``petastorm/reader.py :: make_batch_reader``.  Yields namedtuples
@@ -347,6 +366,8 @@ def make_batch_reader(dataset_url_or_urls,
     ``piece_indices`` (extension): read exactly these global row-group
     indices instead of sharding — see :func:`make_reader`.
     ``scheduling`` (extension): dispatch-order policy — see
+    :func:`make_reader`.  ``ingest`` / ``ingest_window`` (extension,
+    ISSUE 14): the async byte-range ingest plane — see
     :func:`make_reader`.
     """
     from petastorm_tpu.arrow_reader_worker import (ArrowReaderWorker,
@@ -412,7 +433,8 @@ def make_batch_reader(dataset_url_or_urls,
                   shuffle_items=shuffle_row_groups, num_epochs=num_epochs,
                   seed=seed, resume_state=resume_state, cache=cache,
                   result_converter=ArrowResultConverter(result_schema),
-                  topology=topology, scheduling=scheduling)
+                  topology=topology, scheduling=scheduling, ingest=ingest,
+                  ingest_window=ingest_window)
 
 
 class Reader(object):
@@ -425,7 +447,9 @@ class Reader(object):
 
     def __init__(self, *, pool, worker_class, worker_args, items, schema, ngram,
                  shuffle_items, num_epochs, seed, resume_state, cache,
-                 result_converter=None, topology=None, scheduling='auto'):
+                 result_converter=None, topology=None, scheduling='auto',
+                 ingest='auto', ingest_window=None):
+        from petastorm_tpu.ingest import resolve_ingest as _resolve_ingest
         from petastorm_tpu.workers_pool import scheduling as _sched
         #: requested mode; the EFFECTIVE mode (after 'auto' resolution and
         #: the kill switch) is the public ``scheduling`` attribute, set in
@@ -434,6 +458,14 @@ class Reader(object):
         # validate eagerly — a typo must fail before threads spin up
         _sched.resolve_scheduling(scheduling, len(items),
                                   pool.workers_count)
+        #: requested ingest mode (ISSUE 14); the EFFECTIVE mode after
+        #: 'auto'/kill-switch resolution is the public ``ingest``
+        #: attribute, set per _start (so reset() re-reads the env).
+        self._ingest_requested = ingest
+        self._ingest_window = ingest_window
+        _resolve_ingest(ingest, worker_args.filesystem)  # eager validation
+        self.ingest = None
+        self.ingest_plane = None
         self.scheduling = None
         self.cost_model = None
         self._reorder = None
@@ -517,7 +549,24 @@ class Reader(object):
                 'data.' % ', '.join(mismatches))
 
     def _start(self, start_epoch=0, start_cursor=0, prologue=()):
+        from petastorm_tpu import ingest as _ingest
         from petastorm_tpu.workers_pool import scheduling as _sched
+        # Ingest plane (ISSUE 14): resolved per start so reset()
+        # re-reads the kill switch; ProcessPool readers resolve off
+        # (the plane cannot cross the worker pickle boundary).
+        if self.ingest_plane is not None:
+            self.ingest_plane.close()
+            self.ingest_plane = None
+        self.ingest = _ingest.resolve_ingest(
+            self._ingest_requested, self._worker_args.filesystem,
+            in_process_pool=type(self._pool).__name__ != 'ProcessPool')
+        if self.ingest == 'plane':
+            self.ingest_plane = _ingest.IngestPlane(
+                self._worker_args.filesystem, self._worker_args.pieces,
+                columns=self._ingest_columns(),
+                registry=getattr(self._pool, 'metrics', None),
+                window=self._ingest_window)
+        self._worker_args.ingest = self.ingest_plane
         # Small in-flight window: keeps resume tokens tight and bounds memory;
         # large enough to never starve the workers.
         window = max(2 * self._pool.workers_count, 4)
@@ -576,9 +625,27 @@ class Reader(object):
             max_ventilation_queue_size=max(
                 1, min(len(self._items) + len(prologue), window)),
             start_epoch=start_epoch, start_cursor=start_cursor,
-            prologue_items=prologue, dispatch_policy=policy)
+            prologue_items=prologue, dispatch_policy=policy,
+            dispatch_listener=(self.ingest_plane.observe_dispatch
+                               if self.ingest_plane is not None else None))
         self._pool.start(self._worker_class, self._worker_args,
                          ventilator=self._ventilator, reorder=self._reorder)
+
+    def _ingest_columns(self):
+        """Column names one piece's decode may read: the selected view
+        plus any predicate columns (the two-pass predicate read touches
+        both) — the set the fetch planner restricts ranges to.  Names
+        with no physical chunk (hive partition keys) simply match
+        nothing at plan time."""
+        wanted = set(self._worker_args.schema_view.fields)
+        predicate = getattr(self._worker_args, 'predicate', None)
+        if predicate is not None:
+            try:
+                wanted |= set(predicate.get_fields()) \
+                    & set(self._worker_args.schema.fields)
+            except Exception:  # noqa: BLE001 — over-fetch beats a missed page
+                return None
+        return wanted
 
     def _scheduling_weights(self):
         """Epoch-0 cost priors for the adaptive scheduler, cached across
@@ -820,6 +887,10 @@ class Reader(object):
 
     def stop(self):
         self._pool.stop()
+        if self.ingest_plane is not None:
+            # after pool.stop: a worker blocked in a checkout unblocks
+            # here and degrades to the sync path instead of wedging join
+            self.ingest_plane.close()
         self._stopped = True
 
     def join(self):
@@ -852,6 +923,10 @@ class Reader(object):
             d.update(cache_stats)
         d['ventilated_count'] = self._ventilator.ventilated_count
         d['scheduling'] = self.scheduling
+        # Ingest plane (ISSUE 14): effective mode + live fetch counters.
+        d['ingest'] = self.ingest
+        if self.ingest_plane is not None:
+            d.update(self.ingest_plane.stats)
         # results staged behind an earlier incomplete position (adaptive
         # only; 0 when idle/fifo) — the reorder stage's live depth
         d['reorder_pending'] = (self._reorder.pending_results

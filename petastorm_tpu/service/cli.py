@@ -59,6 +59,14 @@ def _build_parser():
                         'serving, no peer fill — '
                         'PETASTORM_TPU_NO_CLUSTER_CACHE=1 is the '
                         'equivalent kill switch')
+    d.add_argument('--ingest', default='auto',
+                   choices=('auto', 'plane', 'off'),
+                   help='async byte-range ingest plane mode for every '
+                        "per-split reader (see make_reader(ingest=)); "
+                        "'auto' enables it on non-local dataset "
+                        'filesystems — the object-store case decode '
+                        'workers exist for; PETASTORM_TPU_NO_INGEST_'
+                        'PLANE=1 is the kill switch')
     d.add_argument('--no-telemetry-spans', action='store_true',
                    help='do not ship per-split correlated stage spans on '
                         'the data-plane end headers (metrics registries '
@@ -130,6 +138,7 @@ def main(argv=None):
             cache_plane_ram_bytes=args.cache_plane_ram_bytes,
             cache_plane_disk_bytes=args.cache_plane_disk_bytes,
             cluster_cache=(False if args.no_cluster_cache else None),
+            ingest=args.ingest,
             telemetry_spans=not args.no_telemetry_spans)
         with Dispatcher(config, bind=args.bind) as dispatcher:
             print('dispatcher serving %s (%d splits, %d consumers)'

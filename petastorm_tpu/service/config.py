@@ -106,6 +106,16 @@ class ServiceConfig:
             ``PETASTORM_TPU_NO_ADAPTIVE_SCHED=1`` kill switch has a
             config-level mirror.  An explicit ``scheduling`` in
             ``reader_kwargs`` wins.
+        ingest: the async byte-range ingest plane mode every per-split
+            reader mounts (``'auto'`` / ``'plane'`` / ``'off'`` — see
+            ``make_reader(ingest=)``, ISSUE 14).  Decode workers are
+            exactly the processes that pay object-store first-byte
+            latency, so ``'auto'`` turns the plane on whenever the
+            dataset lives on a non-local filesystem; the field exists so
+            a job can force it from one place, and so the
+            ``PETASTORM_TPU_NO_INGEST_PLANE=1`` kill switch has a
+            config-level mirror.  An explicit ``ingest`` in
+            ``reader_kwargs`` wins.
         telemetry_spans: ship each split's correlated stage spans
             (decode / serialize / shm publish / cache fill) on its
             ``end`` header so clients with a ``trace_recorder`` merge
@@ -134,6 +144,7 @@ class ServiceConfig:
     cache_plane_disk_bytes: int = None
     cluster_cache: bool = None
     scheduling: str = 'auto'
+    ingest: str = 'auto'
     telemetry_spans: bool = True
 
     def __post_init__(self):
@@ -162,6 +173,9 @@ class ServiceConfig:
         if self.scheduling not in ('auto', 'fifo', 'adaptive'):
             raise ValueError("scheduling must be 'auto', 'fifo' or "
                              "'adaptive', got %r" % (self.scheduling,))
+        if self.ingest not in ('auto', 'plane', 'off'):
+            raise ValueError("ingest must be 'auto', 'plane' or 'off', "
+                             "got %r" % (self.ingest,))
         if self.heartbeat_interval_s is None:
             self.heartbeat_interval_s = self.lease_ttl_s / 3.0
 
@@ -197,6 +211,7 @@ class ServiceConfig:
             'cache_plane_disk_bytes': self.cache_plane_disk_bytes,
             'cluster_cache': bool(self.cluster_cache),
             'scheduling': self.scheduling,
+            'ingest': self.ingest,
             'telemetry_spans': bool(self.telemetry_spans),
             'fingerprint': self.fingerprint(num_splits),
         }

@@ -750,6 +750,12 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         # an explicit reader_kwargs['scheduling'] wins, and 'auto' still
         # degrades to fifo on splits too small to reorder.
         kwargs.setdefault('scheduling', job.get('scheduling', 'auto'))
+        # ...and the job's ingest-plane mode (ISSUE 14): decode workers
+        # are exactly the processes that pay object-store first-byte
+        # latency, so the per-split reader mounts the same async
+        # byte-range plane a local reader would ('auto' still stays off
+        # on local filesystems and under the kill switch).
+        kwargs.setdefault('ingest', job.get('ingest', 'auto'))
         if job.get('cache_plane') and 'cache_type' not in kwargs:
             kwargs['cache_type'] = 'plane'
             kwargs.setdefault('cache_location', job['cache_plane_dir'])
@@ -777,6 +783,24 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         if plane_metrics is not None:
             self.metrics.merge(
                 {'histograms': plane_metrics.snapshot()['histograms']})
+
+    def _accumulate_ingest_stats(self, reader):
+        """Fold one per-split reader's ingest-plane activity (ISSUE 14)
+        into the worker registry: the ``ingest_fetch``/``ingest_wait``
+        histograms reach the fleet ``stages`` rollup, the counters feed
+        the ``fetch-bound`` health regime's degrade ratio."""
+        plane = getattr(reader, 'ingest_plane', None)
+        if plane is None:
+            return
+        for name, value in plane.stats.items():
+            if name in ('ingest_fetches', 'ingest_fetch_bytes',
+                        'ingest_gets', 'ingest_degraded', 'ingest_hedges',
+                        'ingest_hedge_wins'):
+                self.metrics.counter(name).inc(int(value))
+        self.metrics.merge(
+            {'histograms': {name: hist for name, hist
+                            in plane.metrics.snapshot()['histograms'].items()
+                            if name.startswith('ingest_')}})
 
     def _cluster_chunks(self, split, fetcher):
         """Try the cluster cache tier for a leased split: peer-fill any
@@ -942,6 +966,13 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     getattr(reader, '_cache', None), 'spans', None)
                 if plane_spans is not None:
                     spans.extend(plane_spans.drain())
+                # Ingest-plane fetch/hedge spans (ISSUE 14) ride the same
+                # split 'end' header — the per-split plane's buffer is
+                # this split's fetch activity, exactly.
+                ingest_spans = getattr(
+                    getattr(reader, 'ingest_plane', None), 'spans', None)
+                if ingest_spans is not None:
+                    spans.extend(ingest_spans.drain())
                 record = None
                 if prov_on:
                     # The plane instance is per-split, so its lifetime
@@ -959,6 +990,7 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 decode_out.put(('end', split, seq, rows,
                                 spans[-_MAX_SPANS_PER_SPLIT:], record))
                 self._accumulate_cache_stats(reader)
+                self._accumulate_ingest_stats(reader)
                 if self._cluster is not None and self._cluster.ready():
                     # The per-split reader's plane just published this
                     # split's entries: advertise them on the next beat

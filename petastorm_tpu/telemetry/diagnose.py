@@ -81,6 +81,15 @@ _REGIME_ACTIONS = {
         'early and fast pieces backfill the stall window — adding '
         'workers would idle just the same; '
         'PETASTORM_TPU_NO_ADAPTIVE_SCHED=1 is the kill switch'),
+    'fetch-bound': (
+        'cold-read I/O is on the critical path: deepen the ingest '
+        "readahead (ingest_window on make_reader, or let the DataLoader "
+        'autotuner move it), check that the ingest plane is actually on '
+        "(ingest='auto' stays off on local filesystems; "
+        'PETASTORM_TPU_NO_INGEST_PLANE=1 kills it), and if '
+        'ingest_degraded is climbing, root-cause the fetch failures — '
+        'every degraded piece pays object-store first-byte latency on a '
+        'decode worker'),
 }
 
 #: |clock_drift_ms| above this breaks cross-process span ordering at
@@ -264,6 +273,13 @@ def _regime_verdicts(evidence):
                 evidence_bits.append(
                     'h2d (link) p99 %s ms vs h2d_stage (host copy) '
                     'p99 %s ms' % (link, stage))
+        elif regime == 'fetch-bound':
+            wait = _stage_p99(stages, ('ingest_wait',))
+            fetch = _stage_p99(stages, ('ingest_fetch',))
+            if wait is not None or fetch is not None:
+                evidence_bits.append(
+                    'decode blocked on fetches p99 %s ms vs fetch wall '
+                    'p99 %s ms' % (wait, fetch))
         elif regime == 'skew-bound':
             for name in ('decode', 'decode_split'):
                 stage = stages.get(name)

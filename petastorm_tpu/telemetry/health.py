@@ -38,6 +38,14 @@ Regime catalogue (``classify_regime``):
 * ``shm-degraded``   — the zero-copy result plane is falling back to
   the byte path (arena full, /dev/shm unusable).  Knobs: arena
   capacity, /dev/shm size, consumer drain rate.
+* ``fetch-bound``    — cold-read I/O is on the critical path: decode
+  measurably blocks on in-flight ingest fetches (``ingest_wait``
+  dominating stage time, or the ``ingest_fetch`` stall component), or
+  the ingest plane is degrading to synchronous reads
+  (``ingest_degraded`` vs ``ingest_fetches``).  Knobs: a deeper
+  ``ingest_window``, more fetch threads, request hedging; a degrading
+  plane wants the fetch failures root-caused (the kill switch
+  ``PETASTORM_TPU_NO_INGEST_PLANE`` is the incident lever).
 * ``skew-bound``     — per-item decode latency is heavily skewed
   (p99/p50 over :data:`SKEW_RATIO_FLOOR`) while workers show idle gaps
   (``meta['decode_utilization']`` under :data:`SKEW_UTILIZATION_CEIL`,
@@ -58,7 +66,7 @@ __all__ = ['classify_regime', 'health_report', 'report_from_frames',
 
 REGIMES = ('decode-bound', 'link-bound', 'lease-starved', 'cache-degraded',
            'cluster-cache-degraded', 'shm-degraded', 'skew-bound',
-           'healthy', 'idle')
+           'fetch-bound', 'healthy', 'idle')
 
 #: Histogram name -> pipeline component.  Names from every registry the
 #: fleet merges: service workers (decode_split/serialize/shm_publish),
@@ -70,6 +78,10 @@ STAGE_COMPONENTS = {
     'serialize': 'delivery', 'shm_publish': 'delivery',
     'device_put': 'link', 'h2d_dispatch': 'link', 'h2d_commit': 'link',
     'h2d_stage': 'link_stage',
+    # ingest_wait, NOT ingest_fetch: fetch time itself is supposed to be
+    # busy (that's the overlap working) — only decode BLOCKED on a fetch
+    # evidences the fetch-bound regime.
+    'ingest_wait': 'ingest',
 }
 
 #: attribute_stalls component -> regime it evidences.
@@ -77,6 +89,7 @@ _STALL_REGIMES = {
     'decode': 'decode-bound', 'cache_fill': 'decode-bound',
     'h2d': 'link-bound', 'h2d_stage': 'link-bound',
     'lease_wait': 'lease-starved',
+    'ingest_fetch': 'fetch-bound',
 }
 
 #: A stall component below this share of the wait does not name a regime.
@@ -132,6 +145,10 @@ def degrade_ratios(delta):
         # to a full re-decode of entries a live peer holds.
         'cluster': ratio('cache_peer_degraded',
                          ('cache_peer_fills', 'cache_remote_hits')),
+        # Ingest plane (ISSUE 14): degraded = pieces that fell back to a
+        # synchronous cold read (fetch/plan failure, abandoned checkout)
+        # — each one puts first-byte latency back on a decode worker.
+        'ingest': ratio('ingest_degraded', ('ingest_fetches',)),
     }
 
 
@@ -153,7 +170,8 @@ def classify_regime(delta, stall_pct=None, meta=None):
             ('cache', 'cache_degraded', 'cache-degraded'),
             ('cluster', 'cache_peer_degraded', 'cluster-cache-degraded'),
             ('shm', 'shm_degraded', 'shm-degraded'),
-            ('link', 'h2d_degraded', 'link-bound')):
+            ('link', 'h2d_degraded', 'link-bound'),
+            ('ingest', 'ingest_degraded', 'fetch-bound')):
         ratio = ratios.get(plane)
         if ratio is not None and ratio >= DEGRADE_RATIO_FLOOR:
             degraded = counters.get(counter_name, 0)
@@ -208,7 +226,8 @@ def classify_regime(delta, stall_pct=None, meta=None):
             component, seconds = max(busy.items(), key=lambda kv: kv[1])
             share = seconds / total
             regime = {'decode': 'decode-bound', 'link': 'link-bound',
-                      'link_stage': 'link-bound'}.get(component)
+                      'link_stage': 'link-bound',
+                      'ingest': 'fetch-bound'}.get(component)
             if regime is not None and share >= BUSY_SHARE_FLOOR:
                 candidates.append((
                     0.8 * share, regime,
@@ -270,6 +289,7 @@ def health_report(delta, stall_pct=None, meta=None, window_s=None):
     if stall_pct:
         for component, keys in (('decode', ('decode', 'cache_fill')),
                                 ('link', ('h2d', 'h2d_stage')),
+                                ('ingest', ('ingest_fetch',)),
                                 ('control', ('lease_wait',))):
             pct = max(float(stall_pct.get(k, 0.0) or 0.0) for k in keys)
             components[component] = {
@@ -278,7 +298,7 @@ def health_report(delta, stall_pct=None, meta=None, window_s=None):
                             % pct,
             }
     ratios = degrade_ratios(delta)
-    for plane in ('cache', 'cluster', 'shm', 'link'):
+    for plane in ('cache', 'cluster', 'shm', 'link', 'ingest'):
         ratio = ratios.get(plane)
         if ratio is None:
             continue

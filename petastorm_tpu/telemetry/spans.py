@@ -160,6 +160,12 @@ STALL_COMPONENTS = {
     # stall wants narrowing/overlap, so the breakdown keeps them apart.
     'h2d': ('device_put', 'h2d/dispatch', 'h2d/commit'),
     'h2d_stage': ('h2d/stage',),
+    # Ingest plane (ISSUE 14): an async range fetch (or its hedge)
+    # active while the consumer waited — when the overlap machinery is
+    # working, these spans run UNDER decode time and never intersect a
+    # data_wait; a high share here means cold-read latency is NOT being
+    # hidden (the fetch-bound regime).
+    'ingest_fetch': ('ingest/fetch', 'ingest/hedge'),
 }
 
 #: Wait-wrapper spans: ``service/split_wait`` covers the WHOLE client

@@ -5,6 +5,7 @@ a synthetic in-memory reader so adapter/integration tests don't need a
 materialized Parquet dataset.
 """
 
+from petastorm_tpu.test_util.emulation import BandwidthLimitedFilesystem  # noqa: F401
 from petastorm_tpu.test_util.fault_injection import (  # noqa: F401
     FlakyOpenFilesystem, FlakyReadFilesystem, is_data_file,
 )

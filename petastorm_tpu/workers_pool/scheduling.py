@@ -55,6 +55,15 @@ MIN_ITEMS_FOR_ADAPTIVE = 8
 MIN_WINDOW, MAX_WINDOW = 8, 256
 MIN_INFLIGHT, MAX_INFLIGHT = 4, 128
 MIN_PREFETCH, MAX_PREFETCH = 2, 8
+#: ...including the ingest plane's readahead window (ISSUE 14): how many
+#: pieces' byte ranges may sit fetched-or-fetching ahead of decode.
+MIN_INGEST_WINDOW, MAX_INGEST_WINDOW = 2, 64
+DEFAULT_INGEST_WINDOW = 8
+
+#: Decode blocked on an in-flight ingest fetch for more than this many
+#: seconds inside one tuning window means the readahead is too shallow —
+#: hidden latency waits nowhere.
+INGEST_WAIT_GROW_S = 0.05
 
 #: decode p99/p50 above this reads as cost skew worth reordering for
 #: (log2 histogram buckets: 8x is three buckets of genuine spread).
@@ -432,10 +441,12 @@ class SchedulerKnobs(object):
     the loader prefetch depth.  Owners register setters; unclaimed
     knobs are tuned but unapplied (the gauges still tell the story)."""
 
-    def __init__(self, window=64, max_inflight=16, prefetch=2):
+    def __init__(self, window=64, max_inflight=16, prefetch=2,
+                 ingest_window=DEFAULT_INGEST_WINDOW):
         self.window = int(window)
         self.max_inflight = int(max_inflight)
         self.prefetch = int(prefetch)
+        self.ingest_window = int(ingest_window)
         self._setters = {}
 
     def bind(self, name, setter):
@@ -487,13 +498,28 @@ class Autotuner(object):
         self._last_tune = 0.0
         self._last_observations = 0
         self._last_wait = self._last_step = 0.0
+        #: ingest plane (ISSUE 14): wait/fetch counters snapshotted per
+        #: window so each decision reads a DELTA, not lifetime totals.
+        self._ingest_plane = None
+        self._last_ingest_wait = self._last_ingest_fetches = 0.0
         if stall_monitor is not None:
             self._baseline_stall_monitor(stall_monitor)
         if registry is not None:
             self._g_window = registry.gauge('sched_window')
             self._g_inflight = registry.gauge('sched_max_inflight')
             self._g_prefetch = registry.gauge('sched_prefetch')
+            self._g_ingest = registry.gauge('sched_ingest_window')
             self._c_adjust = registry.counter('sched_adjust_total')
+
+    def attach_ingest(self, plane):
+        """Give the autotuner the reader's ingest plane: its measured
+        decode-blocked-on-fetch time is the window-sizing signal
+        (``ingest_wait`` > 0 means the readahead is too shallow; fetches
+        completing with zero waits mean it can shrink)."""
+        self._ingest_plane = plane
+        if plane is not None:
+            self._last_ingest_wait = plane.wait_seconds
+            self._last_ingest_fetches = plane.fetch_count
 
     def attach_stall_monitor(self, monitor):
         self._stall_monitor = monitor
@@ -587,10 +613,30 @@ class Autotuner(object):
             changed |= self._step(knobs, 'prefetch',
                                   2.0 if delivery_jitter else 0.5,
                                   MIN_PREFETCH, MAX_PREFETCH)
+        # Ingest readahead window (ISSUE 14): decode measurably blocked
+        # on an in-flight fetch this window -> deepen the readahead so
+        # bytes land earlier; a window of fetches completing with zero
+        # waits -> latency is fully hidden, shrink gently (buffer memory
+        # back).  No fetches at all is no signal — leave it alone.
+        if self._ingest_plane is not None:
+            wait = self._ingest_plane.wait_seconds
+            fetches = self._ingest_plane.fetch_count
+            d_wait = wait - self._last_ingest_wait
+            d_fetches = fetches - self._last_ingest_fetches
+            self._last_ingest_wait = wait
+            self._last_ingest_fetches = fetches
+            if d_wait > INGEST_WAIT_GROW_S:
+                changed |= self._step(knobs, 'ingest_window', 1.5,
+                                      MIN_INGEST_WINDOW, MAX_INGEST_WINDOW)
+            elif d_fetches > 0:
+                changed |= self._step(knobs, 'ingest_window', 1 / 1.25,
+                                      MIN_INGEST_WINDOW, MAX_INGEST_WINDOW)
         if self._registry is not None:
             self._g_window.set(knobs.window)
             self._g_inflight.set(knobs.max_inflight)
             self._g_prefetch.set(knobs.prefetch)
+            if self._ingest_plane is not None:
+                self._g_ingest.set(knobs.ingest_window)
             if changed:
                 self._c_adjust.inc()
         return changed

@@ -88,8 +88,14 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
                  randomize_item_order=False, random_seed=0,
                  max_ventilation_queue_size=None,
                  start_epoch=0, start_cursor=0, prologue_items=None,
-                 dispatch_policy=None):
+                 dispatch_policy=None, dispatch_listener=None):
         super(ConcurrentVentilator, self).__init__(ventilate_fn)
+        #: Called with every VentilatedItem in the ACTUAL dispatch order
+        #: (prologue + epochs, FIFO or adaptive early-launch alike), just
+        #: before the pool sees it â€” the ingest plane's readahead feed
+        #: (ISSUE 14).  Must be fast and non-blocking; a listener that
+        #: raises is disabled, never fatal to the epoch.
+        self._dispatch_listener = dispatch_listener
         if iterations is not None and iterations <= 0:
             raise ValueError('iterations must be positive or None, got %r' % (iterations,))
         self._items = list(items)
@@ -258,6 +264,7 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
             out = self._next_dispatch(self._pick_prologue)
             if out is None:
                 return
+            self._notify_listener(out)
             self._ventilate_fn(out)
         if not self._items:
             # Prologue-only ventilator (elastic reshard onto more shards
@@ -278,11 +285,22 @@ class ConcurrentVentilator(Ventilator):  # ptlint: disable=pickle-unsafe-attrs â
                     return
                 if out is _EPOCH_DONE:
                     break
+                self._notify_listener(out)
                 self._ventilate_fn(out)
             with self._lock:
                 self._epoch += 1
                 self._cursor = 0
         self._completed.set()
+
+    def _notify_listener(self, out):
+        if self._dispatch_listener is None:
+            return
+        try:
+            self._dispatch_listener(out)
+        except Exception:  # noqa: BLE001 â€” advisory feed, never fatal
+            logger.exception('dispatch_listener raised; disabling it '
+                             '(readahead degrades, delivery unaffected)')
+            self._dispatch_listener = None
 
     def take_dispatch_meta(self, position):
         """Pop the dispatch decision recorded for ``position`` (None when

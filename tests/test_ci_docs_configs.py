@@ -203,6 +203,38 @@ def test_bench_compact_line_pins_cluster_cache_fields():
     assert 'cluster_cache_images_per_sec_warm' in trend.TRACKED_FIELDS
 
 
+def test_bench_compact_line_pins_object_store_ingest_fields():
+    """The ingest plane's evidence (ISSUE 14): sync vs plane cold-epoch
+    throughput, the ratio, the in-leg delivery-digest flag, and the
+    degrade count must ride the compact machine line; the leg must sit
+    in the shared host-leg table; the plane throughput must be
+    trend-gated; and the docs must carry the new kwargs/regime rows."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    for field in ('object_store_ingest_images_per_sec_sync',
+                  'object_store_ingest_images_per_sec_plane',
+                  'object_store_ingest_plane_over_sync',
+                  'object_store_ingest_delivery_identical',
+                  'object_store_ingest_degraded'):
+        assert "'%s'" % field in block.group(1), field
+    assert re.search(
+        r"_IPC_PLANE_LEGS = \((?:.|\n)*?object_store_ingest_leg", src), \
+        'object_store_ingest_leg missing from the leg table'
+    from petastorm_tpu.benchmark import trend
+    assert 'object_store_ingest_images_per_sec_plane' in trend.TRACKED_FIELDS
+    perf = open(os.path.join(REPO, 'docs', 'performance.md')).read()
+    for needle in ('ingest_window', 'PETASTORM_TPU_NO_INGEST_PLANE',
+                   'object_store_ingest'):
+        assert needle in perf, needle
+    api = open(os.path.join(REPO, 'docs', 'api.md')).read()
+    assert '`ingest`' in api and '`ingest_window`' in api
+    obs = open(os.path.join(REPO, 'docs', 'observability.md')).read()
+    for needle in ('fetch-bound', 'ingest_degraded', 'ingest_wait',
+                   'sched_ingest_window'):
+        assert needle in obs, needle
+
+
 def test_bench_compact_line_pins_provenance_fields():
     """The provenance plane's overhead evidence (ISSUE 13): the
     interleaved on/off rates and the derived overhead percentage must
@@ -252,7 +284,8 @@ def test_docs_span_catalogue_synced_with_code():
         'service/split_wait', 'service/decode_split',
         'service/serve_cached_split', 'service/serialize',
         'service/shm_publish', 'pool/process', 'pool/publish',
-        'h2d/stage', 'h2d/dispatch', 'h2d/commit', 'cache/fill')
+        'h2d/stage', 'h2d/dispatch', 'h2d/commit', 'cache/fill',
+        'ingest/fetch', 'ingest/hedge')
     for name in live_spans:
         assert name in obs, 'span %r missing from the docs catalogue' % name
     # ...and the literal list above must itself stay live: each name is

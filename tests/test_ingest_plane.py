@@ -1,0 +1,570 @@
+"""Latency-hiding object-store ingest plane (ISSUE 14).
+
+Covers the satellite test matrix: range-coalescing planner golden cases,
+bit-identity vs the synchronous path across pools and the service
+worker, hedge winner/loser cancellation, mid-epoch fetch-failure degrade
+with full delivery, kill-switch inertness, the ``fetch-bound`` health
+regime, the autotuner's ``ingest_window`` knob, and the per-worker
+open-file LRU.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.ingest import (IngestMissError, IngestPlane, SparseFile,
+                                  coalesce, column_chunk_ranges, read_footer,
+                                  resolve_ingest)
+
+from test_common import create_test_dataset
+
+ROWS = 96
+ROWS_PER_GROUP = 8   # -> 12 row groups
+
+
+# -- planner golden cases -----------------------------------------------------
+
+def test_coalesce_adjacent_and_gapped():
+    # adjacent ranges merge; a gap <= merge_gap merges (gap bytes paid);
+    # a gap past it splits
+    assert coalesce([(0, 10), (10, 10)], merge_gap=0) == [(0, 20)]
+    assert coalesce([(0, 10), (15, 10)], merge_gap=5) == [(0, 25)]
+    assert coalesce([(0, 10), (16, 10)], merge_gap=5) == [(0, 10), (16, 10)]
+    # unsorted input sorts; zero/negative lengths drop
+    assert coalesce([(30, 5), (0, 10), (10, 0)], merge_gap=0) == \
+        [(0, 10), (30, 5)]
+
+
+def test_coalesce_oversize_ranges_split_and_cap_merging():
+    # a single oversize chunk splits into bounded GETs...
+    assert coalesce([(0, 100)], merge_gap=0, max_range_bytes=40) == \
+        [(0, 40), (40, 40), (80, 20)]
+    # ...and two mergeable ranges stay apart when the merge would
+    # exceed the cap
+    assert coalesce([(0, 30), (30, 30)], merge_gap=0, max_range_bytes=40) \
+        == [(0, 30), (30, 30)]
+
+
+@pytest.fixture(scope='module')
+def parquet_file(tmp_path_factory):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = str(tmp_path_factory.mktemp('ingestpq') / 'probe.parquet')
+    rng = np.random.default_rng(0)
+    # payload is INCOMPRESSIBLE so the file outgrows the 64 KiB footer
+    # tail — a tail covering the whole file would make every plan
+    # trivially complete and the miss cases unreachable
+    table = pa.table({
+        'idx': pa.array(np.arange(64, dtype=np.int64)),
+        'label': pa.array(np.arange(64, dtype=np.int32)),
+        'payload': pa.array([rng.integers(0, 256, 8192)
+                             .astype(np.uint8).tobytes()
+                             for _ in range(64)], type=pa.binary()),
+    })
+    pq.write_table(table, path, row_group_size=32)
+    return path
+
+
+def test_column_subset_plans_fewer_bytes(parquet_file):
+    with open(parquet_file, 'rb') as handle:
+        metadata, _, _ = read_footer(handle,
+                                     os.path.getsize(parquet_file))
+    full = column_chunk_ranges(metadata, 0, None)
+    subset = column_chunk_ranges(metadata, 0, {'idx'})
+    assert sum(n for _, n in subset) < sum(n for _, n in full)
+    # an unknown selection (schema drift) over-fetches the whole group
+    # rather than missing pages
+    assert column_chunk_ranges(metadata, 0, {'nope'}) == full
+    with pytest.raises(Exception):
+        column_chunk_ranges(metadata, 9, None)   # row group out of range
+
+
+def test_union_plan_serves_predicate_two_pass_reads(parquet_file):
+    """The plane fetches selected+predicate columns as ONE union plan;
+    both predicate passes (predicate cols first, remaining cols for
+    passing rows) must read from the same sparse buffer."""
+    import pyarrow.parquet as pq
+    size = os.path.getsize(parquet_file)
+    with open(parquet_file, 'rb') as handle:
+        metadata, tail_off, tail = read_footer(handle, size)
+        segments = {tail_off: tail}
+        for off, n in coalesce(column_chunk_ranges(
+                metadata, 0, {'idx', 'payload'})):
+            handle.seek(off)
+            segments[off] = handle.read(n)
+    pf = pq.ParquetFile(SparseFile(size, segments))
+    direct = pq.ParquetFile(parquet_file)
+    # two-pass: the predicate column alone, then the remaining column
+    assert pf.read_row_group(0, columns=['idx']).equals(
+        direct.read_row_group(0, columns=['idx']))
+    assert pf.read_row_group(0, columns=['payload']).equals(
+        direct.read_row_group(0, columns=['payload']))
+    # ...but a column OUTSIDE the plan is a miss, not garbage.  Re-plan
+    # with merge_gap=0: the default 64 KiB gap-merge legitimately
+    # swallows the tiny 'label' chunk sitting between idx and payload.
+    with open(parquet_file, 'rb') as handle:
+        tight = {tail_off: tail}
+        for off, n in coalesce(column_chunk_ranges(
+                metadata, 0, {'idx', 'payload'}), merge_gap=0):
+            handle.seek(off)
+            tight[off] = handle.read(n)
+    with pytest.raises(IngestMissError):
+        pq.ParquetFile(SparseFile(size, tight)).read_row_group(
+            0, columns=['label'])
+
+
+def test_sparse_file_protocol():
+    sf = SparseFile(20, {0: b'0123456789', 10: b'abcdefghij'})
+    sf.seek(-5, 2)
+    assert sf.read() == b'fghij'
+    sf.seek(8)
+    assert sf.read(4) == b'89ab'   # read crossing segment boundary
+    miss = SparseFile(20, {0: b'0123456789'})
+    miss.seek(5)
+    with pytest.raises(IngestMissError):
+        miss.read(10)
+    assert not isinstance(IngestMissError('x'), OSError)  # never retried
+
+
+# -- reader wire-through ------------------------------------------------------
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('ingestds')
+    return create_test_dataset('file://' + str(path), num_rows=ROWS,
+                               rows_per_rowgroup=ROWS_PER_GROUP)
+
+
+def _read_rows(url, **kwargs):
+    from petastorm_tpu import make_reader
+    kwargs.setdefault('schema_fields', ['id'])
+    kwargs.setdefault('shuffle_row_groups', True)
+    kwargs.setdefault('seed', 9)
+    kwargs.setdefault('num_epochs', 2)
+    with make_reader(url, **kwargs) as reader:
+        rows = [int(r.id) for r in reader]
+        diag = dict(reader.diagnostics)
+    return rows, diag
+
+
+def test_bit_identity_thread_and_dummy_pools(dataset):
+    """Same dataset, same seed: the plane must deliver exactly what the
+    synchronous path delivers, in the same order, on both in-process
+    pools (adaptive scheduling pins thread-pool delivery to epoch
+    order, so order is comparable)."""
+    sync, d_sync = _read_rows(dataset.url, workers_count=4,
+                              scheduling='adaptive', ingest='off')
+    plane, d_plane = _read_rows(dataset.url, workers_count=4,
+                                scheduling='adaptive', ingest='plane')
+    assert d_sync['ingest'] == 'off' and d_plane['ingest'] == 'plane'
+    assert plane == sync
+    assert d_plane['ingest_fetches'] > 0
+    assert d_plane['ingest_degraded'] == 0
+    dummy_sync, _ = _read_rows(dataset.url, reader_pool_type='dummy',
+                               ingest='off')
+    dummy_plane, dd = _read_rows(dataset.url, reader_pool_type='dummy',
+                                 ingest='plane')
+    assert dd['ingest'] == 'plane'
+    assert dummy_plane == dummy_sync
+
+
+def test_process_pool_resolves_off(dataset):
+    """The plane's buffers cannot cross the worker pickle boundary:
+    even an explicit 'plane' resolves off on a ProcessPool reader, and
+    delivery is unaffected."""
+    rows, diag = _read_rows(dataset.url, reader_pool_type='process',
+                            workers_count=2, ingest='plane', num_epochs=1,
+                            shuffle_row_groups=False)
+    assert diag['ingest'] == 'off'
+    assert sorted(rows) == list(range(ROWS))
+
+
+def test_kill_switch_inert(dataset, monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_NO_INGEST_PLANE', '1')
+    rows, diag = _read_rows(dataset.url, workers_count=4, ingest='plane',
+                            num_epochs=1)
+    assert diag['ingest'] == 'off'
+    assert 'ingest_fetches' not in diag
+    monkeypatch.delenv('PETASTORM_TPU_NO_INGEST_PLANE')
+    # ...and 'auto' on a local filesystem stays off without the switch
+    _, diag2 = _read_rows(dataset.url, workers_count=4, num_epochs=1)
+    assert diag2['ingest'] == 'off'
+
+
+class _RemoteLookingFs(object):
+    """Delegating wrapper whose protocol claims object-store storage —
+    what 'auto' keys on; bytes still come from local disk."""
+
+    protocol = 's3'
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_auto_enables_on_remote_protocol(dataset):
+    import fsspec
+    fs = _RemoteLookingFs(fsspec.filesystem('file'))
+    assert resolve_ingest('auto', fs) == 'plane'
+    sync, _ = _read_rows(dataset.url, workers_count=4, filesystem=fs,
+                         scheduling='adaptive', ingest='off', num_epochs=1)
+    rows, diag = _read_rows(dataset.url, workers_count=4, filesystem=fs,
+                            scheduling='adaptive', num_epochs=1)
+    assert diag['ingest'] == 'plane'
+    assert diag['ingest_fetches'] > 0
+    assert rows == sync
+
+
+def test_resolve_validation_and_eager_typo(dataset):
+    with pytest.raises(ValueError):
+        resolve_ingest('sometimes')
+    from petastorm_tpu import make_reader
+    with pytest.raises(ValueError):
+        make_reader(dataset.url, ingest='sometimes')
+
+
+def test_fetch_failure_degrades_mid_epoch(dataset):
+    """Every plane fetch fails (injected), every piece degrades to the
+    synchronous path — the epoch still delivers in full and the degrade
+    is counted."""
+    import fsspec
+
+    from petastorm_tpu.test_util import FlakyOpenFilesystem
+    fs = FlakyOpenFilesystem(fsspec.filesystem('file'), fail_times=1)
+    rows, diag = _read_rows(dataset.url, workers_count=4, filesystem=fs,
+                            ingest='plane', scheduling='fifo', num_epochs=1,
+                            shuffle_row_groups=False)
+    assert sorted(rows) == list(range(ROWS))
+    assert diag['ingest'] == 'plane'
+    assert diag['ingest_degraded'] > 0
+
+
+class _DictCache(object):
+    """Minimal in-memory result cache (the user-instance cache_type
+    surface): second epoch is all hits."""
+
+    def __init__(self):
+        self.store = {}
+        self.hits = 0
+
+    def get(self, key, fill):
+        if key in self.store:
+            self.hits += 1
+        else:
+            self.store[key] = fill()
+        return self.store[key]
+
+    def cleanup(self):
+        pass
+
+
+def test_cache_hits_release_prefetched_entries(dataset):
+    """A result-cache HIT never reads Parquet — the plane's prefetched
+    entry for that dispatch must be RELEASED, not leaked: a warm epoch
+    would otherwise wedge the readahead window full and pin its
+    buffers for the reader's lifetime."""
+    cache = _DictCache()
+    rows, diag = _read_rows(dataset.url, workers_count=4, ingest='plane',
+                            scheduling='adaptive', num_epochs=2,
+                            shuffle_row_groups=False, cache_type=cache)
+    assert rows == list(range(ROWS)) * 2
+    assert cache.hits >= ROWS // ROWS_PER_GROUP   # epoch 2 hit the cache
+    # nothing left pinned: window slots and buffered bytes all returned
+    assert diag['ingest_occupancy'] == 0
+    assert diag['ingest_pending'] == 0
+    assert diag['ingest_buffered_bytes'] == 0
+
+
+# -- hedging + demand promotion (plane unit level) ----------------------------
+
+class _Piece(object):
+    def __init__(self, path, row_group):
+        self.path, self.row_group = path, row_group
+
+
+class _StallFirstOpenFs(object):
+    """First open of each file hands back a handle whose reads block on
+    ``release`` — a straggling GET; later opens pass through."""
+
+    protocol = 's3'
+
+    def __init__(self, inner, release):
+        self._inner = inner
+        self._release = release
+        self._opened = set()
+        self._lock = threading.Lock()
+
+    def open(self, path, mode='rb', **kwargs):
+        handle = self._inner.open(path, mode, **kwargs)
+        with self._lock:
+            first = path not in self._opened
+            self._opened.add(path)
+        if first:
+            return _StalledFile(handle, self._release)
+        return handle
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _StalledFile(object):
+    def __init__(self, inner, release):
+        self._inner = inner
+        self._release = release
+
+    def read(self, *args, **kwargs):
+        self._release.wait(30)
+        return self._inner.read(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_hedge_winner_and_loser_cancellation(parquet_file):
+    import fsspec
+    import pyarrow.parquet as pq
+    release = threading.Event()
+    fs = _StallFirstOpenFs(fsspec.filesystem('file'), release)
+    pieces = [_Piece(parquet_file, 0)]
+    plane = IngestPlane(fs, pieces, fetch_threads=1,
+                        hedge_deadline_s=0.05)
+    try:
+        plane.observe_dispatch((0,))
+        pf = plane.checkout(parquet_file, 0)  # blocks, hedges, hedge wins
+        assert pf is not None
+        assert pf.read_row_group(0).equals(
+            pq.ParquetFile(parquet_file).read_row_group(0))
+        stats = plane.stats
+        assert stats['ingest_hedges'] == 1
+        assert stats['ingest_hedge_wins'] == 1
+        assert stats['ingest_degraded'] == 0
+        # release the straggler: the loser must notice it lost and
+        # discard without corrupting anything or counting a fetch
+        release.set()
+        time.sleep(0.1)
+        assert plane.stats['ingest_fetches'] == 1
+    finally:
+        release.set()
+        plane.close()
+
+
+def test_demand_promotion_bypasses_full_window(parquet_file):
+    """A piece decode demands while the window is full of earlier work
+    must still fetch (window overdraft on demand) — the no-deadlock
+    guarantee."""
+    import fsspec
+    pieces = [_Piece(parquet_file, 0), _Piece(parquet_file, 1)]
+    plane = IngestPlane(fsspec.filesystem('file'), pieces,
+                        window=2, fetch_threads=1)
+    try:
+        plane.observe_dispatch((0,))
+        plane.observe_dispatch((1,))
+        # demand the LAST enqueued piece first; with window 2 and one
+        # fetch thread it may still be queued — promotion must serve it
+        pf = plane.checkout(parquet_file, 1)
+        assert pf is not None and pf.read_row_group(1).num_rows == 32
+    finally:
+        plane.close()
+
+
+def test_plane_close_unblocks_checkout(parquet_file):
+    import fsspec
+    release = threading.Event()
+    fs = _StallFirstOpenFs(fsspec.filesystem('file'), release)
+    plane = IngestPlane(fs, [_Piece(parquet_file, 0)], fetch_threads=1)
+    plane.observe_dispatch((0,))
+    result = {}
+
+    def check():
+        result['pf'] = plane.checkout(parquet_file, 0)
+
+    thread = threading.Thread(target=check, daemon=True)
+    thread.start()
+    time.sleep(0.1)
+    plane.close()
+    release.set()
+    thread.join(10)
+    assert not thread.is_alive()
+    assert result['pf'] is None   # degraded to sync, uncounted (shutdown)
+
+
+# -- health regime + autotuner knob ------------------------------------------
+
+def _hist(count, total, bucket=20):
+    counts = [0] * 64
+    counts[bucket] = count
+    return {'counts': counts, 'count': count, 'sum': total}
+
+
+def test_fetch_bound_regime_and_verdict():
+    """Synthetic starved-fetch fixture: decode blocked on fetches
+    dominates the window -> fetch-bound regime -> diagnose verdict with
+    the ingest knob."""
+    from petastorm_tpu.telemetry import diagnose, health
+    delta = {'histograms': {'ingest_wait': _hist(24, 9.0),
+                            'decode': _hist(24, 0.4, bucket=12)},
+             'counters': {}}
+    report = health.health_report(delta)
+    assert report['regime'] == 'fetch-bound'
+    verdicts = diagnose.run_rules({'health': report, 'stages': {},
+                                   'counters': {}, 'meta': {},
+                                   'workers': {}})
+    fetch = [v for v in verdicts if v['id'] == 'fetch-bound']
+    assert fetch and 'ingest' in fetch[0]['action']
+    # degrade ratio alone also names the regime
+    degraded = {'histograms': {},
+                'counters': {'ingest_degraded': 5, 'ingest_fetches': 20}}
+    candidates = health.classify_regime(degraded)
+    assert any(r == 'fetch-bound' for _, r, _ in candidates)
+
+
+def test_set_window_grows_fetch_pool(parquet_file):
+    """Widening the window must widen fetch concurrency: an unpinned
+    plane grows its fetch pool with the window (an explicit
+    fetch_threads stays pinned)."""
+    import fsspec
+    plane = IngestPlane(fsspec.filesystem('file'),
+                        [_Piece(parquet_file, 0)], window=4)
+    try:
+        assert len(plane._threads) == 4
+        plane.set_window(12)
+        assert len(plane._threads) == 12
+        plane.set_window(4)          # shrink never reaps threads
+        assert len(plane._threads) == 12
+    finally:
+        plane.close()
+    pinned = IngestPlane(fsspec.filesystem('file'),
+                         [_Piece(parquet_file, 0)], window=4,
+                         fetch_threads=2)
+    try:
+        pinned.set_window(16)
+        assert len(pinned._threads) == 2
+    finally:
+        pinned.close()
+
+
+class _FakePlane(object):
+    def __init__(self):
+        self.wait_seconds = 0.0
+        self.fetch_count = 0
+        self.window = 8
+
+    def set_window(self, window):
+        self.window = int(window)
+
+
+def test_autotuner_moves_ingest_window():
+    from petastorm_tpu.workers_pool import scheduling as sched
+    plane = _FakePlane()
+    knobs = sched.SchedulerKnobs(ingest_window=8)
+    knobs.bind('ingest_window', plane.set_window)
+    tuner = sched.Autotuner(interval_s=0.0)
+    tuner.attach_ingest(plane)
+    # decode blocked on fetches -> grow
+    plane.wait_seconds = 1.0
+    plane.fetch_count = 10
+    assert tuner.tune(knobs)
+    assert knobs.ingest_window == 12 and plane.window == 12
+    # a window of fetches with zero new waits -> gentle shrink
+    plane.fetch_count = 20
+    assert tuner.tune(knobs)
+    assert knobs.ingest_window == 10
+    # no fetches, no waits -> no movement
+    before = knobs.ingest_window
+    tuner.tune(knobs)
+    assert knobs.ingest_window == before
+
+
+# -- per-worker open-file LRU (satellite) -------------------------------------
+
+class _RecordingFs(object):
+    """Delegating local fs that tracks every handle it opened (a
+    non-plain-local wrapper, so workers route through fs.open)."""
+
+    protocol = 'file'
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.handles = []
+
+    def open(self, path, mode='rb', **kwargs):
+        handle = self._inner.open(path, mode, **kwargs)
+        self.handles.append(handle)
+        return handle
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_open_file_cache_is_lru_bounded(parquet_file, tmp_path, monkeypatch):
+    import shutil
+
+    import fsspec
+
+    from petastorm_tpu.arrow_reader_worker import ArrowReaderWorker
+    monkeypatch.setenv('PETASTORM_TPU_MAX_OPEN_FILES', '2')
+    paths = []
+    for i in range(4):
+        path = str(tmp_path / ('f%d.parquet' % i))
+        shutil.copy(parquet_file, path)
+        paths.append(path)
+    fs = _RecordingFs(fsspec.filesystem('file'))
+    args = type('A', (), {'filesystem': fs})()
+    worker = ArrowReaderWorker(0, lambda *_: None, args)
+    for path in paths:
+        worker._parquet_file(path)
+    assert len(worker._open_files) == 2
+    assert list(worker._open_files) == paths[-2:]
+    # evicted handles are CLOSED, not leaked
+    assert [h.closed for h in fs.handles] == [True, True, False, False]
+    # re-reading a cached path refreshes recency instead of reopening
+    worker._parquet_file(paths[2])
+    assert len(fs.handles) == 4
+    worker._parquet_file(paths[0])           # reopens; evicts paths[3] (LRU)
+    assert paths[3] not in worker._open_files
+    worker.shutdown()
+    assert all(h.closed for h in fs.handles)
+
+
+# -- service worker inherits the plane ----------------------------------------
+
+def test_service_config_carries_ingest_mode(dataset):
+    from petastorm_tpu.service import ServiceConfig
+    config = ServiceConfig(dataset.url, ingest='plane')
+    assert config.job_info(4)['ingest'] == 'plane'
+    assert ServiceConfig(dataset.url).job_info(4)['ingest'] == 'auto'
+    with pytest.raises(ValueError):
+        ServiceConfig(dataset.url, ingest='sometimes')
+
+
+def test_service_worker_bit_identity_with_plane(dataset):
+    """One dispatcher + one worker + one consumer, per-split readers
+    mounting the plane: exactly-once delivery of every row, identical
+    to the synchronous service run."""
+    from petastorm_tpu.service import (Dispatcher, ServiceConfig,
+                                       ServiceDataLoader, Worker)
+
+    def run(ingest_mode):
+        config = ServiceConfig(dataset.url, num_consumers=1,
+                               rowgroups_per_split=3,
+                               reader_kwargs={'workers_count': 2},
+                               ingest=ingest_mode)
+        ids = []
+        with Dispatcher(config) as dispatcher:
+            with Worker(dispatcher.addr):
+                loader = ServiceDataLoader(dispatcher.addr, batch_size=8,
+                                           consumer=0, drop_last=False)
+                with loader:
+                    for batch in loader.iter_host_batches():
+                        ids.extend(np.asarray(batch['id']).tolist())
+        return ids
+
+    sync_ids = run('off')
+    plane_ids = run('plane')
+    assert sorted(plane_ids) == list(range(ROWS))
+    assert sorted(plane_ids) == sorted(sync_ids)

@@ -156,7 +156,7 @@ def test_stall_monitor_report_carries_breakdown(dataset):
     report = monitor.report()
     assert set(report['stall_breakdown']) == {
         'lease_wait', 'decode', 'ipc', 'cache_fill', 'h2d', 'h2d_stage',
-        'other'}
+        'ingest_fetch', 'other'}
     component, pct = report['stall_top_component'].split(':')
     assert component in report['stall_breakdown']
     assert pct.endswith('%')
@@ -219,14 +219,15 @@ THREAD_READER_KEYS = {
     'decode_p50_ms', 'decode_p99_ms', 'ventilated_count',
     'prologue_remaining', 'cursor', 'epoch', 'seed',
     # ISSUE 9: effective dispatch policy + live reorder-stage depth
-    'scheduling', 'reorder_pending'}
+    # ISSUE 14: effective ingest-plane mode after 'auto' resolution
+    'scheduling', 'reorder_pending', 'ingest'}
 
 PROCESS_READER_KEYS = {
     'pool', 'workers_count', 'items_processed', 'inflight', 'workers_alive',
     'shm_results', 'shm_degraded', 'decode_busy_s', 'decode_utilization',
     'decode_p50_ms', 'decode_p99_ms', 'ventilated_count',
     'prologue_remaining', 'cursor', 'epoch', 'seed',
-    'scheduling', 'reorder_pending'}
+    'scheduling', 'reorder_pending', 'ingest'}
 
 LOADER_ONLY_KEYS = {
     'batches',
