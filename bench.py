@@ -1032,6 +1032,169 @@ def delivery_plane_service_leg(worker_counts=(1, 2, 4), shm_pairs=3):
     return fields
 
 
+def control_plane_recovery_leg(pairs=2, consume_batches=10):
+    """Crash-survivable control plane (ISSUE 15): time-to-first-batch
+    after a dispatcher restart, ledger-restored vs cold, measured on a
+    LIVE client (no resume token — the mid-training scenario a
+    dispatcher crash actually interrupts).
+
+    Procedure per run: serve ~``consume_batches`` host batches of the
+    pre-decoded service dataset, quiesce (worker down, client drained
+    and waiting), then bring up a NEW dispatcher on the same port + a
+    fresh worker and time until the client delivers its first
+    not-yet-seen row.  Cold restart forgets the ledger: the fleet
+    re-decodes (and the client dedupes) every already-delivered split
+    before new rows flow.  Ledger-restored skips straight to the
+    remaining work.  Interleaved pairs, medians; exactly-once asserted
+    in-leg on every run (restart must never cost correctness, only
+    latency)."""
+    import socket
+    import tempfile
+    import threading
+
+    from petastorm_tpu.service import (Dispatcher, ServiceConfig,
+                                       ServiceDataLoader, Worker)
+
+    ensure_raw_svc_dataset()
+    workdir = tempfile.mkdtemp(prefix='ptcp-recovery-')
+
+    def measure(with_ledger, tag):
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            addr = 'tcp://127.0.0.1:%d' % s.getsockname()[1]
+        ledger_path = (os.path.join(workdir, 'ledger_%s.json' % tag)
+                       if with_ledger else None)
+        config = ServiceConfig(
+            SVC_DATASET_URL, num_consumers=1, rowgroups_per_split=2,
+            lease_ttl_s=10.0, ledger_path=ledger_path,
+            reader_kwargs={'workers_count': max(2, WORKERS // 2)})
+        d1 = Dispatcher(config, bind=addr).start()
+        w1 = Worker(addr).start()
+        deliveries = []   # (t_mono, [row ids]) per host batch
+        pump_errors = []  # surfaced in the driver loop — a dead pump
+        done = threading.Event()     # must name ITS error, not wedge
+                                     # the leg into a misleading timeout
+
+        loaders = []
+
+        def pump():
+            try:
+                loader = ServiceDataLoader(addr, batch_size=BATCH,
+                                           consumer=0, drop_last=False,
+                                           queue_splits=1, credits=4)
+                loaders.append(loader)
+                with loader:
+                    for batch in loader.iter_host_batches():
+                        deliveries.append(
+                            (time.monotonic(),
+                             np.asarray(batch['noun_id']).tolist()))
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                pump_errors.append(e)
+            finally:
+                done.set()
+
+        def check_pump():
+            if pump_errors:
+                raise pump_errors[0]
+
+        def stop_loaders():
+            for loader in loaders:
+                try:
+                    loader.reader.stop()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+
+        consumer = threading.Thread(target=pump, daemon=True)
+        consumer.start()
+        try:
+            deadline = time.monotonic() + 300.0
+            while len(deliveries) < consume_batches \
+                    and not done.is_set():
+                if time.monotonic() > deadline:
+                    raise RuntimeError('recovery leg: first phase '
+                                       'wedged')
+                time.sleep(0.05)
+            check_pump()
+        except BaseException:
+            stop_loaders()
+            raise
+        finally:
+            # Quiesce on the happy path AND teardown on error: the
+            # phase-1 service must never outlive measure() — a leaked
+            # live worker/dispatcher would contaminate every later
+            # bench leg's measurements.  (Also part of the protocol:
+            # no pre-restart decode may feed the TTFB — the worker's
+            # buffers die with it, the client drains to a steady wait.)
+            w1.stop()
+            w1.join()
+            d1.stop()
+            d1.join()
+        while deliveries and time.monotonic() - deliveries[-1][0] < 0.75:
+            time.sleep(0.05)
+        seen_before = {i for _, ids in deliveries for i in ids}
+        t0 = time.monotonic()
+        d2 = Dispatcher(config, bind=addr).start()
+        w2 = Worker(addr).start()
+        ttfb = None
+        try:
+            while True:
+                check_pump()
+                fresh = [(t, ids) for t, ids in deliveries if t > t0
+                         and set(ids) - seen_before]
+                if fresh:
+                    ttfb = fresh[0][0] - t0
+                    break
+                if done.is_set():
+                    raise RuntimeError('recovery leg: epoch ended with '
+                                       'no new rows after restart')
+                if time.monotonic() > deadline:
+                    raise RuntimeError('recovery leg: no new rows after '
+                                       'restart')
+                time.sleep(0.02)
+            done.wait(timeout=max(1.0, deadline - time.monotonic()))
+            check_pump()
+            if not done.is_set():
+                raise RuntimeError('recovery leg: epoch wedged after '
+                                   'restart')
+            delivered = sorted(i for _, ids in deliveries for i in ids)
+            exactly_once = delivered == list(range(SVC_ROWS))
+            restores = d2.ledger_restores
+        except BaseException:
+            stop_loaders()
+            raise
+        finally:
+            w2.stop()
+            w2.join()
+            d2.stop()
+            d2.join()
+        return ttfb, exactly_once, restores
+
+    cold, restored = [], []
+    exact = True
+    try:
+        for pair in range(max(1, int(pairs))):
+            ttfb, ok, restores = measure(True, 'restored_%d' % pair)
+            assert restores == 1, \
+                'ledger arm never restored (restores=%r)' % restores
+            restored.append(ttfb)
+            exact = exact and ok
+            ttfb, ok, _ = measure(False, 'cold_%d' % pair)
+            cold.append(ttfb)
+            exact = exact and ok
+    finally:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+    cold_s = float(np.median(cold))
+    restored_s = float(np.median(restored))
+    return {
+        'control_plane_ttfb_cold_s': round(cold_s, 3),
+        'control_plane_ttfb_restored_s': round(restored_s, 3),
+        'control_plane_recovery_speedup':
+            round(cold_s / restored_s, 2) if restored_s else None,
+        'control_plane_exactly_once': bool(exact),
+    }
+
+
 def _make_light_step():
     """A cheap jitted step with the SAME state/signature as
     ``_make_resnet_step`` (so ``_device_floor_ms`` / ``_run_stall`` /
@@ -1813,6 +1976,7 @@ _IPC_PLANE_LEGS = (
     ('adaptive_sched', adaptive_sched_leg),
     ('object_store_ingest', object_store_ingest_leg),
     ('provenance_overhead', provenance_overhead_leg),
+    ('control_plane_recovery', control_plane_recovery_leg),
 )
 
 
@@ -2094,6 +2258,10 @@ _COMPACT_KEYS = (
     'provenance_images_per_sec_on',
     'provenance_images_per_sec_off',
     'provenance_overhead_pct',
+    'control_plane_ttfb_cold_s',
+    'control_plane_ttfb_restored_s',
+    'control_plane_recovery_speedup',
+    'control_plane_exactly_once',
     'ipc_bytes_per_s', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',

@@ -52,6 +52,9 @@ TRACKED_FIELDS = (
     'object_store_ingest_images_per_sec_plane',
     'cluster_cache_images_per_sec_warm',
     'dlrm_host_rows_per_s',
+    # ISSUE 15: ledger-restored over cold dispatcher-restart TTFB — a
+    # ratio, so host-load noise on the absolute TTFBs largely cancels.
+    'control_plane_recovery_speedup',
 )
 
 #: The ONLY backend labels ``bench.py`` ever emits: ``jax.default_backend()``

@@ -23,6 +23,14 @@ machinery:
   over the epoch-cache plane's content-fingerprint digests (on by
   default with ``cache_plane=True``; kill switch
   ``PETASTORM_TPU_NO_CLUSTER_CACHE=1``).
+* ``petastorm_tpu.service.ledger`` — the durable dispatcher ledger
+  (ISSUE 15): crash-safe snapshot/restore of split states, attempt
+  counters, and the cache directory (``ServiceConfig(ledger_path=)``),
+  with held-claim reconciliation so a dispatcher restart resumes the
+  epoch instead of re-decoding the world.  Workers drain gracefully on
+  SIGTERM / the ``drain`` RPC, and ``petastorm-tpu-chaos``
+  (``test_util/chaos.py``) is the scenario matrix proving digest +
+  exactly-once + zero residue under injected faults.
 
 Console entry point: ``petastorm-tpu-data-service`` (see
 ``petastorm_tpu/service/cli.py``).

@@ -67,6 +67,16 @@ def _build_parser():
                         'filesystems — the object-store case decode '
                         'workers exist for; PETASTORM_TPU_NO_INGEST_'
                         'PLANE=1 is the kill switch')
+    d.add_argument('--ledger-path', default=None,
+                   help='durable dispatcher ledger file (ISSUE 15): '
+                        'split states, attempt counters, and the cache '
+                        'directory persist crash-safely, and a '
+                        'restarted dispatcher pointed at the same path '
+                        '(and port) resumes the job instead of '
+                        're-decoding the world')
+    d.add_argument('--drain-timeout-s', type=float, default=30.0,
+                   help='how long a draining worker may spend finishing '
+                        'in-flight splits before deregistering timed_out')
     d.add_argument('--no-telemetry-spans', action='store_true',
                    help='do not ship per-split correlated stage spans on '
                         'the data-plane end headers (metrics registries '
@@ -95,6 +105,14 @@ def _build_parser():
 
     s = sub.add_parser('status', help='print dispatcher stats as JSON')
     s.add_argument('--dispatcher', required=True)
+
+    g = sub.add_parser('drain', help='gracefully drain one worker '
+                                     '(scale-in): it finishes or hands '
+                                     'back in-flight splits, then '
+                                     'deregisters')
+    g.add_argument('--dispatcher', required=True)
+    g.add_argument('--worker', required=True,
+                   help="worker id from `status` (e.g. 'w0')")
 
     p = sub.add_parser('stop', help='ask the dispatcher to shut down')
     p.add_argument('--dispatcher', required=True)
@@ -139,7 +157,9 @@ def main(argv=None):
             cache_plane_disk_bytes=args.cache_plane_disk_bytes,
             cluster_cache=(False if args.no_cluster_cache else None),
             ingest=args.ingest,
-            telemetry_spans=not args.no_telemetry_spans)
+            telemetry_spans=not args.no_telemetry_spans,
+            ledger_path=args.ledger_path,
+            drain_timeout_s=args.drain_timeout_s)
         with Dispatcher(config, bind=args.bind) as dispatcher:
             print('dispatcher serving %s (%d splits, %d consumers)'
                   % (dispatcher.addr, dispatcher._job['num_splits'],
@@ -158,8 +178,13 @@ def main(argv=None):
                         max_inflight_splits=args.max_inflight_splits,
                         max_buffered_chunks=args.max_buffered_chunks,
                         cache_plane_dir=args.cache_plane_dir)
+        # SIGTERM -> graceful drain (ISSUE 15): finish or hand back
+        # in-flight splits, flush shm, deregister — the scale-in path
+        # orchestrators drive (terminationGracePeriod should exceed the
+        # job's drain_timeout_s).
+        worker.install_signal_handlers()
         try:
-            worker.run()  # blocks until stop()/SIGTERM
+            worker.run()  # blocks until stop()/drained SIGTERM
         except KeyboardInterrupt:
             pass
         return 0
@@ -167,6 +192,21 @@ def main(argv=None):
     if args.command == 'status':
         print(json.dumps(_rpc_once(args.dispatcher, {'op': 'stats'}),
                          indent=1, sort_keys=True))
+        return 0
+
+    if args.command == 'drain':
+        from petastorm_tpu.errors import ServiceError
+        try:
+            # _Rpc surfaces an error-carrying reply (e.g. unknown
+            # worker id) as a ServiceError — the operator gets the
+            # message and exit 1, not a traceback.
+            _rpc_once(args.dispatcher,
+                      {'op': 'drain', 'worker_id': args.worker})
+        except ServiceError as e:
+            print('drain refused: %s' % e, file=sys.stderr)
+            return 1
+        print('worker %s draining (watch `status` for it to deregister)'
+              % args.worker)
         return 0
 
     if args.command == 'stop':

@@ -38,6 +38,7 @@ from petastorm_tpu.errors import ServiceError
 from petastorm_tpu.jax.loader import DataLoader
 from petastorm_tpu.service.worker import _Rpc, deserialize_chunk
 from petastorm_tpu.telemetry import merge_into_recorder, provenance
+from petastorm_tpu.utils import backoff
 
 logger = logging.getLogger(__name__)
 
@@ -108,6 +109,10 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                 logger.warning('cannot create shm probe (%s); same-host '
                                'delivery will use the byte path', e)
         self.shm_chunks = 0
+        #: Discovery-poll retries scheduled under the shared backoff
+        #: policy (ISSUE 15) — nonzero means the dispatcher was
+        #: unreachable at some point this connection rode through.
+        self.retry_attempts = 0
         self.consumed = set(int(s) for s in resume.get('consumed') or ())
         unknown = self.consumed - set(self._my_splits)
         if unknown:
@@ -200,12 +205,18 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
         held = {}               # ordered mode: completed, awaiting turn
         order = [sid for sid in self._my_splits if sid not in received]
         next_refresh = 0.0
+        #: Active backoff episode across consecutive discovery-poll
+        #: failures (ISSUE 15): a healthy poll runs at a JITTERED ~1 Hz
+        #: (a consumer fleet spreads over the second instead of
+        #: arriving in phase), and a dead/restarting dispatcher sees
+        #: exponentially-paced retries, not a synchronized 1 Hz hammer
+        #: from every training host at once.
+        discovery_retry = None
         addr_of = {}            # DEALER -> worker data addr (span origin)
         try:
             while remaining and not self._stop.is_set():
                 now = time.monotonic()
                 if now >= next_refresh:
-                    next_refresh = now + 1.0
                     try:
                         t_rpc0 = time.monotonic()
                         reply = rpc.call({'op': 'workers'})
@@ -229,8 +240,14 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                             if worker.get('clock_offset') is not None:
                                 self._worker_offsets[worker['addr']] = \
                                     float(worker['clock_offset'])
+                        discovery_retry = None
+                        next_refresh = now + backoff.jittered(1.0, 0.2)
                     except ServiceError:
                         workers, reply = [], {}
+                        discovery_retry = discovery_retry or \
+                            backoff.DISCOVERY_POLICY.episode()
+                        self.retry_attempts += 1
+                        next_refresh = now + discovery_retry.next_delay()
                     failed = set(reply.get('failed_splits') or ()) & remaining
                     if failed:
                         # The dispatcher gave up on these (attempt ceiling):
@@ -240,6 +257,23 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                             'split(s) %s of consumer %d failed every decode '
                             'attempt at the dispatcher'
                             % (sorted(failed)[:5], self.consumer))
+                    stale = set(reply.get('retired_splits') or ()) \
+                        & remaining
+                    if stale:
+                        # A ledger-restored dispatcher retired these in a
+                        # PREVIOUS incarnation: they will never stream
+                        # again, and this connection holds no token that
+                        # accounts for them (a live client's remaining
+                        # set already excludes everything it received) —
+                        # raise instead of hanging forever.
+                        raise ServiceError(
+                            'split(s) %s of consumer %d were delivered '
+                            'and retired before this dispatcher '
+                            'restarted (restored ledger): resume with '
+                            'the matching token, or point the '
+                            'dispatcher at a fresh ledger_path for a '
+                            'fresh epoch' % (sorted(stale)[:5],
+                                             self.consumer))
                     # Rotate by consumer index: host c starts its pulls at
                     # worker c % W instead of every host hammering worker 0.
                     if workers:

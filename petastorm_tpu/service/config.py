@@ -123,6 +123,21 @@ class ServiceConfig:
             overhead is <1% (a handful of small dicts per chunk); the
             flag exists for byte-budgeted control planes, and turning it
             off never affects the metrics registry or heartbeat stats.
+        ledger_path: durable dispatcher ledger file (ISSUE 15;
+            ``service/ledger.py``).  When set, every split-state
+            transition persists crash-safely and a restarted dispatcher
+            pointed at the same path restores the lease ledger + cache
+            directory instead of re-decoding the world: done splits stay
+            done, attempt counters survive, and leases workers still
+            hold resume via their ``held`` heartbeat claims.  The file
+            outlives clean shutdowns on purpose (it is the next
+            incarnation's restore source); a ledger written under a
+            different partition geometry is ignored whole.  None (the
+            default) keeps the pre-ledger in-memory-only behavior.
+        drain_timeout_s: how long a draining worker may spend finishing
+            its in-flight splits before it deregisters anyway
+            (``timed_out=True`` — the dispatcher requeues whatever it
+            still held, attempt+1, and counts ``drain_timeouts``).
     """
 
     dataset_url: str
@@ -146,6 +161,8 @@ class ServiceConfig:
     scheduling: str = 'auto'
     ingest: str = 'auto'
     telemetry_spans: bool = True
+    ledger_path: str = None
+    drain_timeout_s: float = 30.0
 
     def __post_init__(self):
         if self.num_consumers < 1:
@@ -176,6 +193,8 @@ class ServiceConfig:
         if self.ingest not in ('auto', 'plane', 'off'):
             raise ValueError("ingest must be 'auto', 'plane' or 'off', "
                              "got %r" % (self.ingest,))
+        if self.drain_timeout_s <= 0:
+            raise ValueError('drain_timeout_s must be positive')
         if self.heartbeat_interval_s is None:
             self.heartbeat_interval_s = self.lease_ttl_s / 3.0
 
@@ -213,5 +232,6 @@ class ServiceConfig:
             'scheduling': self.scheduling,
             'ingest': self.ingest,
             'telemetry_spans': bool(self.telemetry_spans),
+            'drain_timeout_s': float(self.drain_timeout_s),
             'fingerprint': self.fingerprint(num_splits),
         }

@@ -12,6 +12,11 @@ short pickled RPCs:
   ``complete``        worker finished streaming a split (client acked it)
   ``mark_consumed``   a resuming client retires splits its token already holds
   ``job`` / ``workers`` / ``stats``  discovery + metrics surface
+  ``drain``           ask one worker to drain gracefully (via its next
+                      heartbeat reply; see ``Worker.drain``)
+  ``release``         a draining worker hands back a split it never
+                      started (requeued at the FRONT, attempt intact)
+  ``deregister``      a drained worker leaves the fleet for good
   ``stop``            remote shutdown (CLI convenience)
 
 Lease expiry is the failure path: a worker that stops heartbeating has
@@ -22,6 +27,18 @@ worker is rejected once the split has moved on.  Exactly-once *delivery*
 is finished on the client side (whole-split commit + dedupe by split id);
 the dispatcher guarantees exactly-once *assignment* per attempt and
 at-least-once decode.
+
+The dispatcher itself stopped being a single point of state loss in
+ISSUE 15: with ``ServiceConfig(ledger_path=...)`` every split-state
+transition persists to a crash-safe snapshot (``service/ledger.py``),
+and a restarted dispatcher reloads it — done splits stay done (no
+re-decode of delivered work), attempt counters survive, and in-flight
+leases are restored as **orphan leases** that re-registering workers'
+``held`` heartbeat claims adopt (the lease resumes) or that requeue
+with their attempt count intact after one TTL (the restart was not the
+worker's failure).  Workers ride their existing re-register path and
+clients their existing resend/re-subscribe path; neither needs to know
+the control plane died.
 
 The lease doubles as the **per-piece decode-ownership grant** for the
 epoch-cache plane (``ServiceConfig(cache_plane=True)``): every row group
@@ -158,6 +175,23 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         #: Lease calls answered 'wait' because every scannable split was
         #: inside another worker's preference window.
         self.affinity_deferrals = 0
+        # -- crash-survivable control plane (ISSUE 15) -----------------------
+        #: Graceful drains completed (deregister RPCs) and drains that
+        #: overran their deadline (the worker left with leases live).
+        self.drains = 0
+        self.drain_timeouts = 0
+        #: Restore bookkeeping: lineage restart count (carried in the
+        #: ledger file), orphan leases adopted by re-registering
+        #: workers' held claims, and orphans requeued attempt-intact.
+        self.ledger_restores = 0
+        self.ledger_adoptions = 0
+        self.ledger_requeues = 0
+        self._ledger = None
+        self._ledger_dirty = False
+        #: data addr -> digest set from the ledger: worker ids are
+        #: restart-scoped, so the directory restores by the one identity
+        #: that survives — a re-registering worker's data address.
+        self._ledger_digests_by_addr = {}
         self._lock = make_lock('service.dispatcher.Dispatcher._lock')
         self._stop = threading.Event()
         self._thread = None
@@ -179,6 +213,131 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         #: Health gauges land here so any Prometheus scrape of the
         #: dispatcher process carries them (``render_prometheus``).
         self.metrics = MetricsRegistry('dispatcher')
+        if getattr(config, 'ledger_path', None):
+            from petastorm_tpu.service.ledger import DispatcherLedger
+            # acquire() raises against a live owner BEFORE any state is
+            # touched: two control planes on one ledger never coexist.
+            self._ledger = DispatcherLedger(config.ledger_path).acquire()
+            self._restore_from_ledger(self._ledger.load())
+            # First snapshot immediately (cold start) / persist the
+            # incremented restore count (restart) — the file must name
+            # this incarnation before the first worker registers.
+            self._ledger_save(force=True)
+
+    # -- durable ledger (ISSUE 15) -------------------------------------------
+
+    def _restore_from_ledger(self, state):
+        """Apply a loaded snapshot, or cold-start on any mismatch.  A
+        ledger from a different partition geometry is ignored whole
+        (its split ids index a different world) — same gate the client
+        resume token passes through."""
+        from petastorm_tpu.service import ledger as _ledger_mod
+        if state is None:
+            return
+        if state.get('fingerprint') != self._job['fingerprint'] \
+                or int(state.get('num_splits', -1)) != len(self._splits):
+            logger.warning(
+                'ledger %s was written under a different partition '
+                'geometry (fingerprint/num_splits mismatch); cold start',
+                self._ledger.path)
+            return
+        try:
+            records = _ledger_mod.decode_splits(state['splits'])
+        except (KeyError, TypeError, ValueError) as e:
+            logger.warning('ledger %s has undecodable split records '
+                           '(%s); cold start', self._ledger.path, e)
+            return
+        if len(records) != len(self._splits):
+            # Rejected WHOLE: zip() would silently truncate and
+            # half-apply a short record list (tail splits re-decoding
+            # at attempt 0 contradicts everything the ledger promises).
+            logger.warning(
+                'ledger %s holds %d split records for a %d-split job; '
+                'cold start', self._ledger.path, len(records),
+                len(self._splits))
+            return
+        now = time.monotonic()
+        restored = collections.Counter()
+        for split, (split_state, attempt) in zip(self._splits, records):
+            split.attempt = attempt
+            restored[split_state] += 1
+            if split_state == _DONE:
+                split.state = _DONE
+            elif split_state == _FAILED:
+                split.state = _FAILED
+            elif split_state == _LEASED:
+                # Orphan lease: held by nobody until a re-registering
+                # worker's `held` heartbeat claim adopts it; expiring
+                # unclaimed requeues it attempt-INTACT (_expire_leases).
+                split.state = _LEASED
+                split.worker_id = None
+                split.lease_expires = now + self._config.lease_ttl_s
+        self._pending = collections.deque(
+            s for s in self._splits if s.state == _PENDING)
+        self._ledger_digests_by_addr = {
+            str(addr): {str(d) for d in digests}
+            for addr, digests in (state.get('worker_digests') or {}).items()}
+        pieces = state.get('piece_digests')
+        if self._cluster_on and pieces \
+                and len(pieces) == self._num_pieces:
+            self._piece_digests = [str(d) for d in pieces]
+        self.ledger_restores = int(state.get('restores', 0)) + 1
+        logger.info(
+            'ledger %s restored (restart #%d): %d done / %d leased '
+            '(orphaned) / %d pending / %d failed splits, %d worker '
+            'digest sets, piece map %s', self._ledger.path,
+            self.ledger_restores, restored[_DONE], restored[_LEASED],
+            restored[_PENDING], restored[_FAILED],
+            len(self._ledger_digests_by_addr),
+            'restored' if self._piece_digests is not None else 'absent')
+
+    def _ledger_state(self):
+        """Snapshot dict for :meth:`ledger.DispatcherLedger.save`
+        (caller must NOT hold ``self._lock``)."""
+        from petastorm_tpu.service import ledger as _ledger_mod
+        with self._lock:
+            digests = {self._workers[wid]['addr']: sorted(held)
+                       for wid, held in self._worker_digests.items()
+                       if wid in self._workers}
+            # Directory entries of not-yet-re-registered workers survive
+            # a SECOND restart too: carry restored-but-unclaimed addrs.
+            for addr, held in self._ledger_digests_by_addr.items():
+                digests.setdefault(addr, sorted(held))
+            return {
+                'fingerprint': self._job['fingerprint'],
+                'dataset_url': self._config.dataset_url,
+                'num_splits': len(self._splits),
+                'splits': _ledger_mod.encode_splits(self._splits),
+                'worker_digests': digests,
+                'piece_digests': self._piece_digests,
+                'restores': self.ledger_restores,
+                'saved_unix': time.time(),
+            }
+
+    def _ledger_save(self, force=False):
+        """Persist when dirty (serve-loop tick) or unconditionally
+        (``force=True`` — the write-ahead transitions: complete /
+        mark_consumed / deregister persist BEFORE their reply)."""
+        if self._ledger is None or not (force or self._ledger_dirty):
+            return
+        self._ledger_dirty = False
+        if self._ledger.save(self._ledger_state()) is None:
+            # Best-effort save failed (ENOSPC, unwritable dir): keep
+            # the dirty flag so the next tick retries instead of
+            # silently dropping the pending transitions.
+            self._ledger_dirty = True
+
+    def _ledger_mark(self):
+        if self._ledger is not None:
+            self._ledger_dirty = True
+
+    def _ledger_done(self, split_id):
+        """O(1) write-ahead record for one work-retiring transition:
+        journal line now (BEFORE the RPC reply), full snapshot on the
+        next serve-loop tick (which truncates the journal)."""
+        if self._ledger is not None:
+            self._ledger.append({'op': 'done', 'split': int(split_id)})
+            self._ledger_dirty = True
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -229,14 +388,39 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         poller = zmq.Poller()
         poller.register(socket, zmq.POLLIN)
         try:
+            from petastorm_tpu.test_util import chaos
             while not self._stop.is_set():
                 self._expire_leases()
+                # Dirty-flag snapshot per tick: lease grants/expiries
+                # reach the ledger within one loop turn (the write-ahead
+                # transitions already saved synchronously).
+                self._ledger_save()
                 # One fleet flight frame per interval, from the loop the
                 # control plane already runs (contained inside tick()).
                 self.flight.maybe_tick()
                 if not dict(poller.poll(100)):
                     continue
-                request = pickle.loads(socket.recv())
+                raw = socket.recv()
+                try:
+                    request = pickle.loads(raw)
+                    if not isinstance(request, dict):
+                        raise TypeError('expected dict, got %s'
+                                        % type(request).__name__)
+                except Exception as e:  # noqa: BLE001 — reply, don't die
+                    # A malformed peer (non-pickle frame, non-dict
+                    # payload) must cost one error reply, never the
+                    # serve thread: a dead REP socket wedges every
+                    # worker and client in the fleet.
+                    socket.send(pickle.dumps(
+                        {'error': 'malformed request: %s: %s'
+                                  % (type(e).__name__, e)}, protocol=4))
+                    continue
+                # Chaos seam (ISSUE 15): the REP contract forbids a
+                # dropped reply (the socket would wedge), so the
+                # control-plane fault model here is DELAY — lost
+                # requests/replies are injected at the callers' REQ
+                # seam ('rpc.request'), where timeout+retry lives.
+                chaos.inject('dispatcher.rpc', op=request.get('op'))
                 try:
                     reply = self._dispatch(request)
                 except Exception as e:  # noqa: BLE001 — reply, don't die
@@ -250,6 +434,11 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             # The ring is the postmortem: leave the last window on disk
             # when a flight dir is configured (best-effort by contract).
             self.flight.persist(reason='dispatcher_exit')
+            if self._ledger is not None:
+                # Final snapshot + owner release: the FILE stays — it is
+                # the next incarnation's restore source.
+                self._ledger_save(force=True)
+                self._ledger.release()
             socket.close(0)
             context.term()
 
@@ -279,6 +468,12 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         # (cache_remote_hits / peer_fills / peer_degraded) already ride
         # the merged heartbeat registries above.
         merged['counters']['cache_affinity_routed'] = self.affinity_routed
+        # Crash-survivable control plane (ISSUE 15): restore/drain
+        # traffic in the flight ring, so windowed deltas can say "the
+        # control plane restarted inside this window".
+        merged['counters']['ledger_restores'] = self.ledger_restores
+        merged['counters']['drains'] = self.drains
+        merged['counters']['drain_timeouts'] = self.drain_timeouts
         return merged
 
     # -- lease bookkeeping ---------------------------------------------------
@@ -289,9 +484,25 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         with self._lock:
             for split in self._splits:
                 if split.state == _LEASED and split.lease_expires < now:
+                    if split.worker_id is None:
+                        # Ledger-restored orphan nobody claimed within
+                        # the grace TTL: requeue with the attempt count
+                        # INTACT — a dispatcher restart is not the
+                        # worker's failure and must not walk the split
+                        # toward the max_split_attempts poison ceiling.
+                        logger.info(
+                            'restored lease on split %d unclaimed; '
+                            'requeueing at attempt %d',
+                            split.split_id, split.attempt)
+                        split.state = _PENDING
+                        self._pending.append(split)
+                        self.ledger_requeues += 1
+                        self._ledger_mark()
+                        continue
                     split.worker_id = None
                     split.attempt += 1
                     self.lease_churn += 1
+                    self._ledger_mark()
                     if split.attempt >= max_attempts:
                         # Every worker that touched this split walked away
                         # (undecodable row group, poisoned data): a terminal
@@ -329,7 +540,16 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 'addr': request['data_addr'],
                 'last_heartbeat': time.monotonic(),
                 'stats': {},
+                'draining': False,
             }
+            # Ledger-restored cache directory (ISSUE 15): the data addr
+            # is the identity that survives a dispatcher restart, so a
+            # re-registering worker re-enters the directory immediately
+            # instead of waiting for its next on-change advertisement.
+            held = self._ledger_digests_by_addr.pop(
+                request['data_addr'], None)
+            if held:
+                self._worker_digests[worker_id] = set(held)
         logger.info('registered worker %s at %s', worker_id,
                     request['data_addr'])
         # t_mono: the registration doubles as the clock-offset handshake
@@ -360,6 +580,10 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             worker['last_heartbeat'] = now
             if request.get('stats'):
                 worker['stats'] = dict(request['stats'])
+            if request.get('draining'):
+                # Worker-initiated drain (SIGTERM): the fleet view must
+                # show it draining, same as a `drain`-RPC'd worker.
+                worker['draining'] = True
             # Cluster cache directory (ISSUE 10): the advertised digest
             # set replaces wholesale (workers only ship it on change);
             # the piece-digest map is per-job, first valid one wins.
@@ -386,12 +610,29 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 if split.state == _LEASED and split.worker_id == worker_id \
                         and (held is None or split.split_id in held):
                     split.lease_expires = now + self._config.lease_ttl_s
+                elif split.state == _LEASED and split.worker_id is None \
+                        and held is not None and split.split_id in held:
+                    # Reconciliation (ISSUE 15): a ledger-restored
+                    # orphan lease the worker still holds RESUMES under
+                    # its post-restart worker id — the split streams on,
+                    # attempt intact, nothing re-decodes.
+                    split.worker_id = worker_id
+                    split.lease_expires = now + self._config.lease_ttl_s
+                    self.ledger_adoptions += 1
+                    self._ledger_mark()
+                    logger.info('worker %s re-claimed restored lease on '
+                                'split %d (attempt %d)', worker_id,
+                                split.split_id, split.attempt)
+            draining = bool(worker.get('draining'))
         # t_mono: every heartbeat doubles as a clock re-handshake (ISSUE
         # 7 satellite) — long-lived workers drift off their one
         # registration-time offset, so the worker EWMAs the midpoint
         # estimate from each beat and ships `clock_drift_ms` back.
         return {'ok': True, 't_mono': time.monotonic(),
-                'need_piece_digests': need_pieces}
+                'need_piece_digests': need_pieces,
+                # Dispatcher-initiated drain (the `drain` RPC) reaches
+                # the worker here, on the channel it already polls.
+                'drain': draining}
 
     # -- cache-affinity helpers (ISSUE 10; callers hold self._lock) ----------
 
@@ -515,6 +756,10 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             if worker_id not in self._workers:
                 return {'error': 'unknown worker %r' % worker_id}
             self._workers[worker_id]['last_heartbeat'] = time.monotonic()
+            if self._workers[worker_id].get('draining'):
+                # A draining worker gets no new work — the scale-in
+                # contract; its in-flight splits finish or hand back.
+                return {'wait': True, 'drain': True}
             chosen, routed = self._choose_pending(worker_id, consumers)
             if chosen is not None:
                 chosen.state = _LEASED
@@ -522,6 +767,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 chosen.lease_expires = (time.monotonic()
                                         + self._config.lease_ttl_s)
                 chosen.affinity_defer_until = None
+                self._ledger_mark()
                 if routed:
                     self.affinity_routed += 1
                 holders = (self._split_holders(chosen, worker_id)
@@ -558,6 +804,10 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             if self._trace is not None:
                 self._trace.instant('service/split_done', split=split_id,
                                     worker=worker_id)
+        # Write-ahead for the transition that retires work: the durable
+        # record exists BEFORE the worker hears 'ok' (a restart between
+        # the two costs one idempotent re-complete, never a re-decode).
+        self._ledger_done(split_id)
         return {'ok': True}
 
     def _op_mark_consumed(self, request):
@@ -566,14 +816,85 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         split already streaming stays leased — the client drops the
         duplicate, so marking here is an optimization, not a correctness
         requirement."""
-        retired = 0
+        retired = []
         with self._lock:
             for split_id in request['split_ids']:
                 split = self._splits[int(split_id)]
                 if split.state == _PENDING:
                     split.state = _DONE
-                    retired += 1
-        return {'ok': True, 'retired': retired}
+                    retired.append(split.split_id)
+        for split_id in retired:
+            self._ledger_done(split_id)  # write-ahead: see _op_complete
+        return {'ok': True, 'retired': len(retired)}
+
+    # -- graceful drain (ISSUE 15) -------------------------------------------
+
+    def _op_drain(self, request):
+        """Mark one worker draining; it learns on its next heartbeat
+        reply (or lease refusal) and runs its local drain path — finish
+        or hand back in-flight splits, flush shm, deregister."""
+        worker_id = request['worker_id']
+        with self._lock:
+            worker = self._workers.get(worker_id)
+            if worker is None:
+                return {'ok': False, 'error': 'unknown worker %r'
+                                              % worker_id}
+            worker['draining'] = True
+        logger.info('worker %s marked draining', worker_id)
+        return {'ok': True}
+
+    def _op_release(self, request):
+        """A draining worker hands back a split it leased but never
+        started decoding: requeued at the FRONT of the queue (it was
+        next in line), attempt count INTACT (nothing failed)."""
+        worker_id, split_id = request['worker_id'], int(request['split_id'])
+        with self._lock:
+            split = self._splits[split_id]
+            if split.state != _LEASED or split.worker_id != worker_id \
+                    or split.attempt != request.get('attempt',
+                                                    split.attempt):
+                return {'ok': False}  # the lease moved on; nothing to do
+            split.state = _PENDING
+            split.worker_id = None
+            self._pending.appendleft(split)
+            self._ledger_mark()
+            if self._trace is not None:
+                self._trace.instant('service/lease_released',
+                                    split=split_id, worker=worker_id)
+        return {'ok': True}
+
+    def _op_deregister(self, request):
+        """A drained worker leaves the fleet.  ``timed_out=True`` means
+        the drain deadline passed with splits still in flight: those
+        requeue IMMEDIATELY (attempt+1 — the worker walked away with
+        them streaming, exactly the lease-expiry semantics, minus the
+        TTL wait)."""
+        worker_id = request['worker_id']
+        timed_out = bool(request.get('timed_out'))
+        max_attempts = self._config.max_split_attempts
+        with self._lock:
+            worker = self._workers.pop(worker_id, None)
+            self._worker_digests.pop(worker_id, None)
+            if worker is None:
+                return {'ok': False}
+            self.drains += 1
+            if timed_out:
+                self.drain_timeouts += 1
+            for split in self._splits:
+                if split.state == _LEASED and split.worker_id == worker_id:
+                    split.worker_id = None
+                    split.attempt += 1
+                    self.lease_churn += 1
+                    if split.attempt >= max_attempts:
+                        split.state = _FAILED
+                    else:
+                        split.state = _PENDING
+                        self._pending.append(split)
+                    self._ledger_mark()
+        logger.info('worker %s deregistered (%s drain)', worker_id,
+                    'timed-out' if timed_out else 'clean')
+        self._ledger_save(force=True)
+        return {'ok': True}
 
     def _op_job(self, request):
         return {'job': self._job}
@@ -596,11 +917,24 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             # waiting client can raise instead of hanging forever.
             failed = sorted(s.split_id for s in self._splits
                             if s.state == _FAILED)
+            # Ledger-restored dispatchers additionally surface the DONE
+            # set (ISSUE 15): a split the previous incarnation retired
+            # will never stream again — a token-less client waiting on
+            # one (ledger reused across runs, trainer restarted without
+            # its resume token) must raise, not hang forever.  Scoped
+            # to restored dispatchers: within one run a client either
+            # acked the split itself or holds the token that retired it.
+            done = (sorted(s.split_id for s in self._splits
+                           if s.state == _DONE)
+                    if self.ledger_restores else None)
         # t_mono rides the discovery poll the client already makes every
         # second: its send/recv midpoint IS the client<->dispatcher clock
         # handshake — no extra RPC on the refresh path.
-        return {'workers': workers, 'failed_splits': failed,
-                't_mono': time.monotonic()}
+        reply = {'workers': workers, 'failed_splits': failed,
+                 't_mono': time.monotonic()}
+        if done is not None:
+            reply['retired_splits'] = done
+        return reply
 
     def _op_stats(self, request):
         stale = 3.0 * self._config.lease_ttl_s
@@ -646,6 +980,26 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 if self._worker_digests else 0,
                 'piece_map': self._piece_digests is not None,
             })
+            draining = sum(1 for w in self._workers.values()
+                           if w.get('draining'))
+        # Crash-survivable control plane rollup (ISSUE 15): the ledger
+        # lineage (how many restarts this job's control plane has
+        # survived), drain traffic, and the fleet-summed retry counters
+        # (the thundering-herd signal the unified backoff bounds).
+        control = {
+            'ledger': self._ledger is not None,
+            'ledger_restores': self.ledger_restores,
+            'ledger_adoptions': self.ledger_adoptions,
+            'ledger_requeues': self.ledger_requeues,
+            'ledger_saves': (self._ledger.saves
+                             if self._ledger is not None else 0),
+            'drains': self.drains,
+            'drain_timeouts': self.drain_timeouts,
+            'workers_draining': draining,
+        }
+        control.update({
+            key: sum(int(w.get(key, 0)) for w in workers.values())
+            for key in ('retry_attempts', 'retry_giveups')})
         # True fleet-wide stage latencies: the heartbeat registry
         # snapshots merge by histogram-bucket addition (the reason the
         # buckets are fixed log2), then each stage reports the ONE
@@ -673,7 +1027,11 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         delta = snapshot_delta(self._fleet_snapshot(),
                                baseline['snapshot'] if baseline else None)
         meta = {'pending': states[_PENDING], 'leased': states[_LEASED],
-                'failed': states[_FAILED], 'workers_alive': alive}
+                'failed': states[_FAILED], 'workers_alive': alive,
+                # control-plane-degraded evidence (ISSUE 15)
+                'ledger_restores': self.ledger_restores,
+                'drain_timeouts': self.drain_timeouts,
+                'retry_giveups': control['retry_giveups']}
         fleet_health = health.health_report(
             delta, meta=meta,
             window_s=(time.monotonic() - baseline['t_mono'])
@@ -695,6 +1053,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             'cache': cache,
             'shm': shm,
             'cluster_cache': cluster,
+            'control_plane': control,
             'stages': stages,
             'health': fleet_health,
             'workers': workers,

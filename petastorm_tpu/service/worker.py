@@ -48,6 +48,8 @@ import numpy as np
 
 from petastorm_tpu.errors import ServiceError, ServiceRpcTimeoutError
 from petastorm_tpu.telemetry import MetricsRegistry, provenance
+from petastorm_tpu.test_util import chaos
+from petastorm_tpu.utils import backoff
 
 logger = logging.getLogger(__name__)
 
@@ -87,6 +89,16 @@ class _Rpc(object):  # ptlint: disable=pickle-unsafe-attrs — one per owning th
     def call(self, request, timeout_s=None):
         from petastorm_tpu.errors import ServiceError
         timeout_s = self._timeout_s if timeout_s is None else timeout_s
+        # Chaos seam (ISSUE 15): a dropped control-plane request
+        # surfaces exactly what a lost request surfaces — a timeout on
+        # a recycled socket — without waiting the full window (the
+        # caller's retry/backoff path is what the fault exercises).
+        if chaos.inject('rpc.request', op=request.get('op')) == 'drop':
+            self._socket.close(0)
+            self._connect()
+            raise ServiceRpcTimeoutError(
+                'chaos: dropped %r to %s' % (request.get('op'),
+                                             self._addr))
         self._socket.send(pickle.dumps(request, protocol=4))
         if not self._socket.poll(int(timeout_s * 1000)):
             self._socket.close(0)
@@ -181,6 +193,17 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         self._max_buffered = int(max_buffered_chunks)
         self._trace = trace_recorder
         self._stop = threading.Event()
+        #: Graceful drain (ISSUE 15): set by :meth:`drain`, a SIGTERM
+        #: (see :meth:`install_signal_handlers`), or a dispatcher
+        #: ``drain`` RPC arriving on a heartbeat reply.  The event loop
+        #: then stops leasing, hands back splits it never started,
+        #: finishes streaming the rest, and deregisters — zero lost
+        #: splits, zero residue.
+        self._drain = threading.Event()
+        #: True once the drain path completed (diagnostics surface).
+        self.drained = False
+        #: True when the drain deadline passed with splits in flight.
+        self.drain_timed_out = False
         self._thread = None
         self._reader_factory = None
         self._t_start = None
@@ -237,6 +260,13 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                                        'cache_peer_fills',
                                        'cache_peer_degraded')}
         self._m_serve_hist = self.metrics.histogram('serve_cached_split')
+        #: Unified backoff telemetry (ISSUE 15): every control-plane
+        #: retry this worker schedules (heartbeat, re-register, peer
+        #: fetch) and every episode that exhausted its budget.  Ride the
+        #: heartbeats like every counter, summed fleet-wide in `stats`'s
+        #: control_plane rollup — a retry storm is a fleet phenomenon.
+        self._m_retry = {key: self.metrics.counter(key)
+                         for key in ('retry_attempts', 'retry_giveups')}
         #: ClusterWorkerState when the job opts in (None otherwise /
         #: killed); owned by run(), read by the event + decode threads.
         self._cluster = None
@@ -259,6 +289,30 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
 
     def stop(self):
         self._stop.set()
+
+    def drain(self):
+        """Begin a graceful drain (ISSUE 15): stop taking leases, hand
+        back splits never started (``release`` RPC, attempt intact),
+        finish streaming + awaiting acks for the rest, flush/retire shm
+        slabs, then ``deregister`` and exit the event loop.  Bounded by
+        the job's ``drain_timeout_s``; past it the worker deregisters
+        as ``timed_out`` and the dispatcher requeues the remainder
+        immediately.  Idempotent; safe from any thread and from a
+        signal handler (it only sets an Event)."""
+        self._drain.set()
+
+    def install_signal_handlers(self):
+        """SIGTERM -> :meth:`drain` (the scale-in half of autoscaling:
+        an orchestrator's terminationGracePeriod maps onto the drain
+        deadline).  Main-thread only by the stdlib's rules; the CLI
+        path calls this, in-process deployments call :meth:`drain`."""
+        import signal
+
+        def on_sigterm(signum, frame):
+            logger.info('SIGTERM: draining worker %s', self.worker_id)
+            self.drain()
+
+        signal.signal(signal.SIGTERM, on_sigterm)
 
     def join(self):
         if self._thread is not None:
@@ -379,6 +433,19 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         self.clock_drift_ms = round(
             1e3 * (ewma - self._clock_offset_initial), 3)
 
+    def _count_retry(self, episode):
+        """Count one heartbeat-class retry; an EXHAUSTED episode counts
+        one ``retry_giveups`` (the dead-dispatcher signal the
+        control-plane-degraded regime reads) and rolls into a fresh
+        episode — the worker never stops trying, only the telemetry
+        marks the budget boundary."""
+        episode = episode or backoff.HEARTBEAT_POLICY.episode()
+        self._m_retry['retry_attempts'].inc()
+        if episode.give_up():
+            self._m_retry['retry_giveups'].inc()
+            episode = backoff.HEARTBEAT_POLICY.episode()
+        return episode
+
     def _advertised(self, addr):
         """The address published to the dispatcher: clients on OTHER
         machines connect to it, so a wildcard bind host must be replaced
@@ -398,7 +465,15 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
 
     def _event_loop(self, zmq, data, rpc, job, decode_in, decode_out):
         heartbeat_every = max(0.2, job['lease_ttl_s'] / 3.0)
-        last_heartbeat = 0.0
+        next_heartbeat = 0.0
+        #: Active backoff episode across consecutive heartbeat /
+        #: re-register failures (None while healthy) — the unified
+        #: jittered-exponential policy (ISSUE 15) in place of the old
+        #: fixed-interval retry that had the whole fleet hammering a
+        #: restarted dispatcher in lockstep.
+        hb_retry = None
+        draining = False
+        drain_deadline = None
         next_lease_probe = 0.0
         subscribers = {}      # consumer -> identity
         credits = {}          # identity -> remaining chunk budget
@@ -493,6 +568,45 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                         # split again.  It stays in inflight, so the lease
                         # keeps renewing.
                         replay((int(msg['split']), int(msg['attempt'])))
+            # 1b. drain trigger (ISSUE 15): hand back every split still
+            # sitting in the decode queue (never started — `release`
+            # requeues it at the dispatcher, attempt intact), stop
+            # leasing, and let the rest finish streaming.  The split
+            # currently decoding, anything buffered, and every
+            # streamed-but-unacked split complete through the normal
+            # chunk/end/ack/complete path — zero lost splits.
+            if not draining and self._drain.is_set():
+                draining = True
+                drain_deadline = now + float(job.get('drain_timeout_s',
+                                                     30.0))
+                handed = 0
+                while True:
+                    try:
+                        item = decode_in.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        # run()'s stop sentinel: shutdown outranks the
+                        # drain — re-queue it for the decode thread and
+                        # stop handing back (popping it again here
+                        # would spin this loop forever).
+                        decode_in.put(None)
+                        break
+                    inflight.pop(item['split_id'], None)
+                    decoding.discard(item['split_id'])
+                    handed += 1
+                    try:
+                        rpc.call({'op': 'release',
+                                  'worker_id': self.worker_id,
+                                  'split_id': item['split_id'],
+                                  'attempt': item['attempt']})
+                    except ServiceError:
+                        # The lease expires instead (attempt+1) — the
+                        # slow path, but still zero lost splits.
+                        pass
+                logger.info('draining: handed back %d unstarted '
+                            'split(s), %d still in flight', handed,
+                            len(inflight))
             # 2. move decoded chunks into per-consumer send queues — but
             # only while fewer than max_buffered_chunks wait for credits:
             # leaving the rest in the bounded decode_out queue is what
@@ -546,10 +660,29 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     if header['type'] == 'chunk':
                         if credits.get(identity, 0) < 1:
                             break
-                        credits[identity] -= 1
-                        data.send_multipart(
-                            [identity, pickle.dumps(header, protocol=4),
-                             payload])
+                        # Chaos seam (ISSUE 15): drop/duplicate/delay a
+                        # data-plane chunk.  Byte-path frames only — a
+                        # duplicated shm descriptor would double-release
+                        # its slab generation.  A dropped chunk keeps
+                        # its credit with the client (the fault models
+                        # identity loss, and exactly-once must stay
+                        # LIVE under injection: the client's chunk-count
+                        # mismatch at `end` requests the resend).
+                        action = (chaos.inject('worker.chunk',
+                                               split=header['split'],
+                                               seq=header['seq'])
+                                  if header['tag'] != b'S' else None)
+                        if action != 'drop':
+                            credits[identity] -= 1
+                            data.send_multipart(
+                                [identity,
+                                 pickle.dumps(header, protocol=4),
+                                 payload])
+                            if action == 'dup':
+                                data.send_multipart(
+                                    [identity,
+                                     pickle.dumps(header, protocol=4),
+                                     payload])
                     else:
                         data.send_multipart(
                             [identity, pickle.dumps(header, protocol=4)])
@@ -569,14 +702,21 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     logger.warning('split %d attempt %d un-acked for %.0fs; '
                                    'replaying', key[0], key[1], ack_timeout)
                     replay(key)
-            # 4. heartbeat (renews the leases this worker still claims)
-            if now - last_heartbeat >= heartbeat_every:
+            # 4. heartbeat (renews the leases this worker still claims).
+            # Cadence is jittered (a same-TTL fleet must not beat in
+            # phase) and failures retry on the shared
+            # jittered-exponential policy (ISSUE 15) instead of the old
+            # fixed-interval lockstep: a restarted dispatcher sees the
+            # fleet's retries spread out, not as one synchronized storm.
+            if now >= next_heartbeat:
                 try:
                     t_hb0 = time.monotonic()
                     request = {'op': 'heartbeat',
                                'worker_id': self.worker_id,
                                'stats': self.heartbeat_stats(),
                                'held': list(inflight)}
+                    if draining:
+                        request['draining'] = True
                     # Cluster cache advertisement rides the heartbeat
                     # (ISSUE 10): the compact held-digest set when it
                     # changed, and the once-per-job piece-digest map
@@ -592,15 +732,28 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                             self._cluster.advertised_pieces = True
                         if reply.get('need_piece_digests'):
                             self._cluster.advertised_pieces = False
+                    if reply.get('drain'):
+                        # Dispatcher-initiated drain (the `drain` RPC)
+                        # arrives here, on the channel we already poll.
+                        self._drain.set()
                     # Opportunistic clock re-handshake (ISSUE 7): the
                     # beat's send/recv midpoint EWMAs into clock_offset
                     # so a long-lived worker tracks drift instead of
                     # freezing its registration-time estimate.
                     self._update_clock(reply.get('t_mono'), t_hb0,
                                        time.monotonic())
+                    hb_retry = None
+                    next_heartbeat = now + backoff.jittered(
+                        heartbeat_every, 0.1)
                 except ServiceRpcTimeoutError:
                     logger.warning('heartbeat to %s timed out',
                                    self._dispatcher_addr)
+                    hb_retry = self._count_retry(hb_retry)
+                    # Never slower than the healthy cadence: a worker
+                    # "backing off" past the TTL would lose its leases
+                    # to expiry while politely waiting.
+                    next_heartbeat = now + min(heartbeat_every,
+                                               hb_retry.next_delay())
                 except ServiceError:
                     # The dispatcher lost our registration (restart):
                     # re-register under a fresh id rather than dying.
@@ -615,13 +768,45 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                             # A restarted dispatcher lost the directory:
                             # re-advertise everything on the next beat.
                             self._cluster.reset_advertisement()
-                    except ServiceError:  # incl. timeout; retry next beat
-                        pass
-                last_heartbeat = now  # retry next interval, don't spin
+                        hb_retry = None
+                        # Beat immediately under the fresh id: the
+                        # `held` claims on that beat are what lets a
+                        # ledger-restored dispatcher ADOPT our leases
+                        # before their grace TTL expires them.
+                        next_heartbeat = now
+                    except ServiceError:  # incl. timeout
+                        hb_retry = self._count_retry(hb_retry)
+                        next_heartbeat = now + min(heartbeat_every,
+                                                   hb_retry.next_delay())
+            # 4b. drain completion (ISSUE 15): once nothing is in
+            # flight (every split acked+completed or handed back) and
+            # nothing is buffered, deregister and leave; past the
+            # deadline deregister as timed_out — the dispatcher
+            # requeues the remainder immediately.
+            if draining:
+                idle = not inflight and decode_out.empty() \
+                    and not any(sendq.values())
+                if idle or now > drain_deadline:
+                    self.drain_timed_out = not idle
+                    if not idle:
+                        logger.warning(
+                            'drain deadline passed with %d split(s) '
+                            'still in flight; deregistering timed_out',
+                            len(inflight))
+                    try:
+                        rpc.call({'op': 'deregister',
+                                  'worker_id': self.worker_id,
+                                  'timed_out': not idle})
+                    except ServiceError:
+                        pass  # heartbeats stop; leases expire instead
+                    self.drained = True
+                    break
             # 5. lease more work — only for consumers with a live
             # subscriber here, so an absent training host's splits don't
-            # occupy this worker's decode plane and send buffer.
-            if subscribers and len(inflight) < self._max_inflight \
+            # occupy this worker's decode plane and send buffer.  A
+            # draining worker takes nothing new, by contract.
+            if not draining and subscribers \
+                    and len(inflight) < self._max_inflight \
                     and now >= next_lease_probe:
                 try:
                     reply = rpc.call({'op': 'lease',
@@ -629,6 +814,11 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                                       'consumers': sorted(subscribers)})
                 except ServiceError:  # timeout or not-yet-re-registered
                     reply = {'wait': True}
+                if reply.get('drain'):
+                    # Dispatcher-initiated drain also rides lease
+                    # refusals — a lease-hungry worker must not wait a
+                    # heartbeat interval to learn it.
+                    self._drain.set()
                 if reply.get('split'):
                     split = reply['split']
                     # Cluster tier: the dispatcher's directory hints at
@@ -829,10 +1019,19 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 if fetcher is None:
                     fetcher = cluster.PeerFetcher(self._zmq_context)
                 blob = None
-                for addr in addrs:
+                # Every advertised holder is tried back to back (a
+                # delay earned by holder A buys nothing against holder
+                # B, and this runs on the decode thread); the unified
+                # retry telemetry (ISSUE 15) counts the extra attempts
+                # and an all-holders-failed walk as one giveup.
+                for i, addr in enumerate(addrs):
+                    if i:
+                        self._m_retry['retry_attempts'].inc()
                     blob = fetcher.fetch(addr, digest)
                     if blob is not None:
                         break
+                if blob is None:
+                    self._m_retry['retry_giveups'].inc()
                 if blob is not None \
                         and identity.plane.publish_blob(digest, blob):
                     self._m_cluster['cache_peer_fills'].inc()
@@ -912,6 +1111,9 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             t0 = time.monotonic()
             spans = []
             try:
+                # Chaos seam (ISSUE 15): per-split decode latency spikes
+                # and injected decode failures (the lease-expiry path).
+                chaos.inject('worker.decode', split=split['split_id'])
                 prov_on = provenance.enabled()
                 peer_fills_before = (
                     int(self._m_cluster['cache_peer_fills'].value)
@@ -1047,6 +1249,12 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 int(self._m_cluster['cache_peer_fills'].value),
             'cache_peer_degraded':
                 int(self._m_cluster['cache_peer_degraded'].value),
+            # Unified backoff telemetry (ISSUE 15): summed fleet-wide in
+            # the dispatcher's control_plane rollup — climbing giveups
+            # fleet-wide is the retry-storm / dead-control-plane signal.
+            'retry_attempts': int(self._m_retry['retry_attempts'].value),
+            'retry_giveups': int(self._m_retry['retry_giveups'].value),
+            'draining': bool(self._drain.is_set()),
         }
 
     def heartbeat_stats(self):

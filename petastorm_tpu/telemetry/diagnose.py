@@ -81,6 +81,18 @@ _REGIME_ACTIONS = {
         'early and fast pieces backfill the stall window — adding '
         'workers would idle just the same; '
         'PETASTORM_TPU_NO_ADAPTIVE_SCHED=1 is the kill switch'),
+    'control-plane-degraded': (
+        'the control plane itself is the fault domain: if the '
+        'dispatcher is restarting, read its logs/ledger lineage for the '
+        'crash cause (the ledger keeps delivery exactly-once through '
+        'restarts, but every restart pauses lease traffic); if drains '
+        'are timing out, raise drain_timeout_s past the real in-flight '
+        'split time or shrink rowgroups_per_split; if retry_giveups is '
+        'climbing fleet-wide, workers are exhausting retry budgets '
+        'against the dispatcher (heartbeat backoff episodes) or whole '
+        'holder lists are failing peer fetches — check the dispatcher '
+        'endpoint and peer data-plane reachability before adding '
+        'capacity'),
     'fetch-bound': (
         'cold-read I/O is on the critical path: deepen the ingest '
         "readahead (ingest_window on make_reader, or let the DataLoader "
@@ -135,6 +147,10 @@ def evidence_from_stats(stats, source='live fleet'):
         'health': report,
         'span_residue': None,
         'reason': None,
+        # Crash-survivable control plane rollup (ISSUE 15): ledger
+        # lineage, drain traffic, fleet retry counters — the restart /
+        # drain-timeout rules read it.
+        'control_plane': stats.get('control_plane') or {},
     }
 
 
@@ -439,8 +455,59 @@ def rule_slow_batches(evidence):
     }
 
 
+def rule_dispatcher_restarts(evidence):
+    """ISSUE 15: the ledger lineage counts every control-plane restart
+    of this job.  One restart is survivable news (that is what the
+    ledger is FOR); a repeat offender is a crash loop."""
+    control = evidence.get('control_plane') or {}
+    restarts = int(control.get('ledger_restores', 0) or 0)
+    if not restarts:
+        return None
+    adopted = int(control.get('ledger_adoptions', 0) or 0)
+    requeued = int(control.get('ledger_requeues', 0) or 0)
+    return {
+        'id': 'dispatcher-restarts',
+        'severity': 'crit' if restarts >= 3 else 'warn',
+        'score': min(1.0, 0.3 + 0.2 * restarts),
+        'summary': 'dispatcher restarted %d time(s) (ledger lineage)'
+                   % restarts,
+        'evidence': 'restore reconciliation: %d orphan lease(s) '
+                    'resumed by re-registering workers, %d requeued '
+                    'attempt-intact' % (adopted, requeued),
+        'action': 'delivery stayed exactly-once through the ledger '
+                  'restore, but every restart pauses lease traffic — '
+                  'find the crash cause in the dispatcher logs; a '
+                  'climbing count means a crash loop, not bad luck',
+    }
+
+
+def rule_drain_timeouts(evidence):
+    """ISSUE 15: a drain that overran its deadline left splits to
+    requeue (attempt+1) — the graceful scale-in path is not actually
+    graceful at this drain_timeout_s."""
+    control = evidence.get('control_plane') or {}
+    timeouts = int(control.get('drain_timeouts', 0) or 0)
+    if not timeouts:
+        return None
+    drains = int(control.get('drains', 0) or 0)
+    return {
+        'id': 'drain-timeout', 'severity': 'warn',
+        'score': min(1.0, 0.4 + 0.2 * timeouts),
+        'summary': 'worker drain timed out %d time(s) (of %d drains)'
+                   % (timeouts, drains),
+        'evidence': 'the worker deregistered with splits still in '
+                    'flight; the dispatcher requeued them at attempt+1',
+        'action': 'raise drain_timeout_s past the real worst-case '
+                  'in-flight split time (decode + stream + client ack), '
+                  'or shrink rowgroups_per_split so splits finish '
+                  'faster; orchestrators must set '
+                  'terminationGracePeriod above drain_timeout_s',
+    }
+
+
 _RULES = (rule_failed_splits, rule_watchdog_reason, rule_clock_drift,
-          rule_span_residue, rule_slow_batches)
+          rule_span_residue, rule_slow_batches, rule_dispatcher_restarts,
+          rule_drain_timeouts)
 
 
 def run_rules(evidence):

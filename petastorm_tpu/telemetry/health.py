@@ -53,6 +53,15 @@ Regime catalogue (``classify_regime``):
   block the epoch while the rest of the pool idles.  Knob:
   ``scheduling='adaptive'`` (the ISSUE 9 out-of-order scheduler) —
   more workers would idle just the same.
+* ``control-plane-degraded`` — the control plane itself is the fault
+  domain (ISSUE 15): the dispatcher restarted inside the window
+  (``ledger_restores`` climbing), worker drains overran their deadline
+  (``drain_timeouts``), or control-plane retries are exhausting their
+  backoff budgets fleet-wide (``retry_giveups``).  Data still flows
+  (the ledger + reconciliation exist so it does), but every one of
+  these is a restart/scale-in event away from an outage.  Knobs: the
+  dispatcher's crash loop (why is it restarting?), ``drain_timeout_s``
+  vs real in-flight split time, dispatcher reachability.
 * ``healthy`` / ``idle`` — nothing above threshold / no traffic at all.
 """
 
@@ -66,7 +75,7 @@ __all__ = ['classify_regime', 'health_report', 'report_from_frames',
 
 REGIMES = ('decode-bound', 'link-bound', 'lease-starved', 'cache-degraded',
            'cluster-cache-degraded', 'shm-degraded', 'skew-bound',
-           'fetch-bound', 'healthy', 'idle')
+           'fetch-bound', 'control-plane-degraded', 'healthy', 'idle')
 
 #: Histogram name -> pipeline component.  Names from every registry the
 #: fleet merges: service workers (decode_split/serialize/shm_publish),
@@ -243,6 +252,51 @@ def classify_regime(delta, stall_pct=None, meta=None):
             candidates.append((
                 0.95, 'lease-starved',
                 '%d split(s) pending with 0 live workers' % pending))
+
+    # 4b. control-plane degradation (ISSUE 15).  All three triggers
+    # read the WINDOWED counter delta, like every other regime — a
+    # drain that timed out on day 1 must not classify the fleet
+    # degraded forever (the fleet snapshot carries ledger_restores /
+    # drain_timeouts from the dispatcher and retry_giveups from the
+    # merged worker registries, so all three window cleanly).
+    window_restarts = int(counters.get('ledger_restores', 0) or 0)
+    if window_restarts >= 1:
+        candidates.append((
+            min(1.0, 0.5 + 0.2 * window_restarts),
+            'control-plane-degraded',
+            'dispatcher restarted %d time(s) in this window '
+            '(ledger_restores delta)' % window_restarts))
+    drain_timeouts = int(counters.get('drain_timeouts', 0) or 0)
+    if drain_timeouts > 0:
+        candidates.append((
+            min(1.0, 0.4 + 0.2 * drain_timeouts),
+            'control-plane-degraded',
+            '%d worker drain(s) overran drain_timeout_s in this window '
+            'and left splits to requeue' % drain_timeouts))
+    # Floor of 3: one giveup is routinely a single stale peer-fetch
+    # hint (all advertised holders missing one digest — the cluster
+    # tier calls that advisory); a dead dispatcher produces a steady
+    # giveup stream from every worker's heartbeat episodes.
+    giveups = int(counters.get('retry_giveups', 0) or 0)
+    if giveups >= 3:
+        candidates.append((
+            min(1.0, 0.3 + 0.1 * giveups),
+            'control-plane-degraded',
+            '%d retry episode(s) exhausted their budget in this window '
+            '(retry_giveups: heartbeat backoff or all-holders-failed '
+            'peer fetches)' % giveups))
+    if meta:
+        # Cumulative lineage from the stats meta, crash-LOOP floor: a
+        # restarted dispatcher carries a FRESH flight ring, so its own
+        # restarts never show in its windowed delta — the ledger
+        # lineage is the only place a repeat offender is visible.
+        restarts = int(meta.get('ledger_restores', 0) or 0)
+        if restarts >= 2:
+            candidates.append((
+                min(1.0, 0.4 + 0.15 * restarts),
+                'control-plane-degraded',
+                'dispatcher restarted %d times over this job (ledger '
+                'lineage) — a control-plane crash loop' % restarts))
 
     candidates.sort(key=lambda c: c[0], reverse=True)
     return candidates

@@ -69,6 +69,23 @@ def render_stats(stats, elapsed_s=None):
                cluster.get('cache_peer_fills', 0),
                cluster.get('cache_peer_degraded', 0),
                cluster.get('cache_affinity_routed', 0)))
+    control = stats.get('control_plane') or {}
+    if control.get('ledger') or control.get('drains') \
+            or control.get('drain_timeouts') \
+            or control.get('retry_attempts') \
+            or control.get('retry_giveups'):
+        # Crash-survivable control plane (ISSUE 15): ledger lineage,
+        # drain traffic, and the fleet's backoff-retry counters.
+        lines.append(
+            'control ledger %-3s restores %-3d adoptions %-3d drains '
+            '%-3d timeouts %-3d retries %d (giveups %d)'
+            % ('on' if control.get('ledger') else 'off',
+               control.get('ledger_restores', 0),
+               control.get('ledger_adoptions', 0),
+               control.get('drains', 0),
+               control.get('drain_timeouts', 0),
+               control.get('retry_attempts', 0),
+               control.get('retry_giveups', 0)))
     stages = stats.get('stages') or {}
     if stages:
         # The dispatcher built these with telemetry.summarize_hist — the

@@ -57,6 +57,8 @@ class _BandwidthLimitedFile(object):
         return b''.join(out)
 
     def __getattr__(self, name):
+        if name == '_f':  # mid-unpickle: not yet restored
+            raise AttributeError(name)
         return getattr(self._f, name)
 
     def __enter__(self):
@@ -102,4 +104,6 @@ class BandwidthLimitedFilesystem(object):
         return handle
 
     def __getattr__(self, name):
+        if name == '_inner':  # mid-unpickle: not yet restored
+            raise AttributeError(name)
         return getattr(self._inner, name)

@@ -1,90 +1,19 @@
-"""Fault-injection filesystems for exercising the retry/poisoning plane.
+"""Back-compat re-exports of the fault-injection filesystems.
 
-No reference equivalent (SURVEY.md §5.3: the reference has no fault
-injection hooks); these are the public counterpart to the framework's
-transient-retry + ``PoisonedRowGroupError`` machinery — wrap any fsspec
-filesystem and pass it as ``make_reader(..., filesystem=...)`` to simulate
-GCS flakes deterministically.
-
-Only *data* files (``*.parquet`` not starting with ``_``) are failed:
-footer/metadata reads happen at reader construction, which deliberately has
-no retry layer.
+The flaky filesystems were promoted into the chaos plane's seam
+registry (``petastorm_tpu/test_util/chaos.py``, ISSUE 15 — the PR 14
+``BandwidthLimitedFilesystem`` promotion precedent): they are the
+public counterpart to the framework's transient-retry +
+``PoisonedRowGroupError`` machinery and now live next to the rest of
+the deterministic fault inventory, with direct unit tests
+(``tests/test_chaos.py``).  This module keeps the historical import
+path working; new code should import from ``test_util.chaos``.
 """
 
-from petastorm_tpu.utils.locks import make_lock
+from petastorm_tpu.test_util.chaos import (FlakyOpenFilesystem,  # noqa: F401
+                                           FlakyReadFilesystem,
+                                           _DyingFile, is_data_file)
 
+__all__ = ['FlakyOpenFilesystem', 'FlakyReadFilesystem', 'is_data_file']
 
-def is_data_file(path):
-    """True for row-group data files (``*.parquet`` not ``_``-prefixed)."""
-    name = path.rsplit('/', 1)[-1]
-    return name.endswith('.parquet') and not name.startswith('_')
-
-
-_is_data_file = is_data_file  # module-internal alias
-
-
-class FlakyOpenFilesystem(object):
-    """Delegating fs whose first ``fail_times`` opens of each data file raise
-    OSError."""
-
-    def __init__(self, real_fs, fail_times):
-        self._real = real_fs
-        self._fail_times = fail_times
-        self._counts = {}
-        self._lock = make_lock('test_util.fault_injection.FlakyOpenFilesystem._lock')
-
-    # Documented to ride ``make_reader(..., filesystem=...)``, which the
-    # ProcessPool pickles into worker args — the lock (and the injection
-    # counts, which are per-process bookkeeping) must stay behind.
-    def __getstate__(self):
-        state = self.__dict__.copy()
-        del state['_lock']
-        # Counts consumed in the parent (e.g. the construction-time footer
-        # read) must not eat a worker's injection budget.
-        del state['_counts']
-        return state
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
-        self._counts = {}
-        self._lock = make_lock('test_util.fault_injection.FlakyOpenFilesystem._lock')
-
-    def open(self, path, *args, **kwargs):
-        if _is_data_file(path):
-            with self._lock:
-                n = self._counts.get(path, 0)
-                self._counts[path] = n + 1
-            if n < self._fail_times:
-                raise OSError('injected transient open failure #%d on %s' % (n, path))
-        return self._real.open(path, *args, **kwargs)
-
-    def __getattr__(self, name):
-        if name == '_real':  # mid-unpickle: not yet restored
-            raise AttributeError(name)
-        return getattr(self._real, name)
-
-
-class FlakyReadFilesystem(FlakyOpenFilesystem):
-    """First open of each data file succeeds but the handle dies on first
-    read — exercises eviction of a wedged cached handle."""
-
-    def open(self, path, *args, **kwargs):
-        handle = self._real.open(path, *args, **kwargs)
-        if _is_data_file(path):
-            with self._lock:
-                n = self._counts.get(path, 0)
-                self._counts[path] = n + 1
-            if n < self._fail_times:
-                return _DyingFile(handle)
-        return handle
-
-
-class _DyingFile(object):
-    def __init__(self, inner):
-        self._inner = inner
-
-    def read(self, *args, **kwargs):
-        raise OSError('injected read failure')
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
+_is_data_file = is_data_file  # historical module-internal alias

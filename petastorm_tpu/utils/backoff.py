@@ -1,0 +1,183 @@
+"""Unified retry/backoff policy for every control-plane retry loop.
+
+One jittered-exponential policy (ISSUE 15) replacing the ad-hoc
+fixed-interval retries that grew per-plane: worker heartbeats retried at
+exactly the heartbeat cadence, clients re-polled discovery at a fixed
+1 Hz, and peer fetches walked holder lists back to back.  Fixed
+intervals are individually harmless and collectively a thundering herd:
+after a dispatcher blip every worker in the fleet retries *in lockstep*
+(they all failed at the same instant, so they all wake at the same
+instant), and a control plane that just restarted takes the whole
+fleet's retry storm on its first serve-loop tick.
+
+:class:`BackoffPolicy` is the tunable (base/cap/factor/jitter +
+optional deadline); :class:`Backoff` is one retry *episode* — stateful
+attempt counter, deadline tracking, and a ``give_up`` verdict callers
+turn into their terminal path.  Jitter is **full jitter** (delay drawn
+uniformly from ``[base_s, computed]``): the fleet's retries decorrelate
+within one attempt instead of synchronizing forever on the exponential
+envelope.
+
+Kill switch: ``PETASTORM_TPU_NO_BACKOFF_JITTER=1`` pins every delay to
+the deterministic exponential envelope (no randomness) — for tests that
+assert exact schedules and for operators bisecting a timing bug.  The
+*exponential* part has no kill switch on purpose: reverting to fixed
+intervals is exactly the storm this module exists to prevent.
+
+Stdlib-only by design (control-plane modules import it before numpy/jax
+are safe to touch).
+"""
+
+import os
+import random
+import time
+
+__all__ = ['BackoffPolicy', 'Backoff', 'jittered', 'jitter_enabled',
+           'HEARTBEAT_POLICY', 'DISCOVERY_POLICY']
+
+
+def jitter_enabled():
+    """The jitter kill switch, read per delay so the env toggle works
+    mid-process (matches ``PETASTORM_TPU_NO_SHM`` semantics)."""
+    return os.environ.get('PETASTORM_TPU_NO_BACKOFF_JITTER', '') \
+        in ('', '0')
+
+
+def jittered(value, spread=0.25, rng=None):
+    """``value`` +/- ``spread`` fraction, uniform — the cadence
+    de-synchronizer for HEALTHY-path periodic work (heartbeats,
+    discovery polls): a fleet configured with one interval must not
+    beat in phase.  Returns ``value`` exactly under the kill switch."""
+    if not jitter_enabled():
+        return value
+    rng = rng if rng is not None else random
+    return value * (1.0 + spread * (2.0 * rng.random() - 1.0))
+
+
+class BackoffPolicy(object):
+    """Immutable description of one retry schedule.
+
+    Args:
+        base_s: first-attempt delay (and the jitter floor).
+        cap_s: the exponential envelope never exceeds this.
+        factor: per-attempt multiplier on the envelope.
+        deadline_s: give up once this much wall time has elapsed in the
+            episode (None = retry forever; the caller's loop condition
+            is then the only bound).
+        max_attempts: give up after this many delays (None = unbounded).
+    """
+
+    __slots__ = ('base_s', 'cap_s', 'factor', 'deadline_s', 'max_attempts')
+
+    def __init__(self, base_s, cap_s, factor=2.0, deadline_s=None,
+                 max_attempts=None):
+        if base_s <= 0 or cap_s < base_s or factor < 1.0:
+            raise ValueError('need 0 < base_s <= cap_s and factor >= 1, '
+                             'got base_s=%r cap_s=%r factor=%r'
+                             % (base_s, cap_s, factor))
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.factor = float(factor)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.max_attempts = (None if max_attempts is None
+                             else int(max_attempts))
+
+    def envelope(self, attempt):
+        """Deterministic delay ceiling of the ``attempt``-th retry
+        (0-based): ``min(cap, base * factor**attempt)``."""
+        return min(self.cap_s, self.base_s * (self.factor ** attempt))
+
+    def delay(self, attempt, rng=None):
+        """One concrete delay for the ``attempt``-th retry: uniform in
+        ``[base_s, envelope]`` (full jitter), or the bare envelope under
+        the kill switch."""
+        ceiling = self.envelope(attempt)
+        if not jitter_enabled():
+            return ceiling
+        rng = rng if rng is not None else random
+        return self.base_s + (ceiling - self.base_s) * rng.random()
+
+    def episode(self, rng=None, now=None):
+        """A fresh :class:`Backoff` episode under this policy."""
+        return Backoff(self, rng=rng, now=now)
+
+
+class Backoff(object):
+    """One retry episode: attempt counter + deadline bookkeeping.
+
+    Usage::
+
+        retry = HEARTBEAT_POLICY.episode()
+        while True:
+            try:
+                return rpc.call(request)
+            except ServiceRpcTimeoutError:
+                if retry.give_up():
+                    raise
+                time.sleep(retry.next_delay())
+
+    The caller owns the sleep (event loops fold the delay into their
+    poll timeout instead); ``next_delay`` only computes and counts.
+    """
+
+    __slots__ = ('policy', 'attempts', '_rng', '_t0', '_clock')
+
+    def __init__(self, policy, rng=None, now=None):
+        self.policy = policy
+        self.attempts = 0
+        self._rng = rng
+        self._clock = now if now is not None else time.monotonic
+        self._t0 = self._clock()
+
+    def next_delay(self):
+        """Delay before the next retry (seconds); advances the attempt
+        counter.  Clamped so a delay never overshoots the deadline —
+        the last retry fires AT the deadline, not past it."""
+        delay = self.policy.delay(self.attempts, rng=self._rng)
+        self.attempts += 1
+        if self.policy.deadline_s is not None:
+            remaining = self.policy.deadline_s - (self._clock() - self._t0)
+            delay = max(0.0, min(delay, remaining))
+        return delay
+
+    def give_up(self):
+        """True once the episode exhausted its deadline or attempt
+        budget — the caller's terminal path (raise / degrade)."""
+        if self.policy.max_attempts is not None \
+                and self.attempts >= self.policy.max_attempts:
+            return True
+        if self.policy.deadline_s is not None \
+                and (self._clock() - self._t0) >= self.policy.deadline_s:
+            return True
+        return False
+
+    def reset(self):
+        """A success: the next failure starts a fresh episode."""
+        self.attempts = 0
+        self._t0 = self._clock()
+
+
+#: Worker heartbeat / re-register retries.  base well under the
+#: heartbeat cadence (a single dropped beat retries quickly), cap at a
+#: typical lease TTL (a worker must not silently sit out several TTLs
+#: and lose its leases to expiry while "backing off").  max_attempts
+#: bounds the EPISODE, not the worker: exhausting it counts one
+#: ``retry_giveups`` (the dead-dispatcher signal the
+#: control-plane-degraded regime reads) and a fresh episode begins —
+#: the worker itself retries until its own stop/drain path ends the
+#: loop.
+HEARTBEAT_POLICY = BackoffPolicy(base_s=0.2, cap_s=5.0, factor=2.0,
+                                 max_attempts=8)
+
+#: Client discovery polls.  base_s IS the healthy cadence (the 1 Hz
+#: poll, now jittered so a consumer fleet spreads over the second);
+#: failures widen toward cap_s so a dead dispatcher sees a trickle,
+#: not a synchronized hammer.
+#:
+#: (Peer fetches deliberately have NO delay policy: every advertised
+#: holder of a digest is a DIFFERENT resource, tried back to back on
+#: the decode thread — a delay earned by one failed holder buys
+#: nothing against the next.  They share only the retry TELEMETRY:
+#: extra attempts count ``retry_attempts``, an all-holders-failed walk
+#: counts one ``retry_giveups``.)
+DISCOVERY_POLICY = BackoffPolicy(base_s=1.0, cap_s=8.0, factor=2.0)

@@ -1,0 +1,260 @@
+"""The chaos plane (ISSUE 15): seam engine, seeded determinism, the
+promoted fault-injection filesystems, the delivery digest, and one real
+scenario through the matrix runner.
+
+The full >= 6-scenario matrix runs via ``petastorm-tpu-chaos matrix``
+(bench/CI); here the engine itself is pinned — a typo'd seam, a broken
+budget, or a digest that stopped detecting duplicates would silently
+hollow out every scenario's assertions.
+"""
+
+import json
+import os
+import pickle
+import time
+
+import fsspec
+import numpy as np
+import pytest
+
+from petastorm_tpu.test_util import chaos
+
+
+@pytest.fixture(autouse=True)
+def _inert_chaos():
+    """Every test starts and ends with no armed spec (the module global
+    must never leak between tests)."""
+    chaos.deactivate()
+    yield
+    chaos.deactivate()
+
+
+# -- seam engine --------------------------------------------------------------
+
+def test_inject_is_inert_without_activation():
+    assert chaos.active() is None
+    assert chaos.inject('worker.chunk', split=1, seq=0) is None
+
+
+def test_budget_and_counts():
+    state = chaos.activate({'seed': 0, 'faults': [
+        {'seam': 'worker.chunk', 'action': 'drop', 'p': 1.0, 'max': 2}]})
+    actions = [chaos.inject('worker.chunk', seq=i) for i in range(4)]
+    assert actions == ['drop', 'drop', None, None]
+    assert state.counts == {('worker.chunk', 'drop'): 2}
+    assert state.fired() == 2
+
+
+def test_ops_filter_matches_context():
+    chaos.activate({'seed': 0, 'faults': [
+        {'seam': 'rpc.request', 'action': 'drop', 'p': 1.0,
+         'ops': ['heartbeat']}]})
+    assert chaos.inject('rpc.request', op='lease') is None
+    assert chaos.inject('rpc.request', op='heartbeat') == 'drop'
+
+
+def test_seeded_decisions_are_deterministic():
+    spec = {'seed': 42, 'faults': [
+        {'seam': 'worker.chunk', 'action': 'drop', 'p': 0.5}]}
+    runs = []
+    for _ in range(2):
+        chaos.activate(spec, salt=3)
+        runs.append([chaos.inject('worker.chunk', seq=i)
+                     for i in range(32)])
+        chaos.deactivate()
+    assert runs[0] == runs[1]
+    assert 'drop' in runs[0] and None in runs[0]
+    # A different salt (another process role) decorrelates the stream.
+    chaos.activate(spec, salt=4)
+    assert [chaos.inject('worker.chunk', seq=i)
+            for i in range(32)] != runs[0]
+
+
+def test_delay_action_sleeps_and_error_action_raises():
+    chaos.activate({'seed': 0, 'faults': [
+        {'seam': 'worker.decode', 'action': 'delay', 'p': 1.0,
+         'delay_s': 0.05, 'max': 1},
+        {'seam': 'fs.open', 'action': 'error', 'p': 1.0}]})
+    t0 = time.monotonic()
+    assert chaos.inject('worker.decode', split=0) == 'delay'
+    assert time.monotonic() - t0 >= 0.05
+    with pytest.raises(chaos.ChaosInjectedError):
+        chaos.inject('fs.open', path='x.parquet')
+
+
+def test_unknown_action_rejected_unknown_seam_warns():
+    with pytest.raises(ValueError, match='action'):
+        chaos.ChaosState({'faults': [{'seam': 'rpc.request',
+                                      'action': 'explode'}]})
+    # Unknown seam: tolerated (warn) — it can never fire.
+    state = chaos.ChaosState({'faults': [{'seam': 'nope',
+                                          'action': 'drop'}]})
+    assert state.fire('rpc.request', {}) is None
+
+
+def test_env_arming_round_trip(monkeypatch):
+    spec = {'seed': 9, 'faults': [{'seam': 'worker.chunk',
+                                   'action': 'dup', 'p': 1.0, 'max': 1}]}
+    monkeypatch.setenv(chaos.CHAOS_ENV, json.dumps(spec))
+    monkeypatch.setenv(chaos.CHAOS_SALT_ENV, '2')
+    chaos._arm_from_env()
+    assert chaos.inject('worker.chunk', seq=0) == 'dup'
+    # Unparseable env must be ignored, never crash an importing worker.
+    chaos.deactivate()
+    monkeypatch.setenv(chaos.CHAOS_ENV, '{not json')
+    chaos._arm_from_env()
+    assert chaos.active() is None
+
+
+# -- promoted fault-injection filesystems -------------------------------------
+
+@pytest.fixture()
+def parquet_file(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = str(tmp_path / 'part.parquet')
+    pq.write_table(pa.table({'id': np.arange(8)}), path)
+    return path
+
+
+def test_is_data_file_rules():
+    assert chaos.is_data_file('/x/part-0001.parquet')
+    assert not chaos.is_data_file('/x/_common_metadata')
+    assert not chaos.is_data_file('/x/_metadata.parquet')
+    assert not chaos.is_data_file('/x/readme.txt')
+
+
+def test_flaky_open_fails_then_recovers(parquet_file):
+    fs = chaos.FlakyOpenFilesystem(fsspec.filesystem('file'),
+                                   fail_times=2)
+    for _ in range(2):
+        with pytest.raises(OSError, match='injected transient open'):
+            fs.open(parquet_file, 'rb')
+    with fs.open(parquet_file, 'rb') as handle:
+        assert handle.read(4) == b'PAR1'
+    # Non-data files never fail.
+    meta = str(os.path.dirname(parquet_file)) + '/_metadata'
+    open(meta, 'wb').close()
+    fs.open(meta, 'rb').close()
+
+
+def test_flaky_read_dies_on_first_read_only(parquet_file):
+    fs = chaos.FlakyReadFilesystem(fsspec.filesystem('file'),
+                                   fail_times=1)
+    handle = fs.open(parquet_file, 'rb')  # open SUCCEEDS...
+    with pytest.raises(OSError, match='injected read failure'):
+        handle.read(4)                    # ...the read dies
+    with fs.open(parquet_file, 'rb') as second:
+        assert second.read(4) == b'PAR1'
+
+
+def test_flaky_fs_pickles_without_lock_or_counts(parquet_file):
+    fs = chaos.FlakyOpenFilesystem(fsspec.filesystem('file'),
+                                   fail_times=1)
+    with pytest.raises(OSError):
+        fs.open(parquet_file, 'rb')   # budget consumed in the parent
+    clone = pickle.loads(pickle.dumps(fs))
+    # The child re-arms: its injection budget is its own.
+    with pytest.raises(OSError):
+        clone.open(parquet_file, 'rb')
+    with clone.open(parquet_file, 'rb') as handle:
+        assert handle.read(4) == b'PAR1'
+
+
+def test_fault_injection_back_compat_reexports():
+    from petastorm_tpu.test_util import fault_injection
+    assert fault_injection.FlakyOpenFilesystem \
+        is chaos.FlakyOpenFilesystem
+    assert fault_injection.FlakyReadFilesystem \
+        is chaos.FlakyReadFilesystem
+    assert fault_injection.is_data_file is chaos.is_data_file
+    assert fault_injection._is_data_file is chaos.is_data_file
+
+
+def test_bandwidth_limited_fs_registered_and_picklable(parquet_file):
+    """The PR 14 emulation filesystem sits in the seam registry and —
+    regression for the recursion bug the fetch_latency_spike scenario
+    exposed — survives a pickle round trip (it rides reader_kwargs
+    across the control plane)."""
+    fs = chaos.FILESYSTEM_FAULTS['bandwidth_limited'](
+        fsspec.filesystem('file'), bps=1e9)
+    clone = pickle.loads(pickle.dumps(fs))
+    with clone.open(parquet_file, 'rb') as handle:
+        assert handle.read(4) == b'PAR1'
+
+
+# -- delivery digest ----------------------------------------------------------
+
+def test_delivery_digest_is_order_independent_and_content_exact():
+    a = chaos.DeliveryDigest()
+    a.update({'id': np.array([0, 1]), 'x': np.array([1.0, 2.0])})
+    a.update({'id': np.array([2]), 'x': np.array([3.0])})
+    b = chaos.DeliveryDigest()
+    b.update({'id': np.array([2]), 'x': np.array([3.0])})
+    b.update({'id': np.array([1, 0]), 'x': np.array([2.0, 1.0])})
+    assert a.hexdigest() == b.hexdigest()
+    assert a.rows == b.rows == 3
+    # One flipped bit anywhere changes the digest...
+    c = chaos.DeliveryDigest()
+    c.update({'id': np.array([0, 1, 2]), 'x': np.array([1.0, 2.0, 3.1])})
+    assert c.hexdigest() != a.hexdigest()
+    # ...and a duplicated row can never cancel a missing one (the row
+    # count rides in the digest).
+    d = chaos.DeliveryDigest()
+    d.update({'id': np.array([0, 0, 2]), 'x': np.array([1.0, 1.0, 3.0])})
+    assert d.hexdigest() != a.hexdigest()
+
+
+def test_direct_read_digest_matches_itself(tmp_path):
+    url, rows = chaos.make_chaos_dataset(str(tmp_path / 'ds'), rows=16,
+                                         payload_bytes=64)
+    assert chaos.direct_read_digest(url) == chaos.direct_read_digest(url)
+    assert rows == 16
+
+
+# -- scenario catalogue + one real run ----------------------------------------
+
+def test_scenario_catalogue_meets_the_acceptance_bar():
+    # >= 6 distinct fault scenarios, covering every required class.
+    assert len(chaos.SCENARIOS) >= 6
+    for required in ('dispatcher_kill', 'worker_kill', 'worker_drain',
+                     'message_drop', 'fetch_latency_spike',
+                     'shm_enospc', 'plane_enospc'):
+        assert required in chaos.SCENARIOS, required
+    assert set(chaos.SMOKE_SCENARIOS) <= set(chaos.SCENARIOS)
+    assert len(chaos.SMOKE_SCENARIOS) == 3
+    for name, scenario in chaos.SCENARIOS.items():
+        assert scenario.get('summary'), name
+        for fault in scenario.get('faults') or ():
+            assert fault['seam'] in chaos.SEAMS, (name, fault)
+
+
+def test_message_drop_scenario_end_to_end(tmp_path):
+    """One REAL scenario through the runner in-suite: dropped chunks and
+    control RPCs, digest + exactly-once + zero residue asserted — the
+    harness itself is what this pins (the full matrix runs in CI's
+    chaos-smoke step and the bench)."""
+    url, rows = chaos.make_chaos_dataset(str(tmp_path / 'ds'), seed=11)
+    report = chaos.run_scenario('message_drop', url, rows,
+                                str(tmp_path), seed=11)
+    assert report['ok'], report
+    assert report['checks']['digest'] == 'ok'
+    assert report['checks']['exactly_once'] == 'ok'
+    assert report['checks']['zero_residue'] == 'ok'
+    assert sum(report['injections'].values()) > 0, \
+        'scenario ran but injected nothing'
+
+
+def test_error_action_restricted_to_handled_seams():
+    """`action: error` is only accepted at seams whose caller models
+    the failure — anywhere else the raise would kill the process (the
+    dispatcher would die without sending its REP reply), which is an
+    outage, not an injected fault."""
+    with pytest.raises(ValueError, match='no\\s+handler'):
+        chaos.ChaosState({'faults': [{'seam': 'dispatcher.rpc',
+                                      'action': 'error'}]})
+    with pytest.raises(ValueError, match='no\\s+handler'):
+        chaos.ChaosState({'faults': [{'seam': 'worker.chunk',
+                                      'action': 'error'}]})
+    for seam in ('worker.decode', 'fs.open', 'fs.read'):
+        chaos.ChaosState({'faults': [{'seam': seam, 'action': 'error'}]})

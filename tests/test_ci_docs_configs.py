@@ -252,6 +252,64 @@ def test_bench_compact_line_pins_provenance_fields():
         'provenance_overhead_leg missing from the leg table'
 
 
+def test_bench_compact_line_pins_control_plane_recovery_fields():
+    """The crash-survivable control plane's evidence (ISSUE 15):
+    dispatcher-restart time-to-first-batch cold vs ledger-restored, the
+    speedup ratio, and the in-leg exactly-once flag must ride the
+    compact machine line; the leg must sit in the shared host-leg
+    table; and the speedup must be trend-gated."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    for field in ('control_plane_ttfb_cold_s',
+                  'control_plane_ttfb_restored_s',
+                  'control_plane_recovery_speedup',
+                  'control_plane_exactly_once'):
+        assert "'%s'" % field in block.group(1), field
+    assert re.search(
+        r"_IPC_PLANE_LEGS = \((?:.|\n)*?control_plane_recovery_leg", src), \
+        'control_plane_recovery_leg missing from the leg table'
+    from petastorm_tpu.benchmark import trend
+    assert 'control_plane_recovery_speedup' in trend.TRACKED_FIELDS
+
+
+def test_chaos_cli_registered_and_ci_runs_the_smoke():
+    """ISSUE 15: the chaos harness entry point must stay registered and
+    the CI tests job must run the fast 3-scenario smoke (the invariant
+    gate on every PR); the catalogue itself must keep the >= 6-scenario
+    acceptance floor."""
+    src = open(os.path.join(REPO, 'pyproject.toml')).read()
+    block = re.search(r'\[project\.scripts\](.*?)(\n\[|$)', src, re.S)
+    assert 'petastorm-tpu-chaos' in block.group(1)
+    job = _load_ci()['jobs']['tests']
+    run_text = '\n'.join(s['run'] for s in job['steps'] if 'run' in s)
+    assert 'python -m petastorm_tpu.test_util.chaos matrix --smoke' \
+        in run_text
+    from petastorm_tpu.test_util import chaos
+    assert len(chaos.SCENARIOS) >= 6
+    assert len(chaos.SMOKE_SCENARIOS) == 3
+
+
+def test_docs_carry_control_plane_rows():
+    """ISSUE 15 docs: data_service.md must document the ledger file
+    format, drain semantics, the chaos CLI, and the backoff policy
+    (the 'Operating the control plane' section + failure-matrix rows);
+    observability.md must carry the new regime, counters, and
+    verdicts."""
+    ds = open(os.path.join(REPO, 'docs', 'data_service.md')).read()
+    for needle in ('Operating the control plane', 'ledger_path',
+                   'dispatcher_ledger', 'drain_timeout_s',
+                   'petastorm-tpu-chaos', 'PETASTORM_TPU_CHAOS',
+                   'PETASTORM_TPU_NO_BACKOFF_JITTER',
+                   'control_plane_recovery_speedup', 'ledger_restores'):
+        assert needle in ds, needle
+    obs = open(os.path.join(REPO, 'docs', 'observability.md')).read()
+    for needle in ('control-plane-degraded', 'ledger_restores',
+                   'drain_timeouts', 'retry_giveups',
+                   'dispatcher-restarts', 'drain-timeout'):
+        assert needle in obs, needle
+
+
 def test_docs_carry_provenance_plane_rows():
     """ISSUE 13 docs: observability.md must document the provenance
     record model, the explain CLI, the kill switch, the SLO watchdog,

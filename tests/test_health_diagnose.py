@@ -684,3 +684,79 @@ def test_repo_bench_history_round_one_checks_clean():
     assert isinstance(history[0].get('value'), (int, float))
     report = trend.check(path=path)
     assert report['ok']
+
+
+# -- control-plane-degraded regime + verdicts (ISSUE 15) ----------------------
+
+def test_control_plane_degraded_regime_candidates():
+    from petastorm_tpu.telemetry import health
+
+    def regimes(delta, meta=None):
+        return [r for _, r, _ in health.classify_regime(delta, meta=meta)]
+
+    # Windowed restart delta (a flight/artifact window spanning one).
+    assert 'control-plane-degraded' in regimes(
+        {'counters': {'ledger_restores': 1}})
+    # Cumulative lineage >= 2 = crash loop (a restarted dispatcher's
+    # fresh ring can never show its own restart as a delta).
+    assert 'control-plane-degraded' in regimes(
+        {}, meta={'ledger_restores': 2})
+    assert 'control-plane-degraded' not in regimes(
+        {}, meta={'ledger_restores': 1})
+    # Drain timeouts and backoff giveups evidence it from the WINDOWED
+    # delta only — one resolved day-1 incident (cumulative meta) must
+    # not classify the fleet degraded forever.
+    assert 'control-plane-degraded' in regimes(
+        {'counters': {'drain_timeouts': 1}})
+    assert 'control-plane-degraded' in regimes(
+        {'counters': {'retry_giveups': 3}})
+    # ...but a single giveup (one stale peer-fetch hint) stays quiet.
+    assert 'control-plane-degraded' not in regimes(
+        {'counters': {'retry_giveups': 1}})
+    assert 'control-plane-degraded' not in regimes(
+        {'counters': {}}, meta={'drain_timeouts': 5,
+                                'retry_giveups': 9})
+    # ...and a clean window stays quiet.
+    assert 'control-plane-degraded' not in regimes(
+        {'counters': {}}, meta={'ledger_restores': 0,
+                                'drain_timeouts': 0,
+                                'retry_giveups': 0})
+    assert 'control-plane-degraded' in health.REGIMES
+
+
+def test_dispatcher_restarts_verdict():
+    from petastorm_tpu.telemetry.diagnose import rule_dispatcher_restarts
+    assert rule_dispatcher_restarts({'control_plane': {}}) is None
+    verdict = rule_dispatcher_restarts({'control_plane': {
+        'ledger_restores': 1, 'ledger_adoptions': 2,
+        'ledger_requeues': 1}})
+    assert verdict['severity'] == 'warn'
+    assert 'restarted 1 time' in verdict['summary']
+    assert '2 orphan lease(s) resumed' in verdict['evidence']
+    crit = rule_dispatcher_restarts({'control_plane': {
+        'ledger_restores': 3}})
+    assert crit['severity'] == 'crit'
+
+
+def test_drain_timeout_verdict():
+    from petastorm_tpu.telemetry.diagnose import rule_drain_timeouts
+    assert rule_drain_timeouts({'control_plane': {'drains': 5}}) is None
+    verdict = rule_drain_timeouts({'control_plane': {
+        'drain_timeouts': 2, 'drains': 5}})
+    assert verdict['severity'] == 'warn'
+    assert 'timed out 2 time(s) (of 5 drains)' in verdict['summary']
+    assert 'drain_timeout_s' in verdict['action']
+
+
+def test_stats_evidence_carries_control_plane_rollup():
+    from petastorm_tpu.telemetry.diagnose import (evidence_from_stats,
+                                                  run_rules)
+    evidence = evidence_from_stats({
+        'pending': 0, 'leased': 0, 'done': 4, 'failed': 0,
+        'lease_churn': 0, 'workers': {},
+        'control_plane': {'ledger_restores': 3, 'drain_timeouts': 1,
+                          'drains': 2}})
+    assert evidence['control_plane']['ledger_restores'] == 3
+    ids = {v['id'] for v in run_rules(evidence)}
+    assert 'dispatcher-restarts' in ids
+    assert 'drain-timeout' in ids

@@ -251,11 +251,15 @@ WORKER_DIAG_KEYS = {
     'shm_chunks', 'shm_degraded', 'cache_hits', 'cache_misses',
     'cache_evictions', 'cache_ram_hits', 'cache_degraded',
     # cluster cache tier (ISSUE 10)
-    'cache_remote_hits', 'cache_peer_fills', 'cache_peer_degraded'}
+    'cache_remote_hits', 'cache_peer_fills', 'cache_peer_degraded',
+    # crash-survivable control plane (ISSUE 15): unified-backoff retry
+    # telemetry + the drain state flag
+    'retry_attempts', 'retry_giveups', 'draining'}
 
 DISPATCHER_STATS_KEYS = {
     'num_splits', 'pending', 'leased', 'done', 'failed', 'lease_churn',
-    'cache', 'shm', 'cluster_cache', 'stages', 'health', 'workers'}
+    'cache', 'shm', 'cluster_cache', 'control_plane', 'stages', 'health',
+    'workers'}
 
 
 def test_golden_keys_thread_reader_and_loader(dataset):
