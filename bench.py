@@ -1962,6 +1962,187 @@ def provenance_overhead_leg(pairs=3, seconds=3.0):
     }
 
 
+def multi_tenant_leg(pairs=2):
+    """Multi-tenant serving tier (ISSUE 16): two tenants with weights
+    1:3 sharing one 2-worker fleet over the JPEG dataset, against a
+    cluster cache plane warmed by a prior single-tenant epoch.
+
+    Passes per pair (fresh dispatcher each, medians reported):
+
+    * ``warm_solo``: the default tenant alone on the warm plane — the
+      warm-fleet throughput reference;
+    * ``duo``: the default tenant (weight 1) plus a registered ``burst``
+      tenant (weight 3) consuming the SAME dataset concurrently on the
+      warm plane — the co-tenant compounding evidence;
+    * ``fair``: the same 1:3 pair, but cache plane OFF and decode-bound
+      — the only regime where the WDRR grant share is visible in row
+      rates (on a warm plane each stream is capped by its own consumer,
+      not the contended fleet, and every ratio reads ~1).  The
+      fair-share ratio is burst-rows over default-rows inside the
+      window where BOTH streams were active (outside it the survivor
+      takes the whole fleet and the ratio means nothing); the WDRR
+      target is the weight ratio 3.0, trend-gated within the usual
+      noise band.
+
+    Correctness is asserted in-leg, not reported-and-ignored: every
+    stream must deliver exactly-once (sorted ids == the full dataset)
+    and bit-identical content (order-independent DeliveryDigest equal to
+    the cold direct-serve reference).  Co-tenant compounding (the
+    acceptance criterion: a second tenant on an already-decoded dataset
+    rides the cluster cache instead of re-decoding) shows up as
+    ``multi_tenant_remote_hits`` > 0 and the duo's combined rate
+    relative to warm-solo."""
+    import threading
+
+    from petastorm_tpu.service import (Dispatcher, ServiceConfig,
+                                       ServiceDataLoader, Worker)
+    from petastorm_tpu.service.client import register_tenant_job
+    from petastorm_tpu.test_util.chaos import DeliveryDigest
+
+    ensure_dataset()
+    plane = os.path.join(BENCH_DIR, 'multi_tenant_v1', 'plane')
+    _wipe_plane(plane)
+    # One rowgroup per split = 12 grants per tenant epoch: enough lease
+    # granularity that the 3:1 WDRR share is measurable, not quantized.
+    fair_kwargs = dict(dataset_url=DATASET_URL, num_consumers=1,
+                       rowgroups_per_split=1, lease_ttl_s=30.0,
+                       reader_kwargs={'workers_count': 1})
+    job_kwargs = dict(fair_kwargs, cache_plane=True,
+                      cache_plane_dir=plane)
+
+    def fleet_pass(tenants, kwargs):
+        """``tenants``: [(tenant_or_None, weight), ...] consumed
+        concurrently against a fresh dispatcher built from ``kwargs``
+        (co-tenant jobs register the same kwargs); returns
+        (streams, worker_diags)."""
+        config = ServiceConfig(**kwargs)
+        streams = [{'tenant': t, 'weight': w, 'deliveries': [],
+                    'ids': [], 'digest': None, 'error': None}
+                   for t, w in tenants]
+
+        def consume(stream):
+            try:
+                digest = DeliveryDigest()
+                loader = ServiceDataLoader(
+                    addr, batch_size=BATCH, consumer=0, drop_last=False,
+                    prefetch=2, tenant=stream['tenant'])
+                with loader:
+                    for batch in loader.iter_host_batches():
+                        digest.update(batch)
+                        stream['deliveries'].append(
+                            (time.monotonic(), len(batch['noun_id'])))
+                        stream['ids'].extend(
+                            np.asarray(batch['noun_id']).tolist())
+                stream['digest'] = digest.hexdigest()
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                stream['error'] = e
+
+        with Dispatcher(config) as dispatcher:
+            addr = dispatcher.addr
+            workers = [Worker(addr).start() for _ in range(2)]
+            try:
+                for stream in streams:
+                    if stream['tenant'] is not None:
+                        register_tenant_job(addr, stream['tenant'],
+                                            kwargs,
+                                            weight=stream['weight'])
+                threads = [threading.Thread(target=consume, args=(s,),
+                                            daemon=True) for s in streams]
+                for t in threads:
+                    t.start()
+                deadline = time.monotonic() + 600.0
+                for t in threads:
+                    t.join(max(1.0, deadline - time.monotonic()))
+                    if t.is_alive():
+                        raise RuntimeError('multi-tenant leg: consumer '
+                                           'wedged')
+                for stream in streams:
+                    if stream['error'] is not None:
+                        raise stream['error']
+                diags = [w.diagnostics for w in workers]
+            finally:
+                for w in workers:
+                    w.stop()
+                for w in workers:
+                    w.join()
+        return streams, diags
+
+    def check_stream(stream, ref_digest):
+        tag = stream['tenant'] or 'default'
+        if sorted(stream['ids']) != list(range(NUM_IMAGES)):
+            raise AssertionError(
+                'multi-tenant leg: tenant %r delivery was not '
+                'exactly-once (%d rows)' % (tag, len(stream['ids'])))
+        if ref_digest is not None and stream['digest'] != ref_digest:
+            raise AssertionError(
+                'multi-tenant leg: tenant %r content diverged from the '
+                'reference (%s vs %s)' % (tag, stream['digest'],
+                                          ref_digest))
+
+    def solo_rate(stream):
+        deliveries = stream['deliveries']
+        if len(deliveries) < 2:
+            return 0.0
+        t0, t_end = deliveries[0][0], deliveries[-1][0]
+        rows = sum(n for _, n in deliveries[1:])
+        return rows / (t_end - t0) if t_end > t0 else 0.0
+
+    def window_ratio(default, burst):
+        """Burst-over-default rows inside the both-streams-active
+        window."""
+        start = max(s['deliveries'][0][0] for s in (default, burst))
+        end = min(s['deliveries'][-1][0] for s in (default, burst))
+        in_window = [sum(n for t, n in s['deliveries']
+                         if start < t <= end) for s in (default, burst)]
+        return (in_window[1] / in_window[0]) if in_window[0] else None
+
+    # Untimed cold pass: decodes the epoch into the plane AND supplies
+    # the content reference every later stream must match.
+    (ref,), _ = fleet_pass([(None, 1.0)], job_kwargs)
+    check_stream(ref, None)
+    ref_digest = ref['digest']
+
+    rates = {'warm_solo': [], 'duo': []}
+    ratios = []
+    remote_hits = 0
+    for _ in range(max(1, int(pairs))):
+        (solo,), _ = fleet_pass([(None, 1.0)], job_kwargs)
+        check_stream(solo, ref_digest)
+        rates['warm_solo'].append(solo_rate(solo))
+
+        streams, diags = fleet_pass([(None, 1.0), ('burst', 3.0)],
+                                    job_kwargs)
+        for stream in streams:
+            check_stream(stream, ref_digest)
+        remote_hits += sum(d['cache_remote_hits'] for d in diags)
+        merged = sorted(t for s in streams for t, _ in s['deliveries'])
+        total = sum(n for s in streams for _, n in s['deliveries'])
+        rates['duo'].append(total / (merged[-1] - merged[0])
+                            if merged[-1] > merged[0] else 0.0)
+
+        streams, _ = fleet_pass([(None, 1.0), ('burst', 3.0)],
+                                fair_kwargs)
+        for stream in streams:
+            check_stream(stream, ref_digest)
+        ratios.append(window_ratio(*streams))
+
+    med = {k: float(np.median(v)) for k, v in rates.items()}
+    measured = [r for r in ratios if r is not None]
+    ratio = float(np.median(measured)) if measured else None
+    return {
+        'multi_tenant_images_per_sec_warm_solo':
+            round(med['warm_solo'], 1),
+        'multi_tenant_images_per_sec_duo': round(med['duo'], 1),
+        'multi_tenant_fair_share_ratio':
+            round(ratio, 2) if ratio is not None else None,
+        'multi_tenant_duo_over_warm_solo':
+            round(med['duo'] / med['warm_solo'], 2)
+            if med['warm_solo'] else None,
+        'multi_tenant_remote_hits': remote_hits,
+        'multi_tenant_exactly_once': True,
+    }
+
+
 #: Host-only IPC/transfer-plane legs (the shm result plane's and the
 #: transfer plane's evidence sets), wired identically into the
 #: cpu-fallback and on-chip paths of main() — one table so the two paths
@@ -1977,6 +2158,7 @@ _IPC_PLANE_LEGS = (
     ('object_store_ingest', object_store_ingest_leg),
     ('provenance_overhead', provenance_overhead_leg),
     ('control_plane_recovery', control_plane_recovery_leg),
+    ('multi_tenant', multi_tenant_leg),
 )
 
 
@@ -2262,6 +2444,12 @@ _COMPACT_KEYS = (
     'control_plane_ttfb_restored_s',
     'control_plane_recovery_speedup',
     'control_plane_exactly_once',
+    'multi_tenant_images_per_sec_warm_solo',
+    'multi_tenant_images_per_sec_duo',
+    'multi_tenant_fair_share_ratio',
+    'multi_tenant_duo_over_warm_solo',
+    'multi_tenant_remote_hits',
+    'multi_tenant_exactly_once',
     'ipc_bytes_per_s', 'h2d_bytes_per_s',
     'kernel_backend', 'kernel_max_err',
     'legs_failed', 'throughput_error', 'device_unhealthy', 'last_tpu',

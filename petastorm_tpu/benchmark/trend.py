@@ -55,6 +55,10 @@ TRACKED_FIELDS = (
     # ISSUE 15: ledger-restored over cold dispatcher-restart TTFB — a
     # ratio, so host-load noise on the absolute TTFBs largely cancels.
     'control_plane_recovery_speedup',
+    # ISSUE 16: burst-over-default row rate while both tenants are
+    # active — a ratio (weight target 3.0), so host-load noise on the
+    # absolute rates largely cancels.
+    'multi_tenant_fair_share_ratio',
 )
 
 #: The ONLY backend labels ``bench.py`` ever emits: ``jax.default_backend()``

@@ -31,13 +31,31 @@ machinery:
   SIGTERM / the ``drain`` RPC, and ``petastorm-tpu-chaos``
   (``test_util/chaos.py``) is the scenario matrix proving digest +
   exactly-once + zero residue under injected faults.
+* ``petastorm_tpu.service.tenancy`` — the multi-tenant serving tier
+  (ISSUE 16): several consumers with distinct datasets/configs share
+  one worker fleet.  Co-tenant jobs register at runtime
+  (:func:`~petastorm_tpu.service.client.register_tenant_job`, consumed
+  with ``ServiceDataLoader(tenant=...)``), lease grants are
+  weighted-deficit-round-robin fair across tenants (composing with the
+  cache-affinity split pick), admission is bounded
+  (``max_tenant_jobs``, structured ``retry_after_s`` refusals), and
+  per-tenant shm/cache byte quotas degrade — never stall — the
+  over-budget tenant.
+* ``petastorm_tpu.service.autoscaler`` — the closed-loop fleet
+  autoscaler (ISSUE 16): an in-dispatcher tick controller
+  (``ServiceConfig(autoscale=True)``) that scales out on sustained
+  lease starvation through a pluggable ``WorkerLauncher`` and scales in
+  through the graceful drain path (least cache-coverage victim), damped
+  by cooldown/step/min-max bounds; kill switch
+  ``PETASTORM_TPU_NO_AUTOSCALE=1``.
 
 Console entry point: ``petastorm-tpu-data-service`` (see
 ``petastorm_tpu/service/cli.py``).
 """
 
 from petastorm_tpu.service.client import (ServiceDataLoader,  # noqa: F401
-                                          ServiceReader)
+                                          ServiceReader,
+                                          register_tenant_job)
 from petastorm_tpu.service.config import ServiceConfig  # noqa: F401
 from petastorm_tpu.service.dispatcher import Dispatcher  # noqa: F401
 from petastorm_tpu.service.worker import Worker  # noqa: F401

@@ -82,6 +82,36 @@ def _build_parser():
                         'the data-plane end headers (metrics registries '
                         'and heartbeat stats stay on; see '
                         'docs/observability.md)')
+    d.add_argument('--max-tenant-jobs', type=int, default=8,
+                   help='admission cap on CONCURRENT tenant jobs sharing '
+                        'this fleet (ISSUE 16); registrations past it '
+                        'are refused with a retry_after_s hint')
+    d.add_argument('--tenant-shm-quota-bytes', type=int, default=None,
+                   help='per-tenant cap on outstanding shm-arena bytes; '
+                        'over-quota chunks degrade to the byte path '
+                        '(default: unlimited)')
+    d.add_argument('--tenant-cache-quota-bytes', type=int, default=None,
+                   help='per-tenant cap on cache-plane bytes written per '
+                        'worker; past it the tenant reads/decodes '
+                        'without the plane (default: unlimited)')
+    d.add_argument('--autoscale', action='store_true',
+                   help='closed-loop fleet autoscaler (ISSUE 16): spawn '
+                        'workers when leases starve, drain the least-'
+                        'cache-covered worker when the fleet idles; '
+                        'PETASTORM_TPU_NO_AUTOSCALE=1 is the kill switch')
+    d.add_argument('--autoscale-min-workers', type=int, default=1)
+    d.add_argument('--autoscale-max-workers', type=int, default=8)
+    d.add_argument('--autoscale-step', type=int, default=1,
+                   help='max workers spawned per scale-out action')
+    d.add_argument('--autoscale-cooldown-s', type=float, default=10.0,
+                   help='hysteresis: no further action for this long '
+                        'after any scale action')
+    d.add_argument('--autoscale-starve-s', type=float, default=3.0,
+                   help='pending work + zero free lease slots must '
+                        'persist this long before a scale-out')
+    d.add_argument('--autoscale-idle-s', type=float, default=30.0,
+                   help='a fully idle fleet must persist this long '
+                        'before a scale-in drain')
 
     w = sub.add_parser('worker', help='run one decode worker')
     w.add_argument('--dispatcher', required=True,
@@ -159,7 +189,17 @@ def main(argv=None):
             ingest=args.ingest,
             telemetry_spans=not args.no_telemetry_spans,
             ledger_path=args.ledger_path,
-            drain_timeout_s=args.drain_timeout_s)
+            drain_timeout_s=args.drain_timeout_s,
+            max_tenant_jobs=args.max_tenant_jobs,
+            tenant_shm_quota_bytes=args.tenant_shm_quota_bytes,
+            tenant_cache_quota_bytes=args.tenant_cache_quota_bytes,
+            autoscale=args.autoscale,
+            autoscale_min_workers=args.autoscale_min_workers,
+            autoscale_max_workers=args.autoscale_max_workers,
+            autoscale_step=args.autoscale_step,
+            autoscale_cooldown_s=args.autoscale_cooldown_s,
+            autoscale_starve_s=args.autoscale_starve_s,
+            autoscale_idle_s=args.autoscale_idle_s)
         with Dispatcher(config, bind=args.bind) as dispatcher:
             print('dispatcher serving %s (%d splits, %d consumers)'
                   % (dispatcher.addr, dispatcher._job['num_splits'],

@@ -36,6 +36,7 @@ import time
 
 from petastorm_tpu.errors import ServiceError
 from petastorm_tpu.jax.loader import DataLoader
+from petastorm_tpu.service import tenancy
 from petastorm_tpu.service.worker import _Rpc, deserialize_chunk
 from petastorm_tpu.telemetry import merge_into_recorder, provenance
 from petastorm_tpu.utils import backoff
@@ -48,11 +49,15 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
 
     def __init__(self, dispatcher_addr, consumer=None, resume=None,
                  ordered=False, queue_splits=4, credits=None,
-                 rpc_timeout_s=20.0, trace_recorder=None):
+                 rpc_timeout_s=20.0, trace_recorder=None, tenant=None):
         import zmq
 
         self._zmq = zmq
         self._dispatcher_addr = dispatcher_addr
+        #: Which tenant's job this connection consumes (ISSUE 16).  None
+        #: asks for the dispatcher's own (default) job — the tenant-less
+        #: wire shape every pre-tenancy client sends.
+        self.tenant = None if tenant is None else str(tenant)
         self._context = zmq.Context()
         self._rpc_timeout_s = rpc_timeout_s
         #: optional ``benchmark.TraceRecorder``: worker spans riding the
@@ -77,9 +82,16 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
         rpc = _Rpc(self._context, self._dispatcher_addr,
                    timeout_s=self._rpc_timeout_s)
         try:
-            self.job = rpc.call({'op': 'job'})['job']
+            request = {'op': 'job'}
+            if self.tenant is not None:
+                request['tenant'] = self.tenant
+            self.job = rpc.call(request)['job']
         finally:
             rpc.close()
+        # The effective tenant (the job's own id) — subscribes and the
+        # resume token carry THIS, so a tenant-less connection to the
+        # default job round-trips as 'default' everywhere downstream.
+        self.tenant = str(self.job.get('tenant') or tenancy.DEFAULT_TENANT)
         if consumer is None:
             consumer = _default_consumer(self.job['num_consumers'])
         if not 0 <= consumer < self.job['num_consumers']:
@@ -93,8 +105,13 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
         self._credits = int(credits if credits is not None
                             else self.job['credits'])
         self._ordered = bool(ordered)
-        self._my_splits = [sid for sid in range(self.job['num_splits'])
-                           if sid % self.job['num_consumers'] == self.consumer]
+        # Tenant jobs live in a GLOBAL split-id space starting at
+        # split_base; the consumer-modulo shard is over the tenant-LOCAL
+        # index so every tenant's consumers spread the same way the
+        # single-tenant (base 0) job always did.
+        base = int(self.job.get('split_base', 0))
+        self._my_splits = [base + i for i in range(self.job['num_splits'])
+                           if i % self.job['num_consumers'] == self.consumer]
         # Same-host shm delivery: create the /dev/shm probe whose
         # visibility proves to a worker that descriptors will map here.
         # Workers without sight of it (cross-host) keep the byte path.
@@ -289,6 +306,7 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                         sock.connect(addr)
                         sock.send(pickle.dumps(
                             {'type': 'subscribe', 'consumer': self.consumer,
+                             'tenant': self.tenant,
                              'credits': self._credits,
                              'shm_probe': self._shm_probe}, protocol=4))
                         sockets[addr] = sock
@@ -477,6 +495,62 @@ class _ServiceConnection(object):  # ptlint: disable=pickle-unsafe-attrs — one
                 continue
 
 
+def register_tenant_job(dispatcher_addr, tenant, config_kwargs, weight=1.0,
+                        rpc_timeout_s=20.0, max_wait_s=120.0):
+    """Register ``tenant``'s job on a running dispatcher (ISSUE 16).
+
+    ``config_kwargs`` are :class:`~petastorm_tpu.service.config.
+    ServiceConfig` keyword arguments (``dataset_url`` at minimum); the
+    dispatcher builds the config, appends the tenant's splits to the
+    global id space, and every registered worker starts serving them
+    under the fair-share schedule — no new fleet.
+
+    Admission is bounded (``max_tenant_jobs``): a refusal past the cap
+    carries ``retry_after_s`` and this helper queues-with-backoff up to
+    ``max_wait_s`` before raising a clear :class:`ServiceError`.  Any
+    other refusal (duplicate tenant, bad config) raises immediately.
+
+    Returns the registered job's ``job_info`` dict (``split_base``,
+    ``num_splits``, ...), which a :class:`ServiceDataLoader` constructed
+    with ``tenant=`` then consumes.
+    """
+    import zmq
+
+    context = zmq.Context()
+    try:
+        rpc = _Rpc(context, dispatcher_addr, timeout_s=rpc_timeout_s)
+        try:
+            deadline = time.monotonic() + max_wait_s
+            while True:
+                # raw=True: an admission refusal is a structured reply
+                # (error + retry_after_s), not an exception — we need to
+                # read the retry hint before deciding to raise.
+                reply = rpc.call(
+                    {'op': 'register_job', 'tenant': str(tenant),
+                     'weight': float(weight),
+                     'config': dict(config_kwargs)}, raw=True)
+                if isinstance(reply, dict) and reply.get('job') is not None:
+                    return reply['job']
+                error = (reply or {}).get('error', 'malformed reply')
+                retry_after = (reply or {}).get('retry_after_s')
+                if retry_after is None:
+                    raise ServiceError(
+                        'dispatcher %s refused tenant %r job: %s'
+                        % (dispatcher_addr, tenant, error))
+                delay = backoff.jittered(float(retry_after), 0.25)
+                if time.monotonic() + delay > deadline:
+                    raise ServiceError(
+                        'dispatcher %s still refusing tenant %r job '
+                        'after %.0fs (%s) — raise max_tenant_jobs or '
+                        'retire a finished job' % (dispatcher_addr, tenant,
+                                                   max_wait_s, error))
+                time.sleep(delay)
+        finally:
+            rpc.close()
+    finally:
+        context.term()
+
+
 def _default_consumer(num_consumers):
     """The sharding contract's default: this training host's index."""
     try:
@@ -575,6 +649,7 @@ class ServiceReader(object):
         return {'service': {
             'version': 1,
             'consumer': self._conn.consumer,
+            'tenant': self._conn.tenant,
             'consumed': sorted(self._conn.consumed),
             'num_splits': self._conn.job['num_splits'],
             'num_consumers': self._conn.job['num_consumers'],
@@ -600,6 +675,10 @@ class ServiceDataLoader(DataLoader):
         consumer: which consumer shard this host is; defaults to
             ``jax.process_index() % num_consumers`` — the service analog
             of the readers' JAX auto-sharding.
+        tenant: which tenant's job to consume on a shared fleet
+            (ISSUE 16); None (the default) consumes the dispatcher's own
+            job — exactly the pre-tenancy behavior.  Register other
+            tenants' jobs first via :func:`register_tenant_job`.
         ordered: release splits in split-id order (deterministic) instead
             of completion order.
         queue_splits / credits / rpc_timeout_s: client-side flow control;
@@ -614,14 +693,17 @@ class ServiceDataLoader(DataLoader):
 
     def __init__(self, dispatcher_addr, batch_size, consumer=None,
                  ordered=False, queue_splits=4, credits=None,
-                 rpc_timeout_s=20.0, resume_state=None, **kwargs):
+                 rpc_timeout_s=20.0, resume_state=None, tenant=None,
+                 **kwargs):
         svc = ((resume_state or {}).get('reader') or {}).get('service') or {}
         if svc and consumer is None:
             consumer = svc.get('consumer')
+        if svc and tenant is None:
+            tenant = svc.get('tenant')
         connection = _ServiceConnection(
             dispatcher_addr, consumer=consumer, resume=svc,
             ordered=ordered, queue_splits=queue_splits, credits=credits,
-            rpc_timeout_s=rpc_timeout_s,
+            rpc_timeout_s=rpc_timeout_s, tenant=tenant,
             # The loader's recorder doubles as the merge target for the
             # workers' spans: ONE timeline from rowgroup decode to H2D.
             trace_recorder=kwargs.get('trace_recorder'))
@@ -652,7 +734,8 @@ def _check_resume_geometry(svc, connection):
             ('fingerprint', connection.job['fingerprint']),
             ('num_splits', connection.job['num_splits']),
             ('num_consumers', connection.job['num_consumers']),
-            ('consumer', connection.consumer))
+            ('consumer', connection.consumer),
+            ('tenant', connection.tenant))
         if svc.get(key) is not None and svc[key] != current]
     if mismatches:
         raise ServiceError(

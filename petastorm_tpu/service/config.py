@@ -138,6 +138,50 @@ class ServiceConfig:
             its in-flight splits before it deregisters anyway
             (``timed_out=True`` — the dispatcher requeues whatever it
             still held, attempt+1, and counts ``drain_timeouts``).
+        tenant: the tenant id this config's job registers under (ISSUE
+            16).  The dispatcher's constructor config is the *default*
+            tenant's job; further tenants join a running dispatcher via
+            the ``register_job`` RPC (``client.register_tenant_job``)
+            with their own ServiceConfig.  Split ids stay globally
+            unique across tenants, so every split-addressed RPC is
+            tenant-agnostic.
+        tenant_weight: fair-share weight for weighted deficit
+            round-robin lease scheduling across tenants.  Tenant A at
+            weight 3 vs tenant B at weight 1 receives ~3x the lease
+            grants while both have pending work; a lone tenant's
+            schedule is bit-identical to the pre-tenancy dispatcher.
+        max_tenant_jobs: admission cap on CONCURRENT tenant jobs;
+            registration past the cap is refused with a retry hint
+            (clients queue with jittered backoff) rather than erroring.
+        tenant_shm_quota_bytes: per-tenant budget of outstanding shm
+            arena bytes on each worker (None = unlimited).  Over
+            budget, that tenant's chunks degrade to the byte path
+            (``shm_quota_degraded``) — never a stall.
+        tenant_cache_quota_bytes: per-tenant budget of bytes published
+            into the cache plane per worker (None = unlimited).  Over
+            budget, that tenant's later splits decode directly without
+            the plane (``cache_quota_degraded``) — the existing
+            degrade-to-direct-decode semantics.
+        autoscale: opt the dispatcher into the closed-loop autoscaler
+            (``service/autoscaler.py``): an in-dispatcher tick
+            controller scales the worker fleet out on sustained
+            lease-wait starvation and in (graceful drain, least
+            cache-coverage victim) on sustained idleness.  Requires a
+            ``WorkerLauncher`` (``Dispatcher(launcher=)``); the
+            subprocess launcher is the production seam.
+            ``PETASTORM_TPU_NO_AUTOSCALE=1`` is the kill switch.
+        autoscale_min_workers / autoscale_max_workers: alive-fleet
+            clamp; scale-in never drains below the min, scale-out never
+            spawns past the max.
+        autoscale_step: workers per scale-out action (bounded step —
+            half the flap damping).
+        autoscale_cooldown_s: seconds after ANY action during which the
+            controller only observes (the other half of the damping;
+            the chaos scale-storm bound derives from it).
+        autoscale_starve_s: how long pending splits must starve (no
+            free lease slot on any alive worker) before scaling out.
+        autoscale_idle_s: how long the fleet must be fully idle (no
+            pending, no leased) before scaling in.
     """
 
     dataset_url: str
@@ -163,6 +207,18 @@ class ServiceConfig:
     telemetry_spans: bool = True
     ledger_path: str = None
     drain_timeout_s: float = 30.0
+    tenant: str = 'default'
+    tenant_weight: float = 1.0
+    max_tenant_jobs: int = 8
+    tenant_shm_quota_bytes: int = None
+    tenant_cache_quota_bytes: int = None
+    autoscale: bool = False
+    autoscale_min_workers: int = 1
+    autoscale_max_workers: int = 8
+    autoscale_step: int = 1
+    autoscale_cooldown_s: float = 10.0
+    autoscale_starve_s: float = 3.0
+    autoscale_idle_s: float = 30.0
 
     def __post_init__(self):
         if self.num_consumers < 1:
@@ -195,6 +251,25 @@ class ServiceConfig:
                              "got %r" % (self.ingest,))
         if self.drain_timeout_s <= 0:
             raise ValueError('drain_timeout_s must be positive')
+        if not self.tenant or not isinstance(self.tenant, str):
+            raise ValueError('tenant must be a non-empty string')
+        if self.tenant_weight <= 0:
+            raise ValueError('tenant_weight must be positive')
+        if self.max_tenant_jobs < 1:
+            raise ValueError('max_tenant_jobs must be >= 1')
+        if self.autoscale:
+            if self.autoscale_min_workers < 0:
+                raise ValueError('autoscale_min_workers must be >= 0')
+            if self.autoscale_max_workers < max(1,
+                                                self.autoscale_min_workers):
+                raise ValueError('autoscale_max_workers must be >= '
+                                 'max(1, autoscale_min_workers)')
+            if self.autoscale_step < 1:
+                raise ValueError('autoscale_step must be >= 1')
+            if self.autoscale_cooldown_s < 0 \
+                    or self.autoscale_starve_s < 0 \
+                    or self.autoscale_idle_s < 0:
+                raise ValueError('autoscale timings must be >= 0')
         if self.heartbeat_interval_s is None:
             self.heartbeat_interval_s = self.lease_ttl_s / 3.0
 
@@ -234,4 +309,12 @@ class ServiceConfig:
             'telemetry_spans': bool(self.telemetry_spans),
             'drain_timeout_s': float(self.drain_timeout_s),
             'fingerprint': self.fingerprint(num_splits),
+            # Multi-tenant serving tier (ISSUE 16).  The dispatcher
+            # overlays the assigned 'split_base' when it registers the
+            # job; 0 here keeps a bare job_info() self-consistent.
+            'tenant': self.tenant,
+            'tenant_weight': float(self.tenant_weight),
+            'split_base': 0,
+            'tenant_shm_quota_bytes': self.tenant_shm_quota_bytes,
+            'tenant_cache_quota_bytes': self.tenant_cache_quota_bytes,
         }

@@ -52,9 +52,11 @@ full plane degrades that piece to direct decode — see
 """
 
 import collections
+import dataclasses
 import logging
 import pickle
 import threading
+from petastorm_tpu.service import tenancy as _tenancy
 from petastorm_tpu.utils.locks import make_lock
 import time
 
@@ -82,12 +84,14 @@ class Split(object):
     """One leasable unit of decode work: consecutive row-group indices."""
 
     __slots__ = ('split_id', 'indices', 'consumer', 'attempt', 'state',
-                 'worker_id', 'lease_expires', 'affinity_defer_until')
+                 'worker_id', 'lease_expires', 'affinity_defer_until',
+                 'tenant')
 
-    def __init__(self, split_id, indices, consumer):
+    def __init__(self, split_id, indices, consumer, tenant='default'):
         self.split_id = split_id
         self.indices = list(indices)
         self.consumer = consumer
+        self.tenant = tenant
         self.attempt = 0
         self.state = _PENDING
         self.worker_id = None
@@ -99,22 +103,28 @@ class Split(object):
 
     def describe(self):
         return {'split_id': self.split_id, 'indices': list(self.indices),
-                'consumer': self.consumer, 'attempt': self.attempt}
+                'consumer': self.consumer, 'attempt': self.attempt,
+                'tenant': self.tenant}
 
 
-def build_splits(num_pieces, rowgroups_per_split, num_consumers):
+def build_splits(num_pieces, rowgroups_per_split, num_consumers,
+                 split_base=0, tenant='default'):
     """Cut ``num_pieces`` row groups into Split objects.
 
     Consecutive grouping keeps each split's reads sequential on disk;
     the consumer assignment is the ``_shard_indices`` modulo contract
     over SPLITS (not row groups), so consumers own disjoint, covering
-    subsets by construction.
+    subsets by construction.  ``split_base`` offsets the split ids into
+    the dispatcher's GLOBAL id space (ISSUE 16: each tenant's slice
+    starts where the previous one ended), while the consumer modulo
+    runs over the tenant-LOCAL index so sharding is per-job.
     """
     splits = []
     for start in range(0, num_pieces, rowgroups_per_split):
-        sid = len(splits)
+        local = len(splits)
         indices = range(start, min(start + rowgroups_per_split, num_pieces))
-        splits.append(Split(sid, indices, sid % num_consumers))
+        splits.append(Split(split_base + local, indices,
+                            local % num_consumers, tenant=tenant))
     return splits
 
 
@@ -133,7 +143,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
     """
 
     def __init__(self, config, bind='tcp://127.0.0.1:*', num_pieces=None,
-                 trace_recorder=None):
+                 trace_recorder=None, launcher=None):
         self._config = config
         self._bind = bind
         self._trace = trace_recorder
@@ -145,9 +155,24 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                              % (config.dataset_url,))
         self._num_pieces = int(num_pieces)
         self._splits = build_splits(num_pieces, config.rowgroups_per_split,
-                                    config.num_consumers)
+                                    config.num_consumers,
+                                    tenant=config.tenant)
         self._job = config.job_info(len(self._splits))
-        self._pending = collections.deque(self._splits)
+        # -- multi-tenant serving tier (ISSUE 16) ----------------------------
+        # The constructor config IS the default tenant's job; further
+        # tenants join over the `register_job` RPC with their own
+        # configs, their splits appended to the GLOBAL id space so every
+        # split-addressed RPC stays tenant-agnostic.
+        self._default_tenant = config.tenant
+        self._tenants = _tenancy.TenantRegistry(
+            max_jobs=getattr(config, 'max_tenant_jobs', 8))
+        self._scheduler = _tenancy.TenantScheduler()
+        default_job = _tenancy.TenantJob(
+            config.tenant, config.tenant_weight, config, self._job,
+            split_base=0, num_splits=len(self._splits),
+            num_pieces=self._num_pieces, registered_t=time.monotonic())
+        default_job.pending = collections.deque(self._splits)
+        self._tenants.admit(default_job)
         self._workers = {}   # worker_id -> {'addr', 'last_heartbeat', 'stats'}
         self._next_worker_id = 0
         self.lease_churn = 0
@@ -213,6 +238,15 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         #: Health gauges land here so any Prometheus scrape of the
         #: dispatcher process carries them (``render_prometheus``).
         self.metrics = MetricsRegistry('dispatcher')
+        # -- closed-loop autoscaler (ISSUE 16) -------------------------------
+        # An in-dispatcher tick controller (flight-recorder pattern, no
+        # extra thread); PETASTORM_TPU_NO_AUTOSCALE=1 beats the config.
+        self.autoscaler = None
+        if getattr(config, 'autoscale', False):
+            from petastorm_tpu.service import autoscaler as _autoscaler
+            if launcher is None:
+                launcher = _autoscaler.SubprocessWorkerLauncher()
+            self.autoscaler = _autoscaler.Autoscaler(config, launcher)
         if getattr(config, 'ledger_path', None):
             from petastorm_tpu.service.ledger import DispatcherLedger
             # acquire() raises against a live owner BEFORE any state is
@@ -234,11 +268,56 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         from petastorm_tpu.service import ledger as _ledger_mod
         if state is None:
             return
-        if state.get('fingerprint') != self._job['fingerprint'] \
-                or int(state.get('num_splits', -1)) != len(self._splits):
+        if state.get('fingerprint') != self._job['fingerprint']:
             logger.warning(
                 'ledger %s was written under a different partition '
-                'geometry (fingerprint/num_splits mismatch); cold start',
+                'geometry (fingerprint mismatch); cold start',
+                self._ledger.path)
+            return
+        # v2 tenant table (ISSUE 16): rebuild every non-default tenant's
+        # job BEFORE gating on the flat split list — staged, so any
+        # rejection cold-starts WHOLE (a v1 file has no table and
+        # restores as the single default-tenant job it describes).
+        staged, base = [], len(self._splits)
+        from petastorm_tpu.service.config import ServiceConfig
+        for entry in state.get('tenants') or ():
+            try:
+                cfg = ServiceConfig(
+                    **_tenancy.config_from_jsonable(entry['config']))
+                tenant = str(entry['tenant'])
+                if int(entry['split_base']) != base:
+                    raise ValueError('split_base %r, expected %d'
+                                     % (entry['split_base'], base))
+                splits = build_splits(int(entry['num_pieces']),
+                                      cfg.rowgroups_per_split,
+                                      cfg.num_consumers,
+                                      split_base=base, tenant=tenant)
+                if len(splits) != int(entry['num_splits']):
+                    raise ValueError('rebuilt %d splits, recorded %d'
+                                     % (len(splits), entry['num_splits']))
+            except Exception as e:  # noqa: BLE001 — reject whole
+                logger.warning('ledger %s tenant table undecodable '
+                               '(%s: %s); cold start', self._ledger.path,
+                               type(e).__name__, e)
+                return
+            job = _tenancy.TenantJob(
+                tenant, float(entry.get('weight', 1.0)), cfg,
+                dict(cfg.job_info(len(splits)), split_base=base),
+                split_base=base, num_splits=len(splits),
+                num_pieces=int(entry['num_pieces']),
+                registered_t=time.monotonic())
+            staged.append((job, splits))
+            base += len(splits)
+        if len(staged) + 1 > self._tenants.max_jobs:
+            logger.warning(
+                'ledger %s holds %d tenant jobs over this dispatcher\'s '
+                'max_tenant_jobs=%d; cold start', self._ledger.path,
+                len(staged) + 1, self._tenants.max_jobs)
+            return
+        if int(state.get('num_splits', -1)) != base:
+            logger.warning(
+                'ledger %s was written under a different partition '
+                'geometry (num_splits mismatch); cold start',
                 self._ledger.path)
             return
         try:
@@ -247,15 +326,17 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             logger.warning('ledger %s has undecodable split records '
                            '(%s); cold start', self._ledger.path, e)
             return
-        if len(records) != len(self._splits):
+        if len(records) != base:
             # Rejected WHOLE: zip() would silently truncate and
             # half-apply a short record list (tail splits re-decoding
             # at attempt 0 contradicts everything the ledger promises).
             logger.warning(
                 'ledger %s holds %d split records for a %d-split job; '
-                'cold start', self._ledger.path, len(records),
-                len(self._splits))
+                'cold start', self._ledger.path, len(records), base)
             return
+        for job, splits in staged:
+            self._splits.extend(splits)
+            self._tenants.admit(job)
         now = time.monotonic()
         restored = collections.Counter()
         for split, (split_state, attempt) in zip(self._splits, records):
@@ -272,8 +353,11 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 split.state = _LEASED
                 split.worker_id = None
                 split.lease_expires = now + self._config.lease_ttl_s
-        self._pending = collections.deque(
-            s for s in self._splits if s.state == _PENDING)
+        for job in self._tenants.jobs():
+            job.pending = collections.deque(
+                s for s in self._splits[job.split_base:
+                                        job.split_base + job.num_splits]
+                if s.state == _PENDING)
         self._ledger_digests_by_addr = {
             str(addr): {str(d) for d in digests}
             for addr, digests in (state.get('worker_digests') or {}).items()}
@@ -303,6 +387,19 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             # a SECOND restart too: carry restored-but-unclaimed addrs.
             for addr, held in self._ledger_digests_by_addr.items():
                 digests.setdefault(addr, sorted(held))
+            # v2 tenant table (ISSUE 16): everything needed to rebuild a
+            # non-default tenant's job at restore WITHOUT touching its
+            # dataset (num_pieces is recorded, not re-counted).  The
+            # default tenant is the constructor config and needs no row.
+            from petastorm_tpu.service import tenancy as _tenancy
+            tenants = [
+                {'tenant': job.tenant, 'weight': job.weight,
+                 'split_base': job.split_base,
+                 'num_splits': job.num_splits,
+                 'num_pieces': job.num_pieces,
+                 'config': _tenancy.config_to_jsonable(
+                     dataclasses.asdict(job.config))}
+                for job in self._tenants.jobs() if job.split_base > 0]
             return {
                 'fingerprint': self._job['fingerprint'],
                 'dataset_url': self._config.dataset_url,
@@ -310,6 +407,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 'splits': _ledger_mod.encode_splits(self._splits),
                 'worker_digests': digests,
                 'piece_digests': self._piece_digests,
+                'tenants': tenants,
                 'restores': self.ledger_restores,
                 'saved_unix': time.time(),
             }
@@ -398,6 +496,9 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 # One fleet flight frame per interval, from the loop the
                 # control plane already runs (contained inside tick()).
                 self.flight.maybe_tick()
+                # Closed-loop autoscaler tick (ISSUE 16): same pattern —
+                # observe under the lock, act outside it.
+                self._autoscale_tick()
                 if not dict(poller.poll(100)):
                     continue
                 raw = socket.recv()
@@ -434,6 +535,10 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             # The ring is the postmortem: leave the last window on disk
             # when a flight dir is configured (best-effort by contract).
             self.flight.persist(reason='dispatcher_exit')
+            if self.autoscaler is not None:
+                # Reap launcher-owned worker children: an exiting control
+                # plane must not strand the processes it spawned.
+                self.autoscaler.close()
             if self._ledger is not None:
                 # Final snapshot + owner release: the FILE stays — it is
                 # the next incarnation's restore source.
@@ -474,9 +579,67 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         merged['counters']['ledger_restores'] = self.ledger_restores
         merged['counters']['drains'] = self.drains
         merged['counters']['drain_timeouts'] = self.drain_timeouts
+        # Multi-tenant serving tier (ISSUE 16): per-tenant grant
+        # counters in the ring — their windowed deltas are the
+        # tenant-starved evidence (one tenant's grants flat while
+        # another's climb) — plus the autoscaler's action counters so
+        # the chaos scale-storm bound reads from the same frames.
+        with self._lock:
+            for job in self._tenants.jobs():
+                merged['counters']['tenant_grants:%s' % job.tenant] = \
+                    job.grants
+        if self.autoscaler is not None:
+            merged['counters']['autoscale_outs'] = self.autoscaler.scale_outs
+            merged['counters']['autoscale_ins'] = self.autoscaler.scale_ins
         return merged
 
+    # -- closed-loop autoscaler (ISSUE 16) -----------------------------------
+
+    def _autoscale_tick(self):
+        """One control-law evaluation: observation built under the lock,
+        the (blocking) spawn/drain action executed outside it by the
+        autoscaler/drain machinery the dispatcher already has."""
+        if self.autoscaler is None or not self.autoscaler.enabled:
+            return
+        stale = 3.0 * self._config.lease_ttl_s
+        now = time.monotonic()
+        with self._lock:
+            states = collections.Counter(s.state for s in self._splits)
+            pending, leased = states[_PENDING], states[_LEASED]
+            alive = [wid for wid, w in sorted(self._workers.items())
+                     if not w.get('draining')
+                     and (now - w['last_heartbeat']) < stale]
+            held = collections.Counter(
+                s.worker_id for s in self._splits
+                if s.state == _LEASED and s.worker_id is not None)
+            free_slots = sum(
+                max(0, self._config.max_inflight_splits - held[wid])
+                for wid in alive)
+            coverage = {wid: len(self._worker_digests.get(wid, ()))
+                        for wid in alive}
+        action = self.autoscaler.maybe_tick({
+            'pending': pending, 'leased': leased, 'alive': alive,
+            'free_slots': free_slots, 'coverage': coverage,
+            'dispatcher_addr': self.addr}, now=now)
+        if action and action[0] == 'scale_in':
+            victim = action[1]
+            with self._lock:
+                worker = self._workers.get(victim)
+                if worker is not None:
+                    worker['draining'] = True
+            logger.info('autoscaler draining worker %s (least cache '
+                        'coverage)', victim)
+
     # -- lease bookkeeping ---------------------------------------------------
+
+    def _pending_for(self, split):
+        """The owning tenant's pending deque (caller holds the lock).
+        Splits always carry the tenant they were built under; a missing
+        job (evicted tenant) falls back to the default job's deque so a
+        requeue can never drop work on the floor."""
+        job = self._tenants.get(split.tenant) \
+            or self._tenants.get(self._default_tenant)
+        return job.pending
 
     def _expire_leases(self):
         now = time.monotonic()
@@ -495,7 +658,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                             'requeueing at attempt %d',
                             split.split_id, split.attempt)
                         split.state = _PENDING
-                        self._pending.append(split)
+                        self._pending_for(split).append(split)
                         self.ledger_requeues += 1
                         self._ledger_mark()
                         continue
@@ -518,7 +681,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                             'lease on split %d (attempt %d) expired; '
                             'requeueing', split.split_id, split.attempt)
                         split.state = _PENDING
-                        self._pending.append(split)
+                        self._pending_for(split).append(split)
                     if self._trace is not None:
                         self._trace.instant('service/lease_expired',
                                             split=split.split_id)
@@ -691,19 +854,23 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                     holders.setdefault(digest, []).append(worker['addr'])
         return holders or None
 
-    def _choose_pending(self, worker_id, consumers):
-        """Pop the split to lease to ``worker_id`` (None = nothing
-        assignable now).  FIFO, except that with directory evidence the
-        call prefers (within a bounded scan window) a split the
-        requester already holds, and keeps a split another live worker
-        holds back from a cold requester for a bounded window.  Splits
-        requeued by lease expiry (attempt > 0) are never kept back."""
+    def _choose_pending(self, job, worker_id, consumers):
+        """Pop the split (from tenant ``job``'s queue) to lease to
+        ``worker_id`` (None = nothing assignable now).  FIFO, except
+        that with directory evidence the call prefers (within a bounded
+        scan window) a split the requester already holds, and keeps a
+        split another live worker holds back from a cold requester for
+        a bounded window.  Splits requeued by lease expiry (attempt > 0)
+        are never kept back.  The WDRR scheduler picked the tenant;
+        this picks the split WITHIN it — the two compose, affinity
+        never overrides fair share."""
+        pending = job.pending
         affinity = (self._cluster_on and self._piece_digests is not None
                     and bool(self._worker_digests))
         window, skipped = [], []
         limit = _AFFINITY_SCAN if affinity else 1
-        while self._pending and len(window) < limit:
-            split = self._pending.popleft()
+        while pending and len(window) < limit:
+            split = pending.popleft()
             if split.state != _PENDING:
                 continue  # completed via mark_consumed while queued
             if consumers is not None and split.consumer not in consumers:
@@ -738,20 +905,36 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         # scan must not rotate the FIFO); consumer-mismatched splits
         # rejoin at the back exactly as before.
         for split in reversed([s for s in window if s is not chosen]):
-            self._pending.appendleft(split)
-        self._pending.extend(skipped)
+            pending.appendleft(split)
+        pending.extend(skipped)
         return chosen, routed
+
+    @staticmethod
+    def _parse_lease_consumers(consumers):
+        """``consumers`` from the wire → {tenant: {consumer, ...}} or
+        None (no filter).  Workers ship the tenant-qualified form
+        ``[[tenant, consumer], ...]``; a bare int (pre-ISSUE-16 worker)
+        means the default tenant's consumer — the single-tenant wire
+        protocol unchanged."""
+        if consumers is None:
+            return None
+        by_tenant = {}
+        for entry in consumers:
+            if isinstance(entry, (list, tuple)):
+                tenant, consumer = entry
+            else:
+                tenant, consumer = _tenancy.DEFAULT_TENANT, entry
+            by_tenant.setdefault(str(tenant), set()).add(int(consumer))
+        return by_tenant
 
     def _op_lease(self, request):
         worker_id = request['worker_id']
-        # ``consumers``: the consumer indices with a live subscriber on the
-        # requesting worker.  Leasing only their splits keeps a worker from
-        # decoding splits whose training host is absent (they would stall
-        # its shared send buffer); a request without the field leases
-        # anything.
-        consumers = request.get('consumers')
-        if consumers is not None:
-            consumers = {int(c) for c in consumers}
+        # ``consumers``: the (tenant, consumer) pairs with a live
+        # subscriber on the requesting worker.  Leasing only their
+        # splits keeps a worker from decoding splits whose training host
+        # is absent (they would stall its shared send buffer); a request
+        # without the field leases anything.
+        by_tenant = self._parse_lease_consumers(request.get('consumers'))
         with self._lock:
             if worker_id not in self._workers:
                 return {'error': 'unknown worker %r' % worker_id}
@@ -760,7 +943,31 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 # A draining worker gets no new work — the scale-in
                 # contract; its in-flight splits finish or hand back.
                 return {'wait': True, 'drain': True}
-            chosen, routed = self._choose_pending(worker_id, consumers)
+            # Two-level pick: WDRR chooses the tenant, the affinity
+            # scan chooses the split within it.  A tenant whose every
+            # candidate is affinity-deferred refunds its debit and the
+            # grant falls through to the next tenant — deferral must
+            # not eat a tenant's fair share.
+            chosen, routed = None, False
+            tried = set()
+            while chosen is None:
+                eligible = [
+                    j for j in self._tenants.jobs()
+                    if j.tenant not in tried and j.pending
+                    and (by_tenant is None or j.tenant in by_tenant)]
+                tenant = self._scheduler.pick(eligible)
+                if tenant is None:
+                    break
+                job = self._tenants.get(tenant)
+                cfilter = (None if by_tenant is None
+                           else by_tenant.get(tenant))
+                chosen, routed = self._choose_pending(
+                    job, worker_id, cfilter)
+                if chosen is None:
+                    self._scheduler.refund(tenant)
+                    tried.add(tenant)
+                else:
+                    job.grants += 1
             if chosen is not None:
                 chosen.state = _LEASED
                 chosen.worker_id = worker_id
@@ -782,7 +989,17 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 if holders:
                     reply['holders'] = holders
                 return reply
-            if all(s.state in (_DONE, _FAILED) for s in self._splits):
+            # 'done' is scoped to the tenants this worker serves: a
+            # worker streaming tenant A must not park because tenant B
+            # still has work (and vice versa a global check would hang
+            # A's worker on B's tail).
+            relevant = [j for j in self._tenants.jobs()
+                        if by_tenant is None or j.tenant in by_tenant]
+            if relevant and all(
+                    s.state in (_DONE, _FAILED)
+                    for j in relevant
+                    for s in self._splits[j.split_base:
+                                          j.split_base + j.num_splits]):
                 return {'done': True}
             return {'wait': True}
 
@@ -856,7 +1073,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 return {'ok': False}  # the lease moved on; nothing to do
             split.state = _PENDING
             split.worker_id = None
-            self._pending.appendleft(split)
+            self._pending_for(split).appendleft(split)
             self._ledger_mark()
             if self._trace is not None:
                 self._trace.instant('service/lease_released',
@@ -889,7 +1106,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                         split.state = _FAILED
                     else:
                         split.state = _PENDING
-                        self._pending.append(split)
+                        self._pending_for(split).append(split)
                     self._ledger_mark()
         logger.info('worker %s deregistered (%s drain)', worker_id,
                     'timed-out' if timed_out else 'clean')
@@ -897,7 +1114,62 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         return {'ok': True}
 
     def _op_job(self, request):
-        return {'job': self._job}
+        tenant = request.get('tenant')
+        if tenant is None:
+            return {'job': self._job}
+        with self._lock:
+            job = self._tenants.get(str(tenant))
+            if job is None:
+                return {'error': 'unknown tenant %r (registered: %s)'
+                                 % (tenant,
+                                    ', '.join(self._tenants.tenants()))}
+            return {'job': dict(job.job_info)}
+
+    def _op_register_job(self, request):
+        """Register a second (third, ...) tenant's job on this fleet
+        (ISSUE 16).  The new tenant's splits are appended to the GLOBAL
+        split-id space at ``split_base = len(splits)`` so every
+        split-addressed RPC works unchanged; admission is bounded
+        (``max_tenant_jobs``) and a refusal past the cap carries
+        ``retry_after_s`` so clients queue-with-backoff."""
+        from petastorm_tpu.service.config import ServiceConfig
+        tenant = str(request['tenant'])
+        weight = float(request.get('weight', 1.0))
+        kwargs = dict(request.get('config') or {})
+        kwargs['tenant'] = tenant
+        kwargs['tenant_weight'] = weight
+        try:
+            config = ServiceConfig(**kwargs)
+            num_pieces = _count_row_groups(config.dataset_url,
+                                           config.reader_kwargs)
+        except Exception as e:  # noqa: BLE001 — a bad registration must
+            # produce an error REPLY, never take the serve loop down.
+            return {'error': 'tenant %r registration rejected: %s'
+                             % (tenant, e)}
+        with self._lock:
+            if tenant in self._tenants:
+                return {'error': 'tenant %r is already registered '
+                                 '(one job per tenant id)' % tenant}
+            base = len(self._splits)
+            splits = build_splits(num_pieces, config.rowgroups_per_split,
+                                  config.num_consumers, split_base=base,
+                                  tenant=tenant)
+            job_info = dict(config.job_info(len(splits)),
+                            split_base=base)
+            job = _tenancy.TenantJob(
+                tenant, weight, config, job_info, split_base=base,
+                num_splits=len(splits), num_pieces=num_pieces,
+                registered_t=time.monotonic())
+            refusal = self._tenants.admit(job)
+            if refusal is not None:
+                return refusal
+            self._splits.extend(splits)
+            job.pending = collections.deque(splits)
+            self._ledger_mark()
+        logger.info('registered tenant %r: %d splits at base %d '
+                    '(weight %.2f)', tenant, len(splits), base, weight)
+        self._ledger_save(force=True)
+        return {'job': job_info}
 
     def _op_workers(self, request):
         stale = 3.0 * self._config.lease_ttl_s
@@ -956,13 +1228,14 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         cache = {key: sum(int(w.get(key, 0)) for w in workers.values())
                  for key in ('cache_hits', 'cache_misses',
                              'cache_evictions', 'cache_ram_hits',
-                             'cache_degraded')}
+                             'cache_degraded', 'cache_quota_degraded')}
         # shm result-plane rollup (ISSUE 5 satellite): the per-worker
         # counters rode the heartbeats all along but never summed — a
         # worker silently degraded to the byte path (arena full, /dev/shm
         # unusable) was invisible without reading every worker's row.
         shm = {key: sum(int(w.get(key, 0)) for w in workers.values())
-               for key in ('shm_chunks', 'shm_degraded')}
+               for key in ('shm_chunks', 'shm_degraded',
+                           'shm_quota_degraded')}
         # Cluster cache tier rollup (ISSUE 10): worker counters summed
         # fleet-wide plus the dispatcher's own routing counters and the
         # directory's footprint — one `status`/`top` call says whether
@@ -1026,12 +1299,63 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             frames[-1] if frames else None)
         delta = snapshot_delta(self._fleet_snapshot(),
                                baseline['snapshot'] if baseline else None)
+        # Multi-tenant rollup (ISSUE 16): per-tenant queue/grant state
+        # plus the fair-share scheduler's deficits — the `top` tenant
+        # table and the explain cost attribution read this, and the
+        # tenant-starved regime's evidence derives from it.
+        grant_deltas = {
+            name.split(':', 1)[1]: value
+            for name, value in (delta.get('counters') or {}).items()
+            if name.startswith('tenant_grants:')}
+        with self._lock:
+            deficits = self._scheduler.deficits()
+            tenants = {}
+            for job in self._tenants.jobs():
+                span = self._splits[job.split_base:
+                                    job.split_base + job.num_splits]
+                tstates = collections.Counter(s.state for s in span)
+                tenants[job.tenant] = {
+                    'weight': job.weight,
+                    'split_base': job.split_base,
+                    'num_splits': job.num_splits,
+                    'pending': tstates[_PENDING],
+                    'leased': tstates[_LEASED],
+                    'done': tstates[_DONE],
+                    'failed': tstates[_FAILED],
+                    'grants': job.grants,
+                    'grants_delta': int(grant_deltas.get(job.tenant, 0)),
+                    'deficit': round(deficits.get(job.tenant, 0.0), 3),
+                }
+        # A tenant is starved when it has pending work but took zero
+        # grants over the window WHILE another tenant's grants climbed:
+        # the fleet is moving, this tenant is not — the fair-share
+        # regression signal (a wholly idle fleet is lease-starved, a
+        # different regime).
+        fleet_moving = any(row['grants_delta'] > 0
+                           for row in tenants.values())
+        starved_tenants = sorted(
+            tid for tid, row in tenants.items()
+            if row['pending'] > 0 and row['grants_delta'] == 0
+            and fleet_moving)
+        if self.autoscaler is not None:
+            autoscale = self.autoscaler.snapshot()
+        else:
+            from petastorm_tpu.service import autoscaler as _autoscaler
+            # Same shape as Autoscaler.snapshot() so `top`, the golden
+            # stats schema, and trend diffs never branch on presence.
+            autoscale = {'enabled': False,
+                         'killed': _autoscaler.killed(),
+                         'scale_outs': 0, 'scale_ins': 0, 'actions': 0,
+                         'suppressed': 0, 'last_action': None}
         meta = {'pending': states[_PENDING], 'leased': states[_LEASED],
                 'failed': states[_FAILED], 'workers_alive': alive,
                 # control-plane-degraded evidence (ISSUE 15)
                 'ledger_restores': self.ledger_restores,
                 'drain_timeouts': self.drain_timeouts,
-                'retry_giveups': control['retry_giveups']}
+                'retry_giveups': control['retry_giveups'],
+                # fair-share regression evidence (ISSUE 16)
+                'starved_tenants': starved_tenants,
+                'tenant_count': len(tenants)}
         fleet_health = health.health_report(
             delta, meta=meta,
             window_s=(time.monotonic() - baseline['t_mono'])
@@ -1054,6 +1378,8 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             'shm': shm,
             'cluster_cache': cluster,
             'control_plane': control,
+            'tenants': tenants,
+            'autoscale': autoscale,
             'stages': stages,
             'health': fleet_health,
             'workers': workers,

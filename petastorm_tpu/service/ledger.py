@@ -59,7 +59,12 @@ __all__ = ['DispatcherLedger', 'LedgerHeldError', 'LEDGER_KIND',
            'LEDGER_VERSION', 'encode_splits', 'decode_splits']
 
 LEDGER_KIND = 'dispatcher_ledger'
-LEDGER_VERSION = 1
+#: v1 = single-tenant (PR 15); v2 adds the ``tenants`` table (ISSUE 16).
+#: ``load()`` accepts both — a v1 file restores as one default-tenant
+#: job — and cold-starts (with a distinct warning) on anything newer:
+#: a downgraded dispatcher must not half-apply state it cannot parse.
+LEDGER_VERSION = 2
+_COMPAT_VERSIONS = (1, 2)
 
 #: Compact per-split state codes (the splits list dominates the file).
 _STATE_CODES = {'pending': 'p', 'leased': 'l', 'done': 'd', 'failed': 'f'}
@@ -159,11 +164,25 @@ class DispatcherLedger(object):
             logger.warning('ledger %s unreadable (%s); cold start',
                            self.path, e)
             return None
-        if not isinstance(state, dict) \
-                or state.get('kind') != LEDGER_KIND \
-                or int(state.get('version', -1)) != LEDGER_VERSION:
-            logger.warning('ledger %s is not a v%d %s file; cold start',
-                           self.path, LEDGER_VERSION, LEDGER_KIND)
+        if not isinstance(state, dict) or state.get('kind') != LEDGER_KIND:
+            logger.warning('ledger %s is not a %s file; cold start',
+                           self.path, LEDGER_KIND)
+            return None
+        try:
+            version = int(state.get('version', -1))
+        except (TypeError, ValueError):
+            version = -1
+        if version > LEDGER_VERSION:
+            logger.warning(
+                'ledger %s is version %d, newer than this dispatcher '
+                'understands (v%d) — written by a newer release; cold '
+                'start (the file is left untouched)',
+                self.path, version, LEDGER_VERSION)
+            return None
+        if version not in _COMPAT_VERSIONS:
+            logger.warning('ledger %s is not a v%s %s file; cold start',
+                           self.path,
+                           '/'.join(map(str, _COMPAT_VERSIONS)), LEDGER_KIND)
             return None
         splits = state.get('splits')
         for entry in self._replay_journal():

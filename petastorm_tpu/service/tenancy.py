@@ -1,0 +1,296 @@
+"""Multi-tenant serving tier: shared fleets, fair shares, quotas (ISSUE 16).
+
+The data service ran one fleet per job; the tf.data-service design this
+subsystem reproduces (arxiv 2101.12127) serves N concurrent jobs over
+ONE worker fleet.  This module holds the tenant model the dispatcher
+wires in:
+
+* :class:`TenantJob` — one registered job: a tenant id, a fair-share
+  weight, the job's :class:`~petastorm_tpu.service.config.ServiceConfig`
+  -derived ``job_info`` dict, and its slice of the GLOBAL split-id
+  space.  Split ids stay globally unique (tenant N's splits start at
+  ``split_base``), so every existing split-addressed RPC — ``complete``,
+  ``release``, ``mark_consumed``, heartbeat ``held`` claims — works
+  unchanged across tenants.
+* :class:`TenantRegistry` — the ordered job table with admission
+  control: at most ``max_jobs`` concurrent jobs; past the cap,
+  registration is refused with ``retry_after_s`` so clients
+  queue-with-backoff instead of erroring out.
+* :class:`TenantScheduler` — weighted deficit round-robin (WDRR) over
+  tenants' pending splits.  Per lease grant, every tenant with eligible
+  pending work accrues credit proportional to its weight share; the
+  highest-deficit tenant wins and pays 1.0.  With one tenant the
+  schedule degenerates to "always that tenant" — bit-identical to the
+  single-tenant dispatcher.  The scheduler only picks *which tenant*;
+  PR 10's cache-affinity scan still picks *which split* within it.
+* :class:`QuotaLedger` — per-tenant byte budgets for the shm arena and
+  the cache plane.  Enforcement is at publish/admission with the
+  existing degrade-to-direct-path semantics: an over-quota tenant's
+  chunks take the byte path (shm) or skip the plane (cache) — never a
+  stall, never an error.
+
+Nothing here owns a thread or a socket; the dispatcher calls in under
+its own lock, workers consult the quota ledger on their event loop.
+"""
+
+import json
+import logging
+import warnings
+
+from petastorm_tpu.utils.locks import make_lock
+
+logger = logging.getLogger(__name__)
+
+__all__ = ['DEFAULT_TENANT', 'TenantJob', 'TenantRegistry',
+           'TenantScheduler', 'QuotaLedger', 'config_to_jsonable',
+           'config_from_jsonable']
+
+#: The tenant every pre-ISSUE-16 client, worker, and ledger implicitly
+#: belongs to.  A bare (tenant-less) subscribe/job RPC maps here, which
+#: is what keeps the single-tenant wire protocol bit-compatible.
+DEFAULT_TENANT = 'default'
+
+#: Registration refusals past the admission cap carry this retry hint;
+#: ``register_tenant_job`` (client.py) sleeps a jittered multiple of it.
+ADMISSION_RETRY_S = 1.0
+
+#: Deficit counters are clamped to ±this many grants of credit so a
+#: tenant that sat starved-by-choice (no pending work) for an hour
+#: cannot monopolize the fleet for the next hour (bounded burst — the
+#: classic DRR quantum-clamp).
+_DEFICIT_CLAMP = 8.0
+
+
+def config_to_jsonable(config_kwargs):
+    """A JSON-safe copy of a ServiceConfig kwargs dict for the ledger.
+
+    ``reader_kwargs`` may carry non-JSON values (callables, numpy
+    scalars); those entries are dropped WITH a warning rather than
+    poisoning the whole snapshot — a restored job re-resolves its
+    reader the same way a fresh registration would.
+    """
+    out = {}
+    for key, value in dict(config_kwargs).items():
+        try:
+            json.dumps(value)
+        except (TypeError, ValueError):
+            if key == 'reader_kwargs' and isinstance(value, dict):
+                kept = {}
+                for rk, rv in value.items():
+                    try:
+                        json.dumps(rv)
+                        kept[rk] = rv
+                    except (TypeError, ValueError):
+                        warnings.warn(
+                            'tenant config reader_kwargs[%r] is not '
+                            'JSON-serializable; dropped from the ledger '
+                            'snapshot (restored jobs re-resolve it)' % rk)
+                out[key] = kept
+            else:
+                warnings.warn(
+                    'tenant config field %r is not JSON-serializable; '
+                    'dropped from the ledger snapshot' % key)
+        else:
+            out[key] = value
+    return out
+
+
+def config_from_jsonable(data):
+    """Rebuild the ServiceConfig kwargs dict a ledger snapshot stored."""
+    return dict(data or {})
+
+
+class TenantJob(object):
+    """One registered job: identity, weight, config, split slice.
+
+    ``pending`` is the tenant's OWN deque of
+    :class:`~petastorm_tpu.service.dispatcher.Split` objects — the
+    dispatcher's former single ``_pending`` deque, sharded per tenant so
+    the scheduler can pick a tenant before the affinity scan picks a
+    split.  ``grants`` counts lease grants (the per-tenant rollup and
+    the tenant-starved regime read its windowed delta).
+    """
+
+    __slots__ = ('tenant', 'weight', 'config', 'job_info', 'split_base',
+                 'num_splits', 'num_pieces', 'pending', 'grants',
+                 'rows_delivered', 'registered_t')
+
+    def __init__(self, tenant, weight, config, job_info, split_base,
+                 num_splits, num_pieces=0, registered_t=0.0):
+        self.tenant = tenant
+        self.weight = float(weight)
+        self.config = config
+        self.job_info = job_info
+        self.split_base = int(split_base)
+        self.num_splits = int(num_splits)
+        self.num_pieces = int(num_pieces)
+        self.pending = None       # deque[Split]; the dispatcher owns it
+        self.grants = 0
+        self.rows_delivered = 0
+        self.registered_t = registered_t
+
+    def describe(self):
+        return {'tenant': self.tenant, 'weight': self.weight,
+                'split_base': self.split_base,
+                'num_splits': self.num_splits,
+                'grants': self.grants}
+
+
+class TenantRegistry(object):
+    """Ordered tenant-job table with bounded admission.
+
+    Insertion order is preserved (``dict`` semantics) so the WDRR
+    tie-break — and therefore the whole schedule — is deterministic.
+    """
+
+    def __init__(self, max_jobs=8):
+        self.max_jobs = int(max_jobs)
+        self._jobs = {}
+
+    def __len__(self):
+        return len(self._jobs)
+
+    def __contains__(self, tenant):
+        return tenant in self._jobs
+
+    def get(self, tenant):
+        return self._jobs.get(tenant)
+
+    def jobs(self):
+        """Registered jobs, registration order."""
+        return list(self._jobs.values())
+
+    def tenants(self):
+        return list(self._jobs)
+
+    def admit(self, job):
+        """Admit ``job`` or return a refusal dict (never raises).
+
+        A refusal carries ``retry_after_s`` so the client can
+        queue-with-backoff; the cap counts CONCURRENT jobs, so a
+        completed/retired job frees a slot.
+        """
+        if job.tenant in self._jobs:
+            return {'error': 'tenant %r is already registered '
+                             '(one job per tenant id)' % job.tenant}
+        if len(self._jobs) >= self.max_jobs:
+            return {'error': 'admission refused: %d concurrent tenant '
+                             'job(s) is the cap (max_tenant_jobs=%d)'
+                             % (len(self._jobs), self.max_jobs),
+                    'retry_after_s': ADMISSION_RETRY_S}
+        self._jobs[job.tenant] = job
+        return None
+
+    def evict(self, tenant):
+        return self._jobs.pop(tenant, None)
+
+
+class TenantScheduler(object):
+    """Weighted deficit round-robin over tenants.
+
+    ``pick(eligible)`` is called once per lease grant with the tenants
+    that currently have grantable pending work.  Every eligible tenant
+    accrues ``weight / sum(weights)`` of credit; the highest-deficit
+    one wins and is debited the full grant (1.0).  Over a long run each
+    tenant's grant share converges to its weight share of whatever set
+    was jointly eligible — the fluid fair-share schedule, quantized to
+    whole splits.  Deficits are clamped so an absence does not bank an
+    unbounded burst.
+    """
+
+    def __init__(self):
+        self._deficit = {}
+
+    def pick(self, eligible):
+        """Choose one tenant id from ``eligible`` (ordered sequence).
+
+        Deterministic: ties break toward the earliest-registered
+        eligible tenant.  Returns None on an empty set.
+        """
+        eligible = [t for t in eligible]
+        if not eligible:
+            return None
+        if len(eligible) == 1:
+            # Single-tenant fast path: no deficit bookkeeping at all, so
+            # the pre-tenancy dispatcher schedule is reproduced exactly.
+            return eligible[0].tenant
+        jobs = eligible
+        total = sum(j.weight for j in jobs) or float(len(jobs))
+        best, best_deficit = None, None
+        for job in jobs:
+            share = (job.weight / total) if total else (1.0 / len(jobs))
+            deficit = self._deficit.get(job.tenant, 0.0) + share
+            deficit = max(-_DEFICIT_CLAMP, min(_DEFICIT_CLAMP, deficit))
+            self._deficit[job.tenant] = deficit
+            if best is None or deficit > best_deficit:
+                best, best_deficit = job, deficit
+        self._deficit[best.tenant] = best_deficit - 1.0
+        return best.tenant
+
+    def refund(self, tenant):
+        """Undo one grant's debit: the picked tenant yielded no grant
+        (all its pending splits were affinity-deferred), so the lease
+        went elsewhere and the tenant keeps its credit."""
+        if tenant in self._deficit:
+            self._deficit[tenant] = min(
+                _DEFICIT_CLAMP, self._deficit[tenant] + 1.0)
+
+    def forget(self, tenant):
+        self._deficit.pop(tenant, None)
+
+    def deficits(self):
+        return dict(self._deficit)
+
+
+class QuotaLedger(object):  # ptlint: disable=pickle-unsafe-attrs — lives on one process's dispatcher/worker event loop; snapshot() (a plain dict) is what crosses boundaries
+    """Per-tenant outstanding-byte accounting for one resource plane.
+
+    Thread-safe (the worker event loop charges at publish while the
+    client-facing section refunds at ack).  ``None`` budget = unlimited
+    for that tenant; a charge that would cross the budget is REFUSED
+    (caller degrades to the direct path) — outstanding bytes never
+    exceed the budget, and refusal is the only enforcement, so no path
+    through here can stall.
+    """
+
+    def __init__(self, default_budget=None):
+        self._lock = make_lock('service.tenancy.QuotaLedger._lock')
+        self._default = default_budget
+        self._budgets = {}
+        self._used = {}
+        self.refusals = 0
+
+    def set_budget(self, tenant, budget_bytes):
+        with self._lock:
+            self._budgets[tenant] = budget_bytes
+
+    def budget(self, tenant):
+        with self._lock:
+            return self._budgets.get(tenant, self._default)
+
+    def used(self, tenant):
+        with self._lock:
+            return self._used.get(tenant, 0)
+
+    def charge(self, tenant, nbytes):
+        """True and charge if within budget; False (refused) otherwise."""
+        nbytes = int(nbytes)
+        with self._lock:
+            budget = self._budgets.get(tenant, self._default)
+            used = self._used.get(tenant, 0)
+            if budget is not None and used + nbytes > budget:
+                self.refusals += 1
+                return False
+            self._used[tenant] = used + nbytes
+            return True
+
+    def refund(self, tenant, nbytes):
+        with self._lock:
+            used = self._used.get(tenant, 0) - int(nbytes)
+            self._used[tenant] = max(0, used)
+
+    def snapshot(self):
+        with self._lock:
+            return {'used': dict(self._used),
+                    'budgets': dict(self._budgets),
+                    'refusals': self.refusals}

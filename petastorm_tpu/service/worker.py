@@ -47,6 +47,7 @@ import traceback
 import numpy as np
 
 from petastorm_tpu.errors import ServiceError, ServiceRpcTimeoutError
+from petastorm_tpu.service import tenancy
 from petastorm_tpu.telemetry import MetricsRegistry, provenance
 from petastorm_tpu.test_util import chaos
 from petastorm_tpu.utils import backoff
@@ -86,7 +87,10 @@ class _Rpc(object):  # ptlint: disable=pickle-unsafe-attrs — one per owning th
         self._socket.setsockopt(self._zmq.LINGER, 0)
         self._socket.connect(self._addr)
 
-    def call(self, request, timeout_s=None):
+    def call(self, request, timeout_s=None, raw=False):
+        """``raw=True`` returns error replies instead of raising — for
+        callers that read structured refusals (e.g. an admission
+        refusal's ``retry_after_s``)."""
         from petastorm_tpu.errors import ServiceError
         timeout_s = self._timeout_s if timeout_s is None else timeout_s
         # Chaos seam (ISSUE 15): a dropped control-plane request
@@ -107,7 +111,7 @@ class _Rpc(object):  # ptlint: disable=pickle-unsafe-attrs — one per owning th
                 'no reply from %s to %r within %.1fs'
                 % (self._addr, request.get('op'), timeout_s))
         reply = pickle.loads(self._socket.recv())
-        if isinstance(reply, dict) and reply.get('error'):
+        if not raw and isinstance(reply, dict) and reply.get('error'):
             raise ServiceError('%s rejected %r: %s'
                                % (self._addr, request.get('op'),
                                   reply['error']))
@@ -205,7 +209,6 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         #: True when the drain deadline passed with splits in flight.
         self.drain_timed_out = False
         self._thread = None
-        self._reader_factory = None
         self._t_start = None
         self._decode_out = None
         self.worker_id = None
@@ -271,6 +274,29 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         #: killed); owned by run(), read by the event + decode threads.
         self._cluster = None
         self._cache_plane_dir = cache_plane_dir
+        # -- multi-tenant serving (ISSUE 16) ---------------------------------
+        #: tenant -> job_info, fetched lazily on the first lease naming
+        #: an unknown tenant (the register reply seeds the default).
+        self._tenant_jobs = {}
+        #: tenant -> resolved reader factory (datasets differ per job).
+        self._reader_factories = {}
+        #: Per-tenant byte budgets (job_info's tenant_*_quota_bytes).
+        #: shm: outstanding descriptor bytes, refunded when the split's
+        #: ack retires them; over budget the chunk takes the byte path.
+        #: cache: cumulative fill bytes this worker pushed into the
+        #: plane; over budget the tenant's readers are built WITHOUT the
+        #: plane (direct decode).  Both degrade, neither stalls.
+        self._shm_quota = tenancy.QuotaLedger()
+        self._cache_quota = tenancy.QuotaLedger()
+        #: (split_id, attempt) -> shm bytes charged; refunded on ack /
+        #: replay / decode error so a lost ack cannot leak budget.
+        self._shm_split_bytes = {}
+        #: tenants whose cache-plane budget is exhausted (sticky for the
+        #: worker's lifetime: the plane's files persist on disk).
+        self._cache_over_budget = set()
+        self._m_quota = {key: self.metrics.counter(key)
+                         for key in ('shm_quota_degraded',
+                                     'cache_quota_degraded')}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -361,6 +387,9 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 # downstream consumer (per-split readers, the cluster
                 # identity) sees the same resolved path.
                 job = dict(job, cache_plane_dir=self._cache_plane_dir)
+            # The register reply's job IS the default tenant's; further
+            # tenants' jobs are fetched lazily on their first lease.
+            self._adopt_tenant_job(job)
             # Clock handshake (ISSUE 5): dispatcher monotonic against
             # the local send/recv midpoint — wrong by at most rtt/2,
             # which orders spans fine on any LAN.  Heartbeats repeat it
@@ -463,6 +492,60 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 '--advertise-host to override)', '0.0.0.0', host)
         return '%s://%s:%s' % (scheme, host, port)
 
+    # -- multi-tenant job table (ISSUE 16) -----------------------------------
+
+    def _adopt_tenant_job(self, job):
+        """Enter one tenant's job_info into the worker's table and arm
+        its quota budgets.  Returns the tenant id."""
+        tenant = str(job.get('tenant') or tenancy.DEFAULT_TENANT)
+        self._tenant_jobs[tenant] = job
+        self._shm_quota.set_budget(tenant,
+                                   job.get('tenant_shm_quota_bytes'))
+        self._cache_quota.set_budget(tenant,
+                                     job.get('tenant_cache_quota_bytes'))
+        return tenant
+
+    def _job_for(self, split):
+        """The owning tenant's job_info for a leased split (the decode
+        thread reads dataset_url / reader_kwargs from it).  Known by the
+        time the split is queued — ``_event_loop`` fetches unknown
+        tenants' jobs before queueing; the default job is the fallback
+        for pre-tenancy dispatchers that ship splits without the key."""
+        tenant = str(split.get('tenant') or tenancy.DEFAULT_TENANT)
+        return self._tenant_jobs.get(
+            tenant, self._tenant_jobs[tenancy.DEFAULT_TENANT])
+
+    def _fetch_tenant_job(self, rpc, tenant):
+        """Fetch + adopt an unknown tenant's job_info from the
+        dispatcher; False when the RPC fails (the caller releases the
+        split instead of decoding it against the wrong config)."""
+        if tenant in self._tenant_jobs:
+            return True
+        try:
+            reply = rpc.call({'op': 'job', 'tenant': tenant})
+        except ServiceError as e:
+            logger.warning('job fetch for tenant %r failed: %s', tenant, e)
+            return False
+        job = reply['job']
+        if self._cache_plane_dir is not None:
+            job = dict(job, cache_plane_dir=self._cache_plane_dir)
+        self._adopt_tenant_job(job)
+        logger.info('adopted tenant %r job (%s)', tenant,
+                    job.get('dataset_url'))
+        return True
+
+    @staticmethod
+    def _split_tenant(split):
+        return str(split.get('tenant') or tenancy.DEFAULT_TENANT)
+
+    def _refund_shm_quota(self, split):
+        """Return a split's outstanding shm-descriptor bytes to its
+        tenant's budget (ack arrived / stream abandoned)."""
+        key = (int(split['split_id']), int(split['attempt']))
+        nbytes = self._shm_split_bytes.pop(key, 0)
+        if nbytes:
+            self._shm_quota.refund(self._split_tenant(split), nbytes)
+
     def _event_loop(self, zmq, data, rpc, job, decode_in, decode_out):
         heartbeat_every = max(0.2, job['lease_ttl_s'] / 3.0)
         next_heartbeat = 0.0
@@ -475,9 +558,10 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         draining = False
         drain_deadline = None
         next_lease_probe = 0.0
-        subscribers = {}      # consumer -> identity
+        subscribers = {}      # (tenant, consumer) -> identity
         credits = {}          # identity -> remaining chunk budget
-        sendq = {}            # consumer -> deque of (header, payload|None)
+        sendq = {}            # (tenant, consumer) -> deque of
+        #                       (header, payload|None)
         inflight = {}         # split_id -> split description
         awaiting_ack = {}     # (split_id, attempt) -> split description
         ack_deadline = {}     # (split_id, attempt) -> monotonic deadline
@@ -492,6 +576,10 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             split = awaiting_ack.pop(key, None)
             ack_deadline.pop(key, None)
             if split is not None and split['split_id'] not in decoding:
+                # The abandoned stream's shm descriptors will never be
+                # acked: return their bytes before the re-decode
+                # re-charges the tenant's budget.
+                self._refund_shm_quota(split)
                 decoding.add(split['split_id'])
                 decode_in.put(split)
         poller = zmq.Poller()
@@ -511,7 +599,12 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     kind = msg.get('type')
                     if kind == 'subscribe':
                         consumer = int(msg['consumer'])
-                        previous = subscribers.get(consumer)
+                        # Tenant-qualified subscription (ISSUE 16): a
+                        # subscribe without the field is a pre-tenancy
+                        # client on the default tenant's job.
+                        ckey = (str(msg.get('tenant')
+                                    or tenancy.DEFAULT_TENANT), consumer)
+                        previous = subscribers.get(ckey)
                         if previous is not None and previous != identity:
                             # The consumer reconnected under a new ZMQ
                             # identity: anything streamed to the old one
@@ -519,16 +612,17 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                             # its un-acked splits to the new identity.
                             credits.pop(previous, None)
                             for key in [k for k, s in awaiting_ack.items()
-                                        if s['consumer'] == consumer]:
+                                        if (self._split_tenant(s),
+                                            s['consumer']) == ckey]:
                                 replay(key)
-                        subscribers[consumer] = identity
+                        subscribers[ckey] = identity
                         credits[identity] = int(msg.get('credits', 8))
                         # Same-host handshake: the client names a probe
                         # file it created in ITS /dev/shm; seeing the file
                         # proves shared shm (hostname checks get
                         # containers wrong in both directions).
                         from petastorm_tpu.workers_pool import shm_plane
-                        self._shm_consumers[consumer] = bool(
+                        self._shm_consumers[ckey] = bool(
                             self._arena is not None
                             and shm_plane.probe_exists(
                                 msg.get('shm_probe')))
@@ -554,6 +648,10 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                         ack_deadline.pop(key, None)
                         if split is not None:
                             inflight.pop(split['split_id'], None)
+                            # The ack retires the split's shm
+                            # descriptors: their bytes return to the
+                            # tenant's outstanding-shm budget.
+                            self._refund_shm_quota(split)
                             try:
                                 rpc.call({'op': 'complete',
                                           'worker_id': self.worker_id,
@@ -617,13 +715,13 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 except queue.Empty:
                     break
                 kind, split = item[0], item[1]
-                consumer = split['consumer']
+                ckey = (self._split_tenant(split), split['consumer'])
                 if kind == 'chunk':
                     _, _, seq, tag, payload = item
                     header = {'type': 'chunk', 'split': split['split_id'],
                               'attempt': split['attempt'], 'seq': seq,
                               'tag': tag}
-                    sendq.setdefault(consumer, deque()).append(
+                    sendq.setdefault(ckey, deque()).append(
                         (header, payload))
                 elif kind == 'end':
                     _, _, nchunks, nrows, chunk_spans = item[:5]
@@ -641,18 +739,19 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                         # the end header like the spans; the client
                         # aligns its stage windows onto its own clock.
                         header['provenance'] = item[5]
-                    sendq.setdefault(consumer, deque()).append((header, None))
+                    sendq.setdefault(ckey, deque()).append((header, None))
                     key = (split['split_id'], split['attempt'])
                     awaiting_ack[key] = split
                     ack_deadline[key] = time.monotonic() + ack_timeout
                 else:  # decode error: log, drop — the lease will expire
                     decoding.discard(split['split_id'])
                     inflight.pop(split['split_id'], None)
+                    self._refund_shm_quota(split)
                     logger.error('decode of split %d failed:\n%s',
                                  split['split_id'], item[2])
             # 3. flush send queues under credit control
-            for consumer, q in sendq.items():
-                identity = subscribers.get(consumer)
+            for ckey, q in sendq.items():
+                identity = subscribers.get(ckey)
                 if identity is None:
                     continue
                 while q:
@@ -693,8 +792,9 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             if ack_deadline:
                 for key in [k for k, d in ack_deadline.items() if now > d]:
                     split = awaiting_ack.get(key)
-                    if split is None or \
-                            subscribers.get(split['consumer']) is None:
+                    if split is None or subscribers.get(
+                            (self._split_tenant(split),
+                             split['consumer'])) is None:
                         # no subscriber to replay to: push the deadline out
                         # instead of spinning on decode
                         ack_deadline[key] = now + ack_timeout
@@ -809,6 +909,9 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     and len(inflight) < self._max_inflight \
                     and now >= next_lease_probe:
                 try:
+                    # (tenant, consumer) pairs — the dispatcher's WDRR
+                    # scheduler leases only work these subscribers can
+                    # actually drain.
                     reply = rpc.call({'op': 'lease',
                                       'worker_id': self.worker_id,
                                       'consumers': sorted(subscribers)})
@@ -827,9 +930,25 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     # fill.  Advisory: absent/stale hints just decode.
                     if reply.get('holders'):
                         split['holders'] = reply['holders']
-                    inflight[split['split_id']] = split
-                    decoding.add(split['split_id'])
-                    decode_in.put(split)
+                    # First lease for an unknown tenant: fetch its job
+                    # BEFORE queueing (the decode thread must read the
+                    # right dataset/config).  A failed fetch hands the
+                    # split back rather than decoding it wrong.
+                    if self._fetch_tenant_job(rpc,
+                                              self._split_tenant(split)):
+                        inflight[split['split_id']] = split
+                        decoding.add(split['split_id'])
+                        decode_in.put(split)
+                    else:
+                        try:
+                            rpc.call({'op': 'release',
+                                      'worker_id': self.worker_id,
+                                      'split_id': split['split_id'],
+                                      'attempt': split['attempt']})
+                        except ServiceError:
+                            pass  # the lease expires instead
+                        next_lease_probe = now + min(
+                            1.0, max(0.05, job['lease_ttl_s'] / 10.0))
                 else:
                     # nothing assignable right now (all leased or all done)
                     next_lease_probe = now + min(
@@ -869,18 +988,34 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         time feeds the stage histograms and, correlation-id'd by
         ``split/seq``, the span list riding the split's ``end`` header."""
         t0 = time.monotonic()
+        tenant = self._split_tenant(split)
         if self._arena is not None \
-                and self._shm_consumers.get(split['consumer']):
-            from petastorm_tpu.workers_pool import shm_plane
-            desc = shm_plane.write_columns(self._arena, chunk)
-            if desc is not None:
-                t1 = time.monotonic()
-                self._m_shm_chunks.inc()
-                self._m_shm_pub_hist.observe(t1 - t0)
-                spans.append({'name': 'service/shm_publish', 't0': t0,
-                              't1': t1, 'pid': os.getpid(),
-                              'tid': threading.get_ident(), 'cid': cid})
-                return b'S', pickle.dumps(desc, protocol=4)
+                and self._shm_consumers.get((tenant, split['consumer'])):
+            # Per-tenant shm budget (ISSUE 16), enforced at publish: a
+            # chunk that would push the tenant's OUTSTANDING descriptor
+            # bytes past its quota takes the byte path instead — degrade,
+            # never stall.  Charged bytes return when the split's ack
+            # retires its descriptors.
+            nbytes = sum(int(getattr(v, 'nbytes', 0))
+                         for v in chunk.values())
+            if not self._shm_quota.charge(tenant, nbytes):
+                self._m_quota['shm_quota_degraded'].inc()
+            else:
+                from petastorm_tpu.workers_pool import shm_plane
+                desc = shm_plane.write_columns(self._arena, chunk)
+                if desc is not None:
+                    key = (int(split['split_id']), int(split['attempt']))
+                    self._shm_split_bytes[key] = \
+                        self._shm_split_bytes.get(key, 0) + nbytes
+                    t1 = time.monotonic()
+                    self._m_shm_chunks.inc()
+                    self._m_shm_pub_hist.observe(t1 - t0)
+                    spans.append({'name': 'service/shm_publish', 't0': t0,
+                                  't1': t1, 'pid': os.getpid(),
+                                  'tid': threading.get_ident(),
+                                  'cid': cid})
+                    return b'S', pickle.dumps(desc, protocol=4)
+                self._shm_quota.refund(tenant, nbytes)
         tag, payload = serialize_chunk(chunk)
         t1 = time.monotonic()
         self._m_serialize_hist.observe(t1 - t0)
@@ -926,7 +1061,10 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 worker_args, split.get('indices') or ()),
             cache=cache, transport=transport, sched=sched, stages=stages,
             stage_busy_ms=busy_ms or None,
-            split=int(split['split_id']), attempt=int(split['attempt']))
+            split=int(split['split_id']), attempt=int(split['attempt']),
+            # Cost attribution (ISSUE 16): every service record names
+            # the tenant whose job paid for this split's decode.
+            tenant=self._split_tenant(split))
 
     def _reader_kwargs(self, job):
         """Per-split reader kwargs; with ``job['cache_plane']`` the reader
@@ -946,6 +1084,14 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         # byte-range plane a local reader would ('auto' still stays off
         # on local filesystems and under the kill switch).
         kwargs.setdefault('ingest', job.get('ingest', 'auto'))
+        tenant = str(job.get('tenant') or tenancy.DEFAULT_TENANT)
+        if tenant in self._cache_over_budget \
+                and 'cache_type' not in kwargs:
+            # Per-tenant cache budget exhausted (ISSUE 16): this
+            # tenant's readers run WITHOUT the plane — direct decode,
+            # no new fills, never a stall.
+            self._m_quota['cache_quota_degraded'].inc()
+            return kwargs
         if job.get('cache_plane') and 'cache_type' not in kwargs:
             kwargs['cache_type'] = 'plane'
             kwargs.setdefault('cache_location', job['cache_plane_dir'])
@@ -1118,12 +1264,20 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                 peer_fills_before = (
                     int(self._m_cluster['cache_peer_fills'].value)
                     if prov_on else 0)
+                tenant = self._split_tenant(split)
+                tjob = self._job_for(split)
                 # Cluster cache tier (ISSUE 10): a split the local plane
                 # fully holds (natively or after peer fill) streams
                 # without constructing a reader — no Parquet open, no
-                # decode, no per-split pool spin-up.
-                chunks, self._fetcher = self._cluster_chunks(split,
-                                                             self._fetcher)
+                # decode, no per-split pool spin-up.  The tier's
+                # identity is built over the REGISTRATION job's dataset,
+                # so a co-tenant rides it exactly when its job reads the
+                # same dataset (the fleet-compounding case: its splits
+                # serve warm from entries the first tenant decoded).
+                chunks = None
+                if tjob.get('dataset_url') == job.get('dataset_url'):
+                    chunks, self._fetcher = self._cluster_chunks(
+                        split, self._fetcher)
                 if chunks is not None:
                     outcome = 'remote_hit'
                     if prov_on and int(self._m_cluster[
@@ -1132,14 +1286,17 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                     self._serve_cached_split(split, chunks, decode_out,
                                              ship_spans, t0, outcome)
                     continue
-                if self._reader_factory is None:
-                    self._reader_factory = self._resolve_factory(job)
-                reader = self._reader_factory(
-                    job['dataset_url'], piece_indices=split['indices'],
+                factory = self._reader_factories.get(tenant)
+                if factory is None:
+                    factory = self._resolve_factory(tjob)
+                    self._reader_factories[tenant] = factory
+                reader = factory(
+                    tjob['dataset_url'], piece_indices=split['indices'],
                     num_epochs=1, shuffle_row_groups=False,
-                    **self._reader_kwargs(job))
+                    **self._reader_kwargs(tjob))
                 seq = 0
                 rows = 0
+                out_bytes = 0
                 tags = set()
                 with reader:
                     for item in reader:
@@ -1150,10 +1307,26 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                             split, chunk, cid, spans)
                         tags.add(tag)
                         rows += len(next(iter(chunk.values())))
+                        out_bytes += len(payload)
                         decode_out.put(('chunk', split, seq, tag, payload))
                         seq += 1
                 t1 = time.monotonic()
                 self._m_decode_hist.observe(t1 - t0)
+                # Per-tenant cache-plane budget (ISSUE 16): the split's
+                # serialized bytes approximate what its reader filled
+                # into the plane; the charge that crosses the budget
+                # turns the tenant's FUTURE readers plane-less (the
+                # files already on disk stay — they are the plane's to
+                # evict).
+                if tjob.get('cache_plane') \
+                        and tenant not in self._cache_over_budget \
+                        and self._cache_quota.budget(tenant) is not None \
+                        and not self._cache_quota.charge(tenant,
+                                                         out_bytes):
+                    self._cache_over_budget.add(tenant)
+                    logger.warning(
+                        'tenant %r cache-plane budget exhausted; its '
+                        'readers degrade to direct decode', tenant)
                 spans.append({'name': 'service/decode_split', 't0': t0,
                               't1': t1, 'pid': os.getpid(),
                               'tid': threading.get_ident(),
@@ -1193,7 +1366,9 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
                                 spans[-_MAX_SPANS_PER_SPLIT:], record))
                 self._accumulate_cache_stats(reader)
                 self._accumulate_ingest_stats(reader)
-                if self._cluster is not None and self._cluster.ready():
+                if self._cluster is not None and self._cluster.ready() \
+                        and tjob.get('dataset_url') == job.get(
+                            'dataset_url'):
                     # The per-split reader's plane just published this
                     # split's entries: advertise them on the next beat
                     # without waiting for the listdir refresh.
@@ -1254,6 +1429,14 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
             # fleet-wide is the retry-storm / dead-control-plane signal.
             'retry_attempts': int(self._m_retry['retry_attempts'].value),
             'retry_giveups': int(self._m_retry['retry_giveups'].value),
+            # Per-tenant quota enforcement (ISSUE 16): chunks pushed to
+            # the byte path by an shm budget and readers built without
+            # the cache plane by a cache budget — degrades, not stalls,
+            # so only these counters make them visible fleet-wide.
+            'shm_quota_degraded':
+                int(self._m_quota['shm_quota_degraded'].value),
+            'cache_quota_degraded':
+                int(self._m_quota['cache_quota_degraded'].value),
             'draining': bool(self._drain.is_set()),
         }
 

@@ -81,6 +81,14 @@ _REGIME_ACTIONS = {
         'early and fast pieces backfill the stall window — adding '
         'workers would idle just the same; '
         'PETASTORM_TPU_NO_ADAPTIVE_SCHED=1 is the kill switch'),
+    'tenant-starved': (
+        'the shared fleet is granting leases to other tenants while '
+        'this one starves (ISSUE 16): raise the starved tenant\'s '
+        'weight (register_tenant_job(weight=)), check whether its '
+        'splits are being affinity-deferred onto one saturated worker, '
+        'and whether a per-tenant quota is degrading its every chunk; '
+        'if the whole fleet is saturated, add workers (or enable the '
+        'autoscaler) instead of re-dividing them'),
     'control-plane-degraded': (
         'the control plane itself is the fault domain: if the '
         'dispatcher is restarting, read its logs/ledger lineage for the '
@@ -125,6 +133,18 @@ def evidence_from_stats(stats, source='live fleet'):
         1 for row in workers.values()
         if isinstance(row.get('age_s'), (int, float))
         and row['age_s'] < 60.0)
+    # Fair-share evidence (ISSUE 16): re-derive the dispatcher's
+    # starved-tenant signal from its per-tenant rollup (pending work +
+    # zero grants while the rest of the fleet was granted) so the
+    # health FALLBACK below can classify tenant-starved too.
+    tenants = stats.get('tenants') or {}
+    fleet_moving = any(int(row.get('grants_delta', 0) or 0) > 0
+                       for row in tenants.values())
+    meta['starved_tenants'] = sorted(
+        tid for tid, row in tenants.items()
+        if int(row.get('pending', 0) or 0) > 0
+        and int(row.get('grants_delta', 0) or 0) == 0 and fleet_moving)
+    meta['tenant_count'] = len(tenants)
     counters = {}
     counters.update(stats.get('cache') or {})
     counters.update(stats.get('shm') or {})
@@ -151,6 +171,10 @@ def evidence_from_stats(stats, source='live fleet'):
         # lineage, drain traffic, fleet retry counters — the restart /
         # drain-timeout rules read it.
         'control_plane': stats.get('control_plane') or {},
+        # Multi-tenant serving tier (ISSUE 16): per-tenant grant/queue
+        # rollup + the autoscaler's action counters.
+        'tenants': tenants,
+        'autoscale': stats.get('autoscale') or {},
     }
 
 
@@ -319,6 +343,19 @@ def _regime_verdicts(evidence):
                     'worst worker %s: cache_degraded %d with %d hits '
                     '(a plane silently OFF keeps degrading while hits '
                     'look plausible)' % worker)
+        elif regime == 'tenant-starved':
+            rows = evidence.get('tenants') or {}
+            granted = sorted(
+                (tid for tid, row in rows.items()
+                 if int(row.get('grants_delta', 0) or 0) > 0),
+                key=lambda t: -int(rows[t].get('grants_delta', 0) or 0))
+            if granted:
+                top = granted[0]
+                evidence_bits.append(
+                    'meanwhile tenant %r took %d grant(s) this window '
+                    '(weight %.1f)'
+                    % (top, int(rows[top].get('grants_delta', 0) or 0),
+                       float(rows[top].get('weight', 1.0) or 1.0)))
         elif regime == 'shm-degraded':
             worker = _worst_worker(evidence, 'shm_degraded')
             if worker:

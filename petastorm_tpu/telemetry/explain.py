@@ -121,6 +121,7 @@ def explain_record(record):
     return {
         'step': record.get('step'),
         'journal': record.get('journal'),
+        'tenant': record.get('tenant'),
         'latency_ms': record.get('latency_ms'),
         'coverage_pct': round(100.0 * provenance.stage_coverage(record), 1),
         'source': record.get('source'),
@@ -139,10 +140,14 @@ def explain_record(record):
 def format_chain(record):
     """Human-readable causal chain of one record."""
     info = explain_record(record)
-    lines = ['step %s — %s ms wall — worker pid %s%s%s'
+    lines = ['step %s — %s ms wall — worker pid %s%s%s%s'
              % (info['step'], info['latency_ms'], info['worker_pid'],
                 (' @ %s' % info['worker_host']
                  if info['worker_host'] else ''),
+                # Cost attribution (ISSUE 16): a shared fleet's tail
+                # batch names the tenant that paid for it.
+                (' [tenant %s]' % info['tenant']
+                 if info['tenant'] else ''),
                 (' [journal %s]' % info['journal']
                  if info['journal'] else ''))]
     pieces = info['pieces'] or []

@@ -53,6 +53,12 @@ Regime catalogue (``classify_regime``):
   block the epoch while the rest of the pool idles.  Knob:
   ``scheduling='adaptive'`` (the ISSUE 9 out-of-order scheduler) —
   more workers would idle just the same.
+* ``tenant-starved``  — a tenant with pending work took ZERO lease
+  grants over a window in which the shared fleet granted to others
+  (ISSUE 16): the fair-share schedule is being defeated (weight 0-ish
+  share, affinity monopolization, or an over-quota tenant whose splits
+  never finish).  Knobs: the tenant's weight, ``max_tenant_jobs``,
+  per-tenant quotas, more workers.
 * ``control-plane-degraded`` — the control plane itself is the fault
   domain (ISSUE 15): the dispatcher restarted inside the window
   (``ledger_restores`` climbing), worker drains overran their deadline
@@ -75,7 +81,8 @@ __all__ = ['classify_regime', 'health_report', 'report_from_frames',
 
 REGIMES = ('decode-bound', 'link-bound', 'lease-starved', 'cache-degraded',
            'cluster-cache-degraded', 'shm-degraded', 'skew-bound',
-           'fetch-bound', 'control-plane-degraded', 'healthy', 'idle')
+           'fetch-bound', 'tenant-starved', 'control-plane-degraded',
+           'healthy', 'idle')
 
 #: Histogram name -> pipeline component.  Names from every registry the
 #: fleet merges: service workers (decode_split/serialize/shm_publish),
@@ -252,6 +259,19 @@ def classify_regime(delta, stall_pct=None, meta=None):
             candidates.append((
                 0.95, 'lease-starved',
                 '%d split(s) pending with 0 live workers' % pending))
+        # 4a. per-tenant starvation on a shared fleet (ISSUE 16): the
+        # dispatcher names tenants whose pending work took zero grants
+        # in a window where OTHER tenants were granted — the fleet
+        # moved, just never for them, so this is a fairness fault, not
+        # the all-stop lease-starved regime above.
+        starved = list(meta.get('starved_tenants') or ())
+        if starved:
+            candidates.append((
+                min(1.0, 0.75 + 0.05 * len(starved)),
+                'tenant-starved',
+                'tenant(s) %s have pending splits but took 0 lease '
+                'grants this window while the rest of the fleet was '
+                'granted' % ', '.join(repr(t) for t in starved[:4])))
 
     # 4b. control-plane degradation (ISSUE 15).  All three triggers
     # read the WINDOWED counter delta, like every other regime — a

@@ -237,7 +237,11 @@ def merge_records(records):
             merged['stages'][name] = (list(window) if mine is None else
                                       [min(mine[0], window[0]),
                                        max(mine[1], window[1])])
-        for key in ('cache', 'transport', 'transfer', 'worker_host'):
+        # 'tenant' rides the same unanimous-or-'mixed' rule (ISSUE 16):
+        # a service batch fed by one tenant's splits is attributed to
+        # it; cross-tenant feeds (never produced today) would be loud.
+        for key in ('cache', 'transport', 'transfer', 'worker_host',
+                    'tenant'):
             value = record.get(key)
             if value is None:
                 continue

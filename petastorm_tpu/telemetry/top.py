@@ -86,6 +86,36 @@ def render_stats(stats, elapsed_s=None):
                control.get('drain_timeouts', 0),
                control.get('retry_attempts', 0),
                control.get('retry_giveups', 0)))
+    tenants = stats.get('tenants') or {}
+    if len(tenants) > 1:
+        # Multi-tenant serving tier (ISSUE 16): one row per job sharing
+        # this fleet — pending depth, windowed grant share, weight.  A
+        # single-tenant fleet keeps the classic (table-free) frame.
+        grant_total = sum(int(row.get('grants_delta', 0) or 0)
+                          for row in tenants.values())
+        lines.append('tenants (%d):' % len(tenants))
+        lines.append('  %-12s %6s %8s %8s %7s %7s %9s'
+                     % ('tenant', 'weight', 'pending', 'done', 'grants',
+                        'g/win', 'share'))
+        for tid in sorted(tenants):
+            row = tenants[tid]
+            delta = int(row.get('grants_delta', 0) or 0)
+            share = ('%5.1f%%' % (100.0 * delta / grant_total)
+                     if grant_total else '    -')
+            lines.append('  %-12s %6.1f %8s %8s %7s %7d %9s'
+                         % (tid[:12], float(row.get('weight', 1.0) or 1.0),
+                            row.get('pending', '-'), row.get('done', '-'),
+                            row.get('grants', '-'), delta, share))
+    autoscale = stats.get('autoscale') or {}
+    if autoscale.get('enabled') or autoscale.get('killed') \
+            or autoscale.get('actions'):
+        lines.append(
+            'autoscale %-8s outs %-3d ins %-3d suppressed %-3d last %s'
+            % ('killed' if autoscale.get('killed')
+               else ('on' if autoscale.get('enabled') else 'off'),
+               autoscale.get('scale_outs', 0), autoscale.get('scale_ins', 0),
+               autoscale.get('suppressed', 0),
+               autoscale.get('last_action') or '-'))
     stages = stats.get('stages') or {}
     if stages:
         # The dispatcher built these with telemetry.summarize_hist — the

@@ -483,10 +483,53 @@ SCENARIOS = {
         'config': {'cache_plane_ram_bytes': 1,
                    'cache_plane_disk_bytes': 1},
     },
+    # -- ISSUE 16: scale-storm + multi-tenant scenarios ---------------------
+    'autoscale_storm': {
+        'summary': 'one-worker fleet under the closed-loop autoscaler: '
+                   'lease starvation scales out mid-epoch, hysteresis '
+                   'keeps the action count inside the damping bound, '
+                   'and delivery stays exactly-once',
+        'n_workers': 1,
+        'config': {'autoscale': True, 'autoscale_min_workers': 1,
+                   'autoscale_max_workers': 3, 'autoscale_step': 1,
+                   'autoscale_cooldown_s': 1.0, 'autoscale_starve_s': 0.3,
+                   'autoscale_idle_s': 3600.0},
+        'max_autoscale_actions': 6,
+    },
+    'autoscale_worker_kill': {
+        'summary': 'autoscaled fleet loses a worker to SIGKILL '
+                   'mid-epoch: the lease expires, the controller '
+                   'backfills capacity, exactly-once holds through the '
+                   'churn and the damping bound still holds',
+        'n_workers': 2,
+        'config': {'autoscale': True, 'autoscale_min_workers': 1,
+                   'autoscale_max_workers': 3, 'autoscale_step': 1,
+                   'autoscale_cooldown_s': 1.0, 'autoscale_starve_s': 0.3,
+                   'autoscale_idle_s': 3600.0},
+        'kills': [{'role': 'worker', 'phase': 'mid_epoch',
+                   'signal': 'kill', 'restart': False}],
+        'max_autoscale_actions': 6,
+    },
+    'tenant_fair_share': {
+        'summary': 'two tenants (weights 1:3) share one fleet over the '
+                   'same dataset under WDRR lease scheduling; BOTH '
+                   'delivery digests equal the ground truth',
+        'tenants': [{'tenant': 'burst', 'weight': 3.0}],
+    },
+    'tenant_worker_kill': {
+        'summary': 'two tenants share the fleet and one worker dies to '
+                   'SIGKILL mid-epoch: both tenants stay exactly-once '
+                   'through the lease churn',
+        'tenants': [{'tenant': 'burst', 'weight': 3.0}],
+        'kills': [{'role': 'worker', 'phase': 'mid_epoch',
+                   'signal': 'kill', 'restart': False}],
+    },
 }
 
-#: The fast CI smoke: one kill, one drain, one message-fault class.
-SMOKE_SCENARIOS = ('worker_kill', 'worker_drain', 'message_drop')
+#: The fast CI smoke: one kill, one drain, one message-fault class, and
+#: one ISSUE-16 scale-storm.
+SMOKE_SCENARIOS = ('worker_kill', 'worker_drain', 'message_drop',
+                   'autoscale_storm')
 
 
 # -- runner -------------------------------------------------------------------
@@ -622,6 +665,7 @@ def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
     from petastorm_tpu.workers_pool import shm_plane
 
     scenario = SCENARIOS[name]
+    n_workers = int(scenario.get('n_workers', n_workers))
     spec = {'seed': int(seed), 'faults': scenario.get('faults') or []}
     ledger_path = os.path.join(workdir, 'ledger_%s.json' % name)
     overrides = dict(scenario.get('config') or {})
@@ -686,20 +730,44 @@ def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
                 return report
             time.sleep(0.1)
 
-        digest = DeliveryDigest()
-        ids = []
-        consume_error = []
+        # Co-tenant jobs (ISSUE 16): register every scenario tenant on
+        # the SAME dataset over the same fleet before consumption
+        # starts, so the whole epoch runs under fair-share scheduling.
+        for entry in scenario.get('tenants') or ():
+            from petastorm_tpu.service.client import register_tenant_job
+            try:
+                register_tenant_job(
+                    dispatcher_addr, entry['tenant'], dict(
+                        dataset_url=dataset_url, num_consumers=1,
+                        rowgroups_per_split=2, lease_ttl_s=2.0,
+                        reader_kwargs={'workers_count': 1}),
+                    weight=entry.get('weight', 1.0))
+            except Exception as e:  # noqa: BLE001 — reported, matrix continues
+                report['checks']['register_%s' % entry['tenant']] = \
+                    'failed: %r' % e
+                return report
 
-        def consume():
+        # One consuming stream per tenant (the default job first), each
+        # with its own digest + id list: the invariants must hold PER
+        # TENANT — an aggregate digest could hide one tenant's loss
+        # behind another's duplicate.
+        streams = [{'tenant': None, 'digest': DeliveryDigest(),
+                    'ids': [], 'errors': []}]
+        streams += [{'tenant': entry['tenant'], 'digest': DeliveryDigest(),
+                     'ids': [], 'errors': []}
+                    for entry in scenario.get('tenants') or ()]
+
+        def consume(stream):
             try:
                 loader = ServiceDataLoader(
                     dispatcher_addr, batch_size=8, consumer=0,
-                    drop_last=False, queue_splits=1, credits=2)
+                    drop_last=False, queue_splits=1, credits=2,
+                    tenant=stream['tenant'])
                 with loader:
                     for batch in loader.iter_host_batches():
                         chunk = {k: np.asarray(v) for k, v in batch.items()}
-                        digest.update(chunk)
-                        ids.extend(chunk['id'].tolist())
+                        stream['digest'].update(chunk)
+                        stream['ids'].extend(chunk['id'].tolist())
                         # Throttled consumption keeps splits in flight
                         # long enough for phase-targeted kills to land
                         # mid-epoch by construction — sized so the
@@ -707,20 +775,22 @@ def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
                         # each stats poll can take seconds.
                         time.sleep(0.1)
             except Exception as e:  # noqa: BLE001 — reported, matrix continues
-                consume_error.append(e)
+                stream['errors'].append(e)
 
-        consumer = threading.Thread(target=consume, daemon=True)
-        consumer.start()
+        consumers = [threading.Thread(target=consume, args=(stream,),
+                                      daemon=True) for stream in streams]
+        for thread in consumers:
+            thread.start()
 
         # -- kill controller (in this thread: phases are ordered) ------------
         for kill in scenario.get('kills') or ():
             while not _phase_reached(stats.poll(), kill['phase'],
                                      n_workers):
                 if time.monotonic() > deadline \
-                        or not consumer.is_alive():
+                        or not any(t.is_alive() for t in consumers):
                     break
                 time.sleep(0.05)
-            if not consumer.is_alive():
+            if not any(t.is_alive() for t in consumers):
                 report['checks'].setdefault(
                     'kill_%s' % kill['role'],
                     'epoch finished before phase %r' % kill['phase'])
@@ -757,32 +827,56 @@ def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
                                         [dispatcher_addr, _repo_root()],
                                         spec_env=spec_env)
 
-        consumer.join(max(1.0, deadline - time.monotonic()))
-        if consumer.is_alive():
+        for thread in consumers:
+            thread.join(max(1.0, deadline - time.monotonic()))
+        if any(t.is_alive() for t in consumers):
             report['checks']['liveness'] = (
-                'epoch wedged (> %.0fs); %d rows delivered'
-                % (timeout_s, digest.rows))
+                'epoch wedged (> %.0fs); %s rows delivered'
+                % (timeout_s, [s['digest'].rows for s in streams]))
             return report
-        if consume_error:
-            report['checks']['consumer'] = 'raised: %r' % consume_error[0]
+        errors = [e for s in streams for e in s['errors']]
+        if errors:
+            report['checks']['consumer'] = 'raised: %r' % errors[0]
             return report
 
-        # -- the three invariants --------------------------------------------
+        # -- the three invariants, PER TENANT STREAM -------------------------
         want_ids = list(range(rows))
-        exactly_once = sorted(ids) == want_ids
-        report['checks']['exactly_once'] = (
-            'ok' if exactly_once else
-            'lost=%s dup=%s' % (
-                sorted(set(want_ids) - set(ids))[:8],
-                sorted(i for i in set(ids) if ids.count(i) > 1)[:8]))
         if expected_digest is None:
             expected_digest = direct_read_digest(dataset_url)
-        digest_ok = digest.hexdigest() == expected_digest
-        report['checks']['digest'] = (
-            'ok' if digest_ok else '%s != expected %s'
-            % (digest.hexdigest(), expected_digest))
-        report['digest'] = digest.hexdigest()
-        report['ok'] = bool(exactly_once and digest_ok)
+        all_ok = True
+        for stream in streams:
+            suffix = '' if stream['tenant'] is None \
+                else '_%s' % stream['tenant']
+            ids = stream['ids']
+            exactly_once = sorted(ids) == want_ids
+            report['checks']['exactly_once%s' % suffix] = (
+                'ok' if exactly_once else
+                'lost=%s dup=%s' % (
+                    sorted(set(want_ids) - set(ids))[:8],
+                    sorted(i for i in set(ids) if ids.count(i) > 1)[:8]))
+            digest_ok = stream['digest'].hexdigest() == expected_digest
+            report['checks']['digest%s' % suffix] = (
+                'ok' if digest_ok else '%s != expected %s'
+                % (stream['digest'].hexdigest(), expected_digest))
+            all_ok = all_ok and exactly_once and digest_ok
+        report['digest'] = streams[0]['digest'].hexdigest()
+
+        # -- autoscaler damping bound (ISSUE 16) -----------------------------
+        bound = scenario.get('max_autoscale_actions')
+        if bound is not None:
+            final = stats.poll() or {}
+            auto = final.get('autoscale') or {}
+            actions = int(auto.get('actions', 0) or 0)
+            damped = actions <= int(bound)
+            report['checks']['autoscale_damped'] = (
+                'ok (%d action(s): outs %d ins %d, suppressed %d)'
+                % (actions, int(auto.get('scale_outs', 0) or 0),
+                   int(auto.get('scale_ins', 0) or 0),
+                   int(auto.get('suppressed', 0) or 0)) if damped
+                else 'flapping: %d action(s) > damping bound %d'
+                % (actions, int(bound)))
+            all_ok = all_ok and damped
+        report['ok'] = bool(all_ok)
         return report
     finally:
         deactivate()

@@ -37,6 +37,12 @@ Sections (each contained — a dead plane is reported, not fatal):
   ``time.monotonic()`` clock-offset handshake (span alignment sanity),
   and a span-buffer residue report (spans recorded but not drained by
   an ack/heartbeat channel).
+* **autoscaler** — the closed-loop fleet autoscaler (ISSUE 16):
+  kill-switch state, and a fake-launcher control-law round-trip with an
+  injected clock — sustained starvation must scale out, the cooldown
+  must suppress the immediate follow-up, sustained idleness must name a
+  least-coverage drain victim — plus a damping-config sanity check
+  (min <= max, positive step/cooldown).
 * **ingest** — the async byte-range ingest plane (ISSUE 14):
   kill-switch state, a coalescing-plan sanity check against a real
   synthetic Parquet footer (ranges sorted, in-bounds, column subsets
@@ -471,6 +477,74 @@ def _check_ingest():
     return out
 
 
+def _check_autoscaler():
+    """Environment + control-law sanity of the fleet autoscaler
+    (``service/autoscaler.py``, ISSUE 16): kill-switch state, then a
+    deterministic fake-launcher round-trip with an injected clock —
+    sustained lease starvation must produce exactly one scale-out, the
+    cooldown must suppress the immediate retry, and sustained idleness
+    must name the least-cache-covered worker as the drain victim."""
+    from petastorm_tpu.service.autoscaler import Autoscaler, WorkerLauncher
+    from petastorm_tpu.service.config import ServiceConfig
+
+    out = {'kill_switch': False}
+    from petastorm_tpu.service import autoscaler as _mod
+    out['kill_switch'] = _mod.killed()
+    if out['kill_switch']:
+        out['note'] = ('PETASTORM_TPU_NO_AUTOSCALE=1: controllers '
+                       'construct but never act on this host')
+
+    class _FakeLauncher(WorkerLauncher):
+        def __init__(self):
+            self.spawned, self.drains = [], []
+
+        def spawn(self, addr):
+            self.spawned.append(addr)
+            return len(self.spawned)
+
+        def notify_drain(self, worker_id):
+            self.drains.append(worker_id)
+
+    config = ServiceConfig(dataset_url='file:///dev/null',
+                           autoscale=True, autoscale_min_workers=1,
+                           autoscale_max_workers=4, autoscale_step=1,
+                           autoscale_cooldown_s=5.0,
+                           autoscale_starve_s=2.0, autoscale_idle_s=10.0)
+    out['damping_config_ok'] = bool(
+        config.autoscale_min_workers <= config.autoscale_max_workers
+        and config.autoscale_step >= 1
+        and config.autoscale_cooldown_s > 0)
+    launcher = _FakeLauncher()
+    scaler = Autoscaler(config, launcher, now=0.0)
+    # An env kill switch makes the round-trip vacuous — report and skip.
+    if not scaler.enabled:
+        out['control_law_ok'] = None
+        return out
+    starving = {'pending': 5, 'leased': 2, 'alive': ['w0'],
+                'free_slots': 0, 'coverage': {'w0': 3},
+                'dispatcher_addr': 'tcp://127.0.0.1:1'}
+    first = scaler.maybe_tick(starving, now=0.0)          # starve starts
+    sustained = scaler.maybe_tick(starving, now=2.5)      # past starve_s
+    scaler.maybe_tick(starving, now=4.0)                  # starve restarts
+    cooled = scaler.maybe_tick(starving, now=6.5)         # sustained again,
+    #                                                       inside cooldown
+    idle = {'pending': 0, 'leased': 0, 'alive': ['w0', 'w1'],
+            'free_slots': 6, 'coverage': {'w0': 3, 'w1': 0},
+            'dispatcher_addr': 'tcp://127.0.0.1:1'}
+    scaler.maybe_tick(idle, now=20.0)                     # idle starts
+    drained = scaler.maybe_tick(idle, now=31.0)           # past idle_s
+    out['scale_out_fired'] = sustained == ('scale_out', 1)
+    out['cooldown_suppressed'] = bool(first is None and cooled is None
+                                      and scaler.suppressed >= 1)
+    out['drain_victim_least_coverage'] = drained == ('scale_in', 'w1')
+    out['control_law_ok'] = bool(out['scale_out_fired']
+                                 and out['cooldown_suppressed']
+                                 and out['drain_victim_least_coverage']
+                                 and launcher.spawned
+                                 and launcher.drains == ['w1'])
+    return out
+
+
 def _check_telemetry():
     """Environment of the telemetry plane (``petastorm_tpu/telemetry``):
     does a registry round-trip and render, is the cross-process clock
@@ -556,6 +630,7 @@ def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
     _contained(report, 'cluster_cache',
                lambda: _check_cluster_cache(cache_plane_dir,
                                             dispatcher_addr))
+    _contained(report, 'autoscaler', _check_autoscaler)
     _contained(report, 'telemetry', _check_telemetry)
     _contained(report, 'ingest', _check_ingest)
     if dataset_url:

@@ -219,10 +219,15 @@ def test_scenario_catalogue_meets_the_acceptance_bar():
     assert len(chaos.SCENARIOS) >= 6
     for required in ('dispatcher_kill', 'worker_kill', 'worker_drain',
                      'message_drop', 'fetch_latency_spike',
-                     'shm_enospc', 'plane_enospc'):
+                     'shm_enospc', 'plane_enospc',
+                     # multi-tenant + autoscaler scenarios (ISSUE 16)
+                     'autoscale_storm', 'autoscale_worker_kill',
+                     'tenant_fair_share', 'tenant_worker_kill'):
         assert required in chaos.SCENARIOS, required
     assert set(chaos.SMOKE_SCENARIOS) <= set(chaos.SCENARIOS)
-    assert len(chaos.SMOKE_SCENARIOS) == 3
+    # The CI smoke gained the scale-storm scenario (ISSUE 16).
+    assert len(chaos.SMOKE_SCENARIOS) == 4
+    assert 'autoscale_storm' in chaos.SMOKE_SCENARIOS
     for name, scenario in chaos.SCENARIOS.items():
         assert scenario.get('summary'), name
         for fault in scenario.get('faults') or ():

@@ -273,11 +273,55 @@ def test_bench_compact_line_pins_control_plane_recovery_fields():
     assert 'control_plane_recovery_speedup' in trend.TRACKED_FIELDS
 
 
+def test_bench_compact_line_pins_multi_tenant_fields():
+    """The multi-tenant serving tier's evidence (ISSUE 16): warm-solo
+    vs duo fleet rates, the decode-bound fair-share ratio (WDRR weight
+    target 3.0), the co-tenant compounding ratio + remote-hit count,
+    and the in-leg exactly-once flag must ride the compact machine
+    line; the leg must sit in the shared host-leg table; and the
+    fair-share ratio must be trend-gated."""
+    src = open(os.path.join(REPO, 'bench.py')).read()
+    block = re.search(r'_COMPACT_KEYS = \((.*?)\n\)', src, re.S)
+    assert block, 'bench.py lost its _COMPACT_KEYS tuple'
+    for field in ('multi_tenant_images_per_sec_warm_solo',
+                  'multi_tenant_images_per_sec_duo',
+                  'multi_tenant_fair_share_ratio',
+                  'multi_tenant_duo_over_warm_solo',
+                  'multi_tenant_remote_hits',
+                  'multi_tenant_exactly_once'):
+        assert "'%s'" % field in block.group(1), field
+    assert re.search(r"_IPC_PLANE_LEGS = \((?:.|\n)*?multi_tenant_leg",
+                     src), 'multi_tenant_leg missing from the leg table'
+    from petastorm_tpu.benchmark import trend
+    assert 'multi_tenant_fair_share_ratio' in trend.TRACKED_FIELDS
+
+
+def test_docs_carry_tenancy_and_autoscaler_rows():
+    """ISSUE 16 docs: data_service.md must document fleet sharing
+    (registration, WDRR fair share, admission, quotas, the v2 ledger
+    table) and the autoscaler (control law, damping, kill switch);
+    observability.md must carry the tenant-starved regime, the tenants
+    / autoscale stats rollups, and the doctor's autoscaler probe."""
+    ds = open(os.path.join(REPO, 'docs', 'data_service.md')).read()
+    for needle in ('Sharing a fleet', 'register_tenant_job',
+                   'max_tenant_jobs', 'retry_after_s',
+                   'tenant_shm_quota_bytes', 'tenant_cache_quota_bytes',
+                   'multi_tenant_fair_share_ratio',
+                   'PETASTORM_TPU_NO_AUTOSCALE', '--autoscale',
+                   'autoscale_storm'):
+        assert needle in ds, needle
+    obs = open(os.path.join(REPO, 'docs', 'observability.md')).read()
+    for needle in ('tenant-starved', 'starved_tenants', 'grants_delta',
+                   'scale_outs', 'suppressed',
+                   'PETASTORM_TPU_NO_AUTOSCALE'):
+        assert needle in obs, needle
+
+
 def test_chaos_cli_registered_and_ci_runs_the_smoke():
-    """ISSUE 15: the chaos harness entry point must stay registered and
-    the CI tests job must run the fast 3-scenario smoke (the invariant
-    gate on every PR); the catalogue itself must keep the >= 6-scenario
-    acceptance floor."""
+    """ISSUE 15/16: the chaos harness entry point must stay registered
+    and the CI tests job must run the fast 4-scenario smoke (the
+    invariant gate on every PR, scale-storm included); the catalogue
+    itself must keep the >= 6-scenario acceptance floor."""
     src = open(os.path.join(REPO, 'pyproject.toml')).read()
     block = re.search(r'\[project\.scripts\](.*?)(\n\[|$)', src, re.S)
     assert 'petastorm-tpu-chaos' in block.group(1)
@@ -287,7 +331,8 @@ def test_chaos_cli_registered_and_ci_runs_the_smoke():
         in run_text
     from petastorm_tpu.test_util import chaos
     assert len(chaos.SCENARIOS) >= 6
-    assert len(chaos.SMOKE_SCENARIOS) == 3
+    assert len(chaos.SMOKE_SCENARIOS) == 4
+    assert 'autoscale_storm' in chaos.SMOKE_SCENARIOS
 
 
 def test_docs_carry_control_plane_rows():

@@ -285,8 +285,11 @@ def test_drain_release_deregister_semantics(dataset_url, tmp_path):
     # Hand-back requeues at the FRONT, attempt intact.
     assert d._op_release({'worker_id': w0, 'split_id': split['split_id'],
                           'attempt': split['attempt']})['ok']
-    assert d._pending[0].split_id == split['split_id']
-    assert d._pending[0].attempt == split['attempt']
+    # (the pending deque is per-tenant since ISSUE 16; this job is the
+    # implicit default tenant's)
+    pending = d._tenants.get('default').pending
+    assert pending[0].split_id == split['split_id']
+    assert pending[0].attempt == split['attempt']
     # Releasing a lease that moved on has no standing.
     assert not d._op_release({'worker_id': w0,
                               'split_id': split['split_id'],

@@ -526,7 +526,8 @@ def test_dispatcher_stats_rolls_up_shm_counters_fleet_wide(raw_dataset):
                       'shm rollup to reflect the heartbeat counters')
             snapshot = stats()
     assert sorted(ids) == list(range(raw_dataset.rows))
-    assert set(snapshot['shm']) == {'shm_chunks', 'shm_degraded'}
+    assert set(snapshot['shm']) == {'shm_chunks', 'shm_degraded',
+                                    'shm_quota_degraded'}
     assert snapshot['shm']['shm_chunks'] == \
         sum(int(w.get('shm_chunks', 0))
             for w in snapshot['workers'].values())

@@ -254,12 +254,17 @@ WORKER_DIAG_KEYS = {
     'cache_remote_hits', 'cache_peer_fills', 'cache_peer_degraded',
     # crash-survivable control plane (ISSUE 15): unified-backoff retry
     # telemetry + the drain state flag
-    'retry_attempts', 'retry_giveups', 'draining'}
+    'retry_attempts', 'retry_giveups', 'draining',
+    # multi-tenant quotas (ISSUE 16): chunks/fills an over-budget
+    # tenant degraded to the direct path
+    'shm_quota_degraded', 'cache_quota_degraded'}
 
 DISPATCHER_STATS_KEYS = {
     'num_splits', 'pending', 'leased', 'done', 'failed', 'lease_churn',
     'cache', 'shm', 'cluster_cache', 'control_plane', 'stages', 'health',
-    'workers'}
+    'workers',
+    # multi-tenant serving tier + closed-loop autoscaler (ISSUE 16)
+    'tenants', 'autoscale'}
 
 
 def test_golden_keys_thread_reader_and_loader(dataset):
@@ -344,7 +349,8 @@ def test_golden_keys_dispatcher_stats_and_fleet_rollup(tmp_path):
     # per-worker reply rows (it would grow the poll linearly with fleet
     # size for data nothing reads)
     assert all('registry' not in row for row in stats['workers'].values())
-    assert stats['shm'] == {'shm_chunks': 3, 'shm_degraded': 2}
+    assert stats['shm'] == {'shm_chunks': 3, 'shm_degraded': 2,
+                            'shm_quota_degraded': 0}
     assert stats['cache']['cache_hits'] == 1
     # stages carry the CANONICAL summarize_hist shape (ISSUE 7
     # satellite): count/p50/p99/max — the same numbers top and diagnose
@@ -429,12 +435,21 @@ def test_top_json_golden_schema(capsys):
     assert set(stats) == DISPATCHER_STATS_KEYS
     assert set(stats['cache']) == {
         'cache_hits', 'cache_misses', 'cache_evictions', 'cache_ram_hits',
-        'cache_degraded'}
-    assert set(stats['shm']) == {'shm_chunks', 'shm_degraded'}
+        'cache_degraded', 'cache_quota_degraded'}
+    assert set(stats['shm']) == {'shm_chunks', 'shm_degraded',
+                                 'shm_quota_degraded'}
     assert set(stats['cluster_cache']) == {
         'cache_remote_hits', 'cache_peer_fills', 'cache_peer_degraded',
         'cache_affinity_routed', 'affinity_deferrals', 'directory_workers',
         'directory_digests', 'piece_map'}
+    # the ISSUE 16 rollups: one row per tenant (here only the default
+    # job) and the autoscaler counter snapshot
+    assert set(stats['tenants']['default']) == {
+        'weight', 'split_base', 'num_splits', 'pending', 'leased', 'done',
+        'failed', 'grants', 'grants_delta', 'deficit'}
+    assert set(stats['autoscale']) == {
+        'enabled', 'killed', 'scale_outs', 'scale_ins', 'actions',
+        'suppressed', 'last_action'}
     # stage summaries keep the canonical summarize_hist shape ('exemplar'
     # may additionally appear when the source histogram recorded tail
     # exemplars — an additive key, never a replacement)
