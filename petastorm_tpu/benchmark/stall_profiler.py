@@ -16,7 +16,62 @@ blocks, which is exactly what this measures.  Optional
 profiles (enabled when ``annotate=True``).
 """
 
+import math
+import os
 import time
+
+#: Env override for :func:`fused_dispatch_window` — pins the hbm_scan
+#: fused-window step count regardless of the auto-size math below.
+DISPATCH_WINDOW_ENV = 'PETASTORM_TPU_BENCH_DISPATCH_WINDOW_STEPS'
+
+#: One fused dispatch of W steps pays roughly one transport round trip of
+#: dispatch latency no matter how large W is; this is the planning figure
+#: for a tunneled/remote device (measured ~100 ms on the tunneled v5e
+#: runs behind BENCH_NOTES' 72->144 window change).
+DEFAULT_DISPATCH_LATENCY_MS = 100.0
+
+#: The phantom stall budget: the per-window dispatch latency amortized
+#: over the window must cost no more than this share of step time, so the
+#: measured stall_pct reflects the data plane rather than the dispatch
+#: transport.
+PHANTOM_STALL_BUDGET_PCT = 3.0
+
+
+def fused_dispatch_window(train_steps, step_floor_ms=None,
+                          dispatch_latency_ms=DEFAULT_DISPATCH_LATENCY_MS,
+                          phantom_stall_budget_pct=PHANTOM_STALL_BUDGET_PCT):
+    """Steps to fold into one fused hbm_scan dispatch window.
+
+    BENCH_NOTES' 72->144-step window change roughly halved a *phantom*
+    per-dispatch-latency stall that the 72-step window charged to the
+    data plane; this pins that fix as an auto-sized knob instead of a
+    hardcoded constant.  Each fused window pays ~one
+    ``dispatch_latency_ms`` of transport latency regardless of length,
+    so the window must be long enough that this overhead amortizes below
+    ``phantom_stall_budget_pct`` of the measured step time:
+
+        W_min = dispatch_latency_ms / (budget% * step_floor_ms)
+
+    rounded up to a whole multiple of ``train_steps`` (windows must tile
+    the measured span).  At the tunneled-v5e figures (floor ~26 ms/step,
+    100 ms dispatch, 3% budget) that lands on 144 steps for
+    ``train_steps=36`` — the BENCH_NOTES fix, now derived.  Without a
+    measured ``step_floor_ms`` (the bootstrap call that measures it),
+    the historical 4x multiple is the fallback; the result is capped at
+    8x to keep bench wall time bounded on very fast devices.  The
+    ``PETASTORM_TPU_BENCH_DISPATCH_WINDOW_STEPS`` env var overrides
+    everything (floored at one ``train_steps`` tile).
+    """
+    base = max(1, int(train_steps))
+    pinned = os.environ.get(DISPATCH_WINDOW_ENV)
+    if pinned:
+        return max(base, int(pinned))
+    if not step_floor_ms or step_floor_ms <= 0:
+        return 4 * base
+    need = dispatch_latency_ms / (
+        step_floor_ms * phantom_stall_budget_pct / 100.0)
+    mult = max(1, int(math.ceil(need / base)))
+    return min(8, mult) * base
 
 
 class StallMonitor(object):

@@ -59,6 +59,10 @@ TRACKED_FIELDS = (
     # active — a ratio (weight target 3.0), so host-load noise on the
     # absolute rates largely cancels.
     'multi_tenant_fair_share_ratio',
+    # ISSUE 17: warm resident epoch over cold streamed+admitting epoch
+    # wall-clock — a ratio from one pass, so host-load noise on the
+    # absolute rates largely cancels.
+    'device_residency_warm_over_cold',
 )
 
 #: The ONLY backend labels ``bench.py`` ever emits: ``jax.default_backend()``

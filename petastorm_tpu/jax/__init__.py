@@ -5,8 +5,9 @@ North star (BASELINE.json): ``petastorm.jax.DataLoader`` — double-buffered
 row-group sharding by ``jax.process_index()``.
 """
 
-from petastorm_tpu.jax import augment, packing  # noqa: F401
+from petastorm_tpu.jax import augment, packing, residency  # noqa: F401
 from petastorm_tpu.jax.loader import (DataLoader,  # noqa: F401
                                       DeviceInMemDataLoader,
                                       DiskCachedDataLoader, InMemDataLoader,
-                                      PackedDataLoader, make_jax_loader)
+                                      PackedDataLoader, ResidentDataLoader,
+                                      make_jax_loader)

@@ -238,11 +238,13 @@ class DataLoader(object):
         counter.inc(t1 - t0)
         hist.observe(t1 - t0)
 
-    def _seal_provenance(self, stages, transfer=None):
+    def _seal_provenance(self, stages, transfer=None, residency=None):
         """Merge the reader records drained since the last batch with
         this batch's consumer-side stage windows, seal into the journal,
-        and run the SLO watchdog.  Returns the journal step, or None
-        when provenance is off."""
+        and run the SLO watchdog.  ``residency`` is the resident tier's
+        outcome for this batch (hit / admitted / evicted / bypass) when a
+        residency-capable loader served it.  Returns the journal step,
+        or None when provenance is off."""
         journal = self.provenance
         if journal is None:
             return None
@@ -260,6 +262,8 @@ class DataLoader(object):
                 record['stages'][name] = list(window)
         if transfer is not None:
             record['transfer'] = transfer
+        if residency is not None:
+            record['residency'] = residency
         record = journal.seal(record)
         # Back-annotate tail exemplars: the stage histograms observed
         # these windows before the step existed, so the refs attach
@@ -1486,25 +1490,37 @@ class DeviceInMemDataLoader(InMemDataLoader):
 
     def _materialize(self):
         """Build the HBM-resident epoch cache (idempotent); returns the
-        device pytree or None when the dataset is empty."""
-        if self._dev_cache is None:
-            # Build the host cache via the parent's one-time read, then move
-            # it to HBM wholesale (one transfer for the whole dataset).
-            if self._build_cache() is None:
-                return None
-            numeric = _filter_numeric(self._cache, self._warned_fields)
-            # Transfer plane (one coalesced put for the whole cache, a
-            # transient staging slab); oversized/unsupported caches fall
-            # back to the per-leaf puts below.
-            plane = self._transfer_plane()
-            placed = plane.put_once(numeric) if plane is not None else None
-            if placed is None:
-                place = (lambda x: jax.device_put(x, self._device)) \
-                    if self._device is not None else jax.device_put
-                placed = jax.tree_util.tree_map(place, numeric)
-            self._dev_cache = placed
-            # The host copy is never read again — release dataset-sized RAM.
-            self._cache = None
+        device pytree or None when the dataset is empty.
+
+        The degenerate single-entry case of the residency LRU
+        (``petastorm_tpu.jax.residency``): the whole dataset is one
+        "entry", admitted once via :func:`residency.place_once` and never
+        evicted.  Re-entry (a new pass, a new ``scan_epochs`` call)
+        revalidates the cached buffers instead of re-issuing a
+        dataset-sized ``device_put`` per epoch; buffers invalidated
+        underneath us (donated or deleted) raise a clear error rather
+        than failing deep inside a gather."""
+        from petastorm_tpu.jax import residency
+
+        if self._dev_cache is not None:
+            if residency.device_cache_valid(self._dev_cache):
+                return self._dev_cache
+            # The host copy was released after placement, so the cache
+            # cannot be rebuilt from here.
+            raise RuntimeError(
+                'DeviceInMemDataLoader device cache buffers were deleted '
+                '(donated or explicitly freed) after materialization; '
+                'rebuild the loader to re-read the dataset')
+        # Build the host cache via the parent's one-time read, then move
+        # it to HBM wholesale (one transfer for the whole dataset; the
+        # transfer plane coalesces it into one staging put when enabled).
+        if self._build_cache() is None:
+            return None
+        numeric = _filter_numeric(self._cache, self._warned_fields)
+        self._dev_cache = residency.place_once(
+            numeric, plane=self._transfer_plane(), device=self._device)
+        # The host copy is never read again — release dataset-sized RAM.
+        self._cache = None
         return self._dev_cache
 
     def __iter__(self):
@@ -1579,13 +1595,16 @@ class DeviceInMemDataLoader(InMemDataLoader):
         seed = self._seed if self._seed is not None \
             else int(np.random.default_rng().integers(2 ** 31))
         key = jax.random.PRNGKey(seed)
+        identity = None  # shuffle=False: one device array, not one per epoch
         epoch = 0
         while self._num_epochs is None or epoch < self._num_epochs:
             if self._shuffle:
                 key, sub = jax.random.split(key)
                 order = jax.random.permutation(sub, n)
             else:
-                order = jnp.arange(n)
+                if identity is None:
+                    identity = jnp.arange(n)
+                order = identity
             if epoch >= self._start_epoch:
                 yield order
             epoch += 1
@@ -1788,6 +1807,312 @@ class DeviceInMemDataLoader(InMemDataLoader):
                                  'batch_size': int(self.batch_size),
                                  'drop_last': bool(self._drop_last),
                                  'seed': int(self._seed)}}
+
+
+class ResidentDataLoader(InMemDataLoader):
+    """Device-resident data plane: a compressed-in-HBM tier with an
+    epoch-keyed on-device shuffle and a multi-epoch residency LRU
+    (``petastorm_tpu.jax.residency``).
+
+    Sits beyond :class:`DeviceInMemDataLoader` on the tier ladder: batches
+    live on device in the transfer plane's narrowed **wire** dtypes (uint8
+    stays uint8, float32 rides as bfloat16 under ``wire_dtypes='auto'``)
+    and are widened inside the jitted gather, so HBM holds roughly 2-4x
+    more samples than the full-width device cache.  Epoch 0 streams
+    through a :class:`~petastorm_tpu.jax.transfer.DispatchPump` and admits
+    each delivered batch into the :class:`~petastorm_tpu.jax.residency.
+    ResidencyTier`; once every row is resident, warm epochs are served by
+    a single jitted gather+widen per step and fetch **zero** host batches.
+
+    Determinism contract: every epoch's order is
+    ``epoch_permutation(seed, epoch, n)`` — a pure function of the pair,
+    not of traversal history — so a resident epoch is bit-identical to
+    the equivalent streamed epoch (both deliver ``widen(narrow(rows))``),
+    and dropping the tier mid-epoch (:meth:`drop_resident_tier`) falls
+    back to streaming with an unchanged delivery digest.
+
+    Degrades to full-width streaming (no narrowing, no residency) under
+    ``PETASTORM_TPU_NO_RESIDENCY=1`` or when any field's dtype is outside
+    the wire support matrix; a ``hbm_budget_bytes`` too small for the
+    dataset keeps streaming every epoch (the LRU churns, visible as
+    ``residency_thrash``) rather than failing.  Unlike
+    :class:`DeviceInMemDataLoader` the host cache is **retained**, so the
+    fallbacks always have rows to stream from.
+    """
+
+    def __init__(self, reader, batch_size, num_epochs=1, shuffle=True,
+                 seed=None, device=None, wire_dtypes='auto',
+                 hbm_budget_bytes=None, **kwargs):
+        from petastorm_tpu.jax import residency
+
+        for unsupported in ('transform_fn', 'shuffling_queue_capacity'):
+            if kwargs.get(unsupported):
+                # Same contract as DeviceInMemDataLoader: warm batches
+                # never exist on the host, so host-side hooks cannot run.
+                raise ValueError('ResidentDataLoader does not support %s'
+                                 % unsupported)
+        super(ResidentDataLoader, self).__init__(
+            reader, batch_size, num_epochs=num_epochs, shuffle=shuffle,
+            seed=seed, device=device, wire_dtypes=wire_dtypes, **kwargs)
+        if self._sharding is not None:
+            raise ValueError('ResidentDataLoader caches on one device; use '
+                             'InMemDataLoader with sharding= for global '
+                             'batch assembly')
+        self._budget = hbm_budget_bytes
+        self._tier = None
+        self._plan = None
+        self._identity_order = None
+        #: Full counter shape exists from construction — stats rollups see
+        #: every residency_* counter at 0 even when the plane is off.
+        self._res_counters = residency.ensure_counters(self.metrics)
+        #: Resolved at first iteration; fixed per loader so re-iterating
+        #: replays the same epoch-order stream.
+        self._res_seed = None
+        self._steps_into_epoch = 0
+        self._start_epoch = 0
+        self._start_step = 0
+        self._epochs_done = 0
+        resumed = (self._resume_state or {}).get('resident')
+        if resumed:
+            if seed is None or int(resumed['seed']) != int(seed):
+                raise ValueError(
+                    'resident resume token was taken with seed=%r; rebuild '
+                    'the loader with that explicit seed (every epoch order '
+                    'is derived from (seed, epoch))' % (resumed['seed'],))
+            self._start_epoch = int(resumed['epochs_done'])
+            self._start_step = int(resumed.get('steps_into_epoch', 0))
+            token_bs = resumed.get('batch_size')
+            if self._start_step and token_bs is not None \
+                    and int(token_bs) != int(batch_size):
+                raise ValueError(
+                    'resident resume token was taken %d steps into an epoch '
+                    'of batch_size=%d batches; resume with that batch_size '
+                    '(got %d), or checkpoint at an epoch boundary to change '
+                    'it' % (self._start_step, int(token_bs), int(batch_size)))
+            if self._start_step and not self._deterministic:
+                raise ValueError(
+                    'mid-epoch resident resume requires '
+                    'deterministic_cache_order=True: the step cursor indexes '
+                    'into the cached row order, which only the canonical '
+                    'content-sorted cache reproduces across restarts')
+            self._epochs_done = self._start_epoch
+            self._steps_into_epoch = self._start_step
+
+    @property
+    def residency_stats(self):
+        """Counter snapshot — full shape regardless of plane state."""
+        c = self._res_counters
+        return {'admitted': int(c.admitted.value),
+                'evictions': int(c.evictions.value),
+                'hits': int(c.hits.value),
+                'bypass': int(c.bypass.value),
+                'thrash': int(c.thrash.value),
+                'host_batches': int(c.host_batches.value)}
+
+    def drop_resident_tier(self):
+        """Release the resident tier now (e.g. to reclaim HBM for a model
+        that grew).  Safe mid-epoch: the remaining batches of the pass
+        stream from the retained host cache with identical delivered
+        values, so the delivery digest is unchanged."""
+        if self._tier is not None:
+            self._tier.drop()
+
+    def _epoch_order(self, epoch, n):
+        from petastorm_tpu.jax import residency
+        import jax.numpy as jnp
+
+        if not self._shuffle:
+            if self._identity_order is None \
+                    or len(self._identity_order) != n:
+                self._identity_order = jnp.arange(n)
+            return self._identity_order
+        return residency.epoch_permutation(self._res_seed, epoch, n)
+
+    def __iter__(self):
+        from petastorm_tpu.jax import residency
+
+        if self._build_cache() is None:
+            return iter(())
+        numeric = _filter_numeric(self._cache, self._warned_fields)
+        leaves = jax.tree_util.tree_leaves(numeric)
+        if not leaves:
+            return iter(())
+        n = len(leaves[0])
+        if self._drop_last and n < self.batch_size:
+            logger.warning('epoch cache holds %d rows < batch_size=%d with '
+                           'drop_last: no batches to serve', n,
+                           self.batch_size)
+            return iter(())
+        # Wire narrowing is TRANSFER-plane behavior (pre-residency
+        # streaming already delivered widen(narrow(rows)) under 'auto'),
+        # so the kill switch disables only the resident tier: a killed
+        # loader must reproduce the pre-residency delivery exactly,
+        # lossy wire dtypes included.
+        plan = residency.wire_plan(numeric, self._wire_dtypes)
+        tier = None
+        if plan is not None and not residency.killed():
+            if self._tier is None:
+                self._tier = residency.ResidencyTier(
+                    plan, n, self.batch_size, self._budget,
+                    self._res_counters, device=self._device)
+            tier = self._tier
+        self._plan = plan
+        if self._res_seed is None:
+            self._res_seed = self._seed if self._seed is not None \
+                else int(np.random.default_rng().integers(2 ** 31))
+        return self._gen(numeric, n, plan, tier)
+
+    def _gen(self, cache, n, plan, tier):
+        self._epochs_done = self._start_epoch  # fresh pass
+        self._steps_into_epoch = self._start_step
+        skip = self._start_step  # mid-epoch baseline: first epoch only
+        epoch = self._start_epoch
+        while self._num_epochs is None or epoch < self._num_epochs:
+            order_dev = self._epoch_order(epoch, n)
+            stop = n - self.batch_size + 1 if self._drop_last else n
+            starts = list(range(0, max(stop, 0), self.batch_size))
+            if skip and skip >= len(starts):
+                raise ValueError(
+                    'resident resume token is %d steps into an epoch of %d '
+                    'steps — the dataset or batch geometry changed since '
+                    'the checkpoint' % (skip, len(starts)))
+            if tier is not None and tier.serving_ok():
+                batches = self._resident_epoch(cache, n, plan, tier,
+                                               order_dev, starts, skip)
+            else:
+                batches = self._streamed_epoch(cache, n, plan, tier,
+                                               order_dev, starts, skip)
+            for j, batch in batches:
+                self._m_batches.inc()
+                # Account BEFORE the yield (same contract as
+                # DeviceInMemDataLoader): a state_dict() taken while the
+                # consumer holds the epoch's last batch reads as an epoch
+                # boundary.
+                if j + 1 == len(starts):
+                    self._steps_into_epoch = 0
+                    self._epochs_done += 1
+                else:
+                    self._steps_into_epoch = j + 1
+                yield batch
+            if tier is not None and not tier.fully_resident:
+                # drop_last never streams the ragged tail and a resume
+                # never re-streams skipped batches; admit the leftovers
+                # directly so the next epoch can serve warm.
+                tier.backfill(cache, plan)
+            skip = 0
+            epoch += 1
+
+    def _put_wire(self, wire):
+        if self._device is not None:
+            return {k: jax.device_put(v, self._device)
+                    for k, v in wire.items()}
+        return {k: jax.device_put(v) for k, v in wire.items()}
+
+    def _stream_one(self, cache, n, plan, idx):
+        """Slice, narrow, place, widen one batch — the streamed delivery.
+        Identical values to a warm gather over the same rows: both
+        deliver ``widen(narrow(rows))``."""
+        t0 = time.monotonic()
+        host_rows = {name: np.asarray(v)[idx] for name, v in cache.items()}
+        wire = plan.narrow(host_rows) if plan is not None else host_rows
+        t1 = time.monotonic()
+        wire_dev = self._put_wire(wire)
+        batch = plan.widen(wire_dev) if plan is not None else wire_dev
+        t2 = time.monotonic()
+        self._observe('host_batch', t0, t1)
+        self._observe('device_put', t1, t2)
+        self._res_counters.host_batches.inc()
+        return wire_dev, batch, [t0, t1], [t1, t2]
+
+    def _streamed_epoch(self, cache, n, plan, tier, order_dev, starts, skip):
+        """One epoch through the dispatch ring: a DispatchPump background
+        thread slices/narrows/places while the consumer steps, and each
+        delivered batch is admitted into the tier."""
+        from petastorm_tpu.jax.transfer import _DONE, DispatchPump
+
+        order_np = np.asarray(order_dev)
+        bs = self.batch_size
+
+        def source():
+            for j, start in enumerate(starts):
+                if j < skip:
+                    continue
+                yield j, order_np[start:min(start + bs, n)]
+
+        def ship(item):
+            j, idx = item
+            wire_dev, batch, w_host, w_put = self._stream_one(
+                cache, n, plan, idx)
+            outcome = tier.admit(idx, wire_dev) if tier is not None \
+                else 'bypass'
+            if self.provenance is not None:
+                self._seal_provenance({'host_batch': w_host,
+                                       'h2d_dispatch': w_put},
+                                      residency=outcome)
+            return j, batch
+
+        pump = DispatchPump(source(), ship, self._prefetch)
+        self._pump = pump
+        pump.start()
+        try:
+            while True:
+                item = pump.get()
+                if item is _DONE:
+                    return
+                yield item
+        finally:
+            pump.stop(join_timeout_s=0.2)
+
+    def _resident_epoch(self, cache, n, plan, tier, order_dev, starts, skip):
+        """One warm epoch: jitted gather+widen per step, zero host batches.
+        If the tier is dropped mid-epoch, the remaining steps stream from
+        the retained host cache — same values, digest intact."""
+        order_np = None
+        bs = self.batch_size
+        for j, start in enumerate(starts):
+            if j < skip:
+                continue
+            if tier.serving_ok():
+                if start + bs <= n:
+                    batch = tier.gather(order_dev, start)
+                else:  # ragged tail (drop_last=False)
+                    batch = tier.gather_tail(order_dev, start)
+                outcome = 'hit'
+            else:
+                if order_np is None:
+                    order_np = np.asarray(order_dev)
+                idx = order_np[start:min(start + bs, n)]
+                _, batch, _, _ = self._stream_one(cache, n, plan, idx)
+                outcome = 'bypass'
+                self._res_counters.bypass.inc()
+            if self.provenance is not None:
+                self._seal_provenance({}, residency=outcome)
+            yield j, batch
+
+    def state_dict(self):
+        """Resume token.  Epoch orders are ``epoch_permutation(seed,
+        epoch, n)`` — pure functions of the pair — so ``(epochs_done,
+        steps_into_epoch)`` fully determines the continuation; resume
+        with the same explicit ``seed`` and the remaining stream replays
+        exactly (the tier rebuilds by streaming, values unchanged).
+        Mid-epoch exactness across restarts additionally needs
+        ``deterministic_cache_order=True``, same as the device-cache
+        sibling."""
+        if self._seed is None:
+            raise ValueError('resume needs an explicit seed= (epoch orders '
+                             'must be re-derivable after restart)')
+        if self._steps_into_epoch and not self._deterministic:
+            raise ValueError(
+                'mid-epoch checkpoint (%d steps into the current epoch) '
+                'needs deterministic_cache_order=True — the step cursor '
+                'indexes into the cached row order, which a pool-ordered '
+                'rebuild does not reproduce' % self._steps_into_epoch)
+        return {'version': 1,
+                'resident': {'epochs_done': int(self._epochs_done),
+                             'steps_into_epoch': int(self._steps_into_epoch),
+                             'batch_size': int(self.batch_size),
+                             'drop_last': bool(self._drop_last),
+                             'seed': int(self._seed)}}
 
 
 class DiskCachedDataLoader(DataLoader):

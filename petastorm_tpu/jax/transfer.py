@@ -79,7 +79,8 @@ import jax.numpy as jnp
 
 logger = logging.getLogger(__name__)
 
-__all__ = ['TransferPlane', 'DispatchPump', 'plane_enabled', 'KILL_SWITCH']
+__all__ = ['TransferPlane', 'DispatchPump', 'plane_enabled', 'KILL_SWITCH',
+           'wire_dtype_for']
 
 #: Environment kill switch: set to any non-empty value to force every
 #: loader onto the inline ``device_put`` path regardless of ``transfer=``.
@@ -169,6 +170,16 @@ def _resolve_wire(name, out_dtype, policy):
         return out_dtype
     want = policy.get(name)
     return np.dtype(want) if want is not None else out_dtype
+
+
+def wire_dtype_for(name, out_dtype, policy):
+    """Public form of the wire-narrowing rule for one named leaf.
+
+    The residency tier (``petastorm_tpu.jax.residency``) stores batches
+    on device in exactly these wire dtypes, so the compressed-in-HBM
+    budget math and the H2D link both follow one policy.
+    """
+    return _resolve_wire(name, np.dtype(out_dtype), policy)
 
 
 class _Unsupported(Exception):

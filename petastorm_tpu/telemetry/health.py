@@ -68,6 +68,17 @@ Regime catalogue (``classify_regime``):
   these is a restart/scale-in event away from an outage.  Knobs: the
   dispatcher's crash loop (why is it restarting?), ``drain_timeout_s``
   vs real in-flight split time, dispatcher reachability.
+* ``residency-thrash`` — the device-resident tier's admissions are
+  displacing live entries (``residency_thrash`` vs admissions + hits,
+  ISSUE 17): the HBM budget is smaller than the working set, so every
+  epoch streams AND churns the tier for no warm payoff.  Knobs:
+  ``hbm_budget_bytes``, a narrower ``wire_dtypes`` policy, smaller
+  per-host shard; the kill switch ``PETASTORM_TPU_NO_RESIDENCY`` is
+  the incident lever.
+* ``resident``      — the healthy-variant label (ISSUE 17): the window's
+  batches were served from the device-resident tier (``residency_hits``
+  at or above host deliveries) with nothing degraded — the loader is on
+  the zero-host-batch warm path.
 * ``healthy`` / ``idle`` — nothing above threshold / no traffic at all.
 """
 
@@ -82,7 +93,7 @@ __all__ = ['classify_regime', 'health_report', 'report_from_frames',
 REGIMES = ('decode-bound', 'link-bound', 'lease-starved', 'cache-degraded',
            'cluster-cache-degraded', 'shm-degraded', 'skew-bound',
            'fetch-bound', 'tenant-starved', 'control-plane-degraded',
-           'healthy', 'idle')
+           'residency-thrash', 'resident', 'healthy', 'idle')
 
 #: Histogram name -> pipeline component.  Names from every registry the
 #: fleet merges: service workers (decode_split/serialize/shm_publish),
@@ -165,6 +176,11 @@ def degrade_ratios(delta):
         # synchronous cold read (fetch/plan failure, abandoned checkout)
         # — each one puts first-byte latency back on a decode worker.
         'ingest': ratio('ingest_degraded', ('ingest_fetches',)),
+        # Resident tier (ISSUE 17): "degraded" = admissions that had to
+        # displace a live entry (thrash); traffic = everything the tier
+        # did this window (admissions + warm hits).
+        'residency': ratio('residency_thrash',
+                           ('residency_admitted', 'residency_hits')),
     }
 
 
@@ -187,7 +203,8 @@ def classify_regime(delta, stall_pct=None, meta=None):
             ('cluster', 'cache_peer_degraded', 'cluster-cache-degraded'),
             ('shm', 'shm_degraded', 'shm-degraded'),
             ('link', 'h2d_degraded', 'link-bound'),
-            ('ingest', 'ingest_degraded', 'fetch-bound')):
+            ('ingest', 'ingest_degraded', 'fetch-bound'),
+            ('residency', 'residency_thrash', 'residency-thrash')):
         ratio = ratios.get(plane)
         if ratio is not None and ratio >= DEGRADE_RATIO_FLOOR:
             degraded = counters.get(counter_name, 0)
@@ -372,7 +389,7 @@ def health_report(delta, stall_pct=None, meta=None, window_s=None):
                             % pct,
             }
     ratios = degrade_ratios(delta)
-    for plane in ('cache', 'cluster', 'shm', 'link', 'ingest'):
+    for plane in ('cache', 'cluster', 'shm', 'link', 'ingest', 'residency'):
         ratio = ratios.get(plane)
         if ratio is None:
             continue
@@ -399,10 +416,18 @@ def health_report(delta, stall_pct=None, meta=None, window_s=None):
                                  % (failed, entry['evidence'])).rstrip('; ')
 
     busy = busy_seconds(delta)
+    hits = int(counters.get('residency_hits', 0) or 0)
     if candidates:
         severity, regime, evidence = candidates[0]
     elif not busy and not sum(counters.values()):
         severity, regime, evidence = 0.0, 'idle', 'no activity in window'
+    elif hits and hits >= int(counters.get('residency_host_batches', 0) or 0):
+        # Healthy-variant label (ISSUE 17): the window was served from
+        # the device-resident tier — zero-host-batch warm path.
+        severity, regime, evidence = 0.0, 'resident', (
+            '%d batch(es) served from the device-resident tier this '
+            'window (vs %d streamed from host); nothing degraded'
+            % (hits, int(counters.get('residency_host_batches', 0) or 0)))
     else:
         severity, regime, evidence = 0.0, 'healthy', (
             'no degrade ratio or stall component above threshold')

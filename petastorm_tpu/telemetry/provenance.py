@@ -240,8 +240,10 @@ def merge_records(records):
         # 'tenant' rides the same unanimous-or-'mixed' rule (ISSUE 16):
         # a service batch fed by one tenant's splits is attributed to
         # it; cross-tenant feeds (never produced today) would be loud.
+        # 'residency' (ISSUE 17) likewise: the resident-tier outcome
+        # (hit / admitted / evicted / bypass) is per delivered batch.
         for key in ('cache', 'transport', 'transfer', 'worker_host',
-                    'tenant'):
+                    'tenant', 'residency'):
             value = record.get(key)
             if value is None:
                 continue

@@ -49,6 +49,12 @@ Sections (each contained — a dead plane is reported, not fatal):
   shrink the fetch), a loopback range-fetch round-trip through the same
   ``IngestPlane`` the readers mount (table equality asserted against a
   direct pyarrow read), and the hedge-deadline state.
+* **residency** — the device-resident data plane (ISSUE 17, needs a
+  live backend): kill-switch state, whether buffer donation actually
+  recycles HBM here (it is a copy on CPU), the compressed-in-HBM
+  budget estimate on a training-shaped probe batch (narrowed bytes/row
+  must shrink), and a widen round-trip through a real tier admit +
+  gather (uint8 exact, bf16 error bounded).
 """
 
 import argparse
@@ -545,6 +551,66 @@ def _check_autoscaler():
     return out
 
 
+def _check_residency():
+    """Environment + widen-path sanity of the device-resident data plane
+    (``jax/residency.py``, ISSUE 17): kill-switch state, whether buffer
+    donation actually recycles HBM on this backend, the budget estimate
+    on a training-shaped probe batch (narrowed bytes/row must shrink),
+    and the widen round-trip — uint8 exact, bf16 error bounded — through
+    a real ``ResidencyTier`` admit + gather."""
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu import telemetry
+    from petastorm_tpu.jax import residency
+
+    out = {'kill_switch': residency.killed()}
+    if out['kill_switch']:
+        out['note'] = ('PETASTORM_TPU_NO_RESIDENCY=1: ResidentDataLoader '
+                       'streams full-width every epoch on this host')
+    out['backend'] = jax.default_backend()
+    out['donation_supported'] = residency.donation_supported()
+
+    probe = {'image': (np.arange(8 * 16 * 16 * 3, dtype=np.int64) % 251)
+             .astype(np.uint8).reshape(8, 16, 16, 3),
+             'feat': np.linspace(-1.0, 1.0, 8 * 32,
+                                 dtype=np.float32).reshape(8, 32)}
+    est = residency.estimate_budget(probe, 'auto')
+    out['wire_bytes_per_row'] = est['wire_bytes_per_row']
+    out['logical_bytes_per_row'] = est['logical_bytes_per_row']
+    out['hbm_ratio'] = round(est['hbm_ratio'], 2)
+    # uint8 rides unchanged and float32 halves to bf16, so the ratio must
+    # sit strictly between 1x (nothing narrowed) and 4x (the best case of
+    # an all-float32 batch would be 2x; 4x needs future int narrowing).
+    out['budget_estimate_ok'] = bool(
+        est['narrowed']
+        and est['wire_bytes_per_row'] < est['logical_bytes_per_row']
+        and 1.0 < est['hbm_ratio'] <= 4.0)
+
+    plan = residency.wire_plan(probe, 'auto')
+    counters = residency.ensure_counters(
+        telemetry.MetricsRegistry('doctor_residency'))
+    tier = residency.ResidencyTier(plan, 8, 4, None, counters)
+    wire = plan.narrow(probe)
+    for start in (0, 4):
+        tier.admit(np.arange(start, start + 4),
+                   {k: jax.device_put(v[start:start + 4])
+                    for k, v in wire.items()})
+    out['tier_fully_resident'] = tier.fully_resident
+    order = jnp.arange(8)
+    parts = [tier.gather(order, start) for start in (0, 4)]
+    got = {k: np.concatenate([np.asarray(p[k]) for p in parts])
+           for k in probe}
+    out['widen_uint8_exact'] = bool((got['image'] == probe['image']).all())
+    err = float(np.max(np.abs(got['feat'] - probe['feat'])))
+    out['widen_bf16_max_err'] = round(err, 6)
+    # bf16 keeps 8 significand bits: |err| <= 2^-8 relative, and the
+    # probe values sit in [-1, 1], so 1/128 is a safe absolute bound.
+    out['widen_bf16_bounded'] = bool(err <= 1.0 / 128.0)
+    tier.drop()
+    return out
+
+
 def _check_telemetry():
     """Environment of the telemetry plane (``petastorm_tpu/telemetry``):
     does a registry round-trip and render, is the cross-process clock
@@ -643,6 +709,9 @@ def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
             report['advisor'] = advisor
     if report['backend'].get('probe_ok'):
         _contained(report, 'h2d', lambda: _check_h2d(h2d_mb))
+        # In-process jit + device_put, so it shares the h2d gate: a
+        # wedged tunnel must not hang the report.
+        _contained(report, 'residency', _check_residency)
     return report
 
 

@@ -277,6 +277,44 @@ def test_device_inmem_loader_no_shuffle_matches_source_order(dataset):
     np.testing.assert_array_equal(got, np.arange(64))
 
 
+def test_device_inmem_materializes_device_cache_once(dataset, monkeypatch):
+    """Re-iterating must NOT re-upload: the device cache is placed once
+    and reused while its buffers stay live (ISSUE 17 satellite)."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader, residency
+    calls = []
+    real = residency.place_once
+
+    def counting(numeric, plane=None, device=None):
+        calls.append(len(numeric))
+        return real(numeric, plane=plane, device=device)
+
+    monkeypatch.setattr(residency, 'place_once', counting)
+    with make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        loader = DeviceInMemDataLoader(reader, batch_size=16, num_epochs=1,
+                                       shuffle=False)
+        first = np.concatenate([np.asarray(b['id']) for b in loader])
+        second = np.concatenate([np.asarray(b['id']) for b in loader])
+    np.testing.assert_array_equal(first, second)
+    assert len(calls) == 1
+
+
+def test_device_inmem_deleted_cache_raises(dataset):
+    """If the cached device buffers were donated/freed, re-iteration must
+    fail loudly instead of serving deleted arrays (host cache is gone)."""
+    from petastorm_tpu.jax import DeviceInMemDataLoader
+    import pytest
+    with make_reader(dataset.url, reader_pool_type='dummy', num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        loader = DeviceInMemDataLoader(reader, batch_size=16, num_epochs=1,
+                                       shuffle=False)
+        list(loader)
+        for leaf in loader._dev_cache.values():
+            leaf.delete()
+        with pytest.raises(RuntimeError, match='rebuild the loader'):
+            list(loader)
+
+
 def test_device_inmem_scan_epochs(dataset):
     """scan_epochs: one lax.scan dispatch per epoch drives the same batches
     the per-step iterator would — full coverage every epoch, reshuffled
