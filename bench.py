@@ -1393,6 +1393,100 @@ def epoch_cache_plane_leg(pairs=3):
     return fields
 
 
+def first_epoch_warm_leg(pairs=2):
+    """Proactive materialization (ISSUE 18): the FIRST epoch a consumer
+    ever runs, cold (every JPEG decoded on the consumer's clock) vs
+    pre-warmed (a :class:`MaterializeController` decoded the dataset
+    into the plane before the consumer arrived).  The epoch-cache leg
+    above measures epoch 2+ of one tenant; this leg measures what
+    materialization moves — the cold start itself — for a brand-new
+    consumer whose plane was warmed off its clock.
+
+    Asserted in-leg, not just reported: the warm epoch performs ZERO
+    host decodes (plane misses == 0), and the cold and warm delivery
+    digests are identical (warming changes when rows are decoded,
+    never what is delivered)."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import DataLoader
+    from petastorm_tpu.materialize import MaterializeController
+    from petastorm_tpu.test_util.chaos import DeliveryDigest
+
+    plane_dir = os.path.join(BENCH_DIR, 'first_epoch_warm_v1')
+    cache_kwargs = {'cache_type': 'plane', 'cache_location': plane_dir}
+
+    def first_epoch(digest=None, **extra):
+        """One first-epoch pass; same timer protocol as
+        ``_plane_epoch_rate`` (opens at the first delivered batch), plus
+        the reader's plane counters.  ``digest`` (untimed verification
+        passes only — per-row hashing would cap the measured rate)
+        accumulates the delivery digest."""
+        with make_reader(DATASET_URL, num_epochs=1, workers_count=WORKERS,
+                         shuffle_row_groups=False, columnar_decode=True,
+                         **extra) as reader:
+            loader = DataLoader(reader, batch_size=BATCH, prefetch=2)
+            n_host, t0, t_end = 0, None, None
+            for i, batch in enumerate(loader.iter_host_batches()):
+                if digest is not None:
+                    digest.update({k: np.asarray(v)
+                                   for k, v in batch.items()})
+                if i == 0:
+                    t0 = time.monotonic()
+                else:
+                    n_host += len(batch['noun_id'])
+                    t_end = time.monotonic()
+            diag = reader.diagnostics
+        return (n_host / (t_end - t0)
+                if n_host and t_end is not None and t_end > t0 else 0.0,
+                diag)
+
+    cold_rates, warm_rates, mat_times = [], [], []
+    warm_decodes = 0
+    for _ in range(max(1, int(pairs))):
+        _wipe_plane(plane_dir)
+        cold_rates.append(first_epoch(**cache_kwargs)[0])
+        # Warming must pay the full decode itself: the cold pass above
+        # populated the plane as a side effect, so wipe before timing it.
+        _wipe_plane(plane_dir)
+        t0 = time.monotonic()
+        with MaterializeController(DATASET_URL, plane_dir) as controller:
+            summary = controller.run()
+        mat_times.append(time.monotonic() - t0)
+        if summary.get('done') != summary.get('total_pieces') \
+                or summary.get('failed_pieces'):
+            raise AssertionError('materialize pass incomplete: %r'
+                                 % (summary,))
+        rate, diag = first_epoch(**cache_kwargs)
+        warm_rates.append(rate)
+        warm_decodes = max(warm_decodes, int(diag.get('cache_misses', -1)))
+    # Delivery identity, asserted on untimed verification passes: the
+    # plane left warm by the last pair vs a decode-direct (cache-off)
+    # ground-truth epoch.
+    warm_digest, cold_digest = DeliveryDigest(), DeliveryDigest()
+    first_epoch(warm_digest, **cache_kwargs)
+    first_epoch(cold_digest)
+    if warm_digest.hexdigest() != cold_digest.hexdigest():
+        raise AssertionError(
+            'pre-warmed first epoch delivered %s, decode-direct delivered '
+            '%s' % (warm_digest.hexdigest(), cold_digest.hexdigest()))
+    if warm_decodes != 0:
+        raise AssertionError('pre-warmed first epoch decoded %d piece(s) '
+                             'on the host (expected 0: every piece was '
+                             'materialized)' % warm_decodes)
+    cold = float(np.median(cold_rates))
+    warm = float(np.median(warm_rates))
+    return {
+        'first_epoch_cold_images_per_sec': round(cold, 1),
+        'first_epoch_warm_images_per_sec': round(warm, 1),
+        'first_epoch_warm_over_cold':
+            round(warm / cold, 2) if cold else None,
+        'first_epoch_warm_decodes': int(warm_decodes),
+        'first_epoch_materialize_s':
+            round(float(np.median(mat_times)), 2),
+        'first_epoch_wire_entries': int(summary.get('wire_published', 0)),
+        'first_epoch_digest_identical': True,
+    }
+
+
 def _cluster_fleet_pass(shared_plane, worker_planes, collect_digest=False,
                         wait_digests=0):
     """One ordered client pass over the JPEG dataset against a fresh
@@ -2259,6 +2353,7 @@ _IPC_PLANE_LEGS = (
     ('processpool_plane', processpool_host_plane_leg),
     ('delivery_plane_service', delivery_plane_service_leg),
     ('epoch_cache_plane', epoch_cache_plane_leg),
+    ('first_epoch_warm', first_epoch_warm_leg),
     ('cluster_cache', cluster_cache_leg),
     ('transfer_plane', transfer_plane_leg),
     ('adaptive_sched', adaptive_sched_leg),
@@ -2518,6 +2613,13 @@ _COMPACT_KEYS = (
     'epoch_cache_service_warm_images_per_sec',
     'epoch_cache_service_warm_over_cold',
     'stall_pct_epoch_cache_warm_scan',
+    'first_epoch_cold_images_per_sec',
+    'first_epoch_warm_images_per_sec',
+    'first_epoch_warm_over_cold',
+    'first_epoch_warm_decodes',
+    'first_epoch_materialize_s',
+    'first_epoch_wire_entries',
+    'first_epoch_digest_identical',
     'cluster_cache_images_per_sec_cold_join',
     'cluster_cache_images_per_sec_cold_fleet',
     'cluster_cache_images_per_sec_warm',

@@ -63,6 +63,9 @@ TRACKED_FIELDS = (
     # wall-clock — a ratio from one pass, so host-load noise on the
     # absolute rates largely cancels.
     'device_residency_warm_over_cold',
+    # ISSUE 18: pre-materialized first epoch over cold first epoch — a
+    # ratio of interleaved passes, so host-load noise largely cancels.
+    'first_epoch_warm_over_cold',
 )
 
 #: The ONLY backend labels ``bench.py`` ever emits: ``jax.default_backend()``

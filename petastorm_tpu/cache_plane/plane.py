@@ -407,6 +407,57 @@ class Tier(object):
         finally:
             os.close(fd)
 
+    def eviction_estimate(self, nbytes):
+        """What storing ``nbytes`` more would evict — a READ-ONLY dry
+        run of :meth:`_evict_if_needed`'s exact LRU walk (same listdir,
+        same atime ordering, same cap arithmetic), so a background
+        publisher can ask "would this publish evict anything, and how
+        hot is the hottest victim?" before committing bytes.  Returns::
+
+            {'fits': bool,            # nbytes would land without eviction
+             'victims': int,          # entries the LRU walk would unlink
+             'victim_bytes': int,     # their total size
+             'victim_newest_age_s': float or None,  # youngest victim's
+                                      # seconds-since-last-access
+             'total_bytes': int}      # current published total
+
+        Never raises; an unlistable tier reports a fit (the store path
+        will degrade on its own terms).
+        """
+        nbytes = int(nbytes)
+        entries, total = [], 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith(ENTRY_SUFFIX):
+                continue
+            try:
+                st = os.stat(os.path.join(self.root, name))
+            except OSError:
+                continue
+            entries.append((st.st_atime, st.st_size))
+            total += st.st_size
+        report = {'fits': True, 'victims': 0, 'victim_bytes': 0,
+                  'victim_newest_age_s': None, 'total_bytes': total}
+        over = total + nbytes - self.capacity_bytes
+        if over <= 0:
+            return report
+        report['fits'] = False
+        now = time.time()
+        for atime, size in sorted(entries):  # oldest access first
+            report['victims'] += 1
+            report['victim_bytes'] += size
+            age = max(0.0, now - atime)
+            if report['victim_newest_age_s'] is None \
+                    or age < report['victim_newest_age_s']:
+                report['victim_newest_age_s'] = age
+            over -= size
+            if over <= 0:
+                break
+        return report
+
     def sweep(self):
         """Unlink crash/degrade residue; returns the removed names.
 
@@ -787,6 +838,33 @@ class CachePlane(object):
             logger.warning('cache plane: publish_blob(%s) failed',
                            digest, exc_info=True)
             return False
+
+    def admit_publish(self, nbytes, hot_window_s=300.0):
+        """Eviction-aware admission for BACKGROUND publishers (the
+        materialize plane, ISSUE 18): consult the disk tier's eviction
+        estimator and refuse a publish whose LRU victims include any
+        entry accessed within ``hot_window_s`` — warming must never
+        evict traffic hotter than what it brings.  Consumer-path
+        publishes (``get_or_fill``/peer fill) stay unconditional: a
+        consumer's miss IS demand.
+
+        Returns ``(admitted, estimate)`` where ``estimate`` is
+        :meth:`Tier.eviction_estimate`'s report (None when the plane has
+        no disk tier).  Never raises.
+        """
+        if self.disk is None:
+            return False, None
+        try:
+            estimate = self.disk.eviction_estimate(nbytes)
+        except Exception:  # noqa: BLE001 — cache machinery never raises
+            logger.warning('cache plane: admit_publish estimate failed',
+                           exc_info=True)
+            return True, None
+        if estimate['fits']:
+            return True, estimate
+        newest = estimate['victim_newest_age_s']
+        admitted = newest is None or newest >= float(hot_window_s)
+        return admitted, estimate
 
     def held_digests(self):
         """Digests of every published entry in either tier — what a

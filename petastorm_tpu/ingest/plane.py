@@ -201,6 +201,13 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
         self._c_degraded = self.metrics.counter('ingest_degraded')
         self._c_hedges = self.metrics.counter('ingest_hedges')
         self._c_hedge_wins = self.metrics.counter('ingest_hedge_wins')
+        # Per-plan gap/waste accounting (ISSUE 18 satellite): what the
+        # columns occupy vs what the coalesced GETs transfer.  The
+        # running waste percentage is the layout-rewrite job's trigger
+        # signal, registered here so it rides every snapshot/dashboard.
+        self._c_plan_needed = self.metrics.counter('ingest_plan_needed_bytes')
+        self._c_plan_waste = self.metrics.counter('ingest_plan_waste_bytes')
+        self._g_waste_pct = self.metrics.gauge('ingest_plan_waste_pct')
         #: explicit fetch_threads pins the pool size; otherwise it
         #: tracks the window (set_window grows it) — a widened window
         #: with a frozen thread pool could not raise fetch concurrency,
@@ -314,16 +321,18 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
         ngets = 0
         size = 0
         handle = None
+        plan = None
         try:
             # No `with`: delegating wrapper handles (fault injection,
             # emulation) routinely lack __enter__, and implicit special
             # method lookup bypasses their __getattr__.
             handle = self._fs.open(path, 'rb')
             metadata, tail_offset, tail, size = self._footer(path, handle)
-            ranges = _planner.coalesce(
-                _planner.column_chunk_ranges(metadata, row_group,
-                                             self._columns),
-                self._merge_gap, self._max_range_bytes)
+            raw_ranges = _planner.column_chunk_ranges(metadata, row_group,
+                                                      self._columns)
+            ranges = _planner.coalesce(raw_ranges, self._merge_gap,
+                                       self._max_range_bytes)
+            plan = _planner.plan_stats(raw_ranges, ranges)
             segments = {tail_offset: tail}
             for offset, length in ranges:
                 if entry.done or self._stopped:
@@ -376,6 +385,14 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
             self._c_fetches.inc()
             self._c_bytes.inc(nbytes)
             self._c_gets.inc(ngets)
+            if plan is not None:
+                self._c_plan_needed.inc(plan['needed_bytes'])
+                self._c_plan_waste.inc(plan['waste_bytes'])
+                needed = self._c_plan_needed.value
+                waste = self._c_plan_waste.value
+                fetched = needed + waste
+                self._g_waste_pct.set(
+                    round(100.0 * waste / fetched, 2) if fetched else 0.0)
             if hedge:
                 self._c_hedge_wins.inc()
         elif failed:
@@ -586,6 +603,9 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
             'ingest_degraded': self._c_degraded.value,
             'ingest_hedges': self._c_hedges.value,
             'ingest_hedge_wins': self._c_hedge_wins.value,
+            'ingest_plan_needed_bytes': self._c_plan_needed.value,
+            'ingest_plan_waste_bytes': self._c_plan_waste.value,
+            'ingest_plan_waste_pct': self._g_waste_pct.value,
         }
 
     def hedge_state(self):

@@ -20,7 +20,7 @@ reusable by the doctor's ``ingest`` probe.
 import pyarrow.parquet as pq
 
 __all__ = ['IngestMissError', 'IngestPlanError', 'SparseFile', 'coalesce',
-           'column_chunk_ranges', 'read_footer', 'read_exact']
+           'column_chunk_ranges', 'plan_stats', 'read_footer', 'read_exact']
 
 PARQUET_MAGIC = b'PAR1'
 
@@ -164,6 +164,28 @@ def coalesce(ranges, merge_gap=DEFAULT_MERGE_GAP,
             start += max_range_bytes
         out.append((start, end - start))
     return out
+
+
+def plan_stats(raw_ranges, coalesced_ranges):
+    """Gap/waste accounting of one coalesced plan vs its raw ranges.
+
+    ``needed_bytes`` is what the columns actually occupy (the raw
+    chunks); ``fetched_bytes`` is what the coalesced GETs transfer;
+    their difference is ``waste_bytes`` — merge-gap filler plus any
+    layout-induced interleaving the merge had to ride over.  This is the
+    layout-rewrite job's trigger signal (ISSUE 18c: a rewritten dataset
+    packs selected columns contiguously, driving waste toward zero) and
+    the ingest plane's per-fetch telemetry gauge input.
+    """
+    needed = sum(int(n) for _, n in raw_ranges)
+    fetched = sum(int(n) for _, n in coalesced_ranges)
+    waste = max(0, fetched - needed)
+    return {'needed_bytes': needed,
+            'fetched_bytes': fetched,
+            'waste_bytes': waste,
+            'requests': len(coalesced_ranges),
+            'waste_pct': round(100.0 * waste / fetched, 2) if fetched
+            else 0.0}
 
 
 class SparseFile(object):

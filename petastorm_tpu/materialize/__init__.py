@@ -1,0 +1,72 @@
+"""Proactive materialization plane: decode once, serve forever (ISSUE 18).
+
+Every cache tier so far is reactive — decoded entries, wire-shaped
+slabs, and coalesced range plans exist only after some consumer paid the
+cold path, so a new tenant's first epoch still runs 2-3.5x slower than a
+warm fleet (ROADMAP item 4).  This package inverts that, per the tf.data
+service paper's snapshot/"ingestion-as-a-service" direction and
+MinatoLoader's pay-once preprocessing argument (PAPERS.md): background
+jobs warm datasets AHEAD of demand using capacity the autoscaler would
+otherwise drain away.
+
+Three job kinds, one controller:
+
+* **pre-publish** (:class:`MaterializeController`) — decode every piece
+  of a dataset through the EXACT reader-worker code path consumers run
+  (``PyDictReaderWorker`` / ``ArrowReaderWorker``, instantiated
+  standalone with a capturing result cache) and publish the entries into
+  the cluster cache plane under the digests
+  :class:`service.cluster.ClusterCacheIdentity` computes — so a later
+  consumer's first epoch is all HITs, bit-identical to the decode path
+  by construction.  Piece-granular progress persists through the PR 15
+  snapshot+journal ledger (``kind='materialize_ledger'``): a killed
+  controller resumes attempt-intact.  Admission is eviction-aware:
+  every publish consults the plane's eviction estimator
+  (``CachePlane.admit_publish``) and is refused when it would evict an
+  entry hotter than the configured window — warming never evicts
+  traffic hotter than what it brings.
+* **pre-transcode to wire format** (``transcode``) — columnar entries
+  are additionally published bf16/uint8-narrowed per the public
+  ``jax/transfer.py :: wire_dtype_for`` policy, under a distinct
+  ``:w{policy}`` key suffix, so a warm serve can skip decode AND collate
+  AND narrowing; digest identity against the streamed path is asserted
+  at publish time (the same ``widen(narrow(rows))`` contract PR 17
+  pinned — bf16->f32 widening is exact).
+* **rewrite layout** (``rewrite``) — re-shard a hot dataset into
+  row-group sizes matched to split geometry and repack selected columns
+  contiguously, driven by the ingest planner's gap/waste stats, so the
+  PR 14 coalesced range plans fetch zero waste bytes.  The row sink
+  (``write_rows``) is shared with ``tools/pack_dataset.py`` — offline
+  CLI packing and fleet rewrite jobs produce byte-identical layouts.
+
+Warming candidates come from the provenance journal's observed access
+patterns (``derive_candidates``): records that paid a cold decode name
+the dataset roots worth warming, with per-tenant attribution for free.
+
+Kill switch: ``PETASTORM_TPU_NO_MATERIALIZE=1`` disables every job kind
+(the controller constructs but refuses to run); degrade everywhere —
+admission refusals, unencodable entries, unsupported reader kwargs, and
+wire-plan-ineligible datasets all skip work rather than raise.
+"""
+
+import os
+
+KILL_SWITCH = 'PETASTORM_TPU_NO_MATERIALIZE'
+
+
+def killed():
+    """The materialization plane's kill switch (env beats everything)."""
+    return bool(os.environ.get(KILL_SWITCH))
+
+
+from petastorm_tpu.materialize.controller import (  # noqa: E402,F401
+    MATERIALIZE_LEDGER_KIND, MaterializeController, derive_candidates)
+from petastorm_tpu.materialize.rewrite import (  # noqa: E402,F401
+    layout_stats, rewrite_layout, write_rows)
+from petastorm_tpu.materialize.transcode import (  # noqa: E402,F401
+    is_wire_entry, widen_entry, wire_entry, wire_key)
+
+__all__ = ['KILL_SWITCH', 'killed', 'MaterializeController',
+           'MATERIALIZE_LEDGER_KIND', 'derive_candidates', 'layout_stats',
+           'rewrite_layout', 'write_rows', 'wire_entry', 'widen_entry',
+           'wire_key', 'is_wire_entry']

@@ -1,0 +1,592 @@
+"""Materialization job controller (ISSUE 18a): warm datasets ahead of demand.
+
+The controller owns one dataset's warming job end to end:
+
+* **decode identity** — :class:`service.cluster.ClusterCacheIdentity`
+  resolves the job's pieces, plane context, and per-piece digests
+  WITHOUT constructing a reader; the warmer then instantiates the exact
+  reader-worker class consumers run (``PyDictReaderWorker`` /
+  ``ArrowReaderWorker``) standalone, with a capturing result cache, so
+  a warmed entry is byte-identical to what a consumer's miss would have
+  published — the same single-source-of-truth key formats, the same
+  post-transform values, the same ``encode_entry`` bytes.
+* **lease protocol** — the dispatcher's split-lease semantics over
+  piece-granular work: ``lease`` grants with a TTL and burns an attempt,
+  expiry requeues, ``max_piece_attempts`` poisons a piece to ``failed``.
+  The protocol is what lets autoscaler scale-in victims
+  (:meth:`offer_drain_candidate`) and the controller's own run loop
+  share one work queue without double-warming a piece.
+* **durable progress** — the PR 15 snapshot+journal ledger under
+  ``kind='materialize_ledger'``: ``complete`` appends one O(1)
+  write-ahead line BEFORE the in-memory transition, so a SIGKILLed
+  controller restarts attempt-intact with every finished piece still
+  finished (the chaos scenario asserts exactly this).  Restores are
+  gated on the plane-context fingerprint — a ledger written under a
+  different dataset/spec identity cold-starts instead of lying.
+* **eviction-aware admission** — every publish asks
+  ``CachePlane.admit_publish`` first: a publish whose LRU victims
+  include any entry accessed within ``hot_window_s`` is refused
+  (counted, piece left pending attempt-intact for a later, cooler run).
+
+Candidates come from the provenance journal (:func:`derive_candidates`):
+sealed records that paid a cold decode name the dataset roots worth
+warming, with per-tenant attribution riding along.
+"""
+
+import hashlib
+import logging
+import os
+import threading
+import time
+
+from petastorm_tpu.utils.locks import make_lock
+
+logger = logging.getLogger(__name__)
+
+__all__ = ['MaterializeController', 'MATERIALIZE_LEDGER_KIND',
+           'derive_candidates', 'wire_digests']
+
+MATERIALIZE_LEDGER_KIND = 'materialize_ledger'
+
+#: Ledger snapshot cadence: one full snapshot per this many completes
+#: (the write-ahead journal covers the gap — same cost model as the
+#: dispatcher's ledger).
+_SAVE_EVERY = 16
+
+_PENDING, _LEASED, _DONE, _FAILED = 'p', 'l', 'd', 'f'
+
+
+class _CaptureCache(object):
+    """Result-cache stand-in for the standalone warmer workers: always
+    fills, and records key -> post-transform value — exactly what the
+    consumer path would have handed ``encode_entry``."""
+
+    def __init__(self):
+        self.values = {}
+
+    def get(self, key, fill_func):
+        value = fill_func()
+        self.values[key] = value
+        return value
+
+    def cleanup(self):
+        self.values.clear()
+
+
+class MaterializeController(object):  # ptlint: disable=pickle-unsafe-attrs — owns a lock, threads and an flock'd ledger; runs in one process, never pickled
+    """One dataset's pre-publish warming job.
+
+    Args mirror the service job dict (``ClusterCacheIdentity.build``
+    consumes them verbatim): ``dataset_url`` + ``reader_kwargs`` pin the
+    decode identity, ``cache_plane_dir`` is the shared plane the fleet
+    reads.  ``ledger_path=None`` runs without durability (tests, one-shot
+    tools); ``throttle_s`` stretches the decode->publish window (the
+    chaos harness's kill target).  Construction never raises on an
+    unsupported job — ``identity`` stays None and :meth:`run` reports
+    why.
+    """
+
+    def __init__(self, dataset_url, cache_plane_dir, reader_kwargs=None,
+                 ledger_path=None, cache_plane_disk_bytes=None,
+                 cache_plane_ram_bytes=None, reader_factory='auto',
+                 wire_policy='auto', hot_window_s=300.0, lease_ttl_s=30.0,
+                 max_piece_attempts=3, throttle_s=0.0):
+        from petastorm_tpu.service.cluster import ClusterCacheIdentity
+        self.dataset_url = dataset_url
+        self._job = {'dataset_url': dataset_url,
+                     'reader_kwargs': dict(reader_kwargs or {}),
+                     'reader_factory': reader_factory,
+                     'cache_plane_dir': cache_plane_dir,
+                     'cache_plane_disk_bytes': cache_plane_disk_bytes,
+                     'cache_plane_ram_bytes': cache_plane_ram_bytes}
+        self._wire_policy = wire_policy
+        self._hot_window_s = float(hot_window_s)
+        self._lease_ttl_s = float(lease_ttl_s)
+        self._max_piece_attempts = int(max_piece_attempts)
+        self.throttle_s = float(throttle_s)
+        self._lock = make_lock(
+            'materialize.controller.MaterializeController._lock')
+        self._init_metrics()
+        self.identity = ClusterCacheIdentity.build(self._job)
+        self._piece_state = []       # piece index -> [state_code, attempt]
+        self._leases = {}            # piece index -> (worker_id, expires)
+        self._drain_passes = {}      # worker id -> warming-pass thread
+        self.resumed_pieces = 0
+        self._completes_since_save = 0
+        self._ledger = None
+        if self.identity is not None:
+            self._piece_state = [[_PENDING, 0]
+                                 for _ in range(self.identity.num_pieces)]
+            self._context_token = hashlib.blake2b(
+                self.identity.plane.context.encode('utf-8', 'replace'),
+                digest_size=8).hexdigest()
+            if ledger_path:
+                self._attach_ledger(ledger_path)
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _init_metrics(self):
+        from petastorm_tpu.telemetry import MetricsRegistry
+        self.metrics = MetricsRegistry('materialize')
+        self._m_runs = self.metrics.counter('materialize_runs')
+        self._m_warmed = self.metrics.counter('materialize_pieces_warmed')
+        self._m_resumed = self.metrics.counter('materialize_pieces_resumed')
+        self._m_failed = self.metrics.counter('materialize_pieces_failed')
+        self._m_refused = self.metrics.counter(
+            'materialize_admission_refused')
+        self._m_bytes = self.metrics.counter('materialize_published_bytes')
+        self._m_wire = self.metrics.counter('materialize_wire_published')
+        self._m_wire_skipped = self.metrics.counter(
+            'materialize_wire_skipped')
+        self._m_drain_passes = self.metrics.counter(
+            'materialize_drain_passes')
+
+    # -- durable ledger ------------------------------------------------------
+
+    def _attach_ledger(self, path):
+        """Acquire + restore-or-cold-start.  A held ledger (another live
+        controller on the same path) disables durability for THIS
+        instance rather than raising — warming is an optimization."""
+        from petastorm_tpu.service.ledger import (DispatcherLedger,
+                                                  LedgerHeldError)
+        ledger = DispatcherLedger(path, kind=MATERIALIZE_LEDGER_KIND)
+        try:
+            ledger.acquire()
+        except LedgerHeldError:
+            logger.warning('materialize: ledger %s held by a live '
+                           'controller; running without durability', path)
+            return
+        self._ledger = ledger
+        state = ledger.load()
+        if not state:
+            self._save_ledger()
+            return
+        if state.get('context') != self._context_token \
+                or not isinstance(state.get('splits'), list) \
+                or len(state['splits']) != len(self._piece_state):
+            logger.warning('materialize: ledger %s was written under a '
+                           'different decode identity/geometry; cold start',
+                           path)
+            self._save_ledger()
+            return
+        try:
+            from petastorm_tpu.service.ledger import decode_splits
+            decoded = decode_splits(state['splits'])
+        except (ValueError, KeyError, TypeError):
+            logger.warning('materialize: ledger %s splits undecodable; '
+                           'cold start', path)
+            self._save_ledger()
+            return
+        for i, (restored_state, attempt) in enumerate(decoded):
+            if restored_state == 'done':
+                self._piece_state[i] = [_DONE, attempt]
+                self.resumed_pieces += 1
+                self._m_resumed.inc()
+            elif restored_state == 'failed':
+                self._piece_state[i] = [_FAILED, attempt]
+            else:
+                # pending AND leased both requeue attempt-intact: the
+                # controller's death was not the piece's failure.
+                self._piece_state[i] = [_PENDING, attempt]
+        logger.info('materialize: ledger %s restored %d/%d pieces done',
+                    path, self.resumed_pieces, len(self._piece_state))
+
+    def _save_ledger(self):
+        if self._ledger is None:
+            return
+        with self._lock:
+            splits = [list(rec) for rec in self._piece_state]
+        self._ledger.save({'context': self._context_token,
+                           'dataset_url': self.dataset_url,
+                           'splits': splits})
+        self._completes_since_save = 0
+
+    # -- lease protocol ------------------------------------------------------
+
+    def _expire_leases_locked(self, now):
+        for index, (_, expires) in list(self._leases.items()):
+            if expires < now:
+                del self._leases[index]
+                # Attempt stays burned (the grant consumed it): the
+                # poison ceiling below is what bounds a crashing piece.
+                self._piece_state[index][0] = _PENDING
+
+    def lease(self, worker_id, n=1, skip=()):
+        """Grant up to ``n`` pending piece indices to ``worker_id`` with
+        a TTL; burns one attempt per grant.  Pieces at the attempt
+        ceiling poison to ``failed`` instead of granting."""
+        from petastorm_tpu import materialize
+        if materialize.killed():
+            return []
+        now = time.monotonic()
+        granted = []
+        with self._lock:
+            self._expire_leases_locked(now)
+            for index, rec in enumerate(self._piece_state):
+                if len(granted) >= n:
+                    break
+                if rec[0] != _PENDING or index in skip:
+                    continue
+                if rec[1] >= self._max_piece_attempts:
+                    rec[0] = _FAILED
+                    self._m_failed.inc()
+                    continue
+                rec[0] = _LEASED
+                rec[1] += 1
+                self._leases[index] = (worker_id, now + self._lease_ttl_s)
+                granted.append(index)
+        return granted
+
+    def complete(self, worker_id, index):
+        """Retire one warmed piece — write-ahead journal line FIRST
+        (the durable record exists before the in-memory transition), so
+        a kill between the two re-runs nothing."""
+        if self._ledger is not None:
+            self._ledger.append({'op': 'done', 'split': int(index)})
+        with self._lock:
+            self._piece_state[index][0] = _DONE
+            self._leases.pop(index, None)
+        self._m_warmed.inc()
+        self._completes_since_save += 1
+        if self._completes_since_save >= _SAVE_EVERY:
+            self._save_ledger()
+
+    def release(self, worker_id, index, burn_attempt=True):
+        """Return a lease unfinished.  ``burn_attempt=False`` refunds the
+        grant's attempt — used when the piece itself was fine but the
+        environment refused it (admission), so a later run retries from
+        a clean count."""
+        with self._lock:
+            rec = self._piece_state[index]
+            if rec[0] == _LEASED:
+                rec[0] = _PENDING
+                if not burn_attempt:
+                    rec[1] = max(0, rec[1] - 1)
+            self._leases.pop(index, None)
+
+    def fail(self, worker_id, index):
+        """Decode failure: requeue for retry (the attempt ceiling in
+        ``lease`` poisons a piece that keeps failing)."""
+        self.release(worker_id, index, burn_attempt=True)
+        self._m_failed.inc()
+
+    def pending_count(self):
+        with self._lock:
+            return sum(1 for rec in self._piece_state
+                       if rec[0] == _PENDING
+                       and rec[1] < self._max_piece_attempts)
+
+    # -- warming -------------------------------------------------------------
+
+    def _make_worker(self):
+        """One standalone reader-worker (the EXACT consumer decode path)
+        + its capturing cache.  Per-pass, not per-controller: passes run
+        concurrently (run loop + drain passes) and the parquet handle
+        cache inside the worker is single-threaded state."""
+        identity = self.identity
+        capture = _CaptureCache()
+        if identity.kind == 'columns':
+            from petastorm_tpu.py_dict_reader_worker import (
+                PyDictReaderWorker, RowWorkerArgs)
+            args = RowWorkerArgs(
+                filesystem=identity.fs, pieces=identity.pieces,
+                schema=identity.stored_schema,
+                schema_view=identity.schema_view,
+                transform_spec=identity.transform_spec,
+                predicate=identity.predicate, cache=capture,
+                shuffle_row_drop_partitions=identity.drop_partitions,
+                columnar_output=True)
+            worker = PyDictReaderWorker(0, lambda _result: None, args)
+        else:
+            from petastorm_tpu.arrow_reader_worker import (ArrowReaderWorker,
+                                                           BatchWorkerArgs)
+            args = BatchWorkerArgs(
+                filesystem=identity.fs, pieces=identity.pieces,
+                schema=identity.stored_schema,
+                schema_view=identity.schema_view,
+                transform_spec=identity.transform_spec,
+                predicate=identity.predicate, cache=capture)
+            worker = ArrowReaderWorker(0, lambda _result: None, args)
+        return worker, capture
+
+    def _decode_piece(self, index, worker, capture):
+        """Decode one piece via the consumer code path; returns
+        ``[(digest, cache_key, value), ...]`` (one per row-drop
+        partition)."""
+        identity = self.identity
+        digests = identity.piece_digests(index)
+        capture.values.clear()
+        items = []
+        if identity.kind == 'columns':
+            from petastorm_tpu.py_dict_reader_worker import piece_cache_key
+            for part in range(identity.drop_partitions):
+                key = piece_cache_key(identity.pieces[index],
+                                      identity.schema_view,
+                                      identity.transform_spec, part) + ':c'
+                worker.process(index, part)
+                items.append((digests[part], key, capture.values[key]))
+        else:
+            from petastorm_tpu.arrow_reader_worker import piece_cache_key
+            key = piece_cache_key(identity.pieces[index],
+                                  identity.schema_view,
+                                  identity.transform_spec)
+            worker.process(index)
+            items.append((digests[0], key, capture.values[key]))
+        return items
+
+    def _publish(self, digest, blob):
+        """Admission-gated publish: 'published' | 'present' | 'refused'
+        | 'degraded'."""
+        plane = self.identity.plane
+        if plane.has_digest(digest):
+            return 'present'
+        admitted, _estimate = plane.admit_publish(len(blob),
+                                                  self._hot_window_s)
+        if not admitted:
+            self._m_refused.inc()
+            return 'refused'
+        if not plane.publish_blob(digest, blob):
+            return 'degraded'
+        self._m_bytes.inc(len(blob))
+        return 'published'
+
+    def _warm_piece(self, index, worker, capture):
+        """Decode + publish one piece (raw entry per partition, then the
+        wire-format sibling).  Returns 'done' | 'refused' | 'failed'."""
+        from petastorm_tpu.cache_plane.plane import encode_entry
+        from petastorm_tpu.materialize.transcode import (
+            verify_wire_identity, wire_entry, wire_key)
+        try:
+            items = self._decode_piece(index, worker, capture)
+        except Exception as e:  # noqa: BLE001 — a bad piece must not kill the job
+            logger.warning('materialize: decode of piece %d failed (%s: %s)',
+                           index, type(e).__name__, e)
+            return 'failed'
+        if self.throttle_s:
+            time.sleep(self.throttle_s)  # chaos kill window: decoded, unpublished
+        for digest, key, value in items:
+            try:
+                blob = encode_entry(value)
+            except Exception as e:  # noqa: BLE001 — unencodable: skip the piece
+                logger.warning('materialize: cannot encode piece %d (%s)',
+                               index, e)
+                return 'failed'
+            outcome = self._publish(digest, blob)
+            if outcome == 'refused':
+                return 'refused'
+            if outcome == 'degraded':
+                return 'failed'
+            # Wire-format sibling (ISSUE 18b): columnar pieces only;
+            # skipped entries are covered by the raw entry (degrade).
+            if self._wire_policy and self.identity.kind == 'columns' \
+                    and isinstance(value, dict) and value:
+                entry = wire_entry(value, self._wire_policy)
+                if entry is None \
+                        or not verify_wire_identity(value, entry,
+                                                    self._wire_policy):
+                    self._m_wire_skipped.inc()
+                    continue
+                wdigest = self.identity.plane.digest(
+                    wire_key(key, self._wire_policy))
+                try:
+                    wblob = encode_entry(entry)
+                except Exception:  # noqa: BLE001 — wire copy is optional
+                    self._m_wire_skipped.inc()
+                    continue
+                if self._publish(wdigest, wblob) == 'published':
+                    self._m_wire.inc()
+                else:
+                    self._m_wire_skipped.inc()
+        return 'done'
+
+    def _warm_loop(self, worker_id, deadline=None, max_pieces=None):
+        """Lease/warm/complete until dry, deadline, or max_pieces; the
+        shared engine under ``run`` and drain passes."""
+        worker, capture = self._make_worker()
+        warmed = failed = refused_count = 0
+        refused = set()
+        try:
+            while max_pieces is None or warmed + failed < max_pieces:
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                granted = self.lease(worker_id, 1, skip=refused)
+                if not granted:
+                    break
+                index = granted[0]
+                outcome = self._warm_piece(index, worker, capture)
+                if outcome == 'done':
+                    self.complete(worker_id, index)
+                    warmed += 1
+                elif outcome == 'refused':
+                    # Plane is hotter than this job: leave the piece
+                    # pending attempt-intact for a cooler run, skip it
+                    # for the rest of THIS pass.
+                    self.release(worker_id, index, burn_attempt=False)
+                    refused.add(index)
+                    refused_count += 1
+                else:
+                    self.fail(worker_id, index)
+                    failed += 1
+        finally:
+            try:
+                worker.shutdown()
+            except Exception:  # noqa: BLE001 — handle-cache teardown only
+                pass
+        return {'warmed': warmed, 'failed': failed,
+                'refused': refused_count}
+
+    def run(self, max_pieces=None, worker_id='controller'):
+        """Warm the whole dataset (or up to ``max_pieces``) in the
+        calling thread.  Returns the job summary; never raises for
+        per-piece failures."""
+        from petastorm_tpu import materialize
+        if materialize.killed():
+            return {'ok': False, 'reason': 'kill_switch'}
+        if self.identity is None:
+            return {'ok': False, 'reason': 'identity_unavailable'}
+        self._m_runs.inc()
+        t0 = time.monotonic()
+        pass_stats = self._warm_loop(worker_id, max_pieces=max_pieces)
+        self._save_ledger()
+        summary = self.summary()
+        summary.update(pass_stats)
+        summary['elapsed_s'] = round(time.monotonic() - t0, 3)
+        self.last_summary = summary
+        return summary
+
+    def summary(self):
+        with self._lock:
+            states = [rec[0] for rec in self._piece_state]
+        return {'ok': True,
+                'total_pieces': len(states),
+                'done': states.count(_DONE),
+                'pending': states.count(_PENDING),
+                'failed_pieces': states.count(_FAILED),
+                'resumed': self.resumed_pieces,
+                'wire_published': self._m_wire.value,
+                'admission_refused': self._m_refused.value,
+                'published_bytes': self._m_bytes.value}
+
+    # -- autoscaler hand-off (scale-in candidates warm before they drain) ----
+
+    def offer_drain_candidate(self, worker_id, deadline_s=30.0):
+        """A scale-in victim's capacity, offered for ONE bounded warming
+        pass before its drain proceeds.  Returns True when a pass was
+        started (or is already running) — the dispatcher then defers the
+        drain until :meth:`drain_ready`; False (no pending work, kill
+        switch, unsupported job) means drain immediately."""
+        from petastorm_tpu import materialize
+        if materialize.killed() or self.identity is None \
+                or not self.pending_count():
+            return False
+        with self._lock:
+            thread = self._drain_passes.get(worker_id)
+            if thread is not None and thread.is_alive():
+                return True
+            deadline = time.monotonic() + float(deadline_s)
+            thread = threading.Thread(
+                target=self._drain_pass, args=(worker_id, deadline),
+                daemon=True, name='materialize-drain-%s' % worker_id)
+            self._drain_passes[worker_id] = thread
+        thread.start()
+        return True
+
+    def drain_ready(self, worker_id):
+        """True when the worker's warming pass (if any) has finished —
+        the dispatcher's gate for proceeding with the deferred drain."""
+        thread = self._drain_passes.get(worker_id)
+        return thread is None or not thread.is_alive()
+
+    def _drain_pass(self, worker_id, deadline):
+        self._m_drain_passes.inc()
+        try:
+            self._warm_loop(worker_id, deadline=deadline)
+            self._save_ledger()
+        except Exception:  # noqa: BLE001 — a pass failure must still release the drain
+            logger.warning('materialize: drain warming pass for %s died',
+                           worker_id, exc_info=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self):
+        for thread in list(self._drain_passes.values()):
+            thread.join(timeout=5.0)
+        self._save_ledger()
+        if self._ledger is not None:
+            self._ledger.release()
+            self._ledger = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+
+
+def wire_digests(identity, index, policy='auto'):
+    """Full plane digests of one piece's wire-format entries (empty for
+    batch-kind jobs — wire siblings are columnar-only).  Mirrors
+    ``ClusterCacheIdentity.piece_digests`` for the ``:w{policy}``
+    namespace; the doctor's skip-stage probe reads through this."""
+    from petastorm_tpu.materialize.transcode import wire_key
+    if identity is None or identity.kind != 'columns':
+        return []
+    from petastorm_tpu.py_dict_reader_worker import piece_cache_key
+    return [identity.plane.digest(wire_key(
+                piece_cache_key(identity.pieces[index],
+                                identity.schema_view,
+                                identity.transform_spec, part) + ':c',
+                policy))
+            for part in range(identity.drop_partitions)]
+
+
+def derive_candidates(journals=None, top_k=4):
+    """Warming candidates from observed access patterns: dataset roots
+    named by sealed provenance records, ranked by how much cold decoding
+    consumers paid there (``cache`` outcome ``decode``/``degraded``),
+    with per-tenant attribution.  ``journals=None`` reads every live
+    journal in this process.
+
+    Returns ``[{'root', 'records', 'cold', 'pieces', 'tenants'}, ...]``
+    hottest-coldest first — the controller's admission queue; roots with
+    zero cold records are dropped (nothing to save there).
+    """
+    from petastorm_tpu.telemetry import provenance
+    if journals is None:
+        journals = provenance.journals()
+    by_root = {}
+    for journal in journals:
+        try:
+            records = journal.records()
+        except Exception:  # noqa: BLE001 — candidates are advisory
+            continue
+        for record in records:
+            if not isinstance(record, dict):
+                continue
+            roots = {os.path.dirname(str(piece.get('path')))
+                     for piece in (record.get('pieces') or [])
+                     if isinstance(piece, dict) and piece.get('path')}
+            cold = record.get('cache') in ('decode', 'degraded')
+            tenant = record.get('tenant')
+            for root in roots:
+                agg = by_root.setdefault(root, {
+                    'root': root, 'records': 0, 'cold': 0,
+                    'pieces': set(), 'tenants': {}})
+                agg['records'] += 1
+                agg['cold'] += int(cold)
+                agg['pieces'].update(
+                    (piece.get('path'), piece.get('row_group'))
+                    for piece in (record.get('pieces') or [])
+                    if isinstance(piece, dict)
+                    and os.path.dirname(str(piece.get('path'))) == root)
+                if tenant:
+                    agg['tenants'][tenant] = agg['tenants'].get(tenant,
+                                                                0) + 1
+    out = []
+    for agg in by_root.values():
+        if not agg['cold']:
+            continue
+        agg['pieces'] = len(agg['pieces'])
+        out.append(agg)
+    out.sort(key=lambda a: (-a['cold'], -a['records'], a['root']))
+    return out[:top_k]

@@ -1,0 +1,192 @@
+"""Layout rewrite job (ISSUE 18c): re-shard hot datasets to zero-waste.
+
+The ingest plane's coalesced range plans (PR 14) fetch ``waste_bytes``
+when a dataset's layout interleaves unselected columns between selected
+ones, or when row groups are sized against the split geometry — the
+planner's gap/waste stats measure exactly this.  ``rewrite_layout``
+streams a dataset through the reader/writer pair into a NEW dataset
+whose row groups match the requested geometry and whose files carry
+ONLY the selected columns (contiguous by construction — parquet lays a
+row group's column chunks back to back, so dropping the unselected ones
+removes the interleaving the merge-gap had to ride over).
+``layout_stats`` is the before/after evidence and the trigger signal:
+rewrite when waste_pct says the fleet is paying for bytes it never
+decodes.
+
+``write_rows`` is THE row sink — shared verbatim with
+``tools/pack_dataset.py`` — so offline CLI packing and fleet rewrite
+jobs produce byte-identical layouts (one code path, one test).
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+__all__ = ['write_rows', 'layout_stats', 'rewrite_layout']
+
+
+def write_rows(output_url, schema, rows, rows_per_rowgroup=None,
+               rowgroup_size_mb=None, rows_per_file=None,
+               storage_options=None, filesystem=None,
+               compression='snappy'):
+    """Stream ``rows`` (an iterable of row dicts) into a fresh dataset.
+
+    The single writer path for every offline materialization in the
+    repo (pack, rewrite, future pre-tokenize jobs): one
+    ``DatasetWriter`` configuration surface, so two jobs given the same
+    rows and geometry produce byte-identical layouts.  Returns the row
+    count written.
+    """
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    count = 0
+
+    def counted():
+        nonlocal count
+        for row in rows:
+            count += 1
+            yield row
+
+    kwargs = {}
+    if rows_per_rowgroup is not None:
+        kwargs['rows_per_rowgroup'] = rows_per_rowgroup
+    elif rowgroup_size_mb is not None:
+        kwargs['rowgroup_size_mb'] = rowgroup_size_mb
+    with DatasetWriter(output_url, schema, rows_per_file=rows_per_file,
+                       compression=compression,
+                       storage_options=storage_options,
+                       filesystem=filesystem, **kwargs) as writer:
+        writer.write_many(counted())
+    return count
+
+
+def layout_stats(dataset_url, columns=None, storage_options=None,
+                 filesystem=None, merge_gap=None, max_range_bytes=None):
+    """Gap/waste accounting of a dataset's CURRENT layout, as the ingest
+    plane would plan it: per row group, the raw column-chunk ranges of
+    the selected ``columns`` vs the coalesced GETs — summed dataset-wide
+    through :func:`ingest.planner.plan_stats` (the same arithmetic the
+    live plane's telemetry gauges run).
+
+    Returns ``{'files', 'row_groups', 'rows', 'needed_bytes',
+    'fetched_bytes', 'waste_bytes', 'waste_pct', 'requests',
+    'rows_per_row_group'}`` — the rewrite trigger signal and the
+    before/after evidence in one shape.
+    """
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.etl.dataset_metadata import load_row_groups
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    from petastorm_tpu.ingest import planner
+
+    merge_gap = planner.DEFAULT_MERGE_GAP if merge_gap is None \
+        else int(merge_gap)
+    max_range_bytes = planner.DEFAULT_MAX_RANGE_BYTES \
+        if max_range_bytes is None else int(max_range_bytes)
+    columns = set(columns) if columns is not None else None
+
+    fs, path_or_paths = get_filesystem_and_path_or_paths(
+        dataset_url, storage_options=storage_options, filesystem=filesystem)
+    paths = (path_or_paths if isinstance(path_or_paths, list)
+             else [path_or_paths])
+    files = []
+    for p in paths:
+        files.extend(sorted({piece.path for piece in load_row_groups(fs, p)}))
+
+    totals = {'files': 0, 'row_groups': 0, 'rows': 0, 'needed_bytes': 0,
+              'fetched_bytes': 0, 'waste_bytes': 0, 'requests': 0}
+    group_rows = []
+    for path in files:
+        handle = fs.open(path, 'rb')
+        try:
+            metadata = pq.ParquetFile(handle).metadata
+        finally:
+            try:
+                handle.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        totals['files'] += 1
+        for rg in range(metadata.num_row_groups):
+            raw = planner.column_chunk_ranges(metadata, rg, columns)
+            plan = planner.plan_stats(
+                raw, planner.coalesce(raw, merge_gap, max_range_bytes))
+            totals['row_groups'] += 1
+            rows = metadata.row_group(rg).num_rows
+            totals['rows'] += rows
+            group_rows.append(rows)
+            for key in ('needed_bytes', 'fetched_bytes', 'waste_bytes',
+                        'requests'):
+                totals[key] += plan[key]
+    totals['waste_pct'] = (
+        round(100.0 * totals['waste_bytes'] / totals['fetched_bytes'], 2)
+        if totals['fetched_bytes'] else 0.0)
+    totals['rows_per_row_group'] = {
+        'min': min(group_rows) if group_rows else 0,
+        'max': max(group_rows) if group_rows else 0,
+        'mean': (round(float(sum(group_rows)) / len(group_rows), 1)
+                 if group_rows else 0.0)}
+    return totals
+
+
+def rewrite_layout(source_url, output_url, rows_per_rowgroup,
+                   columns=None, predicate=None, overwrite=False,
+                   storage_options=None, reader_kwargs=None):
+    """Re-shard ``source_url`` into ``output_url`` with row groups of
+    ``rows_per_rowgroup`` rows, keeping only ``columns`` (None = all) —
+    the materialize plane's layout job.
+
+    Streams through the reader (decode identity preserved: codecs,
+    nullability, schema all ride the stored Unischema) and writes
+    through :func:`write_rows`, the sink ``tools/pack_dataset.py``
+    shares.  Returns a summary with before/after :func:`layout_stats`
+    over the SELECTED columns — ``after['waste_bytes']`` trending to
+    zero is the job's whole point.
+    """
+    from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.unischema import Unischema
+
+    fs, target_path = get_filesystem_and_path_or_paths(
+        output_url, storage_options=storage_options)
+    if fs.exists(target_path) and fs.ls(target_path):
+        if not overwrite:
+            raise ValueError('target %r exists; pass overwrite=True'
+                             % (output_url,))
+        fs.rm(target_path, recursive=True)
+
+    stored_schema = get_schema_from_dataset_url(
+        source_url, storage_options=storage_options)
+    if columns is not None:
+        schema = stored_schema.create_schema_view(list(columns))
+    else:
+        schema = stored_schema
+    schema = Unischema(stored_schema.name, list(schema.fields.values()))
+    selected = list(schema.fields)
+
+    before = layout_stats(source_url, columns=selected,
+                          storage_options=storage_options)
+
+    reader_kwargs = dict(reader_kwargs or {})
+    reader_kwargs.setdefault('shuffle_row_groups', False)
+    reader_kwargs.setdefault('num_epochs', 1)
+    reader_kwargs['schema_fields'] = selected
+    reader_kwargs['predicate'] = predicate
+    reader_kwargs['storage_options'] = storage_options
+    with make_reader(source_url, **reader_kwargs) as reader:
+        rows = write_rows(output_url, schema,
+                          (row._asdict() for row in reader),
+                          rows_per_rowgroup=int(rows_per_rowgroup),
+                          storage_options=storage_options)
+
+    after = layout_stats(output_url, columns=selected,
+                         storage_options=storage_options)
+    summary = {'rows': rows, 'rows_per_rowgroup': int(rows_per_rowgroup),
+               'columns': selected, 'output_url': output_url,
+               'before': before, 'after': after,
+               'waste_bytes_saved': before['waste_bytes']
+               - after['waste_bytes']}
+    logger.info('rewrite_layout: %d rows -> %s; waste %d -> %d bytes '
+                '(%.1f%% -> %.1f%%)', rows, output_url,
+                before['waste_bytes'], after['waste_bytes'],
+                before['waste_pct'], after['waste_pct'])
+    return summary

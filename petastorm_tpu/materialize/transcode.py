@@ -1,0 +1,122 @@
+"""Wire-format pre-transcode: publish entries already narrowed (ISSUE 18b).
+
+A raw materialized entry saves the decode; a consumer's transfer plane
+still collates and narrows it per the wire policy every epoch.  This
+module publishes a SECOND entry per piece — the stacked columns already
+cast to their wire dtypes via the public ``jax/transfer.py ::
+wire_dtype_for`` — under a distinct ``:w{policy}`` key suffix, so a
+wire-aware serve skips decode AND collate AND narrowing.
+
+The correctness contract is PR 17's: resident and streamed paths both
+deliver ``widen(narrow(rows))``, bit-identical, because bf16->f32 (and
+every exact wire) widens losslessly.  ``verify_wire_identity`` asserts
+exactly that at publish time — the host-side widen of the entry equals
+the jitted :class:`jax.residency.WirePlan` widen of the same narrow —
+so a wire entry can never drift from what the streamed path delivers.
+
+Wire entries are self-describing (policy token + per-column output
+dtypes ride the entry), so a serve needs no side channel to widen.
+Datasets whose columns fall outside the transfer plane's dtype support
+matrix, or that a policy leaves unnarrowed, publish no wire entry — the
+raw entry already covers them (degrade, never raise).
+"""
+
+import hashlib
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ['policy_token', 'wire_key', 'wire_entry', 'widen_entry',
+           'is_wire_entry', 'verify_wire_identity']
+
+#: Wire entries live beside raw entries in the same plane, distinguished
+#: by this key suffix (the plane digest then mixes in the policy too —
+#: two policies never collide).
+_WIRE_SUFFIX = ':w{%s}'
+
+
+def policy_token(policy):
+    """Stable string identity of a wire policy ('auto' or a per-field
+    dtype map) — part of the cache key, so policy changes re-publish."""
+    if not policy:
+        return 'none'
+    if isinstance(policy, str):
+        return policy
+    if isinstance(policy, dict):
+        body = ','.join('%s=%s' % (k, np.dtype(v).str)
+                        for k, v in sorted(policy.items()))
+        return hashlib.blake2b(body.encode('utf-8'),
+                               digest_size=6).hexdigest()
+    return hashlib.blake2b(repr(policy).encode('utf-8'),
+                           digest_size=6).hexdigest()
+
+
+def wire_key(cache_key, policy):
+    """The plane key of a piece's wire-format entry: the piece's raw
+    result-cache key (the reader workers' single-source-of-truth format)
+    plus the policy suffix."""
+    return cache_key + _WIRE_SUFFIX % policy_token(policy)
+
+
+def wire_entry(columns, policy='auto'):
+    """Build the wire-format entry value for one piece's stacked columns,
+    or None when the piece cannot ride (unsupported dtype, empty, or the
+    policy narrows nothing — a wire copy identical to the raw entry
+    would only burn plane capacity).
+
+    The value is a plain dict (pickled by ``encode_entry``): narrowed
+    columns + the output dtypes ``widen_entry`` needs to restore them.
+    """
+    from petastorm_tpu.jax.residency import wire_plan
+    if not isinstance(columns, dict) or not columns:
+        return None
+    if not all(isinstance(v, np.ndarray) for v in columns.values()):
+        return None
+    plan = wire_plan(columns, policy)
+    if plan is None or not plan.narrowed:
+        return None
+    return {'__wire__': 1,
+            'policy': policy_token(policy),
+            'columns': plan.narrow(columns),
+            'out': {name: f.out.str for name, f in plan.fields.items()}}
+
+
+def is_wire_entry(value):
+    return isinstance(value, dict) and value.get('__wire__') == 1
+
+
+def widen_entry(entry):
+    """Host-side inverse of the narrow: cast every column back to its
+    canonical output dtype (exact for bf16->f32 and all exact wires —
+    the delivered batch is bit-identical to the streamed path's
+    ``widen(narrow(rows))``)."""
+    return {name: np.asarray(col).astype(np.dtype(entry['out'][name]),
+                                         copy=False)
+            for name, col in entry['columns'].items()}
+
+
+def verify_wire_identity(columns, entry, policy='auto'):
+    """Assert the PR 17 contract on a freshly built wire entry: the
+    host widen of the entry is bit-identical to the jitted
+    ``WirePlan.widen`` of the same narrow (what the streamed transfer
+    plane delivers).  Returns True/False; never raises (a verify
+    failure refuses the publish, it must not kill the controller)."""
+    try:
+        import jax.numpy as jnp
+        from petastorm_tpu.jax.residency import wire_plan
+        plan = wire_plan(columns, policy)
+        if plan is None:
+            return False
+        host = widen_entry(entry)
+        device = plan.widen({name: jnp.asarray(col)
+                             for name, col in entry['columns'].items()})
+        for name in columns:
+            if not np.array_equal(host[name], np.asarray(device[name])):
+                return False
+        return True
+    except Exception:  # noqa: BLE001 — verify failure degrades, never raises
+        logger.warning('materialize: wire identity verify failed',
+                       exc_info=True)
+        return False

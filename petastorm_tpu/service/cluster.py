@@ -107,6 +107,14 @@ class ClusterCacheIdentity(object):  # ptlint: disable=pickle-unsafe-attrs — b
         self._converter = converter
         self._kind = kind  # 'columns' (codec reader) | 'batch' (arrow)
         self._drop_partitions = drop_partitions
+        #: Decode-identity inputs retained by ``_build`` for the
+        #: materialize plane (ISSUE 18): a warmer rebuilds the exact
+        #: reader-worker args from these without re-resolving the job.
+        self.fs = None
+        self.stored_schema = None
+        self.schema_view = None
+        self.transform_spec = None
+        self.predicate = None
 
     # -- construction --------------------------------------------------------
 
@@ -236,14 +244,38 @@ class ClusterCacheIdentity(object):  # ptlint: disable=pickle-unsafe-attrs — b
             from petastorm_tpu.arrow_reader_worker import \
                 ArrowResultConverter
             converter = ArrowResultConverter(result_schema)
-        return cls(plane, pieces, item_digests, converter, kind,
-                   drop_partitions)
+        identity = cls(plane, pieces, item_digests, converter, kind,
+                       drop_partitions)
+        identity.fs = fs
+        identity.stored_schema = stored_schema
+        identity.schema_view = schema_view
+        identity.transform_spec = transform_spec
+        identity.predicate = predicate
+        return identity
 
     # -- digest surface ------------------------------------------------------
 
     @property
     def num_pieces(self):
         return len(self._pieces)
+
+    @property
+    def pieces(self):
+        return self._pieces
+
+    @property
+    def kind(self):
+        return self._kind
+
+    @property
+    def drop_partitions(self):
+        return self._drop_partitions
+
+    def piece_digests(self, index):
+        """Full digests of one piece's work items (one per row-drop
+        partition) — the materialize plane publishes under exactly
+        these."""
+        return list(self._item_digests[int(index)])
 
     def piece_cdigests(self):
         """Compact digest per global piece index — the once-per-job

@@ -247,6 +247,13 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             if launcher is None:
                 launcher = _autoscaler.SubprocessWorkerLauncher()
             self.autoscaler = _autoscaler.Autoscaler(config, launcher)
+        # -- materialize hand-off (ISSUE 18) ---------------------------------
+        # When a controller is attached, scale-in victims are offered for
+        # one bounded warming pass before their drain proceeds: idle
+        # capacity warms datasets instead of dying.
+        self._materializer = None
+        self._deferred_drains = {}   # victim worker id -> drain deadline
+        self.materialize_handoffs = 0
         if getattr(config, 'ledger_path', None):
             from petastorm_tpu.service.ledger import DispatcherLedger
             # acquire() raises against a live owner BEFORE any state is
@@ -579,6 +586,10 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         merged['counters']['ledger_restores'] = self.ledger_restores
         merged['counters']['drains'] = self.drains
         merged['counters']['drain_timeouts'] = self.drain_timeouts
+        # Materialize hand-off (ISSUE 18): scale-in victims that ran a
+        # warming pass before draining.
+        merged['counters']['materialize_handoffs'] = \
+            self.materialize_handoffs
         # Multi-tenant serving tier (ISSUE 16): per-tenant grant
         # counters in the ring — their windowed deltas are the
         # tenant-starved evidence (one tenant's grants flat while
@@ -595,6 +606,40 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
 
     # -- closed-loop autoscaler (ISSUE 16) -----------------------------------
 
+    #: A scale-in victim offered to the materializer warms for at most
+    #: this long before its drain proceeds regardless (the hand-off must
+    #: never turn scale-in into scale-never).
+    DRAIN_WARM_DEADLINE_S = 30.0
+
+    def attach_materializer(self, controller):
+        """Attach a :class:`materialize.MaterializeController`: scale-in
+        victims get one bounded warming pass (piece-granular, through the
+        controller's lease protocol) before their drain is executed."""
+        self._materializer = controller
+
+    def _drain_worker(self, victim):
+        with self._lock:
+            worker = self._workers.get(victim)
+            if worker is not None:
+                worker['draining'] = True
+
+    def _tick_deferred_drains(self, now):
+        """Execute drains whose warming pass finished (or timed out)."""
+        materializer = self._materializer
+        for victim, deadline in list(self._deferred_drains.items()):
+            ready = now >= deadline
+            if not ready:
+                try:
+                    ready = materializer is None \
+                        or materializer.drain_ready(victim)
+                except Exception:  # noqa: BLE001 — hand-off is best-effort
+                    ready = True
+            if ready:
+                del self._deferred_drains[victim]
+                self._drain_worker(victim)
+                logger.info('autoscaler draining worker %s (warming pass '
+                            'done)', victim)
+
     def _autoscale_tick(self):
         """One control-law evaluation: observation built under the lock,
         the (blocking) spawn/drain action executed outside it by the
@@ -603,6 +648,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             return
         stale = 3.0 * self._config.lease_ttl_s
         now = time.monotonic()
+        self._tick_deferred_drains(now)
         with self._lock:
             states = collections.Counter(s.state for s in self._splits)
             pending, leased = states[_PENDING], states[_LEASED]
@@ -623,10 +669,25 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             'dispatcher_addr': self.addr}, now=now)
         if action and action[0] == 'scale_in':
             victim = action[1]
-            with self._lock:
-                worker = self._workers.get(victim)
-                if worker is not None:
-                    worker['draining'] = True
+            materializer = self._materializer
+            if materializer is not None \
+                    and victim not in self._deferred_drains:
+                offered = False
+                try:
+                    offered = materializer.offer_drain_candidate(
+                        victim, deadline_s=self.DRAIN_WARM_DEADLINE_S)
+                except Exception:  # noqa: BLE001 — hand-off is best-effort
+                    logger.warning('materialize drain hand-off for %s '
+                                   'failed', victim, exc_info=True)
+                if offered:
+                    self._deferred_drains[victim] = \
+                        now + self.DRAIN_WARM_DEADLINE_S
+                    self.materialize_handoffs += 1
+                    logger.info('autoscaler victim %s offered to the '
+                                'materializer for one warming pass before '
+                                'drain', victim)
+                    return
+            self._drain_worker(victim)
             logger.info('autoscaler draining worker %s (least cache '
                         'coverage)', victim)
 

@@ -99,8 +99,15 @@ class DispatcherLedger(object):
     incarnation's restore source; only the lock and sidecar go).
     """
 
-    def __init__(self, path):
+    def __init__(self, path, kind=LEDGER_KIND):
         self.path = str(path)
+        #: File-kind tag checked on load and stamped on save.  The
+        #: materialize controller (ISSUE 18) persists its piece-granular
+        #: job state through this exact snapshot+journal machinery under
+        #: ``kind='materialize_ledger'`` — distinct kinds keep a
+        #: dispatcher from adopting a materializer's file (and vice
+        #: versa) when both are misconfigured onto one path.
+        self.kind = str(kind)
         self._owner_fd = None
         self._journal_f = None
         #: Snapshots written (telemetry; the dispatcher surfaces it).
@@ -164,9 +171,9 @@ class DispatcherLedger(object):
             logger.warning('ledger %s unreadable (%s); cold start',
                            self.path, e)
             return None
-        if not isinstance(state, dict) or state.get('kind') != LEDGER_KIND:
+        if not isinstance(state, dict) or state.get('kind') != self.kind:
             logger.warning('ledger %s is not a %s file; cold start',
-                           self.path, LEDGER_KIND)
+                           self.path, self.kind)
             return None
         try:
             version = int(state.get('version', -1))
@@ -182,7 +189,7 @@ class DispatcherLedger(object):
         if version not in _COMPAT_VERSIONS:
             logger.warning('ledger %s is not a v%s %s file; cold start',
                            self.path,
-                           '/'.join(map(str, _COMPAT_VERSIONS)), LEDGER_KIND)
+                           '/'.join(map(str, _COMPAT_VERSIONS)), self.kind)
             return None
         splits = state.get('splits')
         for entry in self._replay_journal():
@@ -239,7 +246,7 @@ class DispatcherLedger(object):
         """Atomic snapshot write (tmp + replace; best-effort by the
         ``atomic_json_dump`` contract); a successful snapshot absorbs
         and truncates the journal.  Returns the path or None."""
-        state = dict(state, kind=LEDGER_KIND, version=LEDGER_VERSION)
+        state = dict(state, kind=self.kind, version=LEDGER_VERSION)
         path = atomic_json_dump(self.path, state)
         if path is not None:
             self.saves += 1

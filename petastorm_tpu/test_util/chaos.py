@@ -524,6 +524,17 @@ SCENARIOS = {
         'kills': [{'role': 'worker', 'phase': 'mid_epoch',
                    'signal': 'kill', 'restart': False}],
     },
+    # -- ISSUE 18: proactive materialization plane ---------------------------
+    'materialize_kill': {
+        'summary': 'SIGKILL the materialize controller + its warming '
+                   'worker mid-publish: no torn cache entries, the '
+                   'restarted controller resumes from the ledger '
+                   'attempt-intact, and a plane-cached consumer still '
+                   'delivers the ground-truth digest',
+        'runner': 'materialize',
+        'throttle_s': 0.25,
+        'min_entries_before_kill': 3,
+    },
 }
 
 #: The fast CI smoke: one kill, one drain, one message-fault class, and
@@ -553,6 +564,21 @@ spec = json.loads(sys.argv[2])
 with Dispatcher(ServiceConfig(**spec), bind=sys.argv[1]) as d:
     while d._thread.is_alive():
         time.sleep(0.2)
+"""
+
+_MATERIALIZE_CHILD = r"""
+import json, os, sys
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+sys.path.insert(0, sys.argv[2])
+from petastorm_tpu.materialize import MaterializeController
+spec = json.loads(sys.argv[1])
+summary_path = spec.pop('summary_path')
+with MaterializeController(**spec) as controller:
+    summary = controller.run()
+tmp = summary_path + '.part'
+with open(tmp, 'w') as f:
+    json.dump(summary, f)
+os.replace(tmp, summary_path)
 """
 
 
@@ -650,6 +676,167 @@ def _phase_reached(stats, phase, n_workers):
     raise ValueError('unknown phase %r (known: %s)' % (phase, PHASES))
 
 
+def _run_materialize_scenario(name, dataset_url, rows, workdir, seed=7,
+                              expected_digest=None, timeout_s=240.0):
+    """The ISSUE 18 crash drill: SIGKILL the materialize controller (a
+    single process that is both scheduler and warming worker) while
+    publishes are in flight, then assert the three invariants —
+    (1) zero torn ``.cpe`` entries (publish is tmp+rename atomic),
+    (2) the ledger carries the progress and a restarted controller
+    resumes it instead of re-warming, (3) a consumer reading through
+    the half-then-fully warmed plane delivers the ground-truth digest
+    with zero decode misses.  Same contract as :func:`run_scenario`:
+    returns a report, never raises."""
+    import signal as _signal
+
+    import numpy as np
+
+    from petastorm_tpu.cache_plane.plane import ENTRY_SUFFIX, decode_entry
+    from petastorm_tpu.materialize import MATERIALIZE_LEDGER_KIND
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.service.ledger import DispatcherLedger, decode_splits
+
+    scenario = SCENARIOS[name]
+    report = {'scenario': name, 'seed': int(seed), 'ok': False,
+              'checks': {}, 'injections': {}}
+    plane_dir = os.path.join(workdir, 'mat_plane_%s' % name)
+    ledger_path = os.path.join(workdir, 'mat_ledger_%s.json' % name)
+    summary_path = os.path.join(workdir, 'mat_summary_%s.json' % name)
+    # Disk-only plane (ram tier off): every publish is an inspectable
+    # ``.cpe`` file, and the drill leaves nothing in /dev/shm.
+    spec = {'dataset_url': dataset_url, 'cache_plane_dir': plane_dir,
+            'ledger_path': ledger_path, 'cache_plane_ram_bytes': 0,
+            'throttle_s': float(scenario.get('throttle_s', 0.25)),
+            'summary_path': summary_path}
+    shm_before = _shm_residue()
+    proc = None
+
+    def _entries():
+        try:
+            return sorted(n for n in os.listdir(plane_dir)
+                          if n.endswith(ENTRY_SUFFIX))
+        except OSError:
+            return []
+
+    try:
+        # -- phase 1: warm under throttle, SIGKILL mid-publish ---------------
+        proc = _spawn(_MATERIALIZE_CHILD,
+                      [json.dumps(spec), _repo_root()])
+        deadline = time.monotonic() + timeout_s
+        want = int(scenario.get('min_entries_before_kill', 3))
+        while len(_entries()) < want:
+            if proc.poll() is not None or time.monotonic() > deadline:
+                report['checks']['kill_controller'] = (
+                    'controller finished (%d entr(ies)) before the kill '
+                    'window' % len(_entries()))
+                return report
+            time.sleep(0.02)
+        proc.send_signal(_signal.SIGKILL)
+        proc.wait(timeout=30)
+        report['checks']['kill_controller'] = (
+            'SIGKILL pid %d with %d entr(ies) published'
+            % (proc.pid, len(_entries())))
+
+        # -- invariant 1: zero torn entries ----------------------------------
+        torn = []
+        for entry_name in _entries():
+            try:
+                with open(os.path.join(plane_dir, entry_name), 'rb') as f:
+                    decode_entry(f.read())
+            except Exception as e:  # noqa: BLE001 — any failure IS the finding
+                torn.append('%s: %r' % (entry_name, e))
+        report['checks']['zero_torn_entries'] = (
+            'ok (%d entr(ies) decode cleanly)' % len(_entries())
+            if not torn else '; '.join(torn[:4]))
+
+        # -- invariant 2a: the kill left durable progress in the ledger ------
+        state = DispatcherLedger(ledger_path,
+                                 kind=MATERIALIZE_LEDGER_KIND).load()
+        done_before = 0
+        if state and isinstance(state.get('splits'), list):
+            try:
+                done_before = sum(
+                    1 for st, _ in decode_splits(state['splits'])
+                    if st == 'done')
+            except (ValueError, KeyError, TypeError):
+                pass
+        report['checks']['ledger_progress'] = (
+            'ok (%d piece(s) durably done)' % done_before
+            if done_before >= 1 else
+            'ledger shows no completed piece after the kill')
+
+        # -- invariant 2b: restart resumes instead of re-warming -------------
+        proc = _spawn(_MATERIALIZE_CHILD,
+                      [json.dumps(dict(spec, throttle_s=0.0)),
+                       _repo_root()])
+        proc.wait(timeout=max(1.0, deadline - time.monotonic()))
+        try:
+            with open(summary_path) as f:
+                summary = json.load(f)
+        except (OSError, ValueError) as e:
+            report['checks']['resume'] = 'no restart summary: %r' % e
+            return report
+        resumed = int(summary.get('resumed', 0) or 0)
+        resumed_ok = (resumed >= max(1, done_before)
+                      and summary.get('done') == summary.get('total_pieces')
+                      and not summary.get('failed_pieces'))
+        report['checks']['resume'] = (
+            'ok (resumed %d from the ledger, warmed the remaining %d of %d)'
+            % (resumed, int(summary.get('done', 0)) - resumed,
+               summary.get('total_pieces', 0)) if resumed_ok
+            else 'summary %r' % summary)
+
+        # -- invariant 3: consumer delivery digest + zero decode misses ------
+        if expected_digest is None:
+            expected_digest = direct_read_digest(dataset_url)
+        digest = DeliveryDigest()
+        with make_batch_reader(
+                dataset_url, num_epochs=1, shuffle_row_groups=False,
+                workers_count=1, cache_type='plane',
+                cache_location=plane_dir,
+                cache_extra_settings={'ram_bytes': 0}) as reader:
+            for item in reader:
+                digest.update({k: np.asarray(v)
+                               for k, v in item._asdict().items()})
+            diag = reader.diagnostics
+        digest_ok = digest.hexdigest() == expected_digest
+        report['checks']['digest'] = (
+            'ok' if digest_ok else
+            '%s != expected %s' % (digest.hexdigest(), expected_digest))
+        report['digest'] = digest.hexdigest()
+        misses = int(diag.get('cache_misses', -1))
+        served_warm = misses == 0 and int(diag.get('cache_hits', 0)) >= 1
+        report['checks']['served_from_plane'] = (
+            'ok (%d hit(s), 0 misses)' % int(diag.get('cache_hits', 0))
+            if served_warm else
+            'consumer decoded: hits=%s misses=%s'
+            % (diag.get('cache_hits'), diag.get('cache_misses')))
+        report['ok'] = bool(not torn and done_before >= 1 and resumed_ok
+                            and digest_ok and served_warm)
+        return report
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=20)
+            except Exception:  # noqa: BLE001 — never hang the matrix
+                pass
+        shm_left = _shm_residue() - shm_before
+        tmp_left = _ledger_tmp_residue(ledger_path)
+        # Publish residue (a SIGKILL mid-write leaves ``.tmp.<pid>.*``
+        # next to the entries) must be swept by the restart, not linger.
+        plane_tmp = [n for n in (os.listdir(plane_dir)
+                                 if os.path.isdir(plane_dir) else [])
+                     if n.startswith('.tmp.')]
+        report['checks']['zero_residue'] = (
+            'ok' if not shm_left and not tmp_left and not plane_tmp else
+            'shm=%s tmp=%s plane_tmp=%s'
+            % (sorted(shm_left)[:4], tmp_left[:4], plane_tmp[:4]))
+        if report.get('ok'):
+            report['ok'] = (not shm_left and not tmp_left
+                            and not plane_tmp)
+
+
 def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
                  expected_digest=None, timeout_s=240.0):
     """One scenario end to end; returns a report dict (``ok`` plus the
@@ -665,6 +852,13 @@ def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
     from petastorm_tpu.workers_pool import shm_plane
 
     scenario = SCENARIOS[name]
+    if scenario.get('runner') == 'materialize':
+        # The materialization drill runs no service fleet: one
+        # controller process, killed and restarted, then a direct
+        # plane-cached consumer read.
+        return _run_materialize_scenario(
+            name, dataset_url, rows, workdir, seed=seed,
+            expected_digest=expected_digest, timeout_s=timeout_s)
     n_workers = int(scenario.get('n_workers', n_workers))
     spec = {'seed': int(seed), 'faults': scenario.get('faults') or []}
     ledger_path = os.path.join(workdir, 'ledger_%s.json' % name)

@@ -476,8 +476,90 @@ def _check_ingest():
                 for i, got in enumerate(fetched)))
             out['hedge'] = plane.hedge_state()
             out['degraded'] = plane.stats['ingest_degraded']
+            out['plan_waste_pct'] = plane.stats['ingest_plan_waste_pct']
         finally:
             plane.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def _check_materialize():
+    """Materialization round trip (ISSUE 18): one real piece through
+    materialize -> wire-format publish -> readerless remote-hit serve on
+    this host, reporting the achieved skip stages — ``skip_decode`` (the
+    serve came straight off the plane, no reader, no Parquet open),
+    ``skip_collate`` (the entry is already stacked columns), and
+    ``skip_narrow`` (a wire-format sibling exists whose host widen
+    matches the jitted contract)."""
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from petastorm_tpu import materialize as mat
+    from petastorm_tpu.cache_plane.plane import MISS
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
+    from petastorm_tpu.materialize.controller import wire_digests
+    from petastorm_tpu.materialize.transcode import (is_wire_entry,
+                                                     widen_entry)
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    out = {'kill_switch': mat.killed()}
+    if out['kill_switch']:
+        out['note'] = ('PETASTORM_TPU_NO_MATERIALIZE=1: warming, wire '
+                       'transcode, and layout rewrite all disabled on '
+                       'this host')
+        return out
+
+    root = tempfile.mkdtemp(prefix='pstpu-doctor-materialize-')
+    try:
+        schema = Unischema('DoctorMat', [
+            UnischemaField('id', np.int64, (), ScalarCodec('int64'), False),
+            UnischemaField('vec', np.float32, (16,), NdarrayCodec(), False),
+        ])
+        url = 'file://' + os.path.join(root, 'ds')
+        with DatasetWriter(url, schema, rows_per_rowgroup=4) as writer:
+            for i in range(8):
+                writer.write({'id': i,
+                              'vec': np.full(16, i, dtype=np.float32)})
+        controller = mat.MaterializeController(
+            url, os.path.join(root, 'plane'),
+            ledger_path=os.path.join(root, 'ledger.json'))
+        try:
+            summary = controller.run()
+            out['warmed_pieces'] = summary.get('done', 0)
+            out['wire_published'] = summary.get('wire_published', 0)
+            out['admission_refused'] = summary.get('admission_refused', 0)
+            identity = controller.identity
+            # Readerless remote-HIT serve: ALL lookups off the plane.
+            chunks = identity.serve_chunks(range(identity.num_pieces))
+            served = (sorted(int(v) for chunk in chunks
+                             for v in np.atleast_1d(chunk['id']))
+                      if chunks is not None else None)
+            out['skip_decode'] = served == list(range(8))
+            out['skip_collate'] = bool(chunks) and all(
+                isinstance(chunk['vec'], np.ndarray)
+                and chunk['vec'].ndim == 2 for chunk in chunks)
+            wire = identity.plane.lookup_digest(
+                wire_digests(identity, 0)[0]) \
+                if wire_digests(identity, 0) else MISS
+            out['skip_narrow'] = False
+            if wire is not MISS and is_wire_entry(wire):
+                widened = widen_entry(wire)
+                raw = identity.plane.lookup_digest(
+                    identity.piece_digests(0)[0])
+                out['skip_narrow'] = bool(
+                    raw is not MISS and np.array_equal(
+                        widened['vec'],
+                        raw['vec'].astype(widened['vec'].dtype)))
+            out['roundtrip_ok'] = bool(out['skip_decode']
+                                       and out['skip_collate']
+                                       and out['skip_narrow'])
+        finally:
+            controller.close()
     finally:
         shutil.rmtree(root, ignore_errors=True)
     return out
@@ -699,6 +781,7 @@ def run_doctor(dataset_url=None, probe_timeout_s=60, sample_seconds=5.0,
     _contained(report, 'autoscaler', _check_autoscaler)
     _contained(report, 'telemetry', _check_telemetry)
     _contained(report, 'ingest', _check_ingest)
+    _contained(report, 'materialize', _check_materialize)
     if dataset_url:
         advisor = {}
         _contained(report, 'host_plane',

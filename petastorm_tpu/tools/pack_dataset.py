@@ -46,8 +46,8 @@ def pack_dataset(source_url, output_url, field, max_len, pad_id=0,
     """
     from petastorm_tpu import make_reader
     from petastorm_tpu.codecs import NdarrayCodec
-    from petastorm_tpu.etl.dataset_metadata import DatasetWriter
     from petastorm_tpu.jax.packing import pack_stream
+    from petastorm_tpu.materialize.rewrite import write_rows
     from petastorm_tpu.unischema import Unischema, UnischemaField
 
     reader_kwargs = dict(reader_kwargs or {})
@@ -102,12 +102,18 @@ def pack_dataset(source_url, output_url, field, max_len, pad_id=0,
                            batch['positions'][i].astype(np.int32,
                                                         copy=False)}
 
-        with DatasetWriter(output_url, schema,
-                           rows_per_rowgroup=rows_per_rowgroup
-                           or rows_per_batch) as writer:
-            writer.write_many(emit(first))
+        def packed_rows():
+            for row in emit(first):
+                yield row
             for batch in batches:
-                writer.write_many(emit(batch))
+                for row in emit(batch):
+                    yield row
+
+        # The materialize plane's shared row sink (ISSUE 18): offline
+        # CLI packing and fleet rewrite jobs write byte-identical
+        # layouts through one code path.
+        write_rows(output_url, schema, packed_rows(),
+                   rows_per_rowgroup=rows_per_rowgroup or rows_per_batch)
 
     tokens_out = stats['rows_out'] * max_len
     stats.update({

@@ -1,0 +1,554 @@
+"""Proactive materialization plane (ISSUE 18).
+
+Covers the tentpole surfaces — warm-then-serve with ZERO consumer
+decodes, durable lease/ledger progress (attempt-intact resume),
+eviction-aware admission against the cache plane's estimator, the
+wire-format pre-transcode contract, the layout-rewrite job — plus the
+satellite seams: the shared ``write_rows`` sink under
+``tools/pack_dataset.py``, the ingest planner's gap/waste telemetry,
+provenance-derived warming candidates, the dispatcher's scale-in
+warming hand-off, the kill switch, and the ``materialize_kill`` chaos
+scenario end to end.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.materialize import (MATERIALIZE_LEDGER_KIND,
+                                       MaterializeController, rewrite_layout)
+from petastorm_tpu.materialize.controller import derive_candidates
+from petastorm_tpu.materialize.rewrite import layout_stats
+from petastorm_tpu.materialize.transcode import (is_wire_entry, policy_token,
+                                                 verify_wire_identity,
+                                                 widen_entry, wire_entry,
+                                                 wire_key)
+
+from test_common import create_test_dataset
+
+ROWS = 24
+ROWS_PER_GROUP = 4      # -> 6 pieces
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('matds')
+    return create_test_dataset('file://' + str(path), num_rows=ROWS,
+                               rows_per_rowgroup=ROWS_PER_GROUP)
+
+
+def _read_columns(url, plane_dir=None, schema_fields=None):
+    """One first epoch through the consumer decode path
+    (``columnar_decode=True`` readers share the controller's piece cache
+    keys); returns (column dict keyed by id, plane diagnostics)."""
+    from petastorm_tpu import make_reader
+    kwargs = {}
+    if plane_dir is not None:
+        kwargs.update(cache_type='plane', cache_location=plane_dir)
+    if schema_fields is not None:
+        kwargs['schema_fields'] = schema_fields
+    cols = {}
+    with make_reader(url, num_epochs=1, shuffle_row_groups=False,
+                     workers_count=2, columnar_decode=True,
+                     **kwargs) as reader:
+        for batch in reader:
+            d = batch._asdict()
+            for i, row_id in enumerate(np.asarray(d['id'])):
+                cols[int(row_id)] = {k: np.asarray(v)[i]
+                                     for k, v in d.items()}
+        diag = dict(reader.diagnostics)
+    return cols, diag
+
+
+# -- tentpole: warm once, first epoch serves with zero decodes ---------------
+
+def test_warm_first_epoch_serves_without_decodes(dataset, tmp_path):
+    plane = str(tmp_path / 'plane')
+    with MaterializeController(dataset.url, plane) as controller:
+        summary = controller.run()
+    assert summary['ok'], summary
+    assert summary['total_pieces'] == ROWS // ROWS_PER_GROUP
+    assert summary['done'] == summary['total_pieces']
+    assert not summary['failed_pieces']
+    assert summary['published_bytes'] > 0
+
+    served, diag = _read_columns(dataset.url, plane_dir=plane)
+    # The whole first epoch rode the warmed plane: no consumer decode.
+    assert diag.get('cache_misses') == 0, diag
+    assert diag.get('cache_hits') >= summary['total_pieces'], diag
+
+    # Warming changes WHEN rows decode, never WHAT is delivered.
+    truth, _ = _read_columns(dataset.url)
+    assert sorted(served) == sorted(truth) == list(range(ROWS))
+    for row_id in truth:
+        for field in ('matrix', 'embedding', 'image_png'):
+            np.testing.assert_array_equal(served[row_id][field],
+                                          truth[row_id][field])
+
+
+def test_ledger_resume_is_attempt_intact(dataset, tmp_path):
+    plane = str(tmp_path / 'plane')
+    ledger = str(tmp_path / 'ledger.json')
+    with MaterializeController(dataset.url, plane,
+                               ledger_path=ledger) as controller:
+        first = controller.run(max_pieces=2)
+    assert first['done'] == 2 and first['pending'] == 4
+
+    # A restarted controller restores done pieces from the ledger —
+    # never re-decoded — and finishes only the remainder.
+    with MaterializeController(dataset.url, plane,
+                               ledger_path=ledger) as controller:
+        assert controller.resumed_pieces == 2
+        second = controller.run()
+    assert second['resumed'] == 2
+    assert second['done'] == second['total_pieces']
+    assert second['warmed'] == second['total_pieces'] - 2
+    assert not second['failed_pieces']
+
+
+def test_foreign_ledger_cold_starts(dataset, tmp_path):
+    """A ledger written under a different identity/geometry must cold
+    start, never lie about progress."""
+    from petastorm_tpu.service.ledger import DispatcherLedger
+    ledger = str(tmp_path / 'ledger.json')
+    foreign = DispatcherLedger(ledger, kind=MATERIALIZE_LEDGER_KIND)
+    assert foreign.acquire()
+    foreign.save({'context': 'not-this-dataset',
+                  'dataset_url': 'file:///elsewhere',
+                  'splits': [[2, 1]] * 99})
+    foreign.release()
+    with MaterializeController(dataset.url, str(tmp_path / 'plane'),
+                               ledger_path=ledger) as controller:
+        assert controller.resumed_pieces == 0
+        assert controller.run()['done'] == ROWS // ROWS_PER_GROUP
+
+
+def test_kill_switch_disables_every_entry_point(dataset, tmp_path,
+                                                monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_NO_MATERIALIZE', '1')
+    with MaterializeController(dataset.url,
+                               str(tmp_path / 'plane')) as controller:
+        assert controller.run() == {'ok': False, 'reason': 'kill_switch'}
+        assert controller.lease('w0', n=6) == []
+        assert controller.offer_drain_candidate('w0') is False
+    assert not list((tmp_path / 'plane').glob('*.cpe'))
+
+
+# -- lease protocol ----------------------------------------------------------
+
+def test_lease_expiry_requeues_and_ceiling_poisons(dataset, tmp_path):
+    with MaterializeController(dataset.url, str(tmp_path / 'plane'),
+                               lease_ttl_s=0.05,
+                               max_piece_attempts=2) as controller:
+        total = controller.summary()['total_pieces']
+        g1 = controller.lease('w1', n=2)
+        g2 = controller.lease('w2', n=total)
+        assert len(g1) == 2 and len(g2) == total - 2
+        assert set(g1).isdisjoint(g2)     # a leased piece never double-grants
+        assert controller.lease('w3', n=total) == []
+
+        time.sleep(0.1)                   # every lease expires -> requeue
+        g3 = controller.lease('w3', n=total)
+        assert sorted(g3) == list(range(total))   # attempt 2, last grant
+        for index in g3:
+            controller.release('w3', index)       # burn: crashing pieces
+        # Attempt ceiling reached: pieces poison to failed, not re-grant.
+        assert controller.lease('w4', n=total) == []
+        assert controller.summary()['failed_pieces'] == total
+
+
+def test_release_without_burn_refunds_the_attempt(dataset, tmp_path):
+    with MaterializeController(dataset.url,
+                               str(tmp_path / 'plane')) as controller:
+        (index,) = controller.lease('w1', n=1)
+        assert controller._piece_state[index][1] == 1
+        controller.release('w1', index, burn_attempt=False)
+        assert controller._piece_state[index][1] == 0
+        assert controller.summary()['pending'] == \
+            controller.summary()['total_pieces']
+
+
+# -- eviction-aware admission ------------------------------------------------
+
+def test_admit_publish_refuses_hot_victims(tmp_path):
+    from petastorm_tpu.cache_plane.plane import CachePlane
+    plane = CachePlane(str(tmp_path / 'plane'), disk_capacity_bytes=8192,
+                       ram_capacity_bytes=0)
+    assert plane.publish_blob(plane.digest('resident'), b'x' * 2048)
+
+    est = plane.disk.eviction_estimate(1024)
+    assert est['fits'] and est['victims'] == 0 and est['total_bytes'] == 2048
+    est = plane.disk.eviction_estimate(16384)
+    assert not est['fits'] and est['victims'] == 1
+    assert est['victim_bytes'] == 2048
+    assert est['victim_newest_age_s'] is not None
+
+    admitted, est = plane.admit_publish(1024)
+    assert admitted and est['fits']           # fits without eviction
+    admitted, _ = plane.admit_publish(16384, hot_window_s=300.0)
+    assert not admitted                       # victim accessed just now
+    admitted, _ = plane.admit_publish(16384, hot_window_s=0.0)
+    assert admitted                           # zero window: nothing is hot
+
+    # Age the resident past the hot window: now it is fair game.
+    (entry,) = [os.path.join(plane.disk.root, n)
+                for n in os.listdir(plane.disk.root) if n.endswith('.cpe')]
+    old = time.time() - 1000.0
+    os.utime(entry, (old, old))
+    admitted, est = plane.admit_publish(16384, hot_window_s=300.0)
+    assert admitted and est['victim_newest_age_s'] >= 300.0
+
+
+def test_controller_admission_refusal_is_attempt_intact(dataset, tmp_path):
+    """Warming never evicts entries hotter than what it publishes: with
+    a hot resident filling a tiny plane, every piece is refused, released
+    attempt-intact, and retried on a later (cooler) run."""
+    plane_dir = str(tmp_path / 'plane')
+    # Capacity fits the hot resident exactly (the tier refuses stores
+    # past capacity - 4096), so every ~8 KiB piece entry needs eviction.
+    with MaterializeController(dataset.url, plane_dir,
+                               cache_plane_disk_bytes=28672) as controller:
+        plane = controller.identity.plane
+        assert plane.publish_blob(plane.digest('hot-resident'), b'x' * 24576)
+        summary = controller.run()
+        assert summary['done'] == 0
+        assert summary['admission_refused'] >= 1
+        assert summary['pending'] == summary['total_pieces']
+        assert not summary['failed_pieces']
+        assert all(rec[1] == 0 for rec in controller._piece_state)
+        # The hot resident survived the whole pass untouched.
+        assert plane.has_digest(plane.digest('hot-resident'))
+
+
+# -- wire-format pre-transcode (ISSUE 18b) -----------------------------------
+
+def test_wire_entry_roundtrip_and_identity():
+    cols = {'x': np.arange(12, dtype=np.float32).reshape(3, 4),
+            'i': np.arange(3, dtype=np.int64)}
+    entry = wire_entry(cols)
+    assert is_wire_entry(entry)
+    assert entry['policy'] == policy_token('auto')
+    widened = widen_entry(entry)
+    assert widened['x'].dtype == np.float32
+    np.testing.assert_array_equal(widened['x'], cols['x'])
+    # The PR 17 contract: host widen == jitted widen of the same narrow.
+    assert verify_wire_identity(cols, entry)
+
+
+def test_wire_entry_degrades_to_none():
+    # Narrowing nothing: a wire copy identical to the raw entry would
+    # only burn plane capacity.
+    assert wire_entry({'u': np.zeros(4, np.uint8),
+                       'i': np.arange(4, dtype=np.int32)}) is None
+    assert wire_entry({}) is None
+    assert wire_entry([1, 2]) is None
+    assert wire_entry({'s': np.array(['a', 'b'], dtype=object)}) is None
+    assert not is_wire_entry({'columns': {}})
+
+
+def test_wire_key_and_policy_token_stability():
+    assert wire_key('piece:0', 'auto') == 'piece:0:w{auto}'
+    tok = policy_token({'x': 'float16', 'y': np.float32})
+    assert tok == policy_token({'y': np.float32, 'x': 'float16'})
+    assert policy_token(None) == 'none'
+
+
+def test_controller_publishes_wire_siblings_for_numeric_views(dataset,
+                                                              tmp_path):
+    """A float-bearing schema view gets a second, already-narrowed entry
+    per piece; the widened sibling matches the raw entry exactly."""
+    from petastorm_tpu.cache_plane.plane import MISS
+    from petastorm_tpu.materialize.controller import wire_digests
+    fields = ['id', 'matrix', 'embedding']
+    with MaterializeController(
+            dataset.url, str(tmp_path / 'plane'),
+            reader_kwargs={'schema_fields': fields}) as controller:
+        summary = controller.run()
+        assert summary['done'] == summary['total_pieces']
+        assert summary['wire_published'] == summary['total_pieces']
+        identity = controller.identity
+        for index in range(identity.num_pieces):
+            (wire_digest,) = wire_digests(identity, index)
+            wire = identity.plane.lookup_digest(wire_digest)
+            raw = identity.plane.lookup_digest(
+                identity.piece_digests(index)[0])
+            assert wire is not MISS and is_wire_entry(wire)
+            widened = widen_entry(wire)
+            for name in ('matrix', 'embedding'):
+                narrow_dtype = wire['columns'][name].dtype
+                assert narrow_dtype != raw[name].dtype  # actually narrowed
+                # The sibling IS narrow(raw), and widen restores the
+                # canonical output dtype (bf16 is lossy; the contract is
+                # widen(narrow(rows)) on BOTH paths, not raw identity).
+                np.testing.assert_array_equal(
+                    wire['columns'][name], raw[name].astype(narrow_dtype))
+                np.testing.assert_array_equal(
+                    widened[name],
+                    raw[name].astype(narrow_dtype)
+                    .astype(widened[name].dtype))
+
+
+def test_full_schema_skips_wire_sibling(dataset, tmp_path):
+    """String columns can't ride the wire: the raw entry covers the
+    serve and the skip is counted, never an error."""
+    with MaterializeController(dataset.url,
+                               str(tmp_path / 'plane')) as controller:
+        summary = controller.run()
+    assert summary['done'] == summary['total_pieces']
+    assert summary['wire_published'] == 0
+
+
+# -- layout rewrite (ISSUE 18c) + shared pack sink ---------------------------
+
+def test_rewrite_layout_drives_waste_down_and_preserves_rows(dataset,
+                                                             tmp_path):
+    # 'id' and 'matrix' are separated by unselected columns ('id2' and
+    # the PNG images — parquet chunks follow the Unischema's sorted
+    # field order): the planner's merge gap rides over them -> waste.
+    columns = ('id', 'matrix')
+    out_url = 'file://' + str(tmp_path / 'resharded')
+    summary = rewrite_layout(dataset.url, out_url, rows_per_rowgroup=8,
+                             columns=columns)
+    assert summary['rows'] == ROWS
+    assert summary['before']['waste_bytes'] > 0
+    assert summary['after']['waste_bytes'] < summary['before']['waste_bytes']
+    assert summary['waste_bytes_saved'] > 0
+    assert summary['after']['rows_per_row_group']['max'] <= 8
+    # Offline stats and the summary are the same arithmetic.
+    assert layout_stats(out_url, columns=list(columns)) == summary['after']
+
+    # The rewrite changed layout, never data.
+    from petastorm_tpu import make_reader
+    with make_reader(out_url, num_epochs=1, shuffle_row_groups=False) as r:
+        got = {int(row.id): np.asarray(row.matrix) for row in r}
+    assert sorted(got) == list(range(ROWS))
+    for row in dataset.data:
+        np.testing.assert_array_equal(got[int(row['id'])], row['matrix'])
+
+    with pytest.raises(ValueError, match='overwrite'):
+        rewrite_layout(dataset.url, out_url, rows_per_rowgroup=8,
+                       columns=columns)
+
+
+def test_pack_dataset_writes_through_the_shared_sink(tmp_path, monkeypatch):
+    """tools/pack_dataset.py and rewrite_layout share ONE writer path
+    (``materialize.rewrite.write_rows``) — byte-identical layout logic,
+    one configuration surface."""
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.etl.dataset_metadata import write_dataset
+    from petastorm_tpu.materialize import rewrite
+    from petastorm_tpu.tools.pack_dataset import pack_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    src = 'file://' + str(tmp_path / 'docs')
+    schema = Unischema('Docs', [
+        UnischemaField('tokens', np.int32, (None,), NdarrayCodec(), False)])
+    rng = np.random.default_rng(5)
+    write_dataset(schema, [{'tokens': rng.integers(1, 90, 7)
+                            .astype(np.int32)} for _ in range(12)],
+                  src, rows_per_rowgroup=4)
+
+    calls = []
+    real_write_rows = rewrite.write_rows
+
+    def spy(*args, **kwargs):
+        calls.append((args, kwargs))
+        return real_write_rows(*args, **kwargs)
+
+    monkeypatch.setattr(rewrite, 'write_rows', spy)
+    stats = pack_dataset(src, 'file://' + str(tmp_path / 'packed'),
+                         field='tokens', max_len=16, rows_per_batch=4)
+    assert len(calls) == 1
+    assert stats['sequences_in'] == 12
+
+
+# -- ingest planner gap/waste telemetry (satellite 2) ------------------------
+
+def test_plan_stats_arithmetic():
+    from petastorm_tpu.ingest.planner import plan_stats
+    stats = plan_stats([(0, 10), (100, 10)], [(0, 110)])
+    assert stats == {'needed_bytes': 20, 'fetched_bytes': 110,
+                     'waste_bytes': 90, 'requests': 1, 'waste_pct': 81.82}
+    assert plan_stats([], [])['waste_pct'] == 0.0
+    # Coalescing can never report negative waste.
+    assert plan_stats([(0, 10)], [(0, 10)])['waste_bytes'] == 0
+
+
+def test_ingest_plane_registers_plan_waste_telemetry(tmp_path):
+    import fsspec
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from types import SimpleNamespace
+
+    from petastorm_tpu.ingest import IngestPlane
+
+    # Incompressible payload so the file outgrows the footer tail and a
+    # real ranged fetch (with a real plan) happens; the unselected
+    # 'label' chunk between 'idx' and 'payload' is the merge-gap waste.
+    path = str(tmp_path / 'probe.parquet')
+    rng = np.random.default_rng(0)
+    pq.write_table(pa.table({
+        'idx': pa.array(np.arange(64, dtype=np.int64)),
+        'label': pa.array(np.arange(64, dtype=np.int32)),
+        'payload': pa.array([rng.integers(0, 256, 8192)
+                             .astype(np.uint8).tobytes()
+                             for _ in range(64)], type=pa.binary()),
+    }), path, row_group_size=32)
+
+    plane = IngestPlane(fsspec.filesystem('file'),
+                        [SimpleNamespace(path=path, row_group=0)],
+                        columns={'idx', 'payload'}, fetch_threads=1)
+    try:
+        plane.observe_dispatch((0,))
+        assert plane.checkout(path, 0) is not None
+        stats = plane.stats
+        needed = stats['ingest_plan_needed_bytes']
+        waste = stats['ingest_plan_waste_bytes']
+        assert needed > 0
+        assert waste > 0        # the 'label' chunk rode along
+        assert stats['ingest_plan_waste_pct'] == pytest.approx(
+            100.0 * waste / (needed + waste), abs=0.01)
+    finally:
+        plane.close()
+
+
+# -- provenance-derived warming candidates -----------------------------------
+
+def test_derive_candidates_ranks_cold_roots():
+    class _Journal(object):
+        def __init__(self, records):
+            self._records = records
+
+        def records(self):
+            return self._records
+
+    def record(root, cache, tenant=None, row_groups=(0,)):
+        return {'cache': cache, 'tenant': tenant,
+                'pieces': [{'path': root + '/part0.parquet',
+                            'row_group': rg} for rg in row_groups]}
+
+    journals = [_Journal([
+        record('/data/hot', 'decode', tenant='t1', row_groups=(0, 1)),
+        record('/data/hot', 'degraded', tenant='t2'),
+        record('/data/mild', 'decode'),
+        record('/data/mild', 'plane'),
+        record('/data/cached', 'plane'),     # zero cold -> dropped
+    ]), _Journal([record('/data/hot', 'decode', tenant='t1')])]
+
+    candidates = derive_candidates(journals=journals)
+    assert [c['root'] for c in candidates] == ['/data/hot', '/data/mild']
+    hot = candidates[0]
+    assert hot['cold'] == 3 and hot['records'] == 3
+    assert hot['pieces'] == 2                # (path, rg 0) and (path, rg 1)
+    assert hot['tenants'] == {'t1': 2, 't2': 1}
+
+    class _Broken(object):
+        def records(self):
+            raise RuntimeError('torn journal')
+
+    assert derive_candidates(journals=[_Broken()]) == []
+    assert derive_candidates(journals=journals, top_k=1) == [hot]
+
+
+# -- autoscaler hand-off: scale-in victims warm before they drain ------------
+
+def test_dispatcher_defers_drain_until_warming_pass_done(dataset, tmp_path):
+    from petastorm_tpu.service import Dispatcher, ServiceConfig
+    config = ServiceConfig(dataset.url, num_consumers=1,
+                           rowgroups_per_split=2, lease_ttl_s=2.0)
+    dispatcher = Dispatcher(config, num_pieces=2)  # no serve thread needed
+    w0 = dispatcher._op_register_worker({'data_addr': 'tcp://x:1'})['worker_id']
+
+    with MaterializeController(dataset.url,
+                               str(tmp_path / 'plane')) as controller:
+        dispatcher.attach_materializer(controller)
+        assert controller.offer_drain_candidate(
+            w0, deadline_s=Dispatcher.DRAIN_WARM_DEADLINE_S)
+        now = time.monotonic()
+        dispatcher._deferred_drains[w0] = \
+            now + Dispatcher.DRAIN_WARM_DEADLINE_S
+        dispatcher.materialize_handoffs += 1
+
+        # While the pass runs the drain is deferred, not executed.
+        if not controller.drain_ready(w0):
+            dispatcher._tick_deferred_drains(time.monotonic())
+            assert not dispatcher._workers[w0].get('draining')
+
+        deadline = time.monotonic() + 30.0
+        while not controller.drain_ready(w0):
+            assert time.monotonic() < deadline, 'warming pass never finished'
+            time.sleep(0.05)
+        dispatcher._tick_deferred_drains(time.monotonic())
+        assert w0 not in dispatcher._deferred_drains
+        assert dispatcher._workers[w0]['draining']
+        # The offered capacity actually warmed pieces before draining.
+        assert controller.summary()['done'] == \
+            controller.summary()['total_pieces']
+    assert dispatcher.materialize_handoffs == 1
+    snapshot = dispatcher._fleet_snapshot()
+    assert snapshot['counters']['materialize_handoffs'] == 1
+
+
+def test_deferred_drain_deadline_wins_over_a_stuck_pass(dataset):
+    """Warming can delay a drain, never veto it: a pass that outlives
+    the deadline drains anyway."""
+    from petastorm_tpu.service import Dispatcher, ServiceConfig
+
+    class _StuckMaterializer(object):
+        def drain_ready(self, worker_id):
+            return False
+
+    config = ServiceConfig(dataset.url, num_consumers=1,
+                           rowgroups_per_split=2, lease_ttl_s=2.0)
+    dispatcher = Dispatcher(config, num_pieces=2)
+    w0 = dispatcher._op_register_worker({'data_addr': 'tcp://x:1'})['worker_id']
+    dispatcher.attach_materializer(_StuckMaterializer())
+    dispatcher._deferred_drains[w0] = time.monotonic() - 1.0  # deadline past
+    dispatcher._tick_deferred_drains(time.monotonic())
+    assert dispatcher._workers[w0]['draining']
+    assert w0 not in dispatcher._deferred_drains
+
+
+# -- chaos: SIGKILL mid-publish (satellite 3) --------------------------------
+
+def test_materialize_kill_scenario_registered():
+    from petastorm_tpu.test_util import chaos
+    scenario = chaos.SCENARIOS['materialize_kill']
+    assert scenario['runner'] == 'materialize'
+    assert scenario['throttle_s'] > 0       # the kill window
+    assert 'materialize_kill' not in chaos.SMOKE_SCENARIOS
+
+
+def test_materialize_kill_scenario_end_to_end(tmp_path):
+    """SIGKILL the controller mid-publish: zero torn entries, the ledger
+    resumes attempt-intact, and the consumer's delivery digest through
+    the half-then-fully warmed plane matches ground truth."""
+    from petastorm_tpu.test_util import chaos
+    url, rows = chaos.make_chaos_dataset(str(tmp_path / 'ds'), seed=13)
+    report = chaos.run_scenario('materialize_kill', url, rows,
+                                str(tmp_path), seed=13)
+    assert report['ok'], report
+    checks = report['checks']
+    for name in ('zero_torn_entries', 'ledger_progress', 'resume',
+                 'digest', 'served_from_plane', 'zero_residue'):
+        assert checks[name].startswith('ok'), (name, checks)
+
+
+# -- doctor probe (satellite 4) ----------------------------------------------
+
+def test_doctor_materialize_probe_reports_skip_stages():
+    from petastorm_tpu.tools.doctor import _check_materialize
+    out = _check_materialize()
+    assert out['roundtrip_ok'], out
+    assert out['skip_decode'] and out['skip_collate'] and out['skip_narrow']
+    assert out['warmed_pieces'] == 2
+    assert out['wire_published'] == 2
+
+
+def test_doctor_materialize_probe_honors_kill_switch(monkeypatch):
+    from petastorm_tpu.tools.doctor import _check_materialize
+    monkeypatch.setenv('PETASTORM_TPU_NO_MATERIALIZE', '1')
+    out = _check_materialize()
+    assert out == {'kill_switch': True, 'note': out['note']}
