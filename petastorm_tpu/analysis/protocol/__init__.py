@@ -1,0 +1,35 @@
+"""Protocol verification plane (ISSUE 19).
+
+The control plane's exactly-once / attempt-monotonicity guarantees —
+split leases with attempt ceilings (PR 1/15), durable ledger
+restore/adoption (PR 15), autoscaler drains with the materializer
+warm-before-drain hand-off (PR 16/18), and materialize piece leases —
+were checked only by the chaos matrix, which *samples* interleavings.
+This package checks them *exhaustively* at small scope:
+
+* :mod:`checker` — a stdlib-only explicit-state model checker: BFS over
+  every interleaving of guarded transitions with state-hash dedup,
+  bounded crash/restart transitions as first-class actions, safety
+  invariants evaluated per state, non-progress-cycle detection for
+  liveness, and shortest counterexample traces.
+* :mod:`models` — the three core protocols as transition systems: the
+  split-lease lifecycle, the drain handshake, and the materialize piece
+  lease.  Each model declares the op/state alphabet it covers so the
+  ``protocol-model-conformance`` lint rule can diff it against the
+  implementation's AST (both directions).
+* :mod:`bridge` — renders a violated invariant's trace as a
+  ``petastorm-tpu-chaos`` seam spec, so every model-level counterexample
+  is replayable against the real processes.
+* :mod:`cli` — ``petastorm-tpu-model`` / ``python -m
+  petastorm_tpu.analysis.protocol``: ``--check`` / ``--list-models`` /
+  ``--trace`` / ``--dot``, exit codes 0/1/2, run by the CI lint job from
+  the bare checkout (numpy/pyarrow/jax/zmq never imported).
+
+Divergences this plane surfaces on the real tree are FIXED, never
+baselined — ``analysis/baseline.txt`` stays empty (the ISSUE 4 policy).
+"""
+
+from petastorm_tpu.analysis.protocol.checker import (CheckResult, Model,
+                                                     Violation, check)
+
+__all__ = ['Model', 'CheckResult', 'Violation', 'check']

@@ -1,0 +1,8 @@
+"""``python -m petastorm_tpu.analysis.protocol`` — see cli.py."""
+
+import sys
+
+from petastorm_tpu.analysis.protocol.cli import main
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Counterexample -> chaos bridge.
+
+A model-level violation is only as good as its repro: this module
+renders a :class:`~petastorm_tpu.analysis.protocol.checker.Violation`
+trace as a ``petastorm-tpu-chaos`` scenario spec (the PR 15 seam
+registry: kill phases + message drop/delay/dup faults) so the
+interleaving the checker found can be replayed against real processes
+via ``petastorm-tpu-chaos run --spec-json <file>``.
+
+Two layers ride in one spec:
+
+* the **chaos layer** (``kills`` / ``faults`` / ``dispatcher_subprocess``
+  / ``runner``) — derived from the trace's crash and expiry actions, it
+  drives the real fleet through the same failure schedule;
+* the **protocol layer** (``protocol``: model name, violated invariant,
+  the action labels of the shortest trace) — consumed by
+  :mod:`petastorm_tpu.test_util.protocol_replay`, which drives a real
+  in-process ``Dispatcher`` through the exact step sequence and asserts
+  the invariant on the real object.
+
+Stdlib-only: this module emits the spec shape; validation against the
+seam registry lives in ``test_util/chaos.py`` where the registry is.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = ['trace_to_chaos_spec']
+
+# crash-action label -> (kill role, which restart label revives it)
+_CRASH_ROLES = (
+    (re.compile(r'^(dispatcher_crash$'
+                r'|complete_crash_(prejournal|prereply)\()'),
+     'dispatcher', 'dispatcher_restart'),
+    (re.compile(r'^worker_crash\(w\d+\)$'), 'worker', 'worker_restart'),
+    (re.compile(r'^(controller_sigkill$|complete_crash_midpublish\()'),
+     'materialize', 'controller_restart'),
+)
+
+# labels that mean the fleet was mid-delivery when the crash hit
+_PROGRESS_BEFORE_KILL = re.compile(r'^(complete|stream)\(')
+_LEASE = re.compile(r'^lease\(')
+
+
+def _phase_for(labels_before):
+    """Kill phase from what the trace did before the crash: nothing ->
+    'registered', leases granted -> 'leases', data moved -> 'mid_epoch'."""
+    if any(_PROGRESS_BEFORE_KILL.match(label) for label in labels_before):
+        return 'mid_epoch'
+    if any(_LEASE.match(label) for label in labels_before):
+        return 'leases'
+    return 'registered'
+
+
+def trace_to_chaos_spec(model, violation):
+    """Render *violation* (from *model*) as a chaos scenario spec.
+
+    The returned dict is accepted by ``petastorm-tpu-chaos run
+    --spec-json`` and carries the raw trace for the protocol replay
+    harness under ``'protocol'``.
+    """
+    labels = [label for label, _state in violation.trace
+              if label != '<init>']
+    kills = []
+    faults = []
+    runner = None
+    dispatcher_subprocess = False
+    seen_expiry = False
+
+    for i, label in enumerate(labels):
+        for pattern, role, restart_label in _CRASH_ROLES:
+            if pattern.match(label):
+                restart = any(later.startswith(restart_label)
+                              for later in labels[i + 1:])
+                kills.append({'role': role,
+                              'phase': _phase_for(labels[:i]),
+                              'signal': 'kill',
+                              'restart': restart})
+                if role == 'dispatcher':
+                    dispatcher_subprocess = True
+                if role == 'materialize':
+                    runner = 'materialize'
+                break
+        if not seen_expiry and (label.startswith('expire(')
+                                or label.startswith('deregister_timeout(')):
+            # a lease expired while its holder lived: suppress the
+            # holder's heartbeats so the real TTL lapses the same way
+            faults.append({'seam': 'rpc.request', 'action': 'drop',
+                           'p': 1.0, 'max': 10, 'ops': ['heartbeat']})
+            seen_expiry = True
+
+    spec = {
+        'summary': 'replay of %s counterexample: %s violated'
+                   % (model.name, violation.name),
+        'protocol': {
+            'model': model.name,
+            'invariant': violation.name,
+            'kind': violation.kind,
+            'steps': labels,
+            'cycle': list(violation.cycle),
+        },
+    }
+    if kills:
+        spec['kills'] = kills
+    if faults:
+        spec['faults'] = faults
+    if dispatcher_subprocess:
+        spec['dispatcher_subprocess'] = True
+    if runner:
+        spec['runner'] = runner
+    return spec

@@ -1,0 +1,416 @@
+"""Explicit-state model checker for the control-plane protocols.
+
+Small, stdlib-only, and deliberately boring: a model is a set of guarded
+transitions over dict-shaped states, and the checker does breadth-first
+search over every interleaving with state-hash dedup.  BFS order means
+the first violation found is a *shortest* counterexample, which is what
+makes traces readable enough to hand to the chaos bridge.
+
+Design points, in the order they bit us elsewhere:
+
+* **Crash/restart are ordinary actions.**  Models expose dispatcher /
+  worker / controller death and rebirth as guarded transitions with an
+  explicit budget in the state, so "crash between the in-memory mark and
+  the journal append" is just another interleaving the BFS covers — not
+  a special mode of the checker.
+* **Safety invariants are evaluated on every reachable state**, at the
+  moment the state is first discovered.  A violated invariant stops the
+  search and reports the BFS path from the initial state.
+* **Deadlocks** (a non-settled state with no enabled action) are
+  violations: every protocol here is supposed to quiesce.
+* **Liveness without fairness assumptions is a false-positive machine**
+  (a worker renewing its lease forever "never progresses"), so two
+  restricted checks are used instead: (1) every reachable state must be
+  able to reach a settled state (catches "drain never terminates" and
+  "lease orphaned forever" for real), and (2) a cycle is flagged only
+  when it runs entirely through states where *no progress action is
+  even enabled* — a loop nothing could ever leave usefully.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+__all__ = ['Model', 'Violation', 'CheckResult', 'check', 'freeze',
+           'state_key_fn', 'render_dot', 'render_trace']
+
+
+def freeze(value):
+    """Canonical hashable form of a (possibly nested) model state."""
+    if isinstance(value, dict):
+        return tuple(sorted((k, freeze(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(freeze(v) for v in value)
+    if isinstance(value, (set, frozenset)):
+        return ('<set>',) + tuple(sorted(freeze(v) for v in value))
+    return value
+
+
+def state_key_fn(model):
+    """Hashable-key function for *model* states.
+
+    Models whose state values are already hashable declare ``FIELDS``
+    (the dict key order) and get a flat-tuple fast path — generic
+    :func:`freeze` dominates exploration time otherwise.
+    """
+    fields = getattr(model, 'FIELDS', ())
+    if fields:
+        return lambda state: tuple(map(state.__getitem__, fields))
+    return freeze
+
+
+class _StateStore:
+    """key -> state dict, without storing states when avoidable.
+
+    With ``FIELDS`` the key *is* the state (same values, fixed order),
+    so states are reconstructed on demand instead of kept — the
+    difference between ~100 MB and ~500 MB on the split-lease model.
+    """
+
+    def __init__(self, model):
+        self._fields = getattr(model, 'FIELDS', ())
+        self._states = None if self._fields else {}
+
+    def put(self, key, state):
+        if self._states is not None:
+            self._states[key] = state
+
+    def get(self, key):
+        if self._states is not None:
+            return self._states[key]
+        return dict(zip(self._fields, key))
+
+
+class Model:
+    """Base class for protocol models.
+
+    Subclasses define the transition system::
+
+        name        short CLI identifier ('split-lease', ...)
+        summary     one-line description
+        bound       human-readable scope bound printed by --check
+        initial()   -> state dict
+        actions(s)  -> iterable of (label, next_state, progress) where
+                       *progress* marks transitions that move the
+                       protocol toward settlement (used by liveness)
+        invariants()-> [(name, predicate(state) -> bool)]
+        settled(s)  -> True when the protocol has quiesced (goal states)
+        describe(s) -> short node label for --dot (optional)
+
+    Models also declare the alphabet the conformance lint pins them to:
+    ``OPS`` (RPC op names the model covers), ``STATES`` (state-literal
+    vocabulary) — see :mod:`petastorm_tpu.analysis.rules.protocol_model`.
+    """
+
+    name = ''
+    summary = ''
+    bound = ''
+    # dict key order for the flat-tuple state-key fast path; leave empty
+    # when state values are not all hashable (falls back to freeze())
+    FIELDS = ()
+    OPS = frozenset()
+    STATES = frozenset()
+
+    def initial(self):
+        raise NotImplementedError
+
+    def actions(self, state):
+        raise NotImplementedError
+
+    def invariants(self):
+        return []
+
+    def invariant_violation(self, state):
+        """Name of the first violated invariant, or None.
+
+        The default walks :meth:`invariants`; models on the hot path
+        override this with one fused loop (the checker calls it once per
+        discovered state).
+        """
+        for name, predicate in self.invariants():
+            if not predicate(state):
+                return name
+        return None
+
+    def settled(self, state):
+        raise NotImplementedError
+
+    def describe(self, state):
+        return ''
+
+
+class Violation:
+    """One property failure with its (shortest) evidence trace."""
+
+    # kinds, from most to least actionable
+    SAFETY = 'safety'
+    DEADLOCK = 'deadlock'
+    UNREACHABLE_SETTLEMENT = 'unreachable-settlement'
+    NON_PROGRESS_CYCLE = 'non-progress-cycle'
+
+    def __init__(self, kind, name, message, trace, state, cycle=()):
+        self.kind = kind
+        self.name = name
+        self.message = message
+        # trace: list of (action_label, state_dict); first entry is the
+        # initial state with label '<init>'.
+        self.trace = trace
+        self.state = state
+        # for NON_PROGRESS_CYCLE: the action labels looping forever
+        self.cycle = tuple(cycle)
+
+    def __repr__(self):
+        return ('Violation(kind=%r, name=%r, steps=%d)'
+                % (self.kind, self.name, len(self.trace) - 1))
+
+
+class CheckResult:
+    """Outcome of exploring one model."""
+
+    def __init__(self, model, states, transitions, violations, elapsed_s,
+                 complete):
+        self.model = model
+        self.states = states
+        self.transitions = transitions
+        self.violations = list(violations)
+        self.elapsed_s = elapsed_s
+        # False when max_states stopped the search before exhaustion
+        self.complete = complete
+
+    @property
+    def ok(self):
+        return not self.violations
+
+    def __repr__(self):
+        return ('CheckResult(model=%r, states=%d, transitions=%d, ok=%s)'
+                % (self.model.name, self.states, self.transitions, self.ok))
+
+
+def _trace_to(parent, store, key):
+    """Reconstruct the BFS path from the initial state to *key*."""
+    steps = []
+    while key is not None:
+        prev_key, label = parent[key]
+        steps.append((label, store.get(key)))
+        key = prev_key
+    steps.reverse()
+    return steps
+
+
+def check(model, max_states=2_000_000, stop_at_first=True):
+    """Exhaustively explore *model*; return a :class:`CheckResult`.
+
+    With ``stop_at_first`` (the default) the search stops at the first
+    safety/deadlock violation — BFS order makes it a shortest one.  The
+    liveness passes run only when the safety sweep is clean, over the
+    full reachable graph.
+    """
+    t0 = time.monotonic()
+    key_of = state_key_fn(model)
+    init = model.initial()
+    init_key = key_of(init)
+    violated = model.invariant_violation
+
+    parent = {init_key: (None, '<init>')}
+    store = _StateStore(model)
+    store.put(init_key, init)
+    # adjacency: key -> list of (label, progress, dest_key)
+    edges = {}
+    queue = deque([init_key])
+    violations = []
+    transitions = 0
+    complete = True
+
+    def _check_invariants(key, state):
+        name = violated(state)
+        if name is not None:
+            violations.append(Violation(
+                Violation.SAFETY, name,
+                'invariant %r violated' % name,
+                _trace_to(parent, store, key), state))
+            return True
+        return False
+
+    if _check_invariants(init_key, init) and stop_at_first:
+        return CheckResult(model, 1, 0, violations,
+                           time.monotonic() - t0, True)
+
+    while queue:
+        if len(parent) > max_states:
+            complete = False
+            break
+        key = queue.popleft()
+        state = store.get(key)
+        outgoing = []
+        for label, nxt, progress in model.actions(state):
+            nxt_key = key_of(nxt)
+            if nxt_key == key:
+                # Self-loops (pure no-ops like a renew that changes no
+                # abstract state) add nothing: skip so the liveness
+                # passes don't chase them.
+                continue
+            transitions += 1
+            outgoing.append((label, bool(progress), nxt_key))
+            if nxt_key not in parent:
+                parent[nxt_key] = (key, label)
+                store.put(nxt_key, nxt)
+                if _check_invariants(nxt_key, nxt) and stop_at_first:
+                    return CheckResult(
+                        model, len(parent), transitions, violations,
+                        time.monotonic() - t0, False)
+                queue.append(nxt_key)
+        edges[key] = outgoing
+        if not outgoing and not model.settled(state):
+            violations.append(Violation(
+                Violation.DEADLOCK, 'deadlock',
+                'non-settled state with no enabled action',
+                _trace_to(parent, store, key), state))
+            if stop_at_first:
+                return CheckResult(
+                    model, len(parent), transitions, violations,
+                    time.monotonic() - t0, False)
+
+    n_states = len(parent)
+    if violations or not complete:
+        return CheckResult(model, n_states, transitions, violations,
+                           time.monotonic() - t0, complete)
+
+    # ---- liveness pass 1: every state can still reach settlement ----
+    settled_set = set(k for k in parent if model.settled(store.get(k)))
+    settled_keys = list(settled_set)
+    reverse = {}
+    for src, outs in edges.items():
+        for _label, _progress, dst in outs:
+            reverse.setdefault(dst, []).append(src)
+    can_settle = set(settled_keys)
+    stack = list(settled_keys)
+    while stack:
+        k = stack.pop()
+        for prev in reverse.get(k, ()):
+            if prev not in can_settle:
+                can_settle.add(prev)
+                stack.append(prev)
+    for key in parent:
+        if key not in can_settle:
+            violations.append(Violation(
+                Violation.UNREACHABLE_SETTLEMENT, 'unreachable-settlement',
+                'state can never reach a settled state',
+                _trace_to(parent, store, key), store.get(key)))
+            if stop_at_first:
+                break
+    if violations:
+        return CheckResult(model, n_states, transitions, violations,
+                           time.monotonic() - t0, complete)
+
+    # ---- liveness pass 2: non-progress cycles -----------------------
+    # Restrict to non-settled states where no progress action is enabled
+    # at all, and look for a cycle using only non-progress edges within
+    # that set.  A loop that *could* take a progress step at some state
+    # is a scheduling artifact, not a protocol bug; a loop that never
+    # can is a livelock even under the fairest scheduler.
+    stuck = set()
+    for key, outs in edges.items():
+        if key in settled_set:
+            continue
+        if any(progress for _l, progress, _d in outs):
+            continue
+        stuck.add(key)
+    # iterative DFS cycle detection within `stuck`
+    color = {}  # 0=in-progress, 1=done
+    for root in stuck:
+        if root in color:
+            continue
+        stack = [(root, iter(edges.get(root, ())))]
+        color[root] = 0
+        path = [root]
+        on_path = {root}
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for label, progress, dst in it:
+                if progress or dst not in stuck:
+                    continue
+                if dst in on_path:
+                    # cycle found: slice the path from dst onward
+                    start = path.index(dst)
+                    cycle_keys = path[start:] + [dst]
+                    labels = []
+                    for a, b in zip(cycle_keys, cycle_keys[1:]):
+                        for lab, _p, d in edges.get(a, ()):
+                            if d == b:
+                                labels.append(lab)
+                                break
+                    violations.append(Violation(
+                        Violation.NON_PROGRESS_CYCLE, 'non-progress-cycle',
+                        'cycle with no progress action enabled anywhere',
+                        _trace_to(parent, store, dst),
+                        store.get(dst), cycle=labels))
+                    return CheckResult(
+                        model, n_states, transitions, violations,
+                        time.monotonic() - t0, complete)
+                if dst not in color:
+                    color[dst] = 0
+                    stack.append((dst, iter(edges.get(dst, ()))))
+                    path.append(dst)
+                    on_path.add(dst)
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = 1
+                stack.pop()
+                path.pop()
+                on_path.discard(node)
+
+    return CheckResult(model, n_states, transitions, violations,
+                       time.monotonic() - t0, complete)
+
+
+def render_trace(violation, describe=None):
+    """Counterexample as numbered lines (one action per line)."""
+    lines = ['%s: %s' % (violation.kind, violation.message)]
+    for i, (label, state) in enumerate(violation.trace):
+        desc = describe(state) if describe else ''
+        lines.append('  %2d. %-40s %s' % (i, label, desc))
+    if violation.cycle:
+        lines.append('  cycle: %s' % ' -> '.join(violation.cycle))
+    return '\n'.join(lines)
+
+
+def render_dot(model, max_states=400):
+    """Reachable state graph as Graphviz dot (bounded, for --dot)."""
+    key_of = state_key_fn(model)
+    init = model.initial()
+    init_key = key_of(init)
+    ids = {init_key: 0}
+    states_by_key = {init_key: init}
+    queue = deque([init_key])
+    lines = ['digraph %s {' % model.name.replace('-', '_'),
+             '  rankdir=LR;',
+             '  node [shape=box, fontsize=9];']
+    edge_lines = []
+    while queue and len(ids) < max_states:
+        key = queue.popleft()
+        state = states_by_key[key]
+        for label, nxt, _progress in model.actions(state):
+            nxt_key = key_of(nxt)
+            if nxt_key == key:
+                continue
+            if nxt_key not in ids:
+                if len(ids) >= max_states:
+                    continue
+                ids[nxt_key] = len(ids)
+                states_by_key[nxt_key] = nxt
+                queue.append(nxt_key)
+            edge_lines.append('  n%d -> n%d [label="%s", fontsize=8];'
+                              % (ids[key], ids[nxt_key],
+                                 label.replace('"', '\\"')))
+    for key, node_id in ids.items():
+        state = states_by_key[key]
+        desc = model.describe(state) or ('s%d' % node_id)
+        shape = ', peripheries=2' if model.settled(state) else ''
+        lines.append('  n%d [label="%s"%s];'
+                     % (node_id, desc.replace('"', '\\"'), shape))
+    lines.extend(edge_lines)
+    lines.append('}')
+    return '\n'.join(lines)

@@ -1,0 +1,149 @@
+"""``petastorm-tpu-model`` — the protocol-verification CLI.
+
+Stdlib-only so the CI lint job can run it from a bare checkout (the
+same import-blocker pattern as ptlint and lockdep)::
+
+    petastorm-tpu-model --check            # verify all three models
+    petastorm-tpu-model --check split-lease
+    petastorm-tpu-model --list-models
+    petastorm-tpu-model --trace split-lease
+    petastorm-tpu-model --dot drain > drain.dot
+    petastorm-tpu-model --trace split-lease --chaos-spec out.json
+
+Exit codes match ptlint: 0 all verified, 1 a violation was found,
+2 usage error / unknown model.
+
+``--check`` prints one line per model with the state-space size and the
+documented scope bound (both pinned by ``tests/test_protocol_models.py``)
+and a summary line.  ``--trace`` prints the shortest counterexample for
+a violated model; ``--chaos-spec`` additionally renders that trace as a
+``petastorm-tpu-chaos --spec-json`` file via :mod:`bridge`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from petastorm_tpu.analysis.protocol.checker import (check, render_dot,
+                                                     render_trace)
+
+__all__ = ['main']
+
+
+def _models():
+    from petastorm_tpu.analysis.protocol.models import ALL_MODELS
+    return ALL_MODELS
+
+
+def _select(names):
+    available = {m.name: m for m in _models()}
+    if not names:
+        return list(available.values()), None
+    picked = []
+    for name in names:
+        if name not in available:
+            return None, name
+        picked.append(available[name])
+    return picked, None
+
+
+def _print_result(result, out):
+    model = result.model
+    # A first-violation early stop also leaves the search incomplete —
+    # VIOLATED is the verdict that matters then.
+    if not result.ok:
+        status = 'VIOLATED'
+    elif not result.complete:
+        status = 'INCOMPLETE'
+    else:
+        status = 'OK'
+    out.write('%-12s %8d states %9d transitions  %-10s %6.1fs  bound: %s\n'
+              % (model.name, result.states, result.transitions, status,
+                 result.elapsed_s, model.bound))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-model',
+        description='explicit-state verification of the control-plane '
+                    'protocols (split lease, drain handshake, '
+                    'materialize piece lease)')
+    parser.add_argument('models', nargs='*', metavar='MODEL',
+                        help='model names (default: all)')
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument('--check', action='store_true',
+                      help='explore every interleaving, check invariants '
+                           'and liveness (default mode)')
+    mode.add_argument('--list-models', action='store_true',
+                      help='list models with their scope bounds')
+    mode.add_argument('--trace', action='store_true',
+                      help='print the shortest counterexample trace for '
+                           'each violated model (verbose --check)')
+    mode.add_argument('--dot', action='store_true',
+                      help='emit the reachable state graph as Graphviz dot')
+    parser.add_argument('--chaos-spec', metavar='PATH',
+                        help='with --trace: render the first '
+                             'counterexample as a petastorm-tpu-chaos '
+                             '--spec-json file')
+    parser.add_argument('--max-states', type=int, default=2_000_000,
+                        help='exploration cap (INCOMPLETE beyond it)')
+    args = parser.parse_args(argv)
+    out = sys.stdout
+
+    models, unknown = _select(args.models)
+    if unknown is not None:
+        sys.stderr.write('unknown model %r (have: %s)\n'
+                         % (unknown,
+                            ', '.join(m.name for m in _models())))
+        return 2
+
+    if args.list_models:
+        for m in models:
+            out.write('%-12s %s\n' % (m.name, m.summary))
+            out.write('%-12s bound: %s\n' % ('', m.bound))
+            out.write('%-12s invariants: %s\n'
+                      % ('', ', '.join(name for name, _f in m.invariants())))
+        return 0
+
+    if args.dot:
+        for m in models:
+            out.write(render_dot(m))
+            out.write('\n')
+        return 0
+
+    if args.chaos_spec and not args.trace:
+        sys.stderr.write('--chaos-spec requires --trace\n')
+        return 2
+
+    # --check / --trace
+    total_states = 0
+    failed = []
+    for m in models:
+        result = check(m, max_states=args.max_states)
+        total_states += result.states
+        _print_result(result, out)
+        if not result.ok or not result.complete:
+            failed.append((m, result))
+        if args.trace:
+            for violation in result.violations:
+                out.write(render_trace(violation, m.describe))
+                out.write('\n')
+    out.write('protocol models: %d/%d OK, %d states total\n'
+              % (len(models) - len(failed), len(models), total_states))
+
+    if args.chaos_spec and failed:
+        from petastorm_tpu.analysis.protocol.bridge import trace_to_chaos_spec
+        model, result = failed[0]
+        spec = trace_to_chaos_spec(model, result.violations[0])
+        with open(args.chaos_spec, 'w') as fh:
+            json.dump(spec, fh, indent=2, sort_keys=True)
+        out.write('chaos spec for %s written to %s\n'
+                  % (model.name, args.chaos_spec))
+
+    return 1 if failed else 0
+
+
+if __name__ == '__main__':  # pragma: no cover - exercised via __main__
+    sys.exit(main())

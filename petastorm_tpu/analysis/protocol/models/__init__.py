@@ -1,0 +1,49 @@
+"""Transition-system models of the three core control-plane protocols.
+
+Each model is small enough to check exhaustively but faithful to the
+semantics in ``service/dispatcher.py`` / ``service/ledger.py`` /
+``materialize/controller.py`` — the conformance lint rule
+(``protocol-model-conformance``) pins the two together by diffing the
+op/state vocabulary extracted from those ASTs against the alphabets
+declared here.
+
+``OP_COVERAGE`` is the single source of truth for which model owns each
+dispatcher RPC op.  Ops tagged ``'observability'`` are read-only queries
+with no protocol state to verify; ops tagged ``'unmodeled'`` mutate
+state but are deliberately out of model scope, with the justification
+required right here so the exemption is reviewable.
+"""
+
+from petastorm_tpu.analysis.protocol.models.drain import DrainModel
+from petastorm_tpu.analysis.protocol.models.piece_lease import \
+    PieceLeaseModel
+from petastorm_tpu.analysis.protocol.models.split_lease import \
+    SplitLeaseModel
+
+# Every _op_* handler in service/dispatcher.py must appear here, and
+# every key here must have a handler — enforced both directions by the
+# protocol-model-conformance rule.
+OP_COVERAGE = {
+    'register_worker': 'split-lease',
+    'heartbeat': 'split-lease',     # renew + orphan adoption via `held`
+    'lease': 'split-lease',
+    'complete': 'split-lease',
+    'release': 'drain',             # voluntary handback during drain
+    'deregister': 'drain',
+    'drain': 'drain',
+    'clock': 'observability',       # read-only monotonic-clock probe
+    'job': 'observability',
+    'register_job': 'observability',
+    'workers': 'observability',
+    'stats': 'observability',
+    'stop': 'observability',
+    # mark_consumed is a client-side fast-path retire (PENDING -> DONE +
+    # journal, no lease involved); it cannot violate the lease-cycle
+    # invariants because it never grants, burns, or revokes a lease.
+    'mark_consumed': 'unmodeled',
+}
+
+ALL_MODELS = (SplitLeaseModel(), DrainModel(), PieceLeaseModel())
+
+__all__ = ['SplitLeaseModel', 'DrainModel', 'PieceLeaseModel',
+           'ALL_MODELS', 'OP_COVERAGE']

@@ -1,0 +1,231 @@
+"""Model of the drain handshake.
+
+Covers the three ways a worker leaves the fleet and their interaction
+with in-flight work, per ``service/dispatcher.py`` (``_drain_worker`` /
+``_tick_deferred_drains`` / ``_autoscale_tick``), ``service/autoscaler.py``
+and ``materialize/controller.py`` (``offer_drain_candidate`` /
+``drain_ready``):
+
+* operator RPC ``drain`` and worker-side SIGTERM both put the worker in
+  the draining phase directly;
+* an autoscaler ``scale_in`` victim is first *offered* to the
+  materializer, which either declines (drain immediately) or starts a
+  warm pass — in which case the drain is deferred until the pass
+  finishes **or** ``DRAIN_WARM_DEADLINE_S`` lapses, whichever comes
+  first.  Warming may delay a drain, never veto it: the deadline is a
+  strictly-decreasing budget here, so a warm pass that never finishes
+  cannot hold the drain forever (the mutated model that waits on
+  ``drain_ready`` alone livelocks and the checker flags it).
+* a draining worker takes no new leases, finishes or releases its
+  in-flight split, then deregisters; the deregister-timeout path
+  requeues whatever it still held.
+
+Invariants: work is conserved (every split ends finished or back in the
+queue — nothing is lost across a drain), a deregistered worker holds no
+work, and a draining worker is never granted a lease.  Liveness: drain
+always terminates — every state reaches a settlement with no worker
+draining and no deferred drain outstanding.
+"""
+
+from petastorm_tpu.analysis.protocol.checker import Model
+
+ACTIVE, DRAINING, DEREGISTERED = 'active', 'draining', 'deregistered'
+
+
+class DrainModel(Model):
+    name = 'drain'
+    summary = ('SIGTERM/RPC drain x autoscaler victim selection x '
+               'materializer warm deadline; warming delays, never vetoes')
+
+    OPS = frozenset(['drain', 'release', 'deregister'])
+    STATES = frozenset([ACTIVE, DRAINING, DEREGISTERED])
+    FIELDS = ('workers', 'pending', 'finished', 'deferred', 'warming',
+              'scale_in', 'scale_out', 'drain_grants')
+    # pinned against service/autoscaler.py action literals
+    AUTOSCALER_ACTIONS = frozenset(['scale_out', 'scale_in'])
+
+    def __init__(self, n_workers=2, n_splits=2, warm_budget=2):
+        self.n_workers = n_workers
+        self.n_splits = n_splits
+        self.warm_budget = warm_budget
+        self.bound = ('%d workers x %d splits x warm deadline %d ticks x '
+                      '1 scale_in + 1 scale_out'
+                      % (n_workers, n_splits, warm_budget))
+
+    # -- state shape --------------------------------------------------
+    # workers:  per worker (phase, inflight 0/1)
+    # pending:  splits waiting in the queue
+    # finished: splits completed (work conservation: pending + inflight
+    #           + finished == n_splits)
+    # deferred: per worker: None | warm-deadline ticks remaining
+    # warming:  per worker: None | 'running' | 'ready'
+    # scale_in / scale_out: autoscaler action budgets
+    # drain_grants: leases granted to draining workers (always 0; only a
+    #           mutated model can bump it)
+
+    def initial(self):
+        return {
+            'workers': ((ACTIVE, 0),) * self.n_workers,
+            'pending': self.n_splits,
+            'finished': 0,
+            'deferred': (None,) * self.n_workers,
+            'warming': (None,) * self.n_workers,
+            'scale_in': 1,
+            'scale_out': 1,
+            'drain_grants': 0,
+        }
+
+    @staticmethod
+    def _set(tup, i, value):
+        return tup[:i] + (value,) + tup[i + 1:]
+
+    def _set_worker(self, state, w, phase, inflight):
+        return self._set(state['workers'], w, (phase, inflight))
+
+    def actions(self, state):
+        out = []
+        workers = state['workers']
+        active = [w for w, (phase, _n) in enumerate(workers)
+                  if phase == ACTIVE]
+
+        for w, (phase, inflight) in enumerate(workers):
+            # op lease: active workers only — a draining worker gets
+            # {'wait': True, 'drain': True} back, never a grant.
+            if phase == ACTIVE and inflight == 0 and state['pending'] > 0:
+                nxt = dict(state)
+                nxt['workers'] = self._set_worker(state, w, phase, 1)
+                nxt['pending'] = state['pending'] - 1
+                out.append(('lease(w%d)' % w, nxt, True))
+
+            # finish the in-flight split (decode + complete)
+            if inflight > 0 and phase in (ACTIVE, DRAINING):
+                nxt = dict(state)
+                nxt['workers'] = self._set_worker(state, w, phase, 0)
+                nxt['finished'] = state['finished'] + 1
+                out.append(('finish(w%d)' % w, nxt, True))
+
+            # op release: a draining worker hands its split back to the
+            # front of the queue, attempt-intact, instead of finishing.
+            if phase == DRAINING and inflight > 0:
+                nxt = dict(state)
+                nxt['workers'] = self._set_worker(state, w, phase, 0)
+                nxt['pending'] = state['pending'] + 1
+                out.append(('release(w%d)' % w, nxt, True))
+
+            # drain triggers: operator RPC and worker-side SIGTERM both
+            # reach _drain_worker directly.
+            if phase == ACTIVE and state['deferred'][w] is None:
+                nxt = dict(state)
+                nxt['workers'] = self._set_worker(state, w, DRAINING,
+                                                  inflight)
+                out.append(('rpc_drain(w%d)' % w, nxt, True))
+                nxt = dict(state)
+                nxt['workers'] = self._set_worker(state, w, DRAINING,
+                                                  inflight)
+                out.append(('sigterm(w%d)' % w, nxt, True))
+
+            # op deregister: clean exit once the in-flight work is gone,
+            # or the timeout path that requeues whatever was left.
+            if phase == DRAINING:
+                if inflight == 0:
+                    nxt = dict(state)
+                    nxt['workers'] = self._set_worker(state, w,
+                                                      DEREGISTERED, 0)
+                    out.append(('deregister(w%d)' % w, nxt, True))
+                else:
+                    nxt = dict(state)
+                    nxt['workers'] = self._set_worker(state, w,
+                                                      DEREGISTERED, 0)
+                    nxt['pending'] = state['pending'] + inflight
+                    out.append(('deregister_timeout(w%d)' % w, nxt, True))
+
+        # autoscaler scale_in: victim = least cache coverage (lowest
+        # index here); the dispatcher offers the victim to the
+        # materializer first.
+        if state['scale_in'] > 0 and len(active) > 1:
+            victim = active[0]
+            phase, inflight = workers[victim]
+            # materializer declines (kill switch / no identity / nothing
+            # pending): drain immediately
+            nxt = dict(state)
+            nxt['workers'] = self._set_worker(state, victim, DRAINING,
+                                              inflight)
+            nxt['scale_in'] = 0
+            out.append(('scale_in_immediate(w%d)' % victim, nxt, True))
+            # materializer starts a warm pass: drain deferred behind
+            # DRAIN_WARM_DEADLINE_S
+            nxt = dict(state)
+            nxt['deferred'] = self._set(state['deferred'], victim,
+                                        self.warm_budget)
+            nxt['warming'] = self._set(state['warming'], victim, 'running')
+            nxt['scale_in'] = 0
+            out.append(('scale_in_deferred(w%d)' % victim, nxt, True))
+
+        # autoscaler scale_out: revive a deregistered worker
+        if state['scale_out'] > 0:
+            for w, (phase, _n) in enumerate(workers):
+                if phase == DEREGISTERED:
+                    nxt = dict(state)
+                    nxt['workers'] = self._set_worker(state, w, ACTIVE, 0)
+                    nxt['scale_out'] = 0
+                    out.append(('scale_out(w%d)' % w, nxt, True))
+                    break
+
+        # deferred-drain plumbing (_tick_deferred_drains)
+        for w, ticks in enumerate(state['deferred']):
+            if ticks is None:
+                continue
+            warming = state['warming'][w]
+            if warming == 'running':
+                # the warm pass finishes on its own...
+                nxt = dict(state)
+                nxt['warming'] = self._set(state['warming'], w, 'ready')
+                out.append(('warm_ready(w%d)' % w, nxt, True))
+            if ticks > 0:
+                # ...or the deadline burns down underneath it
+                nxt = dict(state)
+                nxt['deferred'] = self._set(state['deferred'], w, ticks - 1)
+                out.append(('warm_tick(w%d)' % w, nxt, True))
+            if self._deferred_ready(state, w):
+                phase, inflight = workers[w]
+                nxt = dict(state)
+                nxt['deferred'] = self._set(state['deferred'], w, None)
+                nxt['warming'] = self._set(state['warming'], w, None)
+                if phase == ACTIVE:
+                    nxt['workers'] = self._set_worker(state, w, DRAINING,
+                                                      inflight)
+                out.append(('deferred_drain_fire(w%d)' % w, nxt, True))
+
+        return out
+
+    def _deferred_ready(self, state, w):
+        """Warming may delay, never veto: ready at drain_ready() OR the
+        deadline — a mutant that drops the deadline arm livelocks."""
+        return (state['deferred'][w] == 0
+                or state['warming'][w] == 'ready')
+
+    def invariants(self):
+        def work_conserved(state):
+            held = sum(n for _phase, n in state['workers'])
+            return (state['pending'] + held + state['finished']
+                    == self.n_splits)
+
+        def deregistered_holds_nothing(state):
+            return all(n == 0 for phase, n in state['workers']
+                       if phase == DEREGISTERED)
+
+        def draining_never_granted(state):
+            return state['drain_grants'] == 0
+
+        return [('work-conserved', work_conserved),
+                ('deregistered-holds-nothing', deregistered_holds_nothing),
+                ('draining-never-granted', draining_never_granted)]
+
+    def settled(self, state):
+        return (all(phase != DRAINING for phase, _n in state['workers'])
+                and all(t is None for t in state['deferred']))
+
+    def describe(self, state):
+        return ' '.join('%s%d' % (phase[:2], n)
+                        for phase, n in state['workers']) \
+            + ' p%d f%d' % (state['pending'], state['finished'])

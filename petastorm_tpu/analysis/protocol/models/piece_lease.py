@@ -1,0 +1,220 @@
+"""Model of the materialize piece lease.
+
+Faithful to ``materialize/controller.py`` at small scope (defaults:
+2 warmers x 2 pieces x 1 controller SIGKILL/restart,
+``max_piece_attempts`` = 2).  The piece lease differs from the split
+lease in three load-bearing ways, all modeled:
+
+* **the attempt burns at grant** (``lease()`` does ``rec[1] += 1``), and
+  a TTL expiry leaves it burned — so the ceiling counts *grants*, not
+  expiries;
+* **poison happens at lease time**: a pending piece already at the
+  ceiling is failed when the next ``lease()`` pass sees it;
+* **complete is journal-first**: the durable ``{'op': 'done'}`` line is
+  appended *before* the in-memory publish, so a SIGKILL mid-publish
+  restores the piece as DONE (journal wins) — whereas a SIGKILL before
+  the journal line restores it PENDING with the granted attempt intact
+  (the controller's death is not the piece's failure).
+
+``release(burn_attempt=False)`` — the admission-refusal refund — is a
+first-class action: the warmer hands the lease back and the attempt
+counter steps back down.
+
+Invariants: the attempt counter stays in ``[0, max_piece_attempts]``
+(a refund never overdraws, a restore never re-burns), a journaled piece
+can only be DONE after restore, no piece publishes twice, and poison is
+sticky.  Liveness: every state reaches all-pieces-DONE/FAILED.
+"""
+
+from petastorm_tpu.analysis.protocol.checker import Model
+
+# mirrors controller.py's compact codes: _PENDING, _LEASED, _DONE,
+# _FAILED = 'p', 'l', 'd', 'f'
+P_PENDING, P_LEASED, P_DONE, P_FAILED = 'p', 'l', 'd', 'f'
+
+
+class PieceLeaseModel(Model):
+    name = 'piece-lease'
+    summary = ('materialize piece lease: burn-at-grant, poison-at-lease, '
+               'refund, journal-first publish, controller SIGKILL')
+
+    # controller method vocabulary pinned by protocol-model-conformance
+    OPS = frozenset(['lease', 'complete', 'release', 'fail'])
+    STATES = frozenset([P_PENDING, P_LEASED, P_DONE, P_FAILED])
+    FIELDS = ('ctrl', 'ccrash', 'pieces', 'journal', 'refund_left',
+              'completes', 'poison')
+
+    def __init__(self, n_warmers=2, n_pieces=2, max_attempts=2,
+                 crashes=1, refunds_per_piece=1):
+        self.n_warmers = n_warmers
+        self.n_pieces = n_pieces
+        self.max_attempts = max_attempts
+        self.crashes = crashes
+        self.refund_budget = refunds_per_piece
+        self.bound = ('%d warmers x %d pieces x %d controller '
+                      'SIGKILL/restart, max_piece_attempts=%d'
+                      % (n_warmers, n_pieces, crashes, max_attempts))
+
+    # -- state shape --------------------------------------------------
+    # ctrl:    'up' | 'down'
+    # ccrash:  controller SIGKILL budget
+    # pieces:  per piece (state, attempt, holder | None)
+    # journal: per piece: durable done line written
+    # refund_left: per piece admission-refusal budget (bounds the
+    #          lease/refund cycle; refunds = budget - refund_left)
+    # completes: per piece publish count (exactly-once)
+    # poison:  per piece: hit the ceiling at some point
+
+    def initial(self):
+        return {
+            'ctrl': 'up',
+            'ccrash': self.crashes,
+            'pieces': tuple((P_PENDING, 0, None)
+                            for _ in range(self.n_pieces)),
+            'journal': (False,) * self.n_pieces,
+            'refund_left': (self.refund_budget,) * self.n_pieces,
+            'completes': (0,) * self.n_pieces,
+            'poison': (False,) * self.n_pieces,
+        }
+
+    @staticmethod
+    def _set(tup, i, value):
+        return tup[:i] + (value,) + tup[i + 1:]
+
+    @classmethod
+    def _bump(cls, tup, i):
+        return cls._set(tup, i, tup[i] + 1)
+
+    def actions(self, state):
+        out = []
+        up = state['ctrl'] == 'up'
+        pieces = state['pieces']
+
+        if up:
+            for i, (st, attempt, holder) in enumerate(pieces):
+                if st == P_PENDING:
+                    if attempt >= self.max_attempts:
+                        # poison-at-lease-time: the next lease() pass
+                        # fails a pending piece already at the ceiling
+                        nxt = dict(state)
+                        nxt['pieces'] = self._set(
+                            pieces, i, (P_FAILED, attempt, None))
+                        nxt['poison'] = self._set(state['poison'], i, True)
+                        out.append(('poison(p%d)' % i, nxt, True))
+                    else:
+                        for w in range(self.n_warmers):
+                            # lease burns the attempt at grant
+                            nxt = dict(state)
+                            nxt['pieces'] = self._set(
+                                pieces, i, (P_LEASED, attempt + 1, w))
+                            out.append(('lease(w%d,p%d)' % (w, i), nxt,
+                                        True))
+
+                if st == P_LEASED:
+                    # TTL expiry leaves the attempt burned
+                    nxt = dict(state)
+                    nxt['pieces'] = self._set(
+                        pieces, i, (P_PENDING, attempt, None))
+                    out.append(('expire(p%d)' % i, nxt, True))
+
+                    # complete: journal line FIRST, then the in-memory
+                    # publish — atomic when the controller survives...
+                    nxt = dict(state)
+                    nxt['pieces'] = self._set(
+                        pieces, i, (P_DONE, attempt, None))
+                    nxt['journal'] = self._set(state['journal'], i, True)
+                    nxt['completes'] = self._bump(state['completes'], i)
+                    out.append(('complete(w%d,p%d)' % (holder, i), nxt,
+                                True))
+                    # ...but a SIGKILL can land mid-publish, after the
+                    # journal append and before the in-memory flip.
+                    if state['ccrash'] > 0:
+                        nxt = dict(state)
+                        nxt['journal'] = self._set(state['journal'], i, True)
+                        nxt['completes'] = self._bump(state['completes'], i)
+                        nxt['ctrl'] = 'down'
+                        nxt['ccrash'] = state['ccrash'] - 1
+                        out.append(('complete_crash_midpublish(w%d,p%d)'
+                                    % (holder, i), nxt, False))
+
+                    # release(burn_attempt=False): admission refused, the
+                    # warmer refunds the attempt it was granted
+                    if state['refund_left'][i] > 0:
+                        nxt = dict(state)
+                        nxt['pieces'] = self._set(
+                            pieces, i, (P_PENDING, attempt - 1, None))
+                        nxt['refund_left'] = self._set(
+                            state['refund_left'], i,
+                            state['refund_left'][i] - 1)
+                        out.append(('release_refund(w%d,p%d)' % (holder, i),
+                                    nxt, True))
+
+                    # fail() / release(burn_attempt=True): decode error,
+                    # the burn stands
+                    nxt = dict(state)
+                    nxt['pieces'] = self._set(
+                        pieces, i, (P_PENDING, attempt, None))
+                    out.append(('fail(w%d,p%d)' % (holder, i), nxt, True))
+
+            if state['ccrash'] > 0:
+                nxt = dict(state)
+                nxt['ctrl'] = 'down'
+                nxt['ccrash'] = state['ccrash'] - 1
+                out.append(('controller_sigkill', nxt, False))
+        else:
+            nxt = dict(state)
+            nxt['ctrl'] = 'up'
+            nxt['pieces'] = tuple(
+                self._restore_piece(piece, state['journal'][i])
+                for i, piece in enumerate(pieces))
+            out.append(('controller_restart', nxt, False))
+
+        return out
+
+    def _restore_piece(self, piece, journaled):
+        """_attach_ledger semantics for one piece: the journal wins;
+        pending AND leased both come back pending, attempt intact."""
+        st, attempt, _holder = piece
+        if journaled:
+            return (P_DONE, attempt, None)
+        if st == P_LEASED:
+            return (P_PENDING, attempt, None)
+        return (st, attempt, None)
+
+    def invariants(self):
+        def attempt_in_range(state):
+            # 0 <= attempt <= ceiling: a refund of an unburned attempt
+            # would go negative; a restore that re-burns overshoots the
+            # ceiling (restore keeps the granted attempt *intact*).
+            return all(0 <= piece[1] <= self.max_attempts
+                       for piece in state['pieces'])
+
+        def journal_wins(state):
+            return all(piece[0] == P_DONE or state['ctrl'] == 'down'
+                       for piece, j in zip(state['pieces'],
+                                           state['journal'])
+                       if j)
+
+        def exactly_once(state):
+            return all(c <= 1 for c in state['completes'])
+
+        def poison_sticky(state):
+            return all(piece[0] == P_FAILED
+                       for piece, p in zip(state['pieces'], state['poison'])
+                       if p)
+
+        return [('attempt-in-range', attempt_in_range),
+                ('journal-wins', journal_wins),
+                ('exactly-once', exactly_once),
+                ('poison-sticky', poison_sticky)]
+
+    def settled(self, state):
+        return (state['ctrl'] == 'up'
+                and all(piece[0] in (P_DONE, P_FAILED)
+                        for piece in state['pieces']))
+
+    def describe(self, state):
+        return 'C%s %s' % (
+            '+' if state['ctrl'] == 'up' else '-',
+            '/'.join('%s%d' % (piece[0], piece[1])
+                     for piece in state['pieces']))

@@ -1,0 +1,328 @@
+"""Model of the dispatcher split-lease lifecycle.
+
+Faithful to ``service/dispatcher.py`` + ``service/ledger.py`` at small
+scope (defaults: 2 workers x 3 splits x 1 crash/restart per actor,
+``max_split_attempts`` = 2, depth-1 workers — each worker runs the real
+worker loop: lease one split, stream it, complete it, lease the next):
+
+* ``lease`` grants a PENDING split without burning an attempt — the
+  attempt counter moves only on expiry-class revocation
+  (``_expire_leases`` / ``_op_deregister(timed_out=True)``), and
+  reaching ``max_split_attempts`` poisons the split to FAILED.
+* ``complete`` is write-ahead: the in-memory DONE mark, the ledger
+  journal line (``_ledger_done``) and the ok reply are one dispatcher
+  step, but a crash can fall between the mark and the journal append, or
+  between the append and the reply — both windows are first-class
+  actions here.
+* Dispatcher restart restores from the ledger: journaled splits come
+  back DONE; a DONE mark that never reached the journal comes back as
+  its pre-mark LEASED; LEASED splits come back as *orphans*
+  (``worker_id=None``, attempts intact) that either get adopted by a
+  surviving worker's heartbeat ``held`` claim or requeue attempt-intact
+  when the grace TTL lapses (``ledger_requeues``).
+* Workers stream a split to the client *before* completing it, and a
+  stream happens at most once per granted lease (the ``'h'`` ->
+  ``'d'`` stage edge), so the only way a split is ever re-streamed is a
+  real lease revocation — the exactly-once argument is structural plus
+  the ``exactly-once`` invariant below.
+
+Invariants checked on every reachable state:
+
+* ``exactly-once`` — a journaled split is DONE forever: restore can
+  never lose or downgrade a durable completion, so a completed split is
+  never re-granted (and therefore never re-decoded).
+* ``restart-never-burns`` — the attempt counter equals the number of
+  expiry-class burns; crash/restart and orphan grace requeue leave it
+  intact.
+* ``poison-sticky`` — once FAILED at the attempt ceiling, a split never
+  resurrects.
+
+Liveness (checker passes): every state can reach settlement (all splits
+DONE/FAILED, no un-acked worker stage) — i.e. no lease is orphaned
+forever — and no cycle exists in which progress is nowhere enabled.
+"""
+
+from petastorm_tpu.analysis.protocol.checker import Model
+
+# Worker-side stage for its (single) held split: held-not-yet-streamed
+# vs streamed-awaiting-ack.
+_HELD, _STREAMED = 'h', 'd'
+_IDLE = '-'
+
+PENDING, LEASED, DONE, FAILED = 'pending', 'leased', 'done', 'failed'
+
+
+class SplitLeaseModel(Model):
+    name = 'split-lease'
+    summary = ('split lease grant/renew/expire/adopt/poison/complete '
+               'across dispatcher and worker crash points')
+
+    # Alphabet pinned against service/dispatcher.py by the
+    # protocol-model-conformance rule.
+    OPS = frozenset(['register_worker', 'heartbeat', 'lease', 'complete'])
+    STATES = frozenset([PENDING, LEASED, DONE, FAILED])
+    FIELDS = ('disp', 'dcrash', 'workers', 'held', 'splits', 'journal',
+              'burns', 'poison')
+
+    def __init__(self, n_workers=2, n_splits=3, max_attempts=2,
+                 crashes_per_actor=1):
+        self.n_workers = n_workers
+        self.n_splits = n_splits
+        self.max_attempts = max_attempts
+        self.crashes = crashes_per_actor
+        self.bound = ('%d workers x %d splits x %d crash/restart per actor, '
+                      'max_split_attempts=%d, depth-1 workers'
+                      % (n_workers, n_splits, crashes_per_actor,
+                         max_attempts))
+
+    # -- state shape --------------------------------------------------
+    # disp:    'up' | 'down'
+    # dcrash:  dispatcher crash budget remaining
+    # workers: per worker (status 'up'|'down', registered, crash budget)
+    # held:    per worker: '-' | (split, 'h'|'d')  (depth-1 worker loop)
+    # splits:  per split (state, attempt, holder | None)
+    # journal: per split: durably journaled DONE
+    # burns:   per split: expiry-class attempt burns (== attempt in the
+    #          shipped protocol; a restore that re-burns diverges)
+    # poison:  per split: hit the attempt ceiling at some point
+
+    def initial(self):
+        return {
+            'disp': 'up',
+            'dcrash': self.crashes,
+            'workers': tuple(('up', True, self.crashes)
+                             for _ in range(self.n_workers)),
+            'held': (_IDLE,) * self.n_workers,
+            'splits': tuple((PENDING, 0, None)
+                            for _ in range(self.n_splits)),
+            'journal': (False,) * self.n_splits,
+            'burns': (0,) * self.n_splits,
+            'poison': (False,) * self.n_splits,
+        }
+
+    @staticmethod
+    def _set(tup, i, value):
+        return tup[:i] + (value,) + tup[i + 1:]
+
+    def actions(self, state):
+        out = []
+        disp_up = state['disp'] == 'up'
+        splits = state['splits']
+        held = state['held']
+
+        for w, (status, registered, crash_left) in enumerate(state['workers']):
+            alive = status == 'up'
+            ready = alive and registered and disp_up
+            mine = held[w]
+
+            # op register_worker: (re-)register after a worker restart
+            # or after a dispatcher restart wiped the registry.
+            if alive and not registered and disp_up:
+                nxt = dict(state)
+                nxt['workers'] = self._set(
+                    state['workers'], w, ('up', True, crash_left))
+                out.append(('register(w%d)' % w, nxt, True))
+
+            # op lease: grant a PENDING split. No ceiling check and no
+            # attempt burn at grant — both live on the expiry path,
+            # exactly like _op_lease/_expire_leases.
+            if ready and mine == _IDLE:
+                for s, (st, attempt, _holder) in enumerate(splits):
+                    if st == PENDING:
+                        nxt = dict(state)
+                        nxt['splits'] = self._set(
+                            splits, s, (LEASED, attempt, w))
+                        nxt['held'] = self._set(held, w, (s, _HELD))
+                        out.append(('lease(w%d,s%d)' % (w, s), nxt, True))
+
+            if mine != _IDLE:
+                s, stage = mine
+                st, attempt, holder = splits[s]
+
+                # worker streams the split to the client. Needs no RPC:
+                # it happens even if the lease silently expired, which
+                # is exactly the duplicate-delivery window the client
+                # dedups; one stream per granted lease, structurally.
+                if alive and stage == _HELD:
+                    nxt = dict(state)
+                    nxt['held'] = self._set(held, w, (s, _STREAMED))
+                    out.append(('stream(w%d,s%d)' % (w, s), nxt, True))
+
+                # op complete: in-memory DONE mark + write-ahead journal
+                # line + ok reply in one dispatcher step...
+                if ready and stage == _STREAMED and st == LEASED \
+                        and holder == w:
+                    nxt = dict(state)
+                    nxt['splits'] = self._set(splits, s, (DONE, attempt, None))
+                    nxt['journal'] = self._set(state['journal'], s, True)
+                    nxt['held'] = self._set(held, w, _IDLE)
+                    out.append(('complete(w%d,s%d)' % (w, s), nxt, True))
+
+                    # ...with two crash windows. Mid-write-ahead: the
+                    # DONE mark happened but the journal line did not;
+                    # the snapshot still says LEASED, so restore brings
+                    # the split back as a leased orphan.
+                    if state['dcrash'] > 0:
+                        nxt = dict(state)
+                        nxt['splits'] = self._set(
+                            splits, s, (DONE, attempt, None))
+                        nxt['disp'] = 'down'
+                        nxt['dcrash'] = state['dcrash'] - 1
+                        out.append(('complete_crash_prejournal(w%d,s%d)'
+                                    % (w, s), nxt, False))
+                        # Post-journal, pre-reply: durable DONE, but the
+                        # worker never hears ok and will retry.
+                        nxt = dict(state)
+                        nxt['splits'] = self._set(
+                            splits, s, (DONE, attempt, None))
+                        nxt['journal'] = self._set(state['journal'], s, True)
+                        nxt['disp'] = 'down'
+                        nxt['dcrash'] = state['dcrash'] - 1
+                        out.append(('complete_crash_prereply(w%d,s%d)'
+                                    % (w, s), nxt, False))
+
+                # op complete retry / stale lease: the dispatcher replies
+                # ok (idempotent DONE) or rejects (lease moved on);
+                # either way the worker forgets the split.
+                if ready and stage == _STREAMED \
+                        and not (st == LEASED and holder == w):
+                    nxt = dict(state)
+                    nxt['held'] = self._set(held, w, _IDLE)
+                    out.append(('complete_forget(w%d,s%d)' % (w, s),
+                                nxt, True))
+
+                # op heartbeat `held` claim: adopt a restored orphan
+                # lease this worker still physically holds
+                # (ledger_adoptions in _op_heartbeat).
+                if ready and st == LEASED and holder is None:
+                    nxt = dict(state)
+                    nxt['splits'] = self._set(splits, s, (LEASED, attempt, w))
+                    out.append(('adopt(w%d,s%d)' % (w, s), nxt, True))
+
+            # worker crash: the process dies with its held split; its
+            # lease lingers until the TTL expires it.
+            if alive and crash_left > 0:
+                nxt = dict(state)
+                nxt['workers'] = self._set(
+                    state['workers'], w, ('down', registered, crash_left - 1))
+                nxt['held'] = self._set(held, w, _IDLE)
+                out.append(('worker_crash(w%d)' % w, nxt, False))
+            if not alive:
+                # restart with a fresh (unregistered) identity
+                nxt = dict(state)
+                nxt['workers'] = self._set(
+                    state['workers'], w, ('up', False, crash_left))
+                out.append(('worker_restart(w%d)' % w, nxt, False))
+
+        # dispatcher-side timers ---------------------------------------
+        if disp_up:
+            for s, (st, attempt, holder) in enumerate(splits):
+                if st == LEASED and holder is not None:
+                    # _expire_leases: revoke, burn an attempt, poison at
+                    # the ceiling. Enabled even while the holder lives —
+                    # that is the missed-heartbeat interleaving.
+                    nxt = dict(state)
+                    burned = attempt + 1
+                    if burned >= self.max_attempts:
+                        nxt['splits'] = self._set(
+                            splits, s, (FAILED, burned, None))
+                        nxt['poison'] = self._set(state['poison'], s, True)
+                    else:
+                        nxt['splits'] = self._set(
+                            splits, s, (PENDING, burned, None))
+                    nxt['burns'] = self._set(state['burns'], s,
+                                             state['burns'][s] + 1)
+                    out.append(('expire(s%d)' % s, nxt, True))
+                if st == LEASED and holder is None:
+                    # orphan grace TTL lapse: requeue attempt-INTACT
+                    # (ledger_requeues in _expire_leases).
+                    nxt = dict(state)
+                    nxt['splits'] = self._set(splits, s,
+                                              (PENDING, attempt, None))
+                    out.append(('orphan_requeue(s%d)' % s, nxt, True))
+
+        # dispatcher crash / ledger restore ----------------------------
+        if disp_up and state['dcrash'] > 0:
+            nxt = dict(state)
+            nxt['disp'] = 'down'
+            nxt['dcrash'] = state['dcrash'] - 1
+            out.append(('dispatcher_crash', nxt, False))
+        if not disp_up:
+            nxt = dict(state)
+            nxt['disp'] = 'up'
+            nxt['splits'] = tuple(
+                self._restore_split(sp, state['journal'][s])
+                for s, sp in enumerate(splits))
+            # the in-memory worker registry died with the process
+            nxt['workers'] = tuple((status, False, crash_left)
+                                   for status, _reg, crash_left
+                                   in state['workers'])
+            out.append(('dispatcher_restart', nxt, False))
+
+        return out
+
+    def _restore_split(self, split, journaled):
+        """_restore_from_ledger semantics for one split.
+
+        The snapshot is taken as current for grant/expiry transitions
+        (losing one costs a grace-TTL reconciliation, never an attempt);
+        DONE becomes durable only through the journal, so a DONE mark
+        without its journal line restores as its pre-mark LEASED state.
+        """
+        st, attempt, _holder = split
+        if journaled:
+            return (DONE, attempt, None)
+        if st == DONE:
+            # mark happened, journal append did not: pre-mark LEASED,
+            # restored as an orphan
+            return (LEASED, attempt, None)
+        if st == LEASED:
+            # leased -> orphan under the grace TTL, attempts intact
+            return (LEASED, attempt, None)
+        return (st, attempt, None)
+
+    def invariants(self):
+        def exactly_once(state):
+            # A durably completed split stays DONE: it can never return
+            # to PENDING, so it can never be re-granted or re-streamed.
+            return all(sp[0] == DONE
+                       for sp, j in zip(state['splits'], state['journal'])
+                       if j)
+
+        def restart_never_burns(state):
+            return all(sp[1] == b
+                       for sp, b in zip(state['splits'], state['burns']))
+
+        def poison_sticky(state):
+            return all(sp[0] == FAILED
+                       for sp, p in zip(state['splits'], state['poison'])
+                       if p)
+
+        return [('exactly-once', exactly_once),
+                ('restart-never-burns', restart_never_burns),
+                ('poison-sticky', poison_sticky)]
+
+    def invariant_violation(self, state):
+        # fused hot-path equivalent of invariants(): one loop per state
+        journal = state['journal']
+        burns = state['burns']
+        poison = state['poison']
+        for i, sp in enumerate(state['splits']):
+            if journal[i] and sp[0] != DONE:
+                return 'exactly-once'
+            if sp[1] != burns[i]:
+                return 'restart-never-burns'
+            if poison[i] and sp[0] != FAILED:
+                return 'poison-sticky'
+        return None
+
+    def settled(self, state):
+        return (state['disp'] == 'up'
+                and all(sp[0] in (DONE, FAILED) for sp in state['splits'])
+                and all(h == _IDLE for h in state['held']))
+
+    def describe(self, state):
+        splits = '/'.join('%s%d%s' % (sp[0][0], sp[1],
+                                      '' if sp[2] is None else 'w%d' % sp[2])
+                          for sp in state['splits'])
+        return 'D%s %s' % ('+' if state['disp'] == 'up' else '-', splits)

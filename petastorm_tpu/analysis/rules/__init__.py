@@ -9,8 +9,11 @@ and document it in ``docs/development.md``.
 
 from petastorm_tpu.analysis.rules.contracts import (DegradeContractRule,
                                                     ReadonlyViewMutationRule)
+from petastorm_tpu.analysis.rules.env_registry import EnvKillSwitchRegistryRule
 from petastorm_tpu.analysis.rules.lifecycle import (ResourceLifecycleRule,
                                                     ShortWriteRule)
+from petastorm_tpu.analysis.rules.protocol_model import \
+    ProtocolModelConformanceRule
 from petastorm_tpu.analysis.rules.locking import (BlockingUnderLockRule,
                                                   CvWaitNoPredicateRule,
                                                   FlockDisciplineRule,
@@ -30,6 +33,8 @@ ALL_RULES = (
     LockOrderCycleRule(),
     CvWaitNoPredicateRule(),
     WireProtocolConformanceRule(),
+    ProtocolModelConformanceRule(),
+    EnvKillSwitchRegistryRule(),
     UnboundedRecvRule(),
     ShortWriteRule(),
     DegradeContractRule(),

@@ -1,0 +1,125 @@
+"""Env kill-switch registry conformance (ISSUE 19).
+
+Every subsystem here ships with a ``PETASTORM_TPU_*`` kill switch or
+tuning knob (the degrade-not-fail contract: ISSUE 3 shm, ISSUE 7
+native, ISSUE 13 ingest, ...).  The switches only help an operator who
+can FIND them: an env read that never made it into the documentation is
+a dead rescue lever, and a documented variable whose read was renamed
+away is worse — the operator sets it and nothing happens.
+
+This repo-scope rule diffs the code's env vocabulary (every string
+constant shaped like a ``PETASTORM_TPU_*`` name in the shared ASTs)
+against the registry table in ``docs/configuration.md``, both
+directions.  The registry row format is one markdown table row per
+variable with its default and degrade behavior; any ``|``-delimited row
+whose first cell names the variable counts.
+
+The rule is gated on a multi-module lint (the real tree), so the
+single-module fixture harness other rules use stays quiet; its own
+fixtures call ``check_repo`` with an explicit ``registry_path``.
+"""
+
+import ast
+import os
+import re
+
+from petastorm_tpu.analysis.framework import Finding
+from petastorm_tpu.analysis.rules.base import RepoRule
+
+#: repo-root-relative location of the registry (report path for findings).
+REGISTRY_DOC = 'docs/configuration.md'
+
+#: filesystem default: <repo root>/docs/configuration.md, resolved from
+#: this package's location so a bare checkout finds it regardless of CWD.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+DEFAULT_REGISTRY_PATH = os.path.join(_REPO_ROOT, 'docs', 'configuration.md')
+
+_ENV_NAME = re.compile(r'^PETASTORM_TPU_[A-Z0-9_]+$')
+_REGISTRY_ROW = re.compile(r'^\|\s*`?(PETASTORM_TPU_[A-Z0-9_]+)`?\s*\|')
+
+
+def collect_env_reads(module):
+    """Env-switch name -> first line: every string constant that IS a
+    ``PETASTORM_TPU_*`` name (implicit concatenation is folded by the
+    parser, so split names still match whole)."""
+    reads = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _ENV_NAME.match(node.value):
+            reads.setdefault(node.value, node.lineno)
+    return reads
+
+
+def parse_registry(path):
+    """Registered variable -> table-row line from the markdown registry;
+    ``None`` when the registry file does not exist."""
+    if not os.path.isfile(path):
+        return None
+    registered = {}
+    with open(path, 'rb') as f:
+        text = f.read().decode('utf-8', 'replace')
+    for lineno, line in enumerate(text.splitlines(), 1):
+        match = _REGISTRY_ROW.match(line.strip())
+        if match:
+            registered.setdefault(match.group(1), lineno)
+    return registered
+
+
+class EnvKillSwitchRegistryRule(RepoRule):
+    rule_id = 'env-kill-switch-registry'
+    motivation = ('a PETASTORM_TPU_* kill switch the operator cannot '
+                  'find in docs/configuration.md is a dead rescue '
+                  'lever, and a documented variable whose read was '
+                  'renamed away is worse — setting it does nothing; '
+                  'the registry and the code must list the same '
+                  'switches')
+
+    def __init__(self, registry_path=None):
+        self.registry_path = registry_path or DEFAULT_REGISTRY_PATH
+
+    #: The registry-row-without-a-read direction is only sound when
+    #: (most of) the tree is on the table — a subdirectory scan sees a
+    #: fraction of the reads and would flood false "dead rows".  The
+    #: full tree surfaces 20+ distinct switches; a partial scan far
+    #: fewer.
+    FULL_SCAN_MIN_READS = 10
+
+    def check_repo(self, modules):
+        if len(modules) < 2:
+            return  # single-module fixture harness: stay quiet
+        reads = {}  # name -> (module, line) of first read
+        for module in modules:
+            for name, line in collect_env_reads(module).items():
+                reads.setdefault(name, (module, line))
+        if not reads:
+            return  # no env vocabulary on the table (fixture trees)
+        registered = parse_registry(self.registry_path)
+        if registered is None:
+            module, line = sorted(reads.values(),
+                                  key=lambda ml: ml[0].path)[0]
+            yield self.finding_at(
+                module.path, line,
+                'PETASTORM_TPU_* switches are read but %s does not '
+                'exist — create the registry table (variable, '
+                'default, degrade behavior) so operators can find '
+                'the levers' % REGISTRY_DOC)
+            return
+        for name in sorted(set(reads) - set(registered)):
+            module, line = reads[name]
+            yield self.finding_at(
+                module.path, line,
+                'env switch %r is read here but missing from the %s '
+                'registry — document its default and degrade behavior '
+                'so the rescue lever is findable' % (name, REGISTRY_DOC))
+        if len(reads) < self.FULL_SCAN_MIN_READS:
+            return  # partial scan: cannot judge registry rows unread
+        for name in sorted(set(registered) - set(reads)):
+            yield self.finding_at(
+                REGISTRY_DOC, registered[name],
+                'registry documents %r but no module reads it — the '
+                'operator sets it and nothing happens; drop the row or '
+                'restore the read' % name)
+
+    def finding_at(self, path, line, message):
+        return Finding(path, line, self.rule_id, message)

@@ -1,0 +1,223 @@
+"""Code <-> protocol-model conformance (ISSUE 19).
+
+The protocol models in :mod:`petastorm_tpu.analysis.protocol.models`
+verify the lease/ledger/drain state machines exhaustively — but a model
+only protects the code while the two agree on the *alphabet*.  A
+dispatcher op handler the model never heard of, a split-state literal
+renamed on one side, a ledger state code the models no longer cover:
+each silently shrinks the verified surface while ``petastorm-tpu-model
+--check`` keeps printing OK.
+
+This repo-scope rule diffs the code's protocol vocabulary (extracted
+from the shared ASTs) against the models' declared alphabets, both
+directions:
+
+* dispatcher ``_op_*`` handlers <-> the ``OP_COVERAGE`` ownership map
+  (every handler must be claimed by a model or explicitly marked
+  observability/unmodeled; every claimed op must still exist);
+* dispatcher split-state literals (the ``_PENDING, _LEASED, ... =``
+  tuple) <-> ``SplitLeaseModel.STATES``;
+* ledger ``_STATE_CODES`` — keys against the split-lease states it
+  journals, compact-code values against ``PieceLeaseModel.STATES``
+  (the materialize ledger shares the code vocabulary);
+* controller piece-state literals <-> ``PieceLeaseModel.STATES``, and
+  every op in ``PieceLeaseModel.OPS`` must name a real controller
+  method;
+* autoscaler action literals <-> ``DrainModel.AUTOSCALER_ACTIONS``.
+
+Stdlib-only like the rest of ptlint: the model alphabets import from a
+bare checkout (the protocol package has no third-party imports).
+"""
+
+import ast
+import re
+
+from petastorm_tpu.analysis.framework import Finding
+from petastorm_tpu.analysis.rules.base import RepoRule
+
+#: Path suffixes of the modules whose vocabulary the models verify.
+DISPATCHER = 'service/dispatcher.py'
+LEDGER = 'service/ledger.py'
+AUTOSCALER = 'service/autoscaler.py'
+CONTROLLER = 'materialize/controller.py'
+
+_OP_PREFIX = '_op_'
+_STATE_NAME = re.compile(r'^_[A-Z][A-Z_]*$')
+
+
+def _matches(path, member):
+    return path == member or path.endswith('/' + member)
+
+
+def collect_handlers(module):
+    """``_op_<name>`` method definitions: op name -> def line."""
+    handlers = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name.startswith(_OP_PREFIX):
+            handlers.setdefault(node.name[len(_OP_PREFIX):], node.lineno)
+    return handlers
+
+
+def collect_state_literals(module):
+    """State-vocabulary literals: string values of tuple assignments
+    whose targets are all ``_CAPS`` names (``_PENDING, _LEASED, ... =
+    'pending', 'leased', ...``) — the declaration idiom both the
+    dispatcher and the materialize controller use."""
+    literals = {}
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target, value = node.targets[0], node.value
+        if not (isinstance(target, ast.Tuple) and isinstance(value, ast.Tuple)
+                and target.elts and len(target.elts) == len(value.elts)):
+            continue
+        if not all(isinstance(name, ast.Name) and _STATE_NAME.match(name.id)
+                   for name in target.elts):
+            continue
+        if not all(isinstance(lit, ast.Constant)
+                   and isinstance(lit.value, str) for lit in value.elts):
+            continue
+        for lit in value.elts:
+            literals.setdefault(lit.value, lit.lineno)
+    return literals
+
+
+def collect_state_codes(module):
+    """The ledger's ``_STATE_CODES`` dict: (keys, values) as
+    name -> line maps; ``(None, None)`` when the module has none."""
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == '_STATE_CODES'
+                and isinstance(node.value, ast.Dict)):
+            continue
+        keys, values = {}, {}
+        for key, value in zip(node.value.keys, node.value.values):
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                keys.setdefault(key.value, key.lineno)
+            if isinstance(value, ast.Constant) \
+                    and isinstance(value.value, str):
+                values.setdefault(value.value, value.lineno)
+        return keys, values
+    return None, None
+
+
+def collect_scale_actions(module):
+    """Autoscaler action names: first argument of every
+    ``_after_action(...)`` call — the single recording sink both scale
+    actions flow through (stats counter keys like ``'scale_outs'`` are
+    deliberately NOT vocabulary)."""
+    actions = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == '_after_action' \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            actions.setdefault(node.args[0].value, node.args[0].lineno)
+    return actions
+
+
+def _method_names(module):
+    return {node.name: node.lineno for node in ast.walk(module.tree)
+            if isinstance(node, ast.FunctionDef)}
+
+
+class ProtocolModelConformanceRule(RepoRule):
+    rule_id = 'protocol-model-conformance'
+    motivation = ('the protocol models verify the lease/drain/ledger '
+                  'state machines exhaustively, but only while code and '
+                  'model agree on the alphabet — an unclaimed _op_ '
+                  'handler, a renamed state literal, or a dropped '
+                  'autoscaler action silently shrinks the verified '
+                  'surface while --check keeps printing OK')
+
+    def check_repo(self, modules):
+        from petastorm_tpu.analysis.protocol.models import (
+            OP_COVERAGE, DrainModel, PieceLeaseModel, SplitLeaseModel)
+        by_target = {}
+        for module in modules:
+            for target in (DISPATCHER, LEDGER, AUTOSCALER, CONTROLLER):
+                if _matches(module.path, target):
+                    by_target.setdefault(target, module)
+
+        dispatcher = by_target.get(DISPATCHER)
+        if dispatcher is not None:
+            yield from self._check_op_coverage(dispatcher, OP_COVERAGE)
+            yield from self._diff(
+                dispatcher, collect_state_literals(dispatcher),
+                SplitLeaseModel.STATES, 'split-state literal',
+                'SplitLeaseModel.STATES')
+
+        ledger = by_target.get(LEDGER)
+        if ledger is not None:
+            keys, values = collect_state_codes(ledger)
+            if keys is not None:
+                yield from self._diff(
+                    ledger, keys, SplitLeaseModel.STATES,
+                    '_STATE_CODES state', 'SplitLeaseModel.STATES')
+                yield from self._diff(
+                    ledger, values, PieceLeaseModel.STATES,
+                    '_STATE_CODES code', 'PieceLeaseModel.STATES')
+
+        controller = by_target.get(CONTROLLER)
+        if controller is not None:
+            yield from self._diff(
+                controller, collect_state_literals(controller),
+                PieceLeaseModel.STATES, 'piece-state literal',
+                'PieceLeaseModel.STATES')
+            methods = _method_names(controller)
+            for op in sorted(PieceLeaseModel.OPS - set(methods)):
+                yield self.finding_at(
+                    controller, 1,
+                    'PieceLeaseModel.OPS names %r but the controller '
+                    'defines no such method — the model verifies a '
+                    'transition the code lost (or the method was '
+                    'renamed without updating the model)' % op)
+
+        autoscaler = by_target.get(AUTOSCALER)
+        if autoscaler is not None:
+            yield from self._diff(
+                autoscaler, collect_scale_actions(autoscaler),
+                DrainModel.AUTOSCALER_ACTIONS, 'autoscaler action',
+                'DrainModel.AUTOSCALER_ACTIONS')
+
+    def _check_op_coverage(self, dispatcher, op_coverage):
+        handlers = collect_handlers(dispatcher)
+        for op in sorted(set(handlers) - set(op_coverage)):
+            yield self.finding_at(
+                dispatcher, handlers[op],
+                'dispatcher handler _op_%s is not claimed by any '
+                'protocol model — add it to OP_COVERAGE in '
+                'analysis/protocol/models/__init__.py (owned by a '
+                'model, or observability/unmodeled with a '
+                'justification) so the verified surface stays '
+                'honest' % op)
+        for op in sorted(set(op_coverage) - set(handlers)):
+            yield self.finding_at(
+                dispatcher, 1,
+                'OP_COVERAGE claims dispatcher op %r but no _op_%s '
+                'handler exists — the models document a protocol arm '
+                'the code lost; drop the map entry or restore the '
+                'handler' % (op, op))
+
+    def _diff(self, module, code_vocab, model_vocab, what, where):
+        for name in sorted(set(code_vocab) - set(model_vocab)):
+            yield self.finding_at(
+                module, code_vocab[name],
+                '%s %r is not in %s — the checker cannot see states '
+                'the model does not declare; add it to the model '
+                'alphabet (and its transitions) or retire the '
+                'literal' % (what, name, where))
+        for name in sorted(set(model_vocab) - set(code_vocab)):
+            yield self.finding_at(
+                module, 1,
+                '%s declares %r but the code vocabulary here lost it '
+                '(%s) — the model verifies a state machine the code '
+                'no longer implements; re-align one side'
+                % (where, name, what))
+
+    def finding_at(self, module, line, message):
+        return Finding(module.path, line, self.rule_id, message)

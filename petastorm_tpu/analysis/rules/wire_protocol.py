@@ -17,6 +17,15 @@ peer-group module: literals inside a comparison (``tag == b'R'``,
 *handled*; every other occurrence (send_multipart frame lists, framing
 assignments, ``return b'A', payload``) counts as *sent*.  Per group,
 ``sent - handled`` and ``handled - sent`` are findings.
+
+ISSUE 19 extends the catalogue one protocol layer up, to the dispatcher
+RPC *op-name* vocabulary: every ``{'op': '<name>', ...}`` request dict
+built by a client-side module must have a matching ``_op_<name>``
+handler on the dispatcher, and every handler must have a sender
+somewhere in the group — the same both-direction mechanics as frame
+tags.  Dict literals passed to ``.append(...)`` are excluded: those are
+ledger *journal* records (``{'op': 'done', ...}``), a durable-format
+namespace, not RPC traffic.
 """
 
 import ast
@@ -33,6 +42,19 @@ PEER_GROUPS = (
                       'service/dispatcher.py', 'service/cluster.py')),
 )
 
+#: Modules that speak the dispatcher RPC dict protocol: the dispatcher
+#: handles (``_op_*`` methods), everything else builds ``{'op': ...}``
+#: request dicts.  Observability tools ride the same socket, so they
+#: sit in the group too.
+OP_GROUPS = (
+    ('data-service-rpc', ('service/dispatcher.py', 'service/worker.py',
+                          'service/client.py', 'service/cli.py',
+                          'telemetry/diagnose.py', 'telemetry/top.py',
+                          'tools/doctor.py', 'test_util/chaos.py')),
+)
+
+_OP_HANDLER_PREFIX = '_op_'
+
 
 def _matches(path, member):
     return path == member or path.endswith('/' + member)
@@ -41,6 +63,37 @@ def _matches(path, member):
 def _is_frame_tag(value):
     return isinstance(value, bytes) and len(value) == 1 \
         and 65 <= value[0] <= 90  # one uppercase letter
+
+
+def collect_ops(module):
+    """(sent, handled): op name -> first line.
+
+    Sent = the string value under an ``'op'`` key in a dict literal,
+    unless the dict is an argument to a ``.append(...)`` call (ledger
+    journal records reuse the key for a durable format, not RPC).
+    Handled = ``_op_<name>`` method definitions.
+    """
+    journal_dicts = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == 'append':
+            for arg in node.args:
+                if isinstance(arg, ast.Dict):
+                    journal_dicts.add(id(arg))
+    sent, handled = {}, {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name.startswith(_OP_HANDLER_PREFIX):
+            handled.setdefault(node.name[len(_OP_HANDLER_PREFIX):],
+                               node.lineno)
+        elif isinstance(node, ast.Dict) and id(node) not in journal_dicts:
+            for key, value in zip(node.keys, node.values):
+                if isinstance(key, ast.Constant) and key.value == 'op' \
+                        and isinstance(value, ast.Constant) \
+                        and isinstance(value.value, str):
+                    sent.setdefault(value.value, key.lineno)
+    return sent, handled
 
 
 def collect_tags(module):
@@ -100,6 +153,46 @@ class WireProtocolConformanceRule(RepoRule):
                     'peer module ever sends it — a dead protocol arm '
                     '(or its sender was renamed away); wire the sender '
                     'or retire the arm' % (tag, group_name))
+        yield from self._check_op_vocabulary(modules)
+
+    def _check_op_vocabulary(self, modules):
+        """RPC op-name catalogue: every ``{'op': X}`` built in the group
+        needs an ``_op_X`` handler, and every handler needs a sender."""
+        for group_name, members in OP_GROUPS:
+            present = []
+            for module in modules:
+                for member in members:
+                    if _matches(module.path, member):
+                        present.append((member, module))
+            if len({member for member, _ in present}) < 2:
+                continue
+            sent, handled = {}, {}
+            for _member, module in present:
+                mod_sent, mod_handled = collect_ops(module)
+                for op, line in mod_sent.items():
+                    sent.setdefault(op, (module, line))
+                for op, line in mod_handled.items():
+                    handled.setdefault(op, (module, line))
+            if not handled:
+                continue  # no dispatcher side on the table
+            for op in sorted(set(sent) - set(handled)):
+                module, line = sent[op]
+                yield self.finding_at(
+                    module, line,
+                    "RPC op %r is sent on the %s socket but no peer "
+                    "module defines _op_%s — the dispatcher replies "
+                    "unknown-op and the caller's request is dead on "
+                    "arrival; add the handler or retire the call"
+                    % (op, group_name, op))
+            for op in sorted(set(handled) - set(sent)):
+                module, line = handled[op]
+                yield self.finding_at(
+                    module, line,
+                    "RPC op %r has an _op_%s handler on the %s socket "
+                    "but no module in the group ever sends it — a dead "
+                    "protocol arm (or its caller was renamed away); "
+                    "wire a sender or retire the handler"
+                    % (op, op, group_name))
 
     def finding_at(self, module, line, message):
         return Finding(module.path, line, self.rule_id, message)

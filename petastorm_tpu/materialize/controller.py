@@ -558,7 +558,9 @@ def derive_candidates(journals=None, top_k=4):
     for journal in journals:
         try:
             records = journal.records()
-        except Exception:  # noqa: BLE001 — candidates are advisory
+        except Exception as exc:  # noqa: BLE001 — candidates are advisory
+            logger.warning('materialize: skipping unreadable provenance '
+                           'journal (%s: %s)', type(exc).__name__, exc)
             continue
         for record in records:
             if not isinstance(record, dict):

@@ -102,7 +102,7 @@ def layout_stats(dataset_url, columns=None, storage_options=None,
         finally:
             try:
                 handle.close()
-            except Exception:  # noqa: BLE001 — best-effort teardown
+            except OSError:  # best-effort teardown
                 pass
         totals['files'] += 1
         for rg in range(metadata.num_row_groups):

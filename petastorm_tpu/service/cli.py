@@ -146,6 +146,15 @@ def _build_parser():
 
     p = sub.add_parser('stop', help='ask the dispatcher to shut down')
     p.add_argument('--dispatcher', required=True)
+
+    c = sub.add_parser('clock', help='measure clock offset and RTT to '
+                                     'the dispatcher (the handshake '
+                                     'cross-process span alignment uses; '
+                                     'see docs/observability.md)')
+    c.add_argument('--dispatcher', required=True)
+    c.add_argument('--samples', type=int, default=5,
+                   help='handshakes to run; the lowest-RTT one wins '
+                        '(NTP-style best-of-N)')
     return parser
 
 
@@ -252,6 +261,25 @@ def main(argv=None):
     if args.command == 'stop':
         _rpc_once(args.dispatcher, {'op': 'stop'})
         print('dispatcher at %s stopped' % args.dispatcher)
+        return 0
+
+    if args.command == 'clock':
+        import zmq
+
+        from petastorm_tpu.service.worker import _Rpc
+        from petastorm_tpu.telemetry.spans import measure_clock_offset
+        context = zmq.Context()
+        rpc = _Rpc(context, args.dispatcher)
+        try:
+            samples = [measure_clock_offset(
+                lambda: rpc.call({'op': 'clock'})['t_mono'])
+                for _ in range(max(1, args.samples))]
+        finally:
+            rpc.close()
+            context.term()
+        offset_s, rtt_s = min(samples, key=lambda s: s[1])
+        print(json.dumps({'offset_s': offset_s, 'rtt_s': rtt_s,
+                          'samples': len(samples)}, sort_keys=True))
         return 0
 
     return 2  # unreachable: argparse enforces the command set

@@ -542,6 +542,61 @@ SCENARIOS = {
 SMOKE_SCENARIOS = ('worker_kill', 'worker_drain', 'message_drop',
                    'autoscale_storm')
 
+#: Every key a scenario dict (catalogue or --spec-json) may carry.
+#: ``name``/``summary`` label the run; ``protocol`` is the
+#: model-checker counterexample payload the bridge attaches
+#: (analysis/protocol/bridge.py) — carried through to the report,
+#: consumed by test_util/protocol_replay.py, ignored by the runner.
+_SPEC_KEYS = frozenset([
+    'name', 'summary', 'protocol', 'kills', 'faults', 'config',
+    'filesystem', 'cache_plane', 'n_workers', 'dispatcher_subprocess',
+    'runner', 'tenants', 'max_autoscale_actions', 'throttle_s',
+    'min_entries_before_kill'])
+
+_KILL_ROLES = ('dispatcher', 'worker', 'materialize')
+_KILL_SIGNALS = ('kill', 'term')
+
+
+def load_spec_json(path):
+    """Load + validate a ``--spec-json`` scenario file (ISSUE 19: the
+    model-checker counterexample bridge emits these).  Returns
+    ``(name, scenario)`` for :func:`run_scenario`; raises ``ValueError``
+    on an invalid spec so a typo'd seam/action/phase fails loudly
+    instead of silently never firing."""
+    with open(path, 'rb') as f:
+        spec = json.loads(f.read().decode('utf-8'))
+    if not isinstance(spec, dict):
+        raise ValueError('spec must be a JSON object, got %s'
+                         % type(spec).__name__)
+    unknown = sorted(set(spec) - _SPEC_KEYS)
+    if unknown:
+        raise ValueError('unknown spec key(s) %s (known: %s)'
+                         % (', '.join(unknown), ', '.join(sorted(_SPEC_KEYS))))
+    for kill in spec.get('kills') or ():
+        if not isinstance(kill, dict):
+            raise ValueError('each kill must be an object, got %r' % (kill,))
+        if kill.get('role') not in _KILL_ROLES:
+            raise ValueError('kill role must be one of %s, got %r'
+                             % (_KILL_ROLES, kill.get('role')))
+        if kill.get('phase') not in PHASES:
+            raise ValueError('kill phase must be one of %s, got %r'
+                             % (PHASES, kill.get('phase')))
+        if kill.get('signal', 'kill') not in _KILL_SIGNALS:
+            raise ValueError('kill signal must be one of %s, got %r'
+                             % (_KILL_SIGNALS, kill.get('signal')))
+    # Fault validation is ChaosState's constructor: unknown actions and
+    # unhandleable error seams raise there, unknown seams warn.
+    ChaosState({'seed': 0, 'faults': spec.get('faults') or []})
+    runner = spec.get('runner')
+    if runner not in (None, 'materialize'):
+        raise ValueError("runner must be 'materialize' when set, got %r"
+                         % (runner,))
+    name = str(spec.get('name')
+               or 'spec:%s' % os.path.splitext(os.path.basename(path))[0])
+    scenario = {key: value for key, value in spec.items() if key != 'name'}
+    scenario.setdefault('summary', 'replayed --spec-json scenario')
+    return name, scenario
+
 
 # -- runner -------------------------------------------------------------------
 
@@ -838,10 +893,14 @@ def _run_materialize_scenario(name, dataset_url, rows, workdir, seed=7,
 
 
 def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
-                 expected_digest=None, timeout_s=240.0):
+                 expected_digest=None, timeout_s=240.0, scenario=None):
     """One scenario end to end; returns a report dict (``ok`` plus the
     per-invariant verdicts and the injection counts).  Raises nothing:
-    every failure lands in the report — the matrix must finish."""
+    every failure lands in the report — the matrix must finish.
+
+    ``scenario`` overrides the catalogue lookup with an ad-hoc scenario
+    dict (a validated ``--spec-json`` load); ``name`` then only labels
+    the report."""
     import threading
 
     import numpy as np
@@ -851,7 +910,8 @@ def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
                                        ServiceDataLoader)
     from petastorm_tpu.workers_pool import shm_plane
 
-    scenario = SCENARIOS[name]
+    if scenario is None:
+        scenario = SCENARIOS[name]
     if scenario.get('runner') == 'materialize':
         # The materialization drill runs no service fleet: one
         # controller process, killed and restarted, then a direct
@@ -1146,9 +1206,12 @@ def _build_fault_fs(fs_spec):
     return factory(LocalFileSystem(), **kwargs)
 
 
-def run_matrix(names, dataset_url=None, rows=None, workdir=None, seed=7):
+def run_matrix(names, dataset_url=None, rows=None, workdir=None, seed=7,
+               scenario_overrides=None):
     """Run each named scenario against one dataset + one ground-truth
-    digest; returns ``(reports, all_ok)``."""
+    digest; returns ``(reports, all_ok)``.  ``scenario_overrides`` maps
+    a name to an ad-hoc scenario dict (the ``--spec-json`` path) used
+    instead of the catalogue entry."""
     import shutil
     import tempfile
     owned = workdir is None
@@ -1164,7 +1227,9 @@ def run_matrix(names, dataset_url=None, rows=None, workdir=None, seed=7):
         for name in names:
             t0 = time.monotonic()
             report = run_scenario(name, dataset_url, rows, workdir,
-                                  seed=seed, expected_digest=expected)
+                                  seed=seed, expected_digest=expected,
+                                  scenario=(scenario_overrides or
+                                            {}).get(name))
             report['elapsed_s'] = round(time.monotonic() - t0, 1)
             reports.append(report)
             logger.info('scenario %-20s %s (%.1fs)', name,
@@ -1220,7 +1285,13 @@ def main(argv=None):
             cmd, help=('run one scenario' if cmd == 'run'
                        else 'run a scenario set'))
         if cmd == 'run':
-            p.add_argument('scenario', choices=sorted(SCENARIOS))
+            p.add_argument('scenario', nargs='?', default=None,
+                           choices=sorted(SCENARIOS))
+            p.add_argument('--spec-json', default=None, metavar='PATH',
+                           help='run an ad-hoc scenario from a JSON spec '
+                                'file instead of the catalogue (the '
+                                'petastorm-tpu-model --chaos-spec '
+                                'counterexample bridge emits these)')
         else:
             p.add_argument('--scenarios', default=None,
                            help='comma-separated names (default: all)')
@@ -1244,8 +1315,20 @@ def main(argv=None):
         return 0
     if args.dataset_url is not None and args.rows is None:
         parser.error('--dataset-url requires --rows')
+    scenario_overrides = None
     if args.command == 'run':
-        names = [args.scenario]
+        if (args.scenario is None) == (args.spec_json is None):
+            parser.error('run takes a scenario name or --spec-json '
+                         '(exactly one)')
+        if args.spec_json is not None:
+            try:
+                name, scenario = load_spec_json(args.spec_json)
+            except (OSError, ValueError) as e:
+                parser.error('bad --spec-json %s: %s' % (args.spec_json, e))
+            names = [name]
+            scenario_overrides = {name: scenario}
+        else:
+            names = [args.scenario]
     elif args.smoke:
         names = list(SMOKE_SCENARIOS)
     elif args.scenarios:
@@ -1256,7 +1339,8 @@ def main(argv=None):
     else:
         names = list(SCENARIOS)
     reports, ok = run_matrix(names, dataset_url=args.dataset_url,
-                             rows=args.rows, seed=args.seed)
+                             rows=args.rows, seed=args.seed,
+                             scenario_overrides=scenario_overrides)
     if args.json:
         print(json.dumps(reports, sort_keys=True, default=str))
     else:

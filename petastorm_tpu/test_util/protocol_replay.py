@@ -1,0 +1,216 @@
+"""Replay a protocol-model counterexample against a real Dispatcher.
+
+The model checker (``petastorm-tpu-model``) proves properties of the
+*model*; this harness closes the loop to the *code*: it takes the
+bridge spec a violated invariant renders (``analysis/protocol/bridge.py``
+→ ``protocol.steps``, the shortest counterexample's action labels) and
+drives a real in-process :class:`~petastorm_tpu.service.Dispatcher`
+through the same schedule — real ledger file, real ``_op_*`` handlers,
+real crash/restart via the release-and-reacquire idiom the control-plane
+tests use.  The protocol invariants are asserted on the REAL object
+after every step, so a model counterexample that the code actually
+shares becomes a failing real-process assertion
+(:class:`ProtocolReplayError`), and one the code does NOT share (a
+model-only artifact) replays clean.
+
+Only split-lease traces are replayable today: that model's alphabet maps
+one-to-one onto dispatcher operations.  Drain and piece-lease traces
+carry enough in the spec to replay, but no harness binding exists yet —
+:func:`replay` refuses them loudly rather than pretending.
+"""
+
+import re
+
+__all__ = ['ProtocolReplayError', 'replay']
+
+
+class ProtocolReplayError(AssertionError):
+    """A protocol invariant broke on the real dispatcher during replay."""
+
+
+_STEP = re.compile(r'^(?P<action>\w+)\((?P<args>[^)]*)\)$')
+
+#: Model actions with no dispatcher-side effect (data plane / worker
+#: internals): replayed as no-ops.
+_NO_OP_ACTIONS = frozenset(['stream', 'worker_crash'])
+
+
+def _parse(label):
+    match = _STEP.match(label)
+    if match is None:
+        return label, ()
+    args = tuple(a.strip() for a in match.group('args').split(',')
+                 if a.strip())
+    return match.group('action'), args
+
+
+class _SplitLeaseReplay(object):
+    """One split-lease replay session: model worker/split names map to
+    real worker ids / split ids as the trace grants them."""
+
+    def __init__(self, config_factory):
+        from petastorm_tpu.service import Dispatcher
+        self._dispatcher_cls = Dispatcher
+        self._config_factory = config_factory
+        self.dispatcher = Dispatcher(config_factory())
+        self.workers = {}          # model worker -> real worker_id
+        self.splits = {}           # model split -> real split_id
+        self.done_seen = set()     # real split ids observed DONE
+        self.failed_seen = set()   # real split ids observed FAILED
+        self.pre_crash_attempts = None
+
+    # -- step handlers --------------------------------------------------------
+
+    def register(self, w):
+        reply = self.dispatcher._op_register_worker(
+            {'data_addr': 'tcp://replay:%d' % (len(self.workers) + 1)})
+        self.workers[w] = reply['worker_id']
+
+    worker_restart = register
+
+    def lease(self, w, s):
+        if w not in self.workers:
+            self.register(w)
+        reply = self.dispatcher._op_lease({'worker_id': self.workers[w]})
+        split = reply.get('split')
+        if split is None:
+            raise ProtocolReplayError(
+                'replay step lease(%s,%s): the real dispatcher granted '
+                'nothing (reply %r) where the model granted a lease'
+                % (w, s, reply))
+        self.splits[s] = split['split_id']
+
+    def complete(self, w, s):
+        self.dispatcher._op_complete({'worker_id': self.workers[w],
+                                      'split_id': self.splits[s]})
+
+    complete_forget = complete
+
+    def complete_crash_prereply(self, w, s):
+        # The durable DONE record lands before the reply; the crash eats
+        # only the reply — complete, then die.
+        self.complete(w, s)
+        self.dispatcher_crash()
+
+    def complete_crash_prejournal(self, w, s):
+        # The crash lands before the write-ahead: durably the split is
+        # still a lease — die without completing.
+        self.dispatcher_crash()
+
+    def adopt(self, w, s):
+        if w not in self.workers:
+            self.register(w)
+        self.dispatcher._op_heartbeat({'worker_id': self.workers[w],
+                                       'held': [self.splits[s]]})
+
+    def expire(self, s):
+        self._lapse(self.splits[s])
+
+    def orphan_requeue(self, s):
+        self._lapse(self.splits[s])
+
+    def dispatcher_crash(self):
+        d = self.dispatcher
+        with d._lock:
+            self.pre_crash_attempts = {sp.split_id: sp.attempt
+                                       for sp in d._splits}
+        d._ledger_save(force=True)
+        d._ledger.release()  # the flock dies with the pid
+
+    def dispatcher_restart(self):
+        self.dispatcher = self._dispatcher_cls(self._config_factory())
+        self.workers = {}  # registration does not survive a restart
+        if self.pre_crash_attempts is not None:
+            with self.dispatcher._lock:
+                after = {sp.split_id: sp.attempt
+                         for sp in self.dispatcher._splits}
+            for split_id, attempt in self.pre_crash_attempts.items():
+                if after.get(split_id, attempt) != attempt:
+                    raise ProtocolReplayError(
+                        'restart-never-burns violated on the real '
+                        'dispatcher: split %d attempt %d -> %d across '
+                        'crash/restart (ledger restore burned an '
+                        'attempt)' % (split_id, attempt,
+                                      after[split_id]))
+            self.pre_crash_attempts = None
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _lapse(self, split_id):
+        d = self.dispatcher
+        with d._lock:
+            d._splits[split_id].lease_expires = 0.0
+        d._expire_leases()
+
+    def check_invariants(self, label):
+        from petastorm_tpu.service.dispatcher import _DONE, _FAILED
+        with self.dispatcher._lock:
+            states = {sp.split_id: sp.state
+                      for sp in self.dispatcher._splits}
+        for split_id in self.done_seen:
+            if states.get(split_id) != _DONE:
+                raise ProtocolReplayError(
+                    'exactly-once violated on the real dispatcher after '
+                    '%r: split %d was DONE and is now %r — completed '
+                    'work resurrected' % (label, split_id,
+                                          states.get(split_id)))
+        for split_id in self.failed_seen:
+            if states.get(split_id) != _FAILED:
+                raise ProtocolReplayError(
+                    'poison-sticky violated on the real dispatcher '
+                    'after %r: split %d was FAILED and is now %r'
+                    % (label, split_id, states.get(split_id)))
+        for split_id, state in states.items():
+            if state == _DONE:
+                self.done_seen.add(split_id)
+            elif state == _FAILED:
+                self.failed_seen.add(split_id)
+
+    def run(self, labels):
+        executed = []
+        try:
+            for label in labels:
+                action, args = _parse(label)
+                if action in _NO_OP_ACTIONS:
+                    executed.append(label)
+                    continue
+                handler = getattr(self, action, None)
+                if handler is None:
+                    raise ValueError(
+                        'replay has no binding for model action %r — '
+                        'extend _SplitLeaseReplay alongside the model'
+                        % label)
+                handler(*args)
+                self.check_invariants(label)
+                executed.append(label)
+        finally:
+            try:
+                self.dispatcher._ledger.release()
+            except Exception:  # noqa: BLE001 — teardown after the verdict
+                pass
+        return executed
+
+
+def replay(spec, config_factory):
+    """Drive a real dispatcher through ``spec['protocol']['steps']``.
+
+    ``spec`` is a bridge/--spec-json dict; ``config_factory`` returns a
+    fresh ``ServiceConfig`` for the SAME ledger path on every call (each
+    dispatcher restart constructs a new one against the survivor file).
+
+    Returns ``{'ok': True, 'steps': [...]}`` when the real code upholds
+    the protocol invariants through the whole schedule; raises
+    :class:`ProtocolReplayError` when it shares the model's violation.
+    """
+    protocol = spec.get('protocol') or {}
+    model = protocol.get('model')
+    if model != 'split-lease':
+        raise ValueError('only split-lease traces are replayable, got %r'
+                         % (model,))
+    steps = list(protocol.get('steps') or [])
+    if not steps:
+        raise ValueError('spec carries no protocol.steps to replay')
+    session = _SplitLeaseReplay(config_factory)
+    executed = session.run(steps)
+    return {'ok': True, 'model': model, 'steps': executed,
+            'invariant': protocol.get('invariant')}

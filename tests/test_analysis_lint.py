@@ -4,13 +4,16 @@ plus framework-level coverage (suppressions, baseline, walker) and the
 gate test that the repo itself is clean modulo the checked-in baseline.
 """
 
+import ast
 import os
 import textwrap
 
 from petastorm_tpu.analysis import lint_paths, lint_text
-from petastorm_tpu.analysis.framework import (apply_baseline, load_baseline,
-                                              write_baseline)
+from petastorm_tpu.analysis.framework import (Module, apply_baseline,
+                                              load_baseline, write_baseline)
 from petastorm_tpu.analysis.rules import ALL_RULES
+from petastorm_tpu.analysis.rules.env_registry import (
+    DEFAULT_REGISTRY_PATH, EnvKillSwitchRegistryRule, parse_registry)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -494,6 +497,105 @@ def test_wire_catalogue_pinned_on_real_tree():
         assert set(handled) == want_handled, (member, sorted(handled))
 
 
+# -- wire-protocol-conformance: RPC op-name catalogue (ISSUE 19) --------------
+
+def _write_op_pair(tmp_path, dispatcher_src, worker_src):
+    pkg = tmp_path / 'pkg' / 'service'
+    pkg.mkdir(parents=True)
+    (pkg / 'dispatcher.py').write_text(textwrap.dedent(dispatcher_src))
+    (pkg / 'worker.py').write_text(textwrap.dedent(worker_src))
+    return str(tmp_path / 'pkg')
+
+
+def test_op_conformance_fires_both_directions(tmp_path):
+    root = _write_op_pair(
+        tmp_path,
+        '''
+        class D:
+            def _op_lease(self, request):
+                return {}
+            def _op_vestigial(self, request):   # no sender anywhere
+                return {}
+        ''',
+        '''
+        def run(rpc):
+            rpc.call({'op': 'lease'})
+            rpc.call({'op': 'typo_op'})          # no handler
+        ''')
+    findings = [f for f in lint_paths([root])
+                if f.rule_id == 'wire-protocol-conformance']
+    messages = ' | '.join(f.message for f in findings)
+    assert len(findings) == 2, messages
+    assert "'typo_op'" in messages and 'dead on arrival' in messages
+    assert "'vestigial'" in messages and 'ever sends it' in messages
+
+
+def test_op_conformance_excludes_journal_appends(tmp_path):
+    """Ledger journal records reuse the 'op' key as a durable format
+    ({'op': 'done'} appended to a journal list) — those are NOT RPC
+    sends and must not demand an _op_done handler."""
+    root = _write_op_pair(
+        tmp_path,
+        '''
+        class D:
+            def _op_lease(self, request):
+                self._journal.append({'op': 'done', 'split_id': 1})
+                return {}
+        ''',
+        '''
+        def run(rpc):
+            rpc.call({'op': 'lease'})
+        ''')
+    assert not [f for f in lint_paths([root])
+                if f.rule_id == 'wire-protocol-conformance']
+
+
+def test_op_conformance_needs_a_handler_side(tmp_path):
+    """Sender modules without the dispatcher on the scan must stay
+    quiet: every op would look unhandled on a partial scan."""
+    pkg = tmp_path / 'pkg' / 'service'
+    pkg.mkdir(parents=True)
+    (pkg / 'worker.py').write_text(
+        "def run(rpc):\n    rpc.call({'op': 'lease'})\n")
+    (pkg / 'client.py').write_text(
+        "def run(rpc):\n    rpc.call({'op': 'stats'})\n")
+    assert not [f for f in lint_paths([str(tmp_path / 'pkg')])
+                if f.rule_id == 'wire-protocol-conformance']
+
+
+def test_op_catalogue_pinned_on_real_tree():
+    """THE dispatcher RPC op catalogue: every op each module of the
+    data-service-rpc group sends/handles today.  A new op (or a dropped
+    handler) must update this table consciously — including the
+    ISSUE 19 fix that gave _op_clock its missing sender
+    (`petastorm-tpu-data-service clock`)."""
+    from petastorm_tpu.analysis.framework import _parse
+    from petastorm_tpu.analysis.rules.wire_protocol import collect_ops
+    expected = {
+        'service/dispatcher.py': (set(), {
+            'clock', 'complete', 'deregister', 'drain', 'heartbeat',
+            'job', 'lease', 'mark_consumed', 'register_job',
+            'register_worker', 'release', 'stats', 'stop', 'workers'}),
+        'service/worker.py': ({'complete', 'deregister', 'heartbeat',
+                               'job', 'lease', 'register_worker',
+                               'release'}, set()),
+        'service/client.py': ({'job', 'mark_consumed', 'register_job',
+                               'stats', 'workers'}, set()),
+        'service/cli.py': ({'clock', 'drain', 'stats', 'stop'}, set()),
+        'telemetry/diagnose.py': ({'stats'}, set()),
+        'telemetry/top.py': ({'stats'}, set()),
+        'tools/doctor.py': ({'stats'}, set()),
+        'test_util/chaos.py': ({'stats'}, set()),
+    }
+    for member, (want_sent, want_handled) in expected.items():
+        full = os.path.join(REPO, 'petastorm_tpu', member)
+        module, finding = _parse(full, member)
+        assert finding is None, finding
+        sent, handled = collect_ops(module)
+        assert set(sent) == want_sent, (member, sorted(sent))
+        assert set(handled) == want_handled, (member, sorted(handled))
+
+
 # -- framework: suppressions, baseline, walker, syntax errors -----------------
 
 def test_inline_disable_suppresses_only_that_line_and_rule():
@@ -603,3 +705,139 @@ def test_repo_is_clean_modulo_baseline():
     new, _ = apply_baseline(findings, budget)
     assert not new, 'un-baselined lint findings:\n%s' % '\n'.join(
         str(f) for f in new)
+
+
+# -- protocol-model-conformance: code <-> model alphabets (ISSUE 19) ----------
+
+def _dispatcher_source(extra_handler=None, states=None):
+    """A synthetic service/dispatcher.py whose op handlers and state
+    tuple exactly match the model alphabets — mutation pins perturb it
+    one way at a time."""
+    from petastorm_tpu.analysis.protocol.models import OP_COVERAGE
+    states = states or ('pending', 'leased', 'done', 'failed')
+    decl = '%s = %s' % (', '.join('_' + s.upper() for s in states),
+                        ', '.join(repr(s) for s in states))
+    ops = sorted(OP_COVERAGE) + ([extra_handler] if extra_handler else [])
+    body = '\n'.join('    def _op_%s(self, request):\n        return {}' % op
+                     for op in ops)
+    return '%s\n\nclass Dispatcher:\n%s\n' % (decl, body)
+
+
+def _model_findings(tmp_path, dispatcher_src):
+    pkg = tmp_path / 'pkg' / 'service'
+    pkg.mkdir(parents=True)
+    (pkg / 'dispatcher.py').write_text(dispatcher_src)
+    return [f for f in lint_paths([str(tmp_path / 'pkg')])
+            if f.rule_id == 'protocol-model-conformance']
+
+
+def test_model_conformance_quiet_when_alphabets_agree(tmp_path):
+    assert not _model_findings(tmp_path, _dispatcher_source())
+
+
+def test_model_conformance_fires_on_unclaimed_handler(tmp_path):
+    """Mutation pin: an extra _op_ handler the models never heard of
+    reds the lint — the verified surface silently shrank."""
+    findings = _model_findings(tmp_path,
+                               _dispatcher_source(extra_handler='brand_new'))
+    assert len(findings) == 1, [f.message for f in findings]
+    assert '_op_brand_new is not claimed' in findings[0].message
+
+
+def test_model_conformance_fires_on_renamed_state_literal(tmp_path):
+    """Mutation pin: renaming 'leased' out of the dispatcher state tuple
+    fires both directions — unknown literal AND model state the code
+    lost."""
+    findings = _model_findings(
+        tmp_path,
+        _dispatcher_source(states=('pending', 'checked_out', 'done',
+                                   'failed')))
+    messages = ' | '.join(f.message for f in findings)
+    assert len(findings) == 2, messages
+    assert "'checked_out'" in messages and "'leased'" in messages
+
+
+# -- env-kill-switch-registry (ISSUE 19) --------------------------------------
+
+def _env_module(path, source):
+    src = textwrap.dedent(source)
+    return Module(path, src, ast.parse(src))
+
+
+def _registry_rows(names):
+    rows = ['| Variable | Default | Effect |', '| --- | --- | --- |']
+    rows += ['| `%s` | unset | switch |' % n for n in names]
+    return '\n'.join(rows) + '\n'
+
+
+def _ten_reads():
+    return 'import os\n' + '\n'.join(
+        "V%d = os.environ.get('PETASTORM_TPU_SWITCH_%d')" % (i, i)
+        for i in range(10)) + '\n'
+
+
+def test_env_registry_fires_both_directions(tmp_path):
+    registry = tmp_path / 'configuration.md'
+    registry.write_text(_registry_rows(
+        ['PETASTORM_TPU_SWITCH_%d' % i for i in range(10)]
+        + ['PETASTORM_TPU_GHOST']))  # row whose read was renamed away
+    rule = EnvKillSwitchRegistryRule(registry_path=str(registry))
+    modules = [
+        _env_module('pkg/a.py', _ten_reads()),
+        _env_module('pkg/b.py', "import os\nX = os.environ.get("
+                                "'PETASTORM_TPU_UNDOCUMENTED')\n"),
+    ]
+    findings = list(rule.check_repo(modules))
+    messages = ' | '.join(f.message for f in findings)
+    assert len(findings) == 2, messages
+    assert "'PETASTORM_TPU_UNDOCUMENTED'" in messages
+    assert "'PETASTORM_TPU_GHOST'" in messages
+    ghost = [f for f in findings if 'GHOST' in f.message][0]
+    assert ghost.path == 'docs/configuration.md'  # anchored at the row
+
+
+def test_env_registry_quiet_when_synced(tmp_path):
+    registry = tmp_path / 'configuration.md'
+    registry.write_text(_registry_rows(
+        ['PETASTORM_TPU_SWITCH_%d' % i for i in range(10)]))
+    rule = EnvKillSwitchRegistryRule(registry_path=str(registry))
+    modules = [_env_module('pkg/a.py', _ten_reads()),
+               _env_module('pkg/b.py', 'import os\n')]
+    assert not list(rule.check_repo(modules))
+
+
+def test_env_registry_missing_registry_is_one_finding(tmp_path):
+    rule = EnvKillSwitchRegistryRule(
+        registry_path=str(tmp_path / 'nope.md'))
+    modules = [
+        _env_module('pkg/a.py', "import os\n"
+                                "X = os.environ.get('PETASTORM_TPU_X')\n"),
+        _env_module('pkg/b.py', 'import os\n'),
+    ]
+    findings = list(rule.check_repo(modules))
+    assert len(findings) == 1
+    assert 'does not exist' in findings[0].message
+
+
+def test_env_registry_partial_scans_skip_the_unread_direction(tmp_path):
+    """A subdirectory scan sees a fraction of the reads; judging
+    registry rows unread from it would flood false positives."""
+    registry = tmp_path / 'configuration.md'
+    registry.write_text(_registry_rows(['PETASTORM_TPU_A',
+                                        'PETASTORM_TPU_B']))
+    rule = EnvKillSwitchRegistryRule(registry_path=str(registry))
+    modules = [
+        _env_module('pkg/a.py', "import os\n"
+                                "X = os.environ.get('PETASTORM_TPU_A')\n"),
+        _env_module('pkg/b.py', 'import os\n'),
+    ]
+    assert not list(rule.check_repo(modules))
+
+
+def test_env_registry_real_doc_is_live():
+    """The checked-in registry parses and is large enough that the
+    unread-row direction is active on the full-tree scan (the gate is
+    below the real switch count)."""
+    registered = parse_registry(DEFAULT_REGISTRY_PATH)
+    assert registered is not None
+    assert len(registered) >= EnvKillSwitchRegistryRule.FULL_SCAN_MIN_READS

@@ -87,6 +87,8 @@ def test_ci_lint_job_gates_on_ptlint_and_ruff():
     assert 'python -m petastorm_tpu.analysis.lockdep --check ' \
            'petastorm_tpu/' in run_text
     assert 'ruff check' in run_text
+    # ISSUE 19: the protocol models verify from the same bare checkout.
+    assert 'python -m petastorm_tpu.analysis.protocol --check' in run_text
     # The gate stays JAX-free: no dependency install beyond ruff.
     assert 'pip install -e' not in run_text
 
@@ -471,7 +473,7 @@ def test_docs_conf_compiles_and_has_sphinx_settings():
     # every doc page conf/index reference exists
     for page in ('index.md', 'api.md', 'architecture.md', 'performance.md',
                  'migration.md', 'deployment.md', 'data_service.md',
-                 'development.md'):
+                 'development.md', 'configuration.md'):
         assert os.path.exists(os.path.join(REPO, 'docs', page)), page
 
 
@@ -495,6 +497,8 @@ def test_console_script_entry_points_resolve():
     assert 'petastorm-tpu-lockdep' in names, names
     # ISSUE 13: the per-batch provenance explainer
     assert 'petastorm-tpu-explain' in names, names
+    # ISSUE 19: the protocol model checker
+    assert 'petastorm-tpu-model' in names, names
     for line in lines:
         _, target = [s.strip().strip('"') for s in line.split('=', 1)]
         mod, fn = target.split(':')
@@ -665,6 +669,26 @@ def test_docs_carry_lockdep_rule_catalogue_and_dump_rows():
     assert '--dot' in dev and 'PETASTORM_TPU_LOCKDEP' in dev
     obs = open(os.path.join(REPO, 'docs', 'observability.md')).read()
     assert 'lockdep' in obs and 'violations' in obs
+
+
+def test_docs_carry_protocol_models_and_env_registry():
+    """ISSUE 19 docs: development.md catalogues the conformance rules
+    and the protocol-models section; configuration.md is the env
+    kill-switch registry of record (and is reachable from the
+    toctree); data_service.md cross-links the failure matrix to the
+    verified models."""
+    dev = open(os.path.join(REPO, 'docs', 'development.md')).read()
+    for rule_id in ('protocol-model-conformance',
+                    'env-kill-switch-registry'):
+        assert '`%s`' % rule_id in dev, rule_id
+    assert 'petastorm-tpu-model' in dev
+    assert '--chaos-spec' in dev
+    index = open(os.path.join(REPO, 'docs', 'index.md')).read()
+    assert '\nconfiguration\n' in index
+    cfg = open(os.path.join(REPO, 'docs', 'configuration.md')).read()
+    assert 'PETASTORM_TPU_NO_SHM' in cfg
+    ds = open(os.path.join(REPO, 'docs', 'data_service.md')).read()
+    assert 'petastorm-tpu-model' in ds
 
 
 def test_conftest_arms_flight_recorder_and_writes_its_artifact():

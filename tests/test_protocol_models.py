@@ -1,0 +1,407 @@
+"""Protocol verification plane (ISSUE 19): the explicit-state model
+checker, the three control-plane models, the ``petastorm-tpu-model``
+CLI, and the counterexample -> chaos -> real-dispatcher replay loop.
+
+The checker itself is pinned on deliberately broken toy models (each
+violation kind has a known shortest counterexample), the real models on
+their exact state-space sizes (a silent scope shrink would hollow out
+"exhaustively verified"), and the acceptance loop end to end: an
+injected protocol bug (ledger restore re-burns an attempt) is caught by
+the checker, rendered as a chaos spec by the bridge, and replayed into a
+failing real-process assertion — while the shipped code replays clean.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.analysis.protocol import cli as model_cli
+from petastorm_tpu.analysis.protocol.bridge import trace_to_chaos_spec
+from petastorm_tpu.analysis.protocol.checker import (Model, Violation, check,
+                                                     render_dot, render_trace)
+from petastorm_tpu.analysis.protocol.models import (ALL_MODELS, OP_COVERAGE,
+                                                    DrainModel,
+                                                    PieceLeaseModel,
+                                                    SplitLeaseModel)
+from petastorm_tpu.analysis.protocol.models.split_lease import LEASED
+
+ROWS = 64
+
+
+# -- toy models: every violation kind has a known shortest witness ------------
+
+class _AckWithoutLease(Model):
+    """Deliberately broken handshake: ack is never guarded on grant."""
+
+    name = 'toy-broken'
+    summary = 'ack without grant (checker self-test)'
+    bound = '2 booleans'
+    FIELDS = ('granted', 'acked')
+
+    def initial(self):
+        return {'granted': False, 'acked': False}
+
+    def actions(self, state):
+        out = []
+        if not state['granted']:
+            out.append(('grant', {'granted': True,
+                                  'acked': state['acked']}, True))
+        if not state['acked']:
+            # BUG under test: no `granted` guard
+            out.append(('ack', {'granted': state['granted'],
+                                'acked': True}, True))
+        return out
+
+    def invariants(self):
+        return [('ack-implies-grant',
+                 lambda s: s['granted'] or not s['acked'])]
+
+    def settled(self, state):
+        return state['granted'] and state['acked']
+
+
+def test_checker_finds_known_shortest_counterexample():
+    result = check(_AckWithoutLease())
+    assert not result.ok
+    violation = result.violations[0]
+    assert violation.kind == Violation.SAFETY
+    assert violation.name == 'ack-implies-grant'
+    # BFS order: the 1-step witness, not some longer interleaving.
+    assert [label for label, _state in violation.trace] == ['<init>', 'ack']
+    assert 'ack-implies-grant' in render_trace(violation)
+
+
+def test_checker_flags_deadlock():
+    class Stuck(Model):
+        name, FIELDS = 'toy-stuck', ('n',)
+
+        def initial(self):
+            return {'n': 0}
+
+        def actions(self, state):
+            return [('step', {'n': 1}, True)] if state['n'] == 0 else []
+
+        def settled(self, state):
+            return False
+
+    result = check(Stuck())
+    assert [v.kind for v in result.violations] == [Violation.DEADLOCK]
+    assert [label for label, _s in result.violations[0].trace] \
+        == ['<init>', 'step']
+
+
+def test_checker_flags_unreachable_settlement():
+    class Orbit(Model):
+        name, FIELDS = 'toy-orbit', ('n',)
+
+        def initial(self):
+            return {'n': 0}
+
+        def actions(self, state):
+            return {0: [('go', {'n': 1}, True), ('settle', {'n': 3}, True)],
+                    1: [('spin', {'n': 2}, False)],
+                    2: [('spin_back', {'n': 1}, False)],
+                    3: []}[state['n']]
+
+        def settled(self, state):
+            return state['n'] == 3
+
+    result = check(Orbit())
+    assert result.violations
+    assert result.violations[0].kind == Violation.UNREACHABLE_SETTLEMENT
+
+
+def test_checker_flags_non_progress_cycle():
+    # The 1<->2 loop can exit to settlement (so pass 1 is clean), but no
+    # progress action is enabled anywhere on it: livelock even under a
+    # fair scheduler.
+    class Livelock(Model):
+        name, FIELDS = 'toy-livelock', ('n',)
+
+        def initial(self):
+            return {'n': 0}
+
+        def actions(self, state):
+            return {0: [('enter', {'n': 1}, True)],
+                    1: [('spin', {'n': 2}, False),
+                        ('exit', {'n': 3}, False)],
+                    2: [('spin_back', {'n': 1}, False)],
+                    3: []}[state['n']]
+
+        def settled(self, state):
+            return state['n'] == 3
+
+    result = check(Livelock())
+    assert result.violations
+    violation = result.violations[0]
+    assert violation.kind == Violation.NON_PROGRESS_CYCLE
+    assert set(violation.cycle) == {'spin', 'spin_back'}
+
+
+def test_checker_max_states_reports_incomplete():
+    result = check(SplitLeaseModel(), max_states=100)
+    assert not result.complete
+    assert result.states > 100
+
+
+# -- the real models: exhaustive at the documented bound ----------------------
+
+def test_drain_and_piece_lease_verify_exhaustively():
+    """Exact state-space pins: a silent scope shrink (or explosion) in
+    either model changes these numbers before it changes anything
+    else."""
+    drain = check(DrainModel())
+    assert drain.ok and drain.complete
+    assert (drain.states, drain.transitions) == (451, 1855)
+    piece = check(PieceLeaseModel())
+    assert piece.ok and piece.complete
+    assert (piece.states, piece.transitions) == (1520, 4480)
+
+
+def test_split_lease_reduced_scope_verifies_fast():
+    """The 1x2 instance covers every transition class in seconds — the
+    full documented bound runs in the slow test + the CI --check step."""
+    result = check(SplitLeaseModel(n_workers=1, n_splits=2))
+    assert result.ok and result.complete
+    assert (result.states, result.transitions) == (1914, 4191)
+
+
+@pytest.mark.slow
+def test_split_lease_full_bound_verifies_exhaustively():
+    """The acceptance bound: 2 workers x 3 splits x 1 crash/restart per
+    actor, exhaustive, under 60s."""
+    model = SplitLeaseModel()
+    assert '2 workers x 3 splits x 1 crash/restart' in model.bound
+    result = check(model)
+    assert result.ok and result.complete
+    assert (result.states, result.transitions) == (574210, 2354482)
+    assert result.elapsed_s < 60.0
+
+
+def test_model_alphabets_are_declared():
+    for model in ALL_MODELS:
+        assert model.name and model.summary and model.bound
+        assert model.STATES, model.name
+    # every dispatcher op claimed by a real model names one that exists
+    model_names = {m.name for m in ALL_MODELS}
+    for op, owner in OP_COVERAGE.items():
+        assert owner in model_names | {'observability', 'unmodeled'}, op
+
+
+# -- the CLI: output pins + exit codes ----------------------------------------
+
+def test_cli_check_prints_pins_and_exits_zero(capsys):
+    rc = model_cli.main(['--check', 'drain', 'piece-lease'])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = out.strip().splitlines()
+    assert any(line.startswith('drain') and '451 states' in line
+               and 'OK' in line and 'bound:' in line for line in lines)
+    assert any(line.startswith('piece-lease') and '1520 states' in line
+               for line in lines)
+    assert lines[-1] == 'protocol models: 2/2 OK, 1971 states total'
+
+
+def test_cli_list_models_and_dot(capsys):
+    assert model_cli.main(['--list-models']) == 0
+    out = capsys.readouterr().out
+    for model in ALL_MODELS:
+        assert model.name in out
+        assert 'bound:' in out
+    assert model_cli.main(['--dot', 'drain']) == 0
+    assert capsys.readouterr().out.startswith('digraph drain')
+
+
+def test_cli_unknown_model_exits_two(capsys):
+    assert model_cli.main(['--check', 'no-such-model']) == 2
+    assert 'unknown model' in capsys.readouterr().err
+    assert model_cli.main(['--chaos-spec', 'x.json', '--check']) == 2
+
+
+class _ReburnRestore(SplitLeaseModel):
+    """The injected protocol bug of the acceptance criterion: ledger
+    restore burns an attempt for every in-flight lease."""
+
+    def _restore_split(self, split, journaled):
+        restored = super()._restore_split(split, journaled)
+        state, attempt, holder = restored
+        if not journaled and state == LEASED:
+            return (state, attempt + 1, holder)
+        return restored
+
+
+def test_cli_violation_exits_one_and_bridges_spec(tmp_path, monkeypatch,
+                                                  capsys):
+    spec_path = tmp_path / 'counterexample.json'
+    monkeypatch.setattr(model_cli, '_models', lambda: (_ReburnRestore(),))
+    rc = model_cli.main(['--trace', '--chaos-spec', str(spec_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert 'VIOLATED' in out and 'restart-never-burns' in out
+    assert 'protocol models: 0/1 OK' in out
+    spec = json.loads(spec_path.read_text())
+    assert spec['protocol']['invariant'] == 'restart-never-burns'
+    assert spec['protocol']['steps'] == ['lease(w0,s0)', 'dispatcher_crash',
+                                         'dispatcher_restart']
+
+
+# -- counterexample -> chaos bridge -------------------------------------------
+
+def _reburn_spec():
+    result = check(_ReburnRestore())
+    assert not result.ok
+    return trace_to_chaos_spec(result.model, result.violations[0])
+
+
+def test_bridge_renders_reburn_trace_as_chaos_spec():
+    spec = _reburn_spec()
+    assert spec['protocol'] == {
+        'model': 'split-lease',
+        'invariant': 'restart-never-burns',
+        'kind': 'safety',
+        'steps': ['lease(w0,s0)', 'dispatcher_crash', 'dispatcher_restart'],
+        'cycle': [],
+    }
+    # the crash hit after a grant, before any delivery: leases phase,
+    # with a restart later in the trace
+    assert spec['kills'] == [{'role': 'dispatcher', 'phase': 'leases',
+                              'signal': 'kill', 'restart': True}]
+    assert spec['dispatcher_subprocess'] is True
+    # the bridge output is a valid --spec-json file
+    from petastorm_tpu.test_util import chaos
+    chaos.ChaosState({'seed': 0, 'faults': spec.get('faults') or []})
+    assert set(spec) <= chaos._SPEC_KEYS
+
+
+# -- real-dispatcher replay: the code does NOT share the model bug ------------
+
+def _write_dataset(path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path.mkdir()
+    pq.write_table(
+        pa.table({'id': np.arange(ROWS, dtype=np.int64),
+                  'x': np.arange(ROWS, dtype=np.float64) * 0.5}),
+        str(path / 'data.parquet'), row_group_size=4)
+    return 'file://' + str(path)
+
+
+def _config_factory(tmp_path, subdir):
+    from petastorm_tpu.service import ServiceConfig
+    url = _write_dataset(tmp_path / subdir)
+    # the ledger must survive dispatcher restarts OUTSIDE the dataset dir
+    ledger = str(tmp_path / ('%s_ledger.json' % subdir))
+    return lambda: ServiceConfig(
+        url, num_consumers=1, rowgroups_per_split=2, lease_ttl_s=2.0,
+        reader_kwargs={'workers_count': 1}, ledger_path=ledger)
+
+
+def test_reburn_counterexample_replays_clean_on_real_dispatcher(tmp_path):
+    """The model mutant's violation is a model-only artifact: the real
+    ledger restore keeps attempts intact, so the same schedule replays
+    green on a real Dispatcher."""
+    from petastorm_tpu.test_util.protocol_replay import replay
+    verdict = replay(_reburn_spec(), _config_factory(tmp_path, 'clean'))
+    assert verdict['ok']
+    assert verdict['steps'] == ['lease(w0,s0)', 'dispatcher_crash',
+                                'dispatcher_restart']
+
+
+def test_reburn_bug_in_real_code_fails_replay(tmp_path, monkeypatch):
+    """Close the acceptance loop: inject the SAME bug into the real
+    ledger restore (decode burns an attempt for every leased row) and
+    the bridged counterexample becomes a failing real-process
+    assertion."""
+    from petastorm_tpu.service import ledger as ledger_mod
+    from petastorm_tpu.test_util.protocol_replay import (ProtocolReplayError,
+                                                         replay)
+    real_decode = ledger_mod.decode_splits
+
+    def burned_decode(payload):
+        return [(state, attempt + 1 if state == 'leased' else attempt)
+                for state, attempt in real_decode(payload)]
+
+    monkeypatch.setattr(ledger_mod, 'decode_splits', burned_decode)
+    with pytest.raises(ProtocolReplayError, match='restart-never-burns'):
+        replay(_reburn_spec(), _config_factory(tmp_path, 'mutant'))
+
+
+def test_replay_refuses_unreplayable_models(tmp_path):
+    from petastorm_tpu.test_util.protocol_replay import replay
+    with pytest.raises(ValueError, match='split-lease'):
+        replay({'protocol': {'model': 'drain', 'steps': ['x()']}},
+               lambda: None)
+    with pytest.raises(ValueError, match='steps'):
+        replay({'protocol': {'model': 'split-lease', 'steps': []}},
+               lambda: None)
+
+
+# -- chaos --spec-json round trip ---------------------------------------------
+
+def test_load_spec_json_validates(tmp_path):
+    from petastorm_tpu.test_util import chaos
+    good = tmp_path / 'good.json'
+    good.write_text(json.dumps({
+        'name': 'bridged', 'summary': 's',
+        'kills': [{'role': 'dispatcher', 'phase': 'leases',
+                   'signal': 'kill', 'restart': True}],
+        'faults': [{'seam': 'rpc.request', 'action': 'drop', 'p': 1.0,
+                    'ops': ['heartbeat']}]}))
+    name, scenario = chaos.load_spec_json(str(good))
+    assert name == 'bridged'
+    assert scenario['kills'][0]['role'] == 'dispatcher'
+
+    unnamed = tmp_path / 'trace7.json'
+    unnamed.write_text(json.dumps({'summary': 's'}))
+    assert chaos.load_spec_json(str(unnamed))[0] == 'spec:trace7'
+
+    for bad in ({'bogus_key': 1},
+                {'kills': [{'role': 'gremlin', 'phase': 'leases'}]},
+                {'kills': [{'role': 'worker', 'phase': 'never'}]},
+                {'faults': [{'seam': 'worker.chunk', 'action': 'explode'}]},
+                {'runner': 'spark'}):
+        path = tmp_path / 'bad.json'
+        path.write_text(json.dumps(bad))
+        with pytest.raises(ValueError):
+            chaos.load_spec_json(str(path))
+
+
+def test_chaos_run_requires_exactly_one_source():
+    from petastorm_tpu.test_util import chaos
+    with pytest.raises(SystemExit):
+        chaos.main(['run'])  # neither
+    with pytest.raises(SystemExit):
+        chaos.main(['run', 'worker_kill', '--spec-json', 'x.json'])  # both
+
+
+def test_spec_json_round_trip_through_the_runner(tmp_path):
+    """Smoke-scoped round trip: a bridge-shaped spec file loads, runs
+    through the REAL runner (fleet + digest + exactly-once), and its
+    faults actually fire."""
+    from petastorm_tpu.test_util import chaos
+    spec_path = tmp_path / 'spec.json'
+    spec_path.write_text(json.dumps({
+        'name': 'bridged_message_drop',
+        'summary': 'replay: drop a few heartbeats mid-epoch',
+        'protocol': {'model': 'split-lease', 'invariant': None,
+                     'kind': 'safety', 'steps': [], 'cycle': []},
+        'faults': [{'seam': 'rpc.request', 'action': 'drop', 'p': 1.0,
+                    'max': 3, 'ops': ['heartbeat']}]}))
+    name, scenario = chaos.load_spec_json(str(spec_path))
+    url, rows = chaos.make_chaos_dataset(str(tmp_path / 'ds'), seed=5)
+    report = chaos.run_scenario(name, url, rows, str(tmp_path), seed=5,
+                                scenario=scenario)
+    assert report['scenario'] == 'bridged_message_drop'
+    assert report['ok'], report
+    assert report['checks']['exactly_once'] == 'ok'
+    assert sum(report['injections'].values()) > 0, \
+        'spec ran but injected nothing'
+
+
+# -- rendering ----------------------------------------------------------------
+
+def test_render_dot_marks_settled_states():
+    dot = render_dot(DrainModel())
+    assert dot.startswith('digraph drain')
+    assert 'peripheries=2' in dot  # settled states double-boxed
