@@ -36,6 +36,7 @@ OP_COVERAGE = {
     'register_job': 'observability',
     'workers': 'observability',
     'stats': 'observability',
+    'decisions': 'observability',   # read-only decision-journal query
     'stop': 'observability',
     # mark_consumed is a client-side fast-path retire (PENDING -> DONE +
     # journal, no lease involved); it cannot violate the lease-cycle

@@ -49,6 +49,7 @@ import pyarrow.parquet as pq
 
 from petastorm_tpu.ingest import planner as _planner
 from petastorm_tpu.telemetry import MetricsRegistry
+from petastorm_tpu.telemetry import decisions as _decisions
 from petastorm_tpu.telemetry.spans import SpanBuffer
 from petastorm_tpu.utils.locks import make_condition, make_lock
 from petastorm_tpu.workers_pool.scheduling import (DEFAULT_INGEST_WINDOW,
@@ -395,6 +396,10 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
                     round(100.0 * waste / fetched, 2) if fetched else 0.0)
             if hedge:
                 self._c_hedge_wins.inc()
+                _decisions.record_decision(
+                    'hedge', 'hedge_win', 'hedge_deadline_s',
+                    {'won': True, 'wall_s': t1 - t0},
+                    row_group=entry.key[1])
         elif failed:
             self._c_degraded.inc()
             logger.debug('ingest fetch failed for row group %d of %r '
@@ -418,10 +423,12 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
         return max(HEDGE_MIN_DEADLINE_S, HEDGE_FACTOR * q95)
 
     def _launch_hedge(self, entry):
+        """Launch the one hedge fetch; True when it actually launched
+        (the caller journals the decision with its deadline inputs)."""
         with self._cond:
             if entry.done or entry.hedged or entry.state != _FETCHING \
                     or self._stopped:
-                return
+                return False
             entry.hedged = True
         self._c_hedges.inc()
         thread = threading.Thread(target=self._fetch, args=(entry, True),
@@ -434,6 +441,7 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
             self._hedge_threads = [t for t in self._hedge_threads
                                    if t.is_alive()]
             self._hedge_threads.append(thread)
+        return True
 
     # -- decode-side checkout ------------------------------------------------
 
@@ -517,7 +525,16 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
                 return time.monotonic() - start
             now = time.monotonic()
             if hedge_at is not None and now >= hedge_at:
-                self._launch_hedge(entry)
+                if self._launch_hedge(entry):
+                    with self._lock:
+                        samples = len(self._durations)
+                    _decisions.record_decision(
+                        'hedge', 'hedge', 'hedge_deadline_s',
+                        {'blocked_s': deadline + (now - hedge_at),
+                         'deadline_s': deadline,
+                         'explicit': self._hedge_deadline_s is not None,
+                         'samples': samples},
+                        row_group=entry.key[1])
             if now >= give_up_at:
                 # abandon: degrade this checkout to the sync path; the
                 # in-flight fetch discards its bytes when it lands
@@ -529,6 +546,11 @@ class IngestPlane(object):  # ptlint: disable=pickle-unsafe-attrs — lives on t
                         entry.event.set()
                         self._cond.notify_all()
                         self._c_degraded.inc()
+                        _decisions.record_decision(
+                            'hedge', 'abandon', 'checkout_timeout_s',
+                            {'blocked_s': now - start,
+                             'timeout_s': self._checkout_timeout_s},
+                            row_group=entry.key[1])
                 return time.monotonic() - start
 
     def discard(self, path, row_group):

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from petastorm_tpu.jax.transfer import _supported, wire_dtype_for
+from petastorm_tpu.telemetry import decisions as _decisions
 
 #: Kill switch: set to any non-empty value to disable the resident tier.
 #: The loader then streams full-width batches every epoch — byte-for-byte
@@ -409,9 +410,27 @@ class ResidencyTier(object):
         rows = len(row_ids)
         if self._dropped or rows == 0 or rows > self._capacity:
             self._c.bypass.inc()
+            _decisions.record_decision(
+                'residency', 'bypass', 'residency_budget',
+                {'rows': rows, 'capacity': self._capacity,
+                 'dropped': bool(self._dropped)},
+                suppressed=True)
             return 'bypass'
         if (self._slot_of_row[row_ids] >= 0).all():
+            # Warm re-sight of already-resident rows: nothing is allocated or
+            # displaced, so no decision record (this path runs every batch on
+            # warm epochs and would flood the journal with non-decisions).
             return 'admitted'
+        # Snapshot the allocator state the admission rule reads *before* the
+        # evict loop mutates it, so the decision replay can re-derive the
+        # outcome (admitted / evicted / bypass) from inputs alone.
+        _inputs = {
+            'rows': rows,
+            'capacity': self._capacity,
+            'bump': self._bump,
+            'free_rows': [r for _, r in self._free],
+            'entry_rows': [r for _, r in self._entries.values()],
+        }
         self._ensure_slabs()
         evicted = False
         slot = self._alloc(rows)
@@ -421,6 +440,9 @@ class ResidencyTier(object):
             slot = self._alloc(rows)
         if slot is None:
             self._c.bypass.inc()
+            _decisions.record_decision(
+                'residency', 'bypass', 'residency_budget', _inputs,
+                suppressed=True)
             return 'bypass'
         self._write(slot, rows, wire_dev)
         self._entries[self._seq] = (slot, rows)
@@ -432,7 +454,10 @@ class ResidencyTier(object):
         if evicted:
             self._c.thrash.inc()
         self._update_gauges()
-        return 'evicted' if evicted else 'admitted'
+        outcome = 'evicted' if evicted else 'admitted'
+        _decisions.record_decision(
+            'residency', outcome, 'residency_budget', _inputs, slot=slot)
+        return outcome
 
     def _write(self, slot, rows, wire_dev):
         fn = self._write_fns.get(rows)
@@ -512,6 +537,11 @@ class ResidencyTier(object):
         streaming.  Safe to call mid-epoch and more than once."""
         if self._dropped:
             return
+        _decisions.record_decision(
+            'residency', 'drop', 'residency_budget',
+            {'entries': len(self._entries),
+             'resident_rows': self.resident_rows,
+             'capacity': self._capacity})
         if self._slabs is not None:
             live_entries = len(self._entries)
             if live_entries:

@@ -39,6 +39,7 @@ import os
 import threading
 import time
 
+from petastorm_tpu.telemetry import decisions as _decisions
 from petastorm_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
@@ -230,6 +231,12 @@ class MaterializeController(object):  # ptlint: disable=pickle-unsafe-attrs — 
                 if rec[1] >= self._max_piece_attempts:
                     rec[0] = _FAILED
                     self._m_failed.inc()
+                    _decisions.record_decision(
+                        'materialize', 'poison_piece',
+                        'max_piece_attempts',
+                        {'attempts': rec[1],
+                         'max_attempts': self._max_piece_attempts},
+                        piece=index)
                     continue
                 rec[0] = _LEASED
                 rec[1] += 1
@@ -340,14 +347,30 @@ class MaterializeController(object):  # ptlint: disable=pickle-unsafe-attrs — 
         plane = self.identity.plane
         if plane.has_digest(digest):
             return 'present'
-        admitted, _estimate = plane.admit_publish(len(blob),
-                                                  self._hot_window_s)
+        admitted, estimate = plane.admit_publish(len(blob),
+                                                 self._hot_window_s)
+        # Decision journal (ISSUE 20): the admission verdict with the
+        # eviction-estimate inputs it read — "why was this publish
+        # refused" resolves to hot victims, not a bare counter.
+        inputs = {'nbytes': len(blob),
+                  'hot_window_s': self._hot_window_s,
+                  'admitted': admitted,
+                  'fits': estimate.get('fits') if estimate else None,
+                  'victim_newest_age_s':
+                      estimate.get('victim_newest_age_s')
+                      if estimate else None}
         if not admitted:
             self._m_refused.inc()
+            _decisions.record_decision(
+                'materialize', 'refuse_publish', 'hot_window_s', inputs,
+                suppressed=True, digest=digest)
             return 'refused'
         if not plane.publish_blob(digest, blob):
             return 'degraded'
         self._m_bytes.inc(len(blob))
+        _decisions.record_decision(
+            'materialize', 'published', 'hot_window_s', inputs,
+            digest=digest)
         return 'published'
 
     def _warm_piece(self, index, worker, capture):

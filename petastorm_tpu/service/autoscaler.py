@@ -35,6 +35,8 @@ import subprocess
 import sys
 import time
 
+from petastorm_tpu.telemetry import decisions as _decisions
+
 logger = logging.getLogger(__name__)
 
 __all__ = ['KILL_SWITCH', 'killed', 'WorkerLauncher',
@@ -139,6 +141,10 @@ class Autoscaler(object):
         self.suppressed = 0   # wanted to act; cooldown/bounds said no
         self.last_action = None
         self.last_action_t = None
+        # Decision journal (ISSUE 20): the dispatcher points this at its
+        # ledger-persisted journal so every action/suppression explains
+        # itself; None falls through to the process journal.
+        self.decisions = None
 
     @property
     def actions(self):
@@ -183,11 +189,24 @@ class Autoscaler(object):
             self._idle_since = None
 
         cfg = self.config
+        cooldown_left = max(0.0, self._cooldown_until - now)
         if starved and now - self._starve_since >= cfg.autoscale_starve_s:
             want = min(cfg.autoscale_step,
                        cfg.autoscale_max_workers - len(alive))
+            inputs = {'pending': pending, 'leased': leased, 'alive': alive,
+                      'free_slots': free_slots,
+                      'starve_s': round(now - self._starve_since, 3),
+                      'threshold_s': cfg.autoscale_starve_s,
+                      'step': cfg.autoscale_step,
+                      'max_workers': cfg.autoscale_max_workers,
+                      'cooldown_remaining_s': round(cooldown_left, 3)}
             if want <= 0 or now < self._cooldown_until:
                 self.suppressed += 1
+                _decisions.record_decision(
+                    'autoscaler', 'hold', 'autoscale_cooldown_s',
+                    dict(inputs, want=want, wanted='scale_out'),
+                    suppressed=True, cooldown_until=self._cooldown_until,
+                    journal=self.decisions)
                 return None
             spawned = 0
             for _ in range(want):
@@ -204,17 +223,37 @@ class Autoscaler(object):
             self.scale_outs += 1
             self._after_action('scale_out', now)
             self._starve_since = None
+            _decisions.record_decision(
+                'autoscaler', 'scale_out', 'autoscale_starve_s', inputs,
+                cooldown_until=self._cooldown_until, spawned=spawned,
+                journal=self.decisions)
             return ('scale_out', spawned)
 
         if idle and now - self._idle_since >= cfg.autoscale_idle_s \
                 and len(alive) > cfg.autoscale_min_workers:
+            coverage = dict(observation.get('coverage') or {})
+            inputs = {'pending': pending, 'leased': leased, 'alive': alive,
+                      'idle_s': round(now - self._idle_since, 3),
+                      'threshold_s': cfg.autoscale_idle_s,
+                      'min_workers': cfg.autoscale_min_workers,
+                      'coverage': coverage,
+                      'cooldown_remaining_s': round(cooldown_left, 3)}
             if now < self._cooldown_until:
                 self.suppressed += 1
+                _decisions.record_decision(
+                    'autoscaler', 'hold', 'autoscale_cooldown_s',
+                    dict(inputs, want=1, wanted='scale_in'),
+                    suppressed=True, cooldown_until=self._cooldown_until,
+                    journal=self.decisions)
                 return None
-            victim = self._drain_victim(alive, observation.get('coverage'))
+            victim = self._drain_victim(alive, coverage)
             self.scale_ins += 1
             self._after_action('scale_in', now)
             self._idle_since = None
+            _decisions.record_decision(
+                'autoscaler', 'scale_in', 'autoscale_idle_s', inputs,
+                cooldown_until=self._cooldown_until, worker_id=victim,
+                journal=self.decisions)
             self.launcher.notify_drain(victim)
             return ('scale_in', victim)
         return None

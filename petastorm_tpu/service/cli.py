@@ -112,6 +112,13 @@ def _build_parser():
     d.add_argument('--autoscale-idle-s', type=float, default=30.0,
                    help='a fully idle fleet must persist this long '
                         'before a scale-in drain')
+    d.add_argument('--metrics-port', type=int, default=None,
+                   help='serve Prometheus text exposition on '
+                        'http://0.0.0.0:PORT/metrics (stdlib http.server '
+                        'daemon thread; port 0 picks a free one): every '
+                        'live registry plus the decision-journal gauges '
+                        '(ISSUE 20) — see docs/observability.md for a '
+                        'scrape config')
 
     w = sub.add_parser('worker', help='run one decode worker')
     w.add_argument('--dispatcher', required=True,
@@ -210,6 +217,18 @@ def main(argv=None):
             autoscale_starve_s=args.autoscale_starve_s,
             autoscale_idle_s=args.autoscale_idle_s)
         with Dispatcher(config, bind=args.bind) as dispatcher:
+            metrics_server = None
+            if args.metrics_port is not None:
+                from petastorm_tpu.telemetry.scrape import \
+                    start_metrics_server
+                # Refresh through the stats handler so derived gauges
+                # (fleet health, decision rollups) are current at each
+                # scrape — same numbers `top` shows for the same moment.
+                metrics_server = start_metrics_server(
+                    args.metrics_port,
+                    refresh=lambda: dispatcher._op_stats({}))
+                print('metrics on http://0.0.0.0:%d/metrics'
+                      % metrics_server.server_address[1], flush=True)
             print('dispatcher serving %s (%d splits, %d consumers)'
                   % (dispatcher.addr, dispatcher._job['num_splits'],
                      args.num_consumers), flush=True)
@@ -218,6 +237,9 @@ def main(argv=None):
                     time.sleep(0.5)
             except KeyboardInterrupt:
                 pass
+            finally:
+                if metrics_server is not None:
+                    metrics_server.shutdown()
         return 0
 
     if args.command == 'worker':

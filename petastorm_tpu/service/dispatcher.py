@@ -57,6 +57,7 @@ import logging
 import pickle
 import threading
 from petastorm_tpu.service import tenancy as _tenancy
+from petastorm_tpu.telemetry import decisions as _decisions
 from petastorm_tpu.utils.locks import make_lock
 import time
 
@@ -238,6 +239,14 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         #: Health gauges land here so any Prometheus scrape of the
         #: dispatcher process carries them (``render_prometheus``).
         self.metrics = MetricsRegistry('dispatcher')
+        # -- control-plane decision journal (ISSUE 20) -----------------------
+        #: Every autonomous action the dispatcher-side control laws take
+        #: (autoscaler, WDRR tenant picks, affinity routing) lands here;
+        #: each record marks the ledger dirty so the journal persists on
+        #: the next serve-loop tick and a restart keeps the history.
+        self._decisions = _decisions.DecisionJournal(label='dispatcher')
+        self._decisions.on_record = lambda rec: self._ledger_mark()
+        self._scheduler.decisions = self._decisions
         # -- closed-loop autoscaler (ISSUE 16) -------------------------------
         # An in-dispatcher tick controller (flight-recorder pattern, no
         # extra thread); PETASTORM_TPU_NO_AUTOSCALE=1 beats the config.
@@ -247,6 +256,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             if launcher is None:
                 launcher = _autoscaler.SubprocessWorkerLauncher()
             self.autoscaler = _autoscaler.Autoscaler(config, launcher)
+            self.autoscaler.decisions = self._decisions
         # -- materialize hand-off (ISSUE 18) ---------------------------------
         # When a controller is attached, scale-in victims are offered for
         # one bounded warming pass before their drain proceeds: idle
@@ -372,6 +382,11 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         if self._cluster_on and pieces \
                 and len(pieces) == self._num_pieces:
             self._piece_digests = [str(d) for d in pieces]
+        # Decision history (ISSUE 20) survives the restart attempt-
+        # intact: the dead incarnation's records restore verbatim, so
+        # `petastorm-tpu-why` still explains a pre-kill drain.
+        if state.get('decisions'):
+            self._decisions.restore(state['decisions'])
         self.ledger_restores = int(state.get('restores', 0)) + 1
         logger.info(
             'ledger %s restored (restart #%d): %d done / %d leased '
@@ -386,6 +401,9 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
         """Snapshot dict for :meth:`ledger.DispatcherLedger.save`
         (caller must NOT hold ``self._lock``)."""
         from petastorm_tpu.service import ledger as _ledger_mod
+        # Outside self._lock: the journal has its own (leaf) lock and
+        # the dump needs no dispatcher state.
+        decisions_dump = self._decisions.dump()
         with self._lock:
             digests = {self._workers[wid]['addr']: sorted(held)
                        for wid, held in self._worker_digests.items()
@@ -415,6 +433,7 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 'worker_digests': digests,
                 'piece_digests': self._piece_digests,
                 'tenants': tenants,
+                'decisions': decisions_dump,
                 'restores': self.ledger_restores,
                 'saved_unix': time.time(),
             }
@@ -946,6 +965,13 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 if coverage is not None \
                         and coverage >= _AFFINITY_MIN_COVERAGE:
                     chosen, routed = split, True
+                    _decisions.record_decision(
+                        'affinity', 'routed', 'affinity_min_coverage',
+                        {'coverage': coverage,
+                         'min_coverage': _AFFINITY_MIN_COVERAGE,
+                         'scanned': len(window)},
+                        worker_id=worker_id, split_id=split.split_id,
+                        tenant=job.tenant, journal=self._decisions)
                     break
         if chosen is None:
             now = time.monotonic()
@@ -958,10 +984,30 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                         split.affinity_defer_until = now + defer_s
                     if now < split.affinity_defer_until:
                         continue  # inside its holder's preference window
+                    _decisions.record_decision(
+                        'affinity', 'deferral_exhausted',
+                        'affinity_defer_s',
+                        {'waited_s': defer_s + now
+                         - split.affinity_defer_until,
+                         'defer_s': defer_s},
+                        worker_id=worker_id, split_id=split.split_id,
+                        tenant=job.tenant, journal=self._decisions)
                 chosen = split
                 break
             if chosen is None and window:
                 self.affinity_deferrals += 1
+                # The requester got nothing because every scanned split
+                # is inside a holder's preference window — a suppressed
+                # non-action the journal must explain.
+                _decisions.record_decision(
+                    'affinity', 'deferred', 'affinity_defer_s',
+                    {'waited_s': max(
+                        0.0, defer_s + now
+                        - min(s.affinity_defer_until for s in window
+                              if s.affinity_defer_until is not None)),
+                     'defer_s': defer_s, 'scanned': len(window)},
+                    suppressed=True, worker_id=worker_id,
+                    tenant=job.tenant, journal=self._decisions)
         # Unchosen window members go back to the FRONT in order (the
         # scan must not rotate the FIFO); consumer-mismatched splits
         # rejoin at the back exactly as before.
@@ -1408,6 +1454,26 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                          'killed': _autoscaler.killed(),
                          'scale_outs': 0, 'scale_ins': 0, 'actions': 0,
                          'suppressed': 0, 'last_action': None}
+        # Decision-journal rollup (ISSUE 20): the dispatcher's own
+        # per-actor summary merged with every worker's heartbeat-shipped
+        # one — `top`'s decisions line and the control-flapping evidence
+        # read this.  Worker 'last' ages shift by the heartbeat age (the
+        # record aged on the worker's clock since it was shipped).
+        decisions_rollup = self._decisions.summary()
+        for row in workers.values():
+            wdec = row.get('decisions') or {}
+            for actor, wrow in (wdec.get('summary') or {}).items():
+                agg = decisions_rollup.setdefault(
+                    actor, {'actions': 0, 'suppressed': 0, 'last': None})
+                agg['actions'] += int(wrow.get('actions', 0))
+                agg['suppressed'] += int(wrow.get('suppressed', 0))
+                last = wrow.get('last')
+                if last is not None:
+                    last = dict(last, age_s=round(
+                        last.get('age_s', 0.0) + row.get('age_s', 0.0), 1))
+                    if agg['last'] is None \
+                            or last['age_s'] < agg['last'].get('age_s', 0.0):
+                        agg['last'] = last
         meta = {'pending': states[_PENDING], 'leased': states[_LEASED],
                 'failed': states[_FAILED], 'workers_alive': alive,
                 # control-plane-degraded evidence (ISSUE 15)
@@ -1416,7 +1482,11 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
                 'retry_giveups': control['retry_giveups'],
                 # fair-share regression evidence (ISSUE 16)
                 'starved_tenants': starved_tenants,
-                'tenant_count': len(tenants)}
+                'tenant_count': len(tenants),
+                # control-flapping evidence (ISSUE 20): opposing real
+                # actions (scale_out vs scale_in, admit vs evict) inside
+                # the health window, straight from the decision journal.
+                'control_flaps': self._decisions.opposing_actions(60.0)}
         fleet_health = health.health_report(
             delta, meta=meta,
             window_s=(time.monotonic() - baseline['t_mono'])
@@ -1441,10 +1511,24 @@ class Dispatcher(object):  # ptlint: disable=pickle-unsafe-attrs — thread-host
             'control_plane': control,
             'tenants': tenants,
             'autoscale': autoscale,
+            'decisions': decisions_rollup,
             'stages': stages,
             'health': fleet_health,
             'workers': workers,
         }
+
+    def _op_decisions(self, request):
+        """Decision-journal query surface (ISSUE 20) — what
+        ``petastorm-tpu-why --dispatcher`` reads: the dispatcher's own
+        journal with FULL records plus each worker's heartbeat-shipped
+        journal payload (summary + recent records)."""
+        with self._lock:
+            worker_payloads = {
+                wid: w['stats'].get('decisions')
+                for wid, w in self._workers.items()
+                if w['stats'].get('decisions')}
+        return {'journal': self._decisions.dump(),
+                'workers': worker_payloads}
 
     def _op_stop(self, request):
         self._stop.set()

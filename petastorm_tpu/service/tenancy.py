@@ -37,6 +37,7 @@ import json
 import logging
 import warnings
 
+from petastorm_tpu.telemetry import decisions as _decisions
 from petastorm_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
@@ -200,6 +201,9 @@ class TenantScheduler(object):
 
     def __init__(self):
         self._deficit = {}
+        # Decision journal (ISSUE 20): set by the dispatcher to its
+        # ledger-persisted journal; None = the process journal.
+        self.decisions = None
 
     def pick(self, eligible):
         """Choose one tenant id from ``eligible`` (ordered sequence).
@@ -212,9 +216,16 @@ class TenantScheduler(object):
             return None
         if len(eligible) == 1:
             # Single-tenant fast path: no deficit bookkeeping at all, so
-            # the pre-tenancy dispatcher schedule is reproduced exactly.
+            # the pre-tenancy dispatcher schedule is reproduced exactly
+            # (and nothing is journaled — with one eligible tenant there
+            # is no alternative, hence no decision to explain).
             return eligible[0].tenant
         jobs = eligible
+        # Pre-accrual snapshot: the WDRR inputs the replay cross-check
+        # re-runs to reproduce the winner.
+        table = [{'tenant': j.tenant, 'weight': j.weight,
+                  'deficit': self._deficit.get(j.tenant, 0.0)}
+                 for j in jobs]
         total = sum(j.weight for j in jobs) or float(len(jobs))
         best, best_deficit = None, None
         for job in jobs:
@@ -225,6 +236,10 @@ class TenantScheduler(object):
             if best is None or deficit > best_deficit:
                 best, best_deficit = job, deficit
         self._deficit[best.tenant] = best_deficit - 1.0
+        _decisions.record_decision(
+            'tenant_sched', 'pick', 'wdrr_deficit',
+            {'eligible': table, 'deficit_clamp': _DEFICIT_CLAMP},
+            tenant=best.tenant, journal=self.decisions)
         return best.tenant
 
     def refund(self, tenant):
@@ -234,6 +249,10 @@ class TenantScheduler(object):
         if tenant in self._deficit:
             self._deficit[tenant] = min(
                 _DEFICIT_CLAMP, self._deficit[tenant] + 1.0)
+            _decisions.record_decision(
+                'tenant_sched', 'refund', 'wdrr_refund',
+                {'deficit': self._deficit[tenant]},
+                tenant=tenant, journal=self.decisions)
 
     def forget(self, tenant):
         self._deficit.pop(tenant, None)
@@ -253,12 +272,15 @@ class QuotaLedger(object):  # ptlint: disable=pickle-unsafe-attrs — lives on o
     through here can stall.
     """
 
-    def __init__(self, default_budget=None):
+    def __init__(self, default_budget=None, label=None):
         self._lock = make_lock('service.tenancy.QuotaLedger._lock')
         self._default = default_budget
         self._budgets = {}
         self._used = {}
         self.refusals = 0
+        #: Which resource plane this ledger guards ('shm'/'cache') — the
+        #: decision journal names it so a refusal says what degraded.
+        self.label = label
 
     def set_budget(self, tenant, budget_bytes):
         with self._lock:
@@ -280,9 +302,21 @@ class QuotaLedger(object):  # ptlint: disable=pickle-unsafe-attrs — lives on o
             used = self._used.get(tenant, 0)
             if budget is not None and used + nbytes > budget:
                 self.refusals += 1
-                return False
-            self._used[tenant] = used + nbytes
-            return True
+                refused = True
+            else:
+                self._used[tenant] = used + nbytes
+                refused = False
+        if refused:
+            # A quota refusal is a first-class suppressed non-action:
+            # the tenant degraded to the direct path and THIS record is
+            # the only place that says why.  Journaled outside the lock.
+            _decisions.record_decision(
+                'tenant_sched', 'quota_refused', 'quota_budget',
+                {'nbytes': nbytes, 'used': used, 'budget': budget,
+                 'plane': self.label},
+                suppressed=True, tenant=tenant)
+            return False
+        return True
 
     def refund(self, tenant, nbytes):
         with self._lock:

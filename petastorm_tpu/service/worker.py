@@ -286,8 +286,8 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         #: cache: cumulative fill bytes this worker pushed into the
         #: plane; over budget the tenant's readers are built WITHOUT the
         #: plane (direct decode).  Both degrade, neither stalls.
-        self._shm_quota = tenancy.QuotaLedger()
-        self._cache_quota = tenancy.QuotaLedger()
+        self._shm_quota = tenancy.QuotaLedger(label='shm')
+        self._cache_quota = tenancy.QuotaLedger(label='cache')
         #: (split_id, attempt) -> shm bytes charged; refunded on ack /
         #: replay / decode error so a lost ack cannot leak budget.
         self._shm_split_bytes = {}
@@ -1444,10 +1444,15 @@ class Worker(object):  # ptlint: disable=pickle-unsafe-attrs — a worker IS a p
         """The heartbeat payload: ``diagnostics`` plus the telemetry
         piggyback — the full registry snapshot (stage histograms merge
         fleet-wide by addition in the dispatcher), the EWMA clock offset
-        for span alignment with its drift-vs-registration estimate, and
-        this process's pid for timeline labels."""
+        for span alignment with its drift-vs-registration estimate,
+        this process's decision-journal payload (ISSUE 20 — worker-side
+        quota/hedge/autotuner/residency decisions reach the dispatcher
+        rollup on the channel that already exists), and the pid for
+        timeline labels."""
+        from petastorm_tpu.telemetry import decisions as _decisions
         return dict(self.diagnostics,
                     registry=self.metrics.snapshot(),
                     clock_offset=self.clock_offset,
                     clock_drift_ms=self.clock_drift_ms,
+                    decisions=_decisions.heartbeat_payload(),
                     pid=os.getpid())

@@ -34,6 +34,7 @@ See ``docs/observability.md`` for the registry model, the span
 catalogue, the verdict catalogue, and scrape examples.
 """
 
+from petastorm_tpu.telemetry import decisions  # noqa: F401
 from petastorm_tpu.telemetry import flight  # noqa: F401
 from petastorm_tpu.telemetry import health  # noqa: F401
 from petastorm_tpu.telemetry import provenance  # noqa: F401
@@ -48,7 +49,7 @@ __all__ = ['MetricsRegistry', 'merge_snapshots', 'hist_quantile',
            'snapshot_all', 'snapshot_delta', 'summarize_hist',
            'SpanBuffer', 'current_buffer', 'merge_into_recorder',
            'measure_clock_offset', 'attribute_stalls', 'dump_state',
-           'flight', 'health', 'provenance']
+           'decisions', 'flight', 'health', 'provenance']
 
 
 def dump_state():
@@ -65,4 +66,7 @@ def dump_state():
             'flight': flight.dump_current(),
             # Per-batch provenance journals (ISSUE 13): the causal
             # chains `petastorm-tpu-explain --artifact` reconstructs.
-            'provenance': provenance.dump_journals()}
+            'provenance': provenance.dump_journals(),
+            # Control-plane decision journals (ISSUE 20): the records
+            # `petastorm-tpu-why --artifact` explains.
+            'decisions': decisions.dump_journals()}

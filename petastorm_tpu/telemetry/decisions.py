@@ -1,0 +1,700 @@
+"""Control-plane decision journal (ISSUE 20): every autonomous action
+explains itself.
+
+Seven control laws act on this plane without a human in the loop — the
+fleet autoscaler (ISSUE 16), tenant WDRR scheduling + quota enforcement
+(ISSUE 16), cache-affinity lease routing (ISSUE 10), materialize
+admission (ISSUE 18), ingest hedging (ISSUE 14), the scheduler autotuner
+(ISSUE 9), and the device-residency LRU (ISSUE 17).  Their actions have
+so far surfaced only as bare counters (``suppressed``,
+``affinity_deferrals``, ``residency_thrash``...), so an operator staring
+at a drained worker or a starved tenant cannot reconstruct *why*.  This
+module is the control-plane sibling of the per-batch provenance journal
+(ISSUE 13): any action resolves to the **named rule** that fired and a
+snapshot of the inputs it read.
+
+One record per decision, compact and JSON-able::
+
+    {'actor': 'autoscaler', 'action': 'scale_in', 'rule': 'autoscale_idle_s',
+     'inputs': {'pending': 0, 'leased': 0, 'alive': [...], 'idle_s': 31.0,
+                'threshold_s': 30.0, 'coverage': {...}},
+     'suppressed': False, 'worker_id': 'w3',
+     'seq': 17, 't_mono': ..., 'unix_time': ..., 'cooldown_until': ...}
+
+Suppressed NON-actions are first-class records (``suppressed=True``):
+a cooldown that vetoed a scale-out, a quota refusal, a hot-window
+publish refusal, the autotuner's no-evidence hold — "why did nothing
+happen" is exactly the question an operator asks of a wedged
+controller.
+
+Everything flows through ONE seam, :func:`record_decision` — the only
+call sites the decision-catalogue docs pin.  Records ride EXISTING
+channels only: worker heartbeats carry each process's journal summary
+to the dispatcher rollup, flight-recorder frames carry
+``decisions_recent``, ``telemetry.dump_state()`` ships the full
+journals, and the dispatcher's own journal persists through the PR 15
+ledger's dirty-tick so a restart keeps its decision history.
+
+The **determinism cross-check** (:func:`replay_decision`) replays a
+record's input snapshot through a pure re-statement of the control law
+and flags divergence — the runtime sibling of the PR 19 code<->model
+conformance gate: a record whose replay disagrees means the code
+drifted from its own inputs (or the snapshot lies), either of which is
+a bug.  ``petastorm-tpu-why --check`` runs it over every ingested
+record.
+
+Kill switch: ``PETASTORM_TPU_NO_DECISIONS=1`` — :func:`record_decision`
+becomes a no-op returning None; every instrumented control law already
+computed its action before recording, so delivery is bit-identical
+(pinned by test).
+"""
+
+import os
+import threading  # noqa: F401 — make_lock returns threading locks
+import time
+import weakref
+
+from petastorm_tpu.utils.locks import make_lock
+
+__all__ = ['KILL_SWITCH', 'enabled', 'ACTORS', 'CATALOGUE',
+           'RECORD_REQUIRED_KEYS', 'DecisionJournal', 'record_decision',
+           'default_journal', 'journals', 'dump_journals',
+           'recent_summaries', 'summarize_decision', 'replay_decision',
+           'REPLAYS']
+
+KILL_SWITCH = 'PETASTORM_TPU_NO_DECISIONS'
+
+
+def enabled():
+    """False when ``PETASTORM_TPU_NO_DECISIONS`` vetoes journaling."""
+    return os.environ.get(KILL_SWITCH, '') in ('', '0')
+
+
+#: The seven instrumented control laws.  The decision-catalogue table in
+#: docs/observability.md must carry one row per actor (sync-pinned by
+#: tests/test_decisions.py).
+ACTORS = ('autoscaler', 'tenant_sched', 'affinity', 'materialize',
+          'hedge', 'autotuner', 'residency')
+
+#: actor -> {'actions': (...), 'rules': (...)}: the full vocabulary each
+#: actor may emit through the seam.  Single source of truth for the
+#: golden-schema pin AND the docs decision-catalogue sync pin.  A rule
+#: name is the EXISTING threshold name of the control law that fired
+#: (autoscale_idle_s, hot_window_s, ...), never a new invention.
+CATALOGUE = {
+    'autoscaler': {
+        'actions': ('scale_out', 'scale_in', 'hold'),
+        'rules': ('autoscale_starve_s', 'autoscale_idle_s',
+                  'autoscale_cooldown_s'),
+    },
+    'tenant_sched': {
+        'actions': ('pick', 'refund', 'quota_refused'),
+        'rules': ('wdrr_deficit', 'wdrr_refund', 'quota_budget'),
+    },
+    'affinity': {
+        'actions': ('routed', 'deferred', 'deferral_exhausted'),
+        'rules': ('affinity_min_coverage', 'affinity_defer_s'),
+    },
+    'materialize': {
+        'actions': ('published', 'refuse_publish', 'poison_piece'),
+        'rules': ('hot_window_s', 'max_piece_attempts'),
+    },
+    'hedge': {
+        'actions': ('hedge', 'hedge_win', 'abandon'),
+        'rules': ('hedge_deadline_s', 'checkout_timeout_s'),
+    },
+    'autotuner': {
+        'actions': ('grow', 'shrink', 'hold'),
+        'rules': ('skew_ratio_floor', 'wait_frac_floor',
+                  'delivery_jitter', 'ingest_wait_grow_s',
+                  'no_evidence_hold'),
+    },
+    'residency': {
+        'actions': ('admitted', 'evicted', 'bypass', 'drop'),
+        'rules': ('residency_budget',),
+    },
+}
+
+#: Keys every record carries regardless of actor — the golden record
+#: schema (tests/test_decisions.py pins it per actor).
+RECORD_REQUIRED_KEYS = ('actor', 'action', 'rule', 'inputs', 'suppressed',
+                        'seq', 't_mono', 'unix_time')
+
+#: Ring bound: at <= 1 Hz per actor this is tens of minutes of history.
+DEFAULT_CAPACITY = 256
+
+#: Real (non-suppressed) actions are RARE next to holds/refusals, so the
+#: last record per (actor, action) pair is retained past ring eviction —
+#: the rolling rarest-K analogue of the provenance journal's worst-K:
+#: "when did this controller last actually act" must survive a storm of
+#: suppressions.
+_NOTABLE_CAP = 32
+
+
+class DecisionJournal(object):  # ptlint: disable=pickle-unsafe-attrs — pickles by content (__getstate__/__setstate__); dumps are what cross boundaries
+    """Bounded per-process ring of decision records (the PR 12 journal
+    idiom): a ``capacity``-bounded ring plus the rarest-K retention of
+    the last real action per (actor, action), per-actor counters, and a
+    JSON-able :meth:`dump` that :meth:`restore` round-trips — the shape
+    the dispatcher ledger persists so a restart keeps decision history.
+
+    ``on_record`` (when set) fires after every append, outside the
+    journal lock — the dispatcher hooks its ledger dirty-tick here.
+    """
+
+    def __init__(self, capacity=DEFAULT_CAPACITY, label=None):
+        self.capacity = int(capacity)
+        self.label = label
+        self.on_record = None
+        self._lock = make_lock('telemetry.decisions.DecisionJournal._lock')
+        self._records = []
+        self._notable = {}        # (actor, action) -> last real record
+        self._counts = {}         # actor -> {'actions': n, 'suppressed': n}
+        self._seq = 0
+        self._restores = 0
+        _LIVE.add(self)
+
+    def record(self, actor, action, rule, inputs, suppressed=False,
+               cooldown_until=None, **extra):
+        """Append one decision record and return it (a plain dict)."""
+        rec = dict(extra)
+        rec.update({
+            'actor': actor,
+            'action': action,
+            'rule': rule,
+            'inputs': inputs,
+            'suppressed': bool(suppressed),
+            't_mono': time.monotonic(),
+            'unix_time': time.time(),
+        })
+        if cooldown_until is not None:
+            rec['cooldown_until'] = cooldown_until
+        with self._lock:
+            rec['seq'] = self._seq
+            self._seq += 1
+            self._records.append(rec)
+            del self._records[:-self.capacity]
+            counts = self._counts.setdefault(
+                actor, {'actions': 0, 'suppressed': 0})
+            counts['suppressed' if suppressed else 'actions'] += 1
+            if not suppressed:
+                self._notable[(actor, action)] = rec
+                while len(self._notable) > _NOTABLE_CAP:
+                    self._notable.pop(next(iter(self._notable)))
+        hook = self.on_record
+        if hook is not None:
+            try:
+                hook(rec)
+            except Exception:  # noqa: BLE001 — diagnostics never take the host down
+                pass
+        return rec
+
+    # -- reading -------------------------------------------------------------
+
+    def records(self):
+        with self._lock:
+            return list(self._records)
+
+    def last(self, actor, suppressed=None):
+        """Newest record for ``actor`` (``suppressed`` filters when set);
+        searches the ring, then the rarest-K survivors."""
+        with self._lock:
+            for rec in reversed(self._records):
+                if rec['actor'] != actor:
+                    continue
+                if suppressed is not None \
+                        and rec['suppressed'] != suppressed:
+                    continue
+                return rec
+            if suppressed in (None, False):
+                best = None
+                for (a, _), rec in self._notable.items():
+                    if a == actor and (best is None
+                                       or rec['seq'] > best['seq']):
+                        best = rec
+                return best
+        return None
+
+    def counts(self):
+        with self._lock:
+            return {actor: dict(c) for actor, c in self._counts.items()}
+
+    def summary(self, now=None):
+        """Per-actor rollup for ``top`` / the dispatcher stats reply:
+        action + suppression counts and the last real action with its
+        age — a wedged controller is visible at a glance."""
+        now = time.monotonic() if now is None else now
+        out = {}
+        with self._lock:
+            notable = dict(self._notable)
+            counts = {actor: dict(c) for actor, c in self._counts.items()}
+        for actor, c in counts.items():
+            best = None
+            for (a, _), rec in notable.items():
+                if a == actor and (best is None or rec['seq'] > best['seq']):
+                    best = rec
+            row = dict(c)
+            row['last'] = summarize_decision(best, now=now) if best else None
+            out[actor] = row
+        return out
+
+    def dump(self):
+        """JSON-able dump of the ring + survivors + identity — the shape
+        the ledger persists and ``petastorm-tpu-why`` ingests."""
+        with self._lock:
+            return {
+                'kind': 'decision_journal',
+                'pid': os.getpid(),
+                'label': self.label,
+                'seq': self._seq,
+                'restores': self._restores,
+                'records': list(self._records),
+                'notable': [rec for rec in self._notable.values()],
+                'counts': {actor: dict(c)
+                           for actor, c in self._counts.items()},
+            }
+
+    def restore(self, state):
+        """Re-seed from a :meth:`dump` (dispatcher ledger restart path).
+        Records survive attempt-intact — same seq, same inputs, same
+        monotonic stamps (from the DEAD process's clock; ``unix_time``
+        is the cross-restart ordering key).  Never raises: a corrupt
+        section loses history, not the dispatcher."""
+        if not isinstance(state, dict) \
+                or state.get('kind') != 'decision_journal':
+            return False
+        try:
+            records = [dict(r) for r in state.get('records') or ()
+                       if isinstance(r, dict)]
+            notable = [dict(r) for r in state.get('notable') or ()
+                       if isinstance(r, dict)]
+            counts = {str(a): {'actions': int(c.get('actions', 0)),
+                               'suppressed': int(c.get('suppressed', 0))}
+                      for a, c in (state.get('counts') or {}).items()
+                      if isinstance(c, dict)}
+            seq = int(state.get('seq', len(records)))
+        except (TypeError, ValueError, AttributeError):
+            return False
+        with self._lock:
+            self._records = records[-self.capacity:]
+            self._notable = {(r.get('actor'), r.get('action')): r
+                             for r in notable}
+            self._counts = counts
+            self._seq = max(seq, self._seq)
+            self._restores = int(state.get('restores', 0) or 0) + 1
+        return True
+
+    def opposing_actions(self, window_s=60.0, now=None):
+        """Opposing real-action pairs inside the window, per actor — the
+        health engine's ``control-flapping`` evidence.  An autoscaler
+        that both scaled out and scaled in (or a residency tier that
+        admitted and evicted) within one window is oscillating."""
+        now = time.monotonic() if now is None else now
+        horizon = now - float(window_s)
+        opposing = {'autoscaler': ('scale_out', 'scale_in'),
+                    'residency': ('admitted', 'evicted')}
+        tally = {}
+        with self._lock:
+            recent = [r for r in self._records
+                      if not r['suppressed'] and r['t_mono'] >= horizon]
+        for actor, (a, b) in opposing.items():
+            na = sum(1 for r in recent
+                     if r['actor'] == actor and r['action'] == a)
+            nb = sum(1 for r in recent
+                     if r['actor'] == actor and r['action'] == b)
+            pairs = min(na, nb)
+            if pairs:
+                tally[actor] = pairs
+        return tally
+
+    # -- pickling (by content, the provenance idiom) -------------------------
+
+    def __getstate__(self):
+        state = self.dump()
+        state['capacity'] = self.capacity
+        return state
+
+    def __setstate__(self, state):
+        self.__init__(capacity=state.get('capacity', DEFAULT_CAPACITY),
+                      label=state.get('label'))
+        self.restore(state)
+        self._restores = int(state.get('restores', 0) or 0)
+
+
+def summarize_decision(record, now=None):
+    """Compact ref of one record for bounded channels (flight frames,
+    stats rollups, ``top``): identity + age, never the full inputs."""
+    if record is None:
+        return None
+    now = time.monotonic() if now is None else now
+    out = {'actor': record.get('actor'),
+           'action': record.get('action'),
+           'rule': record.get('rule'),
+           'suppressed': record.get('suppressed'),
+           'seq': record.get('seq'),
+           'age_s': round(max(0.0, now - record.get('t_mono', now)), 1)}
+    for key in ('worker_id', 'tenant'):
+        if record.get(key) is not None:
+            out[key] = record[key]
+    return out
+
+
+# -- process wiring -----------------------------------------------------------
+
+_LIVE = weakref.WeakSet()
+_DEFAULT = None
+_DEFAULT_PID = None
+_DEFAULT_LOCK = make_lock('telemetry.decisions._DEFAULT_LOCK')
+
+
+def default_journal(label=None):
+    """The pid-keyed process journal (created on first use; a fork gets
+    a fresh one — the ``spans.current_buffer`` idiom).  Actors that own
+    no explicit journal record here; the dispatcher instead passes its
+    ledger-persisted journal through the seam."""
+    global _DEFAULT, _DEFAULT_PID
+    pid = os.getpid()
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None or _DEFAULT_PID != pid:
+            _DEFAULT = DecisionJournal(label=label or 'proc')
+            _DEFAULT_PID = pid
+        return _DEFAULT
+
+
+def record_decision(actor, action, rule, inputs, suppressed=False,
+                    cooldown_until=None, journal=None, **extra):
+    """THE one seam every control law records through.
+
+    Returns the record dict, or None when the kill switch is set.  The
+    caller has already decided and (when acting) already acted — this
+    call must never change behavior, only remember it.
+    """
+    if not enabled():
+        return None
+    target = journal if journal is not None else default_journal()
+    return target.record(actor, action, rule, inputs,
+                         suppressed=suppressed,
+                         cooldown_until=cooldown_until, **extra)
+
+
+def journals():
+    """Every live journal in this process."""
+    return [j for j in _LIVE]
+
+
+def dump_journals():
+    """Full dumps of every live journal — rides
+    ``telemetry.dump_state()`` and the flight recorder's ``dump()``."""
+    return [j.dump() for j in journals()]
+
+
+def heartbeat_payload(k=8):
+    """Bounded journal payload a worker heartbeat ships: the per-actor
+    summary plus the newest-k FULL records, so a live dispatcher can
+    answer "why was this publish refused" about a worker-side decision
+    without reaching into the worker process."""
+    journal = default_journal()
+    return {'summary': journal.summary(),
+            'recent': journal.records()[-int(k):]}
+
+
+def recent_summaries(k=6, now=None):
+    """The newest-k compact decision refs across every live journal —
+    the bounded payload flight frames carry as ``decisions_recent``."""
+    now = time.monotonic() if now is None else now
+    recent = []
+    for journal in journals():
+        recent.extend(journal.records()[-k:])
+    recent.sort(key=lambda r: (r.get('t_mono', 0.0), r.get('seq', 0)))
+    return [summarize_decision(r, now=now) for r in recent[-k:]]
+
+
+# -- determinism cross-check --------------------------------------------------
+#
+# One pure function per rule, re-stating the control law over the
+# record's input snapshot ONLY.  Each returns a dict of expected fields
+# ('action' always; 'worker_id'/'tenant' when the law also picks a
+# victim/winner); replay_decision compares the intersection against the
+# record.  Deliberately duplicated from the live code paths: a shared
+# helper would make the cross-check tautological.
+
+REPLAYS = {}
+
+
+def _replay(rule):
+    def register(fn):
+        REPLAYS[rule] = fn
+        return fn
+    return register
+
+
+@_replay('autoscale_starve_s')
+def _replay_starve(inputs):
+    starved = (int(inputs.get('pending', 0)) > 0
+               and (not inputs.get('alive')
+                    or int(inputs.get('free_slots', 0)) == 0))
+    ripe = float(inputs.get('starve_s', 0.0)) \
+        >= float(inputs.get('threshold_s', 0.0))
+    want = min(int(inputs.get('step', 1)),
+               int(inputs.get('max_workers', 0))
+               - len(inputs.get('alive') or ()))
+    cooled = float(inputs.get('cooldown_remaining_s', 0.0)) <= 0.0
+    if starved and ripe and want > 0 and cooled:
+        return {'action': 'scale_out'}
+    return {'action': 'hold'}
+
+
+@_replay('autoscale_idle_s')
+def _replay_idle(inputs):
+    alive = list(inputs.get('alive') or ())
+    idle = (int(inputs.get('pending', 0)) == 0
+            and int(inputs.get('leased', 0)) == 0 and alive)
+    ripe = float(inputs.get('idle_s', 0.0)) \
+        >= float(inputs.get('threshold_s', 0.0))
+    roomy = len(alive) > int(inputs.get('min_workers', 0))
+    cooled = float(inputs.get('cooldown_remaining_s', 0.0)) <= 0.0
+    if not (idle and ripe and roomy and cooled):
+        return {'action': 'hold'}
+    coverage = inputs.get('coverage') or {}
+    victim = min(alive, key=lambda wid: (coverage.get(wid, 0), wid))
+    return {'action': 'scale_in', 'worker_id': victim}
+
+
+@_replay('autoscale_cooldown_s')
+def _replay_cooldown(inputs):
+    if float(inputs.get('cooldown_remaining_s', 0.0)) > 0.0 \
+            or int(inputs.get('want', 1)) <= 0:
+        return {'action': 'hold'}
+    return {'action': inputs.get('wanted', 'hold')}
+
+
+@_replay('wdrr_deficit')
+def _replay_wdrr(inputs):
+    eligible = list(inputs.get('eligible') or ())
+    if not eligible:
+        return {'action': 'pick', 'tenant': None}
+    if len(eligible) == 1:
+        return {'action': 'pick', 'tenant': eligible[0]['tenant']}
+    clamp = float(inputs.get('deficit_clamp', 8.0))
+    total = sum(float(e.get('weight', 1.0)) for e in eligible) \
+        or float(len(eligible))
+    best, best_deficit = None, None
+    for entry in eligible:
+        share = (float(entry.get('weight', 1.0)) / total) if total \
+            else 1.0 / len(eligible)
+        deficit = float(entry.get('deficit', 0.0)) + share
+        deficit = max(-clamp, min(clamp, deficit))
+        if best is None or deficit > best_deficit:
+            best, best_deficit = entry, deficit
+    return {'action': 'pick', 'tenant': best['tenant']}
+
+
+@_replay('wdrr_refund')
+def _replay_refund(inputs):
+    return {'action': 'refund'}
+
+
+@_replay('quota_budget')
+def _replay_quota(inputs):
+    budget = inputs.get('budget')
+    refused = budget is not None and \
+        int(inputs.get('used', 0)) + int(inputs.get('nbytes', 0)) \
+        > int(budget)
+    return {'action': 'quota_refused' if refused else 'pick'}
+
+
+@_replay('affinity_min_coverage')
+def _replay_affinity(inputs):
+    if float(inputs.get('coverage', 0.0)) \
+            >= float(inputs.get('min_coverage', 0.5)):
+        return {'action': 'routed'}
+    return {'action': 'deferred'}
+
+
+@_replay('affinity_defer_s')
+def _replay_affinity_exhausted(inputs):
+    if float(inputs.get('waited_s', 0.0)) \
+            >= float(inputs.get('defer_s', 0.0)):
+        return {'action': 'deferral_exhausted'}
+    return {'action': 'deferred'}
+
+
+@_replay('hot_window_s')
+def _replay_hot_window(inputs):
+    fits = inputs.get('fits')
+    if fits is not None:
+        newest = inputs.get('victim_newest_age_s')
+        admitted = bool(fits) or newest is None \
+            or float(newest) >= float(inputs.get('hot_window_s', 300.0))
+    else:
+        # No eviction estimate in the snapshot (diskless plane or a
+        # failed estimator): the recorded verdict is all there is.
+        admitted = bool(inputs.get('admitted'))
+    return {'action': 'published' if admitted else 'refuse_publish'}
+
+
+@_replay('max_piece_attempts')
+def _replay_poison(inputs):
+    if int(inputs.get('attempts', 0)) \
+            >= int(inputs.get('max_attempts', 0)):
+        return {'action': 'poison_piece'}
+    return {'action': 'published'}
+
+
+@_replay('hedge_deadline_s')
+def _replay_hedge(inputs):
+    if inputs.get('won'):
+        # hedge_win is an OUTCOME record (the hedge fetch landed first),
+        # not a threshold decision — nothing to re-derive.
+        return {'action': 'hedge_win'}
+    deadline = inputs.get('deadline_s')
+    if deadline is None:
+        return {'action': 'hold'}
+    if float(inputs.get('blocked_s', 0.0)) >= float(deadline):
+        return {'action': 'hedge'}
+    return {'action': 'hold'}
+
+
+@_replay('checkout_timeout_s')
+def _replay_abandon(inputs):
+    if float(inputs.get('blocked_s', 0.0)) \
+            >= float(inputs.get('timeout_s', 0.0)):
+        return {'action': 'abandon'}
+    return {'action': 'hold'}
+
+
+@_replay('skew_ratio_floor')
+def _replay_skew(inputs):
+    ratio = inputs.get('skew_ratio')
+    if ratio is None:
+        return {'action': 'hold'}
+    if float(ratio) >= float(inputs.get('floor', 8.0)):
+        return {'action': 'grow'}
+    return {'action': 'shrink'}
+
+
+@_replay('wait_frac_floor')
+def _replay_wait_frac(inputs):
+    if float(inputs.get('wait_frac', 0.0)) \
+            > float(inputs.get('floor', 0.1)):
+        return {'action': 'grow'}
+    return {'action': 'shrink'}
+
+
+@_replay('delivery_jitter')
+def _replay_jitter(inputs):
+    jitter = float(inputs.get('hb_p99', 0.0)) \
+        > float(inputs.get('slow_factor', 4.0)) \
+        * float(inputs.get('dp_p99', 0.0))
+    return {'action': 'grow' if jitter else 'shrink'}
+
+
+@_replay('ingest_wait_grow_s')
+def _replay_ingest_wait(inputs):
+    if float(inputs.get('d_wait_s', 0.0)) \
+            > float(inputs.get('grow_s', 0.05)):
+        return {'action': 'grow'}
+    if int(inputs.get('d_fetches', 0)) > 0:
+        return {'action': 'shrink'}
+    return {'action': 'hold'}
+
+
+@_replay('no_evidence_hold')
+def _replay_no_evidence(inputs):
+    return {'action': 'hold'}
+
+
+@_replay('residency_budget')
+def _replay_residency(inputs):
+    if 'rows' not in inputs:
+        return None  # 'drop' records carry no allocator snapshot
+    rows = int(inputs['rows'])
+    capacity = int(inputs.get('capacity', 0))
+    if inputs.get('dropped') or rows == 0 or rows > capacity:
+        return {'action': 'bypass'}
+    # Simulate the allocator over the pre-admission snapshot: exact-size
+    # free-segment reuse, else bump allocation, evicting LRU entries
+    # (their freed segments do not coalesce) until the batch fits or the
+    # tier is empty.
+    free = [int(r) for r in inputs.get('free_rows') or ()]
+    entries = [int(r) for r in inputs.get('entry_rows') or ()]
+    bump = int(inputs.get('bump', 0))
+
+    def _fits():
+        nonlocal bump
+        if rows in free:
+            free.remove(rows)
+            return True
+        if bump + rows <= capacity:
+            bump += rows
+            return True
+        return False
+
+    evicted = False
+    ok = _fits()
+    while not ok and entries:
+        free.append(entries.pop(0))
+        evicted = True
+        ok = _fits()
+    if not ok:
+        return {'action': 'bypass'}
+    return {'action': 'evicted' if evicted else 'admitted'}
+
+
+#: Clamped-knob check shared by every autotuner replay: the recorded
+#: `new` value must equal max(lo, min(hi, int(current * factor))).
+def replay_knob_step(inputs):
+    current = inputs.get('current')
+    factor = inputs.get('factor')
+    if current is None or factor is None:
+        return None
+    expected = int(round(int(current) * float(factor)))
+    lo, hi = inputs.get('lo'), inputs.get('hi')
+    if lo is not None:
+        expected = max(int(lo), expected)
+    if hi is not None:
+        expected = min(int(hi), expected)
+    return expected
+
+
+def replay_decision(record):
+    """Replay one record's input snapshot through the pure control law.
+
+    Returns ``{'rule', 'verdict', 'recorded', 'replayed'}`` where
+    verdict is ``'match'`` (every replayed field agrees),
+    ``'divergent'`` (the pure law disagrees with what the code did —
+    the code drifted from its own inputs), or ``'unchecked'`` (no
+    replay registered for this rule, or the snapshot is unusable).
+    """
+    rule = record.get('rule')
+    fn = REPLAYS.get(rule)
+    result = {'rule': rule, 'seq': record.get('seq'),
+              'actor': record.get('actor')}
+    if fn is None or not isinstance(record.get('inputs'), dict):
+        result.update(verdict='unchecked', recorded=None, replayed=None)
+        return result
+    inputs = record['inputs']
+    try:
+        expected = fn(inputs)
+    except Exception as e:  # noqa: BLE001 — an unreplayable snapshot is a verdict, not a crash
+        result.update(verdict='unchecked', recorded=None,
+                      replayed='replay raised %s: %s'
+                               % (type(e).__name__, e))
+        return result
+    if expected is None:
+        result.update(verdict='unchecked', recorded=None, replayed=None)
+        return result
+    recorded = {key: record.get(key) for key in expected}
+    divergent = any(recorded.get(key) != value
+                    for key, value in expected.items())
+    # Autotuner knob records additionally pin the clamped arithmetic.
+    if not divergent and record.get('actor') == 'autotuner' \
+            and record.get('new') is not None:
+        want = replay_knob_step(inputs)
+        if want is not None and int(record['new']) != want:
+            divergent = True
+            expected = dict(expected, new=want)
+            recorded = dict(recorded, new=record.get('new'))
+    result.update(verdict='divergent' if divergent else 'match',
+                  recorded=recorded, replayed=expected)
+    return result

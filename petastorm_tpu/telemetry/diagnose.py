@@ -101,6 +101,14 @@ _REGIME_ACTIONS = {
         'holder lists are failing peer fetches — check the dispatcher '
         'endpoint and peer data-plane reachability before adding '
         'capacity'),
+    'control-flapping': (
+        'an autonomous controller is oscillating — opposing actions '
+        '(scale_out/scale_in, admit/evict) inside one window pay both '
+        'transition costs and deliver neither steady state: widen the '
+        "actor's hysteresis (autoscale_cooldown_s, the autoscale_idle_s "
+        'vs autoscale_starve_s gap, hbm_budget_bytes vs working set); '
+        'petastorm-tpu-why --actor <actor> names each rule that fired '
+        'and the inputs it read'),
     'fetch-bound': (
         'cold-read I/O is on the critical path: deepen the ingest '
         "readahead (ingest_window on make_reader, or let the DataLoader "
@@ -175,6 +183,10 @@ def evidence_from_stats(stats, source='live fleet'):
         # rollup + the autoscaler's action counters.
         'tenants': tenants,
         'autoscale': stats.get('autoscale') or {},
+        # Decision-journal rollup (ISSUE 20): per-actor action /
+        # suppression counts + last real action — the control-flapping
+        # verdict cites the actual journaled decision from it.
+        'decisions': stats.get('decisions') or {},
     }
 
 
@@ -356,6 +368,26 @@ def _regime_verdicts(evidence):
                     '(weight %.1f)'
                     % (top, int(rows[top].get('grants_delta', 0) or 0),
                        float(rows[top].get('weight', 1.0) or 1.0)))
+        elif regime == 'control-flapping':
+            # Cite the actual journaled decision, not just the pair
+            # count: the flapping actor's last real action with its
+            # rule, subject, and age (ISSUE 20).
+            rows = evidence.get('decisions') or {}
+            for actor in sorted(rows):
+                if actor not in candidate['evidence']:
+                    continue
+                last = (rows[actor] or {}).get('last')
+                if last:
+                    subject = last.get('worker_id') or last.get('tenant')
+                    evidence_bits.append(
+                        'last journaled %s action: %s%s (rule %s, '
+                        '%.0fs ago) — petastorm-tpu-why --actor %s '
+                        'replays the timeline'
+                        % (actor, last.get('action'),
+                           ' %s' % subject if subject else '',
+                           last.get('rule'),
+                           float(last.get('age_s', 0.0) or 0.0), actor))
+                break
         elif regime == 'shm-degraded':
             worker = _worst_worker(evidence, 'shm_degraded')
             if worker:

@@ -45,7 +45,7 @@ import threading
 from petastorm_tpu.utils.locks import make_lock
 import time
 
-from petastorm_tpu.telemetry import provenance
+from petastorm_tpu.telemetry import decisions, provenance
 from petastorm_tpu.telemetry.registry import merge_snapshots, snapshot_all
 from petastorm_tpu.telemetry.spans import current_buffer
 from petastorm_tpu.utils import ipc
@@ -178,6 +178,12 @@ class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-pro
         worst = provenance.worst_summaries()
         if worst:
             frame['provenance_worst'] = worst
+        # Control-plane decisions (ISSUE 20): the last few decision
+        # summaries from every live journal — same compact-refs-in-frames /
+        # full-journals-in-dump() split as provenance.
+        recent = decisions.recent_summaries()
+        if recent:
+            frame['decisions_recent'] = recent
         return frame
 
     # -- thread lifecycle ----------------------------------------------------
@@ -247,6 +253,9 @@ class FlightRecorder(object):  # ptlint: disable=pickle-unsafe-attrs — per-pro
             # unbounded-once (not a ring frame), so the complete causal
             # chains ship with the crash artifact.
             'provenance': provenance.dump_journals(),
+            # Full decision journals (ISSUE 20): same unbounded-once
+            # treatment, so `petastorm-tpu-why` can ingest a flight dump.
+            'decisions': decisions.dump_journals(),
         }
 
     _owner_fd = None

@@ -68,6 +68,14 @@ Regime catalogue (``classify_regime``):
   these is a restart/scale-in event away from an outage.  Knobs: the
   dispatcher's crash loop (why is it restarting?), ``drain_timeout_s``
   vs real in-flight split time, dispatcher reachability.
+* ``control-flapping`` — an autonomous controller is oscillating
+  (ISSUE 20): the decision journal shows opposing real actions from the
+  same actor inside one window (autoscaler scale_out+scale_in pairs,
+  residency admit+evict pairs at the LRU).  Each flap pays both
+  transition costs and delivers neither steady state.  Knobs: widen the
+  actor's hysteresis (``autoscale_cooldown_s``, ``autoscale_idle_s`` vs
+  ``autoscale_starve_s`` gap, ``hbm_budget_bytes``);
+  ``petastorm-tpu-why --actor autoscaler`` names the rules that fired.
 * ``residency-thrash`` — the device-resident tier's admissions are
   displacing live entries (``residency_thrash`` vs admissions + hits,
   ISSUE 17): the HBM budget is smaller than the working set, so every
@@ -93,7 +101,8 @@ __all__ = ['classify_regime', 'health_report', 'report_from_frames',
 REGIMES = ('decode-bound', 'link-bound', 'lease-starved', 'cache-degraded',
            'cluster-cache-degraded', 'shm-degraded', 'skew-bound',
            'fetch-bound', 'tenant-starved', 'control-plane-degraded',
-           'residency-thrash', 'resident', 'healthy', 'idle')
+           'control-flapping', 'residency-thrash', 'resident', 'healthy',
+           'idle')
 
 #: Histogram name -> pipeline component.  Names from every registry the
 #: fleet merges: service workers (decode_split/serialize/shm_publish),
@@ -137,6 +146,11 @@ BUSY_SHARE_FLOOR = 0.6
 SKEW_UTILIZATION_CEIL = 0.6
 #: ...and enough samples that the quantile ratio means something.
 SKEW_MIN_COUNT = 16
+#: Opposing decision pairs (scale_out+scale_in, admit+evict) from ONE
+#: actor in one window before the control plane reads as flapping.  One
+#: pair is a legitimate correction (burst arrived, burst drained); two
+#: is an oscillation.
+CONTROL_FLAP_FLOOR = 2
 
 
 def busy_seconds(delta):
@@ -322,6 +336,21 @@ def classify_regime(delta, stall_pct=None, meta=None):
             '%d retry episode(s) exhausted their budget in this window '
             '(retry_giveups: heartbeat backoff or all-holders-failed '
             'peer fetches)' % giveups))
+    # 4c. control flapping (ISSUE 20): opposing real actions from one
+    # controller inside the journal's window — the decision journal is
+    # the only evidence source here (bare counters cannot order the
+    # actions in time).  The dispatcher ships
+    # ``DecisionJournal.opposing_actions()`` in the stats meta.
+    flaps = (meta or {}).get('control_flaps') or {}
+    for actor, pairs in sorted(flaps.items()):
+        pairs = int(pairs or 0)
+        if pairs >= CONTROL_FLAP_FLOOR:
+            candidates.append((
+                min(1.0, 0.45 + 0.15 * pairs),
+                'control-flapping',
+                '%s made %d opposing action pair(s) inside one window '
+                '(decision journal) — oscillating, paying both '
+                'transition costs' % (actor, pairs)))
     if meta:
         # Cumulative lineage from the stats meta, crash-LOOP floor: a
         # restarted dispatcher carries a FRESH flight ring, so its own

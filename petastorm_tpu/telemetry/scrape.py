@@ -1,0 +1,124 @@
+"""Prometheus scrape endpoint (ISSUE 20): ``GET /metrics`` over stdlib
+``http.server`` — zero new dependencies, one daemon thread.
+
+Serves the text exposition of every live :class:`MetricsRegistry` in the
+process (each already knows :meth:`render_prometheus`) plus the
+decision-journal gauges (per-actor action/suppression totals and the
+age of the last real action — the "is this controller wedged" signals
+``top`` prints, now scrapeable).  The dispatcher CLI arms it with
+``--metrics-port``; a ``refresh`` hook lets the host refresh derived
+gauges (fleet health) before each render.
+
+Scrape config example lives in docs/observability.md.
+"""
+
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger(__name__)
+
+__all__ = ['render_process_metrics', 'render_decision_metrics',
+           'start_metrics_server']
+
+_CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
+
+_LABEL_SAFE = re.compile(r'[^a-zA-Z0-9_]')
+
+
+def render_decision_metrics():
+    """Decision-journal gauges in text exposition format: per-actor
+    action and suppression totals plus last-real-action age, summed /
+    min'd over every live journal in the process."""
+    from petastorm_tpu.telemetry import decisions
+    actions = {}
+    suppressed = {}
+    last_age = {}
+    for journal in decisions.journals():
+        for actor, row in journal.summary().items():
+            actions[actor] = actions.get(actor, 0) + row.get('actions', 0)
+            suppressed[actor] = suppressed.get(actor, 0) \
+                + row.get('suppressed', 0)
+            last = row.get('last')
+            if last and last.get('age_s') is not None:
+                age = float(last['age_s'])
+                if actor not in last_age or age < last_age[actor]:
+                    last_age[actor] = age
+    lines = []
+    for metric, values, kind in (
+            ('petastorm_tpu_decisions_actions_total', actions, 'counter'),
+            ('petastorm_tpu_decisions_suppressed_total', suppressed,
+             'counter'),
+            ('petastorm_tpu_decisions_last_action_age_seconds', last_age,
+             'gauge')):
+        if not values:
+            continue
+        lines.append('# TYPE %s %s' % (metric, kind))
+        for actor in sorted(values):
+            lines.append('%s{actor="%s"} %s'
+                         % (metric, _LABEL_SAFE.sub('_', str(actor)),
+                            values[actor]))
+    return '\n'.join(lines)
+
+
+def render_process_metrics(refresh=None):
+    """One scrape body: every live registry + the decision gauges.
+    ``refresh`` (when given) runs first so derived gauges (fleet health,
+    decision rollups) are current — failures are swallowed, a scrape
+    must never take the host down."""
+    if refresh is not None:
+        try:
+            refresh()
+        except Exception:  # noqa: BLE001 — diagnostics are best-effort
+            pass
+    from petastorm_tpu.telemetry.registry import _LIVE
+    chunks = []
+    for registry in list(_LIVE):
+        try:
+            chunks.append(registry.render_prometheus())
+        except Exception as e:  # noqa: BLE001 — one sick registry must not kill the scrape
+            logger.debug('registry %s failed to render: %s',
+                         getattr(registry, 'namespace', '?'), e)
+            continue
+    decision_chunk = render_decision_metrics()
+    if decision_chunk:
+        chunks.append(decision_chunk)
+    return '\n'.join(c for c in chunks if c) + '\n'
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    server_version = 'petastorm-tpu-metrics/1.0'
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path.split('?', 1)[0] not in ('/', '/metrics'):
+            self.send_error(404, 'scrape /metrics')
+            return
+        body = render_process_metrics(
+            refresh=self.server.refresh).encode('utf-8')
+        self.send_response(200)
+        self.send_header('Content-Type', _CONTENT_TYPE)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format, *args):  # noqa: A002 — http.server API
+        pass  # scrapes every 15s must not spam the dispatcher log
+
+
+class _MetricsServer(ThreadingHTTPServer):
+    daemon_threads = True
+    refresh = None
+
+
+def start_metrics_server(port, host='0.0.0.0', refresh=None):
+    """Bind ``host:port`` (port 0 picks a free one) and serve
+    ``/metrics`` from a daemon thread.  Returns the server; read
+    ``server.server_address[1]`` for the resolved port and call
+    ``server.shutdown()`` to stop."""
+    server = _MetricsServer((host, int(port)), _MetricsHandler)
+    server.refresh = refresh
+    thread = threading.Thread(target=server.serve_forever,
+                              name='telemetry-metrics-http', daemon=True)
+    thread.start()
+    return server

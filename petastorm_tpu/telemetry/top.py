@@ -29,6 +29,26 @@ def _rate(hits, misses):
     return '%5.1f%%' % (100.0 * hits / total) if total else '    -'
 
 
+#: Past-tense phrasing for the autoscale/decisions "last" column.
+_ACTION_PHRASES = {'scale_in': 'drained', 'scale_out': 'spawned',
+                   'routed': 'routed', 'published': 'published',
+                   'evicted': 'evicted', 'admitted': 'admitted'}
+
+
+def _last_decision_phrase(row):
+    """'drained w3 42s ago' from one actor's decision-journal summary
+    row (``DecisionJournal.summary()`` shape), or None."""
+    last = (row or {}).get('last')
+    if not last:
+        return None
+    action = _ACTION_PHRASES.get(last.get('action'), last.get('action'))
+    subject = last.get('worker_id') or last.get('tenant')
+    age = last.get('age_s')
+    return '%s%s%s' % (action,
+                       ' %s' % subject if subject else '',
+                       ' %.0fs ago' % age if age is not None else '')
+
+
 def render_stats(stats, elapsed_s=None):
     """One text frame from a dispatcher ``stats`` reply."""
     from petastorm_tpu.telemetry.health import format_health_line
@@ -106,16 +126,35 @@ def render_stats(stats, elapsed_s=None):
                          % (tid[:12], float(row.get('weight', 1.0) or 1.0),
                             row.get('pending', '-'), row.get('done', '-'),
                             row.get('grants', '-'), delta, share))
+    decision_rows = stats.get('decisions') or {}
     autoscale = stats.get('autoscale') or {}
     if autoscale.get('enabled') or autoscale.get('killed') \
             or autoscale.get('actions'):
+        # Decision journal (ISSUE 20): the bare action name alone aged
+        # badly — "last scale_in" with no when/who reads as current long
+        # after the fleet settled.  Prefer the journal's last real
+        # autoscaler record: action + victim/spawn + age.
+        last = _last_decision_phrase(decision_rows.get('autoscaler')) \
+            or autoscale.get('last_action') or '-'
         lines.append(
             'autoscale %-8s outs %-3d ins %-3d suppressed %-3d last %s'
             % ('killed' if autoscale.get('killed')
                else ('on' if autoscale.get('enabled') else 'off'),
                autoscale.get('scale_outs', 0), autoscale.get('scale_ins', 0),
-               autoscale.get('suppressed', 0),
-               autoscale.get('last_action') or '-'))
+               autoscale.get('suppressed', 0), last))
+    if decision_rows:
+        # One line per control law that has decided anything: action and
+        # suppression totals plus the last real action with its age — a
+        # wedged controller (all suppressions, stale last action) is
+        # visible at a glance.  `petastorm-tpu-why` expands any of these.
+        bits = []
+        for actor in sorted(decision_rows):
+            row = decision_rows[actor] or {}
+            phrase = _last_decision_phrase(row) or '-'
+            bits.append('%s %d/%d %s'
+                        % (actor, row.get('actions', 0),
+                           row.get('suppressed', 0), phrase))
+        lines.append('decisions (acted/suppressed): %s' % '  '.join(bits))
     stages = stats.get('stages') or {}
     if stages:
         # The dispatcher built these with telemetry.summarize_hist — the

@@ -524,6 +524,30 @@ SCENARIOS = {
         'kills': [{'role': 'worker', 'phase': 'mid_epoch',
                    'signal': 'kill', 'restart': False}],
     },
+    # -- ISSUE 20: control-plane decision journal ----------------------------
+    'decision_journal_kill': {
+        'summary': 'SIGKILL the dispatcher mid-scale-storm; the restart '
+                   'restores the decision journal from the ledger '
+                   'attempt-intact, so petastorm-tpu-why still explains '
+                   'the PRE-kill scale-out (rule + inputs, replay-clean) '
+                   'and delivery stays exactly-once with zero residue',
+        'n_workers': 1,
+        'dispatcher_subprocess': True,
+        # One lease slot on one worker + single-rowgroup splits: the
+        # lone worker is saturated (free_slots 0) for essentially the
+        # whole throttled epoch, so the starve window genuinely ripens
+        # across the autoscaler's 1 Hz ticks — a guaranteed storm, not
+        # a race against epoch completion.
+        'config': {'autoscale': True, 'autoscale_min_workers': 1,
+                   'autoscale_max_workers': 2, 'autoscale_step': 1,
+                   'autoscale_cooldown_s': 1.0, 'autoscale_starve_s': 0.3,
+                   'autoscale_idle_s': 3600.0,
+                   'max_inflight_splits': 1, 'rowgroups_per_split': 1},
+        'kills': [{'role': 'dispatcher', 'phase': 'mid_epoch',
+                   'signal': 'kill', 'restart': True}],
+        'max_autoscale_actions': 6,
+        'check_decision_journal': True,
+    },
     # -- ISSUE 18: proactive materialization plane ---------------------------
     'materialize_kill': {
         'summary': 'SIGKILL the materialize controller + its warming '
@@ -551,7 +575,7 @@ _SPEC_KEYS = frozenset([
     'name', 'summary', 'protocol', 'kills', 'faults', 'config',
     'filesystem', 'cache_plane', 'n_workers', 'dispatcher_subprocess',
     'runner', 'tenants', 'max_autoscale_actions', 'throttle_s',
-    'min_entries_before_kill'])
+    'min_entries_before_kill', 'check_decision_journal'])
 
 _KILL_ROLES = ('dispatcher', 'worker', 'materialize')
 _KILL_SIGNALS = ('kill', 'term')
@@ -703,10 +727,13 @@ class _Stats(object):  # ptlint: disable=pickle-unsafe-attrs — owned by the ru
         self._rpc_cls = _Rpc
 
     def poll(self):
+        return self.call({'op': 'stats'})
+
+    def call(self, request, timeout_s=2.0):
         from petastorm_tpu.errors import ServiceError
-        rpc = self._rpc_cls(self._context, self._addr, timeout_s=2.0)
+        rpc = self._rpc_cls(self._context, self._addr, timeout_s=timeout_s)
         try:
-            return rpc.call({'op': 'stats'})
+            return rpc.call(request)
         except ServiceError:
             return None
         finally:
@@ -1057,9 +1084,26 @@ def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
                     report['checks']['kill_dispatcher'] = \
                         'scenario did not run a dispatcher subprocess'
                     continue
+                if scenario.get('check_decision_journal'):
+                    # "mid-SCALE-STORM": the kill must land after the
+                    # autoscaler actually acted (the record under test)
+                    # AND after the serve loop's next ledger tick
+                    # persisted it — otherwise the scenario measures a
+                    # race, not journal survival.
+                    while time.monotonic() < deadline \
+                            and any(t.is_alive() for t in consumers):
+                        auto = (stats.poll() or {}).get('autoscale') or {}
+                        if int(auto.get('scale_outs', 0) or 0) >= 1:
+                            break
+                        time.sleep(0.1)
+                    time.sleep(0.6)  # > one 100 ms serve-loop turn
                 dispatcher_proc.send_signal(signum)
                 dispatcher_proc.wait(timeout=30)
                 report['checks']['kill_dispatcher'] = 'killed'
+                # Wall-clock kill stamp: the decision-journal check
+                # below separates pre-kill records (must survive the
+                # ledger restore) from post-restart ones.
+                report['kill_unix'] = time.time()
                 if kill.get('restart'):
                     child_spec = dict(config_kwargs,
                                       reader_kwargs={'workers_count': 1})
@@ -1130,6 +1174,65 @@ def run_scenario(name, dataset_url, rows, workdir, seed=7, n_workers=2,
                 else 'flapping: %d action(s) > damping bound %d'
                 % (actions, int(bound)))
             all_ok = all_ok and damped
+
+        # -- decision-journal survival (ISSUE 20) ----------------------------
+        # The restarted dispatcher must still explain the PRE-kill
+        # scale-out from its ledger-restored journal: restores lineage,
+        # a pre-kill scale_out record, and the determinism cross-check
+        # clean over it (the replayed control law agrees with what the
+        # dead process recorded).
+        if scenario.get('check_decision_journal'):
+            from petastorm_tpu.telemetry import decisions as _decisions
+            from petastorm_tpu.telemetry import why as _why
+            reply = stats.call({'op': 'decisions'}, timeout_s=10.0)
+            try:
+                records, meta = _why.load_decisions(reply or {})
+            except ValueError as e:
+                report['checks']['decision_journal'] = 'no journal: %s' % e
+                records, meta = [], {}
+                all_ok = False
+            if records:
+                kill_unix = report.get('kill_unix')
+                pre_kill = [
+                    r for r in _why.filter_records(records,
+                                                   actor='autoscaler')
+                    if kill_unix is None
+                    or r.get('unix_time', 0.0) < kill_unix]
+                spawns = [r for r in pre_kill
+                          if r.get('action') == 'scale_out'
+                          and not r.get('suppressed')]
+                verdicts = [_decisions.replay_decision(r)['verdict']
+                            for r in spawns]
+                survived = int(meta.get('restores', 0) or 0) >= 1
+                journal_ok = (survived and bool(spawns)
+                              and 'divergent' not in verdicts)
+                report['checks']['decision_journal'] = (
+                    'ok (restores %d, %d pre-kill spawn record(s), '
+                    'replay %s)'
+                    % (meta.get('restores', 0), len(spawns), verdicts)
+                    if journal_ok else
+                    'restores=%s pre_kill_autoscaler=%d spawns=%d '
+                    'replay=%s'
+                    % (meta.get('restores', 0), len(pre_kill),
+                       len(spawns), verdicts))
+                all_ok = all_ok and journal_ok
+
+        # Autoscaled workers spawned by a KILLED dispatcher are orphans
+        # (their parent died without launcher close()): drain every
+        # registered worker through the control plane so they exit
+        # before teardown — leaked decode processes would outlive the
+        # matrix.
+        if overrides.get('autoscale') and use_subproc:
+            final = stats.poll() or {}
+            for wid in sorted(final.get('workers') or {}):
+                stats.call({'op': 'drain', 'worker_id': wid},
+                           timeout_s=5.0)
+            drain_deadline = time.monotonic() + 25.0
+            while time.monotonic() < drain_deadline:
+                remaining = (stats.poll() or {}).get('workers') or {}
+                if not remaining:
+                    break
+                time.sleep(0.25)
         report['ok'] = bool(all_ok)
         return report
     finally:

@@ -37,6 +37,7 @@ for tiny datasets, single-worker pools, or when
 """
 
 import os
+from petastorm_tpu.telemetry import decisions as _decisions
 from petastorm_tpu.utils.locks import make_lock
 import time
 
@@ -582,23 +583,39 @@ class Autotuner(object):
                            and hb_p99 > 4.0 * dp_p99)
 
         changed = False
+        skew_inputs = {'skew_ratio': skew, 'floor': SKEW_RATIO_FLOOR}
         if skewed:
             # reordering headroom exists: widen the window so slow
             # pieces can move earlier, deepen in-flight so the reorder
             # gap stays covered
-            changed |= self._step(knobs, 'window', 1.5,
-                                  MIN_WINDOW, MAX_WINDOW)
-            changed |= self._step(knobs, 'max_inflight', 1.25,
-                                  self._min_inflight, MAX_INFLIGHT)
+            changed |= self._step_logged(knobs, 'window', 1.5,
+                                         MIN_WINDOW, MAX_WINDOW,
+                                         'grow', 'skew_ratio_floor',
+                                         skew_inputs)
+            changed |= self._step_logged(knobs, 'max_inflight', 1.25,
+                                         self._min_inflight, MAX_INFLIGHT,
+                                         'grow', 'skew_ratio_floor',
+                                         skew_inputs)
         elif skew is not None:
             # MEASURED non-skew shrinks; no signal at all (skew None)
             # leaves the ordering knobs alone — stepping toward the
             # minimums on absence of evidence would throttle the exact
             # workloads that have not produced timings yet
-            changed |= self._step(knobs, 'window', 1 / 1.5,
-                                  MIN_WINDOW, MAX_WINDOW)
-            changed |= self._step(knobs, 'max_inflight', 1 / 1.25,
-                                  self._min_inflight, MAX_INFLIGHT)
+            changed |= self._step_logged(knobs, 'window', 1 / 1.5,
+                                         MIN_WINDOW, MAX_WINDOW,
+                                         'shrink', 'skew_ratio_floor',
+                                         skew_inputs)
+            changed |= self._step_logged(knobs, 'max_inflight', 1 / 1.25,
+                                         self._min_inflight, MAX_INFLIGHT,
+                                         'shrink', 'skew_ratio_floor',
+                                         skew_inputs)
+        else:
+            # The named no-evidence hold: the ordering knobs stay put
+            # BECAUSE there is no timing signal — a first-class
+            # suppressed non-action in the decision journal.
+            _decisions.record_decision(
+                'autotuner', 'hold', 'no_evidence_hold', skew_inputs,
+                suppressed=True)
         # The prefetch knob moves only on a MEASURED signal, same rule
         # as the ordering knobs: a StallMonitor window when one is
         # attached, else populated host_batch AND device_put histograms
@@ -606,13 +623,18 @@ class Autotuner(object):
         # user-set prefetch there would claw back overlap on zero
         # evidence).
         if wait_frac is not None:
-            changed |= self._step(knobs, 'prefetch',
-                                  2.0 if starved else 0.5,
-                                  MIN_PREFETCH, MAX_PREFETCH)
+            changed |= self._step_logged(
+                knobs, 'prefetch', 2.0 if starved else 0.5,
+                MIN_PREFETCH, MAX_PREFETCH,
+                'grow' if starved else 'shrink', 'wait_frac_floor',
+                {'wait_frac': wait_frac, 'floor': 0.1})
         elif hb_p99 is not None and dp_p99 is not None:
-            changed |= self._step(knobs, 'prefetch',
-                                  2.0 if delivery_jitter else 0.5,
-                                  MIN_PREFETCH, MAX_PREFETCH)
+            changed |= self._step_logged(
+                knobs, 'prefetch', 2.0 if delivery_jitter else 0.5,
+                MIN_PREFETCH, MAX_PREFETCH,
+                'grow' if delivery_jitter else 'shrink',
+                'delivery_jitter',
+                {'hb_p99': hb_p99, 'dp_p99': dp_p99, 'slow_factor': 4.0})
         # Ingest readahead window (ISSUE 14): decode measurably blocked
         # on an in-flight fetch this window -> deepen the readahead so
         # bytes land earlier; a window of fetches completing with zero
@@ -625,12 +647,19 @@ class Autotuner(object):
             d_fetches = fetches - self._last_ingest_fetches
             self._last_ingest_wait = wait
             self._last_ingest_fetches = fetches
+            ingest_inputs = {'d_wait_s': d_wait,
+                             'grow_s': INGEST_WAIT_GROW_S,
+                             'd_fetches': d_fetches}
             if d_wait > INGEST_WAIT_GROW_S:
-                changed |= self._step(knobs, 'ingest_window', 1.5,
-                                      MIN_INGEST_WINDOW, MAX_INGEST_WINDOW)
+                changed |= self._step_logged(
+                    knobs, 'ingest_window', 1.5,
+                    MIN_INGEST_WINDOW, MAX_INGEST_WINDOW,
+                    'grow', 'ingest_wait_grow_s', ingest_inputs)
             elif d_fetches > 0:
-                changed |= self._step(knobs, 'ingest_window', 1 / 1.25,
-                                      MIN_INGEST_WINDOW, MAX_INGEST_WINDOW)
+                changed |= self._step_logged(
+                    knobs, 'ingest_window', 1 / 1.25,
+                    MIN_INGEST_WINDOW, MAX_INGEST_WINDOW,
+                    'shrink', 'ingest_wait_grow_s', ingest_inputs)
         if self._registry is not None:
             self._g_window.set(knobs.window)
             self._g_inflight.set(knobs.max_inflight)
@@ -649,6 +678,21 @@ class Autotuner(object):
             return False
         knobs.apply(name, target)
         return True
+
+    def _step_logged(self, knobs, name, factor, lo, hi, action, rule,
+                     inputs):
+        """:meth:`_step` + a decision record when the knob actually
+        moved — the record carries the clamp arithmetic inputs so the
+        determinism cross-check can re-derive the new value."""
+        current = getattr(knobs, name)
+        changed = self._step(knobs, name, factor, lo, hi)
+        if changed:
+            _decisions.record_decision(
+                'autotuner', action, rule,
+                dict(inputs, current=current, factor=factor,
+                     lo=lo, hi=hi),
+                knob=name, new=getattr(knobs, name))
+        return changed
 
 
 def _q(hist, q):

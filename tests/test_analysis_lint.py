@@ -573,8 +573,8 @@ def test_op_catalogue_pinned_on_real_tree():
     from petastorm_tpu.analysis.rules.wire_protocol import collect_ops
     expected = {
         'service/dispatcher.py': (set(), {
-            'clock', 'complete', 'deregister', 'drain', 'heartbeat',
-            'job', 'lease', 'mark_consumed', 'register_job',
+            'clock', 'complete', 'decisions', 'deregister', 'drain',
+            'heartbeat', 'job', 'lease', 'mark_consumed', 'register_job',
             'register_worker', 'release', 'stats', 'stop', 'workers'}),
         'service/worker.py': ({'complete', 'deregister', 'heartbeat',
                                'job', 'lease', 'register_worker',
@@ -585,7 +585,11 @@ def test_op_catalogue_pinned_on_real_tree():
         'telemetry/diagnose.py': ({'stats'}, set()),
         'telemetry/top.py': ({'stats'}, set()),
         'tools/doctor.py': ({'stats'}, set()),
-        'test_util/chaos.py': ({'stats'}, set()),
+        # ISSUE 20: the chaos harness queries the decision journal after
+        # a dispatcher kill and drains orphaned autoscaled workers;
+        # `petastorm-tpu-why` reads the same RPC.
+        'test_util/chaos.py': ({'stats', 'decisions', 'drain'}, set()),
+        'telemetry/why.py': ({'decisions'}, set()),
     }
     for member, (want_sent, want_handled) in expected.items():
         full = os.path.join(REPO, 'petastorm_tpu', member)

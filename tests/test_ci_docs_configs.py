@@ -499,6 +499,8 @@ def test_console_script_entry_points_resolve():
     assert 'petastorm-tpu-explain' in names, names
     # ISSUE 19: the protocol model checker
     assert 'petastorm-tpu-model' in names, names
+    # ISSUE 20: the control-plane decision explainer
+    assert 'petastorm-tpu-why' in names, names
     for line in lines:
         _, target = [s.strip().strip('"') for s in line.split('=', 1)]
         mod, fn = target.split(':')

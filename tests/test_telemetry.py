@@ -264,7 +264,9 @@ DISPATCHER_STATS_KEYS = {
     'cache', 'shm', 'cluster_cache', 'control_plane', 'stages', 'health',
     'workers',
     # multi-tenant serving tier + closed-loop autoscaler (ISSUE 16)
-    'tenants', 'autoscale'}
+    'tenants', 'autoscale',
+    # control-plane decision journal rollup (ISSUE 20)
+    'decisions'}
 
 
 def test_golden_keys_thread_reader_and_loader(dataset):
@@ -362,7 +364,7 @@ def test_golden_keys_dispatcher_stats_and_fleet_rollup(tmp_path):
     # derived fleet health rides the same reply (ISSUE 7)
     assert stats['health']['regime'] in (
         'healthy', 'idle', 'decode-bound', 'link-bound', 'lease-starved',
-        'cache-degraded', 'shm-degraded')
+        'cache-degraded', 'shm-degraded', 'control-flapping')
     assert 'components' in stats['health']
     # per-worker clock offsets surface on the discovery poll for span
     # alignment, next to the dispatcher's own clock
@@ -376,7 +378,8 @@ def test_golden_keys_service_worker_diagnostics():
     assert set(worker.diagnostics) == WORKER_DIAG_KEYS
     beat = worker.heartbeat_stats()
     assert set(beat) == WORKER_DIAG_KEYS | {'registry', 'clock_offset',
-                                            'clock_drift_ms', 'pid'}
+                                            'clock_drift_ms', 'pid',
+                                            'decisions'}
     assert beat['registry']['namespace'] == 'service_worker'
 
 
